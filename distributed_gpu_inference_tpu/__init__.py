@@ -1,0 +1,48 @@
+"""distributed_gpu_inference_tpu — a TPU-native distributed inference framework.
+
+A from-scratch re-design of the capabilities of the reference platform
+``Baozhi888/distributed-gpu-inference`` (a federated GPU inference platform:
+FastAPI control plane + volunteer GPU workers + vLLM/SGLang engines), built
+TPU-first on JAX/XLA/Pallas:
+
+- ``models/``    pure-JAX model families (Llama-class decoders, embeddings, vision)
+- ``ops/``       Pallas TPU kernels (paged attention, flash prefill) + XLA fallbacks
+- ``parallel/``  mesh/sharding (TP/PP/DP/SP) over ICI collectives, ring attention,
+                 shard planner
+- ``runtime/``   serving engine: paged KV cache, continuous batching, speculative
+                 decoding, worker poll loop, engine registry
+- ``server/``    control plane: aiohttp REST API, sqlite-backed store, smart
+                 scheduler, PD disaggregation scheduler, reliability, security,
+                 geo, usage/privacy/admin, observability
+- ``distributed/`` cross-host data plane: pipeline sessions, KV transfer, P2P server
+- ``sdk/``       Python client SDK
+- ``utils/``     substrate: typed data structures, tensor wire framing, config
+- ``native/``    C++ components (block allocator, radix prefix index, framing codec)
+
+Subpackages are imported lazily — ``import distributed_gpu_inference_tpu`` does
+not pull in jax or aiohttp.
+"""
+
+__version__ = "0.1.0"
+
+_SUBPACKAGES = (
+    "utils",
+    "models",
+    "ops",
+    "parallel",
+    "runtime",
+    "server",
+    "distributed",
+    "sdk",
+    "native",
+)
+
+
+def __getattr__(name):
+    if name in _SUBPACKAGES:
+        import importlib
+
+        mod = importlib.import_module(f"{__name__}.{name}")
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

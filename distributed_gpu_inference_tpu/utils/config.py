@@ -1,0 +1,230 @@
+"""Worker-side configuration system.
+
+Capability parity with the reference's ``worker/config.py`` (WorkerConfig:60,
+ServerConfig:29, GPUConfig:36 → TpuConfig here, DirectConfig:43,
+LoadControlConfig:51; precedence env > yaml > defaults :138-170; dotenv
+loader :110-135; per-engine model config from env :173-188;
+DEFAULT_ENGINE_CONFIGS:191).
+
+TPU-first deltas: the accelerator section describes a TPU mesh (chip type,
+requested mesh shape and axis names for dp/tp/pp/sp) instead of CUDA device
+ids; engine defaults point at the JAX engine family rather than
+vLLM/SGLang backends.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+from pydantic import BaseModel, Field
+
+from distributed_gpu_inference_tpu.utils.data_structures import KV_BLOCK_TOKENS
+
+ENV_PREFIX = "TPU_WORKER_"
+
+
+class ServerConfig(BaseModel):
+    """Control-plane endpoint + credentials (reference ServerConfig:29)."""
+
+    url: str = "http://127.0.0.1:8000"
+    fallback_urls: List[str] = Field(default_factory=list)
+    api_key: Optional[str] = None
+    worker_id: Optional[str] = None
+    auth_token: Optional[str] = None
+    refresh_token: Optional[str] = None
+    signing_secret: Optional[str] = None
+    request_timeout_s: float = 30.0
+    verify_tls: bool = True
+
+
+class TpuConfig(BaseModel):
+    """Accelerator resources (replaces reference GPUConfig:36)."""
+
+    chip_type: str = "auto"             # auto-detect from jax.devices()
+    mesh_shape: Optional[List[int]] = None   # None → (num_devices,)
+    mesh_axis_names: List[str] = Field(default_factory=lambda: ["data"])
+    hbm_utilization: float = 0.9        # fraction of HBM the KV pool may claim
+    kv_cache_block_tokens: int = KV_BLOCK_TOKENS
+    max_model_len: int = 8192
+    dtype: str = "bfloat16"
+
+
+class DirectConfig(BaseModel):
+    """Worker-hosted direct inference endpoint (reference DirectConfig:43)."""
+
+    enabled: bool = False
+    host: str = "0.0.0.0"
+    port: int = 8471
+    public_url: Optional[str] = None
+
+
+class LoadControlConfig(BaseModel):
+    """Volunteer-friendly load shaping (reference LoadControlConfig:51)."""
+
+    acceptance_rate: float = 1.0
+    max_concurrent_jobs: int = 4
+    max_jobs_per_hour: int = 0          # 0 = unlimited
+    hbm_limit_fraction: float = 0.95
+    working_hours: Optional[Tuple[int, int]] = None   # (start_h, end_h) local
+    job_type_weights: Dict[str, float] = Field(default_factory=dict)
+    cooldown_seconds: float = 0.0
+
+
+class EngineModelConfig(BaseModel):
+    """Per-task-type engine/model selection (reference :173-188)."""
+
+    engine: str = "jax"                 # jax | jax-speculative | echo (tests)
+    model: str = "llama3-tiny"
+    dtype: str = "bfloat16"
+    quantization: Optional[str] = None  # int8 | fp8 | None
+    extra: Dict[str, Any] = Field(default_factory=dict)
+
+
+DEFAULT_ENGINE_CONFIGS: Dict[str, EngineModelConfig] = {
+    "llm": EngineModelConfig(engine="jax", model="llama3-8b"),
+    "embedding": EngineModelConfig(engine="jax-embedding", model="llama3-8b"),
+    "vision": EngineModelConfig(engine="jax-vision", model="llama3-8b-vision"),
+    "image_gen": EngineModelConfig(engine="jax-diffusion", model="tiny-diffusion"),
+    "whisper": EngineModelConfig(engine="jax-whisper", model="tiny-whisper"),
+}
+
+
+class WorkerConfig(BaseModel):
+    """Root worker configuration (reference WorkerConfig:60)."""
+
+    name: str = "tpu-worker"
+    region: str = "us-central"
+    task_types: List[str] = Field(default_factory=lambda: ["llm"])
+    server: ServerConfig = Field(default_factory=ServerConfig)
+    tpu: TpuConfig = Field(default_factory=TpuConfig)
+    direct: DirectConfig = Field(default_factory=DirectConfig)
+    load_control: LoadControlConfig = Field(default_factory=LoadControlConfig)
+    engines: Dict[str, EngineModelConfig] = Field(default_factory=dict)
+    poll_interval_s: float = 2.0
+    heartbeat_interval_s: float = 30.0
+    log_level: str = "INFO"
+    config_version: int = 0             # server-pushed remote config version
+
+    def engine_for(self, task_type: str) -> EngineModelConfig:
+        if task_type in self.engines:
+            return self.engines[task_type]
+        if task_type in DEFAULT_ENGINE_CONFIGS:
+            # deep copy: callers may mutate; the process-wide defaults must not
+            return DEFAULT_ENGINE_CONFIGS[task_type].model_copy(deep=True)
+        raise KeyError(f"no engine config for task type {task_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# Loading: defaults < yaml < env  (reference precedence :138-170)
+# ---------------------------------------------------------------------------
+
+
+def load_dotenv(path: str | Path = ".env", override: bool = False) -> Dict[str, str]:
+    """Minimal dotenv loader (reference hand-rolled loader :110-135)."""
+    loaded: Dict[str, str] = {}
+    p = Path(path)
+    if not p.exists():
+        return loaded
+    for line in p.read_text().splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip().strip("'\"")
+        if override or key not in os.environ:
+            os.environ[key] = val
+        loaded[key] = val
+    return loaded
+
+
+def _deep_update(base: Dict[str, Any], upd: Dict[str, Any]) -> Dict[str, Any]:
+    for k, v in upd.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _deep_update(base[k], v)
+        else:
+            base[k] = v
+    return base
+
+
+def _env_overrides(environ: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """TPU_WORKER_SERVER__URL=... → {"server": {"url": ...}} (``__`` nests).
+
+    Values stay strings except JSON/YAML-looking composites — pydantic performs
+    the per-field numeric/bool coercion, so a numeric-looking API key or worker
+    name is not corrupted into an int.
+    """
+    environ = os.environ if environ is None else environ
+    out: Dict[str, Any] = {}
+    for key, raw in environ.items():
+        if not key.startswith(ENV_PREFIX):
+            continue
+        path = key[len(ENV_PREFIX):].lower().split("__")
+        val: Any = raw
+        if raw.startswith(("[", "{")):
+            try:
+                val = yaml.safe_load(raw)
+            except Exception:
+                pass
+        node = out
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = val
+    return out
+
+
+def load_worker_config(
+    yaml_path: Optional[str | Path] = None,
+    environ: Optional[Dict[str, str]] = None,
+    dotenv_path: str | Path = ".env",
+    missing_ok: bool = False,
+) -> WorkerConfig:
+    """Build a WorkerConfig with precedence env > yaml > defaults.
+
+    A ``yaml_path`` that does not exist raises unless ``missing_ok=True``
+    (workers booting for the first time pass missing_ok for the default path).
+    ``.env`` is only folded into the process environment when reading from it
+    (``environ is None``) — an explicit environ mapping keeps the call hermetic.
+    """
+    if environ is None:
+        load_dotenv(dotenv_path)
+    data: Dict[str, Any] = {}
+    if yaml_path is not None:
+        p = Path(yaml_path)
+        if p.exists():
+            with open(p) as f:
+                file_data = yaml.safe_load(f) or {}
+            if not isinstance(file_data, dict):
+                raise ValueError(f"config file {yaml_path} must contain a mapping")
+            _deep_update(data, file_data)
+        elif not missing_ok:
+            raise FileNotFoundError(f"config file not found: {yaml_path}")
+    _deep_update(data, _env_overrides(environ))
+    return WorkerConfig.model_validate(data)
+
+
+def save_worker_config(cfg: WorkerConfig, yaml_path: str | Path) -> None:
+    """Persist config (the worker writes issued credentials back after
+    registration — reference main.py:133-136)."""
+    path = Path(yaml_path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(cfg.model_dump(mode="json"), f, sort_keys=False)
+
+
+def set_dotted(cfg: WorkerConfig, dotted_key: str, value: Any) -> WorkerConfig:
+    """`gpu-worker set server.url http://…` style dotted update
+    (reference cli.py:790)."""
+    data = cfg.model_dump()
+    node = data
+    parts = dotted_key.split(".")
+    for p in parts[:-1]:
+        if p not in node or not isinstance(node[p], dict):
+            raise KeyError(f"unknown config section {p!r} in {dotted_key!r}")
+        node = node[p]
+    if parts[-1] not in node:
+        raise KeyError(f"unknown config key {dotted_key!r}")
+    node[parts[-1]] = value
+    return WorkerConfig.model_validate(data)

@@ -1,0 +1,260 @@
+"""Tensor wire framing for DCN / WAN hops.
+
+Capability parity with the reference's ``common/serialization.py``
+(TensorSerializer.serialize:55/deserialize:106, serialize_tensor:163 base64
+dict for JSON transport, StreamingTensorBuffer:209 with 1 MB chunks) —
+re-designed TPU-first:
+
+- **In-slice hops never serialize.** Activations and KV pages move between
+  chips inside jitted graphs via ICI collectives (see ``parallel/``); this
+  module only frames tensors that cross DCN or the WAN (control plane, cold KV
+  tiers, cross-host pipeline hops).
+- **bfloat16 is a first-class wire dtype** (via ml_dtypes), not a float16
+  round-trip carrier like the reference's :73-76 — TPU's native dtype must
+  survive the wire bit-exactly.
+- Compression is zstd (stdlib-adjacent, in-image) with a "none" fallback;
+  the reference used lz4/zstd.
+- Works on numpy arrays and jax Arrays (converted host-side); no torch.
+
+Binary layout (little-endian)::
+
+    magic   b"TPUT"                      4 bytes
+    version u8                           1 byte
+    flags   u8 (bit0: zstd)              1 byte
+    hdr_len u32                          4 bytes
+    header  msgpack {dtype, shape}       hdr_len bytes
+    payload raw or zstd bytes            rest
+"""
+
+from __future__ import annotations
+
+import base64
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; gives native bfloat16/fp8 numpy dtypes
+    import ml_dtypes
+
+    _BFLOAT16 = np.dtype(ml_dtypes.bfloat16)
+    _EXTRA_DTYPES = {
+        "bfloat16": _BFLOAT16,
+        "float8_e4m3fn": np.dtype(ml_dtypes.float8_e4m3fn),
+        "float8_e5m2": np.dtype(ml_dtypes.float8_e5m2),
+    }
+except Exception:  # pragma: no cover - ml_dtypes is always in-image with jax
+    _EXTRA_DTYPES = {}
+
+try:
+    import msgpack
+
+    _HAVE_MSGPACK = True
+except Exception:  # pragma: no cover
+    import json as _json
+
+    _HAVE_MSGPACK = False
+
+try:
+    import zstandard as zstd
+
+    _HAVE_ZSTD = True
+except Exception:  # pragma: no cover
+    _HAVE_ZSTD = False
+
+_MAGIC = b"TPUT"
+_VERSION = 1
+_FLAG_ZSTD = 1
+
+
+def _pack_header(obj: Dict[str, Any]) -> bytes:
+    if _HAVE_MSGPACK:
+        return msgpack.packb(obj, use_bin_type=True)
+    return _json.dumps(obj).encode()  # pragma: no cover
+
+
+def _unpack_header(data: bytes) -> Dict[str, Any]:
+    if _HAVE_MSGPACK:
+        return msgpack.unpackb(data, raw=False)
+    return _json.loads(data.decode())  # pragma: no cover
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    if name in _EXTRA_DTYPES:
+        return _EXTRA_DTYPES[name]
+    return np.dtype(name)
+
+
+def _to_numpy(tensor: Any) -> np.ndarray:
+    """Host-side numpy view of a numpy array or jax Array (no torch)."""
+    if isinstance(tensor, np.ndarray):
+        return tensor
+    # jax.Array exposes __array__ / device transfer via np.asarray.
+    return np.asarray(tensor)
+
+
+class TensorSerializer:
+    """Framed binary codec for single tensors.
+
+    Parity surface: reference ``TensorSerializer.serialize``:55 /
+    ``.deserialize``:106.
+    """
+
+    def __init__(self, compress: bool = True, compression_level: int = 3,
+                 min_compress_bytes: int = 4096) -> None:
+        self.compress = compress and _HAVE_ZSTD
+        self.compression_level = compression_level
+        self.min_compress_bytes = min_compress_bytes
+
+    def serialize(self, tensor: Any) -> bytes:
+        # np.asarray(order="C") rather than ascontiguousarray: the latter
+        # promotes 0-d arrays to 1-d and would corrupt scalar shapes.
+        arr = np.asarray(_to_numpy(tensor), order="C")
+        dtype_name = (
+            "bfloat16" if _EXTRA_DTYPES and arr.dtype == _EXTRA_DTYPES.get("bfloat16")
+            else arr.dtype.name
+        )
+        payload = arr.tobytes()
+        flags = 0
+        if self.compress and len(payload) >= self.min_compress_bytes:
+            compressed = zstd.ZstdCompressor(level=self.compression_level).compress(
+                payload
+            )
+            if len(compressed) < len(payload):
+                payload = compressed
+                flags |= _FLAG_ZSTD
+        header = _pack_header({"dtype": dtype_name, "shape": list(arr.shape)})
+        return b"".join(
+            [
+                _MAGIC,
+                struct.pack("<BB", _VERSION, flags),
+                struct.pack("<I", len(header)),
+                header,
+                payload,
+            ]
+        )
+
+    def deserialize(self, data: bytes) -> np.ndarray:
+        if data[:4] != _MAGIC:
+            raise ValueError("bad magic: not a TPUT tensor frame")
+        version, flags = struct.unpack_from("<BB", data, 4)
+        if version != _VERSION:
+            raise ValueError(f"unsupported frame version {version}")
+        (hdr_len,) = struct.unpack_from("<I", data, 6)
+        header = _unpack_header(data[10 : 10 + hdr_len])
+        payload = data[10 + hdr_len :]
+        if flags & _FLAG_ZSTD:
+            if not _HAVE_ZSTD:  # pragma: no cover
+                raise RuntimeError("zstd frame but zstandard not available")
+            payload = zstd.ZstdDecompressor().decompress(payload)
+        dtype = _dtype_from_name(header["dtype"])
+        arr = np.frombuffer(payload, dtype=dtype)
+        return arr.reshape(header["shape"]).copy()
+
+
+_DEFAULT = TensorSerializer()
+
+
+def serialize_tensor_dict(tensor: Any, compress: bool = False) -> Dict[str, Any]:
+    """Base64 JSON-safe dict (reference ``serialize_tensor``:163) for
+    control-plane / debugging transport. The hot data plane never uses this."""
+    ser = TensorSerializer(compress=compress)
+    return {
+        "__tensor__": True,
+        "data": base64.b64encode(ser.serialize(tensor)).decode("ascii"),
+    }
+
+
+def deserialize_tensor_dict(d: Dict[str, Any]) -> np.ndarray:
+    if not d.get("__tensor__"):
+        raise ValueError("not a serialized tensor dict")
+    return _DEFAULT.deserialize(base64.b64decode(d["data"]))
+
+
+class StreamingTensorBuffer:
+    """Chunked streaming of a tensor frame for bounded-memory DCN transfer.
+
+    Parity: reference ``StreamingTensorBuffer``:209 (1 MB chunks with a packed
+    per-chunk header). Chunk layout::
+
+        seq   u32   chunk index
+        total u32   total chunks
+        len   u32   chunk payload length
+        data  len bytes
+    """
+
+    CHUNK_HEADER = struct.Struct("<III")
+
+    def __init__(self, chunk_bytes: int = 1 << 20,
+                 serializer: Optional[TensorSerializer] = None) -> None:
+        self.chunk_bytes = chunk_bytes
+        self.serializer = serializer or _DEFAULT
+        self._chunks: Dict[int, bytes] = {}
+        self._total: Optional[int] = None
+
+    def chunk(self, tensor: Any) -> Iterator[bytes]:
+        frame = self.serializer.serialize(tensor)
+        total = max(1, -(-len(frame) // self.chunk_bytes))
+        for i in range(total):
+            part = frame[i * self.chunk_bytes : (i + 1) * self.chunk_bytes]
+            yield self.CHUNK_HEADER.pack(i, total, len(part)) + part
+
+    def reset(self) -> None:
+        self._chunks.clear()
+        self._total = None
+
+    def feed(self, chunk: bytes) -> Optional[np.ndarray]:
+        """Feed one chunk; returns the tensor when the last chunk arrives.
+
+        Any framing error resets the buffer so a shared instance is not
+        poisoned for subsequent frames.
+        """
+        seq, total, length = self.CHUNK_HEADER.unpack_from(chunk)
+        payload = chunk[self.CHUNK_HEADER.size : self.CHUNK_HEADER.size + length]
+        if len(payload) != length:
+            self.reset()
+            raise ValueError("truncated chunk")
+        if total < 1 or seq >= total:
+            self.reset()
+            raise ValueError(f"bad chunk header seq={seq} total={total}")
+        if self._total is None:
+            self._total = total
+        elif self._total != total:
+            self.reset()
+            raise ValueError("inconsistent chunk totals")
+        self._chunks[seq] = payload
+        if len(self._chunks) == self._total:
+            frame = b"".join(self._chunks[i] for i in range(self._total))
+            self._chunks.clear()
+            self._total = None
+            return self.serializer.deserialize(frame)
+        return None
+
+
+def serialize_pytree(tree: Any, compress: bool = True) -> bytes:
+    """Frame a flat dict of tensors (e.g. per-layer KV pages) as one message.
+
+    Used by the KV migration path (reference TransferKVCache,
+    ``proto/inference.proto:19`` / ``grpc_server.py:190``) when KV crosses DCN.
+    """
+    ser = TensorSerializer(compress=compress)
+    if not isinstance(tree, dict):
+        raise TypeError("serialize_pytree expects a flat dict of tensors")
+    parts: List[bytes] = []
+    keys: List[str] = []
+    for k, v in tree.items():
+        keys.append(str(k))
+        parts.append(ser.serialize(v))
+    header = _pack_header({"keys": keys, "lens": [len(p) for p in parts]})
+    return struct.pack("<I", len(header)) + header + b"".join(parts)
+
+
+def deserialize_pytree(data: bytes) -> Dict[str, np.ndarray]:
+    (hdr_len,) = struct.unpack_from("<I", data, 0)
+    header = _unpack_header(data[4 : 4 + hdr_len])
+    out: Dict[str, np.ndarray] = {}
+    off = 4 + hdr_len
+    for k, ln in zip(header["keys"], header["lens"]):
+        out[k] = _DEFAULT.deserialize(data[off : off + ln])
+        off += ln
+    return out

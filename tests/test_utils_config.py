@@ -1,0 +1,106 @@
+"""Config precedence env > yaml > defaults (parity: reference worker/config.py)."""
+
+import pytest
+
+from distributed_gpu_inference_tpu.utils.config import (
+    DEFAULT_ENGINE_CONFIGS,
+    WorkerConfig,
+    load_dotenv,
+    load_worker_config,
+    save_worker_config,
+    set_dotted,
+)
+
+
+def test_defaults():
+    cfg = load_worker_config(environ={})
+    assert cfg.server.url == "http://127.0.0.1:8000"
+    assert cfg.tpu.dtype == "bfloat16"
+    assert cfg.engine_for("llm").engine == "jax"
+
+
+def test_yaml_overrides_defaults(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("region: eu-west\nserver:\n  url: http://cp:9000\n")
+    cfg = load_worker_config(yaml_path=p, environ={})
+    assert cfg.region == "eu-west"
+    assert cfg.server.url == "http://cp:9000"
+    assert cfg.poll_interval_s == 2.0  # untouched default
+
+
+def test_env_overrides_yaml(tmp_path):
+    p = tmp_path / "config.yaml"
+    p.write_text("server:\n  url: http://cp:9000\npoll_interval_s: 5\n")
+    env = {
+        "TPU_WORKER_SERVER__URL": "http://env:1234",
+        "TPU_WORKER_POLL_INTERVAL_S": "0.5",
+        "TPU_WORKER_DIRECT__ENABLED": "true",
+        "TPU_WORKER_TASK_TYPES": '["llm", "embedding"]',
+    }
+    cfg = load_worker_config(yaml_path=p, environ=env)
+    assert cfg.server.url == "http://env:1234"
+    assert cfg.poll_interval_s == 0.5
+    assert cfg.direct.enabled is True
+    assert cfg.task_types == ["llm", "embedding"]
+
+
+def test_save_and_reload(tmp_path):
+    cfg = load_worker_config(environ={})
+    cfg.server.auth_token = "tok123"  # credentials persisted post-registration
+    out = tmp_path / "saved.yaml"
+    save_worker_config(cfg, out)
+    cfg2 = load_worker_config(yaml_path=out, environ={})
+    assert cfg2.server.auth_token == "tok123"
+
+
+def test_dotenv_loader(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPU_WORKER_REGION", raising=False)
+    p = tmp_path / ".env"
+    p.write_text("# comment\nTPU_WORKER_REGION=ap-east\nBAD LINE\n")
+    loaded = load_dotenv(p)
+    assert loaded["TPU_WORKER_REGION"] == "ap-east"
+    import os
+
+    assert os.environ["TPU_WORKER_REGION"] == "ap-east"
+    monkeypatch.delenv("TPU_WORKER_REGION", raising=False)
+
+
+def test_set_dotted():
+    cfg = WorkerConfig()
+    cfg2 = set_dotted(cfg, "server.url", "http://x:1")
+    assert cfg2.server.url == "http://x:1"
+    with pytest.raises(KeyError):
+        set_dotted(cfg, "server.nope", 1)
+
+
+def test_numeric_looking_strings_stay_strings():
+    env = {"TPU_WORKER_SERVER__API_KEY": "123456", "TPU_WORKER_NAME": "007"}
+    cfg = load_worker_config(environ=env)
+    assert cfg.server.api_key == "123456"
+    assert cfg.name == "007"
+
+
+def test_engine_for_returns_copy_not_shared_default():
+    cfg = WorkerConfig()
+    e = cfg.engine_for("llm")
+    e.model = "mutated"
+    assert DEFAULT_ENGINE_CONFIGS["llm"].model != "mutated"
+
+
+def test_explicit_missing_yaml_raises():
+    with pytest.raises(FileNotFoundError):
+        load_worker_config(yaml_path="/nonexistent/config.yaml", environ={})
+    cfg = load_worker_config(yaml_path="/nonexistent/config.yaml", environ={},
+                             missing_ok=True)
+    assert cfg.name == "tpu-worker"
+
+
+def test_kv_block_tokens_single_source():
+    from distributed_gpu_inference_tpu.utils.data_structures import KV_BLOCK_TOKENS
+
+    assert WorkerConfig().tpu.kv_cache_block_tokens == KV_BLOCK_TOKENS
+
+
+def test_default_engine_table_covers_all_task_types():
+    assert set(DEFAULT_ENGINE_CONFIGS) >= {"llm", "embedding", "vision",
+                                           "image_gen", "whisper"}

@@ -1,0 +1,156 @@
+"""Substrate invariants (parity: reference tests/test_common_data_structures.py)."""
+
+import time
+
+import pytest
+
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    BlockRange,
+    InferenceState,
+    JobStatus,
+    KVBlockMeta,
+    ModelShardConfig,
+    SamplingParams,
+    TpuTopology,
+    WorkerInfo,
+    WorkerRole,
+    WorkerState,
+    compute_prefix_hash,
+    estimate_kv_cache_bytes,
+)
+
+
+class TestBlockRange:
+    def test_basic(self):
+        r = BlockRange(0, 8)
+        assert r.num_layers == 8
+        assert 0 in r and 7 in r and 8 not in r
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            BlockRange(5, 3)
+        with pytest.raises(ValueError):
+            BlockRange(-1, 3)
+
+    def test_overlap(self):
+        assert BlockRange(0, 4).overlaps(BlockRange(3, 8))
+        assert not BlockRange(0, 4).overlaps(BlockRange(4, 8))
+
+    def test_roundtrip(self):
+        r = BlockRange(2, 9)
+        assert BlockRange.from_dict(r.to_dict()) == r
+
+
+class TestWorkerInfo:
+    def test_availability(self):
+        w = WorkerInfo(state=WorkerState.IDLE, max_sessions=2)
+        assert w.is_available
+        w.active_sessions = 2
+        assert not w.is_available
+        w.state = WorkerState.DRAINING
+        assert not w.is_available
+
+    def test_staleness(self):
+        w = WorkerInfo()
+        assert not w.is_stale(90.0)
+        assert w.is_stale(90.0, now=w.last_heartbeat + 91)
+
+    def test_roundtrip(self):
+        w = WorkerInfo(
+            role=WorkerRole.PREFILL,
+            layer_range=BlockRange(0, 16),
+            topology=TpuTopology(chip_type="v5p", num_chips=4, mesh_shape=(2, 2),
+                                 mesh_axis_names=("data", "model")),
+        )
+        w2 = WorkerInfo.from_dict(w.to_dict())
+        assert w2.role == WorkerRole.PREFILL
+        assert w2.layer_range == BlockRange(0, 16)
+        assert w2.topology.mesh_shape == (2, 2)
+        assert w2.topology.total_hbm_gb == w.topology.total_hbm_gb
+
+
+class TestInferenceState:
+    def test_token_accounting(self):
+        st = InferenceState(max_new_tokens=3)
+        t0 = st.created_at
+        st.record_token(now=t0 + 0.1)
+        assert st.ttft_ms == pytest.approx(100.0, rel=0.01)
+        st.record_token(now=t0 + 0.2)
+        st.record_token(now=t0 + 0.3)
+        assert st.finished and st.finish_reason == "length"
+        assert st.generated_tokens == 3
+        assert st.tpot_ms == pytest.approx(100.0, rel=0.01)
+
+
+class TestKVBlockMeta:
+    def test_refcount_cow(self):
+        b = KVBlockMeta(block_id=0)
+        assert not b.is_shared
+        assert b.incref() == 2
+        assert b.is_shared
+        assert b.decref() == 1
+        assert b.decref() == 0
+        with pytest.raises(ValueError):
+            b.decref()
+
+    def test_capacity(self):
+        b = KVBlockMeta(block_id=1, num_tokens=16)
+        assert b.is_full
+
+
+class TestModelShardConfig:
+    def _cfg(self):
+        return ModelShardConfig(
+            model_name="llama3-8b",
+            num_layers=32,
+            stages=[BlockRange(0, 11), BlockRange(11, 22), BlockRange(22, 32)],
+            stage_workers=["w0", "w1", "w2"],
+        )
+
+    def test_route(self):
+        route = self._cfg().get_inference_route()
+        assert [w for w, _ in route] == ["w0", "w1", "w2"]
+        assert route[-1][1].end == 32
+
+    def test_stage_for_layer(self):
+        cfg = self._cfg()
+        assert cfg.stage_for_layer(0) == 0
+        assert cfg.stage_for_layer(11) == 1
+        assert cfg.stage_for_layer(31) == 2
+
+    def test_validation_gap(self):
+        with pytest.raises(ValueError):
+            ModelShardConfig(
+                model_name="m", num_layers=32,
+                stages=[BlockRange(0, 10), BlockRange(12, 32)],
+            )
+
+    def test_validation_incomplete(self):
+        with pytest.raises(ValueError):
+            ModelShardConfig(
+                model_name="m", num_layers=32,
+                stages=[BlockRange(0, 10), BlockRange(10, 30)],
+            )
+
+
+def test_prefix_hash_stability_and_prefix_property():
+    a = compute_prefix_hash([1, 2, 3, 4])
+    assert a == compute_prefix_hash([1, 2, 3, 4])
+    assert a != compute_prefix_hash([1, 2, 3, 5])
+    assert a == compute_prefix_hash([1, 2, 3, 4, 9, 9], upto=4)
+
+
+def test_kv_size_estimate():
+    # llama3-8b-ish: 32 layers, 8 kv heads, 128 head_dim, 4096 seq, bf16
+    n = estimate_kv_cache_bytes(32, 8, 128, 4096, 2)
+    assert n == 2 * 32 * 8 * 128 * 4096 * 2
+
+
+def test_sampling_params_roundtrip():
+    sp = SamplingParams(max_new_tokens=8, temperature=0.7, top_k=40,
+                        stop_token_ids=(1, 2))
+    assert SamplingParams.from_dict(sp.to_dict()) == sp
+
+
+def test_job_status_enum():
+    assert JobStatus("queued") is JobStatus.QUEUED

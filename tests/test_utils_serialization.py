@@ -1,0 +1,148 @@
+"""Wire framing round-trips incl. native bfloat16 + zstd + streaming chunks.
+
+Parity: reference tests/test_common_serialization.py (round-trips incl.
+bfloat16/lz4) — but here bfloat16 must survive bit-exactly (no f16 carrier).
+"""
+
+import numpy as np
+import pytest
+
+import ml_dtypes
+
+from distributed_gpu_inference_tpu.utils.serialization import (
+    StreamingTensorBuffer,
+    TensorSerializer,
+    deserialize_pytree,
+    deserialize_tensor_dict,
+    serialize_pytree,
+    serialize_tensor_dict,
+)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32, np.uint8, np.float16])
+def test_roundtrip_numpy_dtypes(dtype):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((33, 17)).astype(dtype)
+    ser = TensorSerializer(compress=False)
+    y = ser.deserialize(ser.serialize(x))
+    np.testing.assert_array_equal(x, y)
+    assert y.dtype == x.dtype
+
+
+def test_roundtrip_bfloat16_bit_exact():
+    x = np.arange(-512, 512, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    x = x.reshape(32, 32)
+    ser = TensorSerializer(compress=True, min_compress_bytes=0)
+    y = ser.deserialize(ser.serialize(x))
+    assert y.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(x.view(np.uint16), y.view(np.uint16))
+
+
+def test_compression_kicks_in_and_shrinks():
+    x = np.zeros((256, 256), dtype=np.float32)  # highly compressible
+    raw = TensorSerializer(compress=False).serialize(x)
+    comp = TensorSerializer(compress=True, min_compress_bytes=0).serialize(x)
+    assert len(comp) < len(raw) // 4
+    np.testing.assert_array_equal(
+        TensorSerializer().deserialize(comp), x
+    )
+
+
+def test_incompressible_stays_raw():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 255, size=(64, 64), dtype=np.uint8)
+    ser = TensorSerializer(compress=True, min_compress_bytes=0)
+    y = ser.deserialize(ser.serialize(x))
+    np.testing.assert_array_equal(x, y)
+
+
+@pytest.mark.parametrize("shape", [(), (0,), (0, 5), (1,)])
+def test_scalar_and_empty_shapes(shape):
+    x = np.ones(shape, dtype=np.float32)
+    y = TensorSerializer(compress=False).deserialize(
+        TensorSerializer(compress=False).serialize(x)
+    )
+    assert y.shape == x.shape
+
+
+def test_bad_magic_rejected():
+    with pytest.raises(ValueError):
+        TensorSerializer().deserialize(b"NOPE" + b"\x00" * 32)
+
+
+def test_jax_array_input():
+    import jax.numpy as jnp
+
+    x = jnp.arange(24, dtype=jnp.bfloat16).reshape(4, 6)
+    ser = TensorSerializer(compress=False)
+    y = ser.deserialize(ser.serialize(x))
+    assert y.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(np.asarray(x, dtype=np.float32),
+                                  y.astype(np.float32))
+
+
+def test_json_safe_dict_roundtrip():
+    import json
+
+    x = np.linspace(0, 1, 7, dtype=np.float32)
+    d = serialize_tensor_dict(x)
+    d2 = json.loads(json.dumps(d))
+    np.testing.assert_array_equal(deserialize_tensor_dict(d2), x)
+
+
+class TestStreaming:
+    def test_multi_chunk_roundtrip(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((700, 700)).astype(np.float32)  # ~2 MB
+        buf = StreamingTensorBuffer(chunk_bytes=1 << 18)
+        chunks = list(buf.chunk(x))
+        assert len(chunks) > 4
+        out = None
+        # deliver out of order
+        for c in reversed(chunks):
+            got = buf.feed(c)
+            if got is not None:
+                out = got
+        np.testing.assert_array_equal(out, x)
+
+    def test_single_chunk(self):
+        x = np.ones(3, dtype=np.int32)
+        buf = StreamingTensorBuffer()
+        (c,) = list(buf.chunk(x))
+        np.testing.assert_array_equal(buf.feed(c), x)
+
+
+def test_streaming_buffer_recovers_after_bad_chunk():
+    x = np.arange(1000, dtype=np.float32)
+    buf = StreamingTensorBuffer(chunk_bytes=1024)
+    chunks = list(buf.chunk(x))
+    buf.feed(chunks[0])
+    # a chunk from a different frame (wrong total) must error AND reset state
+    bad = StreamingTensorBuffer.CHUNK_HEADER.pack(0, 99, 4) + b"abcd"
+    with pytest.raises(ValueError):
+        buf.feed(bad)
+    out = None
+    for c in chunks:
+        got = buf.feed(c)
+        if got is not None:
+            out = got
+    np.testing.assert_array_equal(out, x)
+
+
+def test_streaming_buffer_rejects_bad_seq():
+    buf = StreamingTensorBuffer()
+    with pytest.raises(ValueError):
+        buf.feed(StreamingTensorBuffer.CHUNK_HEADER.pack(5, 2, 1) + b"x")
+
+
+def test_pytree_roundtrip():
+    tree = {
+        "layer0.k": np.ones((2, 16, 8), dtype=np.float16),
+        "layer0.v": np.zeros((2, 16, 8), dtype=np.float16),
+        "layer1.k": np.full((2, 16, 8), 3.0, dtype=np.float32),
+    }
+    out = deserialize_pytree(serialize_pytree(tree))
+    assert set(out) == set(tree)
+    for k in tree:
+        np.testing.assert_array_equal(out[k], tree[k])
+        assert out[k].dtype == tree[k].dtype
