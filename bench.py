@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Benchmark driver: single-chip continuous-batch decode throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+Baseline anchor: the reference claims ~50 tok/s for its native Transformers
+backend on an unspecified single GPU (docs/PHASE1_IMPLEMENTATION.md:232 —
+see BASELINE.md); vs_baseline = our aggregate decode tokens/s on one chip
+divided by that claim. Config mirrors BASELINE.json config 2 (continuous
+batching on 1 chip) at reduced batch for the random-weights model.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--decode-tokens", type=int, default=128)
+    ap.add_argument("--multi-step", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    model = args.model or ("llama3-1b" if backend == "tpu" else "llama3-mini")
+
+    import numpy as np
+
+    from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+
+    max_seq = args.prompt_len + args.decode_tokens + 16
+    eng = TPUEngine(
+        model,
+        EngineConfig(
+            max_batch_size=args.batch,
+            max_seq_len=max_seq,
+            prefill_buckets=(args.prompt_len,),
+            multi_step=args.multi_step,
+            enable_prefix_cache=False,  # throughput bench: no reuse between reqs
+        ),
+    )
+    rng = np.random.default_rng(0)
+
+    def make_reqs():
+        return [
+            InferenceRequest(
+                prompt_token_ids=rng.integers(
+                    1, eng.model_cfg.vocab_size, args.prompt_len
+                ).tolist(),
+                sampling=SamplingParams(max_new_tokens=args.decode_tokens),
+            )
+            for _ in range(args.batch)
+        ]
+
+    # warmup: compiles prefill + decode_multi graphs
+    warm = make_reqs()
+    for r in warm:
+        r.sampling.max_new_tokens = args.multi_step
+    eng.generate(warm, use_multi_step=True)
+
+    # measured run
+    reqs = make_reqs()
+    t0 = time.perf_counter()
+    resps = eng.generate(reqs, use_multi_step=True)
+    elapsed = time.perf_counter() - t0
+
+    total_decoded = sum(r.completion_tokens for r in resps)
+    total_prefill = sum(r.prompt_tokens for r in resps)
+    decode_tps = total_decoded / elapsed
+    ttfts = [r.ttft_ms for r in resps if r.ttft_ms is not None]
+
+    baseline_tps = 50.0  # reference native-backend claim (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "continuous_batch_decode_throughput_1chip",
+                "value": round(decode_tps, 2),
+                "unit": "tokens/s",
+                "vs_baseline": round(decode_tps / baseline_tps, 3),
+                "model": model,
+                "backend": backend,
+                "batch": args.batch,
+                "prompt_len": args.prompt_len,
+                "decode_tokens_per_seq": args.decode_tokens,
+                "total_decode_tokens": total_decoded,
+                "total_prefill_tokens": total_prefill,
+                "elapsed_s": round(elapsed, 3),
+                "p50_ttft_ms": round(float(np.median(ttfts)), 1) if ttfts else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Pure-JAX model families.
+
+TPU-native replacement for the reference's engine-wrapped model zoo
+(``worker/engines/llm.py`` HF Transformers, ``llm_vllm.py``, ``llm_sglang.py``):
+instead of wrapping a framework, the decoder is implemented directly as
+functional JAX over a params pytree so it jits, shards (pjit/GSPMD), and
+pipelines over a mesh without translation layers.
+"""
+
+from distributed_gpu_inference_tpu.models.configs import (  # noqa: F401
+    MODEL_REGISTRY,
+    ModelConfig,
+    get_model_config,
+)

@@ -1,0 +1,123 @@
+"""Model geometry registry (Llama-3-class decoder-only transformers).
+
+The reference selects models by HF name and lets vLLM/SGLang introspect the
+config (``worker/engines/llm_vllm.py:42``); here geometry is explicit because
+the shard planner, KV pool sizing, and mesh sharding rules all consume it
+(reference analogue: ``worker/distributed/model_shard.py:273-311``
+``analyze_model`` reconstructs exactly these numbers from an HF config).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    intermediate_size: int
+    head_dim: Optional[int] = None           # default hidden_size // num_heads
+    max_position_embeddings: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+
+    def __post_init__(self) -> None:
+        if self.head_dim is None:
+            object.__setattr__(self, "head_dim", self.hidden_size // self.num_heads)
+        if self.num_heads % self.num_kv_heads != 0:
+            raise ValueError("num_heads must be divisible by num_kv_heads (GQA)")
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    @property
+    def num_params(self) -> int:
+        """Approximate parameter count (embeddings + layers + head)."""
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        d = self.head_dim
+        attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) + (
+            self.num_heads * d
+        ) * h
+        mlp = 3 * h * i
+        norms = 2 * h
+        per_layer = attn + mlp + norms
+        emb = v * h
+        head = 0 if self.tie_word_embeddings else v * h
+        return emb + self.num_layers * per_layer + head + h
+
+    def param_bytes(self, dtype_bytes: int = 2) -> int:
+        return self.num_params * dtype_bytes
+
+    def kv_bytes_per_token(self, dtype_bytes: int = 2) -> int:
+        return 2 * self.num_layers * self.num_kv_heads * self.head_dim * dtype_bytes
+
+    def layer_param_bytes(self, dtype_bytes: int = 2) -> int:
+        """Per-layer weight bytes — the shard planner's unit of placement."""
+        h, i, d = self.hidden_size, self.intermediate_size, self.head_dim
+        attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) + (
+            self.num_heads * d
+        ) * h
+        return (attn + 3 * h * i + 2 * h) * dtype_bytes
+
+
+def _llama(name: str, **kw) -> ModelConfig:
+    return ModelConfig(name=name, **kw)
+
+
+MODEL_REGISTRY: Dict[str, ModelConfig] = {
+    # test-scale
+    "llama3-tiny": _llama(
+        "llama3-tiny", vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, intermediate_size=128,
+        max_position_embeddings=1024, rope_theta=10000.0,
+    ),
+    "llama3-mini": _llama(  # CI-scale but realistic ratios
+        "llama3-mini", vocab_size=2048, hidden_size=256, num_layers=4,
+        num_heads=8, num_kv_heads=4, intermediate_size=640,
+        max_position_embeddings=2048,
+    ),
+    # Llama 3.2 1B geometry — fits single v5e chip in bf16 with room for KV
+    "llama3-1b": _llama(
+        "llama3-1b", vocab_size=128256, hidden_size=2048, num_layers=16,
+        num_heads=32, num_kv_heads=8, intermediate_size=8192,
+        head_dim=64, tie_word_embeddings=True,
+        max_position_embeddings=131072,
+    ),
+    # Llama 3.2 3B geometry
+    "llama3-3b": _llama(
+        "llama3-3b", vocab_size=128256, hidden_size=3072, num_layers=28,
+        num_heads=24, num_kv_heads=8, intermediate_size=8192,
+        head_dim=128, tie_word_embeddings=True,
+        max_position_embeddings=131072,
+    ),
+    # Llama 3 8B geometry (BASELINE.json config 1-3)
+    "llama3-8b": _llama(
+        "llama3-8b", vocab_size=128256, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, intermediate_size=14336,
+        max_position_embeddings=8192,
+    ),
+    # Llama 3 70B geometry (BASELINE.json config 4-5)
+    "llama3-70b": _llama(
+        "llama3-70b", vocab_size=128256, hidden_size=8192, num_layers=80,
+        num_heads=64, num_kv_heads=8, intermediate_size=28672,
+        max_position_embeddings=8192,
+    ),
+}
+
+
+def get_model_config(name: str, **overrides) -> ModelConfig:
+    if name not in MODEL_REGISTRY:
+        raise KeyError(
+            f"unknown model {name!r}; known: {sorted(MODEL_REGISTRY)}"
+        )
+    cfg = MODEL_REGISTRY[name]
+    return replace(cfg, **overrides) if overrides else cfg

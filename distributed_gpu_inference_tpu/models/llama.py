@@ -1,0 +1,319 @@
+"""Llama-3-class decoder as pure functional JAX over a params pytree.
+
+TPU-native core replacing the reference's engine-wrapped models
+(``worker/engines/llm.py`` — HF Transformers generate; ``llm_vllm.py`` /
+``llm_sglang.py`` — CUDA serving engines). Design properties:
+
+- **One generic ``forward_chunk``** serves prefill (S = chunk), chunked/long
+  prefill (S = chunk with prefix), and decode (S = 1): static shapes, no
+  data-dependent Python control flow, jits once per (B, S) bucket.
+- **Paged KV is the only cache layout.** K/V live in HBM pools
+  ``[L, num_blocks, block_size, n_kv_heads, head_dim]`` addressed through
+  per-sequence block tables — the first-party equivalent of vLLM's
+  PagedAttention pools the reference delegates to (SURVEY §2.3), written
+  via scatter inside the jitted graph.
+- **Stacked layer params** (leading L axis) so layers run under ``lax.scan``
+  (fast compiles at 80 layers) and shard/pipeline cleanly over a mesh axis.
+- Attention math runs through ``ops.attention`` which picks the Pallas paged
+  kernel on TPU and a gather-based XLA fallback elsewhere.
+
+Weight-name parity with HF Llama checkpoints is handled in ``models/loader.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_gpu_inference_tpu.models.configs import ModelConfig
+from distributed_gpu_inference_tpu.ops.attention import paged_attention
+
+Params = Dict[str, Any]
+KVPools = Dict[str, jax.Array]  # {"k": [L,N,Bk,Hkv,D], "v": [L,N,Bk,Hkv,D]}
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = None
+) -> Params:
+    """Random-init params with the exact pytree layout the engine shards."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    h, d = cfg.hidden_size, cfg.head_dim
+    nh, nkv, i = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    L, v = cfg.num_layers, cfg.vocab_size
+    keys = jax.random.split(key, 8)
+
+    def _w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)).astype(
+            dtype
+        )
+
+    params: Params = {
+        "embedding": _w(keys[0], (v, h), h),
+        "layers": {
+            "attn_norm": jnp.ones((L, h), dtype),
+            "wq": _w(keys[1], (L, h, nh * d), h),
+            "wk": _w(keys[2], (L, h, nkv * d), h),
+            "wv": _w(keys[3], (L, h, nkv * d), h),
+            "wo": _w(keys[4], (L, nh * d, h), nh * d),
+            "mlp_norm": jnp.ones((L, h), dtype),
+            "w_gate": _w(keys[5], (L, h, i), h),
+            "w_up": _w(keys[6], (L, h, i), h),
+            "w_down": _w(keys[7], (L, i, h), i),
+        },
+        "final_norm": jnp.ones((h,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _w(keys[0], (v, h), h)
+    return params
+
+
+def init_kv_pools(
+    cfg: ModelConfig,
+    num_blocks: int,
+    block_size: int = 16,
+    dtype: Optional[jnp.dtype] = None,
+) -> KVPools:
+    """Device-resident paged KV pools. Block 0 is reserved as the garbage/pad
+    block — writes for padded tokens land there and reads mask it out."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return (x * weight.astype(jnp.float32)).astype(dt)
+
+
+def _rope_angles(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """positions [..., S] → (cos, sin) each [..., S, head_dim//2], float32."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Half-split RoPE (HF Llama ``rotate_half`` convention).
+
+    x: [B, S, H, D]; cos/sin: [B, S, D/2] broadcast over heads.
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[..., None, :]  # [B, S, 1, D/2]
+    s = sin[..., None, :]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * c - xf2 * s, xf2 * c + xf1 * s], axis=-1
+    ).astype(x.dtype)
+
+
+def _write_kv_pages(
+    pool: jax.Array,          # [N, Bk, Hkv, D] (single layer)
+    new: jax.Array,           # [B, S, Hkv, D]
+    block_tables: jax.Array,  # [B, M] int32 physical block ids
+    positions: jax.Array,     # [B, S] int32 token positions (-1 = pad)
+    block_size: int,
+) -> jax.Array:
+    """Scatter a chunk of new K or V rows into the paged pool.
+
+    Padded slots (position < 0) scatter out-of-bounds and are dropped.
+    """
+    b, s = positions.shape
+    num_blocks = pool.shape[0]
+    valid = positions >= 0
+    safe_pos = jnp.where(valid, positions, 0)
+    logical = safe_pos // block_size                       # [B, S]
+    slot = safe_pos % block_size                           # [B, S]
+    phys = jnp.take_along_axis(block_tables, logical, axis=1)  # [B, S]
+    # pad writes must go OUT OF RANGE to be dropped: -1 would *wrap* to the
+    # last block under jax .at[] semantics (negative indices stay in-bounds)
+    phys = jnp.where(valid, phys, num_blocks)
+    flat_phys = phys.reshape(-1)
+    flat_slot = slot.reshape(-1)
+    flat_new = new.reshape(b * s, *new.shape[2:])
+    # no unique_indices: padded rows all collapse to the same OOB index, and
+    # promising uniqueness there would be undefined behavior
+    return pool.at[flat_phys, flat_slot].set(flat_new, mode="drop")
+
+
+def _mlp(x: jax.Array, lp: Dict[str, jax.Array]) -> jax.Array:
+    gate = jax.nn.silu(x @ lp["w_gate"])
+    return ((gate * (x @ lp["w_up"])) @ lp["w_down"]).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Transformer forward over paged KV
+# ---------------------------------------------------------------------------
+
+
+class ChunkOutput(NamedTuple):
+    hidden: jax.Array       # [B, S, H] final-layer hidden states (pre-norm)
+    kv: KVPools             # updated pools
+    logits: jax.Array       # [B, S, V] (or [B, 1, V] if last_only)
+
+
+def _layer_step(
+    cfg: ModelConfig,
+    block_size: int,
+    carry: Tuple[jax.Array, jax.Array, jax.Array, jax.Array],
+    lp: Dict[str, jax.Array],
+    *,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    kv_lens: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+) -> Tuple[Tuple[jax.Array, jax.Array, jax.Array, jax.Array], None]:
+    hidden, k_pool, v_pool, layer_idx = carry
+    b, s, _ = hidden.shape
+    nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    x = rms_norm(hidden, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(b, s, nh, d)
+    k = (x @ lp["wk"]).reshape(b, s, nkv, d)
+    v = (x @ lp["wv"]).reshape(b, s, nkv, d)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    layer_k = lax.dynamic_index_in_dim(k_pool, layer_idx, 0, keepdims=False)
+    layer_v = lax.dynamic_index_in_dim(v_pool, layer_idx, 0, keepdims=False)
+    layer_k = _write_kv_pages(layer_k, k, block_tables, positions, block_size)
+    layer_v = _write_kv_pages(layer_v, v, block_tables, positions, block_size)
+    k_pool = lax.dynamic_update_index_in_dim(k_pool, layer_k, layer_idx, 0)
+    v_pool = lax.dynamic_update_index_in_dim(v_pool, layer_v, layer_idx, 0)
+
+    attn = paged_attention(
+        q, layer_k, layer_v, block_tables, positions, kv_lens, block_size
+    )
+    hidden = hidden + (attn.reshape(b, s, nh * d) @ lp["wo"]).astype(hidden.dtype)
+    hidden = hidden + _mlp(
+        rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps), lp
+    )
+    return (hidden, k_pool, v_pool, layer_idx + 1), None
+
+
+def forward_chunk(
+    cfg: ModelConfig,
+    params: Params,
+    token_ids: jax.Array,      # [B, S] int32 (pad = any id at position -1)
+    positions: jax.Array,      # [B, S] int32, -1 marks padding
+    kv: KVPools,
+    block_tables: jax.Array,   # [B, M] int32 physical block ids
+    kv_lens: jax.Array,        # [B] int32 total valid context AFTER this chunk
+    *,
+    block_size: int = 16,
+    last_only: bool = True,
+) -> ChunkOutput:
+    """Run S tokens per sequence through all layers against the paged cache.
+
+    Covers prefill (S = prompt chunk, positions start at the cached prefix
+    length) and decode (S = 1) with one traced graph per (B, S).
+    """
+    b, s = token_ids.shape
+    hidden = jnp.take(params["embedding"], token_ids, axis=0)
+
+    safe_pos = jnp.maximum(positions, 0)
+    cos, sin = _rope_angles(safe_pos, cfg.head_dim, cfg.rope_theta)
+
+    step = functools.partial(
+        _layer_step,
+        cfg,
+        block_size,
+        block_tables=block_tables,
+        positions=positions,
+        kv_lens=kv_lens,
+        cos=cos,
+        sin=sin,
+    )
+    (hidden, k_pool, v_pool, _), _ = lax.scan(
+        lambda c, lp: step(c, lp),
+        (hidden, kv["k"], kv["v"], jnp.int32(0)),
+        params["layers"],
+    )
+
+    normed = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    if last_only:
+        # last valid token per sequence = kv_lens - 1 mapped into the chunk:
+        # chunk covers positions [kv_len - n_valid, kv_len); the last valid
+        # chunk index is (number of valid positions in chunk) - 1.
+        n_valid = jnp.sum((positions >= 0).astype(jnp.int32), axis=1)  # [B]
+        last_idx = jnp.maximum(n_valid - 1, 0)
+        normed = jnp.take_along_axis(
+            normed, last_idx[:, None, None].astype(jnp.int32), axis=1
+        )  # [B, 1, H]
+    head = params.get("lm_head", params["embedding"])
+    logits = jnp.einsum(
+        "bsh,vh->bsv", normed.astype(jnp.float32), head.astype(jnp.float32)
+    )
+    return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=logits)
+
+
+def forward_hidden_chunk(
+    cfg: ModelConfig,
+    params: Params,
+    hidden: jax.Array,
+    positions: jax.Array,
+    kv: KVPools,
+    block_tables: jax.Array,
+    kv_lens: jax.Array,
+    *,
+    block_size: int = 16,
+    layer_offset: int = 0,
+) -> Tuple[jax.Array, KVPools]:
+    """Forward pre-embedded hidden states through this shard's layers.
+
+    The pipeline-parallel entry point: a stage that owns layers [a, b) calls
+    this on activations received from the previous stage (reference analogue:
+    ``worker/distributed/model_shard.py:173-228`` ModelShard.forward).
+    ``params['layers']`` holds only the owned layers; ``kv`` likewise.
+    """
+    safe_pos = jnp.maximum(positions, 0)
+    cos, sin = _rope_angles(safe_pos, cfg.head_dim, cfg.rope_theta)
+    step = functools.partial(
+        _layer_step,
+        cfg,
+        block_size,
+        block_tables=block_tables,
+        positions=positions,
+        kv_lens=kv_lens,
+        cos=cos,
+        sin=sin,
+    )
+    (hidden, k_pool, v_pool, _), _ = lax.scan(
+        lambda c, lp: step(c, lp),
+        (hidden, kv["k"], kv["v"], jnp.int32(0)),
+        params["layers"],
+    )
+    return hidden, {"k": k_pool, "v": v_pool}
+
+
+def embed_tokens(params: Params, token_ids: jax.Array) -> jax.Array:
+    """First pipeline stage: token embedding (reference model_shard.py:163-166)."""
+    return jnp.take(params["embedding"], token_ids, axis=0)
+
+
+def project_logits(cfg: ModelConfig, params: Params, hidden: jax.Array) -> jax.Array:
+    """Last pipeline stage: final norm + LM head (reference model_shard.py:168-171,
+    get_logits:230-246)."""
+    normed = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head", params["embedding"])
+    return jnp.einsum(
+        "bsh,vh->bsv", normed.astype(jnp.float32), head.astype(jnp.float32)
+    )

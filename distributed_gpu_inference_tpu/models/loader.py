@@ -1,0 +1,160 @@
+"""Weight loading: HF Llama safetensors → params pytree; orbax-native
+checkpoints; (mesh resharding hooks live in ``parallel/sharding.py``).
+
+Reference analogue: ``worker/engines/llm.py:33-36`` (AutoModelForCausalLM
+device_map load) and ``worker/distributed/model_shard.py:61-160``
+(layer-range partial loading) — re-designed: weights map straight into the
+stacked-layer pytree (leading L axis) that ``lax.scan`` and GSPMD sharding
+consume, and a pipeline stage can load only its layer range.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_gpu_inference_tpu.models.configs import ModelConfig
+from distributed_gpu_inference_tpu.utils.data_structures import BlockRange
+
+# HF parameter name → (our key, needs_transpose). Layer index is captured by
+# the regex; our layout stacks layers on a leading axis.
+_HF_LAYER_MAP = {
+    "input_layernorm.weight": ("attn_norm", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+}
+
+_LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+
+def load_hf_llama(
+    model_dir: str | Path,
+    cfg: ModelConfig,
+    dtype: Optional[Any] = None,
+    layer_range: Optional[BlockRange] = None,
+) -> Dict[str, Any]:
+    """Load a HF Llama checkpoint directory (safetensors shards) into the
+    stacked params pytree. ``layer_range`` loads only layers [start, end)
+    (pipeline stages); embeddings / final norm / head are included only for
+    the ranges that own them (first / last stage — reference
+    model_shard.py:163-171)."""
+    from safetensors import safe_open
+
+    model_dir = Path(model_dir)
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    rng = layer_range or BlockRange(0, cfg.num_layers)
+    first_stage = rng.start == 0
+    last_stage = rng.end == cfg.num_layers
+    L = rng.num_layers
+
+    files = sorted(model_dir.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {model_dir}")
+
+    layers: Dict[str, np.ndarray] = {}
+    params: Dict[str, Any] = {"layers": {}}
+
+    def _slot(our_key: str, shape: Tuple[int, ...]) -> np.ndarray:
+        if our_key not in layers:
+            layers[our_key] = np.zeros((L, *shape), dtype=dtype)
+        return layers[our_key]
+
+    for f in files:
+        with safe_open(str(f), framework="np") as st:
+            for name in st.keys():
+                m = _LAYER_RE.match(name)
+                if m:
+                    li = int(m.group(1))
+                    if li not in rng:
+                        continue
+                    sub = m.group(2)
+                    if sub not in _HF_LAYER_MAP:
+                        continue
+                    our_key, transpose = _HF_LAYER_MAP[sub]
+                    w = st.get_tensor(name)
+                    if transpose:
+                        w = w.T
+                    _slot(our_key, w.shape)[li - rng.start] = w.astype(dtype)
+                elif name == "model.embed_tokens.weight" and first_stage:
+                    params["embedding"] = jnp.asarray(st.get_tensor(name), dtype)
+                elif name == "model.norm.weight" and last_stage:
+                    params["final_norm"] = jnp.asarray(st.get_tensor(name), dtype)
+                elif name == "lm_head.weight" and last_stage and \
+                        not cfg.tie_word_embeddings:
+                    params["lm_head"] = jnp.asarray(st.get_tensor(name), dtype)
+
+    params["layers"] = {k: jnp.asarray(v) for k, v in layers.items()}
+    if cfg.tie_word_embeddings and last_stage and not first_stage:
+        # tied head on a non-first stage still needs the embedding matrix;
+        # scan every shard — multi-file checkpoints store it anywhere
+        for f in files:
+            with safe_open(str(f), framework="np") as st:
+                if "model.embed_tokens.weight" in st.keys():
+                    params["embedding"] = jnp.asarray(
+                        st.get_tensor("model.embed_tokens.weight"), dtype
+                    )
+                    break
+    _validate(params, cfg, rng)
+    return params
+
+
+def _validate(params: Dict[str, Any], cfg: ModelConfig, rng: BlockRange) -> None:
+    expected = set(_HF_LAYER_MAP[k][0] for k in _HF_LAYER_MAP)
+    got = set(params["layers"].keys())
+    if got != expected:
+        raise ValueError(f"checkpoint missing layer params: {expected - got}")
+    L = rng.num_layers
+    for k, v in params["layers"].items():
+        if v.shape[0] != L:
+            raise ValueError(f"{k}: expected {L} layers, got {v.shape[0]}")
+    if rng.start == 0 and "embedding" not in params:
+        raise ValueError("first stage missing embedding")
+    if rng.end == cfg.num_layers and "final_norm" not in params:
+        raise ValueError("last stage missing final_norm")
+
+
+# ---------------------------------------------------------------------------
+# Native checkpoints (orbax) — serving snapshots / resume (SURVEY §5.4 notes
+# the reference has none; we add weight checkpointing as a first-class op)
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(path: str | Path, params: Dict[str, Any],
+                    cfg: Optional[ModelConfig] = None) -> None:
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path / "params", params)
+    ckptr.wait_until_finished()
+    if cfg is not None:
+        (path / "model_config.json").write_text(
+            json.dumps({k: getattr(cfg, k) for k in (
+                "name", "vocab_size", "hidden_size", "num_layers", "num_heads",
+                "num_kv_heads", "intermediate_size", "head_dim",
+                "max_position_embeddings", "rope_theta", "rms_norm_eps",
+                "tie_word_embeddings", "dtype",
+            )})
+        )
+
+
+def load_checkpoint(path: str | Path, template: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    ckptr = ocp.StandardCheckpointer()
+    if template is not None:
+        return ckptr.restore(path / "params", template)
+    return ckptr.restore(path / "params")

@@ -1,0 +1,13 @@
+"""TPU kernels and compute ops.
+
+First-party replacements for the native kernels the reference borrows from
+vLLM (PagedAttention CUDA) and SGLang (RadixAttention Triton) — SURVEY §2.3:
+Pallas paged-attention over HBM block tables with a pure-XLA gather fallback,
+flash-style prefill attention, and on-device sampling.
+"""
+
+from distributed_gpu_inference_tpu.ops.attention import (  # noqa: F401
+    dense_causal_attention,
+    paged_attention,
+)
+from distributed_gpu_inference_tpu.ops.sampling import sample_tokens  # noqa: F401

@@ -1,0 +1,132 @@
+"""Attention over paged KV: XLA gather-based implementation + dense reference.
+
+This is the correctness-first fallback path (SURVEY §7 "needs a pure-XLA
+fallback (gather-based) for correctness testing"); the Pallas TPU kernel in
+``ops/paged_attention_pallas.py`` is selected automatically on TPU backends
+for the hot decode path.
+
+Semantics shared by every implementation:
+
+- KV lives in a paged pool ``[num_blocks, block_size, n_kv_heads, head_dim]``
+  per layer; a sequence's context is the concatenation of its block table's
+  pages, valid up to ``kv_lens[b]`` tokens.
+- Queries carry explicit ``positions`` (``-1`` = padding); causal masking is
+  positional: query at position p attends to context positions ``j <= p``.
+- GQA: ``n_heads`` queries share ``n_kv_heads`` KV heads.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("DGI_DISABLE_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def paged_attention(
+    q: jax.Array,             # [B, S, Nh, D]
+    k_pool: jax.Array,        # [N, Bk, Hkv, D] (single layer)
+    v_pool: jax.Array,        # [N, Bk, Hkv, D]
+    block_tables: jax.Array,  # [B, M] int32
+    positions: jax.Array,     # [B, S] int32, -1 = pad
+    kv_lens: jax.Array,       # [B] int32
+    block_size: int = 16,
+    impl: str = "auto",
+) -> jax.Array:
+    """Attention of a chunk of queries against paged context. → [B, S, Nh, D].
+
+    ``impl``: "auto" (pallas on TPU for decode, else xla), "xla", "pallas".
+    """
+    if impl == "auto":
+        if _use_pallas() and q.shape[1] == 1:
+            impl = "pallas"
+        else:
+            impl = "xla"
+    if impl == "pallas":
+        from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+            paged_attention_pallas,
+        )
+
+        return paged_attention_pallas(
+            q, k_pool, v_pool, block_tables, positions, kv_lens, block_size
+        )
+    return paged_attention_xla(
+        q, k_pool, v_pool, block_tables, positions, kv_lens, block_size
+    )
+
+
+def paged_attention_xla(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    kv_lens: jax.Array,
+    block_size: int = 16,
+) -> jax.Array:
+    b, s, nh, d = q.shape
+    hkv = k_pool.shape[2]
+    qpk = nh // hkv
+    m = block_tables.shape[1]
+    j = m * block_size
+
+    # Gather this batch's pages: [B, M, Bk, Hkv, D] → [B, J, Hkv, D]
+    k_ctx = jnp.take(k_pool, block_tables, axis=0).reshape(b, j, hkv, d)
+    v_ctx = jnp.take(v_pool, block_tables, axis=0).reshape(b, j, hkv, d)
+
+    qg = q.reshape(b, s, hkv, qpk, d).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bsgqd,bjgd->bgqsj", qg, k_ctx.astype(jnp.float32)
+    ) * (d**-0.5)
+
+    key_pos = jnp.arange(j, dtype=jnp.int32)[None, :]           # [1, J]
+    causal = positions[:, :, None] >= key_pos[:, None, :]       # [B, S, J]
+    in_len = key_pos[:, None, :] < kv_lens[:, None, None]       # [B, 1→S, J]
+    mask = (causal & in_len)[:, None, None, :, :]               # [B,1,1,S,J]
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    # fully-masked rows (padded queries) → softmax of -inf row ≈ uniform junk;
+    # zero them so padded outputs are exactly 0.
+    any_valid = jnp.any(mask, axis=-1, keepdims=True)
+    probs = jnp.where(any_valid, probs, 0.0)
+
+    out = jnp.einsum("bgqsj,bjgd->bsgqd", probs, v_ctx.astype(jnp.float32))
+    return out.reshape(b, s, nh, d).astype(q.dtype)
+
+
+def dense_causal_attention(
+    q: jax.Array,   # [B, S, Nh, D]
+    k: jax.Array,   # [B, S, Hkv, D]
+    v: jax.Array,   # [B, S, Hkv, D]
+    lengths: Optional[jax.Array] = None,  # [B] valid lengths
+) -> jax.Array:
+    """Plain causal GQA attention over contiguous KV — the test oracle."""
+    b, s, nh, d = q.shape
+    hkv = k.shape[2]
+    qpk = nh // hkv
+    qg = q.reshape(b, s, hkv, qpk, d).astype(jnp.float32)
+    scores = jnp.einsum("bsgqd,bjgd->bgqsj", qg, k.astype(jnp.float32)) * (
+        d**-0.5
+    )
+    idx = jnp.arange(s, dtype=jnp.int32)
+    mask = idx[None, :, None] >= idx[None, None, :]             # [1, S, J]
+    if lengths is not None:
+        mask = mask & (idx[None, None, :] < lengths[:, None, None])
+    scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgqsj,bjgd->bsgqd", probs, v.astype(jnp.float32))
+    return out.reshape(b, s, nh, d).astype(q.dtype)

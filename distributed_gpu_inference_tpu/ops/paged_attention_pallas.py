@@ -1,0 +1,27 @@
+"""Pallas TPU paged-attention kernel (decode path).
+
+Replaces vLLM's PagedAttention CUDA kernel (SURVEY §2.3) with a TPU kernel
+reading KV pages from HBM via block tables. Until the hand-written kernel
+lands (ops task #3), this module exposes the same signature backed by the
+XLA gather implementation so TPU execution is always correct.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def paged_attention_pallas(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+    kv_lens: jax.Array,
+    block_size: int = 16,
+) -> jax.Array:
+    from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
+
+    return paged_attention_xla(
+        q, k_pool, v_pool, block_tables, positions, kv_lens, block_size
+    )

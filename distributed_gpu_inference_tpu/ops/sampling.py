@@ -1,0 +1,62 @@
+"""On-device token sampling: greedy / temperature / top-k / top-p.
+
+The reference delegates sampling to HF ``generate`` / vLLM SamplingParams
+(``worker/engines/llm.py``, ``llm_vllm.py:190``); here it is a single jitted
+function with *traced* per-sequence controls, so one compiled graph serves any
+mix of greedy and sampled requests in a batch (no recompiles, no host sync).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jax.Array,        # [B, V] float32
+    key: jax.Array,           # PRNG key
+    temperature: jax.Array,   # [B] float32; <= 0 → greedy
+    top_k: jax.Array,         # [B] int32; <= 0 → disabled
+    top_p: jax.Array,         # [B] float32; >= 1 → disabled
+) -> jax.Array:
+    """Returns sampled token ids [B] int32. Fully traced — no Python branches."""
+    b, v = logits.shape
+    logits = logits.astype(jnp.float32)
+
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]          # desc [B, V]
+
+    # top-k: threshold at the k-th largest logit (k<=0 → keep all)
+    k = jnp.where(top_k <= 0, v, jnp.minimum(top_k, v)).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)  # [B,1]
+    masked = jnp.where(scaled >= kth, scaled, _NEG_INF)
+
+    # top-p (nucleus) over the top-k-masked distribution
+    sorted_masked = jnp.sort(masked, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_masked, axis=-1)
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    p = jnp.clip(top_p, 0.0, 1.0)[:, None]
+    # keep tokens whose *preceding* cumulative mass is < p (always ≥ 1 token)
+    keep_sorted = (cumprobs - probs_sorted) < p
+    cutoff_count = jnp.sum(keep_sorted.astype(jnp.int32), axis=-1)       # [B]
+    cutoff_val = jnp.take_along_axis(
+        sorted_masked, jnp.maximum(cutoff_count - 1, 0)[:, None], axis=-1
+    )
+    nucleus = jnp.where(masked >= cutoff_val, masked, _NEG_INF)
+
+    sampled_tok = jax.random.categorical(key, nucleus, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
+
+
+def compute_logprobs(logits: jax.Array, token_ids: jax.Array) -> jax.Array:
+    """Log-probability of chosen tokens. logits [B, V], token_ids [B] → [B]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, token_ids[:, None].astype(jnp.int32), axis=-1)[
+        :, 0
+    ]

@@ -1,0 +1,24 @@
+"""Mesh-based parallelism: TP/DP/SP/PP shardings over ICI collectives.
+
+TPU-native replacement for the reference's distribution strategies
+(SURVEY §2.2): tensor parallelism is first-class GSPMD sharding (the reference
+only passes ``tensor_parallel_size`` through to vLLM), pipeline parallelism is
+stages over a mesh axis with ``ppermute`` activation transfer (the reference
+ships base64 JSON per hop), sequence parallelism is ring attention
+(absent in the reference — green-field per SURVEY §5.7).
+"""
+
+from distributed_gpu_inference_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    AXIS_STAGE,
+    MeshPlan,
+    make_mesh,
+)
+from distributed_gpu_inference_tpu.parallel.sharding import (  # noqa: F401
+    batch_shardings,
+    kv_sharding,
+    param_shardings,
+    shard_params,
+)

@@ -1,0 +1,96 @@
+"""Device mesh construction and axis conventions.
+
+Axis names (fixed across the framework so sharding rules compose):
+
+- ``data``  — request-level data parallelism (replica groups; the reference's
+  "many independent workers" DP, SURVEY §2.2, made explicit)
+- ``model`` — tensor parallelism over attention heads / MLP width (reference:
+  passthrough ``tensor_parallel_size``, vLLM internals; here first-class)
+- ``seq``   — sequence/context parallelism (ring attention; absent upstream)
+- ``stage`` — pipeline stages (reference: worker-per-layer-range hops over
+  HTTP; here a mesh axis with ppermute'd activations)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+AXIS_STAGE = "stage"
+
+ALL_AXES = (AXIS_DATA, AXIS_STAGE, AXIS_SEQ, AXIS_MODEL)
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    """A named factorization of the device count into parallelism axes."""
+
+    data: int = 1
+    stage: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.stage * self.seq * self.model
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {
+            AXIS_DATA: self.data,
+            AXIS_STAGE: self.stage,
+            AXIS_SEQ: self.seq,
+            AXIS_MODEL: self.model,
+        }
+
+    def nontrivial_axes(self) -> Tuple[str, ...]:
+        return tuple(a for a, s in self.axis_sizes().items() if s > 1)
+
+
+def make_mesh(
+    plan: Optional[MeshPlan] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    keep_trivial_axes: bool = True,
+) -> Mesh:
+    """Build a Mesh whose axis order is (data, stage, seq, model).
+
+    The model axis is innermost so TP collectives ride the fastest ICI
+    neighbors; stage is outer so pipeline transfers cross the slower links —
+    matching the bandwidth hierarchy argument of the scaling playbook.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if plan is None:
+        plan = MeshPlan(model=len(devices))
+    if plan.num_devices != len(devices):
+        raise ValueError(
+            f"mesh plan {plan} needs {plan.num_devices} devices, got {len(devices)}"
+        )
+    shape = (plan.data, plan.stage, plan.seq, plan.model)
+    names: Tuple[str, ...] = ALL_AXES
+    if not keep_trivial_axes:
+        keep = [i for i, s in enumerate(shape) if s > 1] or [3]
+        shape = tuple(shape[i] for i in keep)
+        names = tuple(names[i] for i in keep)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, names)
+
+
+def infer_plan(
+    num_devices: int,
+    num_kv_heads: int,
+    prefer: str = "model",
+) -> MeshPlan:
+    """Pick a default factorization: TP up to the KV-head count, remainder DP.
+
+    (Sharding KV heads beyond ``num_kv_heads`` would need head replication —
+    supported later; the planner stays conservative.)
+    """
+    model = int(np.gcd(num_devices, num_kv_heads)) if prefer == "model" else 1
+    data = num_devices // model
+    return MeshPlan(data=data, model=model)

@@ -1,0 +1,99 @@
+"""GSPMD sharding rules for the Llama params pytree, KV pools, and batch state.
+
+Megatron-style tensor parallelism expressed as NamedShardings — XLA inserts
+the all-reduces over the ``model`` ICI axis (no hand-written collectives in
+the forward pass). This replaces the reference's TP-by-delegation
+(``worker/engines/llm_vllm.py:56`` just forwards ``tensor_parallel_size`` to
+vLLM's process groups; SURVEY §2.2 flags it as passthrough-only).
+
+Layout (params from ``models/llama.py``; L = stacked layer axis):
+
+==================  ===========================  ==========================
+param               shape                        spec
+==================  ===========================  ==========================
+embedding           [V, H]                       replicated
+layers.attn_norm    [L, H]                       replicated
+layers.wq           [L, H, Nh*D]                 shard out dim on ``model``
+layers.wk / wv      [L, H, Nkv*D]                shard out dim on ``model``
+layers.wo           [L, Nh*D, H]                 shard in dim on ``model``
+layers.w_gate/up    [L, H, I]                    shard out dim on ``model``
+layers.w_down       [L, I, H]                    shard in dim on ``model``
+final_norm          [H]                          replicated
+lm_head             [V, H]                       replicated
+kv pools            [L, N, Bk, Hkv, D]           shard Hkv on ``model``
+tokens/tables/lens  [B, ...]                     shard B on ``data``
+==================  ===========================  ==========================
+
+Pipeline (``stage``) sharding slices the L axis instead — see
+``parallel/pipeline.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_gpu_inference_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL
+
+
+def _ns(mesh: Mesh, *spec) -> NamedSharding:
+    # drop axis names the mesh doesn't carry (trivial axes removed)
+    clean = tuple(s if (s is None or s in mesh.axis_names) else None for s in spec)
+    return NamedSharding(mesh, P(*clean))
+
+
+def param_shardings(mesh: Mesh) -> Dict[str, Any]:
+    """NamedSharding pytree matching ``models.llama.init_params`` layout."""
+    return {
+        "embedding": _ns(mesh, None, None),
+        "layers": {
+            "attn_norm": _ns(mesh, None, None),
+            "wq": _ns(mesh, None, None, AXIS_MODEL),
+            "wk": _ns(mesh, None, None, AXIS_MODEL),
+            "wv": _ns(mesh, None, None, AXIS_MODEL),
+            "wo": _ns(mesh, None, AXIS_MODEL, None),
+            "mlp_norm": _ns(mesh, None, None),
+            "w_gate": _ns(mesh, None, None, AXIS_MODEL),
+            "w_up": _ns(mesh, None, None, AXIS_MODEL),
+            "w_down": _ns(mesh, None, AXIS_MODEL, None),
+        },
+        "final_norm": _ns(mesh, None),
+        "lm_head": _ns(mesh, None, None),
+    }
+
+
+def kv_sharding(mesh: Mesh) -> NamedSharding:
+    """KV pools [L, N, Bk, Hkv, D]: heads sharded over ``model`` so each TP
+    shard attends with its own KV heads — pages never cross chips."""
+    return _ns(mesh, None, None, None, AXIS_MODEL, None)
+
+
+def batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
+    return {
+        "tokens": _ns(mesh, AXIS_DATA, None),       # [B, S]
+        "positions": _ns(mesh, AXIS_DATA, None),    # [B, S]
+        "block_tables": _ns(mesh, AXIS_DATA, None), # [B, M]
+        "kv_lens": _ns(mesh, AXIS_DATA),            # [B]
+        "vec": _ns(mesh, AXIS_DATA),                # any per-seq vector
+        "replicated": _ns(mesh),
+    }
+
+
+def shard_params(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
+    """device_put the params pytree onto the mesh under the TP rules.
+
+    (With single-host multi-device this is a local reshard; multi-host uses
+    the same rules via jax.make_array_from_process_local_data in the loader.)
+    """
+    rules = param_shardings(mesh)
+    if "lm_head" not in params:
+        rules = dict(rules)
+        rules.pop("lm_head")
+    return jax.device_put(params, rules)
+
+
+def shard_kv(kv: Dict[str, jax.Array], mesh: Mesh) -> Dict[str, jax.Array]:
+    s = kv_sharding(mesh)
+    return {k: jax.device_put(v, s) for k, v in kv.items()}

@@ -1,0 +1,7 @@
+"""Serving runtime: paged KV management, jitted engines, continuous batching,
+speculative decoding, worker loop.
+
+TPU-native re-design of the reference's worker runtime + engine layer
+(``worker/main.py``, ``worker/batch_processor.py``, ``worker/engines/``,
+``worker/distributed/kv_cache.py``).
+"""

@@ -1,0 +1,502 @@
+"""Host-side paged KV-cache management: block allocator, radix prefix index,
+copy-on-write, LRU eviction, and multi-tier spill (HBM → host RAM → KV store).
+
+Capability parity with the reference's ``worker/distributed/kv_cache.py``
+(CacheBlock:34, PagedKVCache:79, KVCachePool:250, DistributedKVCacheManager:326
+with L1 GPU / L2 CPU / L3 Redis tiers and get_or_compute:389-445) plus the
+RadixAttention-style prefix sharing the reference rents from SGLang
+(SURVEY §2.3) — re-designed for TPU:
+
+- The *device* side is a pair of pool arrays ``[L, N, block, Hkv, D]`` owned by
+  the engine and mutated **inside jitted graphs** (scatter writes, block
+  copies). This module never holds device tensors for blocks; it owns the
+  *metadata*: free lists, refcounts, the radix tree, LRU order, and tier maps.
+- Device-side effects the metadata layer decides on (CoW copies, spill-in
+  uploads) are returned to the engine as explicit op lists
+  (:class:`PendingDeviceOps`) so the engine can apply them as one fused jitted
+  update — the TPU analogue of the reference's eager ``torch.Tensor`` block
+  copies.
+- Block 0 is reserved as the pad/garbage block (padded-token writes land
+  there) and is never allocated.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    KV_BLOCK_TOKENS,
+    KVBlockMeta,
+    compute_prefix_hash,
+)
+
+
+class OutOfBlocksError(RuntimeError):
+    pass
+
+
+@dataclass
+class PendingDeviceOps:
+    """Device-side effects for the engine to apply in its next jitted update.
+
+    copies:   (src_block, dst_block) page copies (CoW / defrag)
+    uploads:  (dst_block, host_kv) spill-tier promotions; host_kv is
+              ``np.ndarray [L, 2, block, Hkv, D]`` (k and v stacked on axis 1)
+    """
+
+    copies: List[Tuple[int, int]] = field(default_factory=list)
+    uploads: List[Tuple[int, np.ndarray]] = field(default_factory=list)
+
+    def merge(self, other: "PendingDeviceOps") -> None:
+        self.copies.extend(other.copies)
+        self.uploads.extend(other.uploads)
+
+    @property
+    def empty(self) -> bool:
+        return not self.copies and not self.uploads
+
+
+class _RadixNode:
+    __slots__ = ("children", "block_id", "parent", "edge", "last_access")
+
+    def __init__(self, parent: Optional["_RadixNode"], edge: Optional[Tuple[int, ...]],
+                 block_id: Optional[int]) -> None:
+        self.children: Dict[Tuple[int, ...], _RadixNode] = {}
+        self.block_id = block_id
+        self.parent = parent
+        self.edge = edge
+        self.last_access = time.monotonic()
+
+
+class RadixPrefixIndex:
+    """Radix tree over full token blocks for prefix-cache lookup.
+
+    Each edge is one *full* block of tokens (KV_BLOCK_TOKENS); a node holds the
+    physical block id caching that prefix block. Partial blocks are never
+    shared (matches vLLM semantics; the reference's SGLang engine exposes the
+    same behavior through RadixAttention).
+    """
+
+    def __init__(self, block_size: int = KV_BLOCK_TOKENS) -> None:
+        self.block_size = block_size
+        self.root = _RadixNode(None, None, None)
+        self._nodes_by_block: Dict[int, _RadixNode] = {}
+
+    def _chunks(self, token_ids: Sequence[int]) -> List[Tuple[int, ...]]:
+        bs = self.block_size
+        n_full = len(token_ids) // bs
+        return [tuple(token_ids[i * bs : (i + 1) * bs]) for i in range(n_full)]
+
+    def match_prefix(self, token_ids: Sequence[int]) -> List[int]:
+        """Longest cached full-block prefix → list of physical block ids."""
+        node = self.root
+        out: List[int] = []
+        now = time.monotonic()
+        for chunk in self._chunks(token_ids):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_access = now
+            out.append(child.block_id)  # type: ignore[arg-type]
+            node = child
+        return out
+
+    def insert(self, token_ids: Sequence[int], block_ids: Sequence[int]) -> int:
+        """Index ``block_ids`` as the cache of the full blocks of ``token_ids``.
+
+        Returns the number of *newly indexed* blocks (already-present prefix
+        nodes are left untouched — caller dedups against match_prefix).
+        """
+        node = self.root
+        added = 0
+        for chunk, bid in zip(self._chunks(token_ids), block_ids):
+            child = node.children.get(chunk)
+            if child is None:
+                child = _RadixNode(node, chunk, bid)
+                node.children[chunk] = child
+                self._nodes_by_block[bid] = child
+                added += 1
+            node = child
+        return added
+
+    def contains_block(self, block_id: int) -> bool:
+        return block_id in self._nodes_by_block
+
+    def is_leaf(self, block_id: int) -> bool:
+        node = self._nodes_by_block.get(block_id)
+        return node is not None and not node.children
+
+    def remove_block(self, block_id: int) -> None:
+        """Remove a (leaf) node from the tree; interior nodes must not be
+        removed or descendant chains would dangle."""
+        node = self._nodes_by_block.get(block_id)
+        if node is None:
+            return
+        if node.children:
+            raise ValueError(f"cannot evict interior radix block {block_id}")
+        del self._nodes_by_block[block_id]
+        assert node.parent is not None
+        del node.parent.children[node.edge]  # type: ignore[index]
+
+
+@dataclass
+class KVCacheStats:
+    """Hit-rate statistics (reference kv_cache.py:544 get_stats)."""
+
+    prefix_queries: int = 0
+    prefix_hit_tokens: int = 0
+    prefix_total_tokens: int = 0
+    l1_hits: int = 0
+    l2_hits: int = 0
+    l3_hits: int = 0
+    misses: int = 0
+    evictions: int = 0
+    spills: int = 0
+    cow_copies: int = 0
+    allocated_blocks: int = 0
+    cached_blocks: int = 0
+    free_blocks: int = 0
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = dict(self.__dict__)
+        d["prefix_hit_rate"] = (
+            self.prefix_hit_tokens / self.prefix_total_tokens
+            if self.prefix_total_tokens
+            else 0.0
+        )
+        return d
+
+
+class HostKVStore:
+    """L2 host-RAM spill tier: block-content-keyed numpy pages with LRU cap.
+
+    Reference analogue: DistributedKVCacheManager's CPU OrderedDict tier
+    (kv_cache.py:326, promote-on-hit :447-462).
+    """
+
+    def __init__(self, max_blocks: int = 1024) -> None:
+        self.max_blocks = max_blocks
+        self._store: "OrderedDict[str, np.ndarray]" = OrderedDict()
+
+    def get(self, key: str) -> Optional[np.ndarray]:
+        arr = self._store.get(key)
+        if arr is not None:
+            self._store.move_to_end(key)
+        return arr
+
+    def put(self, key: str, value: np.ndarray) -> None:
+        if self.max_blocks <= 0:
+            return
+        self._store[key] = value
+        self._store.move_to_end(key)
+        while len(self._store) > self.max_blocks:
+            self._store.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+
+class RemoteKVStore:
+    """L3 tier interface (reference: Redis with TTL, kv_cache.py:477-520).
+
+    The in-process default is a TTL dict; a Redis/remote-store client can be
+    dropped in by implementing get/put. Values are serialized frames so this
+    tier can sit behind a network boundary.
+    """
+
+    def __init__(self, ttl_s: float = 3600.0) -> None:
+        self.ttl_s = ttl_s
+        self._store: Dict[str, Tuple[float, bytes]] = {}
+
+    def get(self, key: str) -> Optional[bytes]:
+        item = self._store.get(key)
+        if item is None:
+            return None
+        expires, data = item
+        if time.monotonic() > expires:
+            del self._store[key]
+            return None
+        return data
+
+    def put(self, key: str, data: bytes) -> None:
+        self._store[key] = (time.monotonic() + self.ttl_s, data)
+
+    def purge_expired(self) -> int:
+        now = time.monotonic()
+        dead = [k for k, (exp, _) in self._store.items() if now > exp]
+        for k in dead:
+            del self._store[k]
+        return len(dead)
+
+
+class PagedKVCacheManager:
+    """Metadata brain for the device KV pools.
+
+    Responsibilities (reference PagedKVCache:79 + KVCachePool:250 +
+    DistributedKVCacheManager:326, unified):
+
+    - allocate/free per-sequence block chains with rollback on exhaustion
+    - radix prefix reuse with refcounted sharing + copy-on-write
+    - LRU eviction of cached (ref==0) leaf blocks, optional spill to L2/L3
+    - emits :class:`PendingDeviceOps` for the engine's jitted pool updates
+    """
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int = KV_BLOCK_TOKENS,
+        enable_prefix_cache: bool = True,
+        host_store: Optional[HostKVStore] = None,
+        remote_store: Optional[RemoteKVStore] = None,
+        spill_on_evict: bool = False,
+    ) -> None:
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.enable_prefix_cache = enable_prefix_cache
+        self.host_store = host_store
+        self.remote_store = remote_store
+        self.spill_on_evict = spill_on_evict
+
+        self.metas: Dict[int, KVBlockMeta] = {}
+        self.free_list: List[int] = list(range(num_blocks - 1, 0, -1))  # pop() → 1..
+        self.cached_lru: "OrderedDict[int, None]" = OrderedDict()  # ref==0, indexed
+        self.radix = RadixPrefixIndex(block_size)
+        self.seq_blocks: Dict[str, List[int]] = {}
+        self.seq_tokens: Dict[str, List[int]] = {}
+        self.seq_shared_count: Dict[str, int] = {}
+        self.stats = KVCacheStats()
+        self.pending = PendingDeviceOps()
+
+    # -- core alloc ---------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self.free_list)
+
+    @property
+    def num_reclaimable(self) -> int:
+        return len(self.free_list) + len(self.cached_lru)
+
+    def _pop_free_block(self) -> int:
+        if self.free_list:
+            bid = self.free_list.pop()
+        else:
+            bid = self._evict_one()
+        self.metas[bid] = KVBlockMeta(block_id=bid, capacity=self.block_size)
+        self.stats.allocated_blocks += 1
+        return bid
+
+    def _evict_one(self) -> int:
+        """Evict the LRU cached *leaf* block (reference LRU evict :229-238)."""
+        for bid in list(self.cached_lru.keys()):
+            if self.radix.is_leaf(bid):
+                self._evict_block(bid)
+                return bid
+        raise OutOfBlocksError(
+            f"KV pool exhausted: 0 free, {len(self.cached_lru)} cached "
+            "(all interior), all others pinned by active sequences"
+        )
+
+    def _evict_block(self, bid: int) -> None:
+        meta = self.metas.pop(bid, None)
+        self.cached_lru.pop(bid, None)
+        if self.spill_on_evict and meta is not None and meta.prefix_hash:
+            self.stats.spills += 1  # actual page bytes are engine-side (L1);
+            # spill content is uploaded by the engine via snapshot hooks.
+        self.radix.remove_block(bid)
+        self.stats.evictions += 1
+
+    # -- sequence lifecycle -------------------------------------------------
+
+    def allocate_sequence(self, seq_id: str, token_ids: Sequence[int]) -> Tuple[List[int], int]:
+        """Allocate the block chain for a prompt. Returns (block_ids,
+        num_cached_tokens) — the first ``num_cached_tokens`` positions already
+        hold valid KV from the prefix cache (engine skips recomputing them).
+
+        Rollback on exhaustion (reference KVCachePool:283-313).
+        """
+        if seq_id in self.seq_blocks:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        token_ids = list(token_ids)
+        n_tokens = len(token_ids)
+        needed_blocks = max(1, -(-n_tokens // self.block_size))
+
+        cached: List[int] = []
+        if self.enable_prefix_cache:
+            self.stats.prefix_queries += 1
+            self.stats.prefix_total_tokens += n_tokens
+            cached = self.radix.match_prefix(token_ids)
+            # never reuse the *entire* prompt from cache: the last token's
+            # logits must be recomputed, so keep at least one token fresh
+            while cached and len(cached) * self.block_size >= n_tokens:
+                cached.pop()
+        num_cached_tokens = len(cached) * self.block_size
+        self.stats.prefix_hit_tokens += num_cached_tokens
+        if cached:
+            self.stats.l1_hits += len(cached)
+        else:
+            self.stats.misses += 1
+
+        blocks: List[int] = []
+        try:
+            for bid in cached:
+                meta = self.metas[bid]
+                if bid in self.cached_lru:  # revive from cached → active
+                    del self.cached_lru[bid]
+                    self.stats.cached_blocks -= 1
+                    meta.ref_count = 1
+                else:
+                    meta.incref()
+                meta.touch()
+                blocks.append(bid)
+            for _ in range(needed_blocks - len(cached)):
+                blocks.append(self._pop_free_block())
+        except OutOfBlocksError:
+            # undo exactly what was done: drop OUR reference only; a block
+            # another sequence still holds must never reach the free list
+            for bid in blocks:
+                if self.metas[bid].decref() == 0:
+                    self._deactivate_block(bid)
+            raise
+        self.seq_blocks[seq_id] = blocks
+        self.seq_tokens[seq_id] = token_ids
+        self.seq_shared_count[seq_id] = len(cached)
+        return blocks, num_cached_tokens
+
+    def append_token(self, seq_id: str, token_id: int) -> Optional[int]:
+        """Account one generated token; returns a newly allocated block id if
+        the sequence crossed a block boundary, else None. Applies CoW if the
+        tail block is shared."""
+        blocks = self.seq_blocks[seq_id]
+        tokens = self.seq_tokens[seq_id]
+        pos = len(tokens)
+        tokens.append(token_id)
+        logical = pos // self.block_size
+        if logical >= len(blocks):
+            bid = self._pop_free_block()
+            blocks.append(bid)
+            return bid
+        tail = blocks[logical]
+        meta = self.metas[tail]
+        if meta.is_shared:
+            new_bid = self._pop_free_block()
+            meta.decref()
+            blocks[logical] = new_bid
+            self.pending.copies.append((tail, new_bid))
+            self.stats.cow_copies += 1
+            return new_bid
+        return None
+
+    def reserve_tokens(self, seq_id: str, n: int) -> List[int]:
+        """Pre-allocate blocks so the sequence can grow by ``n`` tokens without
+        further allocation (required before a multi-step on-device decode scan,
+        where the host cannot allocate mid-scan). Also copy-on-writes a shared
+        tail block. Returns newly allocated block ids."""
+        blocks = self.seq_blocks[seq_id]
+        cur = len(self.seq_tokens[seq_id])
+        needed = max(1, -(-(cur + n) // self.block_size))
+        added: List[int] = []
+        try:
+            # CoW the block the next token lands in, if shared
+            logical = cur // self.block_size
+            if logical < len(blocks):
+                tail = blocks[logical]
+                meta = self.metas[tail]
+                if meta.is_shared:
+                    new_bid = self._pop_free_block()
+                    meta.decref()
+                    blocks[logical] = new_bid
+                    self.pending.copies.append((tail, new_bid))
+                    self.stats.cow_copies += 1
+                    added.append(new_bid)
+            while len(blocks) < needed:
+                bid = self._pop_free_block()
+                blocks.append(bid)
+                added.append(bid)
+        except OutOfBlocksError:
+            raise
+        return added
+
+    def commit_tokens(self, seq_id: str, token_ids: Sequence[int]) -> None:
+        """Record tokens whose KV was written on-device into already-reserved
+        blocks (the multi-step decode path's post-scan bookkeeping)."""
+        self.seq_tokens[seq_id].extend(int(t) for t in token_ids)
+        if (len(self.seq_tokens[seq_id]) + self.block_size - 1) // self.block_size \
+                > len(self.seq_blocks[seq_id]):
+            raise RuntimeError(
+                f"sequence {seq_id} outgrew its reserved blocks — reserve_tokens "
+                "must cover the scan horizon"
+            )
+
+    def free_sequence(self, seq_id: str, cache: bool = True) -> None:
+        """Release a sequence's blocks; full blocks are kept as prefix cache
+        (ref 0, LRU-ordered) when ``cache=True``."""
+        blocks = self.seq_blocks.pop(seq_id)
+        tokens = self.seq_tokens.pop(seq_id, [])
+        self.seq_shared_count.pop(seq_id, None)
+        n_full = len(tokens) // self.block_size
+        if cache and self.enable_prefix_cache and n_full > 0:
+            self.radix.insert(tokens, blocks[:n_full])
+        for i, bid in enumerate(blocks):
+            meta = self.metas.get(bid)
+            if meta is None:
+                continue
+            remaining = meta.decref()
+            if remaining == 0:
+                if cache and self.enable_prefix_cache and i < n_full and \
+                        self.radix.contains_block(bid):
+                    full_tokens = (i + 1) * self.block_size
+                    meta.prefix_hash = compute_prefix_hash(tokens, full_tokens)
+                self._deactivate_block(bid)
+
+    def _deactivate_block(self, bid: int) -> None:
+        """A block whose refcount just hit 0: park it as reusable cache if the
+        radix still indexes it (interior nodes CANNOT be freed — descendant
+        chains would dangle and match_prefix would hand out a freed id);
+        otherwise return it to the free list."""
+        if self.radix.contains_block(bid):
+            self.cached_lru[bid] = None
+            self.cached_lru.move_to_end(bid)
+            self.stats.cached_blocks += 1
+        else:
+            self.metas.pop(bid, None)
+            self.free_list.append(bid)
+
+    def _release_block(self, bid: int) -> None:
+        """Force-free a block KNOWN to be unreferenced and unindexed."""
+        self.metas.pop(bid, None)
+        self.cached_lru.pop(bid, None)
+        if self.radix.contains_block(bid):
+            if not self.radix.is_leaf(bid):
+                raise ValueError(
+                    f"refusing to force-free interior radix block {bid}"
+                )
+            self.radix.remove_block(bid)
+        self.free_list.append(bid)
+
+    # -- engine handshake ---------------------------------------------------
+
+    def take_pending_ops(self) -> PendingDeviceOps:
+        ops, self.pending = self.pending, PendingDeviceOps()
+        return ops
+
+    def block_table_for(self, seq_id: str, max_blocks: int, pad: int = 0) -> np.ndarray:
+        blocks = self.seq_blocks[seq_id]
+        if len(blocks) > max_blocks:
+            raise ValueError(
+                f"sequence {seq_id} uses {len(blocks)} blocks > table width {max_blocks}"
+            )
+        table = np.full((max_blocks,), pad, dtype=np.int32)
+        table[: len(blocks)] = blocks
+        return table
+
+    def get_stats(self) -> Dict[str, Any]:
+        self.stats.free_blocks = len(self.free_list)
+        return self.stats.as_dict()

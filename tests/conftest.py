@@ -6,8 +6,9 @@ sharding tests via ``--xla_force_host_platform_device_count=8``.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,7 +16,17 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-import sys
+# A TPU-tunnel plugin (axon sitecustomize, if present on PYTHONPATH) may have
+# already imported jax at interpreter startup and forced its own platform
+# selection — in that case the env var above is ignored and any jax call would
+# try to dial the (possibly unavailable) remote TPU. Flip the live config back
+# to CPU before any backend initializes; tests must be hermetic (SURVEY §4).
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parent.parent

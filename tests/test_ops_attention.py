@@ -1,0 +1,141 @@
+"""Paged attention correctness vs the dense causal oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from distributed_gpu_inference_tpu.ops.attention import (
+    dense_causal_attention,
+    paged_attention_xla,
+)
+
+BLOCK = 16
+
+
+def _paged_layout(k, v, num_blocks, block_size=BLOCK):
+    """Pack contiguous [B,S,H,D] KV into a paged pool + block tables."""
+    b, s, h, d = k.shape
+    m = -(-s // block_size)
+    k_pool = np.zeros((num_blocks, block_size, h, d), np.float32)
+    v_pool = np.zeros((num_blocks, block_size, h, d), np.float32)
+    tables = np.zeros((b, m), np.int32)
+    nxt = 1  # block 0 reserved
+    for bi in range(b):
+        for mi in range(m):
+            tables[bi, mi] = nxt
+            lo, hi = mi * block_size, min((mi + 1) * block_size, s)
+            k_pool[nxt, : hi - lo] = k[bi, lo:hi]
+            v_pool[nxt, : hi - lo] = v[bi, lo:hi]
+            nxt += 1
+    return jnp.asarray(k_pool), jnp.asarray(v_pool), jnp.asarray(tables)
+
+
+@pytest.mark.parametrize("s,lens", [(16, [16, 16]), (40, [40, 23])])
+def test_paged_matches_dense_full_chunk(s, lens):
+    rng = np.random.default_rng(0)
+    b, nh, hkv, d = 2, 8, 4, 16
+    q = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+    k = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    lengths = jnp.asarray(lens, jnp.int32)
+
+    dense = dense_causal_attention(q, jnp.asarray(k), jnp.asarray(v), lengths)
+
+    k_pool, v_pool, tables = _paged_layout(k, v, num_blocks=2 + 2 * ((s + 15) // 16))
+    positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+    for bi, ln in enumerate(lens):
+        positions[bi, ln:] = -1
+    paged = paged_attention_xla(
+        q, k_pool, v_pool, tables, jnp.asarray(positions), lengths, BLOCK
+    )
+    # compare only valid query positions
+    for bi, ln in enumerate(lens):
+        np.testing.assert_allclose(
+            np.asarray(paged[bi, :ln]), np.asarray(dense[bi, :ln]),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_decode_query_matches_dense_last_position():
+    rng = np.random.default_rng(1)
+    b, s, nh, hkv, d = 3, 33, 4, 2, 8
+    q_full = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+    k = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    dense = dense_causal_attention(q_full, jnp.asarray(k), jnp.asarray(v))
+
+    k_pool, v_pool, tables = _paged_layout(k, v, num_blocks=2 + 3 * 3)
+    q_last = q_full[:, -1:, :, :]
+    positions = np.full((b, 1), s - 1, np.int32)
+    lens = jnp.full((b,), s, jnp.int32)
+    paged = paged_attention_xla(
+        q_last, k_pool, v_pool, tables, jnp.asarray(positions), lens, BLOCK
+    )
+    np.testing.assert_allclose(
+        np.asarray(paged[:, 0]), np.asarray(dense[:, -1]), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_padded_queries_output_zero():
+    rng = np.random.default_rng(2)
+    b, s, nh, hkv, d = 1, 16, 2, 1, 8
+    q = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+    k = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    v = rng.standard_normal((b, s, hkv, d)).astype(np.float32)
+    k_pool, v_pool, tables = _paged_layout(k, v, num_blocks=3)
+    positions = np.full((b, s), -1, np.int32)  # every query padded
+    out = paged_attention_xla(
+        q, k_pool, v_pool, tables, jnp.asarray(positions),
+        jnp.asarray([0], jnp.int32), BLOCK,
+    )
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+def test_sampling_greedy_and_filters():
+    from distributed_gpu_inference_tpu.ops.sampling import sample_tokens
+
+    logits = jnp.asarray([[0.0, 5.0, 1.0, -2.0]] * 3, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    # greedy (temp 0) always argmax
+    toks = sample_tokens(
+        logits, key,
+        temperature=jnp.asarray([0.0, 0.0, 0.0]),
+        top_k=jnp.asarray([0, 0, 0]),
+        top_p=jnp.asarray([1.0, 1.0, 1.0]),
+    )
+    assert toks.tolist() == [1, 1, 1]
+    # top_k=1 sampling == greedy even at high temperature
+    toks = sample_tokens(
+        logits, key,
+        temperature=jnp.asarray([5.0, 5.0, 5.0]),
+        top_k=jnp.asarray([1, 1, 1]),
+        top_p=jnp.asarray([1.0, 1.0, 1.0]),
+    )
+    assert toks.tolist() == [1, 1, 1]
+    # tiny top_p nucleus collapses to argmax
+    toks = sample_tokens(
+        logits, key,
+        temperature=jnp.asarray([1.0] * 3),
+        top_k=jnp.asarray([0] * 3),
+        top_p=jnp.asarray([1e-6] * 3),
+    )
+    assert toks.tolist() == [1, 1, 1]
+
+
+def test_sampling_respects_top_k_support():
+    from distributed_gpu_inference_tpu.ops.sampling import sample_tokens
+
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]], jnp.float32)
+    seen = set()
+    for i in range(50):
+        toks = sample_tokens(
+            logits, jax.random.PRNGKey(i),
+            temperature=jnp.asarray([2.0]),
+            top_k=jnp.asarray([2]),
+            top_p=jnp.asarray([1.0]),
+        )
+        seen.add(int(toks[0]))
+    assert seen <= {2, 3}  # only the top-2 tokens can appear
+    assert len(seen) == 2

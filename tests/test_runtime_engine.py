@@ -1,0 +1,114 @@
+"""Engine end-to-end on the tiny model: determinism, prefix cache, stop
+tokens, multi-step scan equivalence, slot recycling."""
+
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+ECFG = EngineConfig(
+    max_batch_size=4, max_seq_len=128, prefill_buckets=(16, 32, 64), multi_step=8
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return TPUEngine("llama3-tiny", ECFG)
+
+
+def _req(prompt, max_new=8, **kw):
+    return InferenceRequest(
+        prompt_token_ids=prompt, sampling=SamplingParams(max_new_tokens=max_new, **kw)
+    )
+
+
+def test_greedy_deterministic(engine):
+    p = list(range(10, 30))
+    r1 = engine.generate([_req(p)])[0]
+    r2 = engine.generate([_req(p)])[0]
+    assert r1.token_ids == r2.token_ids
+    assert r1.completion_tokens == 8
+    assert r1.finish_reason == "length"
+    assert r1.ttft_ms is not None and r1.e2e_ms is not None
+
+
+def test_prefix_cache_hit_on_repeat(engine):
+    p = list(range(40, 80))  # 40 tokens → 2 full blocks cacheable
+    r1 = engine.generate([_req(p)])[0]
+    r2 = engine.generate([_req(p)])[0]
+    assert r2.cached_tokens >= 32
+    assert r1.token_ids == r2.token_ids  # cache must not change results
+
+
+def test_batch_matches_solo(engine):
+    pa, pb = list(range(5, 25)), list(range(100, 130))
+    solo_a = engine.generate([_req(pa)])[0]
+    solo_b = engine.generate([_req(pb)])[0]
+    both = engine.generate([_req(pa), _req(pb)])
+    assert both[0].token_ids == solo_a.token_ids
+    assert both[1].token_ids == solo_b.token_ids
+
+
+def test_multi_step_equivalence():
+    e1 = TPUEngine("llama3-tiny", ECFG)
+    e2 = TPUEngine("llama3-tiny", ECFG)
+    p = list(range(10, 30))
+    r1 = e1.generate([_req(p, max_new=20)])[0]
+    r2 = e2.generate([_req(p, max_new=20)], use_multi_step=True)[0]
+    assert r1.token_ids == r2.token_ids
+
+
+def test_stop_token(engine):
+    p = list(range(10, 30))
+    free_run = engine.generate([_req(p, max_new=12)])[0]
+    assert len(free_run.token_ids) == 12
+    stop_at = free_run.token_ids[3]  # stop when the 4th token appears
+    stopped = engine.generate(
+        [_req(p, max_new=12, stop_token_ids=(stop_at,))]
+    )[0]
+    assert stopped.finish_reason == "stop"
+    assert stopped.token_ids == free_run.token_ids[:3]
+
+
+def test_stop_token_multi_step():
+    e1 = TPUEngine("llama3-tiny", ECFG)
+    p = list(range(10, 30))
+    free_run = e1.generate([_req(p, max_new=12)])[0]
+    stop_at = free_run.token_ids[3]
+    e2 = TPUEngine("llama3-tiny", ECFG)
+    stopped = e2.generate([_req(p, max_new=12, stop_token_ids=(stop_at,))],
+                          use_multi_step=True)[0]
+    assert stopped.finish_reason == "stop"
+    assert stopped.token_ids == free_run.token_ids[:3]
+
+
+def test_sampled_generation_runs(engine):
+    p = list(range(10, 30))
+    r = engine.generate([_req(p, max_new=6, temperature=0.8, top_k=40,
+                              top_p=0.9)])[0]
+    assert len(r.token_ids) == 6
+    assert all(0 <= t < 512 for t in r.token_ids)
+
+
+def test_slot_exhaustion_and_recycling(engine):
+    # more requests than slots: generate() runs in waves
+    reqs = [_req(list(range(i, i + 12)), max_new=4) for i in range(10, 20)]
+    resps = engine.generate(reqs)
+    assert len(resps) == 10
+    assert all(r.completion_tokens == 4 for r in resps)
+    assert engine.num_active == 0
+
+
+def test_prompt_too_long_rejected(engine):
+    with pytest.raises(ValueError):
+        engine.submit(_req(list(range(200)), max_new=8))
+
+
+def test_engine_stats(engine):
+    s = engine.get_stats()
+    assert s["requests"] > 0
+    assert s["kv_cache"]["prefix_queries"] > 0
