@@ -176,11 +176,14 @@ def _layer_step(
     lp: Dict[str, jax.Array],
     *,
     block_tables: jax.Array,
-    positions: jax.Array,
-    kv_lens: jax.Array,
+    write_positions: jax.Array,   # where this chunk's KV lands (-1 = drop)
     cos: jax.Array,
     sin: jax.Array,
+    attn_fn,                      # (q, layer_k, layer_v) -> attention output
 ) -> Tuple[Tuple[jax.Array, jax.Array, jax.Array, jax.Array], None]:
+    """One transformer layer over paged KV — shared by the causal decode path
+    and the speculative tree-verify path (they differ only in the attention
+    mask and in where KV rows are written)."""
     hidden, k_pool, v_pool, layer_idx = carry
     b, s, _ = hidden.shape
     nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -194,14 +197,12 @@ def _layer_step(
 
     layer_k = lax.dynamic_index_in_dim(k_pool, layer_idx, 0, keepdims=False)
     layer_v = lax.dynamic_index_in_dim(v_pool, layer_idx, 0, keepdims=False)
-    layer_k = _write_kv_pages(layer_k, k, block_tables, positions, block_size)
-    layer_v = _write_kv_pages(layer_v, v, block_tables, positions, block_size)
+    layer_k = _write_kv_pages(layer_k, k, block_tables, write_positions, block_size)
+    layer_v = _write_kv_pages(layer_v, v, block_tables, write_positions, block_size)
     k_pool = lax.dynamic_update_index_in_dim(k_pool, layer_k, layer_idx, 0)
     v_pool = lax.dynamic_update_index_in_dim(v_pool, layer_v, layer_idx, 0)
 
-    attn = paged_attention(
-        q, layer_k, layer_v, block_tables, positions, kv_lens, block_size
-    )
+    attn = attn_fn(q, layer_k, layer_v)
     hidden = hidden + (attn.reshape(b, s, nh * d) @ lp["wo"]).astype(hidden.dtype)
     hidden = hidden + _mlp(
         rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps), lp
@@ -232,15 +233,20 @@ def forward_chunk(
     safe_pos = jnp.maximum(positions, 0)
     cos, sin = _rope_angles(safe_pos, cfg.head_dim, cfg.rope_theta)
 
+    def attn_fn(q, layer_k, layer_v):
+        return paged_attention(
+            q, layer_k, layer_v, block_tables, positions, kv_lens, block_size
+        )
+
     step = functools.partial(
         _layer_step,
         cfg,
         block_size,
         block_tables=block_tables,
-        positions=positions,
-        kv_lens=kv_lens,
+        write_positions=positions,
         cos=cos,
         sin=sin,
+        attn_fn=attn_fn,
     )
     (hidden, k_pool, v_pool, _), _ = lax.scan(
         lambda c, lp: step(c, lp),
@@ -248,20 +254,69 @@ def forward_chunk(
         params["layers"],
     )
 
-    normed = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
     if last_only:
         # last valid token per sequence = kv_lens - 1 mapped into the chunk:
         # chunk covers positions [kv_len - n_valid, kv_len); the last valid
         # chunk index is (number of valid positions in chunk) - 1.
         n_valid = jnp.sum((positions >= 0).astype(jnp.int32), axis=1)  # [B]
         last_idx = jnp.maximum(n_valid - 1, 0)
-        normed = jnp.take_along_axis(
-            normed, last_idx[:, None, None].astype(jnp.int32), axis=1
+        logits_in = jnp.take_along_axis(
+            hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
         )  # [B, 1, H]
-    head = params.get("lm_head", params["embedding"])
-    logits = jnp.einsum(
-        "bsh,vh->bsv", normed.astype(jnp.float32), head.astype(jnp.float32)
+    else:
+        logits_in = hidden
+    logits = project_logits(cfg, params, logits_in)
+    return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=logits)
+
+
+def forward_tree_chunk(
+    cfg: ModelConfig,
+    params: Params,
+    token_ids: jax.Array,       # [B, N] tree-node tokens
+    rope_positions: jax.Array,  # [B, N] semantic positions (prefix + depth)
+    cache_positions: jax.Array, # [B, N] KV slot positions (prefix + node idx)
+    kv: KVPools,
+    block_tables: jax.Array,    # [B, M]
+    prefix_lens: jax.Array,     # [B] committed context before the tree
+    tree_mask: jax.Array,       # [N, N] ancestor-visibility mask
+    *,
+    block_size: int = 16,
+) -> ChunkOutput:
+    """Target forward over a speculative token tree (the verify pass).
+
+    RoPE uses semantic depth positions; KV pages are written at distinct
+    node-indexed slots so sibling nodes don't collide. After acceptance the
+    engine compacts the winning path's pages (see
+    ``runtime/speculative.py``). Reference analogue:
+    ``worker/engines/speculative.py:419-453`` _verify_candidates.
+    """
+    from distributed_gpu_inference_tpu.ops.attention import paged_tree_attention
+
+    hidden = jnp.take(params["embedding"], token_ids, axis=0)
+    cos, sin = _rope_angles(
+        jnp.maximum(rope_positions, 0), cfg.head_dim, cfg.rope_theta
     )
+
+    def attn_fn(q, layer_k, layer_v):
+        return paged_tree_attention(
+            q, layer_k, layer_v, block_tables, prefix_lens, tree_mask, block_size
+        )
+
+    step = functools.partial(
+        _layer_step,
+        cfg,
+        block_size,
+        block_tables=block_tables,
+        write_positions=cache_positions,
+        cos=cos,
+        sin=sin,
+        attn_fn=attn_fn,
+    )
+    (hidden, k_pool, v_pool, _), _ = lax.scan(
+        lambda c, lp: step(c, lp), (hidden, kv["k"], kv["v"], jnp.int32(0)),
+        params["layers"],
+    )
+    logits = project_logits(cfg, params, hidden)
     return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=logits)
 
 
@@ -286,15 +341,21 @@ def forward_hidden_chunk(
     """
     safe_pos = jnp.maximum(positions, 0)
     cos, sin = _rope_angles(safe_pos, cfg.head_dim, cfg.rope_theta)
+
+    def attn_fn(q, layer_k, layer_v):
+        return paged_attention(
+            q, layer_k, layer_v, block_tables, positions, kv_lens, block_size
+        )
+
     step = functools.partial(
         _layer_step,
         cfg,
         block_size,
         block_tables=block_tables,
-        positions=positions,
-        kv_lens=kv_lens,
+        write_positions=positions,
         cos=cos,
         sin=sin,
+        attn_fn=attn_fn,
     )
     (hidden, k_pool, v_pool, _), _ = lax.scan(
         lambda c, lp: step(c, lp),

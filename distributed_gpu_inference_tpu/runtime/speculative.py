@@ -1,0 +1,559 @@
+"""Speculative decoding: EAGLE-style feature-level draft head + token-tree
+verification, executed as ONE jitted device step (draft → verify → accept →
+KV-compact) with no host round-trips inside the step.
+
+Capability parity with the reference's ``worker/engines/speculative.py``
+(DraftHead:59 predicting the next hidden from [hidden; tok-emb]:98-125 and
+sharing the target's embedding/LM head:94, token tree with ancestor-visibility
+attention mask:184-213, longest-accepted-path trace:215-245,
+draft→verify→accept loop decode_step:305-365, greedy match acceptance
+:445-453, adaptive depth on accept-rate:456-463, MedusaHead:474-513) —
+re-designed TPU-first (SURVEY §7 item 5, BASELINE north star: "rewrite the
+EAGLE-3 draft/verify loop as a single XLA computation with on-device tree
+verification"):
+
+- The reference drafts token-by-token in Python and verifies with a dynamic
+  mask built per step; here the tree SHAPE is static (widths per depth), so
+  the whole draft+verify+accept step is one compiled graph.
+- Tree-node KV lands in the same paged pools the engine serves from, written
+  at node-indexed slots; the accepted path is compacted on device (gather →
+  scatter of the winning pages), so a speculative step leaves the cache
+  exactly as 1+A committed decode steps would have.
+- **Greedy-equivalence invariant**: with temperature 0 the emitted stream is
+  bit-identical to vanilla greedy decode regardless of draft quality — the
+  draft only affects speed. Tests enforce this.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_gpu_inference_tpu.models import llama
+from distributed_gpu_inference_tpu.models.configs import ModelConfig, get_model_config
+from distributed_gpu_inference_tpu.runtime.kv_cache import PagedKVCacheManager
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    InferenceResponse,
+)
+
+
+# ---------------------------------------------------------------------------
+# Static token-tree topology
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TreeTopology:
+    """Node 0 is the root (the pending token); ``widths[d]`` children per
+    frontier node at depth d+1. Static → the step compiles once per shape."""
+
+    widths: Tuple[int, ...] = (4, 2)
+
+    @functools.cached_property
+    def parents(self) -> np.ndarray:
+        parents = [-1]
+        frontier = [0]
+        for w in self.widths:
+            nxt: List[int] = []
+            for p in frontier:
+                for _ in range(w):
+                    parents.append(p)
+                    nxt.append(len(parents) - 1)
+            frontier = nxt
+        return np.asarray(parents, np.int32)
+
+    @functools.cached_property
+    def depths(self) -> np.ndarray:
+        d = np.zeros(len(self.parents), np.int32)
+        for i, p in enumerate(self.parents):
+            if p >= 0:
+                d[i] = d[p] + 1
+        return d
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.parents)
+
+    @property
+    def max_depth(self) -> int:
+        return len(self.widths)
+
+    @functools.cached_property
+    def ancestor_mask(self) -> np.ndarray:
+        """mask[i, j] = node i attends node j (ancestor-or-self)."""
+        n = self.num_nodes
+        m = np.zeros((n, n), bool)
+        for i in range(n):
+            cur = i
+            while cur >= 0:
+                m[i, cur] = True
+                cur = int(self.parents[cur])
+        return m
+
+    @functools.cached_property
+    def level_slices(self) -> List[Tuple[int, int]]:
+        """[(start, end)] node-index range per depth level (root excluded)."""
+        out = []
+        start = 1
+        count = 1
+        for w in self.widths:
+            count *= w
+            out.append((start, start + count))
+            start += count
+        return out
+
+
+@dataclass
+class SpeculativeConfig:
+    """Reference SpeculativeConfig:28 analogue."""
+
+    widths: Tuple[int, ...] = (4, 2)
+    adaptive: bool = True
+    min_accept_rate: float = 0.3       # shrink depth below this
+    grow_accept_rate: float = 0.7      # grow depth above this
+    min_depth: int = 1
+    max_depth: int = 4
+    ema: float = 0.8
+
+
+# ---------------------------------------------------------------------------
+# Draft heads
+# ---------------------------------------------------------------------------
+
+
+def init_draft_params(
+    cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = None
+) -> Dict[str, jax.Array]:
+    """EAGLE-style draft net: h_next = W2 · silu(W1 · [h ; e(tok)]).
+
+    Shares the target's embedding and LM head (reference :94) — only the
+    fusion MLP is new (~2·H² params)."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    h = cfg.hidden_size
+    k1, k2 = jax.random.split(key)
+    return {
+        "w_fuse": (jax.random.normal(k1, (2 * h, h), jnp.float32) * (2 * h) ** -0.5
+                   ).astype(dtype),
+        "w_out": (jax.random.normal(k2, (h, h), jnp.float32) * h**-0.5
+                  ).astype(dtype),
+        "norm": jnp.ones((h,), dtype),
+    }
+
+
+def draft_apply(
+    cfg: ModelConfig, dp: Dict[str, jax.Array], hidden: jax.Array, tok_emb: jax.Array
+) -> jax.Array:
+    """[..., H] × [..., H] → predicted next hidden [..., H]."""
+    x = jnp.concatenate([hidden, tok_emb], axis=-1)
+    x = jax.nn.silu(x @ dp["w_fuse"]) @ dp["w_out"]
+    return llama.rms_norm(x, dp["norm"], cfg.rms_norm_eps)
+
+
+def init_medusa_params(
+    cfg: ModelConfig, key: jax.Array, num_heads: int = 4,
+    dtype: Optional[jnp.dtype] = None,
+) -> Dict[str, jax.Array]:
+    """Medusa alternative (reference MedusaHead:474): K residual projections
+    of the last hidden, one per lookahead distance; shares the LM head."""
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    h = cfg.hidden_size
+    return {
+        "w": (jax.random.normal(key, (num_heads, h, h), jnp.float32) * h**-0.5
+              ).astype(dtype),
+    }
+
+
+def medusa_logits(
+    cfg: ModelConfig, params: llama.Params, mp: Dict[str, jax.Array],
+    hidden: jax.Array,
+) -> jax.Array:
+    """hidden [B, H] → [B, K, V] logits for +1..+K lookahead."""
+    proj = jnp.einsum("bh,khg->bkg", hidden.astype(jnp.float32),
+                      mp["w"].astype(jnp.float32))
+    proj = proj + hidden.astype(jnp.float32)[:, None, :]
+    head = params.get("lm_head", params["embedding"])
+    return jnp.einsum("bkh,vh->bkv", proj, head.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# The decoder
+# ---------------------------------------------------------------------------
+
+
+class SpeculativeDecoder:
+    """Greedy speculative generation over the paged-KV substrate.
+
+    Batched: every sequence in the batch drafts/verifies the same tree shape
+    each step; per-sequence accept lengths differ freely.
+    """
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig | str,
+        params: Optional[llama.Params] = None,
+        draft_params: Optional[Dict[str, jax.Array]] = None,
+        spec_cfg: Optional[SpeculativeConfig] = None,
+        max_batch_size: int = 4,
+        max_seq_len: int = 1024,
+        block_size: int = 16,
+        num_blocks: Optional[int] = None,
+        seed: int = 0,
+        eos_token_id: Optional[int] = None,
+        prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512, 1024),
+    ) -> None:
+        self.model_cfg = (
+            get_model_config(model_cfg) if isinstance(model_cfg, str) else model_cfg
+        )
+        self.spec_cfg = spec_cfg or SpeculativeConfig()
+        self.block_size = block_size
+        self.max_batch_size = max_batch_size
+        self.max_seq_len = max_seq_len
+        self.max_blocks_per_seq = -(-max_seq_len // block_size)
+        self.num_blocks = num_blocks or int(
+            max_batch_size * self.max_blocks_per_seq * 1.5
+        ) + 1
+        key = jax.random.PRNGKey(seed)
+        self.params = params if params is not None else llama.init_params(
+            self.model_cfg, key
+        )
+        self.draft_params = (
+            draft_params
+            if draft_params is not None
+            else init_draft_params(self.model_cfg, jax.random.PRNGKey(seed + 1))
+        )
+        self.kv = llama.init_kv_pools(self.model_cfg, self.num_blocks, block_size)
+        self.manager = PagedKVCacheManager(self.num_blocks, block_size)
+        self.eos_token_id = eos_token_id
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+        self._step_fns: Dict[Tuple[int, ...], Any] = {}
+        self._widths = tuple(self.spec_cfg.widths)
+        self.accept_rate_ema = 0.5
+        self.stats: Dict[str, Any] = {
+            "steps": 0, "drafted": 0, "accepted": 0, "emitted": 0,
+            "depth_changes": 0,
+        }
+
+    # ----------------------------------------------------------- jit builders
+
+    def _build_step(self, widths: Tuple[int, ...]):
+        topo = TreeTopology(widths)
+        cfg = self.model_cfg
+        bs = self.block_size
+        parents = jnp.asarray(topo.parents)
+        depths = jnp.asarray(topo.depths)
+        tree_mask = jnp.asarray(topo.ancestor_mask)
+        n = topo.num_nodes
+        dmax = topo.max_depth
+        level_slices = topo.level_slices
+
+        def step(params, dp, kv, pending, h_last, prefix_lens, block_tables,
+                 active):
+            b = pending.shape[0]
+            emb = params["embedding"]
+
+            # ---- draft phase: grow the tree level by level (static shapes)
+            tokens = jnp.zeros((b, n), jnp.int32).at[:, 0].set(pending)
+            h_root = draft_apply(cfg, dp, h_last, jnp.take(emb, pending, axis=0))
+            head = params.get("lm_head", params["embedding"]).astype(jnp.float32)
+            frontier_h = h_root[:, None, :]           # [B, F, H]
+            for li, w in enumerate(widths):
+                logits = jnp.einsum(
+                    "bfh,vh->bfv", frontier_h.astype(jnp.float32), head
+                )
+                _, cand = jax.lax.top_k(logits, w)    # [B, F, w]
+                start, end = level_slices[li]
+                tokens = tokens.at[:, start:end].set(cand.reshape(b, -1))
+                # next frontier hiddens: f(parent_h, emb(child_tok))
+                child_emb = jnp.take(emb, cand, axis=0)          # [B, F, w, H]
+                parent_h = jnp.broadcast_to(
+                    frontier_h[:, :, None, :], child_emb.shape
+                )
+                frontier_h = draft_apply(cfg, dp, parent_h, child_emb).reshape(
+                    b, -1, cfg.hidden_size
+                )
+
+            # ---- verify phase: one target forward over the tree.
+            # Finished sequences must not write ANY pages (their tables may
+            # not even cover the tree range near max_seq_len): position -1
+            # drops the writes.
+            rope_pos = prefix_lens[:, None] + depths[None, :]
+            cache_pos = prefix_lens[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+            cache_pos = jnp.where(active[:, None], cache_pos, -1)
+            out = llama.forward_tree_chunk(
+                cfg, params, tokens, rope_pos, cache_pos, kv, block_tables,
+                prefix_lens, tree_mask, block_size=bs,
+            )
+            target_pred = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)  # [B,N]
+
+            # ---- acceptance: greedy match down the tree
+            accept = jnp.zeros((b, n), bool).at[:, 0].set(True)
+            for i in range(1, n):
+                p = int(topo.parents[i])
+                ok = accept[:, p] & (tokens[:, i] == target_pred[:, p])
+                accept = accept.at[:, i].set(ok)
+            # deepest accepted node, ties → lowest index
+            score = jnp.where(
+                accept, depths[None, :] * (n + 1) - jnp.arange(n)[None, :], -1
+            )
+            best = jnp.argmax(score, axis=-1).astype(jnp.int32)   # [B]
+            n_accept = jnp.take(depths, best)                      # [B] 0..dmax
+
+            # ---- path extraction (walk parents; static dmax iterations)
+            path = jnp.full((b, dmax), n, jnp.int32)  # n = OOB sentinel
+            cur = best
+            for _ in range(dmax):
+                d = jnp.take(depths, cur)
+                row = jnp.arange(b)
+                write_col = jnp.where(d >= 1, d - 1, dmax)
+                path = path.at[row, write_col].set(
+                    jnp.where(d >= 1, cur, n), mode="drop"
+                )
+                cur = jnp.where(d > 1, jnp.take(parents, cur), cur)
+
+            path_valid = path < n                                   # [B, dmax]
+            safe_path = jnp.where(path_valid, path, 0)
+            accepted_tokens = jnp.where(
+                path_valid,
+                jnp.take_along_axis(tokens, safe_path, axis=1),
+                -1,
+            )                                                       # [B, dmax]
+            bonus = jnp.take_along_axis(target_pred, best[:, None], axis=1)[:, 0]
+            new_h = jnp.take_along_axis(
+                out.hidden, best[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]
+
+            # ---- KV compaction: move accepted nodes' pages to depth order
+            kv2 = out.kv
+            live = path_valid & active[:, None]
+            src_pos = jnp.where(live, prefix_lens[:, None] + path, -1)
+            dst_pos = prefix_lens[:, None] + 1 + jnp.arange(dmax)[None, :]
+            dst_pos = jnp.where(live, dst_pos, -1)
+            kv2 = {
+                "k": _move_rows(kv2["k"], block_tables, src_pos, dst_pos, bs),
+                "v": _move_rows(kv2["v"], block_tables, src_pos, dst_pos, bs),
+            }
+            return kv2, accepted_tokens, n_accept, bonus, new_h
+
+        return jax.jit(step, donate_argnums=(2,))
+
+    def _get_step(self, widths: Tuple[int, ...]):
+        if widths not in self._step_fns:
+            self._step_fns[widths] = self._build_step(widths)
+        return self._step_fns[widths]
+
+    # ------------------------------------------------------------- generation
+
+    def generate(self, requests: Sequence[InferenceRequest]) -> List[InferenceResponse]:
+        """Greedy speculative batch generation (waves of ≤ max_batch_size)."""
+        out: List[InferenceResponse] = []
+        for i in range(0, len(requests), self.max_batch_size):
+            out.extend(self._generate_wave(requests[i : i + self.max_batch_size]))
+        return out
+
+    def _prefill(self, req: InferenceRequest, seq_id: str) -> Tuple[int, jax.Array, int]:
+        token_ids = req.prompt_token_ids or []
+        if not token_ids:
+            raise ValueError("request has no prompt_token_ids")
+        blocks, cached = self.manager.allocate_sequence(seq_id, token_ids)
+        table = self.manager.block_table_for(seq_id, self.max_blocks_per_seq)
+        fresh = token_ids[cached:]
+        n = len(fresh)
+        # bucket-pad so prefill compiles once per bucket, not per length
+        bucket = next((bkt for bkt in self.prefill_buckets if bkt >= n), n)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = fresh
+        pos = np.full((1, bucket), -1, np.int32)
+        pos[0, :n] = np.arange(cached, cached + n)
+        out = llama.forward_chunk(
+            self.model_cfg, self.params, jnp.asarray(toks), jnp.asarray(pos),
+            self.kv,
+            jnp.asarray(table[None]), jnp.asarray([len(token_ids)], jnp.int32),
+            block_size=self.block_size, last_only=True,
+        )
+        self.kv = out.kv
+        pending = int(jnp.argmax(out.logits[0, 0]))
+        # hidden at the last prompt position
+        h_last = out.hidden[0, n - 1]
+        return pending, h_last, cached
+
+    def _generate_wave(self, requests: Sequence[InferenceRequest]) -> List[InferenceResponse]:
+        b = len(requests)
+        seq_ids = [r.session_id or uuid.uuid4().hex for r in requests]
+        start = time.time()
+        pendings = np.zeros((b,), np.int32)
+        h_lasts = []
+        cached_counts = []
+        tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        prefix_lens = np.zeros((b,), np.int32)
+        for i, (r, sid) in enumerate(zip(requests, seq_ids)):
+            pending, h_last, cached = self._prefill(r, sid)
+            pendings[i] = pending
+            h_lasts.append(h_last)
+            cached_counts.append(cached)
+            prefix_lens[i] = len(r.prompt_token_ids or [])
+            tables[i] = self.manager.block_table_for(sid, self.max_blocks_per_seq)
+        h_last = jnp.stack(h_lasts)
+        first_token_time = time.time()
+
+        emitted: List[List[int]] = [[] for _ in range(b)]
+        done = [False] * b
+        finish: List[Optional[str]] = [None] * b
+        stops = [set(r.sampling.stop_token_ids) |
+                 ({self.eos_token_id} if self.eos_token_id is not None else set())
+                 for r in requests]
+
+        def emit(i: int, tok: int) -> None:
+            if done[i]:
+                return
+            if tok in stops[i]:
+                done[i] = True
+                finish[i] = "stop"
+                return
+            emitted[i].append(tok)
+            if len(emitted[i]) >= requests[i].sampling.max_new_tokens:
+                done[i] = True
+                finish[i] = "length"
+
+        # the prefill-sampled token is the first generated token
+        for i in range(b):
+            emit(i, int(pendings[i]))
+
+        while not all(done):
+            widths = self._widths
+            topo_n = TreeTopology(widths).num_nodes
+            # per-sequence capacity check: a sequence whose tree can no longer
+            # fit below max_seq_len finishes with "length"; others continue
+            for i in range(b):
+                if not done[i] and \
+                        int(prefix_lens[i]) + topo_n + 1 >= self.max_seq_len:
+                    done[i] = True
+                    finish[i] = "length"
+            if all(done):
+                break
+            active = np.asarray([not d for d in done])
+            for i, sid in enumerate(seq_ids):
+                if active[i]:
+                    self.manager.reserve_tokens(sid, topo_n + 1)
+                    tables[i] = self.manager.block_table_for(
+                        sid, self.max_blocks_per_seq
+                    )
+            step_fn = self._get_step(widths)
+            self.kv, acc_toks, n_acc, bonus, h_last = step_fn(
+                self.params, self.draft_params, self.kv,
+                jnp.asarray(pendings), h_last,
+                jnp.asarray(prefix_lens), jnp.asarray(tables),
+                jnp.asarray(active),
+            )
+            acc_toks = np.asarray(acc_toks)
+            n_acc = np.asarray(n_acc)
+            bonus = np.asarray(bonus)
+            dmax = len(widths)
+            self.stats["steps"] += 1
+            for i in range(b):
+                if not active[i]:
+                    continue
+                # the pending token (already emitted last round / at prefill)
+                # is now committed — its KV was written as the tree root
+                self.manager.commit_tokens(seq_ids[i], [int(pendings[i])])
+                committed = 1
+                for d in range(int(n_acc[i])):
+                    tok = int(acc_toks[i, d])
+                    self.manager.commit_tokens(seq_ids[i], [tok])
+                    committed += 1
+                    emit(i, tok)
+                    if done[i]:
+                        break
+                prefix_lens[i] += committed
+                if not done[i]:
+                    emit(i, int(bonus[i]))
+                pendings[i] = int(bonus[i])
+                self.stats["drafted"] += topo_n - 1
+                self.stats["accepted"] += int(n_acc[i])
+                self.stats["emitted"] += int(n_acc[i]) + 1
+            # adapt on ACTIVE rows only — finished rows draft stale state
+            live_rate = float(n_acc[active].mean()) / max(1, dmax)
+            self.accept_rate_ema = (
+                self.spec_cfg.ema * self.accept_rate_ema
+                + (1 - self.spec_cfg.ema) * live_rate
+            )
+            self._maybe_adapt()
+
+        responses = []
+        now = time.time()
+        for i, (r, sid) in enumerate(zip(requests, seq_ids)):
+            self.manager.free_sequence(sid, cache=True)
+            responses.append(
+                InferenceResponse(
+                    request_id=r.request_id,
+                    token_ids=emitted[i][: r.sampling.max_new_tokens],
+                    finish_reason=finish[i] or "length",
+                    prompt_tokens=len(r.prompt_token_ids or []),
+                    completion_tokens=len(emitted[i][: r.sampling.max_new_tokens]),
+                    cached_tokens=cached_counts[i],
+                    ttft_ms=(first_token_time - start) * 1000.0,
+                    e2e_ms=(now - start) * 1000.0,
+                )
+            )
+        return responses
+
+    def _maybe_adapt(self) -> None:
+        """Reference _adapt_depth:456-463: shrink when acceptance is poor,
+        grow when it is high."""
+        if not self.spec_cfg.adaptive:
+            return
+        depth = len(self._widths)
+        if (self.accept_rate_ema < self.spec_cfg.min_accept_rate
+                and depth > self.spec_cfg.min_depth):
+            self._widths = self._widths[:-1]
+            self.stats["depth_changes"] += 1
+        elif (self.accept_rate_ema > self.spec_cfg.grow_accept_rate
+                and depth < self.spec_cfg.max_depth):
+            self._widths = self._widths + (1,)
+            self.stats["depth_changes"] += 1
+
+    def get_stats(self) -> Dict[str, Any]:
+        out = dict(self.stats)
+        out["accept_rate_ema"] = self.accept_rate_ema
+        if out["steps"]:
+            out["tokens_per_step"] = out["emitted"] / out["steps"]
+        out["current_widths"] = list(self._widths)
+        return out
+
+
+def _move_rows(
+    pool: jax.Array,          # [L, N, Bk, Hkv, D]
+    block_tables: jax.Array,  # [B, M]
+    src_pos: jax.Array,       # [B, P] token positions (-1 invalid)
+    dst_pos: jax.Array,       # [B, P]
+    block_size: int,
+) -> jax.Array:
+    """Copy KV rows between token positions (all layers), dropping invalid
+    entries — the on-device page compaction after tree acceptance."""
+    num_blocks = pool.shape[1]
+    b, p = src_pos.shape
+
+    def phys_slot(pos):
+        valid = pos >= 0
+        safe = jnp.maximum(pos, 0)
+        logical = safe // block_size
+        slot = safe % block_size
+        phys = jnp.take_along_axis(block_tables, logical, axis=1)
+        return jnp.where(valid, phys, num_blocks), slot, valid
+
+    sphys, sslot, svalid = phys_slot(src_pos)
+    dphys, dslot, dvalid = phys_slot(dst_pos)
+    # gather first (read everything before any write)
+    rows = pool[:, jnp.where(svalid, sphys, 0), jnp.where(svalid, sslot, 0)]
+    # rows: [L, B, P, Hkv, D]; scatter to destinations, drop invalid
+    wphys = jnp.where(svalid & dvalid, dphys, num_blocks).reshape(-1)
+    wslot = dslot.reshape(-1)
+    flat = rows.reshape(pool.shape[0], b * p, *pool.shape[3:])
+    return pool.at[:, wphys, wslot].set(flat, mode="drop")
