@@ -22,3 +22,15 @@ from distributed_gpu_inference_tpu.parallel.sharding import (  # noqa: F401
     param_shardings,
     shard_params,
 )
+from distributed_gpu_inference_tpu.parallel.pipeline import (  # noqa: F401
+    create_shard_plan,
+    pipelined_forward,
+    shard_kv_stages,
+    shard_params_stages,
+    slice_stage_params,
+    uniform_stages,
+)
+from distributed_gpu_inference_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_self_attention,
+    seq_parallel_decode_attention,
+)

@@ -1,0 +1,185 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+Green-field per SURVEY §5.7 — the reference has NO sequence scaling (its
+layer-sharded pipeline scales model depth only; long inputs are delegated to
+vLLM/SGLang chunked-prefill flags, ``worker/engines/llm_vllm.py:61``,
+``llm_sglang.py:63``). Here long sequences are first-class: Q/K/V are sharded
+over the ``seq`` axis, and KV shards rotate around the ring via
+``lax.ppermute`` over ICI while each device accumulates blockwise attention
+with an online softmax (the Liu et al. ring-attention recipe, expressed so XLA
+can overlap the permute with the matmul of the next round).
+
+Two entry points:
+
+- :func:`ring_self_attention` — prefill-style full self-attention of a
+  seq-sharded chunk (each device holds S/n queries and S/n keys).
+- :func:`seq_parallel_decode_attention` — decode-style: queries replicated on
+  the ring, context KV sharded; partial (max, sum, acc) merged with one
+  ``pmax``/``psum`` instead of n ring hops.
+
+Both match the semantics of ``ops.attention.dense_causal_attention`` (the test
+oracle): causal GQA with per-sequence valid ``lengths``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_gpu_inference_tpu.parallel.mesh import AXIS_DATA, AXIS_SEQ
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(
+    q: jax.Array,        # [B, Sq, Nh, D] — this device's query shard
+    k: jax.Array,        # [B, Skv, Hkv, D] — this device's KV shard
+    v: jax.Array,        # [B, Skv, Hkv, D]
+    lengths: jax.Array,  # [B] global valid lengths (replicated)
+    axis_name: str,
+    axis_size: int,
+) -> jax.Array:
+    """Per-device body (runs under shard_map). → [B, Sq, Nh, D]."""
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, nh, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    qpk = nh // hkv
+    scale = d**-0.5
+
+    qg = q.reshape(b, sq, hkv, qpk, d).astype(jnp.float32)
+    q_pos = idx * sq + jnp.arange(sq, dtype=jnp.int32)          # [Sq] global
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def round_body(r, carry):
+        k_c, v_c, m, l, acc = carry
+        # after r forward rotations, this device holds the chunk produced by
+        # ring neighbor (idx - r) mod n — that fixes the keys' global positions
+        src = (idx - r) % axis_size
+        k_pos = src * skv + jnp.arange(skv, dtype=jnp.int32)    # [Skv] global
+
+        scores = (
+            jnp.einsum("bsgqd,bjgd->bgqsj", qg, k_c.astype(jnp.float32))
+            * scale
+        )
+        causal = q_pos[:, None] >= k_pos[None, :]               # [Sq, Skv]
+        valid = k_pos[None, None, :] < lengths[:, None, None]   # [B, 1, Skv]
+        mask = (causal[None] & valid)[:, None, None, :, :]      # [B,1,1,Sq,Skv]
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        m_new = jnp.maximum(m, scores.max(axis=-1))             # [B,g,q,Sq]
+        p = jnp.exp(scores - m_new[..., None]) * mask           # masked → 0
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bgqsj,bjgd->bgqsd", p, v_c.astype(jnp.float32)
+        )
+        k_n = jax.lax.ppermute(k_c, axis_name, perm)
+        v_n = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_n, v_n, m_new, l_new, acc_new)
+
+    m0 = jnp.full((b, hkv, qpk, sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, qpk, sq), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, qpk, sq, d), jnp.float32)
+    _, _, m, l, acc = jax.lax.fori_loop(
+        0, axis_size, round_body, (k, v, m0, l0, acc0)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((l > 0)[..., None], out, 0.0)               # padded queries
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, nh, d).astype(q.dtype)
+
+
+def ring_self_attention(
+    q: jax.Array,        # [B, S, Nh, D] — S divisible by mesh seq size
+    k: jax.Array,        # [B, S, Hkv, D]
+    v: jax.Array,        # [B, S, Hkv, D]
+    lengths: jax.Array,  # [B]
+    mesh: Mesh,
+    shard_batch: bool = False,
+) -> jax.Array:
+    """Causal GQA self-attention with Q/K/V sharded over the ``seq`` axis.
+
+    Jit-compatible: call inside ``jit`` with the mesh in scope, or directly.
+    ``shard_batch=True`` additionally shards B over ``data``.
+    """
+    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_SEQ, 1)
+    if q.shape[1] % n:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by seq axis {n}")
+    dspec = AXIS_DATA if shard_batch else None
+    qkv_spec = P(dspec, AXIS_SEQ, None, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=AXIS_SEQ, axis_size=n
+        ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(dspec)),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, lengths)
+
+
+def _decode_local(
+    q: jax.Array,        # [B, 1, Nh, D] (replicated over ring)
+    k: jax.Array,        # [B, Skv, Hkv, D] — this device's context shard
+    v: jax.Array,
+    lengths: jax.Array,  # [B] global context lengths
+    axis_name: str,
+) -> jax.Array:
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, nh, d = q.shape
+    skv, hkv = k.shape[1], k.shape[2]
+    qpk = nh // hkv
+    qg = q.reshape(b, sq, hkv, qpk, d).astype(jnp.float32)
+    k_pos = idx * skv + jnp.arange(skv, dtype=jnp.int32)
+
+    scores = (
+        jnp.einsum("bsgqd,bjgd->bgqsj", qg, k.astype(jnp.float32)) * d**-0.5
+    )
+    valid = (k_pos[None, :] < lengths[:, None])[:, None, None, None, :]
+    scores = jnp.where(valid, scores, _NEG_INF)
+
+    m_loc = scores.max(axis=-1)
+    m = jax.lax.pmax(m_loc, axis_name)                          # global max
+    p = jnp.exp(scores - m[..., None]) * valid
+    l = jax.lax.psum(p.sum(axis=-1), axis_name)
+    acc = jax.lax.psum(
+        jnp.einsum("bgqsj,bjgd->bgqsd", p, v.astype(jnp.float32)), axis_name
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((l > 0)[..., None], out, 0.0)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, nh, d).astype(q.dtype)
+
+
+def seq_parallel_decode_attention(
+    q: jax.Array,        # [B, 1, Nh, D]
+    k: jax.Array,        # [B, Sctx, Hkv, D] — full context, sharded by caller
+    v: jax.Array,
+    lengths: jax.Array,  # [B]
+    mesh: Mesh,
+) -> jax.Array:
+    """Decode attention against seq-sharded context KV.
+
+    One ``pmax`` + two ``psum`` merge the per-shard partial softmax — the
+    decode-side counterpart of ring prefill (KV never moves; only the
+    [B,Nh,D]-sized partials cross ICI).
+    """
+    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_SEQ, 1)
+    if k.shape[1] % n:
+        raise ValueError(f"ctx len {k.shape[1]} not divisible by seq axis {n}")
+    fn = jax.shard_map(
+        functools.partial(_decode_local, axis_name=AXIS_SEQ),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, None, None),
+            P(None, AXIS_SEQ, None, None),
+            P(None, AXIS_SEQ, None, None),
+            P(None),
+        ),
+        out_specs=P(None, None, None, None),
+        check_vma=False,
+    )
+    return fn(q, k, v, lengths)

@@ -246,19 +246,46 @@ class ContinuousBatcher:
             await self._admit()
             if not self.engine.num_active:
                 continue
-            latency = await loop.run_in_executor(self._exec, self._engine_round)
-            self.stats["decode_rounds"] += 1
-            self.stats["occupancy_sum"] += self.engine.num_active
-            self._retune(latency)
-            for i, s in enumerate(list(self.engine.slots)):
-                if s is not None and s.finish_reason is not None:
-                    resp = await loop.run_in_executor(
-                        self._exec, self.engine.finish_slot, i
-                    )
+            try:
+                latency = await loop.run_in_executor(
+                    self._exec, self._engine_round
+                )
+                self.stats["decode_rounds"] += 1
+                self.stats["occupancy_sum"] += self.engine.num_active
+                self._retune(latency)
+                for i, s in enumerate(list(self.engine.slots)):
+                    if s is not None and s.finish_reason is not None:
+                        resp = await loop.run_in_executor(
+                            self._exec, self.engine.finish_slot, i
+                        )
+                        item = self._slot_items.pop(i, None)
+                        if item and not item.future.done():
+                            item.future.set_result(resp)
+                        self.stats["completed"] += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # a failed round must not wedge the batcher: fail every
+                # in-flight request, abort its slot, keep serving the queue
+                self.stats["engine_errors"] = self.stats.get("engine_errors", 0) + 1
+                for i, s in enumerate(list(self.engine.slots)):
+                    if s is None:
+                        continue
+                    try:
+                        await loop.run_in_executor(
+                            self._exec,
+                            lambda i=i: self.engine.finish_slot(i, cache=False),
+                        )
+                    except Exception:
+                        pass
                     item = self._slot_items.pop(i, None)
                     if item and not item.future.done():
-                        item.future.set_result(resp)
-                    self.stats["completed"] += 1
+                        item.future.set_result(
+                            InferenceResponse(
+                                request_id=item.request.request_id,
+                                error=f"engine error: {e}",
+                            )
+                        )
 
     def get_stats(self) -> Dict[str, Any]:
         out = dict(self.stats)

@@ -234,6 +234,7 @@ class SpeculativeDecoder:
         self.eos_token_id = eos_token_id
         self.prefill_buckets = tuple(sorted(prefill_buckets))
         self._step_fns: Dict[Tuple[int, ...], Any] = {}
+        self._prefill_fn = self._build_prefill()
         self._widths = tuple(self.spec_cfg.widths)
         self.accept_rate_ema = 0.5
         self.stats: Dict[str, Any] = {
@@ -242,6 +243,23 @@ class SpeculativeDecoder:
         }
 
     # ----------------------------------------------------------- jit builders
+
+    def _build_prefill(self):
+        cfg, bs = self.model_cfg, self.block_size
+
+        def prefill(params, kv, tokens, positions, block_table, kv_len):
+            out = llama.forward_chunk(
+                cfg, params, tokens, positions, kv, block_table, kv_len,
+                block_size=bs, last_only=True,
+            )
+            n_valid = jnp.sum((positions >= 0).astype(jnp.int32), axis=1)
+            last_idx = jnp.maximum(n_valid - 1, 0)
+            h_last = jnp.take_along_axis(
+                out.hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0, :]
+            return out.logits[:, 0, :], h_last, out.kv
+
+        return jax.jit(prefill, donate_argnums=(1,))
 
     def _build_step(self, widths: Tuple[int, ...]):
         topo = TreeTopology(widths)
@@ -352,7 +370,19 @@ class SpeculativeDecoder:
     # ------------------------------------------------------------- generation
 
     def generate(self, requests: Sequence[InferenceRequest]) -> List[InferenceResponse]:
-        """Greedy speculative batch generation (waves of ≤ max_batch_size)."""
+        """Greedy speculative batch generation (waves of ≤ max_batch_size).
+
+        Only greedy sampling is supported (the verify pass is an argmax
+        match); non-greedy params are rejected rather than silently ignored
+        so behavior can't diverge from TPUEngine under the same request.
+        """
+        for r in requests:
+            if r.sampling.temperature and r.sampling.temperature > 0.0:
+                raise ValueError(
+                    "SpeculativeDecoder is greedy-only: request "
+                    f"{r.request_id} has temperature={r.sampling.temperature}; "
+                    "route sampled requests to TPUEngine"
+                )
         out: List[InferenceResponse] = []
         for i in range(0, len(requests), self.max_batch_size):
             out.extend(self._generate_wave(requests[i : i + self.max_batch_size]))
@@ -372,17 +402,12 @@ class SpeculativeDecoder:
         toks[0, :n] = fresh
         pos = np.full((1, bucket), -1, np.int32)
         pos[0, :n] = np.arange(cached, cached + n)
-        out = llama.forward_chunk(
-            self.model_cfg, self.params, jnp.asarray(toks), jnp.asarray(pos),
-            self.kv,
+        logits, h_last, self.kv = self._prefill_fn(
+            self.params, self.kv, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(table[None]), jnp.asarray([len(token_ids)], jnp.int32),
-            block_size=self.block_size, last_only=True,
         )
-        self.kv = out.kv
-        pending = int(jnp.argmax(out.logits[0, 0]))
-        # hidden at the last prompt position
-        h_last = out.hidden[0, n - 1]
-        return pending, h_last, cached
+        pending = int(jnp.argmax(logits[0]))
+        return pending, h_last[0], cached
 
     def _generate_wave(self, requests: Sequence[InferenceRequest]) -> List[InferenceResponse]:
         b = len(requests)

@@ -1,0 +1,171 @@
+"""Pipeline parallelism: SPMD microbatch schedule vs single-device oracle.
+
+The reference validates its pipeline with fake HTTP hop sessions
+(``tests/test_worker_distributed_inference_session.py``); here the pipeline is
+one jitted graph, so the test runs it on a REAL 4-stage virtual mesh and
+checks logits + KV against the unsharded ``forward_chunk``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.models import llama
+from distributed_gpu_inference_tpu.models.configs import get_model_config
+from distributed_gpu_inference_tpu.parallel.mesh import MeshPlan, make_mesh
+from distributed_gpu_inference_tpu.parallel import pipeline as pp
+
+CFG = get_model_config("llama3-mini", dtype="float32")
+BLOCK = 16
+
+
+def _batch(n_micro, mb, s, m, num_blocks, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(1, CFG.vocab_size, (n_micro, mb, s)).astype(np.int32)
+    positions = np.tile(np.arange(s, dtype=np.int32), (n_micro, mb, 1))
+    # disjoint block tables per (microbatch, sequence)
+    tables = np.zeros((n_micro, mb, m), np.int32)
+    nxt = 1
+    for i in range(n_micro):
+        for j in range(mb):
+            tables[i, j] = np.arange(nxt, nxt + m) % num_blocks
+            nxt += m
+    kv_lens = np.full((n_micro, mb), s, np.int32)
+    return (
+        jnp.asarray(tokens),
+        jnp.asarray(positions),
+        jnp.asarray(tables),
+        jnp.asarray(kv_lens),
+    )
+
+
+# --- shard planner -----------------------------------------------------------
+
+
+def test_uniform_stages_covers_all_layers():
+    plan = pp.uniform_stages(10, 4)
+    assert plan == [(0, 3), (3, 6), (6, 8), (8, 10)]
+    assert plan[0][0] == 0 and plan[-1][1] == 10
+
+
+def test_create_shard_plan_proportional():
+    cfg = get_model_config("llama3-8b")
+    per_layer = cfg.layer_param_bytes(2)
+    # stage 1 has twice the HBM of stage 0 → roughly 2x the layers
+    hbm = [cfg.num_layers * per_layer, 2 * cfg.num_layers * per_layer]
+    plan = pp.create_shard_plan(cfg, hbm, kv_reserve_frac=0.0)
+    assert plan[0][0] == 0 and plan[-1][1] == cfg.num_layers
+    n0, n1 = plan[0][1] - plan[0][0], plan[1][1] - plan[1][0]
+    assert n1 > n0
+    assert abs(n1 - 2 * n0) <= 2
+
+
+def test_create_shard_plan_insufficient_hbm_raises():
+    cfg = get_model_config("llama3-8b")
+    with pytest.raises(ValueError, match="fit"):
+        pp.create_shard_plan(cfg, [cfg.layer_param_bytes(2)] * 2)
+
+
+def test_slice_stage_params_edges():
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    first = pp.slice_stage_params(params, 0, 2, num_layers=CFG.num_layers)
+    last = pp.slice_stage_params(params, 2, 4, num_layers=CFG.num_layers)
+    assert "embedding" in first and "final_norm" not in first
+    assert "final_norm" in last
+    # tied embeddings: last stage carries the table for project_logits
+    assert "embedding" in last or "lm_head" in last
+    assert first["layers"]["wq"].shape[0] == 2
+
+
+# --- SPMD pipeline vs oracle -------------------------------------------------
+
+
+@pytest.mark.parametrize("n_stages", [2, 4])
+def test_pipelined_prefill_matches_forward_chunk(cpu_devices, n_stages):
+    mesh = make_mesh(MeshPlan(stage=n_stages), cpu_devices[:n_stages])
+    n_micro, mb, s, m, num_blocks = 3, 2, 8, 4, 64
+    tokens, positions, tables, kv_lens = _batch(n_micro, mb, s, m, num_blocks)
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(1))
+    kv = llama.init_kv_pools(CFG, num_blocks, BLOCK)
+
+    # oracle: each microbatch through the plain single-device forward
+    want_logits, oracle_kv = [], kv
+    for i in range(n_micro):
+        out = llama.forward_chunk(
+            CFG, params, tokens[i], positions[i], oracle_kv, tables[i],
+            kv_lens[i], block_size=BLOCK, last_only=True,
+        )
+        oracle_kv = out.kv
+        want_logits.append(out.logits[:, 0, :])
+    want = jnp.stack(want_logits)
+
+    sp = pp.shard_params_stages(params, mesh)
+    skv = pp.shard_kv_stages(kv, mesh)
+    got, got_kv = pp.pipelined_forward(
+        CFG, sp, tokens, positions, skv, tables, kv_lens, mesh,
+        block_size=BLOCK,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(got_kv["k"]), np.asarray(oracle_kv["k"]), atol=1e-5
+    )
+
+
+def test_pipelined_decode_step(cpu_devices):
+    """S=1 decode tick through the pipeline matches the plain decode."""
+    mesh = make_mesh(MeshPlan(stage=4), cpu_devices[:4])
+    n_micro, mb, m, num_blocks = 2, 2, 4, 64
+    prefix = 5
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(2))
+    kv = llama.init_kv_pools(CFG, num_blocks, BLOCK)
+    tokens, positions, tables, kv_lens = _batch(n_micro, mb, prefix, m, num_blocks)
+
+    # prefill both ways to build identical caches
+    oracle_kv = kv
+    for i in range(n_micro):
+        oracle_kv = llama.forward_chunk(
+            CFG, params, tokens[i], positions[i], oracle_kv, tables[i],
+            kv_lens[i], block_size=BLOCK,
+        ).kv
+
+    rng = np.random.default_rng(7)
+    next_tok = jnp.asarray(
+        rng.integers(1, CFG.vocab_size, (n_micro, mb, 1)).astype(np.int32)
+    )
+    dec_pos = jnp.full((n_micro, mb, 1), prefix, jnp.int32)
+    dec_lens = kv_lens + 1
+
+    want = jnp.stack([
+        llama.forward_chunk(
+            CFG, params, next_tok[i], dec_pos[i], oracle_kv, tables[i],
+            dec_lens[i], block_size=BLOCK, last_only=True,
+        ).logits[:, 0, :]
+        for i in range(n_micro)
+    ])
+
+    sp = pp.shard_params_stages(params, mesh)
+    skv = pp.shard_kv_stages(kv, mesh)
+    _, skv = pp.pipelined_forward(
+        CFG, sp, tokens, positions, skv, tables, kv_lens, mesh,
+        block_size=BLOCK,
+    )
+    got, _ = pp.pipelined_forward(
+        CFG, sp, next_tok, dec_pos, skv, tables, dec_lens, mesh,
+        block_size=BLOCK,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-4)
+
+
+def test_pipeline_rejects_uneven_split(cpu_devices):
+    mesh = make_mesh(MeshPlan(stage=3), cpu_devices[:3])
+    params = llama.init_params(CFG, jax.random.PRNGKey(0))
+    kv = llama.init_kv_pools(CFG, 8, BLOCK)
+    tokens, positions, tables, kv_lens = _batch(1, 1, 4, 2, 8)
+    with pytest.raises(ValueError, match="divisible"):
+        pp.pipelined_forward(
+            CFG, params, tokens, positions, kv, tables, kv_lens, mesh,
+            block_size=BLOCK,
+        )
