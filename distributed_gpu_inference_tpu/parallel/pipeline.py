@@ -255,7 +255,7 @@ def pipelined_forward(
     One jitted graph; hops are ICI ppermutes. Works for prefill (S = chunk)
     and decode (S = 1) alike.
     """
-    n_stages = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_STAGE, 1)
+    n_stages = dict(mesh.shape).get(AXIS_STAGE, 1)
     if cfg.num_layers % n_stages:
         raise ValueError(
             f"{cfg.num_layers} layers not divisible by {n_stages} stages; "

@@ -54,8 +54,7 @@ def _ring_attention_local(
     q_pos = idx * sq + jnp.arange(sq, dtype=jnp.int32)          # [Sq] global
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
-    def round_body(r, carry):
-        k_c, v_c, m, l, acc = carry
+    def accumulate(r, k_c, v_c, m, l, acc):
         # after r forward rotations, this device holds the chunk produced by
         # ring neighbor (idx - r) mod n — that fixes the keys' global positions
         src = (idx - r) % axis_size
@@ -77,16 +76,24 @@ def _ring_attention_local(
         acc_new = acc * corr[..., None] + jnp.einsum(
             "bgqsj,bjgd->bgqsd", p, v_c.astype(jnp.float32)
         )
+        return m_new, l_new, acc_new
+
+    def round_body(r, carry):
+        k_c, v_c, m, l, acc = carry
+        m, l, acc = accumulate(r, k_c, v_c, m, l, acc)
         k_n = jax.lax.ppermute(k_c, axis_name, perm)
         v_n = jax.lax.ppermute(v_c, axis_name, perm)
-        return (k_n, v_n, m_new, l_new, acc_new)
+        return (k_n, v_n, m, l, acc)
 
     m0 = jnp.full((b, hkv, qpk, sq), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, hkv, qpk, sq), jnp.float32)
     acc0 = jnp.zeros((b, hkv, qpk, sq, d), jnp.float32)
-    _, _, m, l, acc = jax.lax.fori_loop(
-        0, axis_size, round_body, (k, v, m0, l0, acc0)
+    # n-1 compute+rotate rounds, then a final compute with no rotation — the
+    # last hop's output would be discarded, so don't pay for it on ICI
+    k_c, v_c, m, l, acc = jax.lax.fori_loop(
+        0, axis_size - 1, round_body, (k, v, m0, l0, acc0)
     )
+    m, l, acc = accumulate(axis_size - 1, k_c, v_c, m, l, acc)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     out = jnp.where((l > 0)[..., None], out, 0.0)               # padded queries
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, nh, d).astype(q.dtype)
@@ -105,7 +112,7 @@ def ring_self_attention(
     Jit-compatible: call inside ``jit`` with the mesh in scope, or directly.
     ``shard_batch=True`` additionally shards B over ``data``.
     """
-    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_SEQ, 1)
+    n = dict(mesh.shape).get(AXIS_SEQ, 1)
     if q.shape[1] % n:
         raise ValueError(f"seq len {q.shape[1]} not divisible by seq axis {n}")
     dspec = AXIS_DATA if shard_batch else None
@@ -167,7 +174,7 @@ def seq_parallel_decode_attention(
     decode-side counterpart of ring prefill (KV never moves; only the
     [B,Nh,D]-sized partials cross ICI).
     """
-    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(AXIS_SEQ, 1)
+    n = dict(mesh.shape).get(AXIS_SEQ, 1)
     if k.shape[1] % n:
         raise ValueError(f"ctx len {k.shape[1]} not divisible by seq axis {n}")
     fn = jax.shard_map(

@@ -1,0 +1,8 @@
+"""Control plane: REST API, store, schedulers, fleet services.
+
+TPU-native re-design of the reference's ``server/app`` layer
+(FastAPI + async SQLAlchemy + Postgres → aiohttp + stdlib sqlite/WAL here;
+behavioral parity, not a translation). The control plane never touches
+tensors — it moves JSON params/results only (reference ``SURVEY`` §3.2);
+tensor traffic rides the ICI/DCN data plane in ``comm/`` and ``parallel/``.
+"""

@@ -1,0 +1,740 @@
+"""Control-plane REST API (aiohttp).
+
+Endpoint parity with the reference's FastAPI surface:
+- Jobs API     (``server/app/api/jobs.py``): create async/sync, get, cancel,
+  direct-mode discovery, queue stats.
+- Workers API  (``server/app/api/workers.py``): register (token issuance),
+  heartbeat (config_changed flag), atomic next-job, complete, going-offline /
+  offline, verify, refresh-token, remote config GET/PUT, list/detail with
+  online-probability predictions.
+- Admin API    (``server/app/api/admin.py``): dashboard/realtime stats,
+  enterprise CRUD + API keys, usage summaries, bills, privacy/compliance.
+- ``/health``, ``/regions`` (``server/app/main.py:99-121``), ``/metrics``
+  (Prometheus text).
+
+Auth model mirrors the reference (``workers.py:55-94``): Bearer token
+verified against a salted hash with a 5-strike / 15-min lockout; optional
+HMAC request signing; ``X-API-Key`` for the jobs/admin surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from ..utils.data_structures import JobStatus, WorkerState
+from .geo import GeoService
+from .observability import MetricsCollector, StructuredLogger, TracingManager
+from .reliability import ReliabilityService
+from .scheduler import REGIONS, SmartScheduler, estimate_job_duration_s, region_distance
+from .security import LockoutState, SecurityService
+from .store import Store
+from .task_guarantee import TaskGuaranteeBackgroundWorker, TaskGuaranteeService
+from .usage import UsageService
+from .privacy import EnterprisePrivacyService
+from .worker_config import WorkerConfigService
+
+API = "/api/v1"
+
+
+class ServerState:
+    """Bundles the store + every fleet service; attached to the aiohttp app."""
+
+    def __init__(self, db_path: str = ":memory:",
+                 api_key: Optional[str] = None,
+                 admin_key: Optional[str] = None,
+                 require_signing: bool = False,
+                 heartbeat_timeout_s: float = 90.0) -> None:
+        self.store = Store(db_path)
+        self.security = SecurityService()
+        self.reliability = ReliabilityService(self.store)
+        self.scheduler = SmartScheduler(self.store, self.reliability)
+        self.guarantee = TaskGuaranteeService(
+            self.store, self.reliability, heartbeat_timeout_s
+        )
+        self.background = TaskGuaranteeBackgroundWorker(self.guarantee)
+        self.geo = GeoService()
+        self.worker_config = WorkerConfigService(self.store)
+        self.usage = UsageService(self.store)
+        self.privacy = EnterprisePrivacyService(self.store)
+        self.metrics = MetricsCollector()
+        self.tracing = TracingManager()
+        self.log = StructuredLogger("dgi-tpu.server")
+        self.api_key = api_key
+        self.admin_key = admin_key or api_key
+        self.require_signing = require_signing
+        self.started_at = time.time()
+
+
+def _state(request: web.Request) -> ServerState:
+    return request.app["state"]
+
+
+def _json_error(status: int, detail: str) -> web.Response:
+    return web.json_response({"detail": detail}, status=status)
+
+
+# ---------------------------------------------------------------------------
+# auth helpers
+# ---------------------------------------------------------------------------
+
+
+def _check_api_key(request: web.Request) -> Optional[web.Response]:
+    st = _state(request)
+    if st.api_key and request.headers.get("X-API-Key") != st.api_key:
+        return _json_error(401, "invalid API key")
+    return None
+
+
+def _check_admin_key(request: web.Request) -> Optional[web.Response]:
+    st = _state(request)
+    if st.admin_key and request.headers.get("X-Admin-Key") != st.admin_key:
+        return _json_error(401, "invalid admin key")
+    return None
+
+
+async def _auth_worker(request: web.Request, worker_id: str
+                       ) -> tuple[Optional[Dict[str, Any]], Optional[web.Response]]:
+    """Bearer-token auth with lockout; returns (worker_row, error_response)."""
+    st = _state(request)
+    w = await st.store.get_worker(worker_id)
+    if w is None:
+        return None, _json_error(404, "worker not found")
+    lock = LockoutState(
+        failed_attempts=int(w.get("failed_auth_attempts") or 0),
+        last_failed=w.get("last_failed_auth"),
+        locked_until=w.get("locked_until"),
+    )
+    if st.security.lockout.is_locked(lock):
+        return None, _json_error(423, "worker locked out")
+    auth = request.headers.get("Authorization", "")
+    token = auth[7:] if auth.startswith("Bearer ") else ""
+    ok = st.security.tokens.verify(
+        token, w.get("auth_token_hash"), w.get("token_expires_at")
+    )
+    if not ok:
+        lock = st.security.lockout.record_failure(lock)
+        await st.store.update_worker(
+            worker_id,
+            failed_auth_attempts=lock.failed_attempts,
+            last_failed_auth=lock.last_failed,
+            locked_until=lock.locked_until,
+        )
+        st.security.audit.log("auth_failed", actor=worker_id)
+        return None, _json_error(401, "invalid token")
+    if st.require_signing and w.get("signing_secret"):
+        body = await request.read()
+        sig_ok = st.security.signer.verify(
+            w["signing_secret"], request.method, request.path, body,
+            request.headers.get("X-Timestamp", ""),
+            request.headers.get("X-Signature", ""),
+        )
+        if not sig_ok:
+            return None, _json_error(401, "invalid signature")
+    if w.get("failed_auth_attempts"):
+        await st.store.update_worker(
+            worker_id, failed_auth_attempts=0, locked_until=None
+        )
+    return w, None
+
+
+# ---------------------------------------------------------------------------
+# workers API
+# ---------------------------------------------------------------------------
+
+
+async def register_worker(request: web.Request) -> web.Response:
+    st = _state(request)
+    body = await request.json()
+    worker_id = body.get("worker_id") or str(uuid.uuid4())
+    bundle, stored = st.security.tokens.issue()
+    row: Dict[str, Any] = {
+        "id": worker_id,
+        "name": body.get("name") or worker_id[:8],
+        "region": body.get("region") or "unknown",
+        "country": body.get("country"),
+        "city": body.get("city"),
+        "timezone": body.get("timezone"),
+        "accelerator": body.get("accelerator") or "tpu",
+        "chip_generation": body.get("chip_generation"),
+        "num_chips": int(body.get("num_chips") or 1),
+        "hbm_gb_per_chip": float(body.get("hbm_gb_per_chip") or 16.0),
+        "topology": body.get("topology"),
+        "mesh_shape": body.get("mesh_shape"),
+        "cpu_cores": body.get("cpu_cores"),
+        "ram_gb": body.get("ram_gb"),
+        "supported_types": body.get("supported_types") or ["llm"],
+        "loaded_models": body.get("loaded_models") or [],
+        "status": WorkerState.IDLE.value,
+        "role": body.get("role") or "hybrid",
+        "last_heartbeat": time.time(),
+        "supports_direct": bool(body.get("supports_direct")),
+        "direct_url": body.get("direct_url"),
+        **stored,
+    }
+    await st.store.upsert_worker(row)
+    await st.reliability.start_session(worker_id)
+    cfg = await st.worker_config.get_config(worker_id)
+    st.security.audit.log("worker_registered", actor=worker_id)
+    return web.json_response(
+        {
+            "worker_id": worker_id,
+            **bundle.to_dict(),
+            "config": cfg.to_dict(),
+            "heartbeat_interval_s": 30,
+        }
+    )
+
+
+async def heartbeat(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    st = _state(request)
+    body = await request.json() if request.can_read_body else {}
+    fields: Dict[str, Any] = {"last_heartbeat": time.time()}
+    for key in ("status", "hbm_used_gb", "loaded_models", "current_job_id"):
+        if key in body:
+            fields[key] = body[key]
+    await st.store.update_worker(worker_id, **fields)
+    await st.reliability.update_online_pattern(worker_id, online=True)
+    client_version = int(body.get("config_version") or 0)
+    changed = await st.worker_config.config_changed_since(
+        worker_id, client_version
+    )
+    return web.json_response({"ok": True, "config_changed": changed})
+
+
+async def next_job(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    st = _state(request)
+    job = await st.scheduler.atomic_assign_job(worker_id)
+    if job is None:
+        return web.Response(status=204)  # no job (reference api_client.py:161)
+    # server-side admission policy (reference worker_config.py:195): release
+    # the claim without burning a retry if load control declines it
+    import random as _random
+
+    if not await st.worker_config.should_accept_job(
+        worker_id, job["type"], rand=_random.random(),
+        ignore_job_id=job["id"],
+    ):
+        await st.store.update_job(
+            job["id"], status=JobStatus.QUEUED.value, worker_id=None,
+            started_at=None,
+        )
+        await st.store.update_worker(
+            worker_id, current_job_id=None, status=WorkerState.IDLE.value
+        )
+        return web.Response(status=204)
+    st.metrics.record_queue("queued", (await st.store.queue_stats())["queued"])
+    return web.json_response({"job": job})
+
+
+async def complete_job(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    job_id = request.match_info["job_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    st = _state(request)
+    job = await st.store.get_job(job_id)
+    if job is None or job.get("worker_id") != worker_id:
+        return _json_error(404, "job not assigned to this worker")
+    if job["status"] != JobStatus.RUNNING.value:
+        # late completion of a cancelled/requeued job: release the worker but
+        # never overwrite the terminal status or bill usage for it
+        w2 = await st.store.get_worker(worker_id)
+        if w2 is not None and w2.get("current_job_id") == job_id:
+            await st.store.update_worker(
+                worker_id, current_job_id=None, status=WorkerState.IDLE.value
+            )
+        return _json_error(409, f"job is {job['status']}, not running")
+    body = await request.json()
+    success = bool(body.get("success", True))
+    now = time.time()
+    dur_ms = (
+        (now - float(job["started_at"])) * 1000.0 if job.get("started_at") else None
+    )
+    await st.store.update_job(
+        job_id,
+        status=JobStatus.COMPLETED.value if success else JobStatus.FAILED.value,
+        result=body.get("result"),
+        error=body.get("error"),
+        completed_at=now,
+        actual_duration_ms=dur_ms,
+    )
+    await st.store.update_worker(
+        worker_id, current_job_id=None, status=WorkerState.IDLE.value
+    )
+    await st.reliability.record_event(
+        worker_id,
+        "job_completed" if success else "job_failed",
+        latency_ms=dur_ms,
+    )
+    st.metrics.record_request(
+        job["type"], "completed" if success else "failed",
+        latency_s=(dur_ms or 0) / 1000.0,
+    )
+    if success:
+        job2 = await st.store.get_job(job_id)
+        await st.usage.record_job_usage(job2, enterprise_id=None)
+    return web.json_response({"ok": True})
+
+
+async def going_offline(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    st = _state(request)
+    await st.store.update_worker(worker_id, status=WorkerState.DRAINING.value)
+    return web.json_response({"ok": True, "drain": True})
+
+
+async def offline(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    st = _state(request)
+    requeued = await st.guarantee.handle_worker_offline(worker_id, graceful=True)
+    return web.json_response({"ok": True, "requeued_jobs": requeued})
+
+
+async def verify_worker(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    return web.json_response({"ok": True, "worker_id": worker_id})
+
+
+async def refresh_token(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    st = _state(request)
+    w = await st.store.get_worker(worker_id)
+    if w is None:
+        return _json_error(404, "worker not found")
+    body = await request.json()
+    if not st.security.tokens.verify(
+        body.get("refresh_token", ""), w.get("refresh_token_hash")
+    ):
+        return _json_error(401, "invalid refresh token")
+    bundle, stored = st.security.tokens.issue()
+    await st.store.update_worker(worker_id, **stored)
+    st.security.audit.log("token_refreshed", actor=worker_id)
+    return web.json_response({"worker_id": worker_id, **bundle.to_dict()})
+
+
+async def get_worker_config(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    st = _state(request)
+    cfg = await st.worker_config.get_config(worker_id)
+    await st.store.update_worker(worker_id, last_config_sync=time.time())
+    return web.json_response(cfg.to_dict())
+
+
+async def put_worker_config(request: web.Request) -> web.Response:
+    worker_id = request.match_info["worker_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    st = _state(request)
+    updates = await request.json()
+    cfg = await st.worker_config.update_config(worker_id, updates)
+    return web.json_response(cfg.to_dict())
+
+
+async def list_workers(request: web.Request) -> web.Response:
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    workers = await st.store.list_workers()
+    out = []
+    for w in workers:
+        out.append(
+            {
+                "id": w["id"], "name": w["name"], "region": w["region"],
+                "status": w["status"], "role": w.get("role"),
+                "accelerator": w.get("accelerator"),
+                "chip_generation": w.get("chip_generation"),
+                "num_chips": w.get("num_chips"),
+                "reliability_score": w.get("reliability_score"),
+                "online_probability": st.reliability.predict_online_probability(w),
+                "supported_types": w.get("supported_types"),
+                "loaded_models": w.get("loaded_models"),
+                "last_heartbeat": w.get("last_heartbeat"),
+            }
+        )
+    return web.json_response({"workers": out, "total": len(out)})
+
+
+async def worker_detail(request: web.Request) -> web.Response:
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    w = await st.store.get_worker(request.match_info["worker_id"])
+    if w is None:
+        return _json_error(404, "worker not found")
+    for secret in ("auth_token_hash", "refresh_token_hash", "signing_secret"):
+        w.pop(secret, None)
+    w["online_probability"] = st.reliability.predict_online_probability(w)
+    w["predicted_remaining_minutes"] = st.reliability.predict_remaining_online_time(w)
+    return web.json_response(w)
+
+
+# ---------------------------------------------------------------------------
+# jobs API
+# ---------------------------------------------------------------------------
+
+
+async def _make_job_row(request: web.Request, body: Dict[str, Any]
+                        ) -> Dict[str, Any]:
+    st = _state(request)
+    client_ip = request.headers.get("X-Forwarded-For", request.remote or "")
+    client_ip = client_ip.split(",")[0].strip()
+    client_region = await st.geo.detect_client_region(client_ip)
+    return {
+        "type": body.get("type") or "llm",
+        "params": body.get("params") or {},
+        "priority": int(body.get("priority") or 0),
+        "preferred_region": body.get("preferred_region") or client_region,
+        "allow_cross_region": bool(body.get("allow_cross_region", True)),
+        "client_ip": client_ip or None,
+        "client_region": client_region,
+        "timeout_seconds": float(body.get("timeout_seconds") or 300.0),
+        "max_retries": int(body.get("max_retries") or 3),
+    }
+
+
+async def create_job(request: web.Request) -> web.Response:
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    body = await request.json()
+    row = await _make_job_row(request, body)
+    job_id = await st.store.create_job(row)
+    st.metrics.record_request(row["type"], "queued")
+    return web.json_response({"job_id": job_id, "status": "queued"}, status=201)
+
+
+async def create_job_sync(request: web.Request) -> web.Response:
+    """503 with no capacity; priority boost +10; long-poll for the result
+    (reference jobs.py:116-181)."""
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    body = await request.json()
+    stats = await st.scheduler.get_queue_stats()
+    if stats["active_workers"] == 0:
+        return _json_error(503, "no workers available")
+    row = await _make_job_row(request, body)
+    row["priority"] = row["priority"] + 10
+    job_id = await st.store.create_job(row)
+    timeout = min(float(body.get("timeout_seconds") or 120.0), 300.0)
+    job = await st.guarantee.wait_for_job(job_id, timeout_s=timeout)
+    if job is None:
+        return _json_error(404, "job vanished")
+    if job["status"] != JobStatus.COMPLETED.value:
+        return web.json_response(
+            {"job_id": job_id, "status": job["status"], "error": job.get("error")},
+            status=504 if job["status"] == JobStatus.RUNNING.value else 500,
+        )
+    return web.json_response(
+        {"job_id": job_id, "status": job["status"], "result": job.get("result")}
+    )
+
+
+async def get_job(request: web.Request) -> web.Response:
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    job = await st.store.get_job(request.match_info["job_id"])
+    if job is None:
+        return _json_error(404, "job not found")
+    return web.json_response(job)
+
+
+async def cancel_job(request: web.Request) -> web.Response:
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    job_id = request.match_info["job_id"]
+    job = await st.store.get_job(job_id)
+    if job is None:
+        return _json_error(404, "job not found")
+    if job["status"] in (JobStatus.COMPLETED.value, JobStatus.FAILED.value):
+        return _json_error(409, f"job already {job['status']}")
+    await st.store.update_job(
+        job_id, status=JobStatus.CANCELLED.value, completed_at=time.time()
+    )
+    wid = job.get("worker_id")
+    if wid:  # free the assigned worker's capacity state
+        w = await st.store.get_worker(wid)
+        if w is not None and w.get("current_job_id") == job_id:
+            await st.store.update_worker(
+                wid, current_job_id=None, status=WorkerState.IDLE.value
+            )
+    return web.json_response({"job_id": job_id, "status": "cancelled"})
+
+
+async def nearest_direct_worker(request: web.Request) -> web.Response:
+    """Direct-mode discovery: closest direct-capable idle worker
+    (reference jobs.py:282-338)."""
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    client_ip = (request.headers.get("X-Forwarded-For", request.remote or "")
+                 .split(",")[0].strip())
+    region = request.query.get("region") or await st.geo.detect_client_region(
+        client_ip
+    )
+    workers = await st.store.list_workers(status=[WorkerState.IDLE.value])
+    cands = [
+        w for w in workers if w.get("supports_direct") and w.get("direct_url")
+    ]
+    if not cands:
+        return _json_error(404, "no direct workers available")
+    cands.sort(key=lambda w: region_distance(region, w.get("region")))
+    best = cands[0]
+    return web.json_response(
+        {
+            "worker_id": best["id"],
+            "direct_url": best["direct_url"],
+            "region": best["region"],
+            "client_region": region,
+        }
+    )
+
+
+async def queue_stats(request: web.Request) -> web.Response:
+    st = _state(request)
+    return web.json_response(await st.scheduler.get_queue_stats())
+
+
+# ---------------------------------------------------------------------------
+# admin API
+# ---------------------------------------------------------------------------
+
+
+async def admin_dashboard(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    stats = await st.store.queue_stats()
+    usage = await st.usage.platform_stats()
+    st.metrics.record_worker_counts(stats.get("workers", {}))
+    return web.json_response(
+        {
+            "uptime_s": time.time() - st.started_at,
+            "queue": stats,
+            "usage": usage,
+            "audit_recent": [
+                {"ts": e.ts, "event": e.event, "actor": e.actor}
+                for e in st.security.audit.recent(20)
+            ],
+        }
+    )
+
+
+async def admin_create_enterprise(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    body = await request.json()
+    ent_id = await st.store.insert(
+        "enterprises",
+        {
+            "name": body["name"],
+            "contact_email": body.get("contact_email"),
+            "custom_pricing": body.get("custom_pricing"),
+            "price_plan_id": body.get("price_plan_id"),
+            "allow_logging": int(body.get("allow_logging", True)),
+            "retention_days": int(body.get("retention_days", 30)),
+            "anonymize_data": int(body.get("anonymize_data", False)),
+            "encrypt_fields": int(body.get("encrypt_fields", False)),
+        },
+    )
+    return web.json_response({"enterprise_id": ent_id}, status=201)
+
+
+async def admin_create_api_key(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent_id = request.match_info["enterprise_id"]
+    from .security import generate_token, hash_token
+
+    raw = generate_token()
+    key_id = await st.store.insert(
+        "api_keys",
+        {
+            "enterprise_id": ent_id,
+            "key_hash": hash_token(raw),
+            "name": (await request.json()).get("name") if request.can_read_body else None,
+        },
+    )
+    return web.json_response({"api_key_id": key_id, "api_key": raw}, status=201)
+
+
+async def admin_usage_summary(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent = request.query.get("enterprise_id")
+    return web.json_response({"hourly": await st.usage.hourly_summary(ent)})
+
+
+async def admin_generate_bill(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    body = await request.json()
+    bill = await st.usage.generate_bill(
+        request.match_info["enterprise_id"],
+        float(body["period_start"]),
+        float(body["period_end"]),
+    )
+    return web.json_response(bill, status=201)
+
+
+async def admin_compliance(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    return web.json_response(await st.privacy.compliance_report())
+
+
+async def admin_push_config(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    cfg = await st.worker_config.update_config(
+        request.match_info["worker_id"], await request.json()
+    )
+    return web.json_response(cfg.to_dict())
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+async def health(request: web.Request) -> web.Response:
+    st = _state(request)
+    stats = await st.store.queue_stats()
+    return web.json_response(
+        {
+            "status": "healthy",
+            "uptime_s": time.time() - st.started_at,
+            "workers": stats.get("workers", {}),
+            "jobs": stats.get("jobs", {}),
+        }
+    )
+
+
+async def regions(request: web.Request) -> web.Response:
+    return web.json_response({"regions": list(REGIONS)})
+
+
+async def metrics_endpoint(request: web.Request) -> web.Response:
+    st = _state(request)
+    return web.Response(
+        body=st.metrics.render(),
+        content_type="text/plain",
+        charset="utf-8",
+    )
+
+
+# ---------------------------------------------------------------------------
+# app factory
+# ---------------------------------------------------------------------------
+
+
+def create_app(state: Optional[ServerState] = None,
+               start_background: bool = True) -> web.Application:
+    app = web.Application()
+    app["state"] = state or ServerState()
+
+    app.router.add_post(f"{API}/workers/register", register_worker)
+    app.router.add_post(f"{API}/workers/{{worker_id}}/heartbeat", heartbeat)
+    app.router.add_get(f"{API}/workers/{{worker_id}}/next-job", next_job)
+    app.router.add_post(
+        f"{API}/workers/{{worker_id}}/jobs/{{job_id}}/complete", complete_job
+    )
+    app.router.add_post(f"{API}/workers/{{worker_id}}/going-offline", going_offline)
+    app.router.add_post(f"{API}/workers/{{worker_id}}/offline", offline)
+    app.router.add_post(f"{API}/workers/{{worker_id}}/verify", verify_worker)
+    app.router.add_post(f"{API}/workers/{{worker_id}}/refresh-token", refresh_token)
+    app.router.add_get(f"{API}/workers/{{worker_id}}/config", get_worker_config)
+    app.router.add_put(f"{API}/workers/{{worker_id}}/config", put_worker_config)
+    app.router.add_get(f"{API}/workers", list_workers)
+    app.router.add_get(f"{API}/workers/{{worker_id}}", worker_detail)
+
+    app.router.add_post(f"{API}/jobs", create_job)
+    app.router.add_post(f"{API}/jobs/sync", create_job_sync)
+    app.router.add_get(f"{API}/jobs/direct/nearest", nearest_direct_worker)
+    app.router.add_get(f"{API}/jobs/stats/queue", queue_stats)
+    app.router.add_get(f"{API}/jobs/{{job_id}}", get_job)
+    app.router.add_delete(f"{API}/jobs/{{job_id}}", cancel_job)
+
+    app.router.add_get(f"{API}/admin/stats/dashboard", admin_dashboard)
+    app.router.add_post(f"{API}/admin/enterprises", admin_create_enterprise)
+    app.router.add_post(
+        f"{API}/admin/enterprises/{{enterprise_id}}/api-keys", admin_create_api_key
+    )
+    app.router.add_post(
+        f"{API}/admin/enterprises/{{enterprise_id}}/bills", admin_generate_bill
+    )
+    app.router.add_get(f"{API}/admin/usage/summary", admin_usage_summary)
+    app.router.add_get(f"{API}/admin/privacy/compliance", admin_compliance)
+    app.router.add_put(
+        f"{API}/admin/workers/{{worker_id}}/config", admin_push_config
+    )
+
+    app.router.add_get("/health", health)
+    app.router.add_get("/regions", regions)
+    app.router.add_get("/metrics", metrics_endpoint)
+
+    if start_background:
+        async def _on_startup(app: web.Application) -> None:
+            app["state"].background.start()
+
+        async def _on_cleanup(app: web.Application) -> None:
+            await app["state"].background.stop()
+
+        app.on_startup.append(_on_startup)
+        app.on_cleanup.append(_on_cleanup)
+    return app
+
+
+def main() -> None:  # pragma: no cover - manual entry point
+    import argparse
+
+    ap = argparse.ArgumentParser(description="dgi-tpu control plane")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--db", default="dgi_tpu.sqlite")
+    ap.add_argument("--api-key", default=None)
+    args = ap.parse_args()
+    web.run_app(
+        create_app(ServerState(db_path=args.db, api_key=args.api_key)),
+        host=args.host,
+        port=args.port,
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,264 @@
+"""Metrics, tracing, structured logging — the observability surface.
+
+Behavioral parity with the reference's ``server/app/services/observability.py``:
+- Prometheus metric set (:30-141): inference requests/latency, tokens and
+  tokens/s, KV-cache hit rate / size / evictions per tier, worker status,
+  accelerator memory, distributed hop latency histogram, KV migration latency,
+  batch size, per-phase queue size, speculative accept rate + speedup.
+- Optional imports (:22-27, :146-154): everything degrades to no-op stubs when
+  prometheus_client / opentelemetry are absent.
+- ``MetricsCollector`` facade (:255-405), ``/metrics`` text endpoint factory
+  (:410-450), ``StructuredLogger`` with bound context (:455-488).
+
+TPU additions: ``tpu_profiler_trace`` context manager wraps
+``jax.profiler.trace`` for on-device timeline capture, and memory gauges read
+HBM (device memory stats) instead of nvidia-smi.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from typing import Any, Dict, Iterator, Optional
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+
+    HAVE_PROMETHEUS = True
+except Exception:  # pragma: no cover
+    HAVE_PROMETHEUS = False
+
+try:
+    from opentelemetry import trace as _otel_trace
+    from opentelemetry.sdk.trace import TracerProvider
+    from opentelemetry.sdk.trace.export import (
+        BatchSpanProcessor,
+        ConsoleSpanExporter,
+    )
+
+    HAVE_OTEL = True
+except Exception:  # pragma: no cover
+    HAVE_OTEL = False
+
+
+# ---------------------------------------------------------------------------
+# Prometheus metrics (no-op fallbacks when the client is absent)
+# ---------------------------------------------------------------------------
+
+
+class _Noop:
+    def labels(self, *a: Any, **k: Any) -> "_Noop":
+        return self
+
+    def inc(self, *a: Any) -> None: ...
+    def dec(self, *a: Any) -> None: ...
+    def set(self, *a: Any) -> None: ...
+    def observe(self, *a: Any) -> None: ...
+
+
+class Metrics:
+    """All platform metrics on one registry (names mirror reference :30-141)."""
+
+    def __init__(self) -> None:
+        if not HAVE_PROMETHEUS:
+            self.registry = None
+            noop = _Noop()
+            for name in (
+                "inference_requests", "inference_latency", "tokens_generated",
+                "tokens_per_second", "kv_cache_hit_rate", "kv_cache_size",
+                "kv_cache_evictions", "worker_status", "hbm_used_bytes",
+                "hop_latency", "kv_migration_latency", "batch_size",
+                "queue_size", "spec_accept_rate", "spec_speedup",
+            ):
+                setattr(self, name, noop)
+            return
+        r = CollectorRegistry()
+        self.registry = r
+        self.inference_requests = Counter(
+            "inference_requests_total", "Inference requests",
+            ["job_type", "status"], registry=r)
+        self.inference_latency = Histogram(
+            "inference_latency_seconds", "End-to-end inference latency",
+            ["job_type"], registry=r,
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60))
+        self.tokens_generated = Counter(
+            "tokens_generated_total", "Decoded tokens", registry=r)
+        self.tokens_per_second = Gauge(
+            "tokens_per_second", "Recent decode throughput", registry=r)
+        self.kv_cache_hit_rate = Gauge(
+            "kv_cache_hit_rate", "KV/prefix cache hit rate", ["tier"],
+            registry=r)
+        self.kv_cache_size = Gauge(
+            "kv_cache_size_blocks", "Allocated KV blocks", ["tier"], registry=r)
+        self.kv_cache_evictions = Counter(
+            "kv_cache_evictions_total", "KV block evictions", ["tier"],
+            registry=r)
+        self.worker_status = Gauge(
+            "worker_status", "Workers by status", ["status"], registry=r)
+        self.hbm_used_bytes = Gauge(
+            "hbm_used_bytes", "Per-device HBM in use", ["device"], registry=r)
+        self.hop_latency = Histogram(
+            "distributed_hop_latency_seconds", "Pipeline hop latency",
+            registry=r,
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1))
+        self.kv_migration_latency = Histogram(
+            "kv_migration_latency_seconds", "PD KV migration latency",
+            registry=r, buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1))
+        self.batch_size = Gauge(
+            "batch_size", "Current decode batch size", registry=r)
+        self.queue_size = Gauge(
+            "queue_size", "Queued requests per phase", ["phase"], registry=r)
+        self.spec_accept_rate = Gauge(
+            "speculative_accept_rate", "Draft token accept rate", registry=r)
+        self.spec_speedup = Gauge(
+            "speculative_speedup", "Tokens per verify step", registry=r)
+
+    def render(self) -> bytes:
+        if not HAVE_PROMETHEUS or self.registry is None:
+            return b"# prometheus_client not installed\n"
+        return generate_latest(self.registry)
+
+
+class MetricsCollector:
+    """High-level facade the runtime calls into (reference :255-405)."""
+
+    def __init__(self, metrics: Optional[Metrics] = None) -> None:
+        self.metrics = metrics or Metrics()
+        self._tok_window: list[tuple[float, int]] = []
+
+    def record_request(self, job_type: str, status: str,
+                       latency_s: Optional[float] = None) -> None:
+        self.metrics.inference_requests.labels(job_type, status).inc()
+        if latency_s is not None:
+            self.metrics.inference_latency.labels(job_type).observe(latency_s)
+
+    def record_tokens(self, n: int, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self.metrics.tokens_generated.inc(n)
+        self._tok_window.append((now, n))
+        cutoff = now - 10.0
+        self._tok_window = [(t, c) for t, c in self._tok_window if t >= cutoff]
+        span = max(1e-6, now - self._tok_window[0][0]) if self._tok_window else 1.0
+        total = sum(c for _, c in self._tok_window)
+        self.metrics.tokens_per_second.set(total / span if span > 0 else 0.0)
+
+    def record_kv_stats(self, tier: str, hit_rate: float, size_blocks: int,
+                        evictions: int = 0) -> None:
+        self.metrics.kv_cache_hit_rate.labels(tier).set(hit_rate)
+        self.metrics.kv_cache_size.labels(tier).set(size_blocks)
+        if evictions:
+            self.metrics.kv_cache_evictions.labels(tier).inc(evictions)
+
+    def record_worker_counts(self, by_status: Dict[str, int]) -> None:
+        for status, n in by_status.items():
+            self.metrics.worker_status.labels(status).set(n)
+
+    def record_hop(self, latency_s: float) -> None:
+        self.metrics.hop_latency.observe(latency_s)
+
+    def record_kv_migration(self, latency_s: float) -> None:
+        self.metrics.kv_migration_latency.observe(latency_s)
+
+    def record_batch(self, size: int) -> None:
+        self.metrics.batch_size.set(size)
+
+    def record_queue(self, phase: str, size: int) -> None:
+        self.metrics.queue_size.labels(phase).set(size)
+
+    def record_speculative(self, accept_rate: float,
+                           tokens_per_step: float) -> None:
+        self.metrics.spec_accept_rate.set(accept_rate)
+        self.metrics.spec_speedup.set(tokens_per_step)
+
+    def render(self) -> bytes:
+        return self.metrics.render()
+
+
+# ---------------------------------------------------------------------------
+# Tracing (reference :157-246)
+# ---------------------------------------------------------------------------
+
+
+class TracingManager:
+    def __init__(self, service_name: str = "dgi-tpu",
+                 console_export: bool = False) -> None:
+        self.enabled = HAVE_OTEL
+        if not self.enabled:
+            self._tracer = None
+            return
+        provider = TracerProvider()
+        if console_export:  # deployments swap in OTLP/Jaeger exporters
+            provider.add_span_processor(
+                BatchSpanProcessor(ConsoleSpanExporter())
+            )
+        self._tracer = provider.get_tracer(service_name)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes: Any) -> Iterator[Any]:
+        if not self.enabled or self._tracer is None:
+            yield None
+            return
+        with self._tracer.start_as_current_span(name) as sp:
+            for k, v in attributes.items():
+                try:
+                    sp.set_attribute(k, v)
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                yield sp
+            except Exception as exc:
+                sp.record_exception(exc)
+                raise
+
+
+@contextlib.contextmanager
+def tpu_profiler_trace(log_dir: str = "/tmp/dgi_tpu_profile") -> Iterator[None]:
+    """Wrap a region in a jax.profiler trace (TPU timeline capture).
+
+    No-op when jax is unavailable; safe to leave in production paths.
+    """
+    try:
+        import jax
+
+        with jax.profiler.trace(log_dir):
+            yield
+    except Exception:  # noqa: BLE001 — profiling must never break serving
+        yield
+
+
+# ---------------------------------------------------------------------------
+# Structured logging (reference :455-488)
+# ---------------------------------------------------------------------------
+
+
+class StructuredLogger:
+    def __init__(self, name: str = "dgi-tpu",
+                 context: Optional[Dict[str, Any]] = None) -> None:
+        self._log = logging.getLogger(name)
+        self._context = dict(context or {})
+
+    def bind(self, **context: Any) -> "StructuredLogger":
+        merged = {**self._context, **context}
+        child = StructuredLogger(self._log.name, merged)
+        return child
+
+    def _emit(self, level: int, event: str, **fields: Any) -> None:
+        payload = {"event": event, "ts": time.time(), **self._context, **fields}
+        self._log.log(level, json.dumps(payload, default=str))
+
+    def info(self, event: str, **fields: Any) -> None:
+        self._emit(logging.INFO, event, **fields)
+
+    def warning(self, event: str, **fields: Any) -> None:
+        self._emit(logging.WARNING, event, **fields)
+
+    def error(self, event: str, **fields: Any) -> None:
+        self._emit(logging.ERROR, event, **fields)

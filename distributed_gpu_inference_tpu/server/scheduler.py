@@ -1,0 +1,174 @@
+"""Score-based job→worker assignment + queue statistics.
+
+Behavioral parity with the reference's ``server/app/services/scheduler.py``:
+- Weighted scoring (:47-51): reliability 35, region proximity 25,
+  predicted-online 20, performance 15, load 5.
+- Static region distance matrix (:18-40).
+- Job duration estimator by type/params (:166-192).
+- Atomic claim — reference uses ``SELECT … FOR UPDATE SKIP LOCKED``
+  (:194-234); here the Store's single-writer ``claim_next_job`` transaction
+  provides the same at-most-once guarantee.
+- Queue stats + wait estimate (:236-280).
+
+TPU-aware additions: scoring knows chips/HBM so bigger slices win ties for
+heavy jobs, and the duration estimator uses tokens-vs-MXU-throughput rather
+than GPU heuristics.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..utils.data_structures import JobStatus, WorkerState
+from .reliability import ReliabilityService
+from .store import Store
+
+# Static inter-region "distance" (0 = same region; reference scheduler.py:18-40).
+REGIONS = ("us-west", "us-east", "eu-west", "eu-central", "asia-east",
+           "asia-southeast", "unknown")
+_REGION_DISTANCE: Dict[str, Dict[str, float]] = {
+    "us-west":        {"us-west": 0, "us-east": 1, "eu-west": 3, "eu-central": 3, "asia-east": 2, "asia-southeast": 2, "unknown": 2},
+    "us-east":        {"us-west": 1, "us-east": 0, "eu-west": 2, "eu-central": 2, "asia-east": 3, "asia-southeast": 3, "unknown": 2},
+    "eu-west":        {"us-west": 3, "us-east": 2, "eu-west": 0, "eu-central": 1, "asia-east": 3, "asia-southeast": 3, "unknown": 2},
+    "eu-central":     {"us-west": 3, "us-east": 2, "eu-west": 1, "eu-central": 0, "asia-east": 2, "asia-southeast": 2, "unknown": 2},
+    "asia-east":      {"us-west": 2, "us-east": 3, "eu-west": 3, "eu-central": 2, "asia-east": 0, "asia-southeast": 1, "unknown": 2},
+    "asia-southeast": {"us-west": 2, "us-east": 3, "eu-west": 3, "eu-central": 2, "asia-east": 1, "asia-southeast": 0, "unknown": 2},
+    "unknown":        {r: 2 for r in REGIONS},
+}
+_MAX_DISTANCE = 3.0
+
+WEIGHTS = {
+    "reliability": 0.35,
+    "region": 0.25,
+    "predicted_online": 0.20,
+    "performance": 0.15,
+    "load": 0.05,
+}
+
+# Duration estimates (reference scheduler.py:166-192), re-derived for TPU:
+# decode ≈ max_new_tokens / per-chip decode tok/s; diffusion ≈ steps * s/step.
+_DECODE_TOKS_PER_S_PER_CHIP = 30.0
+_DIFFUSION_S_PER_STEP = 0.4
+
+
+def region_distance(a: Optional[str], b: Optional[str]) -> float:
+    return _REGION_DISTANCE.get(a or "unknown", _REGION_DISTANCE["unknown"]).get(
+        b or "unknown", 2.0
+    )
+
+
+def estimate_job_duration_s(job_type: str, params: Optional[Dict[str, Any]],
+                            num_chips: int = 1) -> float:
+    params = params or {}
+    if job_type == "llm":
+        toks = float(params.get("max_new_tokens") or params.get("max_tokens") or 256)
+        tps = _DECODE_TOKS_PER_S_PER_CHIP * max(1, num_chips)
+        return 2.0 + toks / tps
+    if job_type == "image_gen":
+        steps = float(params.get("num_inference_steps") or 30)
+        return 3.0 + steps * _DIFFUSION_S_PER_STEP
+    if job_type == "vision":
+        return 5.0
+    if job_type == "whisper":
+        return float(params.get("audio_seconds") or 30.0) * 0.3
+    if job_type == "embedding":
+        return 1.0
+    return 10.0
+
+
+class SmartScheduler:
+    """Scores candidate workers and drives atomic job claims."""
+
+    def __init__(self, store: Store,
+                 reliability: Optional[ReliabilityService] = None) -> None:
+        self._store = store
+        self._reliability = reliability or ReliabilityService(store)
+
+    # -- scoring (reference scheduler.py:111-164) ---------------------------
+
+    def score_worker(self, worker: Dict[str, Any], job: Dict[str, Any],
+                     now: Optional[float] = None) -> float:
+        reliability = float(worker.get("reliability_score") or 0.5)
+
+        dist = region_distance(job.get("preferred_region") or job.get("client_region"),
+                               worker.get("region"))
+        region_score = 1.0 - dist / _MAX_DISTANCE
+
+        online = self._reliability.predict_online_probability(worker, now=now)
+
+        # performance: normalized inverse latency, boosted by slice size
+        avg_ms = float(worker.get("avg_latency_ms") or 0.0)
+        perf = 1.0 / (1.0 + avg_ms / 1000.0)
+        chips = max(1, int(worker.get("num_chips") or 1))
+        perf = min(1.0, perf * (1.0 + 0.05 * (chips - 1)))
+
+        load = 0.0 if worker.get("current_job_id") else 1.0
+        if worker.get("status") == WorkerState.BUSY.value:
+            load = 0.0
+
+        return (
+            WEIGHTS["reliability"] * reliability
+            + WEIGHTS["region"] * region_score
+            + WEIGHTS["predicted_online"] * online
+            + WEIGHTS["performance"] * perf
+            + WEIGHTS["load"] * load
+        )
+
+    async def rank_workers(self, job: Dict[str, Any],
+                           now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Eligible workers sorted by descending score."""
+        cands = await self._store.list_workers(
+            status=[WorkerState.IDLE.value, WorkerState.BUSY.value],
+            supports_type=job.get("type"),
+        )
+        pref = job.get("preferred_region")
+        if pref and not job.get("allow_cross_region", True):
+            cands = [w for w in cands if w.get("region") == pref]
+        scored = [(self.score_worker(w, job, now=now), w) for w in cands]
+        scored.sort(key=lambda t: t[0], reverse=True)
+        return [w for _, w in scored]
+
+    # -- atomic claim (worker-pull path) ------------------------------------
+
+    async def atomic_assign_job(self, worker_id: str) -> Optional[Dict[str, Any]]:
+        w = await self._store.get_worker(worker_id)
+        if w is None or w.get("status") in (
+            WorkerState.OFFLINE.value,
+            WorkerState.DRAINING.value,
+        ):
+            return None
+        job = await self._store.claim_next_job(
+            worker_id,
+            supported_types=list(w.get("supported_types") or []),
+            region=w.get("region"),
+        )
+        if job is not None:
+            await self._store.update_worker(
+                worker_id, current_job_id=job["id"], status=WorkerState.BUSY.value
+            )
+        return job
+
+    # -- queue stats (reference scheduler.py:236-280) ------------------------
+
+    async def get_queue_stats(self) -> Dict[str, Any]:
+        stats = await self._store.queue_stats()
+        queued = await self._store.list_jobs(
+            status=[JobStatus.QUEUED.value], limit=500
+        )
+        workers = await self._store.list_workers(
+            status=[WorkerState.IDLE.value, WorkerState.BUSY.value]
+        )
+        total_chips = sum(max(1, int(w.get("num_chips") or 1)) for w in workers)
+        est_backlog_s = sum(
+            estimate_job_duration_s(j["type"], j.get("params")) for j in queued
+        )
+        wait = est_backlog_s / max(1, len(workers)) if workers else float("inf")
+        stats.update(
+            {
+                "active_workers": len(workers),
+                "total_chips": total_chips,
+                "estimated_wait_s": wait if workers else None,
+            }
+        )
+        return stats

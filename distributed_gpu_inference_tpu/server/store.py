@@ -1,0 +1,479 @@
+"""Async persistence layer for the control plane.
+
+The reference uses async SQLAlchemy + Postgres with a *missing* models module
+(``server/app/db/database.py:25-28``; schema reconstructed in SURVEY §2.1 from
+field usage in ``server/app/api/workers.py:199-218``, ``jobs.py:88-97``,
+``services/reliability.py:45-127``, ``services/usage.py:171-186``). This store
+implements that reconstructed contract on stdlib sqlite3:
+
+- WAL-mode sqlite, one writer at a time, reads concurrent.
+- All blocking calls pushed to a thread executor behind an asyncio lock, so
+  the aiohttp control plane stays non-blocking.
+- ``claim_next_job`` provides the atomic pull the reference gets from
+  ``SELECT … FOR UPDATE SKIP LOCKED`` (``scheduler.py:194-234``) — sqlite has
+  a single writer, so ``BEGIN IMMEDIATE`` + conditional UPDATE is equivalent.
+
+Rows are returned as plain dicts; JSON-typed columns are transparently
+encoded/decoded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import time
+import uuid
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from ..utils.data_structures import JobStatus, WorkerState
+
+# Columns stored as JSON text.
+_WORKER_JSON = {
+    "supported_types",
+    "loaded_models",
+    "online_pattern",
+    "config_override",
+    "topology",
+    "mesh_shape",
+}
+_JOB_JSON = {"params", "result"}
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS workers (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL DEFAULT '',
+    region TEXT NOT NULL DEFAULT 'unknown',
+    country TEXT, city TEXT, timezone TEXT,
+    -- TPU capability surface (reference stores gpu_model/gpu_memory_gb etc.)
+    accelerator TEXT NOT NULL DEFAULT 'tpu',
+    chip_generation TEXT, num_chips INTEGER NOT NULL DEFAULT 1,
+    hbm_gb_per_chip REAL NOT NULL DEFAULT 16.0,
+    hbm_used_gb REAL NOT NULL DEFAULT 0.0,
+    topology TEXT, mesh_shape TEXT,
+    cpu_cores INTEGER, ram_gb REAL,
+    supported_types TEXT NOT NULL DEFAULT '[]',
+    loaded_models TEXT NOT NULL DEFAULT '[]',
+    status TEXT NOT NULL DEFAULT 'idle',
+    role TEXT NOT NULL DEFAULT 'hybrid',
+    current_job_id TEXT,
+    last_heartbeat REAL,
+    registered_at REAL NOT NULL,
+    supports_direct INTEGER NOT NULL DEFAULT 0,
+    direct_url TEXT,
+    -- auth (hashes only at rest: reference workers.py:199-235)
+    auth_token_hash TEXT, refresh_token_hash TEXT, signing_secret TEXT,
+    token_expires_at REAL,
+    failed_auth_attempts INTEGER NOT NULL DEFAULT 0,
+    last_failed_auth REAL, locked_until REAL,
+    -- reliability (reference reliability.py:45-127)
+    reliability_score REAL NOT NULL DEFAULT 0.5,
+    success_rate REAL NOT NULL DEFAULT 1.0,
+    total_jobs INTEGER NOT NULL DEFAULT 0,
+    completed_jobs INTEGER NOT NULL DEFAULT 0,
+    failed_jobs INTEGER NOT NULL DEFAULT 0,
+    avg_latency_ms REAL NOT NULL DEFAULT 0.0,
+    unexpected_offline_count INTEGER NOT NULL DEFAULT 0,
+    total_online_seconds REAL NOT NULL DEFAULT 0.0,
+    total_sessions INTEGER NOT NULL DEFAULT 0,
+    avg_session_minutes REAL NOT NULL DEFAULT 0.0,
+    current_session_start REAL,
+    online_pattern TEXT NOT NULL DEFAULT '{}',
+    -- remote config (reference workers.py:491-546)
+    config_version INTEGER NOT NULL DEFAULT 0,
+    config_override TEXT,
+    last_config_sync REAL
+);
+
+CREATE TABLE IF NOT EXISTS jobs (
+    id TEXT PRIMARY KEY,
+    type TEXT NOT NULL,
+    params TEXT NOT NULL DEFAULT '{}',
+    priority INTEGER NOT NULL DEFAULT 0,
+    status TEXT NOT NULL DEFAULT 'queued',
+    preferred_region TEXT,
+    allow_cross_region INTEGER NOT NULL DEFAULT 1,
+    actual_region TEXT,
+    client_ip TEXT, client_region TEXT,
+    worker_id TEXT,
+    result TEXT, error TEXT,
+    timeout_seconds REAL NOT NULL DEFAULT 300.0,
+    retry_count INTEGER NOT NULL DEFAULT 0,
+    max_retries INTEGER NOT NULL DEFAULT 3,
+    created_at REAL NOT NULL,
+    started_at REAL, completed_at REAL,
+    actual_duration_ms REAL
+);
+CREATE INDEX IF NOT EXISTS idx_jobs_status_priority
+    ON jobs (status, priority DESC, created_at);
+CREATE INDEX IF NOT EXISTS idx_jobs_worker ON jobs (worker_id);
+
+CREATE TABLE IF NOT EXISTS enterprises (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL,
+    contact_email TEXT,
+    custom_pricing TEXT,            -- JSON {job_type: price-per-unit}
+    price_plan_id TEXT,
+    allow_logging INTEGER NOT NULL DEFAULT 1,
+    retention_days INTEGER NOT NULL DEFAULT 30,
+    anonymize_data INTEGER NOT NULL DEFAULT 0,
+    encrypt_fields INTEGER NOT NULL DEFAULT 0,
+    created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS price_plans (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL,
+    prices TEXT NOT NULL DEFAULT '{}',   -- JSON {job_type: price-per-unit}
+    created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS api_keys (
+    id TEXT PRIMARY KEY,
+    enterprise_id TEXT NOT NULL,
+    key_hash TEXT NOT NULL,
+    name TEXT,
+    active INTEGER NOT NULL DEFAULT 1,
+    created_at REAL NOT NULL,
+    last_used_at REAL
+);
+CREATE INDEX IF NOT EXISTS idx_api_keys_hash ON api_keys (key_hash);
+
+CREATE TABLE IF NOT EXISTS usage_records (
+    id TEXT PRIMARY KEY,
+    enterprise_id TEXT,
+    job_id TEXT NOT NULL,
+    job_type TEXT NOT NULL,
+    worker_id TEXT,
+    units REAL NOT NULL DEFAULT 0.0,     -- tokens / pixels / seconds
+    unit_kind TEXT NOT NULL DEFAULT 'tokens',
+    cost REAL NOT NULL DEFAULT 0.0,
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_usage_ent_time
+    ON usage_records (enterprise_id, created_at);
+
+CREATE TABLE IF NOT EXISTS bills (
+    id TEXT PRIMARY KEY,
+    enterprise_id TEXT NOT NULL,
+    period_start REAL NOT NULL,
+    period_end REAL NOT NULL,
+    total_cost REAL NOT NULL DEFAULT 0.0,
+    line_items TEXT NOT NULL DEFAULT '[]',
+    status TEXT NOT NULL DEFAULT 'open',
+    created_at REAL NOT NULL
+);
+
+CREATE TABLE IF NOT EXISTS audit_log (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    ts REAL NOT NULL,
+    event TEXT NOT NULL,
+    actor TEXT,
+    detail TEXT
+);
+"""
+
+
+def _encode(table_json: set, row: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in row.items():
+        if k in table_json and v is not None and not isinstance(v, str):
+            v = json.dumps(v)
+        elif isinstance(v, bool):
+            v = int(v)
+        out[k] = v
+    return out
+
+
+def _decode(table_json: set, row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    for k in table_json:
+        if k in d and isinstance(d[k], str):
+            try:
+                d[k] = json.loads(d[k])
+            except (ValueError, TypeError):
+                pass
+    return d
+
+
+class Store:
+    """Async facade over a WAL sqlite database (control-plane state)."""
+
+    def __init__(self, path: str = ":memory:") -> None:
+        self._path = path
+        # one connection, serialized writes; check_same_thread off because we
+        # hop through the default executor
+        self._conn = sqlite3.connect(
+            path, check_same_thread=False, isolation_level=None
+        )
+        self._conn.row_factory = sqlite3.Row
+        if path != ":memory:":
+            self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._conn.executescript(_SCHEMA)
+        self._lock = asyncio.Lock()
+
+    async def _run(self, fn, *args):
+        async with self._lock:
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(None, fn, *args)
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # -- generic helpers ---------------------------------------------------
+
+    def _exec(self, sql: str, params: Sequence[Any] = ()) -> None:
+        self._conn.execute(sql, params)
+
+    def _query(self, sql: str, params: Sequence[Any] = ()) -> List[sqlite3.Row]:
+        return self._conn.execute(sql, params).fetchall()
+
+    async def execute(self, sql: str, params: Sequence[Any] = ()) -> None:
+        await self._run(self._exec, sql, params)
+
+    async def query(
+        self, sql: str, params: Sequence[Any] = ()
+    ) -> List[Dict[str, Any]]:
+        rows = await self._run(self._query, sql, params)
+        return [dict(r) for r in rows]
+
+    # -- workers -----------------------------------------------------------
+
+    async def upsert_worker(self, worker: Dict[str, Any]) -> None:
+        row = _encode(_WORKER_JSON, dict(worker))
+        row.setdefault("registered_at", time.time())
+        cols = ", ".join(row)
+        ph = ", ".join("?" for _ in row)
+        upd = ", ".join(f"{c}=excluded.{c}" for c in row if c != "id")
+        await self.execute(
+            f"INSERT INTO workers ({cols}) VALUES ({ph}) "
+            f"ON CONFLICT(id) DO UPDATE SET {upd}",
+            list(row.values()),
+        )
+
+    async def get_worker(self, worker_id: str) -> Optional[Dict[str, Any]]:
+        rows = await self._run(
+            self._query, "SELECT * FROM workers WHERE id=?", (worker_id,)
+        )
+        return _decode(_WORKER_JSON, rows[0]) if rows else None
+
+    async def update_worker(self, worker_id: str, **fields: Any) -> None:
+        if not fields:
+            return
+        row = _encode(_WORKER_JSON, fields)
+        sets = ", ".join(f"{k}=?" for k in row)
+        await self.execute(
+            f"UPDATE workers SET {sets} WHERE id=?",
+            [*row.values(), worker_id],
+        )
+
+    async def list_workers(
+        self,
+        status: Optional[Iterable[str]] = None,
+        region: Optional[str] = None,
+        supports_type: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
+        sql, params = "SELECT * FROM workers", []
+        clauses = []
+        if status is not None:
+            vals = [s.value if isinstance(s, WorkerState) else s for s in status]
+            clauses.append(f"status IN ({','.join('?' * len(vals))})")
+            params += vals
+        if region is not None:
+            clauses.append("region=?")
+            params.append(region)
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        rows = await self._run(self._query, sql, params)
+        out = [_decode(_WORKER_JSON, r) for r in rows]
+        if supports_type is not None:
+            out = [w for w in out if supports_type in (w.get("supported_types") or [])]
+        return out
+
+    async def delete_worker(self, worker_id: str) -> None:
+        await self.execute("DELETE FROM workers WHERE id=?", (worker_id,))
+
+    # -- jobs --------------------------------------------------------------
+
+    async def create_job(self, job: Dict[str, Any]) -> str:
+        row = _encode(_JOB_JSON, dict(job))
+        row.setdefault("id", str(uuid.uuid4()))
+        row.setdefault("created_at", time.time())
+        row.setdefault("status", JobStatus.QUEUED.value)
+        cols = ", ".join(row)
+        ph = ", ".join("?" for _ in row)
+        await self.execute(
+            f"INSERT INTO jobs ({cols}) VALUES ({ph})", list(row.values())
+        )
+        return row["id"]
+
+    async def get_job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        rows = await self._run(
+            self._query, "SELECT * FROM jobs WHERE id=?", (job_id,)
+        )
+        return _decode(_JOB_JSON, rows[0]) if rows else None
+
+    async def update_job(self, job_id: str, **fields: Any) -> None:
+        if not fields:
+            return
+        row = _encode(_JOB_JSON, fields)
+        sets = ", ".join(f"{k}=?" for k in row)
+        await self.execute(
+            f"UPDATE jobs SET {sets} WHERE id=?", [*row.values(), job_id]
+        )
+
+    async def list_jobs(
+        self,
+        status: Optional[Iterable[str]] = None,
+        worker_id: Optional[str] = None,
+        limit: int = 100,
+    ) -> List[Dict[str, Any]]:
+        sql, params = "SELECT * FROM jobs", []
+        clauses = []
+        if status is not None:
+            vals = [s.value if isinstance(s, JobStatus) else s for s in status]
+            clauses.append(f"status IN ({','.join('?' * len(vals))})")
+            params += vals
+        if worker_id is not None:
+            clauses.append("worker_id=?")
+            params.append(worker_id)
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        sql += " ORDER BY priority DESC, created_at LIMIT ?"
+        params.append(limit)
+        rows = await self._run(self._query, sql, params)
+        return [_decode(_JOB_JSON, r) for r in rows]
+
+    async def claim_next_job(
+        self,
+        worker_id: str,
+        supported_types: Sequence[str],
+        region: Optional[str] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Atomically claim the best queued job for this worker.
+
+        Equivalent of the reference's ``SELECT … FOR UPDATE SKIP LOCKED``
+        claim (``scheduler.py:194-234``): priority DESC then FIFO, filtered to
+        the worker's supported types, region-preferring jobs honored.
+        """
+
+        def txn() -> Optional[sqlite3.Row]:
+            if not supported_types:
+                return None
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                ph = ",".join("?" * len(supported_types))
+                # scan deep enough that a run of region-restricted jobs at the
+                # head of the queue cannot starve claimable work behind them
+                rows = self._conn.execute(
+                    f"SELECT * FROM jobs WHERE status=? AND type IN ({ph}) "
+                    "ORDER BY priority DESC, created_at LIMIT 1000",
+                    [JobStatus.QUEUED.value, *supported_types],
+                ).fetchall()
+                pick = None
+                for r in rows:
+                    pref = r["preferred_region"]
+                    if (
+                        pref
+                        and region
+                        and pref != region
+                        and not r["allow_cross_region"]
+                    ):
+                        continue
+                    pick = r
+                    break
+                if pick is None:
+                    self._conn.execute("COMMIT")
+                    return None
+                now = time.time()
+                cur = self._conn.execute(
+                    "UPDATE jobs SET status=?, worker_id=?, started_at=?, "
+                    "actual_region=? WHERE id=? AND status=?",
+                    (
+                        JobStatus.RUNNING.value,
+                        worker_id,
+                        now,
+                        region,
+                        pick["id"],
+                        JobStatus.QUEUED.value,
+                    ),
+                )
+                if cur.rowcount != 1:  # raced (cannot happen single-writer)
+                    self._conn.execute("ROLLBACK")
+                    return None
+                self._conn.execute("COMMIT")
+                return self._conn.execute(
+                    "SELECT * FROM jobs WHERE id=?", (pick["id"],)
+                ).fetchone()
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+
+        row = await self._run(txn)
+        return _decode(_JOB_JSON, row) if row is not None else None
+
+    # -- queue stats -------------------------------------------------------
+
+    async def queue_stats(self) -> Dict[str, Any]:
+        rows = await self._run(
+            self._query,
+            "SELECT status, COUNT(*) AS n FROM jobs GROUP BY status",
+        )
+        by_status = {r["status"]: r["n"] for r in rows}
+        workers = await self._run(
+            self._query,
+            "SELECT status, COUNT(*) AS n FROM workers GROUP BY status",
+        )
+        w_by_status = {r["status"]: r["n"] for r in workers}
+        return {
+            "jobs": by_status,
+            "queued": by_status.get(JobStatus.QUEUED.value, 0),
+            "running": by_status.get(JobStatus.RUNNING.value, 0),
+            "workers": w_by_status,
+            "idle_workers": w_by_status.get(WorkerState.IDLE.value, 0),
+        }
+
+    # -- enterprise / billing ---------------------------------------------
+
+    async def insert(self, table: str, row: Dict[str, Any],
+                     json_cols: Optional[set] = None) -> str:
+        jc = json_cols if json_cols is not None else _detect_json_cols(table)
+        row = _encode(jc, dict(row))
+        row.setdefault("id", str(uuid.uuid4()))
+        row.setdefault("created_at", time.time())
+        cols = ", ".join(row)
+        ph = ", ".join("?" for _ in row)
+        await self.execute(
+            f"INSERT INTO {table} ({cols}) VALUES ({ph})", list(row.values())
+        )
+        return row["id"]
+
+    async def get(self, table: str, row_id: str) -> Optional[Dict[str, Any]]:
+        rows = await self._run(
+            self._query, f"SELECT * FROM {table} WHERE id=?", (row_id,)
+        )
+        return _decode(_detect_json_cols(table), rows[0]) if rows else None
+
+    async def audit(self, event: str, actor: Optional[str] = None,
+                    detail: Optional[Dict[str, Any]] = None) -> None:
+        await self.execute(
+            "INSERT INTO audit_log (ts, event, actor, detail) VALUES (?,?,?,?)",
+            (time.time(), event, actor, json.dumps(detail or {})),
+        )
+
+
+_TABLE_JSON = {
+    "workers": _WORKER_JSON,
+    "jobs": _JOB_JSON,
+    "enterprises": {"custom_pricing"},
+    "price_plans": {"prices"},
+    "bills": {"line_items"},
+    "usage_records": set(),
+    "api_keys": set(),
+    "audit_log": {"detail"},
+}
+
+
+def _detect_json_cols(table: str) -> set:
+    return _TABLE_JSON.get(table, set())

@@ -1,0 +1,186 @@
+"""Failure detection, retry/requeue, sync-wait — the delivery guarantee layer.
+
+Behavioral parity with the reference's ``server/app/services/task_guarantee.py``:
+- On worker offline: requeue its RUNNING jobs until ``max_retries``, then fail
+  (:60-96).
+- Stale-job sweep: RUNNING jobs past per-job timeout (default cap 30 min)
+  are requeued/failed (:98-158).
+- Dead-worker sweep: heartbeat older than 90 s → worker OFFLINE, its jobs
+  requeued (:160-185).
+- ``wait_for_job``: poll until terminal status or timeout (:187-228).
+- Background loop every 30 s (:231-263).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.data_structures import JobStatus, WorkerState
+from .reliability import ReliabilityService
+from .store import Store
+
+HEARTBEAT_TIMEOUT_S = 90.0
+STALE_JOB_CAP_S = 30 * 60.0
+SWEEP_INTERVAL_S = 30.0
+SYNC_POLL_INTERVAL_S = 0.5
+
+
+class TaskGuaranteeService:
+    def __init__(self, store: Store,
+                 reliability: Optional[ReliabilityService] = None,
+                 heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S) -> None:
+        self._store = store
+        self._reliability = reliability or ReliabilityService(store)
+        self._heartbeat_timeout_s = heartbeat_timeout_s
+
+    # -- requeue machinery ---------------------------------------------------
+
+    async def requeue_job(self, job: Dict[str, Any],
+                          reason: str = "worker_offline") -> str:
+        """Requeue one job (or fail it if retries exhausted). Returns the new
+        status value. Frees the assigned worker's capacity state so a
+        timed-out job doesn't leave a phantom BUSY worker."""
+        wid = job.get("worker_id")
+        if wid:
+            w = await self._store.get_worker(wid)
+            if w is not None and w.get("current_job_id") == job["id"]:
+                fields: Dict[str, Any] = {"current_job_id": None}
+                if w.get("status") == WorkerState.BUSY.value:
+                    fields["status"] = WorkerState.IDLE.value
+                await self._store.update_worker(wid, **fields)
+        retries = int(job.get("retry_count") or 0)
+        max_retries = int(job.get("max_retries") or 3)
+        if retries + 1 > max_retries:
+            await self._store.update_job(
+                job["id"],
+                status=JobStatus.FAILED.value,
+                error=f"exceeded max_retries ({max_retries}): {reason}",
+                completed_at=time.time(),
+            )
+            return JobStatus.FAILED.value
+        await self._store.update_job(
+            job["id"],
+            status=JobStatus.QUEUED.value,
+            worker_id=None,
+            started_at=None,
+            retry_count=retries + 1,
+        )
+        return JobStatus.QUEUED.value
+
+    async def handle_worker_offline(self, worker_id: str,
+                                    graceful: bool = False) -> List[str]:
+        """Mark worker offline and requeue its running jobs (:60-96)."""
+        running = await self._store.list_jobs(
+            status=[JobStatus.RUNNING.value], worker_id=worker_id
+        )
+        requeued = []
+        for job in running:
+            await self.requeue_job(job, reason="worker_offline")
+            requeued.append(job["id"])
+        await self._store.update_worker(
+            worker_id,
+            status=WorkerState.OFFLINE.value,
+            current_job_id=None,
+        )
+        await self._reliability.end_session(worker_id, graceful=graceful)
+        return requeued
+
+    # -- sweeps ---------------------------------------------------------------
+
+    async def sweep_stale_jobs(self, now: Optional[float] = None) -> List[str]:
+        now = time.time() if now is None else now
+        running = await self._store.list_jobs(
+            status=[JobStatus.RUNNING.value], limit=1000
+        )
+        swept = []
+        for job in running:
+            started = job.get("started_at")
+            if started is None:
+                continue
+            timeout = min(
+                float(job.get("timeout_seconds") or 300.0), STALE_JOB_CAP_S
+            )
+            if now - float(started) > timeout:
+                await self.requeue_job(job, reason="job_timeout")
+                swept.append(job["id"])
+        return swept
+
+    async def sweep_dead_workers(self, now: Optional[float] = None) -> List[str]:
+        now = time.time() if now is None else now
+        workers = await self._store.list_workers(
+            status=[
+                WorkerState.IDLE.value,
+                WorkerState.BUSY.value,
+                WorkerState.DRAINING.value,
+            ]
+        )
+        dead = []
+        for w in workers:
+            hb = w.get("last_heartbeat")
+            if hb is None or now - float(hb) > self._heartbeat_timeout_s:
+                # handle_worker_offline → end_session(graceful=False) already
+                # applies the unexpected_offline penalty exactly once
+                await self.handle_worker_offline(w["id"], graceful=False)
+                dead.append(w["id"])
+        return dead
+
+    async def sweep(self, now: Optional[float] = None) -> Dict[str, List[str]]:
+        return {
+            "dead_workers": await self.sweep_dead_workers(now=now),
+            "stale_jobs": await self.sweep_stale_jobs(now=now),
+        }
+
+    # -- sync wait (reference :187-228) ---------------------------------------
+
+    async def wait_for_job(self, job_id: str, timeout_s: float = 300.0,
+                           poll_s: float = SYNC_POLL_INTERVAL_S
+                           ) -> Optional[Dict[str, Any]]:
+        deadline = time.monotonic() + timeout_s
+        terminal = {
+            JobStatus.COMPLETED.value,
+            JobStatus.FAILED.value,
+            JobStatus.CANCELLED.value,
+        }
+        while time.monotonic() < deadline:
+            job = await self._store.get_job(job_id)
+            if job is None:
+                return None
+            if job["status"] in terminal:
+                return job
+            await asyncio.sleep(poll_s)
+        return await self._store.get_job(job_id)
+
+
+class TaskGuaranteeBackgroundWorker:
+    """Runs the sweeps every ``interval_s`` (reference :231-263)."""
+
+    def __init__(self, service: TaskGuaranteeService,
+                 interval_s: float = SWEEP_INTERVAL_S) -> None:
+        self._service = service
+        self._interval = interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._stop = asyncio.Event()
+
+    async def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self._service.sweep()
+            except Exception:  # noqa: BLE001 — sweep must never kill the loop
+                pass
+            try:
+                await asyncio.wait_for(self._stop.wait(), timeout=self._interval)
+            except asyncio.TimeoutError:
+                continue
+
+    def start(self) -> None:
+        if self._task is None:
+            self._stop.clear()
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._stop.set()
+            await self._task
+            self._task = None

@@ -1,0 +1,171 @@
+"""Server-pushed versioned worker configuration (load control, security,
+per-task model configs).
+
+Behavioral parity with the reference's ``server/app/services/worker_config.py``:
+- Load-control knobs (:20-47): acceptance_rate, max_concurrent_jobs,
+  max_jobs_per_hour, HBM utilization cap, working hours, per-type weights,
+  cooldown between jobs.
+- Security policy (:50-66) and per-type ``ModelConfig`` incl. quantization
+  (:68-82).
+- Versioned ``WorkerRemoteConfig`` (:85-107): bump on every update; workers
+  learn of changes via the heartbeat ``config_changed`` flag
+  (reference ``workers.py:276-289``).
+- Server-side ``should_accept_job`` (:195) so admission policy is enforced
+  even if a worker is stale.
+
+TPU deltas: memory knob is HBM fraction (not VRAM), model configs carry
+mesh-shape hints for pjit layouts.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Optional
+
+from .store import Store
+
+
+@dataclass
+class LoadControl:
+    acceptance_rate: float = 1.0          # probability of accepting any job
+    max_concurrent_jobs: int = 1
+    max_jobs_per_hour: int = 0            # 0 = unlimited
+    max_hbm_utilization: float = 0.9      # fraction of per-chip HBM usable
+    working_hours: Optional[list] = None  # [start_hour, end_hour] UTC or None
+    task_type_weights: Dict[str, float] = field(default_factory=dict)
+    cooldown_seconds: float = 0.0
+
+
+@dataclass
+class SecurityPolicy:
+    require_signing: bool = True
+    token_ttl_hours: float = 168.0
+    allowed_ips: Optional[list] = None
+
+
+@dataclass
+class ModelConfig:
+    model_id: str = ""
+    quantization: Optional[str] = None    # int8 / fp8 (TPU-native AQT-style)
+    max_seq_len: int = 4096
+    mesh_shape: Optional[Dict[str, int]] = None  # e.g. {"tp": 4, "dp": 2}
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class WorkerRemoteConfig:
+    version: int = 1
+    load_control: LoadControl = field(default_factory=LoadControl)
+    security: SecurityPolicy = field(default_factory=SecurityPolicy)
+    model_configs: Dict[str, ModelConfig] = field(default_factory=dict)
+    updated_at: float = field(default_factory=time.time)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "WorkerRemoteConfig":
+        lc = LoadControl(**(d.get("load_control") or {}))
+        sec = SecurityPolicy(**(d.get("security") or {}))
+        mcs = {
+            k: ModelConfig(**v) for k, v in (d.get("model_configs") or {}).items()
+        }
+        return cls(
+            version=int(d.get("version") or 1),
+            load_control=lc,
+            security=sec,
+            model_configs=mcs,
+            updated_at=float(d.get("updated_at") or time.time()),
+        )
+
+
+class WorkerConfigService:
+    """Source of truth for per-worker remote config, persisted on worker rows
+    (``config_version`` + ``config_override``)."""
+
+    def __init__(self, store: Store,
+                 defaults: Optional[WorkerRemoteConfig] = None) -> None:
+        self._store = store
+        self._defaults = defaults or WorkerRemoteConfig()
+
+    async def get_config(self, worker_id: str) -> WorkerRemoteConfig:
+        w = await self._store.get_worker(worker_id)
+        if w is None:
+            return self._defaults
+        override = w.get("config_override")
+        if override:
+            cfg = WorkerRemoteConfig.from_dict(override)
+        else:
+            cfg = WorkerRemoteConfig.from_dict(self._defaults.to_dict())
+        cfg.version = int(w.get("config_version") or cfg.version or 1)
+        return cfg
+
+    async def update_config(self, worker_id: str,
+                            updates: Dict[str, Any]) -> WorkerRemoteConfig:
+        """Merge updates into the worker's config and bump the version."""
+        cfg = await self.get_config(worker_id)
+        d = cfg.to_dict()
+        for key, val in updates.items():
+            if key in ("load_control", "security") and isinstance(val, dict):
+                d[key] = {**d.get(key, {}), **val}
+            elif key == "model_configs" and isinstance(val, dict):
+                merged = dict(d.get("model_configs") or {})
+                for task, mc in val.items():
+                    base = dict(merged.get(task) or {})
+                    base.update(mc)
+                    merged[task] = base
+                d["model_configs"] = merged
+            else:
+                d[key] = val
+        d["version"] = cfg.version + 1
+        d["updated_at"] = time.time()
+        new = WorkerRemoteConfig.from_dict(d)
+        await self._store.update_worker(
+            worker_id,
+            config_version=new.version,
+            config_override=new.to_dict(),
+        )
+        return new
+
+    async def config_changed_since(self, worker_id: str, version: int) -> bool:
+        w = await self._store.get_worker(worker_id)
+        if w is None:
+            return False
+        return int(w.get("config_version") or 0) > version
+
+    # -- server-side admission (reference worker_config.py:195) --------------
+
+    async def should_accept_job(self, worker_id: str, job_type: str,
+                                now: Optional[float] = None,
+                                rand: float = 0.0,
+                                ignore_job_id: Optional[str] = None) -> bool:
+        cfg = await self.get_config(worker_id)
+        lc = cfg.load_control
+        now = time.time() if now is None else now
+
+        if rand > lc.acceptance_rate:
+            return False
+        weight = lc.task_type_weights.get(job_type, 1.0)
+        if weight <= 0:
+            return False
+        if lc.working_hours:
+            start, end = lc.working_hours
+            hour = time.gmtime(now).tm_hour
+            in_window = (start <= hour < end) if start <= end else (
+                hour >= start or hour < end
+            )
+            if not in_window:
+                return False
+        w = await self._store.get_worker(worker_id)
+        if w is not None:
+            current = w.get("current_job_id")
+            if (current and current != ignore_job_id
+                    and lc.max_concurrent_jobs <= 1):
+                return False
+            hbm_cap = lc.max_hbm_utilization * float(w.get("hbm_gb_per_chip") or 0)
+            if hbm_cap and float(w.get("hbm_used_gb") or 0) > hbm_cap * max(
+                1, int(w.get("num_chips") or 1)
+            ):
+                return False
+        return True

@@ -1,0 +1,354 @@
+"""REST API end-to-end tests over the aiohttp app (in-process, no sockets
+beyond loopback test server).
+
+Mirrors the reference's API surface contract (``server/app/api/{jobs,workers,
+admin}.py``): register→token, heartbeat→config_changed, atomic next-job →
+complete round-trip, lockout on bad tokens, sync job long-poll, 503 with no
+workers, direct-mode discovery, admin dashboard.
+"""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from distributed_gpu_inference_tpu.server.app import ServerState, create_app
+from distributed_gpu_inference_tpu.utils.data_structures import JobStatus
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_client(**state_kw) -> TestClient:
+    state = ServerState(**state_kw)
+    app = create_app(state, start_background=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def register(client, **body):
+    payload = {"name": "tw", "region": "us-west",
+               "supported_types": ["llm"], "num_chips": 4,
+               "chip_generation": "v5e", **body}
+    resp = await client.post("/api/v1/workers/register", json=payload)
+    assert resp.status == 200
+    return await resp.json()
+
+
+def auth(reg):
+    return {"Authorization": f"Bearer {reg['auth_token']}"}
+
+
+def test_register_heartbeat_and_config_flag():
+    async def body():
+        client = await make_client()
+        reg = await register(client)
+        assert reg["auth_token"] and reg["signing_secret"]
+        assert reg["config"]["version"] >= 1
+        wid = reg["worker_id"]
+
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/heartbeat",
+            json={"status": "idle", "config_version": reg["config"]["version"]},
+            headers=auth(reg),
+        )
+        data = await resp.json()
+        assert resp.status == 200 and data["config_changed"] is False
+
+        # admin pushes new config → heartbeat flags it
+        resp = await client.put(
+            f"/api/v1/admin/workers/{wid}/config",
+            json={"load_control": {"acceptance_rate": 0.5}},
+        )
+        assert resp.status == 200
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/heartbeat",
+            json={"config_version": reg["config"]["version"]},
+            headers=auth(reg),
+        )
+        assert (await resp.json())["config_changed"] is True
+
+        # worker fetches the new config
+        resp = await client.get(f"/api/v1/workers/{wid}/config",
+                                headers=auth(reg))
+        cfg = await resp.json()
+        assert cfg["load_control"]["acceptance_rate"] == 0.5
+        await client.close()
+
+    run(body())
+
+
+def test_job_lifecycle_poll_and_complete():
+    async def body():
+        client = await make_client()
+        reg = await register(client)
+        wid = reg["worker_id"]
+
+        # empty queue → 204
+        resp = await client.get(f"/api/v1/workers/{wid}/next-job",
+                                headers=auth(reg))
+        assert resp.status == 204
+
+        resp = await client.post(
+            "/api/v1/jobs",
+            json={"type": "llm", "params": {"prompt": "hi",
+                                            "max_new_tokens": 8}},
+        )
+        assert resp.status == 201
+        job_id = (await resp.json())["job_id"]
+
+        resp = await client.get(f"/api/v1/workers/{wid}/next-job",
+                                headers=auth(reg))
+        assert resp.status == 200
+        job = (await resp.json())["job"]
+        assert job["id"] == job_id and job["status"] == "running"
+
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/jobs/{job_id}/complete",
+            json={"success": True,
+                  "result": {"text": "hello",
+                             "usage": {"total_tokens": 10}}},
+            headers=auth(reg),
+        )
+        assert resp.status == 200
+
+        resp = await client.get(f"/api/v1/jobs/{job_id}")
+        data = await resp.json()
+        assert data["status"] == JobStatus.COMPLETED.value
+        assert data["result"]["text"] == "hello"
+        assert data["actual_duration_ms"] is not None
+        await client.close()
+
+    run(body())
+
+
+def test_sync_job_503_without_workers_and_longpoll():
+    async def body():
+        client = await make_client()
+        resp = await client.post("/api/v1/jobs/sync",
+                                 json={"type": "llm", "params": {}})
+        assert resp.status == 503
+
+        reg = await register(client)
+        wid = reg["worker_id"]
+
+        async def worker_loop():
+            for _ in range(100):
+                r = await client.get(f"/api/v1/workers/{wid}/next-job",
+                                     headers=auth(reg))
+                if r.status == 200:
+                    job = (await r.json())["job"]
+                    assert job["priority"] >= 10  # sync boost
+                    await client.post(
+                        f"/api/v1/workers/{wid}/jobs/{job['id']}/complete",
+                        json={"success": True, "result": {"text": "done"}},
+                        headers=auth(reg),
+                    )
+                    return
+                await asyncio.sleep(0.02)
+
+        task = asyncio.get_running_loop().create_task(worker_loop())
+        resp = await client.post(
+            "/api/v1/jobs/sync",
+            json={"type": "llm", "params": {}, "timeout_seconds": 5},
+        )
+        await task
+        assert resp.status == 200
+        assert (await resp.json())["result"]["text"] == "done"
+        await client.close()
+
+    run(body())
+
+
+def test_auth_lockout_and_token_refresh():
+    async def body():
+        client = await make_client()
+        reg = await register(client)
+        wid = reg["worker_id"]
+        bad = {"Authorization": "Bearer wrong"}
+        for _ in range(5):
+            resp = await client.post(f"/api/v1/workers/{wid}/heartbeat",
+                                     json={}, headers=bad)
+            assert resp.status == 401
+        resp = await client.post(f"/api/v1/workers/{wid}/heartbeat",
+                                 json={}, headers=auth(reg))
+        assert resp.status == 423  # locked even with the right token
+
+        # refresh flow still works (separate credential)
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/refresh-token",
+            json={"refresh_token": reg["refresh_token"]},
+        )
+        assert resp.status == 200
+        new = await resp.json()
+        assert new["auth_token"] != reg["auth_token"]
+        await client.close()
+
+    run(body())
+
+
+def test_direct_mode_discovery_prefers_region():
+    async def body():
+        client = await make_client()
+        await register(client, name="eu", region="eu-west",
+                       supports_direct=True,
+                       direct_url="http://eu:7000")
+        await register(client, name="us", region="us-west",
+                       supports_direct=True,
+                       direct_url="http://us:7000")
+        resp = await client.get("/api/v1/jobs/direct/nearest?region=eu-west")
+        data = await resp.json()
+        assert data["direct_url"] == "http://eu:7000"
+        await client.close()
+
+    run(body())
+
+
+def test_worker_drain_and_offline_requeue():
+    async def body():
+        client = await make_client()
+        reg = await register(client)
+        wid = reg["worker_id"]
+        resp = await client.post("/api/v1/jobs",
+                                 json={"type": "llm", "params": {}})
+        job_id = (await resp.json())["job_id"]
+        await client.get(f"/api/v1/workers/{wid}/next-job", headers=auth(reg))
+
+        resp = await client.post(f"/api/v1/workers/{wid}/going-offline",
+                                 json={}, headers=auth(reg))
+        assert (await resp.json())["drain"] is True
+        resp = await client.post(f"/api/v1/workers/{wid}/offline",
+                                 json={}, headers=auth(reg))
+        data = await resp.json()
+        assert data["requeued_jobs"] == [job_id]
+        resp = await client.get(f"/api/v1/jobs/{job_id}")
+        assert (await resp.json())["status"] == "queued"
+        await client.close()
+
+    run(body())
+
+
+def test_admin_dashboard_enterprise_and_metrics():
+    async def body():
+        client = await make_client()
+        resp = await client.post("/api/v1/admin/enterprises",
+                                 json={"name": "acme"})
+        assert resp.status == 201
+        ent = (await resp.json())["enterprise_id"]
+        resp = await client.post(
+            f"/api/v1/admin/enterprises/{ent}/api-keys", json={"name": "k1"}
+        )
+        assert resp.status == 201 and (await resp.json())["api_key"]
+
+        resp = await client.get("/api/v1/admin/stats/dashboard")
+        data = await resp.json()
+        assert "queue" in data and "usage" in data
+
+        resp = await client.get("/health")
+        assert (await resp.json())["status"] == "healthy"
+        resp = await client.get("/regions")
+        assert "us-west" in (await resp.json())["regions"]
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+
+        resp = await client.get("/api/v1/admin/privacy/compliance")
+        assert (await resp.json())["enterprises"] == 1
+        await client.close()
+
+    run(body())
+
+
+def test_api_key_required_when_configured():
+    async def body():
+        client = await make_client(api_key="sekret")
+        resp = await client.post("/api/v1/jobs",
+                                 json={"type": "llm", "params": {}})
+        assert resp.status == 401
+        resp = await client.post("/api/v1/jobs",
+                                 json={"type": "llm", "params": {}},
+                                 headers={"X-API-Key": "sekret"})
+        assert resp.status == 201
+        await client.close()
+
+    run(body())
+
+
+def test_worker_list_hides_secrets():
+    async def body():
+        client = await make_client()
+        reg = await register(client)
+        resp = await client.get(f"/api/v1/workers/{reg['worker_id']}")
+        data = await resp.json()
+        assert "auth_token_hash" not in data
+        assert "signing_secret" not in data
+        assert 0.0 <= data["online_probability"] <= 1.0
+        resp = await client.get("/api/v1/workers")
+        listing = await resp.json()
+        assert listing["total"] == 1
+        await client.close()
+
+    run(body())
+
+
+def test_complete_after_cancel_keeps_cancelled_status():
+    """Regression: a late worker completion must not overwrite CANCELLED."""
+
+    async def body():
+        client = await make_client()
+        reg = await register(client)
+        wid = reg["worker_id"]
+        resp = await client.post("/api/v1/jobs",
+                                 json={"type": "llm", "params": {}})
+        job_id = (await resp.json())["job_id"]
+        await client.get(f"/api/v1/workers/{wid}/next-job", headers=auth(reg))
+
+        resp = await client.delete(f"/api/v1/jobs/{job_id}")
+        assert resp.status == 200
+        # cancel released the worker
+        resp = await client.get(f"/api/v1/workers/{wid}")
+        assert (await resp.json())["status"] == "idle"
+
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/jobs/{job_id}/complete",
+            json={"success": True, "result": {"text": "late"}},
+            headers=auth(reg),
+        )
+        assert resp.status == 409
+        resp = await client.get(f"/api/v1/jobs/{job_id}")
+        data = await resp.json()
+        assert data["status"] == "cancelled"
+        assert data["result"] is None
+        await client.close()
+
+    run(body())
+
+
+def test_admission_policy_enforced_on_next_job():
+    """Regression: server-side load control must gate next-job claims."""
+
+    async def body():
+        client = await make_client()
+        reg = await register(client)
+        wid = reg["worker_id"]
+        # zero-weight llm jobs for this worker
+        await client.put(
+            f"/api/v1/admin/workers/{wid}/config",
+            json={"load_control": {"task_type_weights": {"llm": 0.0}}},
+        )
+        resp = await client.post("/api/v1/jobs",
+                                 json={"type": "llm", "params": {}})
+        job_id = (await resp.json())["job_id"]
+        resp = await client.get(f"/api/v1/workers/{wid}/next-job",
+                                headers=auth(reg))
+        assert resp.status == 204  # declined by admission policy
+        # job back in the queue with no retry burned
+        resp = await client.get(f"/api/v1/jobs/{job_id}")
+        data = await resp.json()
+        assert data["status"] == "queued" and data["retry_count"] == 0
+        # worker not left busy
+        resp = await client.get(f"/api/v1/workers/{wid}")
+        assert (await resp.json())["status"] == "idle"
+        await client.close()
+
+    run(body())

@@ -1,0 +1,379 @@
+"""Security / geo / worker-config / usage / privacy service tests.
+
+Mirrors the reference's ``tests/test_server_security.py`` (token hashing,
+HMAC signing windows, lockout), geo region mapping, versioned remote config,
+usage pricing, and privacy anonymization/encryption suites.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from distributed_gpu_inference_tpu.server.geo import (
+    GeoService,
+    is_private_ip,
+    region_for_country,
+)
+from distributed_gpu_inference_tpu.server.privacy import (
+    Anonymizer,
+    EnterprisePrivacyService,
+    FieldEncryptor,
+    RetentionPolicy,
+)
+from distributed_gpu_inference_tpu.server.security import (
+    LockoutPolicy,
+    LockoutState,
+    RequestSigner,
+    TokenManager,
+    hash_token,
+    verify_token,
+)
+from distributed_gpu_inference_tpu.server.store import Store
+from distributed_gpu_inference_tpu.server.usage import (
+    UsageService,
+    units_from_result,
+)
+from distributed_gpu_inference_tpu.server.worker_config import (
+    WorkerConfigService,
+    WorkerRemoteConfig,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# security
+# ---------------------------------------------------------------------------
+
+
+def test_token_hash_and_verify():
+    tm = TokenManager(salt="pepper")
+    bundle, stored = tm.issue(now=1000.0)
+    assert verify_token(bundle.auth_token, stored["auth_token_hash"], "pepper")
+    assert not verify_token("wrong", stored["auth_token_hash"], "pepper")
+    assert tm.verify(bundle.auth_token, stored["auth_token_hash"],
+                     stored["token_expires_at"], now=1001.0)
+    # expired
+    assert not tm.verify(bundle.auth_token, stored["auth_token_hash"],
+                         stored["token_expires_at"],
+                         now=stored["token_expires_at"] + 1)
+
+
+def test_raw_tokens_never_equal_stored_hashes():
+    tm = TokenManager()
+    bundle, stored = tm.issue()
+    assert bundle.auth_token != stored["auth_token_hash"]
+    assert stored["auth_token_hash"] == hash_token(bundle.auth_token)
+
+
+def test_request_signing_window_and_tamper():
+    signer = RequestSigner(validity_s=300.0)
+    hdrs = signer.sign("secret", "POST", "/api/v1/jobs", b'{"a":1}',
+                       timestamp="1000")
+    assert signer.verify("secret", "POST", "/api/v1/jobs", b'{"a":1}',
+                         hdrs["X-Timestamp"], hdrs["X-Signature"], now=1100.0)
+    # outside validity window
+    assert not signer.verify("secret", "POST", "/api/v1/jobs", b'{"a":1}',
+                             hdrs["X-Timestamp"], hdrs["X-Signature"],
+                             now=1400.0)
+    # tampered body
+    assert not signer.verify("secret", "POST", "/api/v1/jobs", b'{"a":2}',
+                             hdrs["X-Timestamp"], hdrs["X-Signature"],
+                             now=1100.0)
+    # wrong secret
+    assert not signer.verify("other", "POST", "/api/v1/jobs", b'{"a":1}',
+                             hdrs["X-Timestamp"], hdrs["X-Signature"],
+                             now=1100.0)
+
+
+def test_lockout_after_five_failures():
+    pol = LockoutPolicy()
+    st = LockoutState()
+    for _ in range(4):
+        st = pol.record_failure(st, now=1000.0)
+        assert not pol.is_locked(st, now=1000.0)
+    st = pol.record_failure(st, now=1000.0)
+    assert pol.is_locked(st, now=1000.0)
+    assert pol.is_locked(st, now=1000.0 + 14 * 60)
+    assert not pol.is_locked(st, now=1000.0 + 16 * 60)
+    assert not pol.is_locked(pol.record_success(st))
+
+
+# ---------------------------------------------------------------------------
+# geo
+# ---------------------------------------------------------------------------
+
+
+def test_region_mapping_and_private_ips():
+    assert region_for_country("DE") == "eu-central"
+    assert region_for_country("JP") == "asia-east"
+    assert region_for_country("ZZ") == "unknown"
+    assert is_private_ip("10.0.0.1")
+    assert is_private_ip("127.0.0.1")
+    assert not is_private_ip("8.8.8.8")
+
+
+def test_geo_cache_and_resolver_chain():
+    async def body():
+        calls = []
+
+        async def failing(ip):
+            calls.append(("fail", ip))
+            raise RuntimeError("down")
+
+        async def resolving(ip):
+            calls.append(("ok", ip))
+            return {"country": "SG"}
+
+        geo = GeoService(resolvers=[failing, resolving])
+        assert await geo.detect_client_region("1.2.3.4") == "asia-southeast"
+        # second call hits the cache: no new resolver calls
+        n = len(calls)
+        assert await geo.detect_client_region("1.2.3.4") == "asia-southeast"
+        assert len(calls) == n
+        assert await geo.detect_client_region("192.168.1.1") == "unknown"
+
+    run(body())
+
+
+def test_geo_cache_ttl_expiry():
+    geo = GeoService(cache_ttl_s=10.0)
+    geo.cache_put("1.1.1.1", "eu-west", now=1000.0)
+    assert geo.cache_get("1.1.1.1", now=1005.0) == "eu-west"
+    assert geo.cache_get("1.1.1.1", now=1011.0) is None
+
+
+# ---------------------------------------------------------------------------
+# worker remote config
+# ---------------------------------------------------------------------------
+
+
+def test_remote_config_versioning_and_merge():
+    async def body():
+        s = Store()
+        await s.upsert_worker({"id": "w1", "supported_types": ["llm"]})
+        svc = WorkerConfigService(s)
+        cfg = await svc.get_config("w1")
+        assert cfg.load_control.acceptance_rate == 1.0
+        v0 = cfg.version
+        new = await svc.update_config(
+            "w1", {"load_control": {"acceptance_rate": 0.5}}
+        )
+        assert new.version == v0 + 1
+        assert new.load_control.acceptance_rate == 0.5
+        # untouched fields survive the merge
+        assert new.load_control.max_concurrent_jobs == 1
+        assert await svc.config_changed_since("w1", v0)
+        assert not await svc.config_changed_since("w1", new.version)
+        s.close()
+
+    run(body())
+
+
+def test_remote_config_model_configs_merge():
+    async def body():
+        s = Store()
+        await s.upsert_worker({"id": "w1"})
+        svc = WorkerConfigService(s)
+        await svc.update_config(
+            "w1",
+            {"model_configs": {"llm": {"model_id": "llama3-8b",
+                                        "quantization": "int8"}}},
+        )
+        cfg = await svc.update_config(
+            "w1", {"model_configs": {"llm": {"mesh_shape": {"tp": 4}}}}
+        )
+        mc = cfg.model_configs["llm"]
+        assert mc.model_id == "llama3-8b"
+        assert mc.quantization == "int8"
+        assert mc.mesh_shape == {"tp": 4}
+        s.close()
+
+    run(body())
+
+
+def test_should_accept_job_rules():
+    async def body():
+        s = Store()
+        await s.upsert_worker({"id": "w1", "hbm_gb_per_chip": 16.0,
+                               "num_chips": 1})
+        svc = WorkerConfigService(s)
+        assert await svc.should_accept_job("w1", "llm")
+        # acceptance rate gate
+        await svc.update_config("w1", {"load_control": {"acceptance_rate": 0.2}})
+        assert not await svc.should_accept_job("w1", "llm", rand=0.9)
+        assert await svc.should_accept_job("w1", "llm", rand=0.1)
+        # zero-weight task type
+        await svc.update_config(
+            "w1",
+            {"load_control": {"acceptance_rate": 1.0,
+                              "task_type_weights": {"image_gen": 0.0}}},
+        )
+        assert not await svc.should_accept_job("w1", "image_gen")
+        assert await svc.should_accept_job("w1", "llm")
+        # working hours window (UTC)
+        await svc.update_config(
+            "w1", {"load_control": {"working_hours": [9, 17]}}
+        )
+        noon = time.mktime((2026, 1, 5, 12, 0, 0, 0, 0, 0)) - time.timezone
+        midnight = time.mktime((2026, 1, 5, 0, 30, 0, 0, 0, 0)) - time.timezone
+        assert await svc.should_accept_job("w1", "llm", now=noon)
+        assert not await svc.should_accept_job("w1", "llm", now=midnight)
+        s.close()
+
+    run(body())
+
+
+def test_remote_config_roundtrip_dict():
+    cfg = WorkerRemoteConfig()
+    cfg2 = WorkerRemoteConfig.from_dict(cfg.to_dict())
+    assert cfg2.load_control.max_hbm_utilization == pytest.approx(0.9)
+    assert cfg2.security.require_signing
+
+
+# ---------------------------------------------------------------------------
+# usage / billing
+# ---------------------------------------------------------------------------
+
+
+def test_units_from_result_per_type():
+    assert units_from_result(
+        "llm", {}, {"usage": {"prompt_tokens": 10, "completion_tokens": 20}}
+    ) == 30
+    assert units_from_result(
+        "image_gen", {"width": 512, "height": 512, "num_images": 2}, {}
+    ) == 512 * 512 * 2
+    assert units_from_result("whisper", {"audio_seconds": 12.5}, {}) == 12.5
+
+
+def test_usage_record_and_custom_pricing():
+    async def body():
+        s = Store()
+        svc = UsageService(s)
+        job = {"id": "j1", "type": "llm", "params": {},
+               "result": {"usage": {"total_tokens": 1000}}, "worker_id": "w1"}
+        rec = await svc.record_job_usage(job)
+        assert rec["units"] == 1000
+        assert rec["cost"] == pytest.approx(1000 * 0.000002)
+
+        ent_id = await s.insert(
+            "enterprises", {"name": "acme", "custom_pricing": {"llm": 0.001}}
+        )
+        rec2 = await svc.record_job_usage(job, enterprise_id=ent_id)
+        assert rec2["cost"] == pytest.approx(1.0)
+        s.close()
+
+    run(body())
+
+
+def test_price_plan_fallback_and_bill():
+    async def body():
+        s = Store()
+        svc = UsageService(s)
+        plan_id = await s.insert(
+            "price_plans", {"name": "basic", "prices": {"llm": 0.0001}}
+        )
+        ent_id = await s.insert(
+            "enterprises", {"name": "beta", "price_plan_id": plan_id}
+        )
+        job = {"id": "j1", "type": "llm", "params": {},
+               "result": {"usage": {"total_tokens": 100}}}
+        await svc.record_job_usage(job, enterprise_id=ent_id)
+        bill = await svc.generate_bill(
+            ent_id, time.time() - 3600, time.time() + 3600
+        )
+        assert bill["total_cost"] == pytest.approx(0.01)
+        assert bill["line_items"][0]["job_type"] == "llm"
+        stats = await svc.platform_stats()
+        assert stats["total_cost"] > 0
+        s.close()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# privacy
+# ---------------------------------------------------------------------------
+
+
+def test_anonymizer_ip_truncation_and_scrub():
+    a = Anonymizer(pseudonym_salt="s")
+    assert a.truncate_ip("203.0.113.77") == "203.0.113.0"
+    assert a.truncate_ip("2001:db8:abcd:1234::1") == "2001:db8:abcd::"
+    text = "mail me at bob@example.com or call +1 (555) 123-4567 from 8.8.8.8"
+    scrubbed = a.scrub_text(text)
+    assert "bob@example.com" not in scrubbed
+    assert "8.8.8.8" not in scrubbed
+    assert "[EMAIL]" in scrubbed and "[IP]" in scrubbed
+    assert a.pseudonym("user1") == a.pseudonym("user1")
+    assert a.pseudonym("user1") != a.pseudonym("user2")
+
+
+def test_field_encryptor_roundtrip():
+    enc = FieldEncryptor("passphrase")
+    rec = {"params": {"prompt": "secret text"}, "other": 1}
+    out = enc.encrypt_fields(rec, ["params"])
+    assert isinstance(out["params"], str) and out["params"] != rec["params"]
+    back = enc.decrypt_fields(out, ["params"])
+    assert back["params"] == {"prompt": "secret text"}
+
+
+def test_retention_cleanup():
+    async def body():
+        s = Store()
+        pol = RetentionPolicy(s, default_days=30)
+        old = time.time() - 40 * 86400
+        await s.create_job({"type": "llm", "params": {}, "status": "completed",
+                            "completed_at": old})
+        await s.create_job({"type": "llm", "params": {}, "status": "completed",
+                            "completed_at": time.time()})
+        await s.insert("usage_records",
+                       {"job_id": "x", "job_type": "llm", "units": 1,
+                        "created_at": old})
+        res = await pol.cleanup()
+        assert res["jobs_deleted"] == 1
+        assert res["usage_deleted"] == 1
+        remaining = await s.query("SELECT COUNT(*) AS n FROM jobs")
+        assert remaining[0]["n"] == 1
+        s.close()
+
+    run(body())
+
+
+def test_enterprise_privacy_orchestration():
+    async def body():
+        s = Store()
+        svc = EnterprisePrivacyService(s, passphrase="k")
+        ent = await s.insert(
+            "enterprises",
+            {"name": "acme", "allow_logging": 1, "anonymize_data": 1,
+             "encrypt_fields": 1},
+        )
+        job = {"id": "j1", "type": "llm", "client_ip": "203.0.113.77",
+               "params": {"prompt": "email bob@example.com"},
+               "result": {"text": "ok"}}
+        prepared = await svc.prepare_job_record(job, enterprise_id=ent)
+        assert prepared["client_ip"] == "203.0.113.0"
+        assert isinstance(prepared["params"], str)  # encrypted
+
+        no_log = await s.insert(
+            "enterprises", {"name": "quiet", "allow_logging": 0}
+        )
+        assert await svc.prepare_job_record(job, enterprise_id=no_log) is None
+
+        await s.insert("usage_records",
+                       {"enterprise_id": ent, "job_id": "j1",
+                        "job_type": "llm", "units": 5})
+        export = await svc.export_enterprise_data(ent)
+        assert len(export["usage_records"]) == 1
+        deleted = await svc.delete_enterprise_data(ent)
+        assert deleted["usage_deleted"] == 1
+        report = await svc.compliance_report()
+        assert report["enterprises"] == 2
+        assert report["with_anonymization"] == 1
+        s.close()
+
+    run(body())

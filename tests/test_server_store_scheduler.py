@@ -1,0 +1,421 @@
+"""Store / scheduler / reliability / task-guarantee tests.
+
+Mirrors the reference's hermetic server tests (sqlite in-memory instead of
+Postgres — SURVEY §4: ``tests/conftest.py:7``), exercising the reconstructed
+§2.1 schema, the atomic job claim, score-based ranking, reliability deltas,
+and the requeue/sweep machinery.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from distributed_gpu_inference_tpu.server.reliability import ReliabilityService
+from distributed_gpu_inference_tpu.server.scheduler import (
+    SmartScheduler,
+    estimate_job_duration_s,
+    region_distance,
+)
+from distributed_gpu_inference_tpu.server.store import Store
+from distributed_gpu_inference_tpu.server.task_guarantee import (
+    TaskGuaranteeService,
+)
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    JobStatus,
+    WorkerState,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _worker(wid="w1", **kw):
+    base = {
+        "id": wid,
+        "name": wid,
+        "region": "us-west",
+        "supported_types": ["llm"],
+        "status": WorkerState.IDLE.value,
+        "last_heartbeat": time.time(),
+        "num_chips": 4,
+    }
+    base.update(kw)
+    return base
+
+
+def _job(**kw):
+    base = {"type": "llm", "params": {"max_new_tokens": 64}}
+    base.update(kw)
+    return base
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+
+def test_worker_roundtrip_json_fields():
+    async def body():
+        s = Store()
+        await s.upsert_worker(_worker(loaded_models=["llama3-8b"],
+                                      online_pattern={"3": 0.7}))
+        w = await s.get_worker("w1")
+        assert w["supported_types"] == ["llm"]
+        assert w["loaded_models"] == ["llama3-8b"]
+        assert w["online_pattern"] == {"3": 0.7}
+        await s.update_worker("w1", status=WorkerState.BUSY.value)
+        assert (await s.get_worker("w1"))["status"] == "busy"
+        s.close()
+
+    run(body())
+
+
+def test_job_crud_and_listing_order():
+    async def body():
+        s = Store()
+        low = await s.create_job(_job(priority=0))
+        high = await s.create_job(_job(priority=5))
+        jobs = await s.list_jobs(status=[JobStatus.QUEUED.value])
+        assert [j["id"] for j in jobs] == [high, low]  # priority DESC
+        await s.update_job(low, status=JobStatus.CANCELLED.value)
+        assert (await s.get_job(low))["status"] == "cancelled"
+        s.close()
+
+    run(body())
+
+
+def test_claim_next_job_atomic_and_filtered():
+    async def body():
+        s = Store()
+        await s.upsert_worker(_worker())
+        jid = await s.create_job(_job())
+        await s.create_job({"type": "image_gen", "params": {}})
+        got = await s.claim_next_job("w1", ["llm"], region="us-west")
+        assert got["id"] == jid and got["status"] == JobStatus.RUNNING.value
+        assert got["worker_id"] == "w1"
+        # second claim: only the image_gen job remains, not supported
+        assert await s.claim_next_job("w1", ["llm"], region="us-west") is None
+        s.close()
+
+    run(body())
+
+
+def test_claim_respects_cross_region_restriction():
+    async def body():
+        s = Store()
+        await s.create_job(
+            _job(preferred_region="eu-west", allow_cross_region=False)
+        )
+        assert await s.claim_next_job("w1", ["llm"], region="us-west") is None
+        got = await s.claim_next_job("w2", ["llm"], region="eu-west")
+        assert got is not None
+        s.close()
+
+    run(body())
+
+
+def test_concurrent_claims_unique():
+    """Two workers claiming concurrently never get the same job."""
+
+    async def body():
+        s = Store()
+        ids = [await s.create_job(_job()) for _ in range(4)]
+        got = await asyncio.gather(
+            *[s.claim_next_job(f"w{i}", ["llm"]) for i in range(6)]
+        )
+        claimed = [g["id"] for g in got if g is not None]
+        assert sorted(claimed) == sorted(ids)
+        assert len(set(claimed)) == len(claimed)
+        s.close()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+
+def test_region_distance_matrix_symmetric_zero_diag():
+    assert region_distance("us-west", "us-west") == 0
+    assert region_distance("us-west", "eu-west") == region_distance(
+        "eu-west", "us-west"
+    )
+
+
+def test_duration_estimator_scales_with_chips():
+    d1 = estimate_job_duration_s("llm", {"max_new_tokens": 300}, num_chips=1)
+    d4 = estimate_job_duration_s("llm", {"max_new_tokens": 300}, num_chips=4)
+    assert d4 < d1
+    assert estimate_job_duration_s("image_gen", {"num_inference_steps": 50}) > 3
+
+
+def test_scheduler_ranks_by_score():
+    async def body():
+        s = Store()
+        await s.upsert_worker(
+            _worker("good", reliability_score=0.9, region="us-west")
+        )
+        await s.upsert_worker(
+            _worker("bad", reliability_score=0.1, region="asia-east")
+        )
+        sched = SmartScheduler(s)
+        ranked = await sched.rank_workers(
+            {"type": "llm", "preferred_region": "us-west"}
+        )
+        assert [w["id"] for w in ranked] == ["good", "bad"]
+        s.close()
+
+    run(body())
+
+
+def test_atomic_assign_marks_worker_busy():
+    async def body():
+        s = Store()
+        await s.upsert_worker(_worker())
+        await s.create_job(_job())
+        sched = SmartScheduler(s)
+        job = await sched.atomic_assign_job("w1")
+        assert job is not None
+        w = await s.get_worker("w1")
+        assert w["status"] == WorkerState.BUSY.value
+        assert w["current_job_id"] == job["id"]
+        # draining workers get nothing
+        await s.update_worker("w1", status=WorkerState.DRAINING.value)
+        await s.create_job(_job())
+        assert await sched.atomic_assign_job("w1") is None
+        s.close()
+
+    run(body())
+
+
+def test_queue_stats_wait_estimate():
+    async def body():
+        s = Store()
+        sched = SmartScheduler(s)
+        stats = await sched.get_queue_stats()
+        assert stats["active_workers"] == 0
+        await s.upsert_worker(_worker())
+        await s.create_job(_job())
+        stats = await sched.get_queue_stats()
+        assert stats["queued"] == 1
+        assert stats["estimated_wait_s"] > 0
+        assert stats["total_chips"] == 4
+        s.close()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# reliability
+# ---------------------------------------------------------------------------
+
+
+def test_reliability_score_deltas_and_clamp():
+    async def body():
+        s = Store()
+        await s.upsert_worker(_worker(reliability_score=0.5))
+        r = ReliabilityService(s)
+        sc = await r.record_event("w1", "job_completed", latency_ms=500.0)
+        # +0.02 complete, +0.01 fast response
+        assert sc == pytest.approx(0.53)
+        w = await s.get_worker("w1")
+        assert w["completed_jobs"] == 1 and w["success_rate"] == 1.0
+        for _ in range(20):
+            sc = await r.record_event("w1", "unexpected_offline")
+        assert sc == 0.0  # clamped
+        s.close()
+
+    run(body())
+
+
+def test_session_tracking_updates_averages():
+    async def body():
+        s = Store()
+        await s.upsert_worker(_worker())
+        r = ReliabilityService(s)
+        t0 = 1000.0
+        await r.start_session("w1", now=t0)
+        minutes = await r.end_session("w1", graceful=True, now=t0 + 1200)
+        assert minutes == pytest.approx(20.0)
+        w = await s.get_worker("w1")
+        assert w["total_sessions"] == 1
+        assert w["avg_session_minutes"] == pytest.approx(20.0)
+        assert w["total_online_seconds"] == pytest.approx(1200.0)
+        s.close()
+
+    run(body())
+
+
+def test_online_pattern_ema_and_prediction():
+    async def body():
+        s = Store()
+        await s.upsert_worker(_worker())
+        r = ReliabilityService(s)
+        now = time.time()
+        for _ in range(10):
+            await r.update_online_pattern("w1", online=True, now=now)
+        w = await s.get_worker("w1")
+        p = r.predict_online_probability(w, now=now)
+        assert p > 0.6  # strong online history this hour
+        s.close()
+
+    run(body())
+
+
+def test_predict_remaining_online_time():
+    async def body():
+        s = Store()
+        await s.upsert_worker(
+            _worker(avg_session_minutes=30.0, current_session_start=1000.0)
+        )
+        r = ReliabilityService(s)
+        w = await s.get_worker("w1")
+        rem = r.predict_remaining_online_time(w, now=1000.0 + 600)
+        assert rem == pytest.approx(20.0)
+        s.close()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# task guarantee
+# ---------------------------------------------------------------------------
+
+
+def test_requeue_until_max_retries():
+    async def body():
+        s = Store()
+        g = TaskGuaranteeService(s)
+        jid = await s.create_job(_job(max_retries=2))
+        job = await s.get_job(jid)
+        assert await g.requeue_job(job) == JobStatus.QUEUED.value
+        job = await s.get_job(jid)
+        assert job["retry_count"] == 1
+        job["retry_count"] = 2
+        await s.update_job(jid, retry_count=2)
+        job = await s.get_job(jid)
+        assert await g.requeue_job(job) == JobStatus.FAILED.value
+        assert "max_retries" in (await s.get_job(jid))["error"]
+        s.close()
+
+    run(body())
+
+
+def test_worker_offline_requeues_running_jobs():
+    async def body():
+        s = Store()
+        g = TaskGuaranteeService(s)
+        await s.upsert_worker(_worker())
+        jid = await s.create_job(_job())
+        await s.claim_next_job("w1", ["llm"])
+        requeued = await g.handle_worker_offline("w1")
+        assert requeued == [jid]
+        assert (await s.get_job(jid))["status"] == JobStatus.QUEUED.value
+        assert (await s.get_worker("w1"))["status"] == WorkerState.OFFLINE.value
+        s.close()
+
+    run(body())
+
+
+def test_sweep_dead_workers_and_stale_jobs():
+    async def body():
+        s = Store()
+        g = TaskGuaranteeService(s, heartbeat_timeout_s=90.0)
+        now = time.time()
+        await s.upsert_worker(_worker("dead", last_heartbeat=now - 1000))
+        await s.upsert_worker(_worker("alive", last_heartbeat=now))
+        jid = await s.create_job(_job(timeout_seconds=10.0))
+        await s.claim_next_job("alive", ["llm"])
+        await s.update_job(jid, started_at=now - 60)  # past its 10 s timeout
+        result = await g.sweep(now=now)
+        assert result["dead_workers"] == ["dead"]
+        assert result["stale_jobs"] == [jid]
+        s.close()
+
+    run(body())
+
+
+def test_wait_for_job_returns_on_completion():
+    async def body():
+        s = Store()
+        g = TaskGuaranteeService(s)
+        jid = await s.create_job(_job())
+
+        async def complete_later():
+            await asyncio.sleep(0.05)
+            await s.update_job(jid, status=JobStatus.COMPLETED.value,
+                               result={"text": "hi"})
+
+        task = asyncio.get_running_loop().create_task(complete_later())
+        job = await g.wait_for_job(jid, timeout_s=2.0, poll_s=0.01)
+        await task
+        assert job["status"] == JobStatus.COMPLETED.value
+        assert job["result"] == {"text": "hi"}
+        s.close()
+
+    run(body())
+
+
+def test_stale_job_requeue_frees_worker_capacity():
+    """Regression: a timed-out job must not leave a phantom BUSY worker."""
+
+    async def body():
+        s = Store()
+        g = TaskGuaranteeService(s)
+        now = time.time()
+        await s.upsert_worker(_worker(last_heartbeat=now))
+        jid = await s.create_job(_job(timeout_seconds=10.0))
+        await s.claim_next_job("w1", ["llm"])
+        await s.update_worker("w1", current_job_id=jid,
+                              status=WorkerState.BUSY.value)
+        await s.update_job(jid, started_at=now - 60)
+        swept = await g.sweep_stale_jobs(now=now)
+        assert swept == [jid]
+        w = await s.get_worker("w1")
+        assert w["current_job_id"] is None
+        assert w["status"] == WorkerState.IDLE.value
+        s.close()
+
+    run(body())
+
+
+def test_dead_worker_penalty_applied_once():
+    """Regression: unexpected_offline must not be double-counted per sweep."""
+
+    async def body():
+        s = Store()
+        g = TaskGuaranteeService(s, heartbeat_timeout_s=90.0)
+        now = time.time()
+        await s.upsert_worker(
+            _worker("dead", last_heartbeat=now - 1000,
+                    reliability_score=0.5, current_session_start=now - 2000)
+        )
+        await g.sweep_dead_workers(now=now)
+        w = await s.get_worker("dead")
+        assert w["unexpected_offline_count"] == 1
+        assert w["reliability_score"] == pytest.approx(0.35)  # one -0.15 delta
+        s.close()
+
+    run(body())
+
+
+def test_claim_scans_past_region_restricted_head():
+    """Regression: 20+ cross-region-locked jobs at the head must not starve
+    claimable jobs behind them."""
+
+    async def body():
+        s = Store()
+        for _ in range(25):
+            await s.create_job(
+                _job(priority=5, preferred_region="eu-west",
+                     allow_cross_region=False)
+            )
+        jid = await s.create_job(_job(priority=0))
+        got = await s.claim_next_job("w1", ["llm"], region="us-west")
+        assert got is not None and got["id"] == jid
+        s.close()
+
+    run(body())
