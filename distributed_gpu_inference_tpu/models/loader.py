@@ -158,3 +158,25 @@ def load_checkpoint(path: str | Path, template: Optional[Dict[str, Any]] = None
     if template is not None:
         return ckptr.restore(path / "params", template)
     return ckptr.restore(path / "params")
+
+
+def load_or_init_params(
+    cfg: ModelConfig,
+    checkpoint_path: Optional[str] = None,
+    dtype: Optional[Any] = None,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """One-stop weight source for engines: orbax checkpoint dir, HF
+    safetensors dir, or random init (hermetic tests / benchmarks)."""
+    import jax
+
+    from distributed_gpu_inference_tpu.models import llama
+
+    if checkpoint_path:
+        p = Path(checkpoint_path)
+        if (p / "config.json").exists() or list(p.glob("*.safetensors")):
+            return load_hf_llama(p, cfg, dtype=dtype)
+        return load_checkpoint(p)
+    return llama.init_params(
+        cfg, jax.random.PRNGKey(seed), jnp.dtype(dtype or cfg.dtype)
+    )

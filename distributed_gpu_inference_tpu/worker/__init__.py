@@ -1,0 +1,7 @@
+"""Worker runtime: registration, poll loop, engines, direct serving.
+
+TPU-native re-design of the reference's ``worker/`` layer: the process model
+(register → heartbeat thread + poll loop → engine dispatch → graceful drain)
+matches ``worker/main.py``, but engines run jitted JAX graphs on TPU chips
+instead of wrapping vLLM/SGLang subprocesses.
+"""

@@ -1,0 +1,185 @@
+"""HTTP client from worker to control plane.
+
+Behavioral parity with the reference's ``worker/api_client.py``:
+- Retry with exponential backoff, but never on 4xx (:71-99, :87).
+- HMAC-SHA256 request signing over METHOD:PATH:BODY_HASH:TS (:52-69) using
+  the signing secret issued at registration.
+- 204 from next-job means "no job" (:161); token refresh flow (:263).
+
+Transport is httpx (sync — the worker's poll loop is a plain thread like the
+reference's). The signing canonicalization matches
+``server.security.RequestSigner`` so the server can verify.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import httpx
+
+from ..server.security import RequestSigner
+
+
+class APIError(Exception):
+    def __init__(self, status: int, detail: str = "") -> None:
+        super().__init__(f"HTTP {status}: {detail}")
+        self.status = status
+        self.detail = detail
+
+
+class APIClient:
+    def __init__(
+        self,
+        base_url: str,
+        worker_id: Optional[str] = None,
+        auth_token: Optional[str] = None,
+        refresh_token: Optional[str] = None,
+        signing_secret: Optional[str] = None,
+        max_retries: int = 3,
+        backoff_s: float = 0.5,
+        timeout_s: float = 30.0,
+        transport: Optional[httpx.BaseTransport] = None,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.worker_id = worker_id
+        self.auth_token = auth_token
+        self.refresh_token = refresh_token
+        self.signing_secret = signing_secret
+        self._max_retries = max_retries
+        self._backoff_s = backoff_s
+        self._signer = RequestSigner()
+        self._client = httpx.Client(
+            base_url=self.base_url, timeout=timeout_s, transport=transport
+        )
+
+    def close(self) -> None:
+        self._client.close()
+
+    # -- low-level ----------------------------------------------------------
+
+    def _headers(self, method: str, path: str, body: bytes) -> Dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+        if self.signing_secret:
+            headers.update(
+                self._signer.sign(self.signing_secret, method, path, body)
+            )
+        return headers
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None,
+                 retries: Optional[int] = None) -> httpx.Response:
+        body = json.dumps(payload).encode() if payload is not None else b""
+        attempts = (self._max_retries if retries is None else retries) + 1
+        last_exc: Optional[Exception] = None
+        for attempt in range(attempts):
+            try:
+                resp = self._client.request(
+                    method, path, content=body or None,
+                    headers=self._headers(method, path, body),
+                )
+            except httpx.TransportError as exc:
+                last_exc = exc
+                if attempt + 1 < attempts:
+                    time.sleep(self._backoff_s * (2**attempt))
+                continue
+            if resp.status_code >= 500 and attempt + 1 < attempts:
+                time.sleep(self._backoff_s * (2**attempt))
+                continue
+            if 400 <= resp.status_code < 500:  # never retried (:87)
+                detail = ""
+                try:
+                    detail = resp.json().get("detail", "")
+                except ValueError:
+                    pass
+                raise APIError(resp.status_code, detail)
+            if resp.status_code >= 500:
+                raise APIError(resp.status_code, resp.text[:200])
+            return resp
+        raise APIError(599, f"transport failed: {last_exc}")
+
+    # -- registration / auth --------------------------------------------------
+
+    def register(self, info: Dict[str, Any]) -> Dict[str, Any]:
+        if self.worker_id:
+            info = {**info, "worker_id": self.worker_id}
+        resp = self._request("POST", "/api/v1/workers/register", info)
+        data = resp.json()
+        self.worker_id = data["worker_id"]
+        self.auth_token = data["auth_token"]
+        self.refresh_token = data["refresh_token"]
+        self.signing_secret = data["signing_secret"]
+        return data
+
+    def verify_credentials(self) -> bool:
+        if not (self.worker_id and self.auth_token):
+            return False
+        try:
+            self._request(
+                "POST", f"/api/v1/workers/{self.worker_id}/verify", {}
+            )
+            return True
+        except APIError:
+            return False
+
+    def refresh_credentials(self) -> Dict[str, Any]:
+        resp = self._request(
+            "POST",
+            f"/api/v1/workers/{self.worker_id}/refresh-token",
+            {"refresh_token": self.refresh_token},
+        )
+        data = resp.json()
+        self.auth_token = data["auth_token"]
+        self.refresh_token = data["refresh_token"]
+        self.signing_secret = data["signing_secret"]
+        return data
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def heartbeat(self, status: str = "idle",
+                  config_version: int = 0,
+                  **extra: Any) -> Dict[str, Any]:
+        resp = self._request(
+            "POST",
+            f"/api/v1/workers/{self.worker_id}/heartbeat",
+            {"status": status, "config_version": config_version, **extra},
+        )
+        return resp.json()
+
+    def fetch_next_job(self) -> Optional[Dict[str, Any]]:
+        resp = self._request(
+            "GET", f"/api/v1/workers/{self.worker_id}/next-job", retries=0
+        )
+        if resp.status_code == 204:
+            return None
+        return resp.json()["job"]
+
+    def complete_job(self, job_id: str, success: bool,
+                     result: Optional[Dict[str, Any]] = None,
+                     error: Optional[str] = None) -> Dict[str, Any]:
+        resp = self._request(
+            "POST",
+            f"/api/v1/workers/{self.worker_id}/jobs/{job_id}/complete",
+            {"success": success, "result": result, "error": error},
+        )
+        return resp.json()
+
+    def going_offline(self) -> None:
+        self._request(
+            "POST", f"/api/v1/workers/{self.worker_id}/going-offline", {}
+        )
+
+    def offline(self) -> List[str]:
+        resp = self._request(
+            "POST", f"/api/v1/workers/{self.worker_id}/offline", {}
+        )
+        return resp.json().get("requeued_jobs", [])
+
+    def fetch_remote_config(self) -> Dict[str, Any]:
+        resp = self._request(
+            "GET", f"/api/v1/workers/{self.worker_id}/config"
+        )
+        return resp.json()

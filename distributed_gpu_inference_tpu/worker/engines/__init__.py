@@ -1,0 +1,98 @@
+"""Engine registry: pluggable per-task-type inference engines.
+
+Behavioral parity with the reference's ``worker/engines/__init__.py``:
+registry with lazy imports of heavy backends (:51-105), aliases (:66), and an
+auto-pick order (:172-193). The reference's ladder was SGLang > vLLM >
+native-Transformers; here the "native" engine IS the TPU-first path (jitted
+paged-KV serving, ``runtime/engine.py``) so it is also the best one — the
+registry survives for task-type dispatch (llm / embedding / image_gen /
+vision / whisper) and for test doubles.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Dict, List, Optional
+
+from .base import BaseEngine, EngineLoadError, GenerationConfig, GenerationResult
+
+# task type → module path : class name (lazy, heavy deps import on first use)
+ENGINE_REGISTRY: Dict[str, str] = {
+    "llm": "distributed_gpu_inference_tpu.worker.engines.llm:TPULLMEngine",
+    "embedding": (
+        "distributed_gpu_inference_tpu.worker.engines.embedding:EmbeddingEngine"
+    ),
+    "image_gen": (
+        "distributed_gpu_inference_tpu.worker.engines.image_gen:ImageGenEngine"
+    ),
+    "vision": "distributed_gpu_inference_tpu.worker.engines.vision:VisionEngine",
+    "whisper": "distributed_gpu_inference_tpu.worker.engines.whisper:WhisperEngine",
+}
+
+# friendly aliases (reference __init__.py:66)
+ALIASES: Dict[str, str] = {
+    "text": "llm",
+    "chat": "llm",
+    "text-generation": "llm",
+    "embed": "embedding",
+    "embeddings": "embedding",
+    "image": "image_gen",
+    "txt2img": "image_gen",
+    "vlm": "vision",
+    "image_qa": "vision",
+    "asr": "whisper",
+    "speech": "whisper",
+}
+
+_OVERRIDES: Dict[str, Callable[..., BaseEngine]] = {}
+
+
+def resolve_task_type(task_type: str) -> str:
+    t = task_type.lower().strip()
+    return ALIASES.get(t, t)
+
+
+def register_engine(task_type: str, factory: Callable[..., BaseEngine]) -> None:
+    """Test/extension hook: override a task type with a custom factory."""
+    _OVERRIDES[resolve_task_type(task_type)] = factory
+
+
+def available_task_types() -> List[str]:
+    return sorted(set(ENGINE_REGISTRY) | set(_OVERRIDES))
+
+
+def get_engine_class(task_type: str) -> Callable[..., BaseEngine]:
+    t = resolve_task_type(task_type)
+    if t in _OVERRIDES:
+        return _OVERRIDES[t]
+    spec = ENGINE_REGISTRY.get(t)
+    if spec is None:
+        raise KeyError(
+            f"no engine for task type {task_type!r}; "
+            f"known: {available_task_types()}"
+        )
+    module_path, _, cls_name = spec.partition(":")
+    module = importlib.import_module(module_path)
+    return getattr(module, cls_name)
+
+
+def create_engine(task_type: str, config: Optional[Dict[str, Any]] = None
+                  ) -> BaseEngine:
+    """Instantiate (not yet loaded) the engine for a task type."""
+    cls = get_engine_class(task_type)
+    return cls(config or {})
+
+
+__all__ = [
+    "BaseEngine",
+    "EngineLoadError",
+    "GenerationConfig",
+    "GenerationResult",
+    "ENGINE_REGISTRY",
+    "ALIASES",
+    "available_task_types",
+    "create_engine",
+    "get_engine_class",
+    "register_engine",
+    "resolve_task_type",
+]

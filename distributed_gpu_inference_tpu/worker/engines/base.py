@@ -1,0 +1,150 @@
+"""Engine base classes.
+
+Behavioral parity with the reference's ``worker/engines/base.py`` (BaseEngine
+ABC: load/inference/unload, :10-57) and ``llm_base.py`` (LLMBaseEngine:
+async/batch/stream variants plus a sync bridge that must not deadlock when
+called inside a running event loop, :116-150 — regression-tested in the
+reference by ``worker/tests/test_llm_base_inference_event_loop.py``).
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import concurrent.futures
+import threading
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+
+class EngineLoadError(RuntimeError):
+    """Model/deps unavailable — worker should drop this task type."""
+
+
+@dataclass
+class GenerationConfig:
+    """Per-request generation knobs (reference ``__init__.py:24``)."""
+
+    max_new_tokens: int = 256
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop: List[str] = field(default_factory=list)
+    seed: Optional[int] = None
+
+    @classmethod
+    def from_params(cls, params: Dict[str, Any]) -> "GenerationConfig":
+        return cls(
+            max_new_tokens=int(
+                params.get("max_new_tokens") or params.get("max_tokens") or 256
+            ),
+            temperature=float(params.get("temperature") or 0.0),
+            top_k=int(params.get("top_k") or 0),
+            top_p=float(params.get("top_p") or 1.0),
+            stop=list(params.get("stop") or []),
+            seed=params.get("seed"),
+        )
+
+
+@dataclass
+class GenerationResult:
+    """Uniform result surface (reference ``__init__.py:35``)."""
+
+    text: str
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    cached_tokens: int = 0
+    finish_reason: str = "stop"
+    ttft_ms: Optional[float] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_result_payload(self) -> Dict[str, Any]:
+        """Shape of the job ``result`` JSON the control plane stores/bills."""
+        return {
+            "text": self.text,
+            "finish_reason": self.finish_reason,
+            "usage": {
+                "prompt_tokens": self.prompt_tokens,
+                "completion_tokens": self.completion_tokens,
+                "total_tokens": self.prompt_tokens + self.completion_tokens,
+                "cached_tokens": self.cached_tokens,
+            },
+            **({"ttft_ms": self.ttft_ms} if self.ttft_ms is not None else {}),
+            **self.extra,
+        }
+
+
+class BaseEngine(abc.ABC):
+    """load_model → inference(params) → unload lifecycle."""
+
+    task_type: str = "llm"
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
+        self.config = dict(config or {})
+        self.loaded = False
+
+    @abc.abstractmethod
+    def load_model(self) -> None: ...
+
+    @abc.abstractmethod
+    def inference(self, params: Dict[str, Any]) -> Dict[str, Any]: ...
+
+    def unload(self) -> None:
+        self.loaded = False
+
+    def health(self) -> Dict[str, Any]:
+        return {"loaded": self.loaded, "task_type": self.task_type}
+
+
+class LLMBaseEngine(BaseEngine):
+    """Adds async/batch/stream on top of a sync ``_generate`` core.
+
+    The sync bridge mirrors the reference's deadlock-avoidance contract
+    (``llm_base.py:116-150``): calling :meth:`inference` from inside a running
+    event loop must hop to a helper thread instead of ``run_until_complete``
+    on the current loop.
+    """
+
+    def _generate(self, prompt_or_messages: Any,
+                  cfg: GenerationConfig) -> GenerationResult:
+        raise NotImplementedError
+
+    # -- sync entry (thread-safe, loop-safe) ---------------------------------
+
+    def inference(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = GenerationConfig.from_params(params)
+        prompt = params.get("messages") or params.get("prompt") or ""
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            result = self._generate(prompt, cfg)
+            return result.to_result_payload()
+        # inside a loop: run in a fresh thread so we neither block the loop's
+        # callbacks nor nest run_until_complete (reference llm_base.py:116-150)
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            result = pool.submit(self._generate, prompt, cfg).result()
+        return result.to_result_payload()
+
+    # -- async + batch + stream ----------------------------------------------
+
+    async def inference_async(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        loop = asyncio.get_running_loop()
+        cfg = GenerationConfig.from_params(params)
+        prompt = params.get("messages") or params.get("prompt") or ""
+        result = await loop.run_in_executor(None, self._generate, prompt, cfg)
+        return result.to_result_payload()
+
+    def batch_inference(self, batch: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+        return [self.inference(p) for p in batch]
+
+    async def batch_inference_async(self, batch: List[Dict[str, Any]]
+                                    ) -> List[Dict[str, Any]]:
+        return await asyncio.gather(
+            *[self.inference_async(p) for p in batch]
+        )
+
+    async def stream_inference(self, params: Dict[str, Any]
+                               ) -> AsyncIterator[Dict[str, Any]]:
+        """Default streaming = one final chunk; token-level engines override."""
+        yield await self.inference_async(params)

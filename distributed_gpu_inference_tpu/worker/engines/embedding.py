@@ -1,0 +1,118 @@
+"""Embedding engine: mean-pooled final hidden states of the decoder.
+
+The reference exposes embeddings as a task type in its engine registry
+(``worker/engines/__init__.py`` task families) without a first-party
+implementation (delegated to backends). Here it is first-party: one jitted
+forward over the same Llama params as the LLM engine, masked mean-pool of the
+final-norm hidden states, L2-normalised — the standard decoder-as-embedder
+recipe, all on the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .base import BaseEngine, EngineLoadError
+
+
+class EmbeddingEngine(BaseEngine):
+    """config keys: model, tokenizer / tokenizer_id, max_seq_len."""
+
+    task_type = "embedding"
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__(config)
+        self.tokenizer = self.config.get("tokenizer")
+        self._fwd = None
+        self._params = None
+        self._cfg = None
+
+    def load_model(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ...models import llama
+        from ...models.configs import get_model_config
+        from ...models.loader import load_or_init_params
+
+        model_name = self.config.get("model", "llama3-mini")
+        self._cfg = get_model_config(model_name)
+        self._params = load_or_init_params(
+            self._cfg, checkpoint_path=self.config.get("checkpoint_path")
+        )
+        if self.tokenizer is None:
+            tok_id = self.config.get("tokenizer_id")
+            if tok_id:
+                from .llm import _load_hf_tokenizer
+
+                self.tokenizer = _load_hf_tokenizer(tok_id)
+            else:
+                from .llm import ByteTokenizer
+
+                self.tokenizer = ByteTokenizer()
+        max_len = int(self.config.get("max_seq_len", 512))
+        cfg = self._cfg
+
+        @functools.partial(jax.jit, static_argnames=())
+        def embed(params, token_ids, lengths):
+            # [B, S] -> hidden [B, S, H] (no KV needed: single full-seq pass)
+            b, s = token_ids.shape
+            positions = jnp.arange(s)[None, :].astype(jnp.int32)
+            mask_valid = positions < lengths[:, None]
+            positions = jnp.where(mask_valid, positions, -1)
+            kv = llama.init_kv_pools(cfg, num_blocks=1 + b * ((s + 15) // 16),
+                                     block_size=16)
+            tables = (
+                1 + jnp.arange(b * ((s + 15) // 16), dtype=jnp.int32)
+            ).reshape(b, -1)
+            out = llama.forward_chunk(
+                cfg, params, token_ids, positions, kv, tables,
+                jnp.zeros((b,), jnp.int32), block_size=16, last_only=False,
+            )
+            hidden = llama.rms_norm(
+                out.hidden, params["final_norm"], cfg.rms_norm_eps
+            ).astype(jnp.float32)
+            m = mask_valid[..., None].astype(jnp.float32)
+            pooled = (hidden * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+            return pooled / jnp.maximum(
+                jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+            )
+
+        self._fwd = embed
+        self._max_len = max_len
+        self.loaded = True
+
+    def unload(self) -> None:
+        self._fwd = None
+        self._params = None
+        super().unload()
+
+    def inference(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        if not self.loaded:
+            raise EngineLoadError("engine not loaded")
+        texts = params.get("texts")
+        if texts is None:
+            texts = [params.get("text") or params.get("prompt") or ""]
+        import jax.numpy as jnp
+
+        ids: List[List[int]] = [
+            list(self.tokenizer.encode(t))[: self._max_len] for t in texts
+        ]
+        lengths = np.array([max(1, len(i)) for i in ids], np.int32)
+        s = max(8, int(max(lengths)))
+        batch = np.zeros((len(ids), s), np.int32)
+        for r, seq in enumerate(ids):
+            batch[r, : len(seq)] = seq
+        out = np.asarray(
+            self._fwd(self._params, jnp.asarray(batch), jnp.asarray(lengths))
+        )
+        total_tokens = int(lengths.sum())
+        return {
+            "embeddings": out.tolist(),
+            "dim": int(out.shape[-1]),
+            "usage": {"prompt_tokens": total_tokens,
+                      "total_tokens": total_tokens},
+        }

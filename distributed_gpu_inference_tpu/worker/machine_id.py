@@ -1,0 +1,89 @@
+"""Stable machine fingerprint for worker identity.
+
+Behavioral parity with the reference's ``worker/machine_id.py``: combine
+hardware identifiers (MAC :56, /etc/machine-id :65, accelerator identity
+:119) into a stable worker id, persisted so re-registrations keep the same
+identity (:140-178). TPU delta: the accelerator component is the TPU chip
+topology (kind + chip count) from jax instead of nvidia-smi GPU UUIDs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import uuid
+from pathlib import Path
+from typing import Dict, Optional
+
+DEFAULT_STATE_DIR = "~/.dgi_tpu"
+
+
+def _mac_address() -> str:
+    return f"{uuid.getnode():012x}"
+
+
+def _machine_id() -> str:
+    for path in ("/etc/machine-id", "/var/lib/dbus/machine-id"):
+        try:
+            text = Path(path).read_text().strip()
+            if text:
+                return text
+        except OSError:
+            continue
+    return ""
+
+
+def _tpu_identity() -> str:
+    """Accelerator component: TPU platform + device kinds (no nvidia-smi)."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        kinds = sorted({d.device_kind for d in devs})
+        return f"{jax.default_backend()}:{','.join(kinds)}:{len(devs)}"
+    except Exception:  # noqa: BLE001 — fingerprint must work without jax/TPU
+        return "cpu-only"
+
+
+class MachineFingerprint:
+    """Computes and persists a stable fingerprint."""
+
+    def __init__(self, state_dir: str = DEFAULT_STATE_DIR) -> None:
+        self._dir = Path(os.path.expanduser(state_dir))
+        self._file = self._dir / "machine_fingerprint.json"
+
+    def components(self) -> Dict[str, str]:
+        return {
+            "mac": _mac_address(),
+            "machine_id": _machine_id(),
+            "accelerator": _tpu_identity(),
+        }
+
+    def compute(self) -> str:
+        blob = json.dumps(self.components(), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+    def load(self) -> Optional[str]:
+        try:
+            data = json.loads(self._file.read_text())
+            return data.get("fingerprint") or None
+        except (OSError, ValueError):
+            return None
+
+    def save(self, fingerprint: str) -> None:
+        self._dir.mkdir(parents=True, exist_ok=True)
+        payload = {"fingerprint": fingerprint, "components": self.components()}
+        self._file.write_text(json.dumps(payload, indent=2))
+
+    def get_or_create(self) -> str:
+        """Persisted fingerprint wins (stable across hardware tweaks)."""
+        existing = self.load()
+        if existing:
+            return existing
+        fp = self.compute()
+        try:
+            self.save(fp)
+        except OSError:  # read-only fs: still return a usable id
+            pass
+        return fp
