@@ -1,0 +1,130 @@
+"""KV handoff (prefill→decode migration): export/adopt correctness.
+
+The invariant under test is the one the reference never implements (its KV
+migration is a simulated sleep, ``server/app/services/pd_scheduler.py:462``):
+a generation continued on the RECIPIENT engine after a real page transfer
+must produce exactly the tokens the donor would have produced.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
+from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+    adopt_kv,
+    deserialize_handoff,
+    export_slot_kv,
+    serialize_handoff,
+)
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+MODEL = "llama3-tiny"
+TOTAL_NEW = 12
+PROMPT = [5, 17, 3, 99, 42, 7, 256, 31, 8, 120, 64]
+
+
+def _cfg():
+    return EngineConfig(
+        max_batch_size=2, max_seq_len=64, block_size=16,
+        prefill_buckets=(16, 32), dtype="float32",
+    )
+
+
+def _req():
+    return InferenceRequest(
+        prompt_token_ids=list(PROMPT),
+        sampling=SamplingParams(max_new_tokens=TOTAL_NEW, temperature=0.0),
+    )
+
+
+@pytest.fixture(scope="module")
+def shared_params():
+    eng = TPUEngine(MODEL, _cfg(), seed=0)
+    return eng.params
+
+
+@pytest.fixture(scope="module")
+def reference_tokens(shared_params):
+    eng = TPUEngine(MODEL, _cfg(), params=shared_params, seed=0)
+    resp = eng.generate([_req()])[0]
+    assert len(resp.token_ids) == TOTAL_NEW
+    return resp.token_ids
+
+
+def _run_split(shared_params, split_at, via_wire):
+    donor = TPUEngine(MODEL, _cfg(), params=shared_params, seed=0)
+    slot = donor.submit(_req())
+    steps = 0
+    while len(donor.slots[slot].generated) < split_at:
+        donor.decode_step()
+        steps += 1
+        assert steps < 64
+
+    handoff = export_slot_kv(donor, slot)
+    assert handoff.kv_len == int(donor._kv_lens[slot])
+    assert handoff.pages.shape[0] == len(donor.manager.seq_blocks[
+        donor.slots[slot].seq_id])
+    if via_wire:
+        handoff = deserialize_handoff(serialize_handoff(handoff))
+    donor.finish_slot(slot, cache=False)
+
+    recipient = TPUEngine(MODEL, _cfg(), params=shared_params, seed=0)
+    new_slot = adopt_kv(recipient, handoff)
+    while recipient.slots[new_slot] is not None and \
+            recipient.slots[new_slot].finish_reason is None:
+        recipient.decode_step()
+    resp = recipient.finish_slot(new_slot)
+    return resp
+
+
+@pytest.mark.parametrize("split_at", [1, 5])
+def test_handoff_continues_bit_exact(shared_params, reference_tokens, split_at):
+    resp = _run_split(shared_params, split_at, via_wire=False)
+    assert resp.token_ids == reference_tokens
+    assert resp.finish_reason == "length"
+    assert resp.prompt_tokens == len(PROMPT)
+
+
+def test_handoff_over_wire_format(shared_params, reference_tokens):
+    resp = _run_split(shared_params, 3, via_wire=True)
+    assert resp.token_ids == reference_tokens
+
+
+def test_wire_roundtrip_preserves_pages(shared_params):
+    donor = TPUEngine(MODEL, _cfg(), params=shared_params, seed=0)
+    slot = donor.submit(_req())
+    donor.decode_step()
+    h = export_slot_kv(donor, slot)
+    h2 = deserialize_handoff(serialize_handoff(h))
+    np.testing.assert_array_equal(
+        np.asarray(h.pages, np.float32), np.asarray(h2.pages, np.float32)
+    )
+    assert h2.token_ids == h.token_ids
+    assert h2.kv_len == h.kv_len
+    assert h2.pending_token == h.pending_token
+    assert h2.request.request_id == h.request.request_id
+
+
+def test_adopt_rejects_model_mismatch(shared_params):
+    donor = TPUEngine(MODEL, _cfg(), params=shared_params, seed=0)
+    slot = donor.submit(_req())
+    h = export_slot_kv(donor, slot)
+    other = TPUEngine("llama3-mini", EngineConfig(
+        max_batch_size=1, max_seq_len=64, block_size=16,
+        prefill_buckets=(16, 32), dtype="float32"), seed=0)
+    with pytest.raises(ValueError, match="model mismatch"):
+        adopt_kv(other, h)
+
+
+def test_adopt_rolls_back_on_full_engine(shared_params):
+    donor = TPUEngine(MODEL, _cfg(), params=shared_params, seed=0)
+    slot = donor.submit(_req())
+    h = export_slot_kv(donor, slot)
+    recipient = TPUEngine(MODEL, _cfg(), params=shared_params, seed=0)
+    recipient.submit(_req())
+    recipient.submit(_req())
+    with pytest.raises(RuntimeError, match="no free slots"):
+        adopt_kv(recipient, h)
