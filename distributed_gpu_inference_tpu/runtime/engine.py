@@ -275,18 +275,17 @@ class TPUEngine:
             self.manager.free_sequence(seq_id, cache=False)
             raise
 
-    def _submit_allocated(self, request: InferenceRequest, slot: int,
-                          seq_id: str, token_ids: List[int], cached: int) -> int:
-        self._apply_pending()
-        s = _Slot(request=request, seq_id=seq_id, prompt_len=len(token_ids),
-                  cached_tokens=cached)
+    def _bind_slot(self, slot: int, s: "_Slot", kv_len: int) -> None:
+        """Install slot state (block table, committed length, sampling, stop
+        ids) for a sequence already allocated in the manager. Shared by the
+        prefill submit path and the PD-handoff adopt path so the two can
+        never drift."""
         self.slots[slot] = s
-        self.stats["requests"] += 1
-
-        m = self.cfg.max_blocks_per_seq
-        self._block_tables[slot] = self.manager.block_table_for(seq_id, m)
-        self._kv_lens[slot] = len(token_ids)
-        sp = request.sampling
+        self._block_tables[slot] = self.manager.block_table_for(
+            s.seq_id, self.cfg.max_blocks_per_seq
+        )
+        self._kv_lens[slot] = kv_len
+        sp = s.request.sampling
         self._temps[slot] = sp.temperature
         self._top_ks[slot] = sp.top_k
         self._top_ps[slot] = sp.top_p
@@ -296,6 +295,14 @@ class TPUEngine:
                 and len(stop) < MAX_STOP_IDS:
             stop.append(self.eos_token_id)
         self._stop_ids[slot, : len(stop)] = stop
+        self.stats["requests"] += 1
+
+    def _submit_allocated(self, request: InferenceRequest, slot: int,
+                          seq_id: str, token_ids: List[int], cached: int) -> int:
+        self._apply_pending()
+        s = _Slot(request=request, seq_id=seq_id, prompt_len=len(token_ids),
+                  cached_tokens=cached)
+        self._bind_slot(slot, s, kv_len=len(token_ids))
 
         # prefill the uncached suffix, bucketed
         fresh = token_ids[cached:]

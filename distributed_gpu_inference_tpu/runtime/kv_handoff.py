@@ -140,6 +140,15 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
         )
     if len(handoff.token_ids) > engine.cfg.max_seq_len:
         raise ValueError("handoff sequence exceeds engine max_seq_len")
+    # mirror submit()'s headroom check: the recipient must be able to FINISH
+    # the generation, or the handoff would silently truncate with "length"
+    remaining = req.sampling.max_new_tokens - len(handoff.generated)
+    if handoff.kv_len + 1 + remaining > engine.cfg.max_seq_len:
+        raise ValueError(
+            f"handoff needs headroom for {remaining} more tokens at kv_len "
+            f"{handoff.kv_len}, exceeding engine max_seq_len "
+            f"{engine.cfg.max_seq_len}"
+        )
     seq_id = f"{req.request_id}-pd"
     blocks, cached_tokens = engine.manager.allocate_sequence(
         seq_id, handoff.token_ids
@@ -161,23 +170,9 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
             start_time=handoff.start_time,
             first_token_time=handoff.first_token_time,
         )
-        engine.slots[slot] = s
-        m = engine.cfg.max_blocks_per_seq
-        engine._block_tables[slot] = engine.manager.block_table_for(seq_id, m)
-        engine._kv_lens[slot] = handoff.kv_len
+        engine._bind_slot(slot, s, kv_len=handoff.kv_len)
         engine._last_tokens[slot] = handoff.pending_token
-        sp = req.sampling
-        engine._temps[slot] = sp.temperature
-        engine._top_ks[slot] = sp.top_k
-        engine._top_ps[slot] = sp.top_p
-        engine._stop_ids[slot] = -1
-        stop = list(sp.stop_token_ids)[: engine._stop_ids.shape[1]]
-        if engine.eos_token_id is not None and engine.eos_token_id not in stop \
-                and len(stop) < engine._stop_ids.shape[1]:
-            stop.append(engine.eos_token_id)
-        engine._stop_ids[slot, : len(stop)] = stop
         engine._apply_pending()
-        engine.stats["requests"] += 1
     except Exception:
         engine.slots[slot] = None
         engine._kv_lens[slot] = 0

@@ -57,10 +57,11 @@ class WorkerCapability:
 
     worker_id: str
     role: WorkerRole = WorkerRole.HYBRID
+    # bandwidth fields are GB/s (gigaBYTES), matching _HBM_GBPS
     compute_tflops: float = 197.0        # aggregate bf16 TFLOP/s
     memory_bandwidth_gbps: float = 819.0  # aggregate HBM GB/s
     hbm_gb: float = 16.0
-    interconnect_gbps: float = 25.0      # to OTHER partitions (ICI or DCN)
+    interconnect_gbps: float = 25.0      # GB/s to OTHER partitions (ICI/DCN)
     max_prefill_batch: int = 8
     max_decode_batch: int = 64
 
@@ -69,15 +70,16 @@ class WorkerCapability:
                       role: WorkerRole = WorkerRole.HYBRID,
                       **kw: Any) -> "WorkerCapability":
         per_chip_bw = _HBM_GBPS.get(topo.chip_type, 819.0)
-        return cls(
+        derived: Dict[str, Any] = dict(
             worker_id=worker_id,
             role=role,
             compute_tflops=topo.peak_bf16_tflops * topo.num_chips,
             memory_bandwidth_gbps=per_chip_bw * topo.num_chips,
             hbm_gb=topo.total_hbm_gb,
             interconnect_gbps=topo.ici_bandwidth_gbps,
-            **kw,
         )
+        derived.update(kw)  # explicit overrides win over topology-derived
+        return cls(**derived)
 
     @property
     def can_prefill(self) -> bool:
@@ -120,6 +122,8 @@ class PDRequest:
     kv_cache_key: Optional[str] = None
     kv_holder: Optional[str] = None      # worker currently holding the KV
     needs_migration: bool = False
+    excluded_workers: set = field(default_factory=set)  # failed migration dsts
+    migration_attempts: int = 0
     # model geometry for KV size estimates
     num_layers: int = 32
     num_kv_heads: int = 8
@@ -136,15 +140,22 @@ class PDRequest:
 class PrefillDecodeScheduler:
     """Routes requests through disaggregated prefill and decode pools."""
 
-    def __init__(self, migrator: Optional["KVCacheMigrator"] = None) -> None:
+    def __init__(self, migrator: Optional["KVCacheMigrator"] = None,
+                 max_migration_attempts: int = 3) -> None:
         self._workers: Dict[str, _PoolWorker] = {}
         self._prefill_q: List[_QueueEntry] = []
         self._decode_q: List[_QueueEntry] = []
+        # decode requests whose background KV migration has completed and are
+        # ready to hand out on the next get_batch("decode")
+        self._ready_migrated: deque = deque()
+        self._bg_tasks: set = set()
         self._cv = asyncio.Condition()
         self.migrator = migrator
+        self.max_migration_attempts = max_migration_attempts
         self.stats: Dict[str, Any] = {
             "submitted": 0, "prefills_assigned": 0, "decodes_assigned": 0,
             "migrations_requested": 0, "affinity_hits": 0, "completed": 0,
+            "migration_failures": 0, "migration_dropped": 0,
         }
 
     # -- pool membership ----------------------------------------------------
@@ -225,6 +236,7 @@ class PrefillDecodeScheduler:
         # KV affinity first: the holder keeps the request if it can decode
         holder = self._workers.get(req.kv_holder or "")
         if holder is not None and holder.cap.can_decode and \
+                holder.cap.worker_id not in req.excluded_workers and \
                 holder.active_decode < holder.cap.max_decode_batch:
             holder.active_decode += 1
             holder.total_decodes += 1
@@ -233,14 +245,28 @@ class PrefillDecodeScheduler:
             self.stats["affinity_hits"] += 1
             self.stats["decodes_assigned"] += 1
             return holder.cap.worker_id
-        # else: best aggregate bandwidth with headroom → migrate KV there
-        best, best_score = None, -1.0
-        for w in self.decode_workers:
-            if w.active_decode >= w.cap.max_decode_batch:
-                continue
-            score = w.cap.memory_bandwidth_gbps / (1.0 + w.active_decode)
-            if score > best_score:
-                best, best_score = w, score
+
+        # else: best aggregate bandwidth with headroom → migrate KV there.
+        # Workers that already failed a migration for THIS request are skipped
+        # (no livelock against a dead link); if exclusion empties the candidate
+        # set, retry over everyone — a transient failure must not strand the
+        # request when only one decode worker exists.
+        def _pick(ignore_exclusions: bool) -> Optional[_PoolWorker]:
+            best, best_score = None, -1.0
+            for w in self.decode_workers:
+                if w.active_decode >= w.cap.max_decode_batch:
+                    continue
+                if not ignore_exclusions and \
+                        w.cap.worker_id in req.excluded_workers:
+                    continue
+                score = w.cap.memory_bandwidth_gbps / (1.0 + w.active_decode)
+                if score > best_score:
+                    best, best_score = w, score
+            return best
+
+        best = _pick(ignore_exclusions=False)
+        if best is None and req.excluded_workers:
+            best = _pick(ignore_exclusions=True)
         if best is None:
             return None
         best.active_decode += 1
@@ -270,8 +296,14 @@ class PrefillDecodeScheduler:
             )
         out: List[PDRequest] = []
         deadline = time.monotonic() + timeout_s
+
+        def _has_work() -> bool:
+            if phase == "decode" and self._ready_migrated:
+                return True
+            return bool(q)
+
         async with self._cv:
-            while not q:
+            while not _has_work():
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return out
@@ -279,58 +311,65 @@ class PrefillDecodeScheduler:
                     await asyncio.wait_for(self._cv.wait(), timeout=remaining)
                 except asyncio.TimeoutError:
                     return out
+            # migrated-and-ready requests go out first (their KV is local now)
+            if phase == "decode":
+                while self._ready_migrated and len(out) < max_batch:
+                    out.append(self._ready_migrated.popleft())
             skipped: List[_QueueEntry] = []
             while q and len(out) < max_batch:
                 entry = heapq.heappop(q)
                 if assign(entry.req) is None:
                     skipped.append(entry)  # no capacity now; retain order
                     break
-                out.append(entry.req)
+                req = entry.req
+                if phase == "decode" and self.migrator is not None and \
+                        req.needs_migration and req.kv_cache_key and \
+                        req.kv_holder and req.decode_worker:
+                    # KV must move first: run the transfer in the background so
+                    # affinity-hit requests in this batch aren't stalled behind
+                    # it; the request is delivered by a later get_batch once
+                    # its migration lands in _ready_migrated
+                    task = asyncio.ensure_future(self._migrate_bg(req))
+                    self._bg_tasks.add(task)
+                    task.add_done_callback(self._bg_tasks.discard)
+                else:
+                    out.append(req)
             for entry in skipped:
                 heapq.heappush(q, entry)
-        # fire migrations for decode assignments that need them — concurrently
-        # (one slow transfer must not stall affinity-hit requests in the same
-        # batch) and failure-isolated (a dead link requeues only ITS request)
-        if phase == "decode" and self.migrator is not None:
-            migrating = [
-                r for r in out
-                if r.needs_migration and r.kv_cache_key and r.kv_holder
-                and r.decode_worker
-            ]
-            if migrating:
-                results = await asyncio.gather(
-                    *(
-                        self.migrator.migrate(
-                            r.kv_cache_key, r.kv_holder, r.decode_worker
-                        )
-                        for r in migrating
-                    ),
-                    return_exceptions=True,
-                )
-                failed: List[PDRequest] = []
-                for r, res in zip(migrating, results):
-                    if isinstance(res, BaseException):
-                        failed.append(r)
-                    else:
-                        r.kv_holder = r.decode_worker
-                if failed:
-                    async with self._cv:
-                        for r in failed:
-                            w = self._workers.get(r.decode_worker or "")
-                            if w:
-                                w.active_decode = max(0, w.active_decode - 1)
-                            r.decode_worker = None
-                            r.needs_migration = False
-                            self.stats["migration_failures"] = (
-                                self.stats.get("migration_failures", 0) + 1
-                            )
-                            heapq.heappush(
-                                self._decode_q,
-                                _QueueEntry((-r.priority, r.arrival), r),
-                            )
-                        self._cv.notify_all()
-                    out = [r for r in out if r not in failed]
         return out
+
+    async def _migrate_bg(self, req: PDRequest) -> None:
+        """Background KV migration with per-request failure isolation:
+        a dead link excludes that destination and requeues the request (up to
+        ``max_migration_attempts``), releasing the reserved decode capacity."""
+        assert self.migrator is not None
+        try:
+            await self.migrator.migrate(
+                req.kv_cache_key, req.kv_holder, req.decode_worker  # type: ignore[arg-type]
+            )
+        except Exception:
+            async with self._cv:
+                w = self._workers.get(req.decode_worker or "")
+                if w:
+                    w.active_decode = max(0, w.active_decode - 1)
+                req.excluded_workers.add(req.decode_worker)
+                req.migration_attempts += 1
+                req.decode_worker = None
+                req.needs_migration = False
+                self.stats["migration_failures"] += 1
+                if req.migration_attempts >= self.max_migration_attempts:
+                    req.phase = "failed"
+                    self.stats["migration_dropped"] += 1
+                else:
+                    heapq.heappush(
+                        self._decode_q, _QueueEntry((-req.priority, req.arrival), req)
+                    )
+                self._cv.notify_all()
+            return
+        req.kv_holder = req.decode_worker
+        async with self._cv:
+            self._ready_migrated.append(req)
+            self._cv.notify_all()
 
     # -- latency estimators (reference :325-348) -----------------------------
 
@@ -358,8 +397,8 @@ class PrefillDecodeScheduler:
 
     def estimate_migration_ms(self, req: PDRequest, src: str, dst: str) -> float:
         w = self._workers.get(src)
-        gbps = w.cap.interconnect_gbps if w else 25.0
-        return req.kv_bytes / (gbps / 8 * 1e9) * 1000.0
+        gBps = w.cap.interconnect_gbps if w else 25.0  # GB/s, like all BW here
+        return req.kv_bytes / (gBps * 1e9) * 1000.0
 
     def get_stats(self) -> Dict[str, Any]:
         out = dict(self.stats)

@@ -1,0 +1,380 @@
+"""Worker runtime: register → load engines → heartbeat + poll → process jobs.
+
+Behavioral parity with the reference's ``worker/main.py`` (Worker:28):
+
+- ``_register``:83 — verify persisted credentials first, re-register when
+  stale, then fetch server-pushed remote config (:151).
+- ``_load_engines``:234 — one engine per supported task type from the
+  registry; a task type whose engine cannot load is dropped, not fatal.
+- ``_heartbeat_loop``:263 — background thread, every ``heartbeat_interval_s``;
+  a ``config_changed`` flag in the response triggers a remote-config refetch
+  (reference ``main.py:290-301``).
+- ``_main_loop``:313 — poll every ``poll_interval_s``; fetch → process →
+  complete; load-control gates (acceptance rate, hourly cap, working hours,
+  cooldown — server-pushed, ``worker_config.py`` values win over local).
+- ``request_shutdown``:444 — graceful drain: stop accepting, finish the
+  running job, tell the server ``going-offline`` then ``offline`` (which
+  requeues anything still assigned); SIGTERM/SIGINT wired (:410-411).
+
+TPU-first deltas: capability probing reports a :class:`TpuTopology` from
+``jax.devices()`` (chip generation, chip count, HBM) instead of nvidia-smi;
+engines are the in-repo JAX engines, so "loading" compiles jitted graphs
+rather than importing a CUDA backend.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.config import WorkerConfig
+from ..utils.data_structures import TpuTopology, WorkerState
+from .api_client import APIClient, APIError
+from .engines import EngineLoadError, create_engine
+from .machine_id import MachineFingerprint
+
+log = logging.getLogger("tpu_worker")
+
+
+def probe_topology() -> TpuTopology:
+    """Describe local accelerators from jax (the TPU analogue of the
+    reference's nvidia-smi probe, ``cli.py:77``). Falls back to a CPU
+    topology when jax is unavailable or sees no accelerator."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        kind = devices[0].device_kind.lower()
+        if "tpu" in kind or "v5" in kind or "v4" in kind or "v6" in kind:
+            chip = (
+                "v5p" if "v5p" in kind or "v5 pod" in kind
+                else "v5e" if "v5" in kind
+                else "v6e" if "v6" in kind
+                else "v4" if "v4" in kind
+                else "v5e"
+            )
+            hbm = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}[chip]
+            return TpuTopology(
+                chip_type=chip, num_chips=len(devices), hbm_gb_per_chip=hbm,
+                mesh_shape=(len(devices),), mesh_axis_names=("data",),
+            )
+        return TpuTopology(chip_type="cpu", num_chips=len(devices),
+                           hbm_gb_per_chip=4.0, ici_bandwidth_gbps=10.0,
+                           dcn_bandwidth_gbps=10.0, peak_bf16_tflops=0.2)
+    except Exception:  # pragma: no cover - jax always importable in-repo
+        return TpuTopology(chip_type="cpu", num_chips=1, hbm_gb_per_chip=4.0)
+
+
+class Worker:
+    """The volunteer/fleet worker process (reference ``Worker``, main.py:28)."""
+
+    def __init__(
+        self,
+        config: WorkerConfig,
+        api: Optional[APIClient] = None,
+        on_credentials: Optional[Callable[[Dict[str, str]], None]] = None,
+        topology: Optional[TpuTopology] = None,
+    ) -> None:
+        self.config = config
+        self.api = api or APIClient(
+            config.server.url,
+            worker_id=config.server.worker_id,
+            auth_token=config.server.auth_token,
+            refresh_token=config.server.refresh_token,
+            signing_secret=config.server.signing_secret,
+            timeout_s=config.server.request_timeout_s,
+        )
+        self._on_credentials = on_credentials
+        self.topology = topology or probe_topology()
+        self.engines: Dict[str, Any] = {}
+        self.state = WorkerState.INITIALIZING
+        self.current_job_id: Optional[str] = None
+
+        self._shutdown = threading.Event()
+        self._drained = threading.Event()
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._hour_window: List[float] = []       # job-start times, rolling hour
+        self._last_job_done_at = 0.0
+        self._rng = random.Random(0xC0FFEE)
+        self.stats: Dict[str, Any] = {
+            "jobs_completed": 0, "jobs_failed": 0, "jobs_rejected": 0,
+            "heartbeats": 0, "config_refetches": 0,
+        }
+
+    # -- registration (reference main.py:83-165) -----------------------------
+
+    def register(self) -> None:
+        if self.api.worker_id and self.api.auth_token and \
+                self.api.verify_credentials():
+            log.info("existing credentials valid for %s", self.api.worker_id)
+        else:
+            info = {
+                "name": self.config.name,
+                "region": self.config.region,
+                "machine_fingerprint": MachineFingerprint().get_or_create(),
+                "supported_types": list(self.config.task_types),
+                "topology": self.topology.to_dict(),
+                "supports_direct": self.config.direct.enabled,
+                "direct_url": self.config.direct.public_url,
+            }
+            data = self.api.register(info)
+            if self._on_credentials:
+                self._on_credentials(
+                    {
+                        "worker_id": data["worker_id"],
+                        "auth_token": data["auth_token"],
+                        "refresh_token": data["refresh_token"],
+                        "signing_secret": data["signing_secret"],
+                    }
+                )
+            log.info("registered as %s", data["worker_id"])
+        self._fetch_remote_config()
+
+    def _fetch_remote_config(self) -> None:
+        """Server-pushed load control wins over local values
+        (reference main.py:151-165; worker_config.py:85-107)."""
+        try:
+            remote = self.api.fetch_remote_config()
+        except APIError as exc:
+            log.warning("remote config fetch failed: %s", exc)
+            return
+        self.stats["config_refetches"] += 1
+        self.config.config_version = int(remote.get("version", 0))
+        lc = remote.get("load_control") or {}
+        for key in (
+            "acceptance_rate", "max_concurrent_jobs", "max_jobs_per_hour",
+            "hbm_limit_fraction", "cooldown_seconds",
+        ):
+            if key in lc and lc[key] is not None:
+                setattr(self.config.load_control, key, lc[key])
+        if lc.get("working_hours"):
+            self.config.load_control.working_hours = tuple(lc["working_hours"])
+        if lc.get("job_type_weights"):
+            self.config.load_control.job_type_weights = dict(
+                lc["job_type_weights"]
+            )
+
+    # -- engines (reference main.py:234-261) ---------------------------------
+
+    def load_engines(self) -> None:
+        loaded: List[str] = []
+        for task_type in list(self.config.task_types):
+            try:
+                cfg = self.config.engine_for(task_type)
+                eng = create_engine(task_type, cfg.model_dump())
+                eng.load_model()
+                self.engines[task_type] = eng
+                loaded.append(task_type)
+            except (EngineLoadError, KeyError) as exc:
+                log.warning("dropping task type %s: %s", task_type, exc)
+        self.config.task_types = loaded
+        if not loaded:
+            raise EngineLoadError("no engine loaded for any task type")
+
+    # -- heartbeat (reference main.py:263-311) -------------------------------
+
+    def _heartbeat_once(self) -> None:
+        try:
+            resp = self.api.heartbeat(
+                status=self.state.value,
+                config_version=self.config.config_version,
+                current_job_id=self.current_job_id,
+                loaded_models=[
+                    getattr(e, "model_name", None) or str(type(e).__name__)
+                    for e in self.engines.values()
+                ],
+                stats={
+                    k: self.stats[k]
+                    for k in ("jobs_completed", "jobs_failed")
+                },
+            )
+            self.stats["heartbeats"] += 1
+            if resp.get("config_changed"):
+                self._fetch_remote_config()
+        except APIError as exc:
+            if exc.status == 401:
+                try:
+                    self.api.refresh_credentials()
+                except APIError:
+                    log.error("token refresh failed; re-registering")
+                    self.api.auth_token = None
+                    self.register()
+            else:
+                log.warning("heartbeat failed: %s", exc)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.wait(self.config.heartbeat_interval_s):
+            self._heartbeat_once()
+
+    # -- load control (reference worker_config.py:195, main loop gates) ------
+
+    def should_accept_job(self, job: Dict[str, Any],
+                          now: Optional[float] = None) -> bool:
+        lc = self.config.load_control
+        now = time.time() if now is None else now
+        if lc.working_hours:
+            start_h, end_h = lc.working_hours
+            hour = time.localtime(now).tm_hour
+            inside = (
+                start_h <= hour < end_h if start_h <= end_h
+                else hour >= start_h or hour < end_h
+            )
+            if not inside:
+                return False
+        if lc.cooldown_seconds > 0 and \
+                now - self._last_job_done_at < lc.cooldown_seconds:
+            return False
+        if lc.max_jobs_per_hour > 0:
+            self._hour_window = [t for t in self._hour_window if now - t < 3600]
+            if len(self._hour_window) >= lc.max_jobs_per_hour:
+                return False
+        rate = lc.acceptance_rate
+        weight = lc.job_type_weights.get(job.get("type", ""), 1.0)
+        if rate * weight < 1.0 and self._rng.random() > rate * weight:
+            return False
+        return True
+
+    # -- job processing (reference main.py:335-402) --------------------------
+
+    def process_job(self, job: Dict[str, Any]) -> None:
+        job_id = job["id"]
+        task_type = job.get("type", "llm")
+        engine = self.engines.get(task_type)
+        self.current_job_id = job_id
+        self.state = WorkerState.BUSY
+        started = time.time()
+        try:
+            if engine is None:
+                raise RuntimeError(f"no engine loaded for type {task_type!r}")
+            result = engine.inference(job.get("params") or {})
+            self.api.complete_job(job_id, success=True, result=result)
+            self.stats["jobs_completed"] += 1
+        except Exception as exc:  # noqa: BLE001 - job failure is a result
+            log.exception("job %s failed", job_id)
+            try:
+                self.api.complete_job(job_id, success=False, error=str(exc))
+            except APIError:
+                log.error("could not report failure for job %s", job_id)
+            self.stats["jobs_failed"] += 1
+        finally:
+            self._last_job_done_at = time.time()
+            self._hour_window.append(started)
+            self.current_job_id = None
+            if self.state != WorkerState.DRAINING:
+                self.state = WorkerState.IDLE
+
+    def _poll_once(self) -> bool:
+        """One poll iteration; returns True if a job was processed."""
+        try:
+            job = self.api.fetch_next_job()
+        except APIError as exc:
+            log.warning("poll failed: %s", exc)
+            return False
+        if job is None:
+            return False
+        if not self.should_accept_job(job):
+            self.stats["jobs_rejected"] += 1
+            try:
+                self.api.complete_job(
+                    job["id"], success=False, error="rejected by load control"
+                )
+            except APIError:
+                pass
+            return False
+        self.process_job(job)
+        return True
+
+    def _main_loop(self) -> None:
+        while not self._shutdown.is_set():
+            busy = self._poll_once()
+            if not busy:
+                self._shutdown.wait(self.config.poll_interval_s)
+        self._drained.set()
+
+    # -- lifecycle (reference main.py:404-496) -------------------------------
+
+    def start(self, install_signal_handlers: bool = True,
+              block: bool = True) -> None:
+        self.register()
+        self.load_engines()
+        self.state = WorkerState.IDLE
+        if install_signal_handlers:
+            try:
+                signal.signal(signal.SIGTERM, self._signal_handler)
+                signal.signal(signal.SIGINT, self._signal_handler)
+            except ValueError:  # pragma: no cover - non-main thread
+                pass
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, name="heartbeat", daemon=True
+        )
+        self._heartbeat_thread.start()
+        self._heartbeat_once()
+        if block:
+            self._main_loop()
+            self._finalize_shutdown()
+
+    def _signal_handler(self, signum: int, frame: Any) -> None:  # pragma: no cover
+        log.info("signal %s: graceful shutdown", signum)
+        self.request_shutdown()
+
+    def request_shutdown(self, timeout_s: float = 60.0) -> None:
+        """Graceful drain (reference main.py:444-463): stop accepting, let the
+        in-flight job finish, notify the server."""
+        if self._shutdown.is_set():
+            return
+        self.state = WorkerState.DRAINING
+        try:
+            self.api.going_offline()
+        except APIError:
+            pass
+        self._shutdown.set()
+
+    def _finalize_shutdown(self) -> None:
+        try:
+            requeued = self.api.offline()
+            if requeued:
+                log.info("server requeued jobs: %s", requeued)
+        except APIError:
+            pass
+        self.state = WorkerState.OFFLINE
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=5.0)
+        for eng in self.engines.values():
+            try:
+                eng.unload()
+            except Exception:  # noqa: BLE001
+                pass
+        self.api.close()
+
+    # -- introspection -------------------------------------------------------
+
+    def get_status(self) -> Dict[str, Any]:
+        return {
+            "worker_id": self.api.worker_id,
+            "state": self.state.value,
+            "current_job_id": self.current_job_id,
+            "task_types": list(self.config.task_types),
+            "topology": self.topology.to_dict(),
+            "stats": dict(self.stats),
+        }
+
+
+def main() -> None:  # pragma: no cover - manual entry point
+    import argparse
+
+    from ..utils.config import load_worker_config
+
+    ap = argparse.ArgumentParser(description="TPU inference worker")
+    ap.add_argument("--config", default="config.yaml")
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    cfg = load_worker_config(args.config)
+    Worker(cfg).start()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

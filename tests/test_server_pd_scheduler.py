@@ -189,15 +189,40 @@ def test_migration_failure_requeues_request():
         await s.submit_job(r)
         [pr] = await s.get_batch("prefill", max_batch=1)
         await s.transition_to_decode(pr, "kvF", holder_worker="pf-big")
-        # first attempt: migration fails → request requeued, batch empty
-        batch = await s.get_batch("decode", max_batch=1)
-        assert batch == []
+        # migration runs in the background; the first attempt fails, excludes
+        # dec-a, requeues; the retry targets dec-b and succeeds; the request
+        # is then delivered by a later get_batch
+        for _ in range(50):
+            batch = await s.get_batch("decode", max_batch=1, timeout_s=0.05)
+            if batch:
+                break
+        assert len(batch) == 1
+        dr = batch[0]
         assert s.stats["migration_failures"] == 1
+        assert dr.decode_worker == "dec-b"      # dec-a excluded after failure
+        assert dr.kv_holder == "dec-b"
         assert s.worker("dec-a").active_decode == 0  # capacity released
-        # second attempt succeeds
-        [dr] = await s.get_batch("decode", max_batch=1)
-        assert dr.decode_worker == "dec-a"
-        assert dr.kv_holder == "dec-a"
+
+    _run(go())
+
+
+def test_migration_exhausts_attempts_and_drops():
+    async def transport(key, src, dst):
+        raise ConnectionError("link down")
+
+    async def go():
+        s = _sched(migrator=KVCacheMigrator(transport))
+        r = PDRequest(prompt_tokens=64)
+        await s.submit_job(r)
+        [pr] = await s.get_batch("prefill", max_batch=1)
+        await s.transition_to_decode(pr, "kvD", holder_worker="pf-big")
+        for _ in range(50):
+            await s.get_batch("decode", max_batch=1, timeout_s=0.02)
+            if r.phase == "failed":
+                break
+        assert r.phase == "failed"
+        assert s.stats["migration_dropped"] == 1
+        assert s.stats["migration_failures"] == 3
 
     _run(go())
 
@@ -260,7 +285,12 @@ def test_end_to_end_real_migration_between_engines():
         transport.record_location("kv-e2e", "prefill-pool", slot)
         await sched.transition_to_decode(pr, "kv-e2e", "prefill-pool")
 
-        [dr] = await sched.get_batch("decode", max_batch=1)
+        for _ in range(100):
+            batch = await sched.get_batch("decode", max_batch=1,
+                                          timeout_s=0.05)
+            if batch:
+                break
+        [dr] = batch
         assert dr.decode_worker == "decode-pool"
         assert migrator.get_stats()["migrations"] == 1
         assert migrator.get_stats()["bytes_moved"] > 0
