@@ -1,0 +1,22 @@
+"""Client SDK for the TPU inference platform.
+
+Parity surface: reference ``sdk/python/inference_client.py`` (C37).
+"""
+
+from .client import (
+    InferenceClient,
+    InferenceClientError,
+    NoWorkersAvailable,
+    chat,
+    embed,
+    generate_image,
+)
+
+__all__ = [
+    "InferenceClient",
+    "InferenceClientError",
+    "NoWorkersAvailable",
+    "chat",
+    "embed",
+    "generate_image",
+]

@@ -1,0 +1,294 @@
+"""Python client SDK: multi-server fallback, retries, sync/async jobs,
+direct-to-worker mode.
+
+Behavioral parity with the reference's ``sdk/python/inference_client.py``:
+
+- Multi-server fallback + retry ladder: 503 → try the next server, 4xx →
+  raise immediately, transport errors/5xx → exponential backoff then next
+  server (:58-100).
+- ``chat`` / ``generate_image`` with sync (long-poll ``/jobs/sync``) or
+  async (create → poll) execution (:104-221).
+- Job lifecycle: create / get / wait / cancel (:225-280).
+- Direct mode: nearest-worker discovery via ``/api/v1/jobs/direct/nearest``
+  with a 60 s cache (:284-306), then POST to the worker's ``/inference``
+  (:308-329); on any direct failure, falls back to the queued path.
+- Module-level one-shot helpers (:380-399).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import httpx
+
+DIRECT_CACHE_TTL_S = 60.0  # reference inference_client.py:284-306
+
+
+class InferenceClientError(Exception):
+    def __init__(self, status: int, detail: str = "") -> None:
+        super().__init__(f"HTTP {status}: {detail}")
+        self.status = status
+        self.detail = detail
+
+
+class NoWorkersAvailable(InferenceClientError):
+    """Every configured server answered 503 (no capacity)."""
+
+    def __init__(self, detail: str = "no workers available") -> None:
+        super().__init__(503, detail)
+
+
+class InferenceClient:
+    def __init__(
+        self,
+        server_url: str | Sequence[str] = "http://127.0.0.1:8000",
+        api_key: Optional[str] = None,
+        timeout_s: float = 120.0,
+        max_retries: int = 2,
+        backoff_s: float = 0.5,
+        transport: Optional[httpx.BaseTransport] = None,
+    ) -> None:
+        self.servers = (
+            [server_url] if isinstance(server_url, str) else list(server_url)
+        )
+        self.servers = [s.rstrip("/") for s in self.servers]
+        self.api_key = api_key
+        self._max_retries = max_retries
+        self._backoff_s = backoff_s
+        self._client = httpx.Client(timeout=timeout_s, transport=transport)
+        self._direct_cache: Optional[Dict[str, Any]] = None
+        self._direct_cache_at = 0.0
+
+    def close(self) -> None:
+        self._client.close()
+
+    def __enter__(self) -> "InferenceClient":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # -- transport with server fallback (reference :58-100) -----------------
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.api_key:
+            h["X-API-Key"] = self.api_key
+        return h
+
+    def _request(self, method: str, path: str,
+                 payload: Optional[Dict[str, Any]] = None,
+                 params: Optional[Dict[str, str]] = None,
+                 timeout: Optional[float] = None,
+                 retry_transport: bool = True) -> httpx.Response:
+        last: Optional[Exception] = None
+        saw_503 = False
+        for server in self.servers:
+            for attempt in range(self._max_retries + 1):
+                try:
+                    resp = self._client.request(
+                        method, f"{server}{path}", json=payload,
+                        params=params, headers=self._headers(),
+                        **({"timeout": timeout} if timeout is not None else {}),
+                    )
+                except httpx.TransportError as exc:
+                    last = exc
+                    if not retry_transport:
+                        # non-idempotent call (e.g. /jobs/sync EXECUTES the
+                        # job): a blind re-POST would run it again
+                        raise InferenceClientError(
+                            599, f"transport failed: {exc}"
+                        ) from exc
+                    if attempt < self._max_retries:
+                        time.sleep(self._backoff_s * (2**attempt))
+                    continue
+                if resp.status_code == 503:
+                    saw_503 = True
+                    break  # capacity problem: next server, don't retry here
+                if 400 <= resp.status_code < 500:
+                    detail = ""
+                    try:
+                        detail = resp.json().get("detail", "")
+                    except ValueError:
+                        pass
+                    raise InferenceClientError(resp.status_code, detail)
+                if resp.status_code >= 500:
+                    last = InferenceClientError(
+                        resp.status_code, resp.text[:200]
+                    )
+                    if attempt < self._max_retries:
+                        time.sleep(self._backoff_s * (2**attempt))
+                    continue
+                return resp
+        if saw_503:
+            raise NoWorkersAvailable()
+        raise InferenceClientError(599, f"all servers failed: {last}")
+
+    # -- job lifecycle (reference :225-280) ----------------------------------
+
+    def create_job(self, job_type: str, params: Dict[str, Any],
+                   priority: int = 0,
+                   preferred_region: Optional[str] = None,
+                   **extra: Any) -> str:
+        body: Dict[str, Any] = {
+            "type": job_type, "params": params, "priority": priority, **extra,
+        }
+        if preferred_region:
+            body["preferred_region"] = preferred_region
+        resp = self._request("POST", "/api/v1/jobs", body)
+        return resp.json()["job_id"]
+
+    def get_job(self, job_id: str) -> Dict[str, Any]:
+        return self._request("GET", f"/api/v1/jobs/{job_id}").json()
+
+    def wait_for_job(self, job_id: str, timeout_s: float = 300.0,
+                     poll_s: float = 0.5) -> Dict[str, Any]:
+        deadline = time.time() + timeout_s
+        while True:
+            job = self.get_job(job_id)
+            if job["status"] in ("completed", "failed", "cancelled"):
+                return job
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {job['status']} after {timeout_s}s"
+                )
+            time.sleep(poll_s)
+
+    def cancel_job(self, job_id: str) -> None:
+        self._request("DELETE", f"/api/v1/jobs/{job_id}")
+
+    def queue_stats(self) -> Dict[str, Any]:
+        return self._request("GET", "/api/v1/jobs/stats/queue").json()
+
+    def _run_job(self, job_type: str, params: Dict[str, Any], sync: bool,
+                 timeout_s: float, **extra: Any) -> Dict[str, Any]:
+        if sync:
+            # read timeout must outlive the server's long-poll window, and a
+            # timeout must NOT be retried (the job may still complete)
+            resp = self._request(
+                "POST", "/api/v1/jobs/sync",
+                {"type": job_type, "params": params,
+                 "timeout_seconds": timeout_s, **extra},
+                timeout=timeout_s + 15.0,
+                retry_transport=False,
+            )
+            data = resp.json()
+            if data.get("status") != "completed":
+                raise InferenceClientError(
+                    500, data.get("error") or f"job {data.get('status')}"
+                )
+            return data["result"]
+        job_id = self.create_job(job_type, params, **extra)
+        job = self.wait_for_job(job_id, timeout_s=timeout_s)
+        if job["status"] != "completed":
+            raise InferenceClientError(
+                500, job.get("error") or f"job {job['status']}"
+            )
+        return job["result"]
+
+    # -- task helpers (reference :104-221) -----------------------------------
+
+    def chat(
+        self,
+        messages: Optional[List[Dict[str, str]]] = None,
+        prompt: Optional[str] = None,
+        model: Optional[str] = None,
+        sync: bool = True,
+        use_direct: bool = False,
+        timeout_s: float = 120.0,
+        **gen_params: Any,
+    ) -> Dict[str, Any]:
+        params: Dict[str, Any] = dict(gen_params)
+        if messages is not None:
+            params["messages"] = messages
+        if prompt is not None:
+            params["prompt"] = prompt
+        if model is not None:
+            params["model"] = model
+        if use_direct:
+            result = self._try_direct("llm", params)
+            if result is not None:
+                return result
+        return self._run_job("llm", params, sync=sync, timeout_s=timeout_s)
+
+    def generate_image(self, prompt: str, sync: bool = True,
+                       timeout_s: float = 300.0,
+                       **gen_params: Any) -> Dict[str, Any]:
+        params = {"prompt": prompt, **gen_params}
+        return self._run_job(
+            "image_gen", params, sync=sync, timeout_s=timeout_s
+        )
+
+    def embed(self, texts: Sequence[str], sync: bool = True,
+              timeout_s: float = 60.0, **params: Any) -> Dict[str, Any]:
+        return self._run_job(
+            "embedding", {"texts": list(texts), **params},
+            sync=sync, timeout_s=timeout_s,
+        )
+
+    def transcribe(self, audio_b64: str, sync: bool = True,
+                   timeout_s: float = 300.0, **params: Any) -> Dict[str, Any]:
+        return self._run_job(
+            "whisper", {"audio": audio_b64, **params},
+            sync=sync, timeout_s=timeout_s,
+        )
+
+    # -- direct mode (reference :284-329) ------------------------------------
+
+    def _get_nearest_worker(self) -> Optional[Dict[str, Any]]:
+        now = time.time()
+        if self._direct_cache is not None and \
+                now - self._direct_cache_at < DIRECT_CACHE_TTL_S:
+            return self._direct_cache
+        try:
+            resp = self._request("GET", "/api/v1/jobs/direct/nearest")
+        except InferenceClientError:
+            return None
+        self._direct_cache = resp.json()
+        self._direct_cache_at = now
+        return self._direct_cache
+
+    def _try_direct(self, job_type: str,
+                    params: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """POST straight to the nearest worker; any failure returns None so
+        the caller falls back to the queued path (reference :308-329)."""
+        worker = self._get_nearest_worker()
+        if worker is None:
+            return None
+        try:
+            resp = self._client.post(
+                f"{worker['direct_url'].rstrip('/')}/inference",
+                json={"type": job_type, "params": params},
+                headers=self._headers(),
+            )
+        except httpx.TransportError:
+            self._direct_cache = None
+            return None
+        if resp.status_code != 200:
+            self._direct_cache = None  # busy/draining: rediscover next time
+            return None
+        return resp.json()["result"]
+
+
+# ---------------------------------------------------------------------------
+# Module-level one-shots (reference :380-399)
+# ---------------------------------------------------------------------------
+
+
+def chat(messages=None, prompt=None, server_url="http://127.0.0.1:8000",
+         **kw) -> Dict[str, Any]:
+    with InferenceClient(server_url) as c:
+        return c.chat(messages=messages, prompt=prompt, **kw)
+
+
+def generate_image(prompt: str, server_url="http://127.0.0.1:8000",
+                   **kw) -> Dict[str, Any]:
+    with InferenceClient(server_url) as c:
+        return c.generate_image(prompt, **kw)
+
+
+def embed(texts: Sequence[str], server_url="http://127.0.0.1:8000",
+          **kw) -> Dict[str, Any]:
+    with InferenceClient(server_url) as c:
+        return c.embed(texts, **kw)

@@ -239,6 +239,30 @@ async def next_job(request: web.Request) -> web.Response:
     return web.json_response({"job": job})
 
 
+async def release_job(request: web.Request) -> web.Response:
+    """Worker declines a claimed job (client-side load control): requeue it
+    without burning a retry or recording a failure — any other worker can run
+    it. Mirrors the server-side admission release in ``next_job``."""
+    worker_id = request.match_info["worker_id"]
+    job_id = request.match_info["job_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err:
+        return err
+    st = _state(request)
+    job = await st.store.get_job(job_id)
+    if job is None or job.get("worker_id") != worker_id:
+        return _json_error(404, "job not assigned to this worker")
+    if job["status"] == JobStatus.RUNNING.value:
+        await st.store.update_job(
+            job_id, status=JobStatus.QUEUED.value, worker_id=None,
+            started_at=None,
+        )
+    await st.store.update_worker(
+        worker_id, current_job_id=None, status=WorkerState.IDLE.value
+    )
+    return web.json_response({"status": "released"})
+
+
 async def complete_job(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     job_id = request.match_info["job_id"]
@@ -673,6 +697,9 @@ def create_app(state: Optional[ServerState] = None,
     app.router.add_get(f"{API}/workers/{{worker_id}}/next-job", next_job)
     app.router.add_post(
         f"{API}/workers/{{worker_id}}/jobs/{{job_id}}/complete", complete_job
+    )
+    app.router.add_post(
+        f"{API}/workers/{{worker_id}}/jobs/{{job_id}}/release", release_job
     )
     app.router.add_post(f"{API}/workers/{{worker_id}}/going-offline", going_offline)
     app.router.add_post(f"{API}/workers/{{worker_id}}/offline", offline)

@@ -167,6 +167,15 @@ class APIClient:
         )
         return resp.json()
 
+    def release_job(self, job_id: str) -> None:
+        """Decline a claimed job without failing it (client-side load
+        control); the server requeues it for other workers."""
+        self._request(
+            "POST",
+            f"/api/v1/workers/{self.worker_id}/jobs/{job_id}/release",
+            {},
+        )
+
     def going_offline(self) -> None:
         self._request(
             "POST", f"/api/v1/workers/{self.worker_id}/going-offline", {}

@@ -1,0 +1,116 @@
+"""Worker-hosted direct inference endpoint.
+
+Behavioral parity with the reference's ``worker/direct_server.py`` (140 LoC,
+FastAPI): ``/health``, ``/status``, and ``/inference`` which returns **503
+while the worker is busy or draining** (:79-85) so clients fall back to the
+control-plane queue. aiohttp here (the framework's one HTTP stack — same as
+the control plane and the P2P data plane).
+
+Discovery flow (reference SURVEY §3.2 direct-mode variant): clients find this
+endpoint via the control plane's ``/api/v1/jobs/direct/nearest`` and POST
+job params straight to ``/inference``, skipping the queue entirely.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+
+class DirectServer:
+    """Serves a Worker's engines over local HTTP (reference DirectServer)."""
+
+    def __init__(self, worker: Any, host: str = "0.0.0.0",
+                 port: int = 8471) -> None:
+        self.worker = worker
+        self.host = host
+        self.port = port
+        self._runner: Optional[web.AppRunner] = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self.stats: Dict[str, Any] = {"requests": 0, "rejected": 0}
+
+    # -- handlers ------------------------------------------------------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "ts": time.time()})
+
+    async def _status(self, request: web.Request) -> web.Response:
+        return web.json_response(self.worker.get_status())
+
+    async def _inference(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response({"detail": "invalid JSON"}, status=400)
+        task_type = body.get("type", "llm")
+        engine = self.worker.engines.get(task_type)
+        if engine is None:
+            return web.json_response(
+                {"detail": f"task type {task_type!r} not loaded"}, status=404
+            )
+        # atomically claim the worker (IDLE→BUSY): a second direct request,
+        # or the queue poll loop, sees BUSY and backs off — engines are never
+        # driven concurrently. 503 → client falls back to the control-plane
+        # queue (reference direct_server.py:79-85).
+        if not self.worker.try_begin_job():
+            self.stats["rejected"] += 1
+            return web.json_response(
+                {"detail": f"worker {self.worker.state.value}"}, status=503
+            )
+        self.stats["requests"] += 1
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, engine.inference, body.get("params") or {}
+            )
+        except Exception as exc:  # noqa: BLE001 - surface as a job error
+            return web.json_response({"detail": str(exc)}, status=500)
+        finally:
+            self.worker.end_job()
+        return web.json_response({"result": result})
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def make_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/status", self._status)
+        app.router.add_post("/inference", self._inference)
+        return app
+
+    def start(self) -> None:
+        """Run in a background thread with a private event loop (the worker's
+        main loop is a plain thread, reference main.py:386)."""
+
+        def _run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            runner = web.AppRunner(self.make_app())
+            loop.run_until_complete(runner.setup())
+            self._runner = runner
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(
+            target=_run, name="direct-server", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10.0):
+            raise RuntimeError("direct server failed to start")
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

@@ -96,6 +96,10 @@ class Worker:
 
         self._shutdown = threading.Event()
         self._drained = threading.Event()
+        self._direct: Optional[Any] = None
+        # guards IDLE→BUSY transitions so the poll loop and the direct server
+        # can never run engine.inference concurrently on the same engines
+        self._state_lock = threading.Lock()
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._hour_window: List[float] = []       # job-start times, rolling hour
         self._last_job_done_at = 0.0
@@ -202,13 +206,20 @@ class Worker:
                 except APIError:
                     log.error("token refresh failed; re-registering")
                     self.api.auth_token = None
-                    self.register()
+                    try:
+                        self.register()
+                    except APIError as reg_exc:
+                        log.error("re-registration failed: %s", reg_exc)
             else:
                 log.warning("heartbeat failed: %s", exc)
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.wait(self.config.heartbeat_interval_s):
-            self._heartbeat_once()
+            try:
+                self._heartbeat_once()
+            except Exception:  # noqa: BLE001 - the thread must survive
+                # outages (even re-registration failing); next tick retries
+                log.exception("heartbeat iteration failed")
 
     # -- load control (reference worker_config.py:195, main loop gates) ------
 
@@ -238,14 +249,31 @@ class Worker:
             return False
         return True
 
+    # -- busy-state acquisition (poll loop vs direct server) -----------------
+
+    def try_begin_job(self) -> bool:
+        """Atomically claim the worker for one inference (IDLE→BUSY).
+        Returns False when busy/draining — the caller must back off."""
+        with self._state_lock:
+            if self.state != WorkerState.IDLE:
+                return False
+            self.state = WorkerState.BUSY
+            return True
+
+    def end_job(self) -> None:
+        with self._state_lock:
+            if self.state == WorkerState.BUSY:
+                self.state = WorkerState.IDLE
+
     # -- job processing (reference main.py:335-402) --------------------------
 
     def process_job(self, job: Dict[str, Any]) -> None:
+        """Run one claimed job. Caller must hold the BUSY state
+        (``try_begin_job``)."""
         job_id = job["id"]
         task_type = job.get("type", "llm")
         engine = self.engines.get(task_type)
         self.current_job_id = job_id
-        self.state = WorkerState.BUSY
         started = time.time()
         try:
             if engine is None:
@@ -264,26 +292,28 @@ class Worker:
             self._last_job_done_at = time.time()
             self._hour_window.append(started)
             self.current_job_id = None
-            if self.state != WorkerState.DRAINING:
-                self.state = WorkerState.IDLE
+            self.end_job()
 
     def _poll_once(self) -> bool:
         """One poll iteration; returns True if a job was processed."""
+        if not self.try_begin_job():  # direct inference in flight / draining
+            return False
+        job = None
         try:
             job = self.api.fetch_next_job()
         except APIError as exc:
             log.warning("poll failed: %s", exc)
-            return False
         if job is None:
+            self.end_job()
             return False
         if not self.should_accept_job(job):
             self.stats["jobs_rejected"] += 1
             try:
-                self.api.complete_job(
-                    job["id"], success=False, error="rejected by load control"
-                )
+                # requeue, don't fail: another worker can run it
+                self.api.release_job(job["id"])
             except APIError:
                 pass
+            self.end_job()
             return False
         self.process_job(job)
         return True
@@ -301,6 +331,14 @@ class Worker:
               block: bool = True) -> None:
         self.register()
         self.load_engines()
+        if self.config.direct.enabled:
+            from .direct_server import DirectServer
+
+            self._direct = DirectServer(
+                self, host=self.config.direct.host,
+                port=self.config.direct.port,
+            )
+            self._direct.start()
         self.state = WorkerState.IDLE
         if install_signal_handlers:
             try:
@@ -321,12 +359,13 @@ class Worker:
         log.info("signal %s: graceful shutdown", signum)
         self.request_shutdown()
 
-    def request_shutdown(self, timeout_s: float = 60.0) -> None:
+    def request_shutdown(self) -> None:
         """Graceful drain (reference main.py:444-463): stop accepting, let the
         in-flight job finish, notify the server."""
         if self._shutdown.is_set():
             return
-        self.state = WorkerState.DRAINING
+        with self._state_lock:
+            self.state = WorkerState.DRAINING
         try:
             self.api.going_offline()
         except APIError:
@@ -341,6 +380,8 @@ class Worker:
         except APIError:
             pass
         self.state = WorkerState.OFFLINE
+        if getattr(self, "_direct", None) is not None:
+            self._direct.stop()
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=5.0)
         for eng in self.engines.values():

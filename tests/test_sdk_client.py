@@ -1,0 +1,230 @@
+"""SDK client: retry/fallback ladder, job lifecycle, direct mode.
+
+Parity target: reference ``tests/test_sdk_inference_client.py`` (retry and
+fallback with mocked transport, SURVEY §4).
+"""
+
+import json
+from typing import Callable, Dict, List
+
+import httpx
+import pytest
+
+from distributed_gpu_inference_tpu.sdk import (
+    InferenceClient,
+    InferenceClientError,
+    NoWorkersAvailable,
+)
+
+
+def make_transport(handler: Callable[[httpx.Request], httpx.Response]):
+    return httpx.MockTransport(handler)
+
+
+def _client(handler, servers="http://s1", **kw) -> InferenceClient:
+    return InferenceClient(
+        servers, transport=make_transport(handler), backoff_s=0.0, **kw
+    )
+
+
+def test_sync_chat_happy_path():
+    def handler(req: httpx.Request) -> httpx.Response:
+        assert req.url.path == "/api/v1/jobs/sync"
+        body = json.loads(req.content)
+        assert body["type"] == "llm"
+        assert body["params"]["messages"][0]["content"] == "hi"
+        return httpx.Response(
+            200, json={"job_id": "j1", "status": "completed",
+                       "result": {"text": "hello"}},
+        )
+
+    c = _client(handler)
+    out = c.chat(messages=[{"role": "user", "content": "hi"}])
+    assert out["text"] == "hello"
+
+
+def test_503_falls_through_servers_then_raises():
+    hits: List[str] = []
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        hits.append(str(req.url.host))
+        return httpx.Response(503, json={"detail": "no workers"})
+
+    c = _client(handler, servers=["http://s1", "http://s2"])
+    with pytest.raises(NoWorkersAvailable):
+        c.chat(prompt="x")
+    # one attempt per server, no retries on 503
+    assert hits == ["s1", "s2"]
+
+
+def test_503_then_next_server_succeeds():
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.host == "s1":
+            return httpx.Response(503, json={"detail": "full"})
+        return httpx.Response(
+            200, json={"job_id": "j", "status": "completed",
+                       "result": {"text": "from-s2"}},
+        )
+
+    c = _client(handler, servers=["http://s1", "http://s2"])
+    assert c.chat(prompt="x")["text"] == "from-s2"
+
+
+def test_4xx_raises_immediately_no_retry():
+    hits: List[int] = []
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        hits.append(1)
+        return httpx.Response(401, json={"detail": "bad key"})
+
+    c = _client(handler, servers=["http://s1", "http://s2"])
+    with pytest.raises(InferenceClientError) as ei:
+        c.chat(prompt="x")
+    assert ei.value.status == 401
+    assert len(hits) == 1
+
+
+def test_5xx_retries_with_backoff_then_next_server():
+    hits: List[str] = []
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        hits.append(str(req.url.host))
+        if req.url.host == "s1":
+            return httpx.Response(500, text="boom")
+        return httpx.Response(
+            200, json={"job_id": "j", "status": "completed",
+                       "result": {"ok": True}},
+        )
+
+    c = _client(handler, servers=["http://s1", "http://s2"], max_retries=2)
+    assert c.chat(prompt="x")["ok"] is True
+    assert hits.count("s1") == 3  # initial + 2 retries
+
+
+def test_async_job_create_wait():
+    state = {"polls": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.path == "/api/v1/jobs" and req.method == "POST":
+            return httpx.Response(201, json={"job_id": "j9",
+                                             "status": "queued"})
+        assert req.url.path == "/api/v1/jobs/j9"
+        state["polls"] += 1
+        if state["polls"] < 3:
+            return httpx.Response(200, json={"id": "j9", "status": "running"})
+        return httpx.Response(
+            200, json={"id": "j9", "status": "completed",
+                       "result": {"text": "done"}},
+        )
+
+    c = _client(handler)
+    out = c.chat(prompt="x", sync=False, timeout_s=5.0)
+    assert out["text"] == "done"
+    assert state["polls"] == 3
+
+
+def test_async_job_failure_raises():
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.method == "POST":
+            return httpx.Response(201, json={"job_id": "j", "status": "queued"})
+        return httpx.Response(
+            200, json={"id": "j", "status": "failed", "error": "engine died"},
+        )
+
+    c = _client(handler)
+    with pytest.raises(InferenceClientError, match="engine died"):
+        c.chat(prompt="x", sync=False)
+
+
+def test_wait_for_job_timeout():
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.method == "POST":
+            return httpx.Response(201, json={"job_id": "j", "status": "queued"})
+        return httpx.Response(200, json={"id": "j", "status": "running"})
+
+    c = _client(handler)
+    with pytest.raises(TimeoutError):
+        c.chat(prompt="x", sync=False, timeout_s=0.2)
+
+
+def test_direct_mode_uses_worker_then_caches():
+    calls: Dict[str, int] = {"nearest": 0, "direct": 0}
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.path == "/api/v1/jobs/direct/nearest":
+            calls["nearest"] += 1
+            return httpx.Response(
+                200, json={"worker_id": "w1",
+                           "direct_url": "http://worker-a:8471",
+                           "region": "us-west"},
+            )
+        if req.url.host == "worker-a":
+            calls["direct"] += 1
+            return httpx.Response(
+                200, json={"result": {"text": "direct-hit"}}
+            )
+        raise AssertionError(f"unexpected {req.url}")
+
+    c = _client(handler)
+    assert c.chat(prompt="a", use_direct=True)["text"] == "direct-hit"
+    assert c.chat(prompt="b", use_direct=True)["text"] == "direct-hit"
+    assert calls["nearest"] == 1  # 60 s cache: discovery happened once
+    assert calls["direct"] == 2
+
+
+def test_direct_busy_falls_back_to_queue():
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.path == "/api/v1/jobs/direct/nearest":
+            return httpx.Response(
+                200, json={"worker_id": "w1",
+                           "direct_url": "http://worker-a:8471",
+                           "region": "us-west"},
+            )
+        if req.url.host == "worker-a":
+            return httpx.Response(503, json={"detail": "busy"})
+        if req.url.path == "/api/v1/jobs/sync":
+            return httpx.Response(
+                200, json={"job_id": "j", "status": "completed",
+                           "result": {"text": "queued-path"}},
+            )
+        raise AssertionError(f"unexpected {req.url}")
+
+    c = _client(handler)
+    out = c.chat(prompt="x", use_direct=True)
+    assert out["text"] == "queued-path"
+    assert c._direct_cache is None  # busy worker dropped from cache
+
+
+def test_direct_discovery_404_falls_back():
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.path == "/api/v1/jobs/direct/nearest":
+            return httpx.Response(404, json={"detail": "none"})
+        return httpx.Response(
+            200, json={"job_id": "j", "status": "completed",
+                       "result": {"text": "queued"}},
+        )
+
+    c = _client(handler)
+    assert c.chat(prompt="x", use_direct=True)["text"] == "queued"
+
+
+def test_cancel_and_queue_stats():
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.method == "DELETE":
+            return httpx.Response(200, json={"status": "cancelled"})
+        return httpx.Response(200, json={"queued": 3, "running": 1})
+
+    c = _client(handler)
+    c.cancel_job("j1")
+    assert c.queue_stats()["queued"] == 3
+
+
+def test_api_key_header_sent():
+    def handler(req: httpx.Request) -> httpx.Response:
+        assert req.headers["X-API-Key"] == "secret"
+        return httpx.Response(
+            200, json={"job_id": "j", "status": "completed", "result": {}}
+        )
+
+    c = _client(handler, api_key="secret")
+    c.chat(prompt="x")

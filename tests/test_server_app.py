@@ -79,6 +79,44 @@ def test_register_heartbeat_and_config_flag():
     run(body())
 
 
+def test_release_requeues_claimed_job():
+    """Client-side load-control decline: the job goes back to QUEUED (not
+    FAILED) and another worker can claim it."""
+    async def body():
+        client = await make_client()
+        reg = await register(client)
+        wid = reg["worker_id"]
+        resp = await client.post(
+            "/api/v1/jobs", json={"type": "llm", "params": {}}
+        )
+        job_id = (await resp.json())["job_id"]
+        resp = await client.get(f"/api/v1/workers/{wid}/next-job",
+                                headers=auth(reg))
+        assert resp.status == 200
+
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/jobs/{job_id}/release",
+            json={}, headers=auth(reg),
+        )
+        assert resp.status == 200
+        job = await (await client.get(f"/api/v1/jobs/{job_id}")).json()
+        assert job["status"] == JobStatus.QUEUED.value
+        assert job["worker_id"] is None
+        assert job["retry_count"] == 0      # a decline is not a failure
+
+        # a second worker claims the same job
+        reg2 = await register(client, name="tw2")
+        resp = await client.get(
+            f"/api/v1/workers/{reg2['worker_id']}/next-job",
+            headers=auth(reg2),
+        )
+        assert resp.status == 200
+        assert (await resp.json())["job"]["id"] == job_id
+        await client.close()
+
+    run(body())
+
+
 def test_job_lifecycle_poll_and_complete():
     async def body():
         client = await make_client()

@@ -1,0 +1,135 @@
+"""Direct server: /health /status /inference with 503 when busy/draining.
+
+Parity target: reference ``worker/direct_server.py:70-118`` (503 gating) and
+the direct-mode discovery flow (SURVEY §3.2).
+"""
+
+import asyncio
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from distributed_gpu_inference_tpu.utils.data_structures import WorkerState
+from distributed_gpu_inference_tpu.worker.direct_server import DirectServer
+
+
+class FakeWorker:
+    def __init__(self):
+        self.state = WorkerState.IDLE
+        self.engines = {"llm": self}
+
+    # worker claim surface (same contract as Worker.try_begin_job/end_job)
+    def try_begin_job(self):
+        if self.state != WorkerState.IDLE:
+            return False
+        self.state = WorkerState.BUSY
+        return True
+
+    def end_job(self):
+        if self.state == WorkerState.BUSY:
+            self.state = WorkerState.IDLE
+
+    # engine surface
+    def inference(self, params):
+        if params.get("boom"):
+            raise RuntimeError("kaboom")
+        return {"text": "ok", "params": params}
+
+    def get_status(self):
+        return {"state": self.state.value, "task_types": ["llm"]}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_client(worker):
+    ds = DirectServer(worker)
+    client = TestClient(TestServer(ds.make_app()))
+    await client.start_server()
+    return client, ds
+
+
+def test_health_and_status():
+    async def body():
+        w = FakeWorker()
+        client, _ = await make_client(w)
+        r = await client.get("/health")
+        assert r.status == 200
+        assert (await r.json())["status"] == "ok"
+        r = await client.get("/status")
+        assert (await r.json())["state"] == "idle"
+        await client.close()
+
+    run(body())
+
+
+def test_inference_roundtrip():
+    async def body():
+        w = FakeWorker()
+        client, ds = await make_client(w)
+        r = await client.post(
+            "/inference", json={"type": "llm", "params": {"prompt": "hi"}}
+        )
+        assert r.status == 200
+        data = await r.json()
+        assert data["result"]["text"] == "ok"
+        assert ds.stats["requests"] == 1
+        await client.close()
+
+    run(body())
+
+
+def test_503_when_busy_or_draining():
+    async def body():
+        w = FakeWorker()
+        client, ds = await make_client(w)
+        for state in (WorkerState.BUSY, WorkerState.DRAINING,
+                      WorkerState.OFFLINE):
+            w.state = state
+            r = await client.post("/inference", json={"type": "llm"})
+            assert r.status == 503
+        assert ds.stats["rejected"] == 3
+        await client.close()
+
+    run(body())
+
+
+def test_unknown_task_type_404():
+    async def body():
+        w = FakeWorker()
+        client, _ = await make_client(w)
+        r = await client.post("/inference", json={"type": "vision"})
+        assert r.status == 404
+        await client.close()
+
+    run(body())
+
+
+def test_engine_error_500():
+    async def body():
+        w = FakeWorker()
+        client, _ = await make_client(w)
+        r = await client.post(
+            "/inference", json={"type": "llm", "params": {"boom": 1}}
+        )
+        assert r.status == 500
+        assert "kaboom" in (await r.json())["detail"]
+        await client.close()
+
+    run(body())
+
+
+def test_threaded_lifecycle():
+    """start()/stop() run the server in a background thread (worker usage)."""
+    import httpx
+
+    w = FakeWorker()
+    ds = DirectServer(w, host="127.0.0.1", port=0)
+    # port 0: pick an ephemeral port — read it back from the runner
+    ds.start()
+    try:
+        port = ds._runner.addresses[0][1]
+        r = httpx.get(f"http://127.0.0.1:{port}/health", timeout=5.0)
+        assert r.status_code == 200
+    finally:
+        ds.stop()

@@ -96,6 +96,10 @@ class FakeAPI:
         )
         return {"ok": True}
 
+    def release_job(self, job_id):
+        self.released = getattr(self, "released", [])
+        self.released.append(job_id)
+
     def going_offline(self):
         self.calls.append("going_offline")
 
@@ -217,22 +221,37 @@ def test_process_job_success_and_failure():
     api = FakeAPI(creds_valid=True)
     w = _worker(api)
     w.load_engines()
+    w.state = WorkerState.IDLE
+    assert w.try_begin_job()
     w.process_job({"id": "j1", "type": "llm", "params": {"x": 1}})
     assert api.completed[0]["success"] is True
     assert api.completed[0]["result"] == {"echo": {"x": 1}}
     assert w.stats["jobs_completed"] == 1
     assert w.state == WorkerState.IDLE
 
+    assert w.try_begin_job()
     w.process_job({"id": "j2", "type": "llm", "params": {"boom": True}})
     assert api.completed[1]["success"] is False
     assert "exploded" in api.completed[1]["error"]
     assert w.stats["jobs_failed"] == 1
 
 
+def test_try_begin_job_excludes_concurrent_work():
+    api = FakeAPI(creds_valid=True)
+    w = _worker(api)
+    w.state = WorkerState.IDLE
+    assert w.try_begin_job()
+    assert not w.try_begin_job()        # second claim refused while BUSY
+    w.end_job()
+    assert w.try_begin_job()
+
+
 def test_process_job_unknown_type_fails_cleanly():
     api = FakeAPI(creds_valid=True)
     w = _worker(api)
     w.load_engines()
+    w.state = WorkerState.IDLE
+    assert w.try_begin_job()
     w.process_job({"id": "j3", "type": "vision", "params": {}})
     assert api.completed[0]["success"] is False
 
@@ -276,15 +295,19 @@ def test_load_control_working_hours():
     assert w.should_accept_job({"type": "llm"}) is True
 
 
-def test_rejected_job_reported_to_server():
+def test_rejected_job_released_not_failed():
     api = FakeAPI(creds_valid=True,
                   jobs=[{"id": "jr", "type": "llm", "params": {}}])
     w = _worker(api)
     w.load_engines()
+    w.state = WorkerState.IDLE
     w.config.load_control.acceptance_rate = 0.0
     assert w._poll_once() is False
     assert w.stats["jobs_rejected"] == 1
-    assert api.completed[0]["success"] is False
+    # requeued for other workers — NOT completed as failed
+    assert api.completed == []
+    assert api.released == ["jr"]
+    assert w.state == WorkerState.IDLE
 
 
 def test_full_lifecycle_processes_jobs_then_drains():
