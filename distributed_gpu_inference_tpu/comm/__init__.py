@@ -1,0 +1,30 @@
+"""Cross-host data plane: binary tensor wire, pipeline stage workers, and
+client-side distributed sessions.
+
+TPU-native re-design of the reference's ``worker/distributed`` P2P layer
+(``grpc_server.py`` servicer + aiohttp JSON data plane, ``session.py``
+client pipeline): within a slice, pipeline hops are XLA collectives
+(``parallel/pipeline.py``) and never touch this package; across hosts,
+activations ride a length-prefixed binary frame (msgpack header + zstd
+tensor frames) instead of the reference's base64-JSON (SURVEY §3.3 calls
+that the #1 throughput sin).
+"""
+
+from .wire import pack_message, unpack_message
+from .stage_worker import PipelineStageWorker
+from .session import (
+    DistributedInferenceSession,
+    PipelineHopError,
+    SessionManager,
+    WorkerSession,
+)
+
+__all__ = [
+    "pack_message",
+    "unpack_message",
+    "PipelineStageWorker",
+    "DistributedInferenceSession",
+    "PipelineHopError",
+    "SessionManager",
+    "WorkerSession",
+]

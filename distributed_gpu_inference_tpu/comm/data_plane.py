@@ -1,0 +1,145 @@
+"""HTTP data plane serving a pipeline stage worker.
+
+Working transport of the cross-host pipeline, parity with the reference's
+``HTTPInferenceServer`` (``worker/distributed/grpc_server.py:450-562``,
+routes ``/inference/forward``, ``/inference/close``, ``/health``) plus the
+proto surface the reference never wired (``proto/inference.proto:11-27``):
+CreateSession / CloseSession / Forward / TransferKVCache / HealthCheck all
+respond for real here.
+
+Bodies are TPUM binary frames (``comm.wire``), not base64 JSON. KV transfer
+accepts a serialized :mod:`runtime.kv_handoff` payload so a PD decode pool
+can receive pages over the same socket.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable, Dict, Optional
+
+from aiohttp import web
+
+from .stage_worker import PipelineStageWorker, StageOutOfBlocksError
+from .wire import pack_message, unpack_message
+
+
+class DataPlaneServer:
+    """aiohttp front for one stage worker (or a PD KV-receiving engine)."""
+
+    def __init__(self, stage: PipelineStageWorker,
+                 host: str = "0.0.0.0", port: int = 8472,
+                 kv_receiver: Optional[Callable[[bytes], Dict[str, Any]]] = None
+                 ) -> None:
+        self.stage = stage
+        self.host = host
+        self.port = port
+        self.kv_receiver = kv_receiver
+        self._runner: Optional[web.AppRunner] = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+
+    # -- handlers ------------------------------------------------------------
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(self.stage.health())
+
+    async def _create_session(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        sid = body.get("session_id")
+        if not sid:
+            return web.json_response({"detail": "session_id required"},
+                                     status=400)
+        return web.json_response(self.stage.create_session(sid))
+
+    async def _close_session(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        self.stage.close_session(body.get("session_id", ""))
+        return web.json_response({"status": "closed"})
+
+    async def _forward(self, request: web.Request) -> web.Response:
+        raw = await request.read()
+        try:
+            meta, tensors = unpack_message(raw)
+        except ValueError as exc:
+            return web.json_response({"detail": str(exc)}, status=400)
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(
+                None,
+                self.stage.forward,
+                meta["session_id"],
+                tensors["x"],
+                tensors["positions"],
+                int(meta["kv_len_after"]),
+            )
+        except KeyError as exc:
+            return web.json_response({"detail": str(exc)}, status=404)
+        except StageOutOfBlocksError as exc:
+            return web.json_response({"detail": str(exc)}, status=507)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response({"detail": str(exc)}, status=500)
+        return web.Response(
+            body=pack_message({"session_id": meta["session_id"]}, out),
+            content_type="application/octet-stream",
+        )
+
+    async def _transfer_kv(self, request: web.Request) -> web.Response:
+        """PD KV handoff receiver (proto TransferKVCache:19, made real)."""
+        if self.kv_receiver is None:
+            return web.json_response(
+                {"detail": "this endpoint is not a KV receiver"}, status=501
+            )
+        raw = await request.read()
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(None, self.kv_receiver, raw)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response({"detail": str(exc)}, status=500)
+        return web.json_response(result)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def make_app(self) -> web.Application:
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_get("/health", self._health)
+        app.router.add_post("/inference/create_session", self._create_session)
+        app.router.add_post("/inference/close", self._close_session)
+        app.router.add_post("/inference/forward", self._forward)
+        app.router.add_post("/kv/transfer", self._transfer_kv)
+        return app
+
+    def start(self) -> None:
+        def _run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            runner = web.AppRunner(self.make_app())
+            loop.run_until_complete(runner.setup())
+            self._runner = runner
+            site = web.TCPSite(runner, self.host, self.port)
+            loop.run_until_complete(site.start())
+            self._started.set()
+            loop.run_forever()
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+        self._thread = threading.Thread(
+            target=_run, name="data-plane", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=15.0):
+            raise RuntimeError("data plane server failed to start")
+
+    @property
+    def bound_port(self) -> int:
+        assert self._runner is not None
+        return self._runner.addresses[0][1]
+
+    def stop(self) -> None:
+        if self._loop is not None and not self._loop.is_closed():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,301 @@
+"""Client-side distributed pipeline session with REAL failure recovery.
+
+Parity surface: reference ``worker/distributed/session.py`` —
+``WorkerSession`` (connect/health/forward :79-166), route walking with
+per-hop retry + backoff (:303-329), ``SessionManager`` pool (:398-455).
+
+The reference's failure hook RAISES (``session.py:362-365`` — SURVEY gap #3).
+Here ``_handle_hop_failure`` actually recovers: the dead hop is swapped for a
+spare worker serving the same layer range, a fresh stage session is created
+on it, and the chunk history is REPLAYED through the pipeline prefix to
+rebuild the replacement's KV. Replays are safe because page writes are
+idempotent (same position + same deterministic values), so healthy stages
+just rewrite what they already hold.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import httpx
+import numpy as np
+
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    BlockRange,
+    SessionConfig,
+)
+from .wire import pack_message, unpack_message
+
+log = logging.getLogger("tpu_pipeline_session")
+
+
+class PipelineHopError(RuntimeError):
+    def __init__(self, hop: int, detail: str) -> None:
+        super().__init__(f"hop {hop}: {detail}")
+        self.hop = hop
+        self.detail = detail
+
+
+class WorkerSession:
+    """One hop: HTTP client to a stage worker's data plane."""
+
+    def __init__(self, base_url: str, layer_range: BlockRange,
+                 timeout_s: float = 60.0,
+                 transport: Optional[httpx.BaseTransport] = None) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.layer_range = layer_range
+        self._client = httpx.Client(
+            base_url=self.base_url, timeout=timeout_s, transport=transport
+        )
+
+    def health(self) -> Dict[str, Any]:
+        resp = self._client.get("/health")
+        resp.raise_for_status()
+        return resp.json()
+
+    def create(self, session_id: str) -> None:
+        resp = self._client.post(
+            "/inference/create_session", json={"session_id": session_id}
+        )
+        resp.raise_for_status()
+
+    def forward(self, session_id: str, x: np.ndarray, positions: np.ndarray,
+                kv_len_after: int) -> Dict[str, np.ndarray]:
+        body = pack_message(
+            {"session_id": session_id, "kv_len_after": kv_len_after},
+            {"x": x, "positions": positions},
+        )
+        resp = self._client.post(
+            "/inference/forward", content=body,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        if resp.status_code != 200:
+            detail = ""
+            try:
+                detail = resp.json().get("detail", "")
+            except ValueError:
+                pass
+            raise httpx.HTTPStatusError(
+                f"{resp.status_code}: {detail}", request=resp.request,
+                response=resp,
+            )
+        _, tensors = unpack_message(resp.content)
+        return tensors
+
+    def close(self, session_id: str) -> None:
+        try:
+            self._client.post(
+                "/inference/close", json={"session_id": session_id}
+            )
+        except httpx.HTTPError:
+            pass
+
+    def dispose(self) -> None:
+        self._client.close()
+
+
+@dataclass
+class _ChunkRecord:
+    tokens: np.ndarray        # [B, S] int32 (what stage 0 consumed)
+    positions: np.ndarray     # [B, S] int32
+    kv_len_after: int
+
+
+class DistributedInferenceSession:
+    """Drives a route of stage workers for one generation."""
+
+    def __init__(
+        self,
+        route: Sequence[WorkerSession],
+        config: Optional[SessionConfig] = None,
+        spare_workers: Optional[List[WorkerSession]] = None,
+        session_id: Optional[str] = None,
+    ) -> None:
+        if not route:
+            raise ValueError("empty route")
+        self.route: List[WorkerSession] = list(route)
+        self.config = config or SessionConfig()
+        self.spares: List[WorkerSession] = list(spare_workers or [])
+        self.session_id = session_id or uuid.uuid4().hex
+        self.kv_len = 0
+        self.history: List[_ChunkRecord] = []
+        self.stats: Dict[str, Any] = {
+            "steps": 0, "retries": 0, "hop_failures": 0, "reroutes": 0,
+            "replayed_chunks": 0,
+        }
+        self._setup_done = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def setup(self) -> None:
+        """Connect every hop and create the stage sessions (reference
+        session.py:246-258)."""
+        for i, ws in enumerate(self.route):
+            try:
+                ws.create(self.session_id)
+            except httpx.HTTPError as exc:
+                raise PipelineHopError(i, f"create failed: {exc}") from exc
+        self._setup_done = True
+
+    def close(self) -> None:
+        for ws in self.route:
+            ws.close(self.session_id)
+        self._setup_done = False
+
+    # -- stepping ------------------------------------------------------------
+
+    def _hop_forward(self, hop: int, x: np.ndarray, positions: np.ndarray,
+                     kv_len_after: int) -> Dict[str, np.ndarray]:
+        """One hop with per-hop retry + backoff (reference :303-329), then
+        failure recovery."""
+        ws = self.route[hop]
+        last: Optional[Exception] = None
+        for attempt in range(self.config.max_retries_per_hop):
+            try:
+                return ws.forward(
+                    self.session_id, x, positions, kv_len_after
+                )
+            except (httpx.TransportError, httpx.HTTPStatusError) as exc:
+                # 4xx except 404 are protocol bugs, not worker death
+                if isinstance(exc, httpx.HTTPStatusError) and \
+                        exc.response.status_code not in (404, 500, 502, 503, 507):
+                    raise PipelineHopError(hop, str(exc)) from exc
+                last = exc
+                self.stats["retries"] += 1
+                time.sleep(self.config.retry_backoff_s * (2**attempt))
+        self.stats["hop_failures"] += 1
+        self._handle_hop_failure(hop, last)
+        # the replacement is installed and warmed; replay THIS chunk on it
+        return self.route[hop].forward(
+            self.session_id, x, positions, kv_len_after
+        )
+
+    def _handle_hop_failure(self, hop: int, cause: Optional[Exception]) -> None:
+        """Swap the dead hop for a spare serving the same layers and rebuild
+        its KV by replaying history through the pipeline prefix (the recovery
+        the reference declares but never implements, session.py:362-365)."""
+        dead = self.route[hop]
+        replacement: Optional[WorkerSession] = None
+        for i, spare in enumerate(self.spares):
+            if spare.layer_range == dead.layer_range:
+                replacement = self.spares.pop(i)
+                break
+        if replacement is None:
+            raise PipelineHopError(
+                hop,
+                f"worker {dead.base_url} failed ({cause}) and no spare "
+                f"serves layers {dead.layer_range}",
+            )
+        log.warning(
+            "hop %d (%s) failed: rerouting to %s and replaying %d chunks",
+            hop, dead.base_url, replacement.base_url, len(self.history),
+        )
+        replacement.create(self.session_id)
+        self.route[hop] = replacement
+        dead.dispose()
+        self.stats["reroutes"] += 1
+        # rebuild the replacement's KV: drive every past chunk through hops
+        # [0, hop] — healthy prefix stages rewrite identical pages (idempotent)
+        for rec in self.history:
+            x: np.ndarray = rec.tokens
+            for j in range(hop + 1):
+                out = self.route[j].forward(
+                    self.session_id, x, rec.positions, rec.kv_len_after
+                )
+                x = out["hidden"]
+            self.stats["replayed_chunks"] += 1
+
+    def step(self, token_ids: np.ndarray,
+             positions: Optional[np.ndarray] = None) -> np.ndarray:
+        """Walk all hops for one chunk (prefill piece or a single decode
+        token). Returns logits [B, S, V] from the last stage."""
+        if not self._setup_done:
+            self.setup()
+        token_ids = np.asarray(token_ids, np.int32)
+        if token_ids.ndim == 1:
+            token_ids = token_ids[None, :]
+        b, s = token_ids.shape
+        if positions is None:
+            positions = np.tile(
+                np.arange(self.kv_len, self.kv_len + s, dtype=np.int32), (b, 1)
+            )
+        kv_len_after = int(positions.max()) + 1
+        if self.config.max_length and kv_len_after > self.config.max_length:
+            raise ValueError(
+                f"context {kv_len_after} exceeds session max_length "
+                f"{self.config.max_length}"
+            )
+
+        x: np.ndarray = token_ids
+        out: Dict[str, np.ndarray] = {}
+        for hop in range(len(self.route)):
+            out = self._hop_forward(hop, x, positions, kv_len_after)
+            x = out["hidden"]
+        self.history.append(
+            _ChunkRecord(token_ids, positions, kv_len_after)
+        )
+        self.kv_len = max(self.kv_len, kv_len_after)
+        self.stats["steps"] += 1
+        if "logits" not in out:
+            raise PipelineHopError(
+                len(self.route) - 1, "last stage returned no logits"
+            )
+        return out["logits"]
+
+    # -- convenience ---------------------------------------------------------
+
+    def generate_greedy(self, prompt_ids: Sequence[int],
+                        max_new_tokens: int = 16,
+                        stop_ids: Sequence[int] = ()) -> List[int]:
+        """Simple greedy driver (prefill chunk + per-token decode steps)."""
+        prompt = np.asarray(list(prompt_ids), np.int32)[None, :]
+        logits = self.step(prompt)
+        out: List[int] = []
+        tok = int(np.argmax(logits[0, -1]))
+        for _ in range(max_new_tokens):
+            out.append(tok)
+            if tok in stop_ids:
+                break
+            logits = self.step(np.asarray([[tok]], np.int32))
+            tok = int(np.argmax(logits[0, -1]))
+        return out
+
+
+class SessionManager:
+    """Pool of live sessions keyed by session_id with LRU capacity eviction
+    (reference SessionManager, session.py:398-455)."""
+
+    def __init__(self, max_sessions: int = 16) -> None:
+        self.max_sessions = max_sessions
+        self._sessions: Dict[str, DistributedInferenceSession] = {}
+        self._last_used: Dict[str, float] = {}
+
+    def add(self, session: DistributedInferenceSession) -> None:
+        while len(self._sessions) >= self.max_sessions:
+            lru = min(self._last_used, key=self._last_used.get)
+            self.remove(lru)
+        self._sessions[session.session_id] = session
+        self._last_used[session.session_id] = time.time()
+
+    def get(self, session_id: str) -> Optional[DistributedInferenceSession]:
+        s = self._sessions.get(session_id)
+        if s is not None:
+            self._last_used[session_id] = time.time()
+        return s
+
+    def remove(self, session_id: str) -> None:
+        s = self._sessions.pop(session_id, None)
+        self._last_used.pop(session_id, None)
+        if s is not None:
+            s.close()
+
+    def close_all(self) -> None:
+        for sid in list(self._sessions):
+            self.remove(sid)
+
+    def __len__(self) -> int:
+        return len(self._sessions)

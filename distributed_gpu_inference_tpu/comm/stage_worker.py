@@ -1,0 +1,216 @@
+"""Pipeline stage worker: owns a contiguous layer range and serves forwards.
+
+Cross-host counterpart of the reference's ``ModelShard`` + ``InferenceServicer``
+(``worker/distributed/model_shard.py:28-259``, ``grpc_server.py:36-374``):
+
+- Stage 0 receives token ids and embeds; middle stages receive hidden states;
+  the last stage applies final norm + LM head and returns logits
+  (reference model_shard.py:163-171, 230-246).
+- Each stage keeps its OWN paged-KV pools for its layers, addressed by
+  per-session block tables — device-resident, never shipped (the reference
+  ships per-layer KV over the wire; here only [B, S, H] activations cross
+  hosts, the KV stays put).
+- Replays are idempotent: a page write at the same position with the same
+  values is a no-op in effect, which is what makes failure recovery by
+  re-driving history through healthy stages safe (see ``comm.session``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_gpu_inference_tpu.models import llama
+from distributed_gpu_inference_tpu.models.configs import ModelConfig, get_model_config
+from distributed_gpu_inference_tpu.parallel.pipeline import slice_stage_params
+
+
+@dataclass
+class _StageSession:
+    session_id: str
+    blocks: List[int] = field(default_factory=list)
+    kv_len: int = 0
+    created_at: float = field(default_factory=time.time)
+    steps: int = 0
+
+
+class StageOutOfBlocksError(RuntimeError):
+    pass
+
+
+class PipelineStageWorker:
+    """One host's stage of a cross-host pipeline."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig | str,
+        layer_range: Tuple[int, int],
+        *,
+        params: Optional[llama.Params] = None,
+        full_params: Optional[llama.Params] = None,
+        num_blocks: int = 256,
+        block_size: int = 16,
+        max_batch: int = 8,
+        max_blocks_per_seq: int = 64,
+        dtype: str = "float32",
+        seed: int = 0,
+    ) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.cfg = (
+            get_model_config(model_cfg) if isinstance(model_cfg, str) else model_cfg
+        )
+        self.start, self.end = layer_range
+        self.is_first = self.start == 0
+        self.is_last = self.end == self.cfg.num_layers
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.dtype = jnp.dtype(dtype)
+
+        if params is None:
+            full = full_params if full_params is not None else llama.init_params(
+                self.cfg, jax.random.PRNGKey(seed), self.dtype
+            )
+            params = slice_stage_params(
+                full, self.start, self.end, num_layers=self.cfg.num_layers
+            )
+        self.params = params
+
+        # per-stage KV pools cover ONLY the owned layers
+        stage_cfg_layers = self.end - self.start
+        self.kv = {
+            k: jnp.zeros(
+                (stage_cfg_layers, num_blocks, block_size,
+                 self.cfg.num_kv_heads, self.cfg.head_dim),
+                self.dtype,
+            )
+            for k in ("k", "v")
+        }
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))  # 0 reserved
+        self._sessions: Dict[str, _StageSession] = {}
+        self._lock = threading.Lock()
+        self._jit_cache: Dict[Tuple[int, int], Any] = {}
+        self.stats: Dict[str, Any] = {
+            "forwards": 0, "sessions_created": 0, "sessions_closed": 0,
+            "tokens_processed": 0,
+        }
+
+    # -- session lifecycle ---------------------------------------------------
+
+    def create_session(self, session_id: str) -> Dict[str, Any]:
+        with self._lock:
+            if session_id in self._sessions:
+                # idempotent create: recovery may re-create after a reconnect
+                return {"session_id": session_id, "existing": True}
+            self._sessions[session_id] = _StageSession(session_id)
+            self.stats["sessions_created"] += 1
+        return {"session_id": session_id, "existing": False}
+
+    def close_session(self, session_id: str) -> None:
+        with self._lock:
+            sess = self._sessions.pop(session_id, None)
+            if sess is not None:
+                self._free.extend(reversed(sess.blocks))
+                self.stats["sessions_closed"] += 1
+
+    def _ensure_blocks(self, sess: _StageSession, kv_len_after: int) -> None:
+        needed = max(1, -(-kv_len_after // self.block_size))
+        if needed > self.max_blocks_per_seq:
+            raise StageOutOfBlocksError(
+                f"session {sess.session_id} needs {needed} blocks "
+                f"> per-seq limit {self.max_blocks_per_seq}"
+            )
+        while len(sess.blocks) < needed:
+            if not self._free:
+                raise StageOutOfBlocksError("stage KV pool exhausted")
+            sess.blocks.append(self._free.pop())
+
+    # -- forward -------------------------------------------------------------
+
+    def _fns(self, b: int, s: int):
+        """Jitted forward for a (B, S) shape bucket."""
+        import jax
+
+        key = (b, s)
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        cfg, bs = self.cfg, self.block_size
+
+        def run(params, kv, x, positions, block_table, kv_lens):
+            hidden = x
+            if self.is_first:
+                hidden = llama.embed_tokens(params, x)
+            hidden, kv = llama.forward_hidden_chunk(
+                cfg, params, hidden, positions, kv, block_table, kv_lens,
+                block_size=bs,
+            )
+            if self.is_last:
+                logits = llama.project_logits(cfg, params, hidden)
+                return hidden, kv, logits
+            return hidden, kv, None
+
+        fn = jax.jit(run, donate_argnums=(1,))
+        self._jit_cache[key] = fn
+        return fn
+
+    def forward(
+        self,
+        session_id: str,
+        x: np.ndarray,              # tokens [B,S] int32 (first) | hidden [B,S,H]
+        positions: np.ndarray,      # [B,S] int32, -1 = pad
+        kv_len_after: int,
+    ) -> Dict[str, np.ndarray]:
+        """Run one chunk through this stage's layers. Returns {"hidden": ...}
+        and, on the last stage, {"logits": ...}."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is None:
+                raise KeyError(f"unknown session {session_id}")
+            self._ensure_blocks(sess, kv_len_after)
+            table = np.zeros((self.max_blocks_per_seq,), np.int32)
+            table[: len(sess.blocks)] = sess.blocks
+        b, s = x.shape[0], x.shape[1]
+        fn = self._fns(b, s)
+        if self.is_first:
+            xin = jnp.asarray(x.astype(np.int32))
+        else:
+            xin = jnp.asarray(x, dtype=self.dtype)
+        hidden, self.kv, logits = fn(
+            self.params, self.kv, xin,
+            jnp.asarray(positions.astype(np.int32)),
+            jnp.asarray(np.tile(table, (b, 1))),
+            jnp.asarray(np.full((b,), kv_len_after, np.int32)),
+        )
+        with self._lock:
+            # replay of an already-seen chunk must not advance the clock
+            sess.kv_len = max(sess.kv_len, kv_len_after)
+            sess.steps += 1
+        self.stats["forwards"] += 1
+        n_valid = int((positions >= 0).sum())
+        self.stats["tokens_processed"] += n_valid
+        out: Dict[str, np.ndarray] = {"hidden": np.asarray(hidden, np.float32)}
+        if logits is not None:
+            out["logits"] = np.asarray(logits, np.float32)
+        return out
+
+    # -- introspection -------------------------------------------------------
+
+    def health(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "status": "ok",
+                "layer_range": [self.start, self.end],
+                "is_first": self.is_first,
+                "is_last": self.is_last,
+                "active_sessions": len(self._sessions),
+                "free_blocks": len(self._free),
+                "stats": dict(self.stats),
+            }

@@ -1,0 +1,67 @@
+"""Binary message framing for the cross-host data plane.
+
+One message = msgpack metadata + N framed tensors. Replaces the reference's
+base64-tensors-inside-JSON (``worker/distributed/session.py:125-160``,
+``grpc_server.py:479-524``) with zero-copy-friendly binary: each tensor is a
+``utils.serialization.TensorSerializer`` frame (native dtype incl. bfloat16,
+optional zstd), so the wire cost is ~1x payload instead of base64's 1.33x
+plus JSON escaping, and the same codec serves KV handoff and WAN tiers.
+
+Layout:
+    magic   b"TPUM"
+    u8      version (=1)
+    u32     header length
+    bytes   msgpack header {"meta": {...}, "tensors": [name, ...]}
+    repeat per tensor: u64 frame length + TensorSerializer frame
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from distributed_gpu_inference_tpu.utils.serialization import (
+    TensorSerializer,
+    _pack_header,
+    _unpack_header,
+)
+
+_MAGIC = b"TPUM"
+_VERSION = 1
+
+
+def pack_message(meta: Dict[str, Any],
+                 tensors: Dict[str, Any] | None = None,
+                 compress: bool = True) -> bytes:
+    tensors = tensors or {}
+    ser = TensorSerializer(compress=compress)
+    header = _pack_header({"meta": meta, "tensors": list(tensors)})
+    parts = [_MAGIC, struct.pack("<B", _VERSION),
+             struct.pack("<I", len(header)), header]
+    for name, t in tensors.items():
+        frame = ser.serialize(np.asarray(t))
+        parts.append(struct.pack("<Q", len(frame)))
+        parts.append(frame)
+    return b"".join(parts)
+
+
+def unpack_message(data: bytes) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
+    view = memoryview(data)
+    if bytes(view[:4]) != _MAGIC:
+        raise ValueError("bad magic: not a TPUM message")
+    (version,) = struct.unpack_from("<B", view, 4)
+    if version != _VERSION:
+        raise ValueError(f"unsupported message version {version}")
+    (hlen,) = struct.unpack_from("<I", view, 5)
+    header = _unpack_header(bytes(view[9 : 9 + hlen]))
+    off = 9 + hlen
+    ser = TensorSerializer()
+    tensors: Dict[str, np.ndarray] = {}
+    for name in header["tensors"]:
+        (flen,) = struct.unpack_from("<Q", view, off)
+        off += 8
+        tensors[name] = ser.deserialize(bytes(view[off : off + flen]))
+        off += flen
+    return header["meta"], tensors

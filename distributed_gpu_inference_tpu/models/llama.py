@@ -374,7 +374,9 @@ def project_logits(cfg: ModelConfig, params: Params, hidden: jax.Array) -> jax.A
     """Last pipeline stage: final norm + LM head (reference model_shard.py:168-171,
     get_logits:230-246)."""
     normed = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head", params["embedding"])
+    # NOT dict.get(k, default): the default would be evaluated eagerly and
+    # KeyError on a last pipeline stage that carries lm_head but no embedding
+    head = params["lm_head"] if "lm_head" in params else params["embedding"]
     return jnp.einsum(
         "bsh,vh->bsv", normed.astype(jnp.float32), head.astype(jnp.float32)
     )

@@ -81,7 +81,12 @@ class InferenceClient:
                  payload: Optional[Dict[str, Any]] = None,
                  params: Optional[Dict[str, str]] = None,
                  timeout: Optional[float] = None,
-                 retry_transport: bool = True) -> httpx.Response:
+                 idempotent: bool = True) -> httpx.Response:
+        """``idempotent=False`` marks calls whose SERVER-SIDE effect may have
+        happened even when the response is lost (POST /jobs, /jobs/sync): they
+        are sent exactly once — no transport retry, no 5xx retry, no
+        next-server failover — because a blind re-POST would create or
+        execute the job again."""
         last: Optional[Exception] = None
         saw_503 = False
         for server in self.servers:
@@ -94,9 +99,7 @@ class InferenceClient:
                     )
                 except httpx.TransportError as exc:
                     last = exc
-                    if not retry_transport:
-                        # non-idempotent call (e.g. /jobs/sync EXECUTES the
-                        # job): a blind re-POST would run it again
+                    if not idempotent:
                         raise InferenceClientError(
                             599, f"transport failed: {exc}"
                         ) from exc
@@ -117,10 +120,14 @@ class InferenceClient:
                     last = InferenceClientError(
                         resp.status_code, resp.text[:200]
                     )
+                    if not idempotent:  # the job may have run: don't re-run it
+                        raise last
                     if attempt < self._max_retries:
                         time.sleep(self._backoff_s * (2**attempt))
                     continue
                 return resp
+            if not idempotent and not saw_503:
+                break  # no cross-server failover for effectful calls
         if saw_503:
             raise NoWorkersAvailable()
         raise InferenceClientError(599, f"all servers failed: {last}")
@@ -136,7 +143,7 @@ class InferenceClient:
         }
         if preferred_region:
             body["preferred_region"] = preferred_region
-        resp = self._request("POST", "/api/v1/jobs", body)
+        resp = self._request("POST", "/api/v1/jobs", body, idempotent=False)
         return resp.json()["job_id"]
 
     def get_job(self, job_id: str) -> Dict[str, Any]:
@@ -171,7 +178,7 @@ class InferenceClient:
                 {"type": job_type, "params": params,
                  "timeout_seconds": timeout_s, **extra},
                 timeout=timeout_s + 15.0,
-                retry_transport=False,
+                idempotent=False,
             )
             data = resp.json()
             if data.get("status") != "completed":

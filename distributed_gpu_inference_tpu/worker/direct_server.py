@@ -48,11 +48,24 @@ class DirectServer:
             body = await request.json()
         except ValueError:
             return web.json_response({"detail": "invalid JSON"}, status=400)
+        if not isinstance(body, dict):
+            return web.json_response(
+                {"detail": "body must be a JSON object"}, status=400
+            )
         task_type = body.get("type", "llm")
         engine = self.worker.engines.get(task_type)
         if engine is None:
             return web.json_response(
                 {"detail": f"task type {task_type!r} not loaded"}, status=404
+            )
+        # load control applies to direct traffic too — the volunteer's caps
+        # (working hours, cooldown, hourly budget) must hold no matter which
+        # path the job takes
+        accept = getattr(self.worker, "should_accept_job", None)
+        if accept is not None and not accept({"type": task_type}):
+            self.stats["rejected"] += 1
+            return web.json_response(
+                {"detail": "declined by load control"}, status=503
             )
         # atomically claim the worker (IDLE→BUSY): a second direct request,
         # or the queue poll loop, sees BUSY and backs off — engines are never
@@ -64,6 +77,7 @@ class DirectServer:
                 {"detail": f"worker {self.worker.state.value}"}, status=503
             )
         self.stats["requests"] += 1
+        started = time.time()
         loop = asyncio.get_running_loop()
         try:
             result = await loop.run_in_executor(
@@ -72,6 +86,9 @@ class DirectServer:
         except Exception as exc:  # noqa: BLE001 - surface as a job error
             return web.json_response({"detail": str(exc)}, status=500)
         finally:
+            note = getattr(self.worker, "note_job_done", None)
+            if note is not None:
+                note(started)
             self.worker.end_job()
         return web.json_response({"result": result})
 
@@ -110,7 +127,8 @@ class DirectServer:
             raise RuntimeError("direct server failed to start")
 
     def stop(self) -> None:
-        if self._loop is not None:
+        if self._loop is not None and not self._loop.is_closed():
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+            self._thread = None

@@ -103,6 +103,7 @@ class Worker:
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._hour_window: List[float] = []       # job-start times, rolling hour
         self._last_job_done_at = 0.0
+        self._released_once: set = set()          # jobs we declined once
         self._rng = random.Random(0xC0FFEE)
         self.stats: Dict[str, Any] = {
             "jobs_completed": 0, "jobs_failed": 0, "jobs_rejected": 0,
@@ -223,8 +224,10 @@ class Worker:
 
     # -- load control (reference worker_config.py:195, main loop gates) ------
 
-    def should_accept_job(self, job: Dict[str, Any],
-                          now: Optional[float] = None) -> bool:
+    def gates_open(self, now: Optional[float] = None) -> bool:
+        """Job-independent load-control gates, checked BEFORE claiming a job
+        so a gated worker never pulls work it will bounce back (working
+        hours, cooldown, hourly cap, global acceptance sampling)."""
         lc = self.config.load_control
         now = time.time() if now is None else now
         if lc.working_hours:
@@ -243,11 +246,31 @@ class Worker:
             self._hour_window = [t for t in self._hour_window if now - t < 3600]
             if len(self._hour_window) >= lc.max_jobs_per_hour:
                 return False
-        rate = lc.acceptance_rate
-        weight = lc.job_type_weights.get(job.get("type", ""), 1.0)
-        if rate * weight < 1.0 and self._rng.random() > rate * weight:
+        if lc.acceptance_rate < 1.0 and self._rng.random() > lc.acceptance_rate:
             return False
         return True
+
+    def should_accept_job(self, job: Dict[str, Any],
+                          now: Optional[float] = None) -> bool:
+        """Full admission check (gates + per-type weight). The type-weight
+        throttle is one-shot per job: a job this worker already released once
+        is accepted on re-encounter, so a probabilistic throttle can delay
+        head-of-queue work but never starve it (release→re-claim ping-pong)."""
+        if not self.gates_open(now=now):
+            return False
+        lc = self.config.load_control
+        job_id = job.get("id")
+        if job_id and job_id in self._released_once:
+            return True
+        weight = lc.job_type_weights.get(job.get("type", ""), 1.0)
+        if weight < 1.0 and self._rng.random() > weight:
+            return False
+        return True
+
+    def note_job_done(self, started: float) -> None:
+        """Load-control bookkeeping shared by queued AND direct jobs."""
+        self._last_job_done_at = time.time()
+        self._hour_window.append(started)
 
     # -- busy-state acquisition (poll loop vs direct server) -----------------
 
@@ -289,13 +312,14 @@ class Worker:
                 log.error("could not report failure for job %s", job_id)
             self.stats["jobs_failed"] += 1
         finally:
-            self._last_job_done_at = time.time()
-            self._hour_window.append(started)
+            self.note_job_done(started)
             self.current_job_id = None
             self.end_job()
 
     def _poll_once(self) -> bool:
         """One poll iteration; returns True if a job was processed."""
+        if not self.gates_open():  # gated: don't even claim work
+            return False
         if not self.try_begin_job():  # direct inference in flight / draining
             return False
         job = None
@@ -308,13 +332,16 @@ class Worker:
             return False
         if not self.should_accept_job(job):
             self.stats["jobs_rejected"] += 1
+            self._released_once.add(job["id"])
             try:
-                # requeue, don't fail: another worker can run it
+                # requeue, don't fail: another worker can run it, and WE will
+                # take it if it comes back (one-shot throttle, no starvation)
                 self.api.release_job(job["id"])
             except APIError:
                 pass
             self.end_job()
             return False
+        self._released_once.discard(job["id"])
         self.process_job(job)
         return True
 

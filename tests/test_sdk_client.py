@@ -84,21 +84,34 @@ def test_4xx_raises_immediately_no_retry():
     assert len(hits) == 1
 
 
-def test_5xx_retries_with_backoff_then_next_server():
+def test_5xx_retries_idempotent_get_then_next_server():
     hits: List[str] = []
 
     def handler(req: httpx.Request) -> httpx.Response:
         hits.append(str(req.url.host))
         if req.url.host == "s1":
             return httpx.Response(500, text="boom")
-        return httpx.Response(
-            200, json={"job_id": "j", "status": "completed",
-                       "result": {"ok": True}},
-        )
+        return httpx.Response(200, json={"queued": 1})
 
     c = _client(handler, servers=["http://s1", "http://s2"], max_retries=2)
-    assert c.chat(prompt="x")["ok"] is True
+    assert c.queue_stats()["queued"] == 1
     assert hits.count("s1") == 3  # initial + 2 retries
+
+
+def test_sync_job_not_retried_on_5xx():
+    """A 5xx after the server may have EXECUTED the job must not re-POST it
+    (duplicate inference/billing)."""
+    hits: List[int] = []
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        hits.append(1)
+        return httpx.Response(502, text="gateway died mid-response")
+
+    c = _client(handler, servers=["http://s1", "http://s2"], max_retries=2)
+    with pytest.raises(InferenceClientError) as ei:
+        c.chat(prompt="x")  # sync path → POST /jobs/sync
+    assert ei.value.status == 502
+    assert len(hits) == 1  # exactly one send: no retry, no server failover
 
 
 def test_async_job_create_wait():

@@ -94,6 +94,37 @@ def test_503_when_busy_or_draining():
     run(body())
 
 
+def test_non_object_body_400():
+    async def body():
+        w = FakeWorker()
+        client, _ = await make_client(w)
+        r = await client.post("/inference", json=[1, 2, 3])
+        assert r.status == 400
+        await client.close()
+
+    run(body())
+
+
+def test_load_control_applies_to_direct_traffic():
+    async def body():
+        w = FakeWorker()
+        w.accept = False
+        w.should_accept_job = lambda job: w.accept
+        w.noted = []
+        w.note_job_done = w.noted.append
+        client, ds = await make_client(w)
+        r = await client.post("/inference", json={"type": "llm"})
+        assert r.status == 503
+        assert ds.stats["rejected"] == 1
+        w.accept = True
+        r = await client.post("/inference", json={"type": "llm"})
+        assert r.status == 200
+        assert len(w.noted) == 1       # bookkeeping recorded for direct jobs
+        await client.close()
+
+    run(body())
+
+
 def test_unknown_task_type_404():
     async def body():
         w = FakeWorker()

@@ -295,13 +295,44 @@ def test_load_control_working_hours():
     assert w.should_accept_job({"type": "llm"}) is True
 
 
+def test_gated_worker_never_claims():
+    """Job-independent gates are checked BEFORE fetching, so a gated worker
+    doesn't claim-and-release head-of-queue work."""
+    api = FakeAPI(creds_valid=True,
+                  jobs=[{"id": "jx", "type": "llm", "params": {}}])
+    w = _worker(api)
+    w.load_engines()
+    w.state = WorkerState.IDLE
+    w.config.load_control.acceptance_rate = 0.0
+    assert w._poll_once() is False
+    assert "poll" not in api.calls          # never even fetched
+    assert api.jobs                          # job still queued
+
+
+def test_type_weight_release_is_one_shot():
+    """A job released once by the probabilistic type throttle is ACCEPTED on
+    re-encounter — no release/re-claim ping-pong starvation."""
+    api = FakeAPI(creds_valid=True,
+                  jobs=[{"id": "jw", "type": "llm", "params": {}},
+                        {"id": "jw", "type": "llm", "params": {}}])
+    w = _worker(api)
+    w.load_engines()
+    w.state = WorkerState.IDLE
+    w.config.load_control.job_type_weights = {"llm": 0.0}  # always throttle
+    assert w._poll_once() is False
+    assert api.released == ["jw"]
+    # the same job comes back: taken this time
+    assert w._poll_once() is True
+    assert api.completed[0]["job_id"] == "jw"
+
+
 def test_rejected_job_released_not_failed():
     api = FakeAPI(creds_valid=True,
                   jobs=[{"id": "jr", "type": "llm", "params": {}}])
     w = _worker(api)
     w.load_engines()
     w.state = WorkerState.IDLE
-    w.config.load_control.acceptance_rate = 0.0
+    w.config.load_control.job_type_weights = {"llm": 0.0}
     assert w._poll_once() is False
     assert w.stats["jobs_rejected"] == 1
     # requeued for other workers — NOT completed as failed
