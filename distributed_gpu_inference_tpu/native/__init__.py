@@ -1,0 +1,117 @@
+"""First-party native (C++) runtime components with build-on-demand.
+
+The reference ships zero first-party native code — its native surface lives
+in vLLM/SGLang/grpcio (SURVEY §2.3). Here the performance-critical HOST-side
+runtime pieces are first-party C++ compiled at first use with the system
+toolchain and loaded over ctypes; every component has an exact-semantics
+Python fallback, so the framework works (slower) without a compiler.
+
+Components:
+- ``radix_index.cpp`` — prefix-cache radix tree (scheduler hot path); Python
+  fallback: ``runtime.kv_cache.RadixPrefixIndex``. Perf profile (1-core CI
+  box): ~8-19x faster than the fallback when token ids arrive as numpy
+  int32 arrays (zero-copy across the ABI), break-even on short Python lists
+  where ``array('i', ...)`` conversion dominates — pass arrays on hot paths.
+
+Set ``TPU_NATIVE=0`` to force the Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("tpu_native")
+
+_SRC_DIR = Path(__file__).parent / "src"
+_BUILD_DIR = Path(
+    os.environ.get("TPU_NATIVE_BUILD_DIR", Path(__file__).parent / "_build")
+)
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _compile(src: Path, out: Path) -> bool:
+    out.parent.mkdir(parents=True, exist_ok=True)
+    # build to a temp name then atomic-rename: concurrent importers must
+    # never dlopen a half-written .so
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(out.parent))
+    os.close(fd)
+    cmd = [
+        os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-shared",
+        "-fPIC", "-o", tmp, str(src),
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        log.warning("native build failed to run: %s", exc)
+        os.unlink(tmp)
+        return False
+    if proc.returncode != 0:
+        log.warning("native build failed:\n%s", proc.stderr[-2000:])
+        os.unlink(tmp)
+        return False
+    os.replace(tmp, out)
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("TPU_NATIVE", "1") == "0":
+        return None
+    src = _SRC_DIR / "radix_index.cpp"
+    out = _BUILD_DIR / "libtpu_native.so"
+    # a prebuilt .so without sources (shipped wheel) must load as-is
+    stale = src.exists() and (
+        not out.exists() or out.stat().st_mtime < src.stat().st_mtime
+    )
+    if stale and not _compile(src, out):
+        return None
+    if not out.exists():
+        return None
+    try:
+        lib = ctypes.CDLL(str(out))
+    except OSError as exc:
+        log.warning("could not load native library: %s", exc)
+        return None
+    # signatures
+    lib.radix_new.argtypes = [ctypes.c_int]
+    lib.radix_new.restype = ctypes.c_void_p
+    lib.radix_destroy.argtypes = [ctypes.c_void_p]
+    lib.radix_match.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.radix_match.restype = ctypes.c_int64
+    lib.radix_insert.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.radix_insert.restype = ctypes.c_int64
+    for name in ("radix_contains", "radix_is_leaf", "radix_remove"):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        fn.restype = ctypes.c_int
+    lib.radix_size.argtypes = [ctypes.c_void_p]
+    lib.radix_size.restype = ctypes.c_int64
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    return _load()

@@ -1,0 +1,91 @@
+"""ctypes wrapper exposing the C++ radix index with the exact interface of
+``runtime.kv_cache.RadixPrefixIndex`` (drop-in behind make_radix_index)."""
+
+from __future__ import annotations
+
+import ctypes
+from array import array
+from typing import List, Sequence
+
+import numpy as np
+
+from . import get_lib
+
+_I32P = ctypes.POINTER(ctypes.c_int32)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+def _as_i32(token_ids: Sequence[int]):
+    """Cheapest bulk path to a C int32 buffer: zero-copy for numpy/array
+    inputs, one C-level pass for Python lists."""
+    if isinstance(token_ids, np.ndarray):
+        a = np.ascontiguousarray(token_ids, dtype=np.int32)
+        return a, a.ctypes.data_as(_I32P), a.size
+    a = array("i", token_ids)
+    ptr = (ctypes.c_int32 * len(a)).from_buffer(a)
+    return a, ctypes.cast(ptr, _I32P), len(a)
+
+
+class NativeRadixPrefixIndex:
+    """C++-backed prefix index; see src/radix_index.cpp.
+
+    Marshaling note: token/block sequences cross the boundary as numpy
+    buffers (C-converted in bulk) — a per-element ctypes splat costs more
+    than the whole C++ traversal saves. Callers that already hold numpy
+    int32 arrays cross zero-copy (``wants_arrays`` advertises this).
+    """
+
+    wants_arrays = True
+
+    def __init__(self, block_size: int) -> None:
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.block_size = block_size
+        self._h = lib.radix_new(block_size)
+        if not self._h:
+            raise RuntimeError("radix_new failed")
+
+    def __del__(self) -> None:  # pragma: no cover - interpreter teardown
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_h", None)
+        if lib is not None and h:
+            try:
+                lib.radix_destroy(h)
+            except Exception:
+                pass
+            self._h = None
+
+    def match_prefix(self, token_ids: Sequence[int]) -> List[int]:
+        keep, ptr, n = _as_i32(token_ids)
+        max_out = max(1, n // self.block_size)
+        out = np.empty(max_out, dtype=np.int64)
+        got = self._lib.radix_match(
+            self._h, ptr, n, out.ctypes.data_as(_I64P), max_out,
+        )
+        del keep
+        return out[:got].tolist()
+
+    def insert(self, token_ids: Sequence[int], block_ids: Sequence[int]) -> int:
+        keep, ptr, n = _as_i32(token_ids)
+        blocks = np.ascontiguousarray(block_ids, dtype=np.int64)
+        res = int(self._lib.radix_insert(
+            self._h, ptr, n, blocks.ctypes.data_as(_I64P), blocks.size,
+        ))
+        del keep
+        return res
+
+    def contains_block(self, block_id: int) -> bool:
+        return bool(self._lib.radix_contains(self._h, int(block_id)))
+
+    def is_leaf(self, block_id: int) -> bool:
+        return bool(self._lib.radix_is_leaf(self._h, int(block_id)))
+
+    def remove_block(self, block_id: int) -> None:
+        rc = self._lib.radix_remove(self._h, int(block_id))
+        if rc == -1:
+            raise ValueError(f"cannot evict interior radix block {block_id}")
+
+    def __len__(self) -> int:
+        return int(self._lib.radix_size(self._h))

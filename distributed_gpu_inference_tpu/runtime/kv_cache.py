@@ -22,6 +22,7 @@ RadixAttention-style prefix sharing the reference rents from SGLang
 
 from __future__ import annotations
 
+import logging
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -142,6 +143,32 @@ class RadixPrefixIndex:
         del self._nodes_by_block[block_id]
         assert node.parent is not None
         del node.parent.children[node.edge]  # type: ignore[index]
+
+    def __len__(self) -> int:
+        return len(self._nodes_by_block)
+
+
+def make_radix_index(block_size: int = KV_BLOCK_TOKENS,
+                     prefer_native: bool = True):
+    """Prefix index factory: C++ implementation when the native library is
+    buildable/loadable (``native/src/radix_index.cpp``), exact-semantics
+    Python fallback otherwise. ``TPU_NATIVE=0`` forces the fallback."""
+    if prefer_native:
+        try:
+            from distributed_gpu_inference_tpu.native import native_available
+
+            if native_available():
+                from distributed_gpu_inference_tpu.native.radix import (
+                    NativeRadixPrefixIndex,
+                )
+
+                return NativeRadixPrefixIndex(block_size)
+        except Exception as exc:  # any native issue → fallback, but say so
+            logging.getLogger("tpu_native").warning(
+                "native radix index unavailable, using Python fallback: %s",
+                exc,
+            )
+    return RadixPrefixIndex(block_size)
 
 
 @dataclass
@@ -267,7 +294,7 @@ class PagedKVCacheManager:
         self.metas: Dict[int, KVBlockMeta] = {}
         self.free_list: List[int] = list(range(num_blocks - 1, 0, -1))  # pop() → 1..
         self.cached_lru: "OrderedDict[int, None]" = OrderedDict()  # ref==0, indexed
-        self.radix = RadixPrefixIndex(block_size)
+        self.radix = make_radix_index(block_size)
         self.seq_blocks: Dict[str, List[int]] = {}
         self.seq_tokens: Dict[str, List[int]] = {}
         self.seq_shared_count: Dict[str, int] = {}
@@ -324,7 +351,11 @@ class PagedKVCacheManager:
         """
         if seq_id in self.seq_blocks:
             raise ValueError(f"sequence {seq_id} already allocated")
-        token_ids = list(token_ids)
+        # probe the radix index with the CALLER's representation: a numpy
+        # array crosses the native ABI zero-copy (the fast path — engines and
+        # tokenizers should pass arrays); only the stored copy is a list
+        probe = token_ids
+        token_ids = [int(t) for t in token_ids]
         n_tokens = len(token_ids)
         needed_blocks = max(1, -(-n_tokens // self.block_size))
 
@@ -332,7 +363,7 @@ class PagedKVCacheManager:
         if self.enable_prefix_cache:
             self.stats.prefix_queries += 1
             self.stats.prefix_total_tokens += n_tokens
-            cached = self.radix.match_prefix(token_ids)
+            cached = self.radix.match_prefix(probe)
             # never reuse the *entire* prompt from cache: the last token's
             # logits must be recomputed, so keep at least one token fresh
             while cached and len(cached) * self.block_size >= n_tokens:
@@ -443,7 +474,11 @@ class PagedKVCacheManager:
         self.seq_shared_count.pop(seq_id, None)
         n_full = len(tokens) // self.block_size
         if cache and self.enable_prefix_cache and n_full > 0:
-            self.radix.insert(tokens, blocks[:n_full])
+            idx_tokens: Sequence[int] = tokens
+            if getattr(self.radix, "wants_arrays", False):
+                # one bulk conversion → zero-copy across the native ABI
+                idx_tokens = np.asarray(tokens, np.int32)
+            self.radix.insert(idx_tokens, blocks[:n_full])
         for i, bid in enumerate(blocks):
             meta = self.metas.get(bid)
             if meta is None:
