@@ -1,0 +1,95 @@
+"""Shared building blocks for the non-causal transformer encoders
+(ViT, DiT, ASR): LayerNorm, fan-in init, bidirectional attention block,
+patchify/unpatchify. One implementation — the three encoders must not
+drift on eps/head-reshape details.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def fan_in_init(key: jax.Array, shape: Tuple[int, ...], fan_in: int,
+                dtype=jnp.float32) -> jax.Array:
+    return (jax.random.normal(key, shape, jnp.float32) * fan_in**-0.5
+            ).astype(dtype)
+
+
+def layer_norm(v: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+    mean = v.mean(-1, keepdims=True)
+    var = v.var(-1, keepdims=True)
+    return (v - mean) * lax.rsqrt(var + eps) * w
+
+
+def init_encoder_layers(key: jax.Array, num_layers: int, hidden: int,
+                        mlp_ratio: int = 4, dtype=jnp.float32
+                        ) -> Dict[str, jax.Array]:
+    """Stacked (leading L axis) params for ``encoder_block`` under lax.scan."""
+    ks = jax.random.split(key, 4)
+    L, h = num_layers, hidden
+    return {
+        "norm1": jnp.ones((L, h), dtype),
+        "wqkv": fan_in_init(ks[0], (L, h, h * 3), h, dtype),
+        "wo": fan_in_init(ks[1], (L, h, h), h, dtype),
+        "norm2": jnp.ones((L, h), dtype),
+        "w1": fan_in_init(ks[2], (L, h, h * mlp_ratio), h, dtype),
+        "w2": fan_in_init(ks[3], (L, h * mlp_ratio, h), h * mlp_ratio, dtype),
+    }
+
+
+def mha(x: jax.Array, wqkv: jax.Array, wo: jax.Array,
+        num_heads: int) -> jax.Array:
+    """Bidirectional multi-head self-attention over [B, N, H]."""
+    b, n, h = x.shape
+    hd = h // num_heads
+    q, k, v = jnp.split(x @ wqkv, 3, axis=-1)
+
+    def heads(z):
+        return z.reshape(b, n, num_heads, hd).transpose(0, 2, 1, 3)
+
+    attn = jax.nn.softmax(
+        (heads(q) @ heads(k).transpose(0, 1, 3, 2)) / math.sqrt(hd), -1
+    )
+    return (attn @ heads(v)).transpose(0, 2, 1, 3).reshape(b, n, h) @ wo
+
+
+def encoder_block(x: jax.Array, lp: Dict[str, jax.Array],
+                  num_heads: int) -> jax.Array:
+    """Pre-norm transformer encoder block (attention + GELU MLP)."""
+    x = x + mha(layer_norm(x, lp["norm1"]), lp["wqkv"], lp["wo"], num_heads)
+    y = layer_norm(x, lp["norm2"])
+    return x + jax.nn.gelu(y @ lp["w1"]) @ lp["w2"]
+
+
+def run_encoder(x: jax.Array, layers: Dict[str, jax.Array],
+                num_heads: int) -> jax.Array:
+    """All encoder blocks under one lax.scan (stacked-L params)."""
+
+    def step(h, lp):
+        return encoder_block(h, lp, num_heads), None
+
+    out, _ = lax.scan(step, x, layers)
+    return out
+
+
+def patchify(img: jax.Array, patch: int) -> jax.Array:
+    """[B, S, S, C] → [B, (S/p)^2, p*p*C]."""
+    b, s, _, c = img.shape
+    g = s // patch
+    x = img.reshape(b, g, patch, g, patch, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, patch * patch * c)
+
+
+def unpatchify(x: jax.Array, image_size: int, patch: int,
+               channels: int) -> jax.Array:
+    b = x.shape[0]
+    g = image_size // patch
+    x = x.reshape(b, g, g, patch, patch, channels)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, image_size, image_size, channels
+    )

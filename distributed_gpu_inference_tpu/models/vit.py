@@ -1,0 +1,95 @@
+"""ViT image encoder for the vision-language engine.
+
+TPU-native counterpart of the reference's GLM-4V-style VLM backbone
+(``worker/engines/vision.py`` loads a HF vision-language checkpoint): here
+the VLM is composed first-party — this patch-transformer encodes the image
+into ``num_prefix`` soft tokens projected into the Llama decoder's hidden
+space, which enter the decoder as a hidden-state prefix through
+``llama.forward_hidden_chunk`` (no tokenizer involvement, one jitted graph).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from distributed_gpu_inference_tpu.models.encoder_common import (
+    fan_in_init,
+    init_encoder_layers,
+    layer_norm,
+    patchify,
+    run_encoder,
+)
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    name: str = "tiny-vit"
+    image_size: int = 32
+    channels: int = 3
+    patch_size: int = 4
+    hidden_size: int = 128
+    num_layers: int = 4
+    num_heads: int = 4
+    out_dim: int = 64            # llama hidden size to project into
+    num_prefix: int = 8          # soft tokens handed to the decoder
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+
+VIT_REGISTRY: Dict[str, ViTConfig] = {
+    "tiny-vit": ViTConfig(),
+    "small-vit": ViTConfig(
+        name="small-vit", image_size=224, patch_size=16, hidden_size=384,
+        num_layers=12, num_heads=6, out_dim=2048, num_prefix=64,
+    ),
+}
+
+
+def get_vit_config(name: str) -> ViTConfig:
+    if name not in VIT_REGISTRY:
+        raise KeyError(f"unknown vit model {name!r}")
+    return VIT_REGISTRY[name]
+
+
+def init_params(cfg: ViTConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    h = cfg.hidden_size
+    ks = jax.random.split(key, 5)
+    return {
+        "patch_proj": fan_in_init(ks[0], (cfg.patch_dim, h), cfg.patch_dim,
+                                  dtype),
+        "pos_emb": fan_in_init(ks[1], (cfg.num_patches, h), h, dtype),
+        "query_emb": fan_in_init(ks[2], (cfg.num_prefix, h), h, dtype),
+        "layers": init_encoder_layers(ks[3], cfg.num_layers, h, dtype=dtype),
+        "out_norm": jnp.ones((h,), dtype),
+        "out_proj": fan_in_init(ks[4], (h, cfg.out_dim), h, dtype),
+    }
+
+
+def encode_image(cfg: ViTConfig, params: Params,
+                 images: jax.Array) -> jax.Array:
+    """[B, H, W, C] in [0,1] → [B, num_prefix, out_dim] decoder prefix."""
+    b = images.shape[0]
+    x = patchify(images, cfg.patch_size)
+    x = x @ params["patch_proj"] + params["pos_emb"][None]
+    # perceiver-style: prepend learned queries; after the encoder, only the
+    # query positions feed the decoder (fixed prefix length, static shapes)
+    q = jnp.broadcast_to(
+        params["query_emb"][None], (b,) + params["query_emb"].shape
+    )
+    x = jnp.concatenate([q, x], axis=1)
+    x = run_encoder(x, params["layers"], cfg.num_heads)
+    return layer_norm(
+        x[:, : cfg.num_prefix], params["out_norm"]
+    ) @ params["out_proj"]

@@ -23,6 +23,7 @@ import logging
 import os
 import subprocess
 import tempfile
+import threading
 from pathlib import Path
 from typing import Optional
 
@@ -35,39 +36,60 @@ _BUILD_DIR = Path(
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
+_load_lock = threading.Lock()
 
 
 def _compile(src: Path, out: Path) -> bool:
-    out.parent.mkdir(parents=True, exist_ok=True)
-    # build to a temp name then atomic-rename: concurrent importers must
-    # never dlopen a half-written .so
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(out.parent))
-    os.close(fd)
-    cmd = [
-        os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-shared",
-        "-fPIC", "-o", tmp, str(src),
-    ]
+    tmp = None
     try:
+        out.parent.mkdir(parents=True, exist_ok=True)
+        # build to a temp name then atomic-rename: concurrent importers must
+        # never dlopen a half-written .so
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=str(out.parent))
+        os.close(fd)
+        cmd = [
+            os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-shared",
+            "-fPIC", "-o", tmp, str(src),
+        ]
         proc = subprocess.run(
             cmd, capture_output=True, text=True, timeout=120
         )
+        if proc.returncode != 0:
+            log.warning("native build failed:\n%s", proc.stderr[-2000:])
+            return False
+        os.replace(tmp, out)
+        tmp = None
+        return True
     except (OSError, subprocess.TimeoutExpired) as exc:
-        log.warning("native build failed to run: %s", exc)
-        os.unlink(tmp)
+        # read-only install dir, missing toolchain, … → Python fallback
+        log.warning("native build unavailable: %s", exc)
         return False
-    if proc.returncode != 0:
-        log.warning("native build failed:\n%s", proc.stderr[-2000:])
-        os.unlink(tmp)
-        return False
-    os.replace(tmp, out)
-    return True
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _lib_tried
     if _lib_tried:
         return _lib
-    _lib_tried = True
+    with _load_lock:
+        if _lib_tried:  # lost the race: winner already initialized
+            return _lib
+        try:
+            lib = _load_locked()
+        except Exception as exc:  # e.g. stale .so missing a symbol
+            log.warning("native load failed (cached as unavailable): %s", exc)
+            lib = None
+        _lib = lib
+        _lib_tried = True  # success OR failure is cached: probe runs once
+        return _lib
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
     if os.environ.get("TPU_NATIVE", "1") == "0":
         return None
     src = _SRC_DIR / "radix_index.cpp"
@@ -105,12 +127,15 @@ def _load() -> Optional[ctypes.CDLL]:
         fn.restype = ctypes.c_int
     lib.radix_size.argtypes = [ctypes.c_void_p]
     lib.radix_size.restype = ctypes.c_int64
-    _lib = lib
-    return _lib
+    return lib
 
 
 def native_available() -> bool:
-    return _load() is not None
+    try:
+        return _load() is not None
+    except Exception as exc:  # contract: boolean, never raises
+        log.warning("native probe failed: %s", exc)
+        return False
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

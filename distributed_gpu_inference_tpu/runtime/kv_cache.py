@@ -355,7 +355,10 @@ class PagedKVCacheManager:
         # array crosses the native ABI zero-copy (the fast path — engines and
         # tokenizers should pass arrays); only the stored copy is a list
         probe = token_ids
-        token_ids = [int(t) for t in token_ids]
+        if isinstance(token_ids, np.ndarray):
+            token_ids = token_ids.tolist()  # one C pass, python ints out
+        else:
+            token_ids = [int(t) for t in token_ids]
         n_tokens = len(token_ids)
         needed_blocks = max(1, -(-n_tokens // self.block_size))
 

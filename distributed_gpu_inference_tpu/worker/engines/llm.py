@@ -47,8 +47,11 @@ class ByteTokenizer:
         return [b + self._offset for b in text.encode("utf-8")]
 
     def decode(self, ids: List[int]) -> str:
+        # ids beyond the byte range (models with vocab > 256+offset emit
+        # them under random weights) are dropped, not crashed on
         data = bytes(
-            i - self._offset for i in ids if i >= self._offset
+            i - self._offset for i in ids
+            if self._offset <= i < self._offset + 256
         )
         return data.decode("utf-8", errors="replace")
 

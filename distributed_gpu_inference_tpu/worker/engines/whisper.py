@@ -1,0 +1,124 @@
+"""ASR engine: log-mel → jitted CTC encoder → greedy collapse → text.
+
+Parity surface: the reference's ``whisper`` task family (scheduled by job
+type, audio arrives base64). Accepts base64 WAV (stdlib ``wave``), base64
+raw float32 PCM (``pcm_f32``), or a plain list of samples; resamples
+nothing — callers send 16 kHz mono like the reference's whisper jobs.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import time
+import wave
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .base import BaseEngine, EngineLoadError
+
+
+def _decode_audio(params: Dict[str, Any], sample_rate: int) -> np.ndarray:
+    """→ float32 PCM in [-1, 1], mono."""
+    if "samples" in params:
+        return np.asarray(params["samples"], np.float32)
+    fmt = params.get("audio_format", "wav")
+    if "audio" not in params:
+        raise ValueError("provide 'audio' (base64) or 'samples'")
+    raw = base64.b64decode(params["audio"])
+    if fmt == "pcm_f32":
+        return np.frombuffer(raw, np.float32).copy()
+    with wave.open(io.BytesIO(raw), "rb") as w:
+        if w.getframerate() != sample_rate:
+            raise ValueError(
+                f"expected {sample_rate} Hz audio, got {w.getframerate()}"
+            )
+        data = w.readframes(w.getnframes())
+        width = w.getsampwidth()
+        if width == 2:
+            pcm = np.frombuffer(data, np.int16).astype(np.float32) / 32768.0
+        elif width == 4:
+            pcm = np.frombuffer(data, np.int32).astype(np.float32) / 2**31
+        else:
+            raise ValueError(f"unsupported sample width {width}")
+        if w.getnchannels() > 1:
+            pcm = pcm.reshape(-1, w.getnchannels()).mean(axis=1)
+        return pcm
+
+
+class WhisperEngine(BaseEngine):
+    """config keys: model (asr registry name), checkpoint_path."""
+
+    task_type = "whisper"
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
+        super().__init__(config)
+        self._cfg = None
+        self._params = None
+        self._encode_jit = None
+        self._tokenizer = None
+
+    def load_model(self) -> None:
+        import jax
+
+        from ...models import asr
+
+        model = self.config.get("model", "tiny-whisper")
+        try:
+            self._cfg = asr.get_asr_config(model)
+        except KeyError as exc:
+            raise EngineLoadError(str(exc)) from exc
+        self._params = asr.init_params(
+            self._cfg, jax.random.PRNGKey(int(self.config.get("seed", 0)))
+        )
+        ckpt = self.config.get("checkpoint_path")
+        if ckpt:
+            from ...models.loader import load_checkpoint
+
+            self._params = load_checkpoint(ckpt, template=self._params)
+
+        cfg = self._cfg
+
+        def run(p, mel):
+            return asr.encode(cfg, p, mel)
+
+        self._encode_jit = jax.jit(run)
+        from .llm import ByteTokenizer
+
+        self._tokenizer = ByteTokenizer()
+        self.model_name = model
+        self.loaded = True
+
+    def inference(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        from ...models import asr
+
+        if self._params is None:
+            raise RuntimeError("model not loaded")
+        t0 = time.time()
+        pcm = _decode_audio(params, self._cfg.sample_rate)
+        duration_s = len(pcm) / self._cfg.sample_rate
+        # fixed-shape window: pad or truncate to the model's horizon
+        n = self._cfg.max_samples
+        if len(pcm) >= n:
+            pcm = pcm[:n]
+        else:
+            pcm = np.pad(pcm, (0, n - len(pcm)))
+        mel = asr.log_mel(self._cfg, pcm[None, :])
+        logits = np.asarray(self._encode_jit(self._params, jnp.asarray(mel)))
+        ids = asr.ctc_greedy_decode(logits)[0]
+        text = self._tokenizer.decode(ids)
+        return {
+            "text": text,
+            "language": params.get("language", "en"),
+            "duration_seconds": duration_s,
+            "usage": {"audio_seconds": duration_s},
+            "latency_ms": (time.time() - t0) * 1000.0,
+        }
+
+    def unload(self) -> None:
+        self._params = None
+        self._encode_jit = None
+        self.loaded = False
