@@ -1,0 +1,72 @@
+"""Shared benchmark helpers: percentile stats, request synthesis, JSON out.
+
+Metric definitions mirror the reference's harnesses (SURVEY §6): tokens/s,
+TTFT/E2E p50/p95/p99, prefix-cache hit rate, accept rate — so results are
+comparable in kind; unlike the reference's distributed/PD/speculative
+benchmarks (analytic simulators), every harness here drives REAL compute.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def add_platform_arg(ap) -> None:
+    """Shared --platform flag (all four harnesses)."""
+    ap.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. cpu) before backend init — a "
+        "TPU-tunnel plugin may otherwise pin the default",
+    )
+
+
+def resolve_backend_model(args, tpu_default: str = "llama3-1b",
+                          cpu_default: str = "llama3-mini"):
+    """Apply --platform, return (backend, model). One implementation so the
+    harnesses can't drift on platform/model selection."""
+    import jax
+
+    if getattr(args, "platform", None):
+        jax.config.update("jax_platforms", args.platform)
+    backend = jax.default_backend()
+    model = args.model or (tpu_default if backend == "tpu" else cpu_default)
+    return backend, model
+
+
+def percentiles(values: Sequence[float],
+                ps=(50, 95, 99)) -> Dict[str, Optional[float]]:
+    if not values:
+        return {f"p{p}": None for p in ps}
+    arr = np.asarray(sorted(values))
+    return {f"p{p}": round(float(np.percentile(arr, p)), 2) for p in ps}
+
+
+def synth_prompts(n: int, prompt_len: int, vocab: int, seed: int = 0,
+                  shared_prefix_len: int = 0) -> List[List[int]]:
+    """Random prompts, optionally sharing a common prefix (prefix-cache and
+    PD benchmarks need realistic system-prompt sharing)."""
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(1, vocab, shared_prefix_len).tolist() \
+        if shared_prefix_len else []
+    out = []
+    for _ in range(n):
+        rest = rng.integers(1, vocab, prompt_len - len(prefix)).tolist()
+        out.append(prefix + rest)
+    return out
+
+
+def emit(result: Dict[str, Any]) -> None:
+    print(json.dumps(result))
+
+
+class Timer:
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.t0

@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Prefill/decode disaggregation benchmark: hybrid vs separated, for real.
+
+Parity with the reference's ``benchmarks/pd_separation.py`` metrics (TTFT and
+TPOT, hybrid vs separated) — but the reference computes both from an analytic
+roofline model (:182-225); here both configurations RUN:
+
+- **hybrid**: one engine interleaves new prefills with ongoing decodes (the
+  classic interference regime — a long prefill stalls every decode step).
+- **separated**: a prefill engine and a decode engine; each finished prefill
+  migrates its KV to the decode engine over the real export→wire→adopt path
+  (``runtime/kv_handoff.py``), decodes run without prefill interference.
+
+Usage:
+    python -m benchmarks.pd_separation --requests 8 --prompt-len 128 \
+        --max-tokens 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import (
+    Timer,
+    add_platform_arg,
+    emit,
+    percentiles,
+    resolve_backend_model,
+    synth_prompts,
+)
+
+
+def _mk_engine(model, batch, max_seq, params=None, prefill_buckets=(128,)):
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        EngineConfig,
+        TPUEngine,
+    )
+
+    return TPUEngine(
+        model,
+        EngineConfig(
+            max_batch_size=batch, max_seq_len=max_seq,
+            prefill_buckets=prefill_buckets, enable_prefix_cache=False,
+        ),
+        params=params,
+    )
+
+
+def _req(p, max_tokens):
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+
+    return InferenceRequest(
+        prompt_token_ids=list(p),
+        sampling=SamplingParams(max_new_tokens=max_tokens),
+    )
+
+
+def run_hybrid(model, prompts, args, params):
+    """One engine, staggered arrivals: prefills interleave with decodes."""
+    eng = _mk_engine(model, args.requests, args.max_seq, params,
+                     (args.prompt_len,))
+    eng.generate([_req(prompts[0], 2)])  # warmup compile
+
+    ttfts, tpots = [], []
+    with Timer() as t:
+        for p in prompts:
+            # a new request arrives: prefill NOW (stalls ongoing decodes)
+            t0 = time.perf_counter()
+            eng.submit(_req(p, args.max_tokens))
+            ttfts.append((time.perf_counter() - t0) * 1000.0)
+            # run a few decode steps for everyone between arrivals
+            for _ in range(args.decode_per_arrival):
+                d0 = time.perf_counter()
+                out = eng.decode_step()
+                if out:
+                    tpots.append(
+                        (time.perf_counter() - d0) * 1000.0
+                    )
+        # drain
+        while eng.num_active:
+            d0 = time.perf_counter()
+            out = eng.decode_step()
+            if out:
+                tpots.append((time.perf_counter() - d0) * 1000.0)
+            for i, s in enumerate(list(eng.slots)):
+                if s is not None and s.finish_reason is not None:
+                    eng.finish_slot(i)
+    return ttfts, tpots, t.elapsed
+
+
+def run_separated(model, prompts, args, params):
+    """Prefill engine + decode engine + real KV migration between them."""
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        adopt_kv,
+        deserialize_handoff,
+        export_slot_kv,
+        serialize_handoff,
+    )
+
+    pre = _mk_engine(model, 2, args.max_seq, params, (args.prompt_len,))
+    dec = _mk_engine(model, args.requests, args.max_seq, pre.params,
+                     (args.prompt_len,))
+    pre.generate([_req(prompts[0], 2)])   # warmup both engines
+    dec.generate([_req(prompts[0], 2)])
+
+    ttfts, tpots, migrate_ms = [], [], []
+    with Timer() as t:
+        pending = list(prompts)
+        active = 0
+        while pending or active:
+            if pending:
+                p = pending.pop(0)
+                t0 = time.perf_counter()
+                slot = pre.submit(_req(p, args.max_tokens))
+                ttfts.append((time.perf_counter() - t0) * 1000.0)
+                m0 = time.perf_counter()
+                wire = serialize_handoff(export_slot_kv(pre, slot))
+                pre.finish_slot(slot, cache=False)
+                adopt_kv(dec, deserialize_handoff(wire))
+                migrate_ms.append((time.perf_counter() - m0) * 1000.0)
+                active += 1
+            # decode pool advances independently of prefill arrivals
+            for _ in range(args.decode_per_arrival):
+                d0 = time.perf_counter()
+                out = dec.decode_step()
+                if out:
+                    tpots.append((time.perf_counter() - d0) * 1000.0)
+            for i, s in enumerate(list(dec.slots)):
+                if s is not None and s.finish_reason is not None:
+                    dec.finish_slot(i)
+                    active -= 1
+            if not pending and not dec.num_active:
+                break
+    return ttfts, tpots, migrate_ms, t.elapsed
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--decode-per-arrival", type=int, default=4)
+    add_platform_arg(ap)
+    args = ap.parse_args()
+
+    import jax
+
+    backend, model = resolve_backend_model(args)
+    args.max_seq = args.prompt_len + args.max_tokens + 16
+
+    from distributed_gpu_inference_tpu.models import llama
+    from distributed_gpu_inference_tpu.models.configs import get_model_config
+
+    cfg = get_model_config(model)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    prompts = synth_prompts(args.requests, args.prompt_len, cfg.vocab_size)
+
+    hy_ttft, hy_tpot, hy_s = run_hybrid(model, prompts, args, params)
+    sep_ttft, sep_tpot, mig_ms, sep_s = run_separated(
+        model, prompts, args, params
+    )
+
+    hy = percentiles(hy_tpot)
+    sep = percentiles(sep_tpot)
+    emit({
+        "benchmark": "pd_separation",
+        "metric": "decode_tpot_p95_improvement",
+        "value": round(hy["p95"] / sep["p95"], 3)
+        if hy["p95"] and sep["p95"] else None,
+        "unit": "x (hybrid p95 TPOT / separated p95 TPOT)",
+        "model": model,
+        "backend": backend,
+        "requests": args.requests,
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "hybrid": {
+            "ttft_ms": percentiles(hy_ttft),
+            "tpot_ms": hy,
+            "elapsed_s": round(hy_s, 3),
+        },
+        "separated": {
+            "ttft_ms": percentiles(sep_ttft),
+            "tpot_ms": sep,
+            "migration_ms": percentiles(mig_ms),
+            "elapsed_s": round(sep_s, 3),
+        },
+    })
+
+
+if __name__ == "__main__":
+    main()

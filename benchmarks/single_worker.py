@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Single-worker serving benchmark: real engine, real tokens.
+
+Parity with ``benchmarks/single_worker.py`` in the reference (the only
+reference harness that drives real engines): decode tokens/s, TTFT and E2E
+p50/p95/p99, prefix-cache hit rate — measured over the continuous batcher
+at a given concurrency (reference defaults: 100 requests, 8 concurrent,
+256 max_tokens, :76-97).
+
+Usage:
+    python -m benchmarks.single_worker --model llama3-mini --requests 32 \
+        --concurrency 8 --prompt-len 128 --max-tokens 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks.common import (
+    Timer,
+    add_platform_arg,
+    emit,
+    percentiles,
+    resolve_backend_model,
+    synth_prompts,
+)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--shared-prefix", type=int, default=64,
+                    help="tokens of shared system prefix (prefix-cache hits)")
+    ap.add_argument("--no-prefix-cache", action="store_true")
+    add_platform_arg(ap)
+    args = ap.parse_args()
+
+    import jax
+
+    backend, model = resolve_backend_model(args)
+
+    from distributed_gpu_inference_tpu.runtime.batcher import (
+        BatcherConfig,
+        ContinuousBatcher,
+    )
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        EngineConfig,
+        TPUEngine,
+    )
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+
+    max_seq = args.prompt_len + args.max_tokens + 16
+    eng = TPUEngine(
+        model,
+        EngineConfig(
+            max_batch_size=args.concurrency,
+            max_seq_len=max_seq,
+            prefill_buckets=(args.prompt_len,),
+            enable_prefix_cache=not args.no_prefix_cache,
+        ),
+    )
+    prompts = synth_prompts(
+        args.requests, args.prompt_len, eng.model_cfg.vocab_size,
+        shared_prefix_len=args.shared_prefix,
+    )
+
+    def req(p):
+        return InferenceRequest(
+            prompt_token_ids=list(p),
+            sampling=SamplingParams(max_new_tokens=args.max_tokens),
+        )
+
+    # warmup compile (prefill bucket + decode graphs)
+    eng.generate([req(prompts[0])])
+
+    async def run():
+        batcher = ContinuousBatcher(
+            eng, BatcherConfig(default_timeout_s=600.0)
+        )
+        batcher.start()
+        sem = asyncio.Semaphore(args.concurrency)
+        results = []
+
+        async def one(p):
+            async with sem:
+                t0 = time.perf_counter()
+                resp = await batcher.submit(req(p))
+                return resp, (time.perf_counter() - t0) * 1000.0
+
+        with Timer() as t:
+            results = await asyncio.gather(*(one(p) for p in prompts))
+        await batcher.stop()
+        return results, t.elapsed
+
+    results, elapsed = asyncio.run(run())
+    resps = [r for r, _ in results]
+    e2es = [ms for _, ms in results]
+    ok = [r for r in resps if r.error is None]
+    decoded = sum(r.completion_tokens for r in ok)
+    ttfts = [r.ttft_ms for r in ok if r.ttft_ms is not None]
+    stats = eng.get_stats()
+
+    emit({
+        "benchmark": "single_worker",
+        "metric": "decode_tokens_per_s",
+        "value": round(decoded / elapsed, 2),
+        "unit": "tokens/s",
+        "model": model,
+        "backend": backend,
+        "requests": args.requests,
+        "ok": len(ok),
+        "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len,
+        "max_tokens": args.max_tokens,
+        "elapsed_s": round(elapsed, 3),
+        "requests_per_s": round(len(ok) / elapsed, 3),
+        "ttft_ms": percentiles(ttfts),
+        "e2e_ms": percentiles(e2es),
+        "prefix_hit_rate": round(
+            stats["kv_cache"].get("prefix_hit_rate", 0.0), 4
+        ),
+    })
+
+
+if __name__ == "__main__":
+    main()

@@ -226,7 +226,9 @@ def _pipeline_local(
         act_next = lax.ppermute(hidden, axis_name, fwd_perm)
         return (act_next, kv_out["k"], kv_out["v"], out_buf), None
 
-    act0 = jnp.zeros((mb, s_len, h), jnp.dtype(cfg.dtype))
+    # activation dtype follows the actual weights (callers may load params
+    # in a dtype other than the config default, e.g. float32 on CPU)
+    act0 = jnp.zeros((mb, s_len, h), params["embedding"].dtype)
     out0 = jnp.zeros((n_micro, mb, cfg.vocab_size), jnp.float32)
     (_, kv_k, kv_v, out_buf), _ = lax.scan(
         tick,

@@ -77,7 +77,9 @@ class ImageGenEngine(BaseEngine):
             if params.get("num_inference_steps") is not None
             else self.config.get("default_steps", 20)
         )
-        steps = max(1, steps)
+        # clamp: num_steps is a static jit arg (each distinct value compiles
+        # a sampler) and bounds per-request device work
+        steps = max(1, min(steps, int(self.config.get("max_steps", 250))))
         n = max(1, min(int(params.get("num_images", 1)), 4))
         guidance = float(
             params["guidance_scale"]

@@ -221,10 +221,12 @@ class VisionEngine(BaseEngine):
         tok = int(np.argmax(np.asarray(logits)[0]))
         eos = getattr(self._tokenizer, "eos_token_id", None)
         kv_len = seq
-        for _ in range(max_new):
+        while True:
             if tok == eos:
                 break
             out_ids.append(tok)
+            if len(out_ids) >= max_new:
+                break  # budget reached: don't pay a forward we'd discard
             kv_len += 1
             logits, self._kv = decode(
                 self._llm_params, self._kv,

@@ -100,9 +100,12 @@ class WhisperEngine(BaseEngine):
         t0 = time.time()
         pcm = _decode_audio(params, self._cfg.sample_rate)
         duration_s = len(pcm) / self._cfg.sample_rate
-        # fixed-shape window: pad or truncate to the model's horizon
+        # fixed-shape window: pad or truncate to the model's horizon; a
+        # truncated clip is reported (and billed) as such, never silently
         n = self._cfg.max_samples
-        if len(pcm) >= n:
+        truncated = len(pcm) > n
+        transcribed_s = min(duration_s, self._cfg.max_seconds)
+        if truncated:
             pcm = pcm[:n]
         else:
             pcm = np.pad(pcm, (0, n - len(pcm)))
@@ -114,7 +117,9 @@ class WhisperEngine(BaseEngine):
             "text": text,
             "language": params.get("language", "en"),
             "duration_seconds": duration_s,
-            "usage": {"audio_seconds": duration_s},
+            "transcribed_seconds": transcribed_s,
+            "truncated": truncated,
+            "usage": {"audio_seconds": transcribed_s},
             "latency_ms": (time.time() - t0) * 1000.0,
         }
 

@@ -1,0 +1,86 @@
+"""Benchmark harnesses stay runnable (tiny shapes, in-process).
+
+The reference's distributed/PD/speculative benchmarks are analytic
+simulators; ours drive real compute, so these smoke tests double as
+end-to-end exercises of batcher/pipeline/PD/speculative serving paths.
+"""
+
+import json
+import sys
+
+import pytest
+
+
+def _run(module_main, argv, capsys):
+    old = sys.argv
+    sys.argv = argv
+    try:
+        module_main()
+    finally:
+        sys.argv = old
+    out = capsys.readouterr().out.strip().splitlines()
+    return json.loads(out[-1])
+
+
+def test_single_worker_bench(capsys):
+    from benchmarks.single_worker import main
+
+    res = _run(main, [
+        "single_worker", "--model", "llama3-tiny", "--requests", "4",
+        "--concurrency", "2", "--prompt-len", "16", "--max-tokens", "8",
+        "--shared-prefix", "8",
+    ], capsys)
+    assert res["benchmark"] == "single_worker"
+    assert res["ok"] == 4
+    assert res["value"] > 0
+    assert res["ttft_ms"]["p50"] is not None
+
+
+def test_speculative_bench(capsys):
+    from benchmarks.speculative import main
+
+    res = _run(main, [
+        "speculative", "--model", "llama3-tiny", "--requests", "2",
+        "--prompt-len", "16", "--max-tokens", "12", "--widths", "2,2",
+    ], capsys)
+    assert res["benchmark"] == "speculative"
+    assert res["spec_tokens_per_s"] > 0
+    assert res["vanilla_tokens_per_s"] > 0
+    assert 0.0 <= res["accept_rate"] <= 1.0
+
+
+def test_distributed_http_bench(capsys):
+    from benchmarks.distributed import main
+
+    res = _run(main, [
+        "distributed", "--mode", "http", "--model", "llama3-tiny",
+        "--stages", "2", "--prompt-len", "16", "--max-tokens", "6",
+    ], capsys)
+    assert res["mode"] == "http"
+    assert res["value"] > 0
+    assert res["ttft_ms"] > 0
+
+
+def test_distributed_spmd_bench(capsys):
+    from benchmarks.distributed import main
+
+    res = _run(main, [
+        "distributed", "--mode", "spmd", "--model", "llama3-mini",
+        "--stages", "4", "--microbatches", "2", "--microbatch-size", "1",
+        "--prompt-len", "16", "--iters", "1",
+    ], capsys)
+    assert res["mode"] == "spmd"
+    assert res["value"] > 0
+
+
+def test_pd_separation_bench(capsys):
+    from benchmarks.pd_separation import main
+
+    res = _run(main, [
+        "pd_separation", "--model", "llama3-tiny", "--requests", "3",
+        "--prompt-len", "16", "--max-tokens", "6",
+    ], capsys)
+    assert res["benchmark"] == "pd_separation"
+    assert res["hybrid"]["tpot_ms"]["p50"] is not None
+    assert res["separated"]["tpot_ms"]["p50"] is not None
+    assert res["separated"]["migration_ms"]["p50"] is not None
