@@ -50,6 +50,7 @@ def synth_prompts(n: int, prompt_len: int, vocab: int, seed: int = 0,
     """Random prompts, optionally sharing a common prefix (prefix-cache and
     PD benchmarks need realistic system-prompt sharing)."""
     rng = np.random.default_rng(seed)
+    shared_prefix_len = min(shared_prefix_len, prompt_len)
     prefix = rng.integers(1, vocab, shared_prefix_len).tolist() \
         if shared_prefix_len else []
     out = []
@@ -57,6 +58,21 @@ def synth_prompts(n: int, prompt_len: int, vocab: int, seed: int = 0,
         rest = rng.integers(1, vocab, prompt_len - len(prefix)).tolist()
         out.append(prefix + rest)
     return out
+
+
+def make_request(prompt_token_ids: Sequence[int], max_new_tokens: int):
+    """One request shape for every harness (greedy, fixed budget) so the
+    four benchmarks cannot drift on sampling config."""
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+
+    return InferenceRequest(
+        prompt_token_ids=list(prompt_token_ids),
+        sampling=SamplingParams(max_new_tokens=max_new_tokens,
+                                temperature=0.0),
+    )
 
 
 def emit(result: Dict[str, Any]) -> None:

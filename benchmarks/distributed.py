@@ -137,15 +137,17 @@ def run_spmd(args) -> None:
 
     from jax.sharding import Mesh
 
+    # resolve --platform BEFORE the first jax.devices() call — touching the
+    # backend first would initialize the plugin-pinned platform and make the
+    # flag a no-op. spmd defaults to the CPU-scale model (virtual mesh).
+    _, model = resolve_backend_model(args, tpu_default="llama3-mini")
+    cfg = get_model_config(model)
     devices = jax.devices()
     if len(devices) < args.stages:
         raise SystemExit(
             f"spmd mode needs >= {args.stages} devices (have {len(devices)}); "
             "set XLA_FLAGS=--xla_force_host_platform_device_count=N"
         )
-    # spmd runs on a virtual CPU mesh by default: keep the CPU-scale model
-    _, model = resolve_backend_model(args, tpu_default="llama3-mini")
-    cfg = get_model_config(model)
     mesh = Mesh(
         np.asarray(devices[: args.stages]).reshape(args.stages), (AXIS_STAGE,)
     )

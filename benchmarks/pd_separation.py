@@ -31,6 +31,7 @@ from benchmarks.common import (
     Timer,
     add_platform_arg,
     emit,
+    make_request,
     percentiles,
     resolve_backend_model,
     synth_prompts,
@@ -54,15 +55,7 @@ def _mk_engine(model, batch, max_seq, params=None, prefill_buckets=(128,)):
 
 
 def _req(p, max_tokens):
-    from distributed_gpu_inference_tpu.utils.data_structures import (
-        InferenceRequest,
-        SamplingParams,
-    )
-
-    return InferenceRequest(
-        prompt_token_ids=list(p),
-        sampling=SamplingParams(max_new_tokens=max_tokens),
-    )
+    return make_request(p, max_tokens)
 
 
 def run_hybrid(model, prompts, args, params):

@@ -26,6 +26,7 @@ from benchmarks.common import (
     Timer,
     add_platform_arg,
     emit,
+    make_request,
     percentiles,
     resolve_backend_model,
     synth_prompts,
@@ -57,11 +58,6 @@ def main() -> None:
         EngineConfig,
         TPUEngine,
     )
-    from distributed_gpu_inference_tpu.utils.data_structures import (
-        InferenceRequest,
-        SamplingParams,
-    )
-
     max_seq = args.prompt_len + args.max_tokens + 16
     eng = TPUEngine(
         model,
@@ -78,10 +74,7 @@ def main() -> None:
     )
 
     def req(p):
-        return InferenceRequest(
-            prompt_token_ids=list(p),
-            sampling=SamplingParams(max_new_tokens=args.max_tokens),
-        )
+        return make_request(p, args.max_tokens)
 
     # warmup compile (prefill bucket + decode graphs)
     eng.generate([req(prompts[0])])

@@ -24,6 +24,7 @@ from benchmarks.common import (
     Timer,
     add_platform_arg,
     emit,
+    make_request,
     resolve_backend_model,
     synth_prompts,
 )
@@ -53,11 +54,6 @@ def main() -> None:
         SpeculativeConfig,
         SpeculativeDecoder,
     )
-    from distributed_gpu_inference_tpu.utils.data_structures import (
-        InferenceRequest,
-        SamplingParams,
-    )
-
     max_seq = args.prompt_len + args.max_tokens + 64
     spec = SpeculativeDecoder(
         model,
@@ -80,17 +76,14 @@ def main() -> None:
     )
 
     def reqs():
-        return [
-            InferenceRequest(
-                prompt_token_ids=list(p),
-                sampling=SamplingParams(max_new_tokens=args.max_tokens),
-            )
-            for p in prompts
-        ]
+        return [make_request(p, args.max_tokens) for p in prompts]
 
-    # warmup both paths (compile)
+    # warmup both paths (compile), then reset counters: warmup drafting
+    # must not contaminate the reported accept rate / tokens-per-step
     spec.generate(reqs())
     vanilla.generate(reqs())
+    for k in spec.stats:
+        spec.stats[k] = 0
 
     with Timer() as t_spec:
         spec_resps = spec.generate(reqs())
@@ -110,7 +103,8 @@ def main() -> None:
         "unit": "x vs vanilla decode",
         "model": model,
         "backend": backend,
-        "widths": list(widths),
+        "configured_widths": list(widths),
+        "widths_at_measurement": st.get("current_widths"),
         "accept_rate": round(
             st["accepted"] / st["drafted"] if st.get("drafted") else 0.0, 4
         ),

