@@ -553,6 +553,15 @@ async def queue_stats(request: web.Request) -> web.Response:
 # ---------------------------------------------------------------------------
 
 
+async def admin_page(request: web.Request) -> web.Response:
+    """Static admin SPA (reference serves server/static/admin/index.html —
+    admin.py:75-87). Data calls authenticate with X-Admin-Key client-side."""
+    import pathlib
+
+    page = pathlib.Path(__file__).parent / "static" / "admin.html"
+    return web.Response(text=page.read_text(), content_type="text/html")
+
+
 async def admin_dashboard(request: web.Request) -> web.Response:
     if (err := _check_admin_key(request)) is not None:
         return err
@@ -734,6 +743,7 @@ def create_app(state: Optional[ServerState] = None,
     app.router.add_get("/health", health)
     app.router.add_get("/regions", regions)
     app.router.add_get("/metrics", metrics_endpoint)
+    app.router.add_get("/admin", admin_page)
 
     if start_background:
         async def _on_startup(app: web.Application) -> None:

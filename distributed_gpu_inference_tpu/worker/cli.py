@@ -51,13 +51,25 @@ class ConfigWizard:
     def _ask(self, prompt: str, default: str) -> str:
         try:
             raw = self._input(f"{prompt} [{default}]: ").strip()
-        except EOFError:
+        except (EOFError, StopIteration):
             raw = ""
         return raw or default
 
     def _ask_bool(self, prompt: str, default: bool) -> bool:
         raw = self._ask(prompt + " (y/n)", "y" if default else "n").lower()
         return raw in ("y", "yes", "true", "1")
+
+    def _ask_number(self, prompt: str, default, cast):
+        """Re-prompt on a bad numeric answer instead of crashing the whole
+        wizard (a typo must never discard every prior answer)."""
+        for _ in range(3):
+            raw = self._ask(prompt, str(default))
+            try:
+                return cast(raw)
+            except ValueError:
+                self._print(f"  not a valid number: {raw!r}")
+        self._print(f"  using default {default}")
+        return cast(str(default))
 
     def run(self, base: Optional[WorkerConfig] = None) -> WorkerConfig:
         from .main import probe_topology
@@ -87,28 +99,29 @@ class ConfigWizard:
         # load control (reference wizard load-control section)
         if self._ask_bool("configure load control", False):
             lc = cfg.load_control
-            lc.acceptance_rate = float(
-                self._ask("acceptance rate 0..1", str(lc.acceptance_rate))
+            lc.acceptance_rate = self._ask_number(
+                "acceptance rate 0..1", lc.acceptance_rate, float
             )
-            lc.max_jobs_per_hour = int(
-                self._ask("max jobs/hour (0 = unlimited)",
-                          str(lc.max_jobs_per_hour))
+            lc.max_jobs_per_hour = self._ask_number(
+                "max jobs/hour (0 = unlimited)", lc.max_jobs_per_hour, int
             )
-            lc.cooldown_seconds = float(
-                self._ask("cooldown seconds between jobs",
-                          str(lc.cooldown_seconds))
+            lc.cooldown_seconds = self._ask_number(
+                "cooldown seconds between jobs", lc.cooldown_seconds, float
             )
             hours = self._ask("working hours start-end (e.g. 9-17, empty=all)",
                               "")
             if hours and "-" in hours:
                 a, _, b = hours.partition("-")
-                lc.working_hours = (int(a), int(b))
+                try:
+                    lc.working_hours = (int(a), int(b))
+                except ValueError:
+                    self._print(f"  ignoring invalid hours: {hours!r}")
 
         # direct endpoint (reference wizard direct section)
         if self._ask_bool("enable direct inference endpoint", False):
             cfg.direct.enabled = True
-            cfg.direct.port = int(
-                self._ask("direct port", str(cfg.direct.port))
+            cfg.direct.port = self._ask_number(
+                "direct port", cfg.direct.port, int
             )
             cfg.direct.public_url = self._ask(
                 "public URL clients reach this worker at",
@@ -172,9 +185,15 @@ def cmd_status(args: argparse.Namespace) -> int:
         try:
             import httpx
 
+            headers = {}
+            if cfg.server.api_key:
+                headers["X-API-Key"] = cfg.server.api_key
+            if cfg.server.auth_token:
+                headers["Authorization"] = f"Bearer {cfg.server.auth_token}"
             resp = httpx.get(
                 f"{cfg.server.url.rstrip('/')}/api/v1/workers/"
                 f"{cfg.server.worker_id}",
+                headers=headers,
                 timeout=5.0,
             )
             if resp.status_code == 200:
@@ -198,7 +217,15 @@ def cmd_set(args: argparse.Namespace) -> int:
         value = json.loads(args.value)
     except ValueError:
         pass
-    cfg = set_dotted(cfg, args.key, value)
+    try:
+        cfg = set_dotted(cfg, args.key, value)
+    except KeyError:
+        print(f"error: unknown config key {args.key!r}", file=sys.stderr)
+        return 1
+    except Exception as exc:  # pydantic ValidationError etc.
+        print(f"error: invalid value for {args.key!r}: {exc}",
+              file=sys.stderr)
+        return 1
     save_worker_config(cfg, args.config)
     print(f"{args.key} = {value!r}")
     return 0

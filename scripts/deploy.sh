@@ -18,4 +18,5 @@ done
 
 # shellcheck disable=SC2086
 docker compose $PROFILES up --build -d
-docker compose ps
+# shellcheck disable=SC2086
+docker compose $PROFILES ps

@@ -79,6 +79,19 @@ def test_register_heartbeat_and_config_flag():
     run(body())
 
 
+def test_admin_page_served():
+    async def body():
+        client = await make_client()
+        resp = await client.get("/admin")
+        assert resp.status == 200
+        assert "text/html" in resp.headers["Content-Type"]
+        text = await resp.text()
+        assert "admin/stats/dashboard" in text
+        await client.close()
+
+    run(body())
+
+
 def test_release_requeues_claimed_job():
     """Client-side load-control decline: the job goes back to QUEUED (not
     FAILED) and another worker can claim it."""

@@ -95,6 +95,35 @@ def test_show_masks_secrets(tmp_path, capsys):
     assert "***" in out
 
 
+def test_wizard_recovers_from_bad_number():
+    wiz, lines = wizard_with([
+        "w", "http://s", "us-west", "llm",
+        "y",            # load control
+        "0,8",          # typo
+        "0.8",          # corrected
+        "10", "0", "",  # cap/cooldown/hours
+        "n",            # no direct
+    ])
+    cfg = wiz.run()
+    assert cfg.load_control.acceptance_rate == 0.8
+    assert any("not a valid number" in l for l in lines)
+
+
+def test_set_unknown_key_clean_error(tmp_path, capsys):
+    cfg_path = tmp_path / "config.yaml"
+    rc = main(["--config", str(cfg_path), "set", "server.uri", "http://x"])
+    assert rc == 1
+    assert "unknown config key" in capsys.readouterr().err
+
+
+def test_set_invalid_value_clean_error(tmp_path, capsys):
+    cfg_path = tmp_path / "config.yaml"
+    rc = main(["--config", str(cfg_path), "set",
+               "load_control.acceptance_rate", '"abc"'])
+    assert rc == 1
+    assert "invalid value" in capsys.readouterr().err
+
+
 def test_status_local(tmp_path, capsys):
     cfg_path = tmp_path / "config.yaml"
     main(["--config", str(cfg_path), "set", "name", "w9"])
