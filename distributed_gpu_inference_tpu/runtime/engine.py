@@ -93,20 +93,47 @@ class TPUEngine:
         params: Optional[llama.Params] = None,
         seed: int = 0,
         eos_token_id: Optional[int] = None,
+        mesh: Optional[Any] = None,
+        checkpoint_path: Optional[str] = None,
     ) -> None:
+        """``mesh``: first-class tensor parallelism — params and KV pools are
+        GSPMD-sharded over the mesh's ``model`` axis and XLA inserts the TP
+        collectives (the reference only passes tensor_parallel_size through
+        to vLLM, SURVEY §2.2). Data parallelism stays request-level at the
+        fleet scheduler, so an engine mesh must not carry a data axis.
+
+        ``checkpoint_path``: orbax dir / HF safetensors dir; random init
+        when absent (hermetic tests, benchmarks)."""
         self.model_cfg = (
             get_model_config(model_cfg) if isinstance(model_cfg, str) else model_cfg
         )
         self.cfg = engine_cfg or EngineConfig()
         self.dtype = jnp.dtype(self.cfg.dtype)
-        key = jax.random.PRNGKey(seed)
-        self.params = params if params is not None else llama.init_params(
-            self.model_cfg, key, self.dtype
-        )
+        self.mesh = mesh
+        if mesh is not None:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            tp = sizes.get("model", 1)
+            if sizes.get("data", 1) > 1:
+                raise ValueError(
+                    "engine mesh must not carry a data axis (DP is "
+                    "request-level at the scheduler); got "
+                    f"data={sizes['data']}"
+                )
+            if self.model_cfg.num_kv_heads % max(tp, 1):
+                raise ValueError(
+                    f"num_kv_heads {self.model_cfg.num_kv_heads} not "
+                    f"divisible by model axis {tp}"
+                )
+        if params is not None:
+            self.params = params
+            if mesh is not None:
+                from distributed_gpu_inference_tpu.parallel import sharding as _sh
+
+                self.params = _sh.shard_params(self.params, mesh)
+        else:
+            self.params = self._load_params(checkpoint_path, seed)
         self.num_blocks = self.cfg.resolved_num_blocks()
-        self.kv = llama.init_kv_pools(
-            self.model_cfg, self.num_blocks, self.cfg.block_size, self.dtype
-        )
+        self.kv = self._init_kv()
         self.manager = PagedKVCacheManager(
             self.num_blocks,
             self.cfg.block_size,
@@ -130,6 +157,52 @@ class TPUEngine:
             "requests": 0, "completed": 0, "generated_tokens": 0,
             "prefill_tokens": 0, "prefill_calls": 0, "decode_calls": 0,
         }
+
+    # -------------------------------------------------- sharded weight init
+
+    def _load_params(self, checkpoint_path: Optional[str], seed: int):
+        """Weights land SHARDED when a mesh is set: never materialize the
+        full model on one chip (a TP engine must serve models bigger than a
+        single chip's HBM — full-size init then reshard would OOM first)."""
+        from distributed_gpu_inference_tpu.models.loader import (
+            load_or_init_params,
+        )
+
+        if self.mesh is None:
+            return load_or_init_params(
+                self.model_cfg, checkpoint_path=checkpoint_path,
+                dtype=self.cfg.dtype, seed=seed,
+            )
+        # build on the host CPU backend, then device_put host→shards direct
+        cpu = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu):
+            host_params = load_or_init_params(
+                self.model_cfg, checkpoint_path=checkpoint_path,
+                dtype=self.cfg.dtype, seed=seed,
+            )
+        from distributed_gpu_inference_tpu.parallel import sharding as _sh
+
+        return _sh.shard_params(host_params, self.mesh)
+
+    def _init_kv(self) -> llama.KVPools:
+        if self.mesh is None:
+            return llama.init_kv_pools(
+                self.model_cfg, self.num_blocks, self.cfg.block_size,
+                self.dtype,
+            )
+        # zeros created directly with the sharded layout (no single-device
+        # staging allocation)
+        from distributed_gpu_inference_tpu.parallel import sharding as _sh
+
+        s = _sh.kv_sharding(self.mesh)
+        make = jax.jit(
+            lambda: llama.init_kv_pools(
+                self.model_cfg, self.num_blocks, self.cfg.block_size,
+                self.dtype,
+            ),
+            out_shardings={"k": s, "v": s},
+        )
+        return make()
 
     # ------------------------------------------------------------------ jit
 

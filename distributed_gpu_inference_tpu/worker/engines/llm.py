@@ -100,10 +100,29 @@ class TPULLMEngine(LLMBaseEngine):
                 self.config.get("enable_prefix_cache", True)
             ),
         )
+        # first-class TP: tp_size > 1 builds a model-axis mesh over local
+        # devices (the reference forwarded tensor_parallel_size to vLLM;
+        # here the engine itself shards, llm_vllm.py:56 / SURVEY §2.2)
+        mesh = None
+        tp = int(self.config.get("tp_size") or
+                 (self.config.get("extra") or {}).get("tp_size") or 1)
+        if tp > 1:
+            import jax
+
+            from ...parallel.mesh import MeshPlan, make_mesh
+
+            devices = jax.devices()
+            if len(devices) < tp:
+                raise EngineLoadError(
+                    f"tp_size={tp} but only {len(devices)} devices"
+                )
+            mesh = make_mesh(MeshPlan(model=tp), devices[:tp],
+                             keep_trivial_axes=False)
         self.engine = TPUEngine(
             model_name,
             eng_cfg,
             checkpoint_path=self.config.get("checkpoint_path"),
+            mesh=mesh,
         )
         self.loaded = True
 

@@ -1,0 +1,66 @@
+"""TPULLMEngine end-to-end: load, generate, chat templating, TP wiring.
+
+(Regression: load_model used to pass checkpoint_path to an engine that
+didn't accept it — nothing drove this path end-to-end.)
+"""
+
+import pytest
+
+from distributed_gpu_inference_tpu.worker.engines.base import EngineLoadError
+from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = TPULLMEngine({
+        "model": "llama3-tiny", "max_batch_size": 2, "max_seq_len": 96,
+    })
+    e.load_model()
+    return e
+
+
+def test_load_and_generate(engine):
+    out = engine.inference({"prompt": "hello world", "max_new_tokens": 6})
+    assert isinstance(out["text"], str)
+    assert out["usage"]["completion_tokens"] <= 6
+    assert out["usage"]["prompt_tokens"] > 0
+    assert engine.loaded
+
+
+def test_chat_messages_path(engine):
+    out = engine.inference({
+        "messages": [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"},
+        ],
+        "max_new_tokens": 4,
+    })
+    assert isinstance(out["text"], str)
+
+
+def test_deterministic_greedy(engine):
+    a = engine.inference({"prompt": "abc", "max_new_tokens": 6})
+    b = engine.inference({"prompt": "abc", "max_new_tokens": 6})
+    assert a["text"] == b["text"]
+
+
+def test_tp_size_wiring():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    e = TPULLMEngine({
+        "model": "llama3-tiny", "max_batch_size": 1, "max_seq_len": 64,
+        "tp_size": 2,
+    })
+    e.load_model()
+    assert e.engine.mesh is not None
+    assert "model" in str(e.engine.params["layers"]["wq"].sharding.spec)
+    out = e.inference({"prompt": "tp", "max_new_tokens": 4})
+    assert isinstance(out["text"], str)
+
+
+def test_tp_size_too_large_is_load_error():
+    e = TPULLMEngine({"model": "llama3-tiny", "tp_size": 999})
+    with pytest.raises(EngineLoadError, match="tp_size"):
+        e.load_model()
