@@ -36,6 +36,7 @@ from distributed_gpu_inference_tpu.models.configs import ModelConfig, get_model_
 from distributed_gpu_inference_tpu.models import llama
 from distributed_gpu_inference_tpu.ops.sampling import sample_tokens
 from distributed_gpu_inference_tpu.runtime.kv_cache import (
+    HostKVStore,
     PagedKVCacheManager,
     PendingDeviceOps,
 )
@@ -59,6 +60,9 @@ class EngineConfig:
     enable_prefix_cache: bool = True
     multi_step: int = 16                  # scan horizon for decode_multi
     dtype: str = "bfloat16"
+    # spill tiers (reference HBM→CPU→Redis chain): 0 disables the host tier
+    spill_host_blocks: int = 0
+    spill_remote_store: Optional[Any] = None   # RemoteKVStore-like (L3)
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -134,10 +138,18 @@ class TPUEngine:
             self.params = self._load_params(checkpoint_path, seed)
         self.num_blocks = self.cfg.resolved_num_blocks()
         self.kv = self._init_kv()
+        host_store = (
+            HostKVStore(self.cfg.spill_host_blocks)
+            if self.cfg.spill_host_blocks > 0 else None
+        )
+        spill = host_store is not None or self.cfg.spill_remote_store is not None
         self.manager = PagedKVCacheManager(
             self.num_blocks,
             self.cfg.block_size,
             enable_prefix_cache=self.cfg.enable_prefix_cache,
+            host_store=host_store,
+            remote_store=self.cfg.spill_remote_store,
+            spill_on_evict=spill,
         )
         self.eos_token_id = eos_token_id
         self._rng = jax.random.PRNGKey(seed + 1)
@@ -277,6 +289,13 @@ class TPUEngine:
         ops = self.manager.take_pending_ops()
         if ops.empty:
             return
+        # downloads FIRST: an evicted block's id is about to be reused, so
+        # its page must reach the host store before any copy/upload/prefill
+        # can overwrite it
+        for bid, key in ops.downloads:
+            k = np.asarray(self.kv["k"][:, bid])
+            v = np.asarray(self.kv["v"][:, bid])
+            self.manager.store_spilled(key, np.stack([k, v], axis=1))
         if ops.copies:
             n = len(ops.copies)
             bucket = next(c for c in _COPY_BUCKETS if c >= n) if n <= _COPY_BUCKETS[-1] else n

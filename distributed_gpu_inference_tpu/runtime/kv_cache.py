@@ -45,21 +45,25 @@ class OutOfBlocksError(RuntimeError):
 class PendingDeviceOps:
     """Device-side effects for the engine to apply in its next jitted update.
 
-    copies:   (src_block, dst_block) page copies (CoW / defrag)
-    uploads:  (dst_block, host_kv) spill-tier promotions; host_kv is
-              ``np.ndarray [L, 2, block, Hkv, D]`` (k and v stacked on axis 1)
+    downloads: (src_block, spill_key) pages to pull to host BEFORE any write
+               (spill-on-evict: the block id is about to be reused)
+    copies:    (src_block, dst_block) page copies (CoW / defrag)
+    uploads:   (dst_block, host_kv) spill-tier promotions; host_kv is
+               ``np.ndarray [L, 2, block, Hkv, D]`` (k and v stacked on axis 1)
     """
 
+    downloads: List[Tuple[int, str]] = field(default_factory=list)
     copies: List[Tuple[int, int]] = field(default_factory=list)
     uploads: List[Tuple[int, np.ndarray]] = field(default_factory=list)
 
     def merge(self, other: "PendingDeviceOps") -> None:
+        self.downloads.extend(other.downloads)
         self.copies.extend(other.copies)
         self.uploads.extend(other.uploads)
 
     @property
     def empty(self) -> bool:
-        return not self.copies and not self.uploads
+        return not self.downloads and not self.copies and not self.uploads
 
 
 class _RadixNode:
@@ -334,11 +338,52 @@ class PagedKVCacheManager:
     def _evict_block(self, bid: int) -> None:
         meta = self.metas.pop(bid, None)
         self.cached_lru.pop(bid, None)
-        if self.spill_on_evict and meta is not None and meta.prefix_hash:
-            self.stats.spills += 1  # actual page bytes are engine-side (L1);
-            # spill content is uploaded by the engine via snapshot hooks.
+        if self.spill_on_evict and meta is not None and meta.prefix_hash \
+                and (self.host_store is not None
+                     or self.remote_store is not None):
+            # the block id is about to be reused: the engine pulls the page
+            # to host FIRST (downloads run before any write in
+            # _apply_pending) and hands it to store_spilled()
+            self.pending.downloads.append((bid, meta.prefix_hash))
+            self.stats.spills += 1
         self.radix.remove_block(bid)
         self.stats.evictions += 1
+
+    # -- spill tiers (reference get_or_compute chain, kv_cache.py:389-462) ---
+
+    def store_spilled(self, key: str, page: np.ndarray) -> None:
+        """Engine callback with the evicted page bytes: L2 host store plus
+        write-through to L3 (reference async Redis writeback :506-520)."""
+        if self.host_store is not None:
+            self.host_store.put(key, page)
+        if self.remote_store is not None:
+            from distributed_gpu_inference_tpu.utils.serialization import (
+                TensorSerializer,
+            )
+
+            self.remote_store.put(key, TensorSerializer().serialize(page))
+
+    def _probe_spill(self, key: str) -> Optional[np.ndarray]:
+        """L2 then L3; an L3 hit is promoted to L2 (reference
+        promote-on-hit :447-462)."""
+        if self.host_store is not None:
+            page = self.host_store.get(key)
+            if page is not None:
+                self.stats.l2_hits += 1
+                return page
+        if self.remote_store is not None:
+            raw = self.remote_store.get(key)
+            if raw is not None:
+                from distributed_gpu_inference_tpu.utils.serialization import (
+                    TensorSerializer,
+                )
+
+                page = TensorSerializer().deserialize(raw)
+                self.stats.l3_hits += 1
+                if self.host_store is not None:
+                    self.host_store.put(key, page)
+                return page
+        return None
 
     # -- sequence lifecycle -------------------------------------------------
 
@@ -363,6 +408,7 @@ class PagedKVCacheManager:
         needed_blocks = max(1, -(-n_tokens // self.block_size))
 
         cached: List[int] = []
+        spill_pages: List[np.ndarray] = []
         if self.enable_prefix_cache:
             self.stats.prefix_queries += 1
             self.stats.prefix_total_tokens += n_tokens
@@ -371,9 +417,23 @@ class PagedKVCacheManager:
             # logits must be recomputed, so keep at least one token fresh
             while cached and len(cached) * self.block_size >= n_tokens:
                 cached.pop()
-        num_cached_tokens = len(cached) * self.block_size
+            # L1 miss past this point: probe the spill tiers block-by-block
+            # (reference get_or_compute chain) — restored pages re-upload
+            # into freshly allocated blocks, same fresh-token rule applies
+            if self.host_store is not None or self.remote_store is not None:
+                idx = len(cached)
+                while (idx + 1) * self.block_size < n_tokens:
+                    key = compute_prefix_hash(
+                        token_ids, (idx + 1) * self.block_size
+                    )
+                    page = self._probe_spill(key)
+                    if page is None:
+                        break
+                    spill_pages.append(page)
+                    idx += 1
+        num_cached_tokens = (len(cached) + len(spill_pages)) * self.block_size
         self.stats.prefix_hit_tokens += num_cached_tokens
-        if cached:
+        if cached or spill_pages:
             self.stats.l1_hits += len(cached)
         else:
             self.stats.misses += 1
@@ -390,18 +450,34 @@ class PagedKVCacheManager:
                     meta.incref()
                 meta.touch()
                 blocks.append(bid)
-            for _ in range(needed_blocks - len(cached)):
+            for page in spill_pages:
+                bid = self._pop_free_block()
+                self.pending.uploads.append((bid, page))
+                blocks.append(bid)
+            for _ in range(needed_blocks - len(blocks)):
                 blocks.append(self._pop_free_block())
         except OutOfBlocksError:
             # undo exactly what was done: drop OUR reference only; a block
-            # another sequence still holds must never reach the free list
+            # another sequence still holds must never reach the free list.
+            # Staged uploads for OUR fresh blocks must not fire either.
+            ours = set(blocks) - set(cached)
+            if ours:
+                self.pending.uploads = [
+                    (b, p) for b, p in self.pending.uploads if b not in ours
+                ]
             for bid in blocks:
                 if self.metas[bid].decref() == 0:
                     self._deactivate_block(bid)
             raise
+        if spill_pages:
+            # index the restored chain so concurrent/future requests hit L1
+            n_idx = len(cached) + len(spill_pages)
+            self.radix.insert(
+                token_ids[: n_idx * self.block_size], blocks[:n_idx]
+            )
         self.seq_blocks[seq_id] = blocks
         self.seq_tokens[seq_id] = token_ids
-        self.seq_shared_count[seq_id] = len(cached)
+        self.seq_shared_count[seq_id] = len(cached) + len(spill_pages)
         return blocks, num_cached_tokens
 
     def append_token(self, seq_id: str, token_id: int) -> Optional[int]:

@@ -111,19 +111,25 @@ class TPULLMEngine(LLMBaseEngine):
 
             from ...parallel.mesh import MeshPlan, make_mesh
 
-            devices = jax.devices()
+            devices = jax.local_devices()  # only addressable chips: a mesh
+            # over another process's devices would fail or diverge per host
             if len(devices) < tp:
                 raise EngineLoadError(
-                    f"tp_size={tp} but only {len(devices)} devices"
+                    f"tp_size={tp} but only {len(devices)} local devices"
                 )
             mesh = make_mesh(MeshPlan(model=tp), devices[:tp],
                              keep_trivial_axes=False)
-        self.engine = TPUEngine(
-            model_name,
-            eng_cfg,
-            checkpoint_path=self.config.get("checkpoint_path"),
-            mesh=mesh,
-        )
+        try:
+            self.engine = TPUEngine(
+                model_name,
+                eng_cfg,
+                checkpoint_path=self.config.get("checkpoint_path"),
+                mesh=mesh,
+            )
+        except ValueError as exc:
+            # invalid mesh/model combination must drop the task type, not
+            # kill worker startup (load_engines catches EngineLoadError)
+            raise EngineLoadError(str(exc)) from exc
         self.loaded = True
 
     def unload(self) -> None:

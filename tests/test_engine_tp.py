@@ -93,7 +93,6 @@ def test_tp_engine_prefix_cache_and_handoff(tp_mesh):
     h = export_slot_kv(tp, slot)
     tp.finish_slot(slot, cache=False)
 
-    single = TPUEngine(MODEL, _cfg(), params=None, seed=0)
     # recipient params must equal donor's: pull the sharded tree to host
     host_params = jax.device_get(tp.params)
     single = TPUEngine(MODEL, _cfg(), params=host_params, seed=0)

@@ -1,0 +1,115 @@
+"""Multi-tier KV spill: evicted pages really move HBM→host→remote and come
+back on a prefix hit with bit-exact continuations.
+
+The reference's tiered chain (DistributedKVCacheManager.get_or_compute,
+kv_cache.py:389-462) moves pickled tensors between GPU/CPU/Redis; here
+pages spill from the device pool on eviction and re-upload through the
+pending-ops path, verified by token-level equality against a no-cache run.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
+from distributed_gpu_inference_tpu.runtime.kv_cache import RemoteKVStore
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+MODEL = "llama3-tiny"
+PROMPT_A = list(range(40, 72))            # 2 full blocks cacheable
+PROMPT_B = [7, 9] * 16                    # eviction pressure filler
+
+
+def _cfg(**kw):
+    return EngineConfig(
+        max_batch_size=1, max_seq_len=64, block_size=16,
+        prefill_buckets=(32,), num_blocks=8,  # tiny pool → forced eviction
+        dtype="float32", **kw,
+    )
+
+
+def _req(p, n=8):
+    return InferenceRequest(
+        prompt_token_ids=list(p),
+        sampling=SamplingParams(max_new_tokens=n, temperature=0.0),
+    )
+
+
+def _evict_a_with_b(eng):
+    """Fill the tiny pool with other sequences until A's cached blocks are
+    evicted (their pages spill)."""
+    for i in range(4):
+        filler = [(i * 3 + j) % 500 for j in PROMPT_B]
+        eng.generate([_req(filler)])
+
+
+def test_spill_to_host_and_restore_bit_exact():
+    ref = TPUEngine(MODEL, _cfg(), seed=0)
+    expect = ref.generate([_req(PROMPT_A)])[0].token_ids
+
+    eng = TPUEngine(MODEL, _cfg(spill_host_blocks=64), seed=0,
+                    params=ref.params)
+    eng.generate([_req(PROMPT_A)])
+    _evict_a_with_b(eng)
+    st = eng.manager.get_stats()
+    assert st["spills"] > 0
+    assert len(eng.manager.host_store) > 0
+
+    # same prompt again: restored from the host tier, not recomputed
+    slot = eng.submit(_req(PROMPT_A))
+    assert eng.slots[slot].cached_tokens >= 16     # ≥1 block from L2
+    assert eng.manager.get_stats()["l2_hits"] >= 1
+    while eng.slots[slot] is not None and \
+            eng.slots[slot].finish_reason is None:
+        eng.decode_step()
+    got = eng.finish_slot(slot).token_ids
+    assert got == expect                            # bit-exact continuation
+
+
+def test_spill_writes_through_to_remote_and_restores_from_l3():
+    remote = RemoteKVStore(ttl_s=3600.0)
+    ref = TPUEngine(MODEL, _cfg(), seed=0)
+    expect = ref.generate([_req(PROMPT_A)])[0].token_ids
+
+    # L2 sized 1: effectively forces L3 reads for the older spilled pages
+    eng = TPUEngine(
+        MODEL, _cfg(spill_host_blocks=1, spill_remote_store=remote),
+        seed=0, params=ref.params,
+    )
+    eng.generate([_req(PROMPT_A)])
+    _evict_a_with_b(eng)
+    assert len(remote._store) > 0                   # write-through happened
+
+    slot = eng.submit(_req(PROMPT_A))
+    assert eng.slots[slot].cached_tokens >= 16
+    st = eng.manager.get_stats()
+    assert st["l3_hits"] >= 1
+    while eng.slots[slot] is not None and \
+            eng.slots[slot].finish_reason is None:
+        eng.decode_step()
+    assert eng.finish_slot(slot).token_ids == expect
+
+
+def test_spill_disabled_by_default():
+    eng = TPUEngine(MODEL, _cfg(), seed=0)
+    eng.generate([_req(PROMPT_A)])
+    _evict_a_with_b(eng)
+    st = eng.manager.get_stats()
+    assert st["spills"] == 0
+    assert eng.manager.host_store is None
+
+
+def test_restored_chain_is_radix_indexed():
+    """After an L2 restore the chain is L1 again: a third request hits the
+    radix index directly (no further spill probes)."""
+    eng = TPUEngine(MODEL, _cfg(spill_host_blocks=64), seed=0)
+    eng.generate([_req(PROMPT_A)])
+    _evict_a_with_b(eng)
+    eng.generate([_req(PROMPT_A)])                  # restores via L2
+    l2_before = eng.manager.get_stats()["l2_hits"]
+    slot = eng.submit(_req(PROMPT_A))               # should be pure L1 now
+    assert eng.slots[slot].cached_tokens >= 16
+    assert eng.manager.get_stats()["l2_hits"] == l2_before
+    eng.finish_slot(slot)
