@@ -241,6 +241,68 @@ class InferenceClient:
             sync=sync, timeout_s=timeout_s,
         )
 
+    def stream_chat(
+        self,
+        messages: Optional[List[Dict[str, str]]] = None,
+        prompt: Optional[str] = None,
+        model: Optional[str] = None,
+        timeout_s: float = 300.0,
+        **gen_params: Any,
+    ):
+        """Token streaming via the nearest direct worker's SSE endpoint.
+
+        Yields ``{"text_delta", "token_ids"}`` chunks then a final
+        ``{"done": True, ...}``. When no direct worker is available (or the
+        stream fails before the first chunk), falls back to one queued
+        round trip yielded as a single chunk + done event.
+        """
+        import json as _json
+
+        params: Dict[str, Any] = dict(gen_params)
+        if messages is not None:
+            params["messages"] = messages
+        if prompt is not None:
+            params["prompt"] = prompt
+        if model is not None:
+            params["model"] = model
+
+        worker = self._get_nearest_worker()
+        if worker is not None:
+            url = f"{worker['direct_url'].rstrip('/')}/inference/stream"
+            yielded = False
+            try:
+                with self._client.stream(
+                    "POST", url, json={"type": "llm", "params": params},
+                    headers=self._headers(), timeout=timeout_s,
+                ) as resp:
+                    if resp.status_code == 200:
+                        for line in resp.iter_lines():
+                            if not line.startswith("data: "):
+                                continue
+                            chunk = _json.loads(line[len("data: "):])
+                            if "error" in chunk:
+                                raise InferenceClientError(
+                                    500, chunk["error"]
+                                )
+                            yielded = True
+                            yield chunk
+                        return
+                    self._direct_cache = None  # busy: rediscover later
+            except httpx.TransportError as exc:
+                self._direct_cache = None
+                if yielded:
+                    # chunks already reached the consumer: a queued re-run
+                    # would duplicate text AND execute the prompt twice
+                    raise InferenceClientError(
+                        599, f"stream dropped mid-generation: {exc}"
+                    ) from exc
+        # fallback: queued path, emitted as one chunk (stream contract kept)
+        result = self._run_job("llm", params, sync=True, timeout_s=timeout_s)
+        yield {"text_delta": result.get("text", ""), "token_ids": []}
+        yield {"done": True,
+               "finish_reason": result.get("finish_reason", "stop"),
+               "usage": result.get("usage", {})}
+
     # -- direct mode (reference :284-329) ------------------------------------
 
     def _get_nearest_worker(self) -> Optional[Dict[str, Any]]:

@@ -14,6 +14,7 @@ job params straight to ``/inference``, skipping the queue entirely.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -43,28 +44,39 @@ class DirectServer:
     async def _status(self, request: web.Request) -> web.Response:
         return web.json_response(self.worker.get_status())
 
-    async def _inference(self, request: web.Request) -> web.Response:
+    async def _parse_and_admit(self, request: web.Request,
+                               require_stream: bool = False):
+        """ONE admission pipeline for both inference endpoints (load-control
+        caps must hold no matter which path the job takes): returns
+        ``(engine, body, None)`` with the worker CLAIMED, or
+        ``(None, None, error_response)``. On success the caller owns the
+        claim and must call ``_release(started)``."""
         try:
             body = await request.json()
         except ValueError:
-            return web.json_response({"detail": "invalid JSON"}, status=400)
+            return None, None, web.json_response(
+                {"detail": "invalid JSON"}, status=400
+            )
         if not isinstance(body, dict):
-            return web.json_response(
+            return None, None, web.json_response(
                 {"detail": "body must be a JSON object"}, status=400
             )
         task_type = body.get("type", "llm")
         engine = self.worker.engines.get(task_type)
         if engine is None:
-            return web.json_response(
+            return None, None, web.json_response(
                 {"detail": f"task type {task_type!r} not loaded"}, status=404
             )
-        # load control applies to direct traffic too — the volunteer's caps
-        # (working hours, cooldown, hourly budget) must hold no matter which
-        # path the job takes
+        if require_stream and \
+                getattr(engine, "stream_inference", None) is None:
+            return None, None, web.json_response(
+                {"detail": f"engine for {task_type!r} does not stream"},
+                status=501,
+            )
         accept = getattr(self.worker, "should_accept_job", None)
         if accept is not None and not accept({"type": task_type}):
             self.stats["rejected"] += 1
-            return web.json_response(
+            return None, None, web.json_response(
                 {"detail": "declined by load control"}, status=503
             )
         # atomically claim the worker (IDLE→BUSY): a second direct request,
@@ -73,10 +85,22 @@ class DirectServer:
         # queue (reference direct_server.py:79-85).
         if not self.worker.try_begin_job():
             self.stats["rejected"] += 1
-            return web.json_response(
+            return None, None, web.json_response(
                 {"detail": f"worker {self.worker.state.value}"}, status=503
             )
         self.stats["requests"] += 1
+        return engine, body, None
+
+    def _release(self, started: float) -> None:
+        note = getattr(self.worker, "note_job_done", None)
+        if note is not None:
+            note(started)
+        self.worker.end_job()
+
+    async def _inference(self, request: web.Request) -> web.Response:
+        engine, body, err = await self._parse_and_admit(request)
+        if err is not None:
+            return err
         started = time.time()
         loop = asyncio.get_running_loop()
         try:
@@ -86,19 +110,54 @@ class DirectServer:
         except Exception as exc:  # noqa: BLE001 - surface as a job error
             return web.json_response({"detail": str(exc)}, status=500)
         finally:
-            note = getattr(self.worker, "note_job_done", None)
-            if note is not None:
-                note(started)
-            self.worker.end_job()
+            self._release(started)
         return web.json_response({"result": result})
 
-    # -- lifecycle -----------------------------------------------------------
+    async def _inference_stream(self, request: web.Request
+                                ) -> web.StreamResponse:
+        """SSE token streaming (reference SGLang SSE path,
+        llm_sglang.py:358-416): each chunk is one ``data:`` event; the final
+        event carries done/finish_reason/usage."""
+        import json
+
+        engine, body, err = await self._parse_and_admit(
+            request, require_stream=True
+        )
+        if err is not None:
+            return err
+        started = time.time()
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "X-Accel-Buffering": "no",
+            }
+        )
+        await resp.prepare(request)
+        agen = engine.stream_inference(body.get("params") or {})
+        try:
+            async for chunk in agen:
+                await resp.write(
+                    f"data: {json.dumps(chunk)}\n\n".encode()
+                )
+        except ConnectionResetError:
+            pass  # client went away mid-stream; aclose() below aborts the run
+        finally:
+            # closing the generator signals the pump thread to abort and
+            # WAITS for it — the engine is quiet before the claim releases,
+            # so the next request can never drive the engine concurrently
+            await agen.aclose()
+            self._release(started)
+        with contextlib.suppress(ConnectionResetError):
+            await resp.write_eof()
+        return resp
 
     def make_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get("/health", self._health)
         app.router.add_get("/status", self._status)
         app.router.add_post("/inference", self._inference)
+        app.router.add_post("/inference/stream", self._inference_stream)
         return app
 
     def start(self) -> None:

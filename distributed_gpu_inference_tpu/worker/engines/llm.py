@@ -16,6 +16,7 @@ boxes — no network fetch, mirroring the reference's offline-test strategy).
 
 from __future__ import annotations
 
+import asyncio
 import time
 from typing import Any, Dict, List, Optional
 
@@ -160,8 +161,10 @@ class TPULLMEngine(LLMBaseEngine):
             ids.append(int(eos))
         return tuple(ids[:4])
 
-    def _generate(self, prompt_or_messages: Any,
-                  cfg: GenerationConfig) -> GenerationResult:
+    def _build_request(self, prompt_or_messages: Any,
+                       cfg: GenerationConfig) -> InferenceRequest:
+        """One request builder for the blocking AND streaming paths — the
+        two must never diverge on tokenization/truncation/sampling."""
         if not self.loaded or self.engine is None:
             raise EngineLoadError("engine not loaded")
         text = self._to_prompt(prompt_or_messages)
@@ -169,7 +172,7 @@ class TPULLMEngine(LLMBaseEngine):
         max_prompt = self.engine.cfg.max_seq_len - cfg.max_new_tokens - 1
         if len(token_ids) > max_prompt > 0:
             token_ids = token_ids[-max_prompt:]  # keep the tail (recency)
-        req = InferenceRequest(
+        return InferenceRequest(
             prompt_token_ids=token_ids,
             sampling=SamplingParams(
                 max_new_tokens=cfg.max_new_tokens,
@@ -180,6 +183,10 @@ class TPULLMEngine(LLMBaseEngine):
                 seed=cfg.seed,
             ),
         )
+
+    def _generate(self, prompt_or_messages: Any,
+                  cfg: GenerationConfig) -> GenerationResult:
+        req = self._build_request(prompt_or_messages, cfg)
         t0 = time.perf_counter()
         resp = self.engine.generate([req], use_multi_step=True)[0]
         e2e_ms = (time.perf_counter() - t0) * 1000.0
@@ -199,6 +206,121 @@ class TPULLMEngine(LLMBaseEngine):
             finish_reason=finish,
             ttft_ms=resp.ttft_ms if resp.ttft_ms is not None else e2e_ms,
         )
+
+    # -- token streaming (reference SSE path, llm_sglang.py:358-416) ---------
+
+    def stream(self, params: Dict[str, Any],
+               cancel: Optional[Any] = None):
+        """Sync generator of chunks:
+        ``{"text_delta", "token_ids"}...`` then a final
+        ``{"done": True, "finish_reason", "usage"}``. Drives the engine
+        per-step so tokens flush as they are sampled.
+
+        ``cancel``: a ``threading.Event``-like object; when set, generation
+        stops at the next step boundary and the slot is released (client
+        disconnects must not keep burning decode budget).
+
+        Stop-string handling matches the blocking path exactly: the last
+        ``len(longest_stop) - 1`` characters are held back until the stop
+        scan clears them, so a stop sequence spanning chunk boundaries never
+        leaks its prefix.
+        """
+        cfg = GenerationConfig.from_params(params)
+        req = self._build_request(
+            params.get("messages") or params.get("prompt") or "", cfg
+        )
+        slot = self.engine.submit(req)
+        holdback = max((len(s) for s in cfg.stop), default=0)
+        holdback = max(holdback - 1, 0)
+        sent_tokens = 0
+        sent_text = ""
+        finish_override: Optional[str] = None
+        try:
+            while True:
+                s = self.engine.slots[slot]
+                gen = list(s.generated)
+                finished = s.finish_reason is not None
+                if len(gen) > sent_tokens or finished:
+                    # decode the WHOLE sequence: multi-byte characters and
+                    # cross-chunk stop strings stay correct
+                    full = self.tokenizer.decode(gen)
+                    stop_idx = -1
+                    for st in cfg.stop:
+                        idx = full.find(st)
+                        if idx >= 0 and (stop_idx < 0 or idx < stop_idx):
+                            stop_idx = idx
+                    if stop_idx >= 0:
+                        target = full[:stop_idx]
+                        finish_override = "stop"
+                    elif finished:
+                        target = full
+                    else:
+                        target = full[: max(len(full) - holdback,
+                                            len(sent_text))]
+                    delta = target[len(sent_text):]
+                    if delta:
+                        yield {
+                            "text_delta": delta,
+                            # token ids past a stop cut are not emitted
+                            "token_ids": [] if stop_idx >= 0
+                            else gen[sent_tokens:],
+                        }
+                    sent_text = target
+                    sent_tokens = len(gen)
+                    if stop_idx >= 0:
+                        s.finish_reason = "stop"
+                        finished = True
+                if finished:
+                    break
+                if cancel is not None and cancel.is_set():
+                    s.finish_reason = s.finish_reason or "abort"
+                    break
+                self.engine.decode_step()
+        finally:
+            resp = self.engine.finish_slot(slot)
+        yield {
+            "done": True,
+            "finish_reason": finish_override or resp.finish_reason,
+            "usage": {
+                "prompt_tokens": resp.prompt_tokens,
+                "completion_tokens": resp.completion_tokens,
+                "total_tokens": resp.prompt_tokens + resp.completion_tokens,
+                "cached_tokens": resp.cached_tokens,
+            },
+        }
+
+    async def stream_inference(self, params: Dict[str, Any]):
+        """Async wrapper: the sync per-step generator runs in a worker
+        thread; chunks flow through a queue as they are produced. Closing
+        this generator early (client disconnect) signals the pump thread to
+        abort AND waits for it — the engine is guaranteed quiet when control
+        returns to the caller."""
+        import threading
+
+        loop = asyncio.get_running_loop()
+        q: "asyncio.Queue" = asyncio.Queue()
+        _END = object()
+        cancel = threading.Event()
+
+        def pump():
+            try:
+                for chunk in self.stream(params, cancel=cancel):
+                    loop.call_soon_threadsafe(q.put_nowait, chunk)
+            except Exception as exc:  # noqa: BLE001 - surface to consumer
+                loop.call_soon_threadsafe(q.put_nowait, {"error": str(exc)})
+            finally:
+                loop.call_soon_threadsafe(q.put_nowait, _END)
+
+        fut = loop.run_in_executor(None, pump)
+        try:
+            while True:
+                chunk = await q.get()
+                if chunk is _END:
+                    break
+                yield chunk
+        finally:
+            cancel.set()
+            await fut  # engine quiet before the caller releases the claim
 
     # -- batch path straight through the engine (one compiled graph) ----------
 

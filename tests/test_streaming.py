@@ -1,0 +1,249 @@
+"""Token streaming: engine generator → direct-server SSE → SDK consumer.
+
+Parity: reference SSE streaming (llm_sglang.py:358-416) and the vLLM async
+stream path — here verified end-to-end over a real engine (tiny model),
+including concatenated-deltas == non-streamed output.
+"""
+
+import asyncio
+import json
+
+import httpx
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from distributed_gpu_inference_tpu.utils.data_structures import WorkerState
+from distributed_gpu_inference_tpu.worker.direct_server import DirectServer
+from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+
+@pytest.fixture(scope="module")
+def llm_engine():
+    e = TPULLMEngine({
+        "model": "llama3-tiny", "max_batch_size": 2, "max_seq_len": 96,
+    })
+    e.load_model()
+    return e
+
+
+def test_engine_stream_matches_blocking(llm_engine):
+    blocking = llm_engine.inference({"prompt": "abcd", "max_new_tokens": 8})
+    chunks = list(llm_engine.stream({"prompt": "abcd", "max_new_tokens": 8}))
+    assert chunks[-1]["done"] is True
+    streamed = "".join(c.get("text_delta", "") for c in chunks[:-1])
+    assert streamed == blocking["text"]
+    assert chunks[-1]["usage"]["completion_tokens"] == \
+        blocking["usage"]["completion_tokens"]
+    # multiple incremental chunks, not one blob
+    assert len(chunks) > 2
+
+
+def test_engine_stream_releases_slot(llm_engine):
+    list(llm_engine.stream({"prompt": "x", "max_new_tokens": 4}))
+    assert llm_engine.engine.num_active == 0
+
+
+def test_stream_stop_string_never_leaks_prefix(llm_engine):
+    """A stop string spanning chunk boundaries must not leak its prefix:
+    streamed text equals the blocking path's truncated text for every
+    stop value (including ones matching mid-generation)."""
+    blocking = llm_engine.inference({"prompt": "abcd", "max_new_tokens": 10})
+    full_text = blocking["text"]
+    if len(full_text) >= 3:
+        # pick a stop string from the middle of the real output so it WILL
+        # hit, spanning a chunk edge (per-token chunks are 1 char here)
+        stop = full_text[2:4] or full_text[2]
+        expect = llm_engine.inference(
+            {"prompt": "abcd", "max_new_tokens": 10, "stop": [stop]}
+        )["text"]
+        chunks = list(llm_engine.stream(
+            {"prompt": "abcd", "max_new_tokens": 10, "stop": [stop]}
+        ))
+        streamed = "".join(c.get("text_delta", "") for c in chunks[:-1])
+        assert streamed == expect
+        assert stop not in streamed
+        assert chunks[-1]["finish_reason"] == "stop"
+
+
+def test_stream_cancel_stops_generation(llm_engine):
+    import threading
+
+    cancel = threading.Event()
+    gen = llm_engine.stream(
+        {"prompt": "abcd", "max_new_tokens": 64}, cancel=cancel
+    )
+    first = next(gen)
+    assert "text_delta" in first
+    cancel.set()
+    rest = list(gen)
+    assert rest[-1]["done"] is True
+    # generation stopped early, slot released
+    total = sum(len(c.get("token_ids", [])) for c in [first] + rest[:-1])
+    assert total < 64
+    assert llm_engine.engine.num_active == 0
+
+
+def test_stream_inference_aclose_waits_for_engine(llm_engine):
+    """Closing the async generator mid-stream must leave the engine quiet
+    (no abandoned pump thread still decoding)."""
+    async def body():
+        agen = llm_engine.stream_inference(
+            {"prompt": "abcd", "max_new_tokens": 64}
+        )
+        got = await agen.__anext__()
+        assert "text_delta" in got or "done" in got
+        await agen.aclose()
+        assert llm_engine.engine.num_active == 0
+
+    asyncio.run(body())
+
+
+class StreamWorker:
+    def __init__(self, engine):
+        self.state = WorkerState.IDLE
+        self.engines = {"llm": engine}
+
+    def try_begin_job(self):
+        if self.state != WorkerState.IDLE:
+            return False
+        self.state = WorkerState.BUSY
+        return True
+
+    def end_job(self):
+        if self.state == WorkerState.BUSY:
+            self.state = WorkerState.IDLE
+
+    def get_status(self):
+        return {"state": self.state.value}
+
+
+def test_direct_server_sse(llm_engine):
+    async def body():
+        w = StreamWorker(llm_engine)
+        ds = DirectServer(w)
+        client = TestClient(TestServer(ds.make_app()))
+        await client.start_server()
+        resp = await client.post(
+            "/inference/stream",
+            json={"type": "llm", "params": {"prompt": "hi",
+                                            "max_new_tokens": 6}},
+        )
+        assert resp.status == 200
+        assert "text/event-stream" in resp.headers["Content-Type"]
+        raw = (await resp.read()).decode()
+        events = [json.loads(l[len("data: "):])
+                  for l in raw.splitlines() if l.startswith("data: ")]
+        assert events[-1]["done"] is True
+        assert "usage" in events[-1]
+        assert any(e.get("text_delta") for e in events[:-1])
+        # worker released after the stream
+        assert w.state == WorkerState.IDLE
+        await client.close()
+
+    asyncio.run(body())
+
+
+def test_direct_server_stream_busy_503(llm_engine):
+    async def body():
+        w = StreamWorker(llm_engine)
+        w.state = WorkerState.BUSY
+        ds = DirectServer(w)
+        client = TestClient(TestServer(ds.make_app()))
+        await client.start_server()
+        resp = await client.post(
+            "/inference/stream", json={"type": "llm", "params": {}}
+        )
+        assert resp.status == 503
+        await client.close()
+
+    asyncio.run(body())
+
+
+def test_sdk_stream_chat_parses_sse():
+    from distributed_gpu_inference_tpu.sdk import InferenceClient
+
+    sse = (
+        'data: {"text_delta": "he", "token_ids": [1]}\n\n'
+        'data: {"text_delta": "llo", "token_ids": [2]}\n\n'
+        'data: {"done": true, "finish_reason": "stop", '
+        '"usage": {"completion_tokens": 2}}\n\n'
+    )
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.path == "/api/v1/jobs/direct/nearest":
+            return httpx.Response(
+                200, json={"worker_id": "w", "region": "us-west",
+                           "direct_url": "http://worker-a:8471"},
+            )
+        assert req.url.path == "/inference/stream"
+        return httpx.Response(
+            200, text=sse,
+            headers={"Content-Type": "text/event-stream"},
+        )
+
+    c = InferenceClient("http://s1", transport=httpx.MockTransport(handler),
+                        backoff_s=0.0)
+    chunks = list(c.stream_chat(prompt="x"))
+    assert "".join(ch.get("text_delta", "") for ch in chunks[:-1]) == "hello"
+    assert chunks[-1]["done"] is True
+
+
+def test_sdk_stream_midstream_drop_raises_not_duplicates():
+    """A transport drop AFTER chunks were yielded must raise — a queued
+    re-run would duplicate text and execute the prompt twice."""
+    from distributed_gpu_inference_tpu.sdk import (
+        InferenceClient,
+        InferenceClientError,
+    )
+
+    class _IterStream(httpx.SyncByteStream):
+        def __init__(self, it):
+            self._it = it
+
+        def __iter__(self):
+            return self._it
+
+    class DropTransport(httpx.BaseTransport):
+        def handle_request(self, req):
+            if req.url.path == "/api/v1/jobs/direct/nearest":
+                return httpx.Response(
+                    200, json={"worker_id": "w", "region": "us-west",
+                               "direct_url": "http://worker-a:8471"},
+                )
+            if req.url.path == "/inference/stream":
+                def gen():
+                    yield b'data: {"text_delta": "He", "token_ids": [1]}\n\n'
+                    raise httpx.ReadError("link dropped")
+
+                return httpx.Response(
+                    200, headers={"Content-Type": "text/event-stream"},
+                    stream=_IterStream(gen()),
+                )
+            raise AssertionError(f"unexpected {req.url.path}")
+
+    c = InferenceClient("http://s1", transport=DropTransport(), backoff_s=0.0)
+    out = []
+    with pytest.raises(InferenceClientError, match="mid-generation"):
+        for ch in c.stream_chat(prompt="x"):
+            out.append(ch)
+    assert out and out[0]["text_delta"] == "He"
+
+
+def test_sdk_stream_chat_falls_back_to_queue():
+    from distributed_gpu_inference_tpu.sdk import InferenceClient
+
+    def handler(req: httpx.Request) -> httpx.Response:
+        if req.url.path == "/api/v1/jobs/direct/nearest":
+            return httpx.Response(404, json={"detail": "none"})
+        assert req.url.path == "/api/v1/jobs/sync"
+        return httpx.Response(
+            200, json={"job_id": "j", "status": "completed",
+                       "result": {"text": "fallback", "finish_reason": "stop",
+                                  "usage": {"completion_tokens": 1}}},
+        )
+
+    c = InferenceClient("http://s1", transport=httpx.MockTransport(handler),
+                        backoff_s=0.0)
+    chunks = list(c.stream_chat(prompt="x"))
+    assert chunks[0]["text_delta"] == "fallback"
+    assert chunks[-1]["done"] is True
