@@ -10,4 +10,7 @@ from distributed_gpu_inference_tpu.ops.attention import (  # noqa: F401
     dense_causal_attention,
     paged_attention,
 )
-from distributed_gpu_inference_tpu.ops.sampling import sample_tokens  # noqa: F401
+from distributed_gpu_inference_tpu.ops.sampling import (  # noqa: F401
+    sample_tokens,
+    sample_tokens_per_slot,
+)

@@ -14,14 +14,13 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def sample_tokens(
+def _nucleus_logits(
     logits: jax.Array,        # [B, V] float32
-    key: jax.Array,           # PRNG key
     temperature: jax.Array,   # [B] float32; <= 0 → greedy
     top_k: jax.Array,         # [B] int32; <= 0 → disabled
     top_p: jax.Array,         # [B] float32; >= 1 → disabled
-) -> jax.Array:
-    """Returns sampled token ids [B] int32. Fully traced — no Python branches."""
+):
+    """Shared top-k/top-p masking → (greedy_tok, nucleus_logits)."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
 
@@ -49,8 +48,42 @@ def sample_tokens(
         sorted_masked, jnp.maximum(cutoff_count - 1, 0)[:, None], axis=-1
     )
     nucleus = jnp.where(masked >= cutoff_val, masked, _NEG_INF)
+    return greedy_tok, nucleus
 
+
+def sample_tokens(
+    logits: jax.Array,        # [B, V] float32
+    key: jax.Array,           # ONE PRNG key for the whole batch
+    temperature: jax.Array,   # [B] float32; <= 0 → greedy
+    top_k: jax.Array,         # [B] int32; <= 0 → disabled
+    top_p: jax.Array,         # [B] float32; >= 1 → disabled
+) -> jax.Array:
+    """Returns sampled token ids [B] int32. Fully traced — no Python branches."""
+    greedy_tok, nucleus = _nucleus_logits(logits, temperature, top_k, top_p)
     sampled_tok = jax.random.categorical(key, nucleus, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
+
+
+def sample_tokens_per_slot(
+    logits: jax.Array,        # [B, V] float32
+    slot_keys: jax.Array,     # [B, 2] uint32 — one PRNG key per request
+    positions: jax.Array,     # [B] int32 — folded in so each step differs
+    temperature: jax.Array,   # [B] float32; <= 0 → greedy
+    top_k: jax.Array,         # [B] int32; <= 0 → disabled
+    top_p: jax.Array,         # [B] float32; >= 1 → disabled
+) -> jax.Array:
+    """Per-request randomness: each slot samples from ITS OWN key (folded
+    with the position), so a seeded request reproduces exactly regardless
+    of which other requests share the batch — the serving guarantee a
+    single batch-wide key cannot give."""
+    greedy_tok, nucleus = _nucleus_logits(logits, temperature, top_k, top_p)
+
+    def _one(k, pos, lg):
+        return jax.random.categorical(jax.random.fold_in(k, pos), lg)
+
+    sampled_tok = jax.vmap(_one)(
+        slot_keys, positions, nucleus
+    ).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
 
 

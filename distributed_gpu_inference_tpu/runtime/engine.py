@@ -34,7 +34,9 @@ import numpy as np
 
 from distributed_gpu_inference_tpu.models.configs import ModelConfig, get_model_config
 from distributed_gpu_inference_tpu.models import llama
-from distributed_gpu_inference_tpu.ops.sampling import sample_tokens
+from distributed_gpu_inference_tpu.ops.sampling import (
+    sample_tokens_per_slot,
+)
 from distributed_gpu_inference_tpu.runtime.kv_cache import (
     HostKVStore,
     PagedKVCacheManager,
@@ -163,6 +165,15 @@ class TPUEngine:
         self._top_ks = np.zeros((b,), dtype=np.int32)
         self._top_ps = np.ones((b,), dtype=np.float32)
         self._stop_ids = np.full((b, MAX_STOP_IDS), -1, dtype=np.int32)
+        # One PRNG key per slot: a seeded request's random stream is
+        # independent of which other requests share the batch. Exact token
+        # reproduction additionally requires identical logits — i.e. the
+        # same dtype and the same prefill split (prefix-cache hits change
+        # the suffix bucket, and bf16 reduction order can flip low bits);
+        # greedy requests are robust to those effects, sampled ones are
+        # reproducible given equal numerics.
+        self._slot_keys = np.zeros((b, 2), dtype=np.uint32)
+        self._host_rng = np.random.default_rng(seed + 0x5EED)
 
         self._build_jit_fns()
         self.stats: Dict[str, Any] = {
@@ -230,7 +241,7 @@ class TPUEngine:
 
         self._prefill_fn = jax.jit(prefill, donate_argnums=(1,))
 
-        def decode(params, kv, last_tokens, kv_lens, block_tables, key,
+        def decode(params, kv, last_tokens, kv_lens, block_tables, slot_keys,
                    temps, top_ks, top_ps):
             positions = (kv_lens[:, None] - 1).astype(jnp.int32)
             positions = jnp.where(kv_lens[:, None] > 0, positions, -1)
@@ -239,16 +250,18 @@ class TPUEngine:
                 block_tables, kv_lens, block_size=bs, last_only=True,
             )
             logits = out.logits[:, 0, :]
-            toks = sample_tokens(logits, key, temps, top_ks, top_ps)
+            toks = sample_tokens_per_slot(
+                logits, slot_keys, kv_lens, temps, top_ks, top_ps
+            )
             return toks, logits, out.kv
 
         self._decode_fn = jax.jit(decode, donate_argnums=(1,))
 
-        def decode_multi(params, kv, last_tokens, kv_lens, block_tables, key,
-                         temps, top_ks, top_ps, stop_ids, active, num_steps):
+        def decode_multi(params, kv, last_tokens, kv_lens, block_tables,
+                         slot_keys, temps, top_ks, top_ps, stop_ids, active,
+                         num_steps):
             def step(carry, _):
-                kv, cur_tokens, cur_lens, done, key = carry
-                key, sub = jax.random.split(key)
+                kv, cur_tokens, cur_lens, done = carry
                 positions = jnp.where(
                     (~done & (cur_lens > 0))[:, None], cur_lens[:, None] - 1, -1
                 ).astype(jnp.int32)
@@ -256,17 +269,20 @@ class TPUEngine:
                     cfg, params, cur_tokens[:, None], positions, kv,
                     block_tables, cur_lens, block_size=bs, last_only=True,
                 )
-                toks = sample_tokens(out.logits[:, 0, :], sub, temps, top_ks, top_ps)
+                toks = sample_tokens_per_slot(
+                    out.logits[:, 0, :], slot_keys, cur_lens,
+                    temps, top_ks, top_ps,
+                )
                 hit_stop = jnp.any(toks[:, None] == stop_ids, axis=1)
                 emitted = jnp.where(done, -1, toks)
                 new_done = done | hit_stop
                 new_lens = jnp.where(done, cur_lens, cur_lens + 1)
                 next_tokens = jnp.where(done, cur_tokens, toks)
-                return (out.kv, next_tokens, new_lens, new_done, key), emitted
+                return (out.kv, next_tokens, new_lens, new_done), emitted
 
             done0 = ~active
-            (kv, _, final_lens, done, _), emitted = jax.lax.scan(
-                step, (kv, last_tokens, kv_lens, done0, key), None,
+            (kv, _, final_lens, done), emitted = jax.lax.scan(
+                step, (kv, last_tokens, kv_lens, done0), None,
                 length=num_steps,
             )
             return kv, emitted.T, final_lens, done  # emitted [B, T]
@@ -387,6 +403,15 @@ class TPUEngine:
                 and len(stop) < MAX_STOP_IDS:
             stop.append(self.eos_token_id)
         self._stop_ids[slot, : len(stop)] = stop
+        # host-side key material (no device round-trip on the admission hot
+        # path): threefry PRNGKey(seed) is [seed >> 32, seed & 0xffffffff]
+        if sp.seed is not None:
+            s = int(sp.seed)
+            self._slot_keys[slot] = (s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF
+        else:
+            self._slot_keys[slot] = self._host_rng.integers(
+                0, 2**32, size=2, dtype=np.uint32
+            )
         self.stats["requests"] += 1
 
     def _submit_allocated(self, request: InferenceRequest, slot: int,
@@ -412,8 +437,10 @@ class TPUEngine:
         self.stats["prefill_tokens"] += n
         self.stats["prefill_calls"] += 1
 
-        first = sample_tokens(
-            logits, self._next_key(),
+        first = sample_tokens_per_slot(
+            logits,
+            jnp.asarray(self._slot_keys[slot : slot + 1]),
+            jnp.asarray(self._kv_lens[slot : slot + 1]),
             jnp.asarray(self._temps[slot : slot + 1]),
             jnp.asarray(self._top_ks[slot : slot + 1]),
             jnp.asarray(self._top_ps[slot : slot + 1]),
@@ -473,7 +500,7 @@ class TPUEngine:
         toks, _, self.kv = self._decode_fn(
             self.params, self.kv, jnp.asarray(self._last_tokens),
             jnp.asarray(kv_lens), jnp.asarray(self._block_tables),
-            self._next_key(), jnp.asarray(self._temps),
+            jnp.asarray(self._slot_keys), jnp.asarray(self._temps),
             jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
         )
         self.stats["decode_calls"] += 1
@@ -522,7 +549,7 @@ class TPUEngine:
         self.kv, emitted, _final_lens, _done = self._decode_multi_fn(
             self.params, self.kv, jnp.asarray(self._last_tokens),
             jnp.asarray(kv_lens), jnp.asarray(self._block_tables),
-            self._next_key(), jnp.asarray(self._temps),
+            jnp.asarray(self._slot_keys), jnp.asarray(self._temps),
             jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
             jnp.asarray(self._stop_ids), jnp.asarray(active_mask),
             num_steps,

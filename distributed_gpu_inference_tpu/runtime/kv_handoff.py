@@ -63,6 +63,9 @@ class KVHandoff:
     # timing carried across so TTFT/E2E stay end-to-end truthful
     start_time: float
     first_token_time: Optional[float]
+    # per-slot PRNG key: an UNSEEDED sampled generation keeps its exact
+    # random stream across migration (seeded ones re-derive from the seed)
+    slot_key: Optional[List[int]] = None
     # pages: [n_blocks, L, 2, block_size, n_kv_heads, head_dim]
     pages: np.ndarray = field(repr=False, default=None)  # type: ignore[assignment]
 
@@ -103,6 +106,7 @@ def export_slot_kv(engine: "TPUEngine", slot: int) -> KVHandoff:
         generated=list(s.generated),
         start_time=s.start_time,
         first_token_time=s.first_token_time,
+        slot_key=[int(x) for x in engine._slot_keys[slot]],
         pages=pages,
     )
 
@@ -172,6 +176,10 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
         )
         engine._bind_slot(slot, s, kv_len=handoff.kv_len)
         engine._last_tokens[slot] = handoff.pending_token
+        if handoff.slot_key is not None:
+            # restore the donor's random stream exactly (unseeded sampled
+            # generations continue bit-for-bit too)
+            engine._slot_keys[slot] = np.asarray(handoff.slot_key, np.uint32)
         engine._apply_pending()
     except Exception:
         engine.slots[slot] = None
@@ -221,6 +229,7 @@ def serialize_handoff(h: KVHandoff, compress: bool = True) -> bytes:
         "generated": h.generated,
         "start_time": h.start_time,
         "first_token_time": h.first_token_time,
+        "slot_key": h.slot_key,
     }
     buf = io.BytesIO()
     mb = _pack_header(meta)
@@ -260,5 +269,6 @@ def deserialize_handoff(data: bytes) -> KVHandoff:
         generated=meta["generated"],
         start_time=meta["start_time"],
         first_token_time=meta["first_token_time"],
+        slot_key=meta.get("slot_key"),
         pages=pages,
     )
