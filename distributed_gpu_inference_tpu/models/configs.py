@@ -27,6 +27,7 @@ class ModelConfig:
     rope_theta: float = 500000.0
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
+    attention_bias: bool = False             # Qwen2-style QKV biases
     dtype: str = "bfloat16"
 
     def __post_init__(self) -> None:
@@ -110,6 +111,27 @@ MODEL_REGISTRY: Dict[str, ModelConfig] = {
         "llama3-70b", vocab_size=128256, hidden_size=8192, num_layers=80,
         num_heads=64, num_kv_heads=8, intermediate_size=28672,
         max_position_embeddings=8192,
+    ),
+    # Qwen2.5 family (the reference's single-worker benchmark default is
+    # Qwen2.5-7B, benchmarks/single_worker.py:446) — same decoder recipe
+    # with QKV biases and 1e6 rope theta
+    "qwen2.5-tiny": _llama(  # test-scale
+        "qwen2.5-tiny", vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, intermediate_size=128,
+        max_position_embeddings=1024, rope_theta=10000.0,
+        attention_bias=True, tie_word_embeddings=True,
+    ),
+    "qwen2.5-0.5b": _llama(
+        "qwen2.5-0.5b", vocab_size=151936, hidden_size=896, num_layers=24,
+        num_heads=14, num_kv_heads=2, intermediate_size=4864,
+        max_position_embeddings=32768, rope_theta=1000000.0,
+        rms_norm_eps=1e-6, attention_bias=True, tie_word_embeddings=True,
+    ),
+    "qwen2.5-7b": _llama(
+        "qwen2.5-7b", vocab_size=152064, hidden_size=3584, num_layers=28,
+        num_heads=28, num_kv_heads=4, intermediate_size=18944,
+        max_position_embeddings=32768, rope_theta=1000000.0,
+        rms_norm_eps=1e-6, attention_bias=True,
     ),
 }
 

@@ -49,7 +49,7 @@ def init_params(
     h, d = cfg.hidden_size, cfg.head_dim
     nh, nkv, i = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
     L, v = cfg.num_layers, cfg.vocab_size
-    keys = jax.random.split(key, 8)
+    keys = jax.random.split(key, 9)
 
     def _w(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)).astype(
@@ -71,8 +71,15 @@ def init_params(
         },
         "final_norm": jnp.ones((h,), dtype),
     }
+    if cfg.attention_bias:  # Qwen2-style QKV biases (random init ~ small)
+        bkeys = jax.random.split(keys[1], 3)
+        params["layers"]["bq"] = _w(bkeys[0], (L, nh * d), nh * d)
+        params["layers"]["bk"] = _w(bkeys[1], (L, nkv * d), nkv * d)
+        params["layers"]["bv"] = _w(bkeys[2], (L, nkv * d), nkv * d)
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = _w(keys[0], (v, h), h)
+        # distinct key: an untied head must not be bit-identical to the
+        # embedding, or head/embedding swap bugs become invisible to tests
+        params["lm_head"] = _w(keys[8], (v, h), h)
     return params
 
 
@@ -189,9 +196,16 @@ def _layer_step(
     nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     x = rms_norm(hidden, lp["attn_norm"], cfg.rms_norm_eps)
-    q = (x @ lp["wq"]).reshape(b, s, nh, d)
-    k = (x @ lp["wk"]).reshape(b, s, nkv, d)
-    v = (x @ lp["wv"]).reshape(b, s, nkv, d)
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if "bq" in lp:  # Qwen2-style attention biases (static at trace time)
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(b, s, nh, d)
+    k = k.reshape(b, s, nkv, d)
+    v = v.reshape(b, s, nkv, d)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 

@@ -29,6 +29,10 @@ _HF_LAYER_MAP = {
     "self_attn.k_proj.weight": ("wk", True),
     "self_attn.v_proj.weight": ("wv", True),
     "self_attn.o_proj.weight": ("wo", True),
+    # Qwen2-style attention biases (absent in Llama checkpoints)
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
     "post_attention_layernorm.weight": ("mlp_norm", False),
     "mlp.gate_proj.weight": ("w_gate", True),
     "mlp.up_proj.weight": ("w_up", True),
@@ -111,6 +115,8 @@ def load_hf_llama(
 
 def _validate(params: Dict[str, Any], cfg: ModelConfig, rng: BlockRange) -> None:
     expected = set(_HF_LAYER_MAP[k][0] for k in _HF_LAYER_MAP)
+    if not cfg.attention_bias:  # Llama-family checkpoints carry no biases
+        expected -= {"bq", "bk", "bv"}
     got = set(params["layers"].keys())
     if got != expected:
         raise ValueError(f"checkpoint missing layer params: {expected - got}")
@@ -139,14 +145,11 @@ def save_checkpoint(path: str | Path, params: Dict[str, Any],
     ckptr.save(path / "params", params)
     ckptr.wait_until_finished()
     if cfg is not None:
-        (path / "model_config.json").write_text(
-            json.dumps({k: getattr(cfg, k) for k in (
-                "name", "vocab_size", "hidden_size", "num_layers", "num_heads",
-                "num_kv_heads", "intermediate_size", "head_dim",
-                "max_position_embeddings", "rope_theta", "rms_norm_eps",
-                "tie_word_embeddings", "dtype",
-            )})
-        )
+        from dataclasses import asdict
+
+        # dump EVERY config field: a hand-kept list silently drops new
+        # fields (attention_bias once went missing this way)
+        (path / "model_config.json").write_text(json.dumps(asdict(cfg)))
 
 
 def load_checkpoint(path: str | Path, template: Optional[Dict[str, Any]] = None

@@ -132,6 +132,9 @@ def stage_param_shardings(mesh: Mesh) -> Dict[str, Any]:
             "wk": _l(None, None),
             "wv": _l(None, None),
             "wo": _l(None, None),
+            "bq": _l(None),
+            "bk": _l(None),
+            "bv": _l(None),
             "mlp_norm": _l(None),
             "w_gate": _l(None, None),
             "w_up": _l(None, None),
@@ -143,11 +146,11 @@ def stage_param_shardings(mesh: Mesh) -> Dict[str, Any]:
 
 
 def shard_params_stages(params: llama.Params, mesh: Mesh) -> llama.Params:
-    rules = stage_param_shardings(mesh)
-    if "lm_head" not in params:
-        rules = dict(rules)
-        rules.pop("lm_head")
-    return jax.device_put(params, rules)
+    from distributed_gpu_inference_tpu.parallel.sharding import prune_rules
+
+    return jax.device_put(
+        params, prune_rules(stage_param_shardings(mesh), params)
+    )
 
 
 def stage_kv_sharding(mesh: Mesh) -> NamedSharding:

@@ -54,6 +54,10 @@ def param_shardings(mesh: Mesh) -> Dict[str, Any]:
             "wk": _ns(mesh, None, None, AXIS_MODEL),
             "wv": _ns(mesh, None, None, AXIS_MODEL),
             "wo": _ns(mesh, None, AXIS_MODEL, None),
+            # Qwen2-style attention biases follow their projection's out dim
+            "bq": _ns(mesh, None, AXIS_MODEL),
+            "bk": _ns(mesh, None, AXIS_MODEL),
+            "bv": _ns(mesh, None, AXIS_MODEL),
             "mlp_norm": _ns(mesh, None, None),
             "w_gate": _ns(mesh, None, None, AXIS_MODEL),
             "w_up": _ns(mesh, None, None, AXIS_MODEL),
@@ -81,17 +85,26 @@ def batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
     }
 
 
+def prune_rules(rules: Dict[str, Any], params: Dict[str, Any]) -> Dict[str, Any]:
+    """Restrict a sharding-rule pytree to the keys this model actually has
+    (lm_head absent when tied; bias keys absent for bias-free families).
+    Shared by the TP and pipeline pruners so they cannot drift."""
+    rules = dict(rules)
+    rules["layers"] = {
+        k: v for k, v in rules["layers"].items() if k in params["layers"]
+    }
+    if "lm_head" not in params:
+        rules.pop("lm_head", None)
+    return rules
+
+
 def shard_params(params: Dict[str, Any], mesh: Mesh) -> Dict[str, Any]:
     """device_put the params pytree onto the mesh under the TP rules.
 
     (With single-host multi-device this is a local reshard; multi-host uses
     the same rules via jax.make_array_from_process_local_data in the loader.)
     """
-    rules = param_shardings(mesh)
-    if "lm_head" not in params:
-        rules = dict(rules)
-        rules.pop("lm_head")
-    return jax.device_put(params, rules)
+    return jax.device_put(params, prune_rules(param_shardings(mesh), params))
 
 
 def shard_kv(kv: Dict[str, jax.Array], mesh: Mesh) -> Dict[str, jax.Array]:

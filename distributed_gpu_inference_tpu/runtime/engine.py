@@ -154,7 +154,6 @@ class TPUEngine:
             spill_on_evict=spill,
         )
         self.eos_token_id = eos_token_id
-        self._rng = jax.random.PRNGKey(seed + 1)
 
         b, m = self.cfg.max_batch_size, self.cfg.max_blocks_per_seq
         self.slots: List[Optional[_Slot]] = [None] * b
@@ -339,10 +338,6 @@ class TPUEngine:
             f"{self.cfg.prefill_buckets[-1]}"
         )
 
-    def _next_key(self) -> jax.Array:
-        self._rng, sub = jax.random.split(self._rng)
-        return sub
-
     # -------------------------------------------------------- slot API
 
     def free_slots(self) -> List[int]:
@@ -406,8 +401,10 @@ class TPUEngine:
         # host-side key material (no device round-trip on the admission hot
         # path): threefry PRNGKey(seed) is [seed >> 32, seed & 0xffffffff]
         if sp.seed is not None:
-            s = int(sp.seed)
-            self._slot_keys[slot] = (s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF
+            seed_val = int(sp.seed)
+            self._slot_keys[slot] = (
+                (seed_val >> 32) & 0xFFFFFFFF, seed_val & 0xFFFFFFFF
+            )
         else:
             self._slot_keys[slot] = self._host_rng.integers(
                 0, 2**32, size=2, dtype=np.uint32
