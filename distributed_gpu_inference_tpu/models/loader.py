@@ -119,7 +119,16 @@ def _validate(params: Dict[str, Any], cfg: ModelConfig, rng: BlockRange) -> None
         expected -= {"bq", "bk", "bv"}
     got = set(params["layers"].keys())
     if got != expected:
-        raise ValueError(f"checkpoint missing layer params: {expected - got}")
+        missing, extra = expected - got, got - expected
+        parts = []
+        if missing:
+            parts.append(f"missing {sorted(missing)}")
+        if extra:
+            parts.append(
+                f"unexpected {sorted(extra)} (a biased checkpoint needs a "
+                "config with attention_bias=True)"
+            )
+        raise ValueError("checkpoint layer params: " + "; ".join(parts))
     L = rng.num_layers
     for k, v in params["layers"].items():
         if v.shape[0] != L:

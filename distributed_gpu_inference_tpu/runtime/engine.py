@@ -365,9 +365,6 @@ class TPUEngine:
                 f"prompt {len(token_ids)} + max_new {request.sampling.max_new_tokens}"
                 f" exceeds max_seq_len {self.cfg.max_seq_len}"
             )
-        # validate the worst-case prefill chunk BEFORE allocating anything so
-        # a rejected request can't leak blocks or occupy the slot
-        self._bucket_len(len(token_ids))
         seq_id = request.session_id or uuid.uuid4().hex
         blocks, cached = self.manager.allocate_sequence(seq_id, token_ids)
         try:
@@ -418,21 +415,36 @@ class TPUEngine:
                   cached_tokens=cached)
         self._bind_slot(slot, s, kv_len=len(token_ids))
 
-        # prefill the uncached suffix, bucketed
+        # CHUNKED prefill of the uncached suffix: prompts longer than the
+        # largest bucket split into full-bucket pieces + a bucketed tail, so
+        # long contexts need no giant compile and no dynamic shapes
+        # (reference delegates this to vLLM's chunked-prefill flag,
+        # llm_vllm.py:61 — first-party here). Each chunk attends to all
+        # prior context via kv_len_after; only the final chunk's logits
+        # (the last prompt token) are consumed.
         fresh = token_ids[cached:]
-        n = len(fresh)
-        bucket = self._bucket_len(n)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :n] = fresh
-        pos = np.full((1, bucket), -1, np.int32)
-        pos[0, :n] = np.arange(cached, cached + n)
-        logits, self.kv = self._prefill_fn(
-            self.params, self.kv, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(self._block_tables[slot : slot + 1]),
-            jnp.asarray(self._kv_lens[slot : slot + 1]),
-        )
-        self.stats["prefill_tokens"] += n
-        self.stats["prefill_calls"] += 1
+        max_bucket = self.cfg.prefill_buckets[-1]
+        off = cached
+        logits = None
+        while True:
+            piece = fresh[: max_bucket]
+            fresh = fresh[max_bucket:]
+            n = len(piece)
+            bucket = max_bucket if fresh else self._bucket_len(n)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = piece
+            pos = np.full((1, bucket), -1, np.int32)
+            pos[0, :n] = np.arange(off, off + n)
+            logits, self.kv = self._prefill_fn(
+                self.params, self.kv, jnp.asarray(toks), jnp.asarray(pos),
+                jnp.asarray(self._block_tables[slot : slot + 1]),
+                jnp.asarray([off + n], np.int32),
+            )
+            off += n
+            self.stats["prefill_tokens"] += n
+            self.stats["prefill_calls"] += 1
+            if not fresh:
+                break
 
         first = sample_tokens_per_slot(
             logits,

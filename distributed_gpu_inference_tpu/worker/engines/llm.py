@@ -82,6 +82,7 @@ class TPULLMEngine(LLMBaseEngine):
     def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
         super().__init__(config)
         self.engine: Optional[TPUEngine] = None
+        self._spec = None            # EAGLE-style decoder (engine=jax-speculative)
         self.tokenizer = self.config.get("tokenizer")
 
     # -- lifecycle -----------------------------------------------------------
@@ -131,10 +132,45 @@ class TPULLMEngine(LLMBaseEngine):
             # invalid mesh/model combination must drop the task type, not
             # kill worker startup (load_engines catches EngineLoadError)
             raise EngineLoadError(str(exc)) from exc
+        # engine=jax-speculative: short-prompt greedy requests route through
+        # the EAGLE-style tree decoder (shares the TARGET weights with the
+        # paged engine but owns its own KV pool — sized to exactly one
+        # batch's worst case to bound the extra HBM); sampled, streaming,
+        # and beyond-bucket-length requests keep using the paged TPUEngine.
+        if self.config.get("engine") in ("jax-speculative", "speculative"):
+            try:
+                from ...runtime.speculative import (
+                    SpeculativeConfig,
+                    SpeculativeDecoder,
+                )
+
+                raw_w = self.config.get("spec_widths") or (4, 2, 2)
+                if isinstance(raw_w, str):          # CLI/env: "4,2,2"
+                    raw_w = [p for p in raw_w.split(",") if p.strip()]
+                widths = tuple(int(w) for w in raw_w)
+                if not widths or any(w < 1 for w in widths):
+                    raise ValueError(f"invalid spec_widths {widths}")
+                blocks_per_seq = -(-eng_cfg.max_seq_len // eng_cfg.block_size)
+                self._spec = SpeculativeDecoder(
+                    model_name,
+                    params=self.engine.params,
+                    spec_cfg=SpeculativeConfig(widths=widths),
+                    max_batch_size=eng_cfg.max_batch_size,
+                    max_seq_len=eng_cfg.max_seq_len,
+                    num_blocks=eng_cfg.max_batch_size * blocks_per_seq + 2,
+                    prefill_buckets=eng_cfg.prefill_buckets,
+                )
+            except (ValueError, TypeError) as exc:
+                # a bad speculative config drops the task type, never kills
+                # worker startup
+                raise EngineLoadError(
+                    f"speculative engine config invalid: {exc}"
+                ) from exc
         self.loaded = True
 
     def unload(self) -> None:
         self.engine = None
+        self._spec = None
         super().unload()
 
     # -- core generate ---------------------------------------------------------
@@ -188,7 +224,20 @@ class TPULLMEngine(LLMBaseEngine):
                   cfg: GenerationConfig) -> GenerationResult:
         req = self._build_request(prompt_or_messages, cfg)
         t0 = time.perf_counter()
-        resp = self.engine.generate([req], use_multi_step=True)[0]
+        # speculative path only for greedy prompts within one prefill
+        # bucket: the tree decoder's prefill is single-shot, so longer
+        # prompts take the paged engine's CHUNKED prefill instead of
+        # compiling per prompt length
+        use_spec = (
+            self._spec is not None
+            and cfg.temperature <= 0.0
+            and len(req.prompt_token_ids or [])
+            <= self.engine.cfg.prefill_buckets[-1]
+        )
+        if use_spec:
+            resp = self._spec.generate([req])[0]
+        else:
+            resp = self.engine.generate([req], use_multi_step=True)[0]
         e2e_ms = (time.perf_counter() - t0) * 1000.0
         out_text = self.tokenizer.decode(resp.token_ids)
         finish = resp.finish_reason or "stop"
