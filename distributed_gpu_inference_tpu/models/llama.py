@@ -31,6 +31,7 @@ from jax import lax
 
 from distributed_gpu_inference_tpu.models.configs import ModelConfig
 from distributed_gpu_inference_tpu.ops.attention import paged_attention
+from distributed_gpu_inference_tpu.ops.quantization import matmul as qmm
 
 Params = Dict[str, Any]
 KVPools = Dict[str, jax.Array]  # {"k": [L,N,Bk,Hkv,D], "v": [L,N,Bk,Hkv,D]}
@@ -161,8 +162,8 @@ def _write_kv_pages(
 
 
 def _mlp(x: jax.Array, lp: Dict[str, jax.Array]) -> jax.Array:
-    gate = jax.nn.silu(x @ lp["w_gate"])
-    return ((gate * (x @ lp["w_up"])) @ lp["w_down"]).astype(x.dtype)
+    gate = jax.nn.silu(qmm(x, lp["w_gate"]))
+    return qmm(gate * qmm(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -196,9 +197,9 @@ def _layer_step(
     nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
     x = rms_norm(hidden, lp["attn_norm"], cfg.rms_norm_eps)
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = qmm(x, lp["wq"])
+    k = qmm(x, lp["wk"])
+    v = qmm(x, lp["wv"])
     if "bq" in lp:  # Qwen2-style attention biases (static at trace time)
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -217,7 +218,7 @@ def _layer_step(
     v_pool = lax.dynamic_update_index_in_dim(v_pool, layer_v, layer_idx, 0)
 
     attn = attn_fn(q, layer_k, layer_v)
-    hidden = hidden + (attn.reshape(b, s, nh * d) @ lp["wo"]).astype(hidden.dtype)
+    hidden = hidden + qmm(attn.reshape(b, s, nh * d), lp["wo"]).astype(hidden.dtype)
     hidden = hidden + _mlp(
         rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps), lp
     )

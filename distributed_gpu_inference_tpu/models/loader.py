@@ -124,10 +124,12 @@ def _validate(params: Dict[str, Any], cfg: ModelConfig, rng: BlockRange) -> None
         if missing:
             parts.append(f"missing {sorted(missing)}")
         if extra:
-            parts.append(
-                f"unexpected {sorted(extra)} (a biased checkpoint needs a "
-                "config with attention_bias=True)"
+            hint = (
+                " (a biased checkpoint needs a config with "
+                "attention_bias=True)"
+                if extra <= {"bq", "bk", "bv"} else ""
             )
+            parts.append(f"unexpected {sorted(extra)}{hint}")
         raise ValueError("checkpoint layer params: " + "; ".join(parts))
     L = rng.num_layers
     for k, v in params["layers"].items():

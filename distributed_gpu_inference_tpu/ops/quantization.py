@@ -1,0 +1,111 @@
+"""TPU-native weight-only quantization: int8 / fp8 with per-channel scales.
+
+The reference exposes quantization purely as engine passthrough flags —
+AWQ/GPTQ/FP8/INT8 strings handed to vLLM (``worker/engines/llm_vllm.py:83-87``)
+and SGLang; the actual kernels live in those CUDA deps. Here quantization is
+first-party and TPU-shaped:
+
+- **Storage**: matmul weights live in HBM as int8 (or float8_e4m3) with a
+  float32 per-output-channel scale. Decode is HBM-bandwidth-bound on TPU, so
+  halving (bf16→int8) weight bytes directly raises tokens/s at low batch.
+- **Compute**: the MXU consumes bf16; XLA fuses the int8→bf16 convert into
+  the matmul's HBM read, then one multiply by the channel scale on the
+  [..., out] result. No custom kernels needed — this is the
+  convert-fused weight-only scheme (AQT-style), not emulated CUDA GEMMs.
+- **Pytree shape**: a quantized weight is a sub-dict ``{"qw", "scale"}`` whose
+  leaves both carry the stacked leading L axis, so ``lax.scan`` over layers,
+  GSPMD sharding, and pipeline stage slicing all keep working unchanged.
+
+``matmul(x, w)`` is the single dispatch point: models call it for every
+projection and it transparently handles plain or quantized leaves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+QUANT_MODES = ("int8", "fp8")
+
+# weight leaves eligible for quantization (matmul weights only: norms, biases,
+# and the embedding table stay high-precision)
+QUANT_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+     # MoE expert weights (stacked [L, E, in, out]) share the same scheme;
+     # the router projection stays high-precision — quantizing it perturbs
+     # top-k expert selection far more than it saves in bytes
+     "we_gate", "we_up", "we_down"}
+)
+
+_FP8_MAX = 448.0  # float8_e4m3 largest finite value
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "qw" in w and "scale" in w
+
+
+def quantize_weight(w: jax.Array, mode: str) -> Dict[str, jax.Array]:
+    """Symmetric per-output-channel quantization of ``w [..., in, out]``.
+
+    Scale reduces the contraction axis (-2) only: shape ``[..., 1, out]`` —
+    per layer (leading axes) and per output channel, the granularity that
+    keeps GQA/MLP projections accurate without zero points.
+    """
+    if mode not in QUANT_MODES:
+        raise ValueError(f"unknown quantization mode {mode!r}; use {QUANT_MODES}")
+    wf = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)
+    if mode == "int8":
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        qw = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    else:  # fp8
+        scale = jnp.where(amax > 0, amax / _FP8_MAX, 1.0)
+        qw = (wf / scale).astype(jnp.float8_e4m3fn)
+    return {"qw": qw, "scale": scale.astype(jnp.float32)}
+
+
+def dequantize(w: Dict[str, jax.Array], dtype: Any = jnp.float32) -> jax.Array:
+    return (w["qw"].astype(jnp.float32) * w["scale"]).astype(dtype)
+
+
+def matmul(x: jax.Array, w: Any) -> jax.Array:
+    """``x @ w`` where ``w`` is a plain array or a quantized sub-dict.
+
+    Quantized path: convert-on-read matmul in x.dtype (bf16 on the MXU),
+    then scale the output channels. The scale broadcast ``[..., 1, out]``
+    collapses against ``x @ qw``'s trailing [..., out].
+    """
+    if not is_quantized(w):
+        return x @ w
+    out = x @ w["qw"].astype(x.dtype)
+    # scale shape [..., 1, out] → drop the kept contraction axis for broadcast
+    scale = jnp.squeeze(w["scale"], axis=-2).astype(jnp.float32)
+    return (out.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def quantize_params(
+    params: Dict[str, Any], mode: Optional[str]
+) -> Dict[str, Any]:
+    """Quantize every eligible matmul weight in a model params pytree.
+
+    Structure-preserving everywhere else; returns a new pytree (input leaves
+    are not mutated). ``mode=None`` is the identity.
+    """
+    if mode is None:
+        return params
+    out = dict(params)
+    out["layers"] = {
+        k: (quantize_weight(v, mode)
+            if (k in QUANT_KEYS and not is_quantized(v)) else v)
+        for k, v in params["layers"].items()
+    }
+    return out
+
+
+def param_bytes(params: Dict[str, Any]) -> int:
+    """Total HBM bytes of a params pytree (quantized or not)."""
+    return sum(
+        leaf.dtype.itemsize * leaf.size for leaf in jax.tree.leaves(params)
+    )

@@ -98,7 +98,12 @@ def slice_stage_params(
     keeps the embedding, last keeps final_norm + lm_head (reference
     ``model_shard.py:163-171``)."""
     out: llama.Params = {
-        "layers": {k: v[start:end] for k, v in params["layers"].items()}
+        # tree.map: a layer value may be a quantized {"qw","scale"} sub-dict
+        # whose leaves both carry the stacked L axis
+        "layers": {
+            k: jax.tree.map(lambda a: a[start:end], v)
+            for k, v in params["layers"].items()
+        }
     }
     if start == 0:
         out["embedding"] = params["embedding"]

@@ -85,13 +85,32 @@ def batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
     }
 
 
+def _quantized_leaf_rules(rule: NamedSharding, leaf: Dict[str, Any]) -> Dict[str, Any]:
+    """Expand a weight's sharding rule over a quantized ``{"qw","scale"}``
+    sub-dict: qw keeps the weight spec; the scale drops axis names wherever
+    its (size-1, reduced) dims can't carry a shard."""
+    spec = tuple(rule.spec) + (None,) * (leaf["qw"].ndim - len(tuple(rule.spec)))
+    scale_spec = tuple(
+        s if (i < leaf["scale"].ndim and leaf["scale"].shape[i] > 1) else None
+        for i, s in enumerate(spec)
+    )
+    return {
+        "qw": rule,
+        "scale": NamedSharding(rule.mesh, P(*scale_spec)),
+    }
+
+
 def prune_rules(rules: Dict[str, Any], params: Dict[str, Any]) -> Dict[str, Any]:
     """Restrict a sharding-rule pytree to the keys this model actually has
-    (lm_head absent when tied; bias keys absent for bias-free families).
-    Shared by the TP and pipeline pruners so they cannot drift."""
+    (lm_head absent when tied; bias keys absent for bias-free families), and
+    expand rules over quantized weight sub-dicts so the rule tree's structure
+    matches the params tree exactly. Shared by the TP and pipeline pruners so
+    they cannot drift."""
     rules = dict(rules)
     rules["layers"] = {
-        k: v for k, v in rules["layers"].items() if k in params["layers"]
+        k: (_quantized_leaf_rules(v, params["layers"][k])
+            if isinstance(params["layers"][k], dict) else v)
+        for k, v in rules["layers"].items() if k in params["layers"]
     }
     if "lm_head" not in params:
         rules.pop("lm_head", None)

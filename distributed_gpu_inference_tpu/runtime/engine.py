@@ -34,6 +34,7 @@ import numpy as np
 
 from distributed_gpu_inference_tpu.models.configs import ModelConfig, get_model_config
 from distributed_gpu_inference_tpu.models import llama
+from distributed_gpu_inference_tpu.ops.quantization import quantize_params
 from distributed_gpu_inference_tpu.ops.sampling import (
     sample_tokens_per_slot,
 )
@@ -62,6 +63,10 @@ class EngineConfig:
     enable_prefix_cache: bool = True
     multi_step: int = 16                  # scan horizon for decode_multi
     dtype: str = "bfloat16"
+    # weight-only quantization (ops/quantization.py): int8 | fp8 | None —
+    # first-party TPU replacement for the reference's vLLM passthrough flags
+    # (worker/engines/llm_vllm.py:83-87 AWQ/GPTQ/FP8/INT8)
+    quantization: Optional[str] = None
     # spill tiers (reference HBM→CPU→Redis chain): 0 disables the host tier
     spill_host_blocks: int = 0
     spill_remote_store: Optional[Any] = None   # RemoteKVStore-like (L3)
@@ -131,7 +136,7 @@ class TPUEngine:
                     f"divisible by model axis {tp}"
                 )
         if params is not None:
-            self.params = params
+            self.params = quantize_params(params, self.cfg.quantization)
             if mesh is not None:
                 from distributed_gpu_inference_tpu.parallel import sharding as _sh
 
@@ -191,16 +196,23 @@ class TPUEngine:
         )
 
         if self.mesh is None:
-            return load_or_init_params(
-                self.model_cfg, checkpoint_path=checkpoint_path,
-                dtype=self.cfg.dtype, seed=seed,
+            return quantize_params(
+                load_or_init_params(
+                    self.model_cfg, checkpoint_path=checkpoint_path,
+                    dtype=self.cfg.dtype, seed=seed,
+                ),
+                self.cfg.quantization,
             )
-        # build on the host CPU backend, then device_put host→shards direct
+        # build (and quantize) on the host CPU backend, then device_put
+        # host→shards direct — int8/fp8 leaves ship half the bytes
         cpu = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu):
-            host_params = load_or_init_params(
-                self.model_cfg, checkpoint_path=checkpoint_path,
-                dtype=self.cfg.dtype, seed=seed,
+            host_params = quantize_params(
+                load_or_init_params(
+                    self.model_cfg, checkpoint_path=checkpoint_path,
+                    dtype=self.cfg.dtype, seed=seed,
+                ),
+                self.cfg.quantization,
             )
         from distributed_gpu_inference_tpu.parallel import sharding as _sh
 

@@ -74,7 +74,8 @@ def _load_hf_tokenizer(tokenizer_id: str):
 class TPULLMEngine(LLMBaseEngine):
     """config keys: model (name in models/configs registry), tokenizer /
     tokenizer_id, max_batch_size, max_seq_len, multi_step,
-    enable_prefix_cache, checkpoint_path (orbax/HF weights via models.loader).
+    enable_prefix_cache, checkpoint_path (orbax/HF weights via models.loader),
+    quantization (int8 | fp8 weight-only, ops/quantization.py).
     """
 
     task_type = "llm"
@@ -101,6 +102,7 @@ class TPULLMEngine(LLMBaseEngine):
             enable_prefix_cache=bool(
                 self.config.get("enable_prefix_cache", True)
             ),
+            quantization=self.config.get("quantization"),
         )
         # first-class TP: tp_size > 1 builds a model-axis mesh over local
         # devices (the reference forwarded tensor_parallel_size to vLLM;

@@ -1,0 +1,212 @@
+"""Weight-only quantization (int8/fp8): roundtrip accuracy, model forward
+parity, engine integration, TP sharding, and pipeline stage slicing.
+
+Reference parity: vLLM quantization passthrough flags
+(``worker/engines/llm_vllm.py:83-87`` AWQ/GPTQ/FP8/INT8) — here the scheme is
+first-party (``ops/quantization.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.models import llama
+from distributed_gpu_inference_tpu.models.configs import get_model_config
+from distributed_gpu_inference_tpu.ops import quantization as q
+from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+
+# ---------------------------------------------------------------- roundtrip
+
+
+@pytest.mark.parametrize("mode,tol", [("int8", 0.01), ("fp8", 0.04)])
+def test_roundtrip_error_bounded(mode, tol):
+    w = jax.random.normal(jax.random.PRNGKey(0), (3, 64, 48), jnp.float32)
+    qw = q.quantize_weight(w, mode)
+    assert qw["qw"].shape == w.shape
+    assert qw["scale"].shape == (3, 1, 48)
+    back = q.dequantize(qw)
+    rel = float(jnp.max(jnp.abs(back - w)) / jnp.max(jnp.abs(w)))
+    assert rel < tol
+
+
+def test_int8_storage_dtype_and_bytes():
+    w = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32), jnp.bfloat16)
+    qw = q.quantize_weight(w, "int8")
+    assert qw["qw"].dtype == jnp.int8
+    assert qw["scale"].dtype == jnp.float32
+    # int8 payload is half the bf16 bytes (scales are negligible)
+    assert qw["qw"].nbytes == w.nbytes // 2
+
+
+def test_zero_channel_safe():
+    w = jnp.zeros((1, 8, 8), jnp.float32)
+    qw = q.quantize_weight(w, "int8")
+    assert np.all(np.asarray(q.dequantize(qw)) == 0.0)
+
+
+def test_matmul_dispatch_plain_and_quantized():
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (4, 64), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(3), (64, 32), jnp.float32) * 0.05
+    exact = x @ w
+    approx = q.matmul(x, q.quantize_weight(w, "int8"))
+    assert q.matmul(x, w).shape == approx.shape == exact.shape
+    err = float(jnp.max(jnp.abs(approx - exact)))
+    scale = float(jnp.max(jnp.abs(exact))) + 1e-9
+    assert err / scale < 0.02
+
+
+def test_unknown_mode_raises():
+    with pytest.raises(ValueError):
+        q.quantize_weight(jnp.ones((2, 2)), "awq")
+
+
+# ------------------------------------------------------------- model parity
+
+
+def _forward_last_logits(cfg, params, tokens):
+    b, s = tokens.shape
+    kv = llama.init_kv_pools(cfg, num_blocks=8, block_size=16,
+                             dtype=jnp.float32)
+    tables = np.tile(np.arange(1, 5, dtype=np.int32), (b, 1))
+    positions = np.tile(np.arange(s, dtype=np.int32), (b, 1))
+    out = llama.forward_chunk(
+        cfg, params, jnp.asarray(tokens), jnp.asarray(positions), kv,
+        jnp.asarray(tables), jnp.full((b,), s, jnp.int32),
+        block_size=16, last_only=True,
+    )
+    return np.asarray(out.logits[:, 0, :])
+
+
+@pytest.mark.parametrize("mode,tol,min_cos", [
+    ("int8", 0.08, 0.999),
+    ("fp8", 0.25, 0.99),   # e4m3: 3 mantissa bits → ~6% per-element step
+])
+def test_forward_parity_quantized_vs_full(mode, tol, min_cos):
+    cfg = get_model_config("llama3-tiny", dtype="float32")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    tokens = np.array([[5, 17, 3, 99, 42, 7, 256, 31]], np.int32)
+    full = _forward_last_logits(cfg, params, tokens)
+    quant = _forward_last_logits(cfg, q.quantize_params(params, mode), tokens)
+    # a random-init model has near-uniform logits — the hardest case for
+    # argmax stability, so parity is asserted on the logit field itself
+    denom = np.max(np.abs(full)) + 1e-9
+    assert np.max(np.abs(full - quant)) / denom < tol
+    cos = float(
+        np.dot(full.ravel(), quant.ravel())
+        / (np.linalg.norm(full) * np.linalg.norm(quant) + 1e-9)
+    )
+    assert cos > min_cos
+
+
+def test_quantize_params_structure_and_bytes():
+    cfg = get_model_config("llama3-mini")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qp = q.quantize_params(params, "int8")
+    for k in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        assert q.is_quantized(qp["layers"][k])
+    # norms/embedding untouched; None is identity
+    assert qp["layers"]["attn_norm"] is params["layers"]["attn_norm"]
+    assert qp["embedding"] is params["embedding"]
+    assert q.quantize_params(params, None) is params
+    assert q.param_bytes(qp) < 0.7 * q.param_bytes(params)
+
+
+# ---------------------------------------------------------------- engine e2e
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_engine_generates_quantized(mode):
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=2, max_seq_len=64, block_size=16,
+                     prefill_buckets=(16,), dtype="float32",
+                     quantization=mode),
+    )
+    reqs = [
+        InferenceRequest(
+            prompt_token_ids=[5, 17, 3, 99, 42],
+            sampling=SamplingParams(max_new_tokens=8, temperature=0.0),
+        )
+    ]
+    outs = eng.generate(reqs)
+    assert len(outs) == 1
+    assert len(outs[0].token_ids) == 8
+    assert all(0 <= t < eng.model_cfg.vocab_size for t in outs[0].token_ids)
+    assert q.param_bytes(eng.params) < 0.7 * q.param_bytes(
+        llama.init_params(eng.model_cfg, jax.random.PRNGKey(0), jnp.float32)
+    )
+
+
+def test_engine_quantized_matches_full_greedy():
+    """Greedy decode: int8 engine should emit the same tokens as full
+    precision on the tiny model (ample logit margins at random init)."""
+    def run(quant):
+        eng = TPUEngine(
+            "llama3-tiny",
+            EngineConfig(max_batch_size=1, max_seq_len=64, block_size=16,
+                         prefill_buckets=(16,), dtype="float32",
+                         quantization=quant),
+            seed=0,
+        )
+        out = eng.generate([
+            InferenceRequest(
+                prompt_token_ids=[5, 17, 3, 99, 42, 7, 256, 31],
+                sampling=SamplingParams(max_new_tokens=10, temperature=0.0),
+            )
+        ])
+        return out[0].token_ids
+
+    # near-uniform random-init logits eventually diverge under quantization
+    # noise; the leading tokens must still agree
+    assert run(None)[:6] == run("int8")[:6]
+
+
+# -------------------------------------------------------- sharding / stages
+
+
+def test_tp_sharded_quantized_engine(cpu_devices):
+    from distributed_gpu_inference_tpu.parallel.mesh import MeshPlan, make_mesh
+
+    mesh = make_mesh(MeshPlan(model=2), cpu_devices[:2],
+                     keep_trivial_axes=False)
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=2, max_seq_len=64, block_size=16,
+                     prefill_buckets=(16,), dtype="float32",
+                     quantization="int8"),
+        mesh=mesh,
+    )
+    out = eng.generate([
+        InferenceRequest(
+            prompt_token_ids=[5, 17, 3, 99, 42],
+            sampling=SamplingParams(max_new_tokens=6, temperature=0.0),
+        )
+    ])
+    assert len(out[0].token_ids) == 6
+    # qw really sharded over the model axis (out-dim split in two)
+    qw = eng.params["layers"]["wq"]["qw"]
+    shard_shape = qw.sharding.shard_shape(qw.shape)
+    assert shard_shape[-1] == qw.shape[-1] // 2
+
+
+def test_pipeline_stage_slicing_quantized():
+    from distributed_gpu_inference_tpu.parallel.pipeline import (
+        slice_stage_params,
+    )
+
+    cfg = get_model_config("llama3-mini")
+    params = q.quantize_params(
+        llama.init_params(cfg, jax.random.PRNGKey(0)), "int8"
+    )
+    s0 = slice_stage_params(params, 0, 2, num_layers=cfg.num_layers)
+    s1 = slice_stage_params(params, 2, 4, num_layers=cfg.num_layers)
+    assert s0["layers"]["wq"]["qw"].shape[0] == 2
+    assert s0["layers"]["wq"]["scale"].shape[0] == 2
+    assert s1["layers"]["w_down"]["qw"].shape[0] == 2
+    assert "embedding" in s0 and "final_norm" in s1
