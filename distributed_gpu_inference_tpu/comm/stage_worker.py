@@ -145,7 +145,7 @@ class PipelineStageWorker:
         def run(params, kv, x, positions, block_table, kv_lens):
             hidden = x
             if self.is_first:
-                hidden = llama.embed_tokens(params, x)
+                hidden = llama.embed_tokens(params, x, cfg)
             hidden, kv = llama.forward_hidden_chunk(
                 cfg, params, hidden, positions, kv, block_table, kv_lens,
                 block_size=bs,

@@ -28,6 +28,15 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     attention_bias: bool = False             # Qwen2-style QKV biases
+    sliding_window: Optional[int] = None     # Mistral-style windowed attention
+    # Gemma-family knobs
+    activation: str = "silu"                 # silu | gelu (GeGLU MLP)
+    scale_embeddings: bool = False           # hidden *= sqrt(hidden_size)
+    norm_offset: bool = False                # RMSNorm uses (1 + weight)
+    final_logit_softcap: Optional[float] = None  # cap*tanh(logits/cap)
+    # MoE (Mixtral-style sparse MLP); 0 experts = dense
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
     dtype: str = "bfloat16"
 
     def __post_init__(self) -> None:
@@ -35,6 +44,12 @@ class ModelConfig:
             object.__setattr__(self, "head_dim", self.hidden_size // self.num_heads)
         if self.num_heads % self.num_kv_heads != 0:
             raise ValueError("num_heads must be divisible by num_kv_heads (GQA)")
+        if self.activation not in ("silu", "gelu"):
+            raise ValueError(
+                f"unknown activation {self.activation!r}; use 'silu' or 'gelu'"
+            )
+        if self.num_experts and self.num_experts_per_tok > self.num_experts:
+            raise ValueError("num_experts_per_tok exceeds num_experts")
 
     @property
     def q_per_kv(self) -> int:
@@ -48,7 +63,10 @@ class ModelConfig:
         attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) + (
             self.num_heads * d
         ) * h
-        mlp = 3 * h * i
+        if self.num_experts:
+            mlp = self.num_experts * 3 * h * i + h * self.num_experts
+        else:
+            mlp = 3 * h * i
         norms = 2 * h
         per_layer = attn + mlp + norms
         emb = v * h
@@ -67,7 +85,11 @@ class ModelConfig:
         attn = h * (self.num_heads * d) + 2 * h * (self.num_kv_heads * d) + (
             self.num_heads * d
         ) * h
-        return (attn + 3 * h * i + 2 * h) * dtype_bytes
+        if self.num_experts:
+            mlp = self.num_experts * 3 * h * i + h * self.num_experts
+        else:
+            mlp = 3 * h * i
+        return (attn + mlp + 2 * h) * dtype_bytes
 
 
 def _llama(name: str, **kw) -> ModelConfig:
@@ -132,6 +154,62 @@ MODEL_REGISTRY: Dict[str, ModelConfig] = {
         num_heads=28, num_kv_heads=4, intermediate_size=18944,
         max_position_embeddings=32768, rope_theta=1000000.0,
         rms_norm_eps=1e-6, attention_bias=True,
+    ),
+    # Mistral family — Llama decoder recipe + sliding-window attention.
+    # The reference serves Mistral through vLLM/SGLang model auto-detection
+    # (worker/engines/llm_vllm.py:42 introspects the HF config); here the
+    # window is first-class in the paged attention mask (ops/attention.py).
+    "mistral-tiny": _llama(  # test-scale; window smaller than the test
+        "mistral-tiny", vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, intermediate_size=128,
+        max_position_embeddings=1024, rope_theta=10000.0,
+        rms_norm_eps=1e-5, sliding_window=8,
+    ),
+    "mistral-7b": _llama(  # v0.1 geometry: 4096-token sliding window
+        "mistral-7b", vocab_size=32000, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, intermediate_size=14336,
+        max_position_embeddings=32768, rope_theta=10000.0,
+        rms_norm_eps=1e-5, sliding_window=4096,
+    ),
+    # Gemma family — GeGLU MLP, sqrt(H)-scaled embeddings, (1+w) RMSNorm,
+    # tied embeddings, 256-dim heads. Served by the reference through
+    # vLLM/SGLang auto-detection; first-class decoder variant here.
+    "gemma-tiny": _llama(  # test-scale
+        "gemma-tiny", vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, intermediate_size=256,
+        max_position_embeddings=1024, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, activation="gelu", scale_embeddings=True,
+        norm_offset=True, final_logit_softcap=30.0,
+    ),
+    "gemma-2b": _llama(  # MQA: one KV head
+        "gemma-2b", vocab_size=256000, hidden_size=2048, num_layers=18,
+        num_heads=8, num_kv_heads=1, intermediate_size=16384, head_dim=256,
+        max_position_embeddings=8192, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, activation="gelu", scale_embeddings=True,
+        norm_offset=True,
+    ),
+    "gemma-7b": _llama(
+        "gemma-7b", vocab_size=256000, hidden_size=3072, num_layers=28,
+        num_heads=16, num_kv_heads=16, intermediate_size=24576, head_dim=256,
+        max_position_embeddings=8192, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, activation="gelu", scale_embeddings=True,
+        norm_offset=True,
+    ),
+    # Mixtral family — sparse MoE MLP (top-2 of E experts). The reference's
+    # scope lists EP as absent/optional (SURVEY §2.2); on TPU the expert
+    # axis shards over the mesh's ``model`` axis, so this is the EP design
+    # the reference never had.
+    "mixtral-tiny": _llama(  # test-scale: 4 experts, top-2
+        "mixtral-tiny", vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, intermediate_size=128,
+        max_position_embeddings=1024, rope_theta=10000.0,
+        num_experts=4, num_experts_per_tok=2,
+    ),
+    "mixtral-8x7b": _llama(
+        "mixtral-8x7b", vocab_size=32000, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, intermediate_size=14336,
+        max_position_embeddings=32768, rope_theta=1000000.0,
+        rms_norm_eps=1e-5, num_experts=8, num_experts_per_tok=2,
     ),
 }
 

@@ -57,20 +57,32 @@ def init_params(
             dtype
         )
 
+    # norm identity depends on the convention: plain RMSNorm scales by w
+    # (identity = ones); Gemma's offset form scales by 1+w (identity = zeros)
+    norm_init = jnp.zeros if cfg.norm_offset else jnp.ones
+    layers: Dict[str, jax.Array] = {
+        "attn_norm": norm_init((L, h), dtype),
+        "wq": _w(keys[1], (L, h, nh * d), h),
+        "wk": _w(keys[2], (L, h, nkv * d), h),
+        "wv": _w(keys[3], (L, h, nkv * d), h),
+        "wo": _w(keys[4], (L, nh * d, h), nh * d),
+        "mlp_norm": norm_init((L, h), dtype),
+    }
+    if cfg.num_experts:  # Mixtral-style sparse MoE: stacked expert axis E
+        E = cfg.num_experts
+        ekeys = jax.random.split(keys[5], 3)
+        layers["w_router"] = _w(keys[7], (L, h, E), h)
+        layers["we_gate"] = _w(ekeys[0], (L, E, h, i), h)
+        layers["we_up"] = _w(ekeys[1], (L, E, h, i), h)
+        layers["we_down"] = _w(ekeys[2], (L, E, i, h), i)
+    else:
+        layers["w_gate"] = _w(keys[5], (L, h, i), h)
+        layers["w_up"] = _w(keys[6], (L, h, i), h)
+        layers["w_down"] = _w(keys[7], (L, i, h), i)
     params: Params = {
         "embedding": _w(keys[0], (v, h), h),
-        "layers": {
-            "attn_norm": jnp.ones((L, h), dtype),
-            "wq": _w(keys[1], (L, h, nh * d), h),
-            "wk": _w(keys[2], (L, h, nkv * d), h),
-            "wv": _w(keys[3], (L, h, nkv * d), h),
-            "wo": _w(keys[4], (L, nh * d, h), nh * d),
-            "mlp_norm": jnp.ones((L, h), dtype),
-            "w_gate": _w(keys[5], (L, h, i), h),
-            "w_up": _w(keys[6], (L, h, i), h),
-            "w_down": _w(keys[7], (L, i, h), i),
-        },
-        "final_norm": jnp.ones((h,), dtype),
+        "layers": layers,
+        "final_norm": norm_init((h,), dtype),
     }
     if cfg.attention_bias:  # Qwen2-style QKV biases (random init ~ small)
         bkeys = jax.random.split(keys[1], 3)
@@ -102,11 +114,16 @@ def init_kv_pools(
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, offset: bool = False
+) -> jax.Array:
     dt = x.dtype
     x = x.astype(jnp.float32)
     x = x * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
-    return (x * weight.astype(jnp.float32)).astype(dt)
+    w = weight.astype(jnp.float32)
+    if offset:  # Gemma stores zero-centered norm weights; scale is (1 + w)
+        w = 1.0 + w
+    return (x * w).astype(dt)
 
 
 def _rope_angles(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
@@ -161,9 +178,64 @@ def _write_kv_pages(
     return pool.at[flat_phys, flat_slot].set(flat_new, mode="drop")
 
 
-def _mlp(x: jax.Array, lp: Dict[str, jax.Array]) -> jax.Array:
-    gate = jax.nn.silu(qmm(x, lp["w_gate"]))
+def _mlp(x: jax.Array, lp: Dict[str, jax.Array], activation: str = "silu") -> jax.Array:
+    act = jax.nn.silu if activation == "silu" else functools.partial(
+        jax.nn.gelu, approximate=True  # Gemma GeGLU (gelu_pytorch_tanh)
+    )
+    gate = act(qmm(x, lp["w_gate"]))
     return qmm(gate * qmm(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
+
+
+def _moe_mlp(
+    x: jax.Array, lp: Dict[str, jax.Array], cfg: ModelConfig
+) -> jax.Array:
+    """Mixtral-style sparse MoE MLP, expert-parallel by sharding.
+
+    Routing follows HF Mixtral: softmax over all router logits, keep top-k,
+    renormalize. The combine is expressed as a dense einsum over the expert
+    axis with top-k-masked weights — on a mesh where ``we_*`` shard their E
+    axis over ``model``, each chip runs only its local experts for all
+    tokens and XLA inserts the combine all-reduce: expert parallelism
+    without hand-written all-to-all (the TPU answer to SURVEY §2.2's
+    "EP: ABSENT"). Single-chip cost is E/k times the active-path FLOPs —
+    acceptable at serving batch sizes; a ragged/blocked Pallas dispatch is
+    the designated upgrade path.
+    """
+    act = jax.nn.silu if cfg.activation == "silu" else functools.partial(
+        jax.nn.gelu, approximate=True
+    )
+    b, s, h = x.shape
+    xf = x.reshape(b * s, h)                                   # [T, H]
+    # router math in float32: top-k selection is precision-sensitive
+    logits = (xf.astype(jnp.float32) @ lp["w_router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                    # [T, E]
+    topv, topi = lax.top_k(probs, cfg.num_experts_per_tok)     # [T, k]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # scatter the renormalized top-k back to a dense [T, E] combine weight
+    weights = jnp.zeros_like(probs).at[
+        jnp.arange(xf.shape[0])[:, None], topi
+    ].set(topv)                                                # [T, E]
+
+    gate = act(jnp.einsum("th,ehi->tei", xf, _deq(lp["we_gate"], x.dtype)))
+    up = jnp.einsum("th,ehi->tei", xf, _deq(lp["we_up"], x.dtype))
+    per_expert = jnp.einsum(
+        "tei,eih->teh", gate * up, _deq(lp["we_down"], x.dtype)
+    )                                                          # [T, E, H]
+    out = jnp.einsum(
+        "te,teh->th", weights.astype(jnp.float32),
+        per_expert.astype(jnp.float32),
+    )
+    return out.reshape(b, s, h).astype(x.dtype)
+
+
+def _deq(w: Any, dtype) -> jax.Array:
+    """Expert weights [E, in, out] (layer axis consumed by scan), possibly
+    quantized: convert-on-read, shaped for the einsum contraction."""
+    from distributed_gpu_inference_tpu.ops.quantization import (
+        dequantize, is_quantized,
+    )
+
+    return dequantize(w, dtype) if is_quantized(w) else w
 
 
 # ---------------------------------------------------------------------------
@@ -196,7 +268,7 @@ def _layer_step(
     b, s, _ = hidden.shape
     nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
-    x = rms_norm(hidden, lp["attn_norm"], cfg.rms_norm_eps)
+    x = rms_norm(hidden, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     q = qmm(x, lp["wq"])
     k = qmm(x, lp["wk"])
     v = qmm(x, lp["wv"])
@@ -219,9 +291,11 @@ def _layer_step(
 
     attn = attn_fn(q, layer_k, layer_v)
     hidden = hidden + qmm(attn.reshape(b, s, nh * d), lp["wo"]).astype(hidden.dtype)
-    hidden = hidden + _mlp(
-        rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps), lp
-    )
+    mlp_in = rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
+    if "w_router" in lp:
+        hidden = hidden + _moe_mlp(mlp_in, lp, cfg)
+    else:
+        hidden = hidden + _mlp(mlp_in, lp, cfg.activation)
     return (hidden, k_pool, v_pool, layer_idx + 1), None
 
 
@@ -243,14 +317,15 @@ def forward_chunk(
     length) and decode (S = 1) with one traced graph per (B, S).
     """
     b, s = token_ids.shape
-    hidden = jnp.take(params["embedding"], token_ids, axis=0)
+    hidden = embed_tokens(params, token_ids, cfg)
 
     safe_pos = jnp.maximum(positions, 0)
     cos, sin = _rope_angles(safe_pos, cfg.head_dim, cfg.rope_theta)
 
     def attn_fn(q, layer_k, layer_v):
         return paged_attention(
-            q, layer_k, layer_v, block_tables, positions, kv_lens, block_size
+            q, layer_k, layer_v, block_tables, positions, kv_lens, block_size,
+            window=cfg.sliding_window,
         )
 
     step = functools.partial(
@@ -307,14 +382,24 @@ def forward_tree_chunk(
     """
     from distributed_gpu_inference_tpu.ops.attention import paged_tree_attention
 
-    hidden = jnp.take(params["embedding"], token_ids, axis=0)
+    if cfg.sliding_window is not None and token_ids.shape[1] >= cfg.sliding_window:
+        # within-chunk tree attention skips window masking on the assumption
+        # that node depth << window; N nodes bounds depth, so enforce it
+        raise ValueError(
+            f"speculative tree of {token_ids.shape[1]} nodes on a model with "
+            f"sliding_window={cfg.sliding_window}: tree depth may reach the "
+            "window, which the tree-attention window mask does not cover"
+        )
+    hidden = embed_tokens(params, token_ids, cfg)
     cos, sin = _rope_angles(
         jnp.maximum(rope_positions, 0), cfg.head_dim, cfg.rope_theta
     )
 
     def attn_fn(q, layer_k, layer_v):
         return paged_tree_attention(
-            q, layer_k, layer_v, block_tables, prefix_lens, tree_mask, block_size
+            q, layer_k, layer_v, block_tables, prefix_lens, tree_mask,
+            block_size, node_positions=rope_positions,
+            window=cfg.sliding_window,
         )
 
     step = functools.partial(
@@ -359,7 +444,8 @@ def forward_hidden_chunk(
 
     def attn_fn(q, layer_k, layer_v):
         return paged_attention(
-            q, layer_k, layer_v, block_tables, positions, kv_lens, block_size
+            q, layer_k, layer_v, block_tables, positions, kv_lens, block_size,
+            window=cfg.sliding_window,
         )
 
     step = functools.partial(
@@ -380,18 +466,32 @@ def forward_hidden_chunk(
     return hidden, {"k": k_pool, "v": v_pool}
 
 
-def embed_tokens(params: Params, token_ids: jax.Array) -> jax.Array:
-    """First pipeline stage: token embedding (reference model_shard.py:163-166)."""
-    return jnp.take(params["embedding"], token_ids, axis=0)
+def embed_tokens(
+    params: Params, token_ids: jax.Array, cfg: ModelConfig
+) -> jax.Array:
+    """First pipeline stage: token embedding (reference model_shard.py:163-166).
+    Gemma scales embeddings by sqrt(hidden_size) — cfg is REQUIRED so no call
+    site can silently skip the scaling convention."""
+    hidden = jnp.take(params["embedding"], token_ids, axis=0)
+    if cfg.scale_embeddings:
+        hidden = hidden * jnp.asarray(
+            cfg.hidden_size**0.5, dtype=hidden.dtype
+        )
+    return hidden
 
 
 def project_logits(cfg: ModelConfig, params: Params, hidden: jax.Array) -> jax.Array:
     """Last pipeline stage: final norm + LM head (reference model_shard.py:168-171,
     get_logits:230-246)."""
-    normed = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps)
+    normed = rms_norm(hidden, params["final_norm"], cfg.rms_norm_eps,
+                      cfg.norm_offset)
     # NOT dict.get(k, default): the default would be evaluated eagerly and
     # KeyError on a last pipeline stage that carries lm_head but no embedding
     head = params["lm_head"] if "lm_head" in params else params["embedding"]
-    return jnp.einsum(
+    logits = jnp.einsum(
         "bsh,vh->bsv", normed.astype(jnp.float32), head.astype(jnp.float32)
     )
+    if cfg.final_logit_softcap is not None:  # Gemma-2 style soft capping
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits

@@ -41,6 +41,14 @@ _HF_LAYER_MAP = {
 
 _LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
 
+# HF Mixtral MoE naming: block_sparse_moe.gate (router) and per-expert
+# w1 (gate), w3 (up), w2 (down) projections
+_MOE_GATE_KEY = "block_sparse_moe.gate.weight"
+_MOE_EXPERT_RE = re.compile(
+    r"^block_sparse_moe\.experts\.(\d+)\.(w[123])\.weight$"
+)
+_MOE_EXPERT_MAP = {"w1": "we_gate", "w3": "we_up", "w2": "we_down"}
+
 
 def load_hf_llama(
     model_dir: str | Path,
@@ -83,6 +91,22 @@ def load_hf_llama(
                     if li not in rng:
                         continue
                     sub = m.group(2)
+                    em = _MOE_EXPERT_RE.match(sub)
+                    if em:  # Mixtral expert: stack on [L, E, in, out]
+                        ei = int(em.group(1))
+                        our_key = _MOE_EXPERT_MAP[em.group(2)]
+                        w = st.get_tensor(name).T  # HF stores [out, in]
+                        buf = _slot(
+                            our_key, (cfg.num_experts, *w.shape)
+                        )
+                        buf[li - rng.start, ei] = w.astype(dtype)
+                        continue
+                    if sub == _MOE_GATE_KEY:  # router [E, H] → [H, E]
+                        w = st.get_tensor(name).T
+                        _slot("w_router", w.shape)[li - rng.start] = (
+                            w.astype(dtype)
+                        )
+                        continue
                     if sub not in _HF_LAYER_MAP:
                         continue
                     our_key, transpose = _HF_LAYER_MAP[sub]
@@ -117,6 +141,9 @@ def _validate(params: Dict[str, Any], cfg: ModelConfig, rng: BlockRange) -> None
     expected = set(_HF_LAYER_MAP[k][0] for k in _HF_LAYER_MAP)
     if not cfg.attention_bias:  # Llama-family checkpoints carry no biases
         expected -= {"bq", "bk", "bv"}
+    if cfg.num_experts:  # Mixtral: sparse expert MLP instead of dense
+        expected -= {"w_gate", "w_up", "w_down"}
+        expected |= {"w_router", "we_gate", "we_up", "we_down"}
     got = set(params["layers"].keys())
     if got != expected:
         missing, extra = expected - got, got - expected

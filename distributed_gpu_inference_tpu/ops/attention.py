@@ -45,10 +45,12 @@ def paged_attention(
     kv_lens: jax.Array,       # [B] int32
     block_size: int = 16,
     impl: str = "auto",
+    window: Optional[int] = None,  # Mistral sliding window (None = full causal)
 ) -> jax.Array:
     """Attention of a chunk of queries against paged context. → [B, S, Nh, D].
 
     ``impl``: "auto" (pallas on TPU for decode, else xla), "xla", "pallas".
+    ``window``: query at position p sees context positions (p-window, p].
     """
     if impl == "auto":
         if _use_pallas() and q.shape[1] == 1:
@@ -61,10 +63,12 @@ def paged_attention(
         )
 
         return paged_attention_pallas(
-            q, k_pool, v_pool, block_tables, positions, kv_lens, block_size
+            q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
+            window=window,
         )
     return paged_attention_xla(
-        q, k_pool, v_pool, block_tables, positions, kv_lens, block_size
+        q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
+        window=window,
     )
 
 
@@ -76,6 +80,7 @@ def paged_attention_xla(
     positions: jax.Array,
     kv_lens: jax.Array,
     block_size: int = 16,
+    window: Optional[int] = None,
 ) -> jax.Array:
     b, s, nh, d = q.shape
     hkv = k_pool.shape[2]
@@ -95,7 +100,10 @@ def paged_attention_xla(
     key_pos = jnp.arange(j, dtype=jnp.int32)[None, :]           # [1, J]
     causal = positions[:, :, None] >= key_pos[:, None, :]       # [B, S, J]
     in_len = key_pos[:, None, :] < kv_lens[:, None, None]       # [B, 1→S, J]
-    mask = (causal & in_len)[:, None, None, :, :]               # [B,1,1,S,J]
+    visible = causal & in_len
+    if window is not None:  # Mistral SWA: key must be within (p-window, p]
+        visible &= key_pos[:, None, :] > positions[:, :, None] - window
+    mask = visible[:, None, None, :, :]                         # [B,1,1,S,J]
     scores = jnp.where(mask, scores, _NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
@@ -116,6 +124,8 @@ def paged_tree_attention(
     prefix_lens: jax.Array,   # [B] committed context BEFORE the tree chunk
     tree_mask: jax.Array,     # [N, N] bool — node i may attend node j (ancestors)
     block_size: int = 16,
+    node_positions: Optional[jax.Array] = None,  # [B, N] semantic positions
+    window: Optional[int] = None,                # Mistral SWA over the prefix
 ) -> jax.Array:
     """Attention for speculative tree verification.
 
@@ -150,6 +160,13 @@ def paged_tree_attention(
         jnp.broadcast_to(safe_idx, (b, n, j)).astype(jnp.int32),
         axis=2,
     )                                                                # [B, N, J]
+    if window is not None and node_positions is not None:
+        # prefix keys beyond the node's window drop out; within-chunk nodes
+        # are at most tree-depth apart (<< window), so only the prefix needs
+        # masking
+        is_prefix &= (
+            key_pos[:, None, :] > node_positions[:, :, None] - window
+        )
     mask = is_prefix | (in_chunk & tm)
     scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
@@ -164,6 +181,7 @@ def dense_causal_attention(
     k: jax.Array,   # [B, S, Hkv, D]
     v: jax.Array,   # [B, S, Hkv, D]
     lengths: Optional[jax.Array] = None,  # [B] valid lengths
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Plain causal GQA attention over contiguous KV — the test oracle."""
     b, s, nh, d = q.shape
@@ -175,6 +193,8 @@ def dense_causal_attention(
     )
     idx = jnp.arange(s, dtype=jnp.int32)
     mask = idx[None, :, None] >= idx[None, None, :]             # [1, S, J]
+    if window is not None:
+        mask = mask & (idx[None, None, :] > idx[None, :, None] - window)
     if lengths is not None:
         mask = mask & (idx[None, None, :] < lengths[:, None, None])
     scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)

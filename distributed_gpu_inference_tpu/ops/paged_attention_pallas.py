@@ -19,9 +19,11 @@ def paged_attention_pallas(
     positions: jax.Array,
     kv_lens: jax.Array,
     block_size: int = 16,
+    window=None,
 ) -> jax.Array:
     from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
 
     return paged_attention_xla(
-        q, k_pool, v_pool, block_tables, positions, kv_lens, block_size
+        q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
+        window=window,
     )

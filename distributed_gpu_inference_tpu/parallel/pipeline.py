@@ -144,6 +144,10 @@ def stage_param_shardings(mesh: Mesh) -> Dict[str, Any]:
             "w_gate": _l(None, None),
             "w_up": _l(None, None),
             "w_down": _l(None, None),
+            "w_router": _l(None, None),
+            "we_gate": _l(None, None, None),
+            "we_up": _l(None, None, None),
+            "we_down": _l(None, None, None),
         },
         "final_norm": NamedSharding(mesh, P()),
         "lm_head": NamedSharding(mesh, P()),
@@ -202,7 +206,7 @@ def _pipeline_local(
 
         # stage 0 ingests fresh embeddings; later stages consume the permuted
         # activations. Padded/invalid ticks write no KV (positions forced -1).
-        inject = llama.embed_tokens(params, tok_t)
+        inject = llama.embed_tokens(params, tok_t, cfg)
         act_in = jnp.where(stage == 0, inject, act)
         write_pos = jnp.where(valid, pos_t, -1)
 

@@ -62,6 +62,14 @@ def param_shardings(mesh: Mesh) -> Dict[str, Any]:
             "w_gate": _ns(mesh, None, None, AXIS_MODEL),
             "w_up": _ns(mesh, None, None, AXIS_MODEL),
             "w_down": _ns(mesh, None, AXIS_MODEL, None),
+            # MoE: expert parallelism = shard the E axis over ``model``;
+            # each chip computes its local experts, XLA all-reduces the
+            # combine (models/llama.py _moe_mlp). Router replicated — every
+            # chip needs all routing weights.
+            "w_router": _ns(mesh, None, None, None),
+            "we_gate": _ns(mesh, None, AXIS_MODEL, None, None),
+            "we_up": _ns(mesh, None, AXIS_MODEL, None, None),
+            "we_down": _ns(mesh, None, AXIS_MODEL, None, None),
         },
         "final_norm": _ns(mesh, None),
         "lm_head": _ns(mesh, None, None),

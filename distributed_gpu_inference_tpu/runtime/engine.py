@@ -135,6 +135,12 @@ class TPUEngine:
                     f"num_kv_heads {self.model_cfg.num_kv_heads} not "
                     f"divisible by model axis {tp}"
                 )
+            if self.model_cfg.num_experts and \
+                    self.model_cfg.num_experts % max(tp, 1):
+                raise ValueError(
+                    f"num_experts {self.model_cfg.num_experts} not "
+                    f"divisible by model axis {tp} (EP shards experts)"
+                )
         if params is not None:
             self.params = quantize_params(params, self.cfg.quantization)
             if mesh is not None:
@@ -504,6 +510,21 @@ class TPUEngine:
                     s.seq_id, self.cfg.max_blocks_per_seq
                 )
             self._apply_pending()
+            self._maybe_release_window(slot)
+
+    def _maybe_release_window(self, slot: int) -> None:
+        """Sliding-window models: hand blocks every future query is past back
+        to the pool (window-bounded KV memory — SWA's serving payoff). The
+        released logical slots point at pad block 0; the attention window
+        mask already excludes those positions, so reads stay correct."""
+        w = self.model_cfg.sliding_window
+        if w is None:
+            return
+        s = self.slots[slot]
+        assert s is not None
+        released = self.manager.release_out_of_window(s.seq_id, w)
+        for lb in released:
+            self._block_tables[slot, lb] = 0
 
     def decode_step(self) -> Dict[int, int]:
         """One decode step for all active unfinished slots: feeds each slot's
@@ -595,6 +616,7 @@ class TPUEngine:
             # the per-step path)
             commit = toks if s.finish_reason is None else toks[:-1]
             self.manager.commit_tokens(s.seq_id, commit)
+            self._maybe_release_window(i)
         return out
 
     def finish_slot(self, slot: int, cache: bool = True) -> InferenceResponse:

@@ -192,6 +192,7 @@ class KVCacheStats:
     allocated_blocks: int = 0
     cached_blocks: int = 0
     free_blocks: int = 0
+    window_released_blocks: int = 0
 
     def as_dict(self) -> Dict[str, Any]:
         d = dict(self.__dict__)
@@ -302,6 +303,10 @@ class PagedKVCacheManager:
         self.seq_blocks: Dict[str, List[int]] = {}
         self.seq_tokens: Dict[str, List[int]] = {}
         self.seq_shared_count: Dict[str, int] = {}
+        # first logical block not yet window-released, per sequence — keeps
+        # release_out_of_window O(1) amortized instead of rescanning the
+        # released prefix every decoded token
+        self.seq_window_front: Dict[str, int] = {}
         self.stats = KVCacheStats()
         self.pending = PendingDeviceOps()
 
@@ -545,6 +550,40 @@ class PagedKVCacheManager:
                 "must cover the scan horizon"
             )
 
+    def release_out_of_window(self, seq_id: str, window: int) -> List[int]:
+        """Sliding-window models (Mistral): free leading blocks every future
+        query is past. A query at position p sees keys in (p - window, p];
+        the earliest future query is the pending token at position cur - 1
+        (``seq_tokens`` counts committed + pending), which still sees key
+        cur - window — so only keys ≤ cur - 1 - window are dead. Freed
+        logical slots are pinned to the reserved pad block 0 — the attention
+        window mask already drops those logical positions, so a pad-block
+        read is never visible. Returns the released logical indices (the
+        engine zeroes its block-table rows to match).
+
+        This converts mask-only SWA into window-bounded KV memory — the
+        rolling-buffer benefit vLLM gets for Mistral, without re-indexing."""
+        blocks = self.seq_blocks[seq_id]
+        cur = len(self.seq_tokens[seq_id])
+        released: List[int] = []
+        lb = self.seq_window_front.get(seq_id, 0)
+        while lb < len(blocks):
+            # block lb covers positions [lb*Bk, (lb+1)*Bk); dead iff its last
+            # position (lb+1)*Bk - 1 ≤ cur - 1 - window
+            if (lb + 1) * self.block_size > cur - window:
+                break
+            bid = blocks[lb]
+            meta = self.metas.get(bid)
+            if meta is not None and meta.decref() == 0:
+                self._deactivate_block(bid)
+            blocks[lb] = 0
+            released.append(lb)
+            lb += 1
+        if released:
+            self.seq_window_front[seq_id] = lb
+            self.stats.window_released_blocks += len(released)
+        return released
+
     def free_sequence(self, seq_id: str, cache: bool = True) -> None:
         """Release a sequence's blocks; full blocks are kept as prefix cache
         (ref 0, LRU-ordered) when ``cache=True``."""
@@ -552,6 +591,10 @@ class PagedKVCacheManager:
         tokens = self.seq_tokens.pop(seq_id, [])
         self.seq_shared_count.pop(seq_id, None)
         n_full = len(tokens) // self.block_size
+        if self.seq_window_front.pop(seq_id, 0) > 0 or 0 in blocks[:n_full]:
+            # window-released leading blocks: the chain is no longer a valid
+            # prefix, so it cannot enter the radix index
+            cache = False
         if cache and self.enable_prefix_cache and n_full > 0:
             idx_tokens: Sequence[int] = tokens
             if getattr(self.radix, "wants_arrays", False):

@@ -213,6 +213,18 @@ class SpeculativeDecoder:
             get_model_config(model_cfg) if isinstance(model_cfg, str) else model_cfg
         )
         self.spec_cfg = spec_cfg or SpeculativeConfig()
+        sw = self.model_cfg.sliding_window
+        if sw is not None:
+            # tree verify skips window masking within the chunk on the
+            # assumption depth << window; surface the conflict at
+            # construction, not mid-request in the first traced step
+            n_nodes = TreeTopology(tuple(self.spec_cfg.widths)).num_nodes
+            if n_nodes >= sw:
+                raise ValueError(
+                    f"speculative tree of {n_nodes} nodes >= "
+                    f"sliding_window={sw} of {self.model_cfg.name}: shrink "
+                    "spec_cfg.widths or use a non-windowed model"
+                )
         self.block_size = block_size
         self.max_batch_size = max_batch_size
         self.max_seq_len = max_seq_len
@@ -275,11 +287,16 @@ class SpeculativeDecoder:
         def step(params, dp, kv, pending, h_last, prefix_lens, block_tables,
                  active):
             b = pending.shape[0]
-            emb = params["embedding"]
+
+            # token embedding must follow the target model's convention
+            # (Gemma scales by sqrt(H)) or the draft head sees inputs on a
+            # different scale than the hidden states it fuses with
+            def emb_of(ids):
+                return llama.embed_tokens(params, ids, cfg)
 
             # ---- draft phase: grow the tree level by level (static shapes)
             tokens = jnp.zeros((b, n), jnp.int32).at[:, 0].set(pending)
-            h_root = draft_apply(cfg, dp, h_last, jnp.take(emb, pending, axis=0))
+            h_root = draft_apply(cfg, dp, h_last, emb_of(pending))
             head = params.get("lm_head", params["embedding"]).astype(jnp.float32)
             frontier_h = h_root[:, None, :]           # [B, F, H]
             for li, w in enumerate(widths):
@@ -290,7 +307,7 @@ class SpeculativeDecoder:
                 start, end = level_slices[li]
                 tokens = tokens.at[:, start:end].set(cand.reshape(b, -1))
                 # next frontier hiddens: f(parent_h, emb(child_tok))
-                child_emb = jnp.take(emb, cand, axis=0)          # [B, F, w, H]
+                child_emb = emb_of(cand)                         # [B, F, w, H]
                 parent_h = jnp.broadcast_to(
                     frontier_h[:, :, None, :], child_emb.shape
                 )

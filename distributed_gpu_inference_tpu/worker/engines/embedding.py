@@ -73,7 +73,8 @@ class EmbeddingEngine(BaseEngine):
                 jnp.zeros((b,), jnp.int32), block_size=16, last_only=False,
             )
             hidden = llama.rms_norm(
-                out.hidden, params["final_norm"], cfg.rms_norm_eps
+                out.hidden, params["final_norm"], cfg.rms_norm_eps,
+                cfg.norm_offset,
             ).astype(jnp.float32)
             m = mask_valid[..., None].astype(jnp.float32)
             pooled = (hidden * m).sum(1) / jnp.maximum(m.sum(1), 1.0)

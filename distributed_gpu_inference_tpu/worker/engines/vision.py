@@ -139,7 +139,7 @@ class VisionEngine(BaseEngine):
             from ...models import vit as vit_mod
 
             prefix = vit_mod.encode_image(self._vit_cfg, vp, image)
-            text = llama.embed_tokens(lp, tokens)
+            text = llama.embed_tokens(lp, tokens, cfg)
             hidden = jax.numpy.concatenate(
                 [prefix.astype(text.dtype), text], axis=1
             )

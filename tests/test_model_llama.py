@@ -148,7 +148,7 @@ def test_pipeline_stage_decomposition(params):
     kv1 = jax.tree.map(jnp.copy, kv0)
     pos = _pos(0, n)
     lens = jnp.asarray([n], jnp.int32)
-    hidden = llama.embed_tokens(stage_params[0], jnp.asarray(toks[None]))
+    hidden = llama.embed_tokens(stage_params[0], jnp.asarray(toks[None]), CFG)
     hidden, _ = llama.forward_hidden_chunk(
         CFG, stage_params[0], hidden, pos, kv0, _table(1), lens, block_size=BLOCK
     )
