@@ -81,12 +81,13 @@ class PipelineStageWorker:
             )
         self.params = params
 
-        # per-stage KV pools cover ONLY the owned layers
+        # per-stage KV pools cover ONLY the owned layers (head-major pages,
+        # models/llama.py init_kv_pools layout)
         stage_cfg_layers = self.end - self.start
         self.kv = {
             k: jnp.zeros(
-                (stage_cfg_layers, num_blocks, block_size,
-                 self.cfg.num_kv_heads, self.cfg.head_dim),
+                (stage_cfg_layers, num_blocks, self.cfg.num_kv_heads,
+                 block_size, self.cfg.head_dim),
                 self.dtype,
             )
             for k in ("k", "v")

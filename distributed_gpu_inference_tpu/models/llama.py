@@ -8,7 +8,7 @@ TPU-native core replacing the reference's engine-wrapped models
   prefill (S = chunk with prefix), and decode (S = 1): static shapes, no
   data-dependent Python control flow, jits once per (B, S) bucket.
 - **Paged KV is the only cache layout.** K/V live in HBM pools
-  ``[L, num_blocks, block_size, n_kv_heads, head_dim]`` addressed through
+  ``[L, num_blocks, n_kv_heads, block_size, head_dim]`` addressed through
   per-sequence block tables — the first-party equivalent of vLLM's
   PagedAttention pools the reference delegates to (SURVEY §2.3), written
   via scatter inside the jitted graph.
@@ -34,7 +34,7 @@ from distributed_gpu_inference_tpu.ops.attention import paged_attention
 from distributed_gpu_inference_tpu.ops.quantization import matmul as qmm
 
 Params = Dict[str, Any]
-KVPools = Dict[str, jax.Array]  # {"k": [L,N,Bk,Hkv,D], "v": [L,N,Bk,Hkv,D]}
+KVPools = Dict[str, jax.Array]  # {"k": [L,N,Hkv,Bk,D], "v": [L,N,Hkv,Bk,D]}
 
 
 # ---------------------------------------------------------------------------
@@ -103,9 +103,14 @@ def init_kv_pools(
     dtype: Optional[jnp.dtype] = None,
 ) -> KVPools:
     """Device-resident paged KV pools. Block 0 is reserved as the garbage/pad
-    block — writes for padded tokens land there and reads mask it out."""
+    block — writes for padded tokens land there and reads mask it out.
+
+    Layout ``[L, N, Hkv, Bk, D]`` (head-major pages, like vLLM's pools and
+    the reference's CacheBlock [max_blocks, heads, block, head_dim],
+    kv_cache.py:130-144): a (page, head) slice is a contiguous [Bk, D] tile,
+    which the Pallas decode kernel DMAs without breaking TPU tiling."""
     dtype = dtype or jnp.dtype(cfg.dtype)
-    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_size, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -150,7 +155,7 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _write_kv_pages(
-    pool: jax.Array,          # [N, Bk, Hkv, D] (single layer)
+    pool: jax.Array,          # [N, Hkv, Bk, D] (single layer)
     new: jax.Array,           # [B, S, Hkv, D]
     block_tables: jax.Array,  # [B, M] int32 physical block ids
     positions: jax.Array,     # [B, S] int32 token positions (-1 = pad)
@@ -172,10 +177,12 @@ def _write_kv_pages(
     phys = jnp.where(valid, phys, num_blocks)
     flat_phys = phys.reshape(-1)
     flat_slot = slot.reshape(-1)
-    flat_new = new.reshape(b * s, *new.shape[2:])
+    flat_new = new.reshape(b * s, *new.shape[2:])          # [T, Hkv, D]
+    # advanced indices (dims 0 and 2) separated by the head slice: result
+    # dims order as [T, Hkv, D] — exactly flat_new's layout.
     # no unique_indices: padded rows all collapse to the same OOB index, and
     # promising uniqueness there would be undefined behavior
-    return pool.at[flat_phys, flat_slot].set(flat_new, mode="drop")
+    return pool.at[flat_phys, :, flat_slot].set(flat_new, mode="drop")
 
 
 def _mlp(x: jax.Array, lp: Dict[str, jax.Array], activation: str = "silu") -> jax.Array:

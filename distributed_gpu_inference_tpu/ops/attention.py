@@ -7,9 +7,11 @@ for the hot decode path.
 
 Semantics shared by every implementation:
 
-- KV lives in a paged pool ``[num_blocks, block_size, n_kv_heads, head_dim]``
-  per layer; a sequence's context is the concatenation of its block table's
-  pages, valid up to ``kv_lens[b]`` tokens.
+- KV lives in a paged pool ``[num_blocks, n_kv_heads, block_size, head_dim]``
+  per layer (head-major pages — a (page, head) slice is one contiguous
+  [Bk, D] tile, the layout the Pallas kernel DMAs); a sequence's context is
+  the concatenation of its block table's pages, valid up to ``kv_lens[b]``
+  tokens.
 - Queries carry explicit ``positions`` (``-1`` = padding); causal masking is
   positional: query at position p attends to context positions ``j <= p``.
 - GQA: ``n_heads`` queries share ``n_kv_heads`` KV heads.
@@ -38,8 +40,8 @@ def _use_pallas() -> bool:
 
 def paged_attention(
     q: jax.Array,             # [B, S, Nh, D]
-    k_pool: jax.Array,        # [N, Bk, Hkv, D] (single layer)
-    v_pool: jax.Array,        # [N, Bk, Hkv, D]
+    k_pool: jax.Array,        # [N, Hkv, Bk, D] (single layer)
+    v_pool: jax.Array,        # [N, Hkv, Bk, D]
     block_tables: jax.Array,  # [B, M] int32
     positions: jax.Array,     # [B, S] int32, -1 = pad
     kv_lens: jax.Array,       # [B] int32
@@ -53,7 +55,12 @@ def paged_attention(
     ``window``: query at position p sees context positions (p-window, p].
     """
     if impl == "auto":
-        if _use_pallas() and q.shape[1] == 1:
+        # the Pallas decode kernel needs lane-aligned pages: XLA:TPU stores
+        # HBM arrays padded to 128 lanes, so a head_dim that isn't a
+        # multiple of 128 cannot be page-DMA'd without relayout. All the
+        # production geometries (Llama-3 8B/70B, Qwen-7B, Mistral, Gemma)
+        # have D ∈ {128, 256}; CI-scale minis fall back to XLA.
+        if _use_pallas() and q.shape[1] == 1 and q.shape[3] % 128 == 0:
             impl = "pallas"
         else:
             impl = "xla"
@@ -72,6 +79,18 @@ def paged_attention(
     )
 
 
+def _gather_ctx(
+    pool: jax.Array, block_tables: jax.Array, block_size: int
+) -> jax.Array:
+    """Materialize a batch's paged context: head-major pool [N, Hkv, Bk, D]
+    gathered by [B, M] tables → [B, J, Hkv, D] token-major context."""
+    b, m = block_tables.shape
+    _, hkv, _, d = pool.shape
+    return jnp.take(pool, block_tables, axis=0).transpose(
+        0, 1, 3, 2, 4
+    ).reshape(b, m * block_size, hkv, d)
+
+
 def paged_attention_xla(
     q: jax.Array,
     k_pool: jax.Array,
@@ -83,14 +102,13 @@ def paged_attention_xla(
     window: Optional[int] = None,
 ) -> jax.Array:
     b, s, nh, d = q.shape
-    hkv = k_pool.shape[2]
+    hkv = k_pool.shape[1]
     qpk = nh // hkv
     m = block_tables.shape[1]
     j = m * block_size
 
-    # Gather this batch's pages: [B, M, Bk, Hkv, D] → [B, J, Hkv, D]
-    k_ctx = jnp.take(k_pool, block_tables, axis=0).reshape(b, j, hkv, d)
-    v_ctx = jnp.take(v_pool, block_tables, axis=0).reshape(b, j, hkv, d)
+    k_ctx = _gather_ctx(k_pool, block_tables, block_size)
+    v_ctx = _gather_ctx(v_pool, block_tables, block_size)
 
     qg = q.reshape(b, s, hkv, qpk, d).astype(jnp.float32)
     scores = jnp.einsum(
@@ -118,7 +136,7 @@ def paged_attention_xla(
 
 def paged_tree_attention(
     q: jax.Array,             # [B, N, Nh, D] — one query per tree node
-    k_pool: jax.Array,        # [Nb, Bk, Hkv, D]
+    k_pool: jax.Array,        # [Nb, Hkv, Bk, D]
     v_pool: jax.Array,
     block_tables: jax.Array,  # [B, M]
     prefix_lens: jax.Array,   # [B] committed context BEFORE the tree chunk
@@ -136,13 +154,13 @@ def paged_tree_attention(
     ``worker/engines/speculative.py:184-213`` get_tree_attention_mask).
     """
     b, n, nh, d = q.shape
-    hkv = k_pool.shape[2]
+    hkv = k_pool.shape[1]
     qpk = nh // hkv
     m = block_tables.shape[1]
     j = m * block_size
 
-    k_ctx = jnp.take(k_pool, block_tables, axis=0).reshape(b, j, hkv, d)
-    v_ctx = jnp.take(v_pool, block_tables, axis=0).reshape(b, j, hkv, d)
+    k_ctx = _gather_ctx(k_pool, block_tables, block_size)
+    v_ctx = _gather_ctx(v_pool, block_tables, block_size)
 
     qg = q.reshape(b, n, hkv, qpk, d).astype(jnp.float32)
     scores = jnp.einsum("bsgqd,bjgd->bgqsj", qg, k_ctx.astype(jnp.float32)) * (

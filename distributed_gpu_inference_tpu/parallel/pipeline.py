@@ -163,7 +163,7 @@ def shard_params_stages(params: llama.Params, mesh: Mesh) -> llama.Params:
 
 
 def stage_kv_sharding(mesh: Mesh) -> NamedSharding:
-    """KV pools [L, N, Bk, Hkv, D]: the layer axis follows its stage."""
+    """KV pools [L, N, Hkv, Bk, D]: the layer axis follows its stage."""
     return NamedSharding(mesh, P(AXIS_STAGE, None, None, None, None))
 
 
@@ -178,7 +178,7 @@ def _pipeline_local(
     block_tables: jax.Array,  # [n_micro, mb, M] int32
     kv_lens: jax.Array,       # [n_micro, mb] int32
     params: llama.Params,     # stage-local: layers [L/n, ...], embed/head replicated
-    kv: llama.KVPools,        # stage-local: [L/n, N, Bk, Hkv, D]
+    kv: llama.KVPools,        # stage-local: [L/n, N, Hkv, Bk, D]
     *,
     cfg: ModelConfig,
     axis_name: str,

@@ -20,7 +20,7 @@ layers.w_gate/up    [L, H, I]                    shard out dim on ``model``
 layers.w_down       [L, I, H]                    shard in dim on ``model``
 final_norm          [H]                          replicated
 lm_head             [V, H]                       replicated
-kv pools            [L, N, Bk, Hkv, D]           shard Hkv on ``model``
+kv pools            [L, N, Hkv, Bk, D]           shard Hkv on ``model``
 tokens/tables/lens  [B, ...]                     shard B on ``data``
 ==================  ===========================  ==========================
 
@@ -77,9 +77,9 @@ def param_shardings(mesh: Mesh) -> Dict[str, Any]:
 
 
 def kv_sharding(mesh: Mesh) -> NamedSharding:
-    """KV pools [L, N, Bk, Hkv, D]: heads sharded over ``model`` so each TP
+    """KV pools [L, N, Hkv, Bk, D]: heads sharded over ``model`` so each TP
     shard attends with its own KV heads — pages never cross chips."""
-    return _ns(mesh, None, None, None, AXIS_MODEL, None)
+    return _ns(mesh, None, None, AXIS_MODEL, None, None)
 
 
 def batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:

@@ -276,9 +276,14 @@ class TPUEngine:
 
         def decode_multi(params, kv, last_tokens, kv_lens, block_tables,
                          slot_keys, temps, top_ks, top_ps, stop_ids, active,
-                         num_steps):
+                         budgets, num_steps):
+            # per-slot budgets mask slots out ON DEVICE once they emit their
+            # remaining token allowance — so one compiled T=multi_step graph
+            # serves every call. (The previous host-side num_steps capping
+            # compiled a fresh scan per distinct tail length: a multi-second
+            # XLA compile in the middle of serving.)
             def step(carry, _):
-                kv, cur_tokens, cur_lens, done = carry
+                kv, cur_tokens, cur_lens, done, n_emit = carry
                 positions = jnp.where(
                     (~done & (cur_lens > 0))[:, None], cur_lens[:, None] - 1, -1
                 ).astype(jnp.int32)
@@ -292,14 +297,16 @@ class TPUEngine:
                 )
                 hit_stop = jnp.any(toks[:, None] == stop_ids, axis=1)
                 emitted = jnp.where(done, -1, toks)
-                new_done = done | hit_stop
+                new_emit = n_emit + (~done).astype(jnp.int32)
+                new_done = done | hit_stop | (new_emit >= budgets)
                 new_lens = jnp.where(done, cur_lens, cur_lens + 1)
                 next_tokens = jnp.where(done, cur_tokens, toks)
-                return (out.kv, next_tokens, new_lens, new_done), emitted
+                return (out.kv, next_tokens, new_lens, new_done, new_emit), emitted
 
             done0 = ~active
-            (kv, _, final_lens, done), emitted = jax.lax.scan(
-                step, (kv, last_tokens, kv_lens, done0), None,
+            n0 = jnp.zeros_like(kv_lens)
+            (kv, _, final_lens, done, _), emitted = jax.lax.scan(
+                step, (kv, last_tokens, kv_lens, done0, n0), None,
                 length=num_steps,
             )
             return kv, emitted.T, final_lens, done  # emitted [B, T]
@@ -365,6 +372,17 @@ class TPUEngine:
     def num_active(self) -> int:
         return sum(1 for s in self.slots if s is not None)
 
+    def _validate_request(self, request: InferenceRequest) -> List[int]:
+        token_ids = request.prompt_token_ids
+        if not token_ids:
+            raise ValueError("request has no prompt_token_ids")
+        if len(token_ids) + request.sampling.max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {len(token_ids)} + max_new {request.sampling.max_new_tokens}"
+                f" exceeds max_seq_len {self.cfg.max_seq_len}"
+            )
+        return token_ids
+
     def submit(self, request: InferenceRequest, slot: Optional[int] = None) -> int:
         """Admit a request into a slot: allocate blocks (prefix-cache aware),
         run prefill, sample the first token. Returns the slot index."""
@@ -375,14 +393,7 @@ class TPUEngine:
             slot = free[0]
         if self.slots[slot] is not None:
             raise RuntimeError(f"slot {slot} busy")
-        token_ids = request.prompt_token_ids
-        if not token_ids:
-            raise ValueError("request has no prompt_token_ids")
-        if len(token_ids) + request.sampling.max_new_tokens > self.cfg.max_seq_len:
-            raise ValueError(
-                f"prompt {len(token_ids)} + max_new {request.sampling.max_new_tokens}"
-                f" exceeds max_seq_len {self.cfg.max_seq_len}"
-            )
+        token_ids = self._validate_request(request)
         seq_id = request.session_id or uuid.uuid4().hex
         blocks, cached = self.manager.allocate_sequence(seq_id, token_ids)
         try:
@@ -392,6 +403,85 @@ class TPUEngine:
             self._kv_lens[slot] = 0
             self.manager.free_sequence(seq_id, cache=False)
             raise
+
+    def submit_batch(self, requests: Sequence[InferenceRequest]) -> List[int]:
+        """Admit several requests at once: same-bucket prefills run as ONE
+        batched device call (full batch width, inactive rows masked with
+        position -1). On a remote-tunnel TPU each device call costs a full
+        control round-trip, so per-request prefill serializes admission —
+        this path admits a whole wave for one RTT. Long prompts that need
+        chunking fall back to the per-request chunked path."""
+        if not requests:
+            return []
+        free = self.free_slots()
+        if len(requests) > len(free):
+            raise RuntimeError(
+                f"{len(requests)} requests > {len(free)} free slots"
+            )
+        max_bucket = self.cfg.prefill_buckets[-1]
+        slots_out: List[int] = []
+        grouped: Dict[int, List[Tuple[InferenceRequest, int, str, List[int], int]]] = {}
+        admitted: List[Tuple[int, str]] = []  # (slot, seq_id) for cleanup
+
+        def _rollback() -> None:
+            for slot, seq_id in admitted:
+                self.slots[slot] = None
+                self._kv_lens[slot] = 0
+                if seq_id in self.manager.seq_blocks:
+                    self.manager.free_sequence(seq_id, cache=False)
+
+        try:
+            for request, slot in zip(requests, free):
+                token_ids = self._validate_request(request)
+                seq_id = request.session_id or uuid.uuid4().hex
+                _, cached = self.manager.allocate_sequence(seq_id, token_ids)
+                admitted.append((slot, seq_id))
+                slots_out.append(slot)
+                n_fresh = len(token_ids) - cached
+                if n_fresh > max_bucket:
+                    # chunked long-prompt path (per request)
+                    self._submit_allocated(request, slot, seq_id, token_ids, cached)
+                    continue
+                bucket = self._bucket_len(max(n_fresh, 1))
+                grouped.setdefault(bucket, []).append(
+                    (request, slot, seq_id, token_ids, cached)
+                )
+
+            b = len(self.slots)
+            for bucket, items in sorted(grouped.items()):
+                self._apply_pending()
+                toks = np.zeros((b, bucket), np.int32)
+                pos = np.full((b, bucket), -1, np.int32)
+                lens = np.zeros((b,), np.int32)
+                for request, slot, seq_id, token_ids, cached in items:
+                    s = _Slot(request=request, seq_id=seq_id,
+                              prompt_len=len(token_ids), cached_tokens=cached)
+                    self._bind_slot(slot, s, kv_len=len(token_ids))
+                    fresh = token_ids[cached:]
+                    n = len(fresh)
+                    toks[slot, :n] = fresh
+                    pos[slot, :n] = np.arange(cached, cached + n)
+                    lens[slot] = cached + n
+                    self.stats["prefill_tokens"] += n
+                logits, self.kv = self._prefill_fn(
+                    self.params, self.kv, jnp.asarray(toks), jnp.asarray(pos),
+                    jnp.asarray(self._block_tables), jnp.asarray(lens),
+                )
+                self.stats["prefill_calls"] += 1
+                first = sample_tokens_per_slot(
+                    logits, jnp.asarray(self._slot_keys),
+                    jnp.asarray(self._kv_lens), jnp.asarray(self._temps),
+                    jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+                )
+                first_np = np.asarray(first)
+                for request, slot, seq_id, token_ids, cached in items:
+                    self._record_token(slot, int(first_np[slot]))
+        except Exception:
+            # a failed wave must not leak: every sequence this call admitted
+            # (bound or not) is freed so a retry sees clean state
+            _rollback()
+            raise
+        return slots_out
 
     def _bind_slot(self, slot: int, s: "_Slot", kv_len: int) -> None:
         """Install slot state (block table, committed length, sampling, stop
@@ -565,24 +655,31 @@ class TPUEngine:
         )
         if not active_mask.any():
             return {}
-        # cap the scan so no slot overruns its token budget or max_seq_len
-        remaining = [
-            min(
-                s.request.sampling.max_new_tokens - len(s.generated),
-                self.cfg.max_seq_len - int(self._kv_lens[i]),
-            ) if active_mask[i] and s is not None else 0
-            for i, s in enumerate(self.slots)
-        ]
-        pos_rem = [r for r in remaining if r > 0]
-        if not pos_rem:
+        # per-slot token budgets enforced ON DEVICE (scan masks a slot once
+        # it emits its allowance) — num_steps stays the compiled constant
+        # instead of shrinking to the shortest slot and recompiling per
+        # distinct tail length
+        budgets = np.array(
+            [
+                min(
+                    s.request.sampling.max_new_tokens - len(s.generated),
+                    self.cfg.max_seq_len - int(self._kv_lens[i]),
+                ) if active_mask[i] and s is not None else 0
+                for i, s in enumerate(self.slots)
+            ],
+            dtype=np.int32,
+        )
+        budgets = np.maximum(budgets, 0)
+        active_mask &= budgets > 0
+        if not active_mask.any():
             return {}
-        num_steps = int(min(num_steps, min(pos_rem)))
-        if num_steps <= 0:
-            return {}
-        # pre-reserve KV blocks for the whole horizon (no host alloc mid-scan)
+        # pre-reserve KV blocks for each slot's actual horizon (no host
+        # alloc mid-scan)
         for i, s in enumerate(self.slots):
             if active_mask[i] and s is not None:
-                self.manager.reserve_tokens(s.seq_id, num_steps)
+                self.manager.reserve_tokens(
+                    s.seq_id, int(min(num_steps, budgets[i]))
+                )
                 self._block_tables[i] = self.manager.block_table_for(
                     s.seq_id, self.cfg.max_blocks_per_seq
                 )
@@ -594,7 +691,7 @@ class TPUEngine:
             jnp.asarray(self._slot_keys), jnp.asarray(self._temps),
             jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
             jnp.asarray(self._stop_ids), jnp.asarray(active_mask),
-            num_steps,
+            jnp.asarray(budgets), num_steps,
         )
         self.stats["decode_calls"] += num_steps
         emitted = np.asarray(emitted)  # [B, T], -1 = masked-out step
@@ -652,8 +749,10 @@ class TPUEngine:
         pending = list(requests)
         responses: Dict[str, InferenceResponse] = {}
         while pending or self.num_active:
-            while pending and self.free_slots():
-                self.submit(pending.pop(0))
+            n_free = len(self.free_slots())
+            if pending and n_free:
+                wave, pending = pending[:n_free], pending[n_free:]
+                self.submit_batch(wave)  # one prefill call per bucket
             if use_multi_step:
                 self.decode_multi()
             else:

@@ -7,7 +7,7 @@ with L1 GPU / L2 CPU / L3 Redis tiers and get_or_compute:389-445) plus the
 RadixAttention-style prefix sharing the reference rents from SGLang
 (SURVEY §2.3) — re-designed for TPU:
 
-- The *device* side is a pair of pool arrays ``[L, N, block, Hkv, D]`` owned by
+- The *device* side is a pair of pool arrays ``[L, N, Hkv, block, D]`` owned by
   the engine and mutated **inside jitted graphs** (scatter writes, block
   copies). This module never holds device tensors for blocks; it owns the
   *metadata*: free lists, refcounts, the radix tree, LRU order, and tier maps.
@@ -49,7 +49,7 @@ class PendingDeviceOps:
                (spill-on-evict: the block id is about to be reused)
     copies:    (src_block, dst_block) page copies (CoW / defrag)
     uploads:   (dst_block, host_kv) spill-tier promotions; host_kv is
-               ``np.ndarray [L, 2, block, Hkv, D]`` (k and v stacked on axis 1)
+               ``np.ndarray [L, 2, Hkv, block, D]`` (k and v stacked on axis 1)
     """
 
     downloads: List[Tuple[int, str]] = field(default_factory=list)

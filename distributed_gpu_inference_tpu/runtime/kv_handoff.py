@@ -66,7 +66,7 @@ class KVHandoff:
     # per-slot PRNG key: an UNSEEDED sampled generation keeps its exact
     # random stream across migration (seeded ones re-derive from the seed)
     slot_key: Optional[List[int]] = None
-    # pages: [n_blocks, L, 2, block_size, n_kv_heads, head_dim]
+    # pages: [n_blocks, L, 2, n_kv_heads, block_size, head_dim] (head-major)
     pages: np.ndarray = field(repr=False, default=None)  # type: ignore[assignment]
 
     @property
@@ -92,7 +92,7 @@ def export_slot_kv(engine: "TPUEngine", slot: int) -> KVHandoff:
     # bfloat16 directly — no f32 inflation, no f16 precision loss)
     k = np.asarray(engine.kv["k"][:, ids])
     v = np.asarray(engine.kv["v"][:, ids])
-    # → [n, L, 2, Bk, Hkv, D] so adoption can upload per block
+    # → [n, L, 2, Hkv, Bk, D] so adoption can upload per block
     pages = np.stack([k, v], axis=0).transpose(2, 1, 0, 3, 4, 5)
     tokens = list(engine.manager.seq_tokens[s.seq_id])
     return KVHandoff(
@@ -161,7 +161,7 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
     try:
         cached_blocks = cached_tokens // engine.cfg.block_size
         for i in range(cached_blocks, len(blocks)):
-            # pages[i] is [L, 2, Bk, Hkv, D] — the engine upload layout
+            # pages[i] is [L, 2, Hkv, Bk, D] — the engine upload layout
             engine.manager.pending.uploads.append((blocks[i], handoff.pages[i]))
             staged.append(blocks[i])
 

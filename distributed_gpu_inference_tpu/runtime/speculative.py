@@ -571,7 +571,7 @@ class SpeculativeDecoder:
 
 
 def _move_rows(
-    pool: jax.Array,          # [L, N, Bk, Hkv, D]
+    pool: jax.Array,          # [L, N, Hkv, Bk, D] (head-major pages)
     block_tables: jax.Array,  # [B, M]
     src_pos: jax.Array,       # [B, P] token positions (-1 invalid)
     dst_pos: jax.Array,       # [B, P]
@@ -592,10 +592,15 @@ def _move_rows(
 
     sphys, sslot, svalid = phys_slot(src_pos)
     dphys, dslot, dvalid = phys_slot(dst_pos)
-    # gather first (read everything before any write)
-    rows = pool[:, jnp.where(svalid, sphys, 0), jnp.where(svalid, sslot, 0)]
-    # rows: [L, B, P, Hkv, D]; scatter to destinations, drop invalid
+    # gather first (read everything before any write); advanced indices on
+    # dims 1 (page) and 3 (slot) are separated by slices, so the indexed
+    # dims move FIRST: rows [B, P, L, Hkv, D]
+    rows = pool[
+        :, jnp.where(svalid, sphys, 0), :, jnp.where(svalid, sslot, 0)
+    ]
     wphys = jnp.where(svalid & dvalid, dphys, num_blocks).reshape(-1)
     wslot = dslot.reshape(-1)
-    flat = rows.reshape(pool.shape[0], b * p, *pool.shape[3:])
-    return pool.at[:, wphys, wslot].set(flat, mode="drop")
+    # scatter values for .at[:, wphys, :, wslot] follow the same rule:
+    # [T, L, Hkv, D]
+    flat = rows.reshape(b * p, pool.shape[0], pool.shape[2], pool.shape[4])
+    return pool.at[:, wphys, :, wslot].set(flat, mode="drop")

@@ -44,8 +44,8 @@ def _paged_setup(b, s, hkv, d, block):
     v = jax.random.normal(ks[1], (b, s, hkv, d), jnp.float32)
     m = -(-s // block)
     num_blocks = 1 + b * m
-    k_pool = jnp.zeros((num_blocks, block, hkv, d), jnp.float32)
-    v_pool = jnp.zeros((num_blocks, block, hkv, d), jnp.float32)
+    k_pool = jnp.zeros((num_blocks, hkv, block, d), jnp.float32)
+    v_pool = jnp.zeros((num_blocks, hkv, block, d), jnp.float32)
     tables = np.zeros((b, m), np.int32)
     nxt = 1
     for i in range(b):
@@ -54,8 +54,8 @@ def _paged_setup(b, s, hkv, d, block):
     for i in range(b):
         for t in range(s):
             blk, slot = tables[i][t // block], t % block
-            k_pool = k_pool.at[blk, slot].set(k[i, t])
-            v_pool = v_pool.at[blk, slot].set(v[i, t])
+            k_pool = k_pool.at[blk, :, slot].set(k[i, t])
+            v_pool = v_pool.at[blk, :, slot].set(v[i, t])
     return k, v, k_pool, v_pool, jnp.asarray(tables)
 
 
@@ -96,9 +96,10 @@ def test_window_actually_restricts():
     lens = jnp.full((b,), s, jnp.int32)
     base = paged_attention_xla(q, k_pool, v_pool, tables, positions, lens,
                                block, window=4)
-    # perturb key/value at position 0 — outside every window-4 query ≥ 4
-    k_pool2 = k_pool.at[1, 0].add(100.0)
-    v_pool2 = v_pool.at[1, 0].add(100.0)
+    # perturb key/value at position 0 (block 1, slot 0 across heads) —
+    # outside every window-4 query ≥ 4
+    k_pool2 = k_pool.at[1, :, 0].add(100.0)
+    v_pool2 = v_pool.at[1, :, 0].add(100.0)
     pert = paged_attention_xla(q, k_pool2, v_pool2, tables, positions, lens,
                                block, window=4)
     np.testing.assert_allclose(np.asarray(base[:, 4:]), np.asarray(pert[:, 4:]),

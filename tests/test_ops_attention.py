@@ -15,19 +15,20 @@ BLOCK = 16
 
 
 def _paged_layout(k, v, num_blocks, block_size=BLOCK):
-    """Pack contiguous [B,S,H,D] KV into a paged pool + block tables."""
+    """Pack contiguous [B,S,H,D] KV into a head-major paged pool
+    [N, H, Bk, D] + block tables."""
     b, s, h, d = k.shape
     m = -(-s // block_size)
-    k_pool = np.zeros((num_blocks, block_size, h, d), np.float32)
-    v_pool = np.zeros((num_blocks, block_size, h, d), np.float32)
+    k_pool = np.zeros((num_blocks, h, block_size, d), np.float32)
+    v_pool = np.zeros((num_blocks, h, block_size, d), np.float32)
     tables = np.zeros((b, m), np.int32)
     nxt = 1  # block 0 reserved
     for bi in range(b):
         for mi in range(m):
             tables[bi, mi] = nxt
             lo, hi = mi * block_size, min((mi + 1) * block_size, s)
-            k_pool[nxt, : hi - lo] = k[bi, lo:hi]
-            v_pool[nxt, : hi - lo] = v[bi, lo:hi]
+            k_pool[nxt, :, : hi - lo] = k[bi, lo:hi].transpose(1, 0, 2)
+            v_pool[nxt, :, : hi - lo] = v[bi, lo:hi].transpose(1, 0, 2)
             nxt += 1
     return jnp.asarray(k_pool), jnp.asarray(v_pool), jnp.asarray(tables)
 

@@ -1,0 +1,123 @@
+"""Pallas paged-attention decode kernel vs the XLA oracle (interpret mode).
+
+The kernel (ops/paged_attention_pallas.py) must match paged_attention_xla
+bit-close on every masking case: GQA, partial pages, multi-group contexts,
+sliding windows, inactive slots."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
+from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+    paged_attention_pallas,
+)
+
+
+def _setup(b, kv_lens, nh, hkv, d, block, m, seed=0):
+    """Random pools with each sequence's pages filled up to its kv_len."""
+    key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 3)
+    num_blocks = 1 + b * m
+    k_pool = jax.random.normal(ks[0], (num_blocks, hkv, block, d), jnp.float32)
+    v_pool = jax.random.normal(ks[1], (num_blocks, hkv, block, d), jnp.float32)
+    q = jax.random.normal(ks[2], (b, 1, nh, d), jnp.float32)
+    tables = np.zeros((b, m), np.int32)
+    nxt = 1
+    for i in range(b):
+        tables[i] = np.arange(nxt, nxt + m)
+        nxt += m
+    lens = np.asarray(kv_lens, np.int32)
+    positions = (lens - 1)[:, None].astype(np.int32)
+    return (q, k_pool, v_pool, jnp.asarray(tables),
+            jnp.asarray(positions), jnp.asarray(lens))
+
+
+def _compare(args, block, window=None, atol=2e-5):
+    q, k_pool, v_pool, tables, positions, lens = args
+    want = paged_attention_xla(
+        q, k_pool, v_pool, tables, positions, lens, block, window=window
+    )
+    got = paged_attention_pallas(
+        q, k_pool, v_pool, tables, positions, lens, block, window=window,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=atol)
+
+
+def test_basic_decode_partial_page():
+    _compare(_setup(2, [9, 23], nh=4, hkv=2, d=64, block=16, m=4), 16)
+
+
+def test_multi_group_long_context():
+    # 300 tokens → 19 pages → 3 page groups (8 pages each)
+    _compare(_setup(2, [300, 17], nh=8, hkv=4, d=64, block=16, m=20), 16)
+
+
+def test_group_boundary_exact():
+    # kv_len exactly a group multiple (8 pages * 16 = 128)
+    _compare(_setup(1, [128], nh=4, hkv=2, d=64, block=16, m=8), 16)
+
+
+def test_single_token_context():
+    _compare(_setup(1, [1], nh=4, hkv=4, d=64, block=16, m=2), 16)
+
+
+def test_mqa_single_kv_head():
+    _compare(_setup(2, [40, 7], nh=8, hkv=1, d=64, block=16, m=4), 16)
+
+
+def test_inactive_slot_zero_output():
+    args = _setup(3, [12, 0, 5], nh=4, hkv=2, d=64, block=16, m=2)
+    q, k_pool, v_pool, tables, positions, lens = args
+    got = paged_attention_pallas(
+        q, k_pool, v_pool, tables, positions, lens, 16, interpret=True
+    )
+    assert np.all(np.asarray(got)[1] == 0.0)
+    _compare(args, 16)
+
+
+@pytest.mark.parametrize("window", [4, 16, 100])
+def test_sliding_window(window):
+    _compare(_setup(2, [150, 30], nh=4, hkv=2, d=64, block=16, m=10), 16,
+             window=window)
+
+
+def test_window_skips_leading_groups():
+    """Window smaller than one group: dead leading groups are skipped but
+    output still matches the oracle."""
+    _compare(_setup(1, [290], nh=4, hkv=2, d=64, block=16, m=20), 16,
+             window=32)
+
+
+def test_head_dim_128():
+    _compare(_setup(1, [21], nh=4, hkv=2, d=128, block=16, m=2), 16)
+
+
+def test_bfloat16_pools():
+    q, k_pool, v_pool, tables, positions, lens = _setup(
+        2, [33, 60], nh=4, hkv=2, d=64, block=16, m=4
+    )
+    q = q.astype(jnp.bfloat16)
+    k_pool = k_pool.astype(jnp.bfloat16)
+    v_pool = v_pool.astype(jnp.bfloat16)
+    want = paged_attention_xla(q, k_pool, v_pool, tables, positions, lens, 16)
+    got = paged_attention_pallas(q, k_pool, v_pool, tables, positions, lens,
+                                 16, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_rejects_prefill_shapes():
+    q = jnp.zeros((1, 4, 4, 64), jnp.float32)
+    k = jnp.zeros((4, 2, 16, 64), jnp.float32)
+    with pytest.raises(ValueError, match="decode"):
+        paged_attention_pallas(
+            q, k, k, jnp.zeros((1, 2), jnp.int32),
+            jnp.zeros((1, 4), jnp.int32), jnp.zeros((1,), jnp.int32),
+            16, interpret=True,
+        )

@@ -112,3 +112,38 @@ def test_engine_stats(engine):
     s = engine.get_stats()
     assert s["requests"] > 0
     assert s["kv_cache"]["prefix_queries"] > 0
+
+
+def test_submit_batch_rollback_on_invalid_request():
+    """A failed wave must not leak sequences or half-bound slots."""
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        EngineConfig,
+        TPUEngine,
+    )
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+    import pytest as _pytest
+
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=2, max_seq_len=64, block_size=16,
+                     prefill_buckets=(16,), dtype="float32"),
+    )
+    good = InferenceRequest(
+        prompt_token_ids=[5, 17, 3],
+        sampling=SamplingParams(max_new_tokens=4, temperature=0.0),
+    )
+    bad = InferenceRequest(
+        prompt_token_ids=[], sampling=SamplingParams(max_new_tokens=4),
+    )
+    free_before = eng.manager.num_free
+    with _pytest.raises(ValueError):
+        eng.submit_batch([good, bad])
+    assert eng.num_active == 0
+    assert eng.manager.num_free == free_before
+    assert not eng.manager.seq_blocks
+    # engine still serviceable after the failed wave
+    out = eng.generate([good])
+    assert len(out[0].token_ids) == 4
