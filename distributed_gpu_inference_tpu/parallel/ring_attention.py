@@ -1,23 +1,28 @@
-"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+"""Sequence/context parallelism over the ``seq`` mesh axis: ring + Ulysses.
 
 Green-field per SURVEY §5.7 — the reference has NO sequence scaling (its
 layer-sharded pipeline scales model depth only; long inputs are delegated to
 vLLM/SGLang chunked-prefill flags, ``worker/engines/llm_vllm.py:61``,
 ``llm_sglang.py:63``). Here long sequences are first-class: Q/K/V are sharded
-over the ``seq`` axis, and KV shards rotate around the ring via
-``lax.ppermute`` over ICI while each device accumulates blockwise attention
-with an online softmax (the Liu et al. ring-attention recipe, expressed so XLA
-can overlap the permute with the matmul of the next round).
+over the ``seq`` axis, with two interchangeable communication strategies:
 
-Two entry points:
+- **Ring** (:func:`ring_self_attention`) — KV shards rotate around the ring
+  via ``lax.ppermute`` over ICI while each device accumulates blockwise
+  attention with an online softmax (the Liu et al. recipe, expressed so XLA
+  can overlap the permute with the matmul of the next round). No head-count
+  constraint; n-1 KV-sized hops.
+- **Ulysses** (:func:`ulysses_self_attention`) — two ``lax.all_to_all``
+  exchanges swap the sequence shard for a head shard (DeepSpeed-Ulysses):
+  each device runs plain full-sequence attention over its Nh/n heads.
+  Communication is 2 activation-sized a2a instead of n-1 KV rotations —
+  cheaper when n is large and heads are plentiful; requires
+  ``num_kv_heads % n == 0``.
 
-- :func:`ring_self_attention` — prefill-style full self-attention of a
-  seq-sharded chunk (each device holds S/n queries and S/n keys).
-- :func:`seq_parallel_decode_attention` — decode-style: queries replicated on
-  the ring, context KV sharded; partial (max, sum, acc) merged with one
-  ``pmax``/``psum`` instead of n ring hops.
+Plus :func:`seq_parallel_decode_attention` — decode-style: queries replicated
+on the ring, context KV sharded; partial (max, sum, acc) merged with one
+``pmax``/``psum`` instead of n ring hops.
 
-Both match the semantics of ``ops.attention.dense_causal_attention`` (the test
+All match the semantics of ``ops.attention.dense_causal_attention`` (the test
 oracle): causal GQA with per-sequence valid ``lengths``.
 """
 
@@ -121,6 +126,69 @@ def ring_self_attention(
         functools.partial(
             _ring_attention_local, axis_name=AXIS_SEQ, axis_size=n
         ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(dspec)),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, lengths)
+
+
+def _ulysses_local(
+    q: jax.Array,        # [B, S/n, Nh, D] — this device's sequence shard
+    k: jax.Array,        # [B, S/n, Hkv, D]
+    v: jax.Array,        # [B, S/n, Hkv, D]
+    lengths: jax.Array,  # [B] global valid lengths (replicated)
+    axis_name: str,
+) -> jax.Array:
+    """Per-device body (runs under shard_map). → [B, S/n, Nh, D].
+
+    a2a #1 scatters heads / gathers sequence → full-sequence attention over
+    the local head group; a2a #2 restores the sequence sharding. Contiguous
+    head splits keep GQA intact: device p owns query heads
+    [p·Nh/n, (p+1)·Nh/n) and exactly their KV heads [p·Hkv/n, (p+1)·Hkv/n)
+    (head h reads kv head h // qpk, and Nh/n = qpk · Hkv/n).
+    """
+    from distributed_gpu_inference_tpu.ops.attention import (
+        dense_causal_attention,
+    )
+
+    q_full = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                                tiled=True)   # [B, S, Nh/n, D]
+    k_full = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                                tiled=True)   # [B, S, Hkv/n, D]
+    v_full = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                                tiled=True)
+    out = dense_causal_attention(q_full, k_full, v_full, lengths)
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)     # [B, S/n, Nh, D]
+
+
+def ulysses_self_attention(
+    q: jax.Array,        # [B, S, Nh, D] — S divisible by mesh seq size
+    k: jax.Array,        # [B, S, Hkv, D]
+    v: jax.Array,        # [B, S, Hkv, D]
+    lengths: jax.Array,  # [B]
+    mesh: Mesh,
+    shard_batch: bool = False,
+) -> jax.Array:
+    """Causal GQA self-attention, seq-sharded, Ulysses a2a strategy.
+
+    Same contract as :func:`ring_self_attention`; requires
+    ``num_kv_heads % seq_axis == 0``.
+    """
+    n = dict(mesh.shape).get(AXIS_SEQ, 1)
+    if q.shape[1] % n:
+        raise ValueError(f"seq len {q.shape[1]} not divisible by seq axis {n}")
+    if k.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs num_kv_heads {k.shape[2]} divisible by the seq "
+            f"axis {n} (use ring_self_attention otherwise)"
+        )
+    dspec = AXIS_DATA if shard_batch else None
+    qkv_spec = P(dspec, AXIS_SEQ, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=AXIS_SEQ),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, P(dspec)),
         out_specs=qkv_spec,

@@ -422,6 +422,11 @@ class TPUEngine:
         slots_out: List[int] = []
         grouped: Dict[int, List[Tuple[InferenceRequest, int, str, List[int], int]]] = {}
         admitted: List[Tuple[int, str]] = []  # (slot, seq_id) for cleanup
+        stats_snapshot = {
+            k: self.stats[k]
+            for k in ("requests", "prefill_tokens", "prefill_calls",
+                      "generated_tokens")
+        }
 
         def _rollback() -> None:
             for slot, seq_id in admitted:
@@ -429,6 +434,19 @@ class TPUEngine:
                 self._kv_lens[slot] = 0
                 if seq_id in self.manager.seq_blocks:
                     self.manager.free_sequence(seq_id, cache=False)
+            # pending device ops staged for now-freed blocks must not apply
+            # later: a freed id gets reallocated, and an orphaned upload or
+            # CoW copy would clobber the new owner's pages (allocate_sequence
+            # scrubs its own staging on OutOfBlocksError the same way)
+            alive = self.manager.metas
+            p = self.manager.pending
+            p.uploads = [u for u in p.uploads if u[0] in alive]
+            p.copies = [
+                c for c in p.copies if c[0] in alive and c[1] in alive
+            ]
+            p.downloads = [dl for dl in p.downloads if dl[0] in alive]
+            # stats must not double-count requests a retry will re-admit
+            self.stats.update(stats_snapshot)
 
         try:
             for request, slot in zip(requests, free):

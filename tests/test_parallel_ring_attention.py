@@ -101,3 +101,69 @@ def test_decode_merge_matches_dense(cpu_devices):
         np.testing.assert_allclose(
             np.asarray(got[i : i + 1]), np.asarray(want_i), atol=1e-5
         )
+
+
+# ---------------------------------------------------------------- Ulysses
+
+
+@pytest.mark.parametrize("seq_axis", [2, 4])
+def test_ulysses_matches_dense(cpu_devices, seq_axis):
+    from distributed_gpu_inference_tpu.parallel.ring_attention import (
+        ulysses_self_attention,
+    )
+
+    mesh = make_mesh(MeshPlan(seq=seq_axis), cpu_devices[:seq_axis])
+    b, s, nh, hkv, d = 2, 32, 8, 4, 8  # hkv divisible by seq axis
+    q, k, v = _qkv(jax.random.PRNGKey(3), b, s, nh, hkv, d)
+    lengths = jnp.array([s, s - 7], jnp.int32)
+
+    want = dense_causal_attention(q, k, v, lengths)
+    got = ulysses_self_attention(q, k, v, lengths, mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+def test_ulysses_matches_ring(cpu_devices):
+    from distributed_gpu_inference_tpu.parallel.ring_attention import (
+        ring_self_attention,
+        ulysses_self_attention,
+    )
+
+    mesh = make_mesh(MeshPlan(seq=4), cpu_devices[:4])
+    b, s, nh, hkv, d = 1, 64, 8, 4, 16
+    q, k, v = _qkv(jax.random.PRNGKey(4), b, s, nh, hkv, d)
+    lengths = jnp.array([s - 3], jnp.int32)
+    ring = ring_self_attention(q, k, v, lengths, mesh)
+    uly = ulysses_self_attention(q, k, v, lengths, mesh)
+    np.testing.assert_allclose(np.asarray(uly), np.asarray(ring), atol=1e-5)
+
+
+def test_ulysses_rejects_indivisible_heads(cpu_devices):
+    from distributed_gpu_inference_tpu.parallel.ring_attention import (
+        ulysses_self_attention,
+    )
+
+    mesh = make_mesh(MeshPlan(seq=4), cpu_devices[:4])
+    b, s, nh, hkv, d = 1, 32, 4, 2, 8  # hkv=2 not divisible by 4
+    q, k, v = _qkv(jax.random.PRNGKey(5), b, s, nh, hkv, d)
+    with pytest.raises(ValueError, match="ring_self_attention"):
+        ulysses_self_attention(q, k, v, jnp.array([s], jnp.int32), mesh)
+
+
+def test_ulysses_under_jit_with_data_axis(cpu_devices):
+    from distributed_gpu_inference_tpu.parallel.ring_attention import (
+        ulysses_self_attention,
+    )
+
+    mesh = make_mesh(MeshPlan(data=2, seq=4), cpu_devices)
+    b, s, nh, hkv, d = 2, 16, 8, 4, 8
+    q, k, v = _qkv(jax.random.PRNGKey(6), b, s, nh, hkv, d)
+    lengths = jnp.array([s, s - 2], jnp.int32)
+
+    @jax.jit
+    def run(q, k, v, lengths):
+        return ulysses_self_attention(q, k, v, lengths, mesh,
+                                      shard_batch=True)
+
+    got = run(q, k, v, lengths)
+    want = dense_causal_attention(q, k, v, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)

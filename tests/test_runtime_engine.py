@@ -147,3 +147,39 @@ def test_submit_batch_rollback_on_invalid_request():
     # engine still serviceable after the failed wave
     out = eng.generate([good])
     assert len(out[0].token_ids) == 4
+
+
+def test_submit_batch_rollback_scrubs_pending_and_stats():
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        EngineConfig,
+        TPUEngine,
+    )
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+    import pytest as _pytest
+
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=2, max_seq_len=64, block_size=16,
+                     prefill_buckets=(16,), dtype="float32"),
+    )
+    before = dict(eng.stats)
+    good = InferenceRequest(
+        prompt_token_ids=[5, 17, 3],
+        sampling=SamplingParams(max_new_tokens=4, temperature=0.0),
+    )
+    bad = InferenceRequest(
+        prompt_token_ids=[], sampling=SamplingParams(max_new_tokens=4),
+    )
+    with _pytest.raises(ValueError):
+        eng.submit_batch([good, bad])
+    for k in ("requests", "prefill_tokens", "prefill_calls",
+              "generated_tokens"):
+        assert eng.stats[k] == before[k], k
+    # no pending device ops may reference freed blocks
+    alive = eng.manager.metas
+    assert all(u[0] in alive for u in eng.manager.pending.uploads)
+    assert all(c[0] in alive and c[1] in alive
+               for c in eng.manager.pending.copies)
