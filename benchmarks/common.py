@@ -75,6 +75,93 @@ def make_request(prompt_token_ids: Sequence[int], max_new_tokens: int):
     )
 
 
+def train_toy_lm(cfg, key, steps: int = 600, batch: int = 16,
+                 seq_len: int = 64, lr: float = 3e-3, noise: float = 0.05):
+    """Train a model on a learnable synthetic task so benchmarks that need a
+    PREDICTABLE model (speculative decoding) measure real behavior.
+
+    Random-init weights have near-uniform, chaotic logits — no draft can
+    match them, so an accept-rate measurement on them says nothing (the
+    reference dodges this by SIMULATING accept rates,
+    ``benchmarks/speculative.py:123-272``). Here the target is trained on a
+    noisy Markov chain (x_{t+1} = perm[x_t] w.p. 1-noise): a task a tiny
+    transformer learns to near-ceiling in seconds, giving sharp logits an
+    EAGLE head can genuinely be distilled against.
+
+    Returns ``(params_in_model_dtype, sample_stream)`` where
+    ``sample_stream(key, batch, seq_len)`` draws token streams from the
+    chain (use it for prompts so decode continues in-distribution).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from distributed_gpu_inference_tpu.models import llama
+
+    kp, kperm, kdata = jax.random.split(jax.random.PRNGKey(0) if key is None
+                                        else key, 3)
+    perm = jax.random.permutation(kperm, cfg.vocab_size)
+
+    def sample_stream(k, b, s):
+        ks = jax.random.split(k, s)
+        x0 = jax.random.randint(ks[0], (b,), 0, cfg.vocab_size, jnp.int32)
+
+        def step(x, kk):
+            k_u, k_r = jax.random.split(kk)
+            nxt = perm[x]
+            u = jax.random.uniform(k_u, (b,))
+            rnd = jax.random.randint(k_r, (b,), 0, cfg.vocab_size, jnp.int32)
+            x2 = jnp.where(u < noise, rnd, nxt).astype(jnp.int32)
+            return x2, x2
+
+        _, xs = jax.lax.scan(step, x0, ks[1:])
+        return jnp.concatenate([x0[:, None], xs.T], axis=1)   # [B, S]
+
+    bs = 16
+    m = -(-seq_len // bs)
+    positions = jnp.tile(jnp.arange(seq_len, dtype=jnp.int32), (batch, 1))
+    lens = jnp.full((batch,), seq_len, jnp.int32)
+    tables = jnp.asarray(
+        np.arange(1, 1 + batch * m, dtype=np.int32).reshape(batch, m)
+    )
+    params = llama.init_params(cfg, kp, jnp.float32)
+    opt = optax.adam(lr)
+    opt_state = opt.init(params)
+
+    def loss_fn(params, toks):
+        kv = llama.init_kv_pools(cfg, 1 + batch * m, bs, jnp.float32)
+        out = llama.forward_chunk(
+            cfg, params, toks, positions, kv, tables, lens,
+            block_size=bs, last_only=False,
+        )
+        logp = jax.nn.log_softmax(out.logits[:, :-1].astype(jnp.float32), -1)
+        tgt = toks[:, 1:, None]
+        return -jnp.mean(jnp.take_along_axis(logp, tgt, axis=-1))
+
+    # the WHOLE training loop is one lax.scan in one jitted call: through a
+    # remote TPU tunnel, a host-driven step loop pays dispatch per step and
+    # a compile per shape — this compiles once and runs device-side
+    @jax.jit
+    def train(params, opt_state):
+        def step_fn(carry, step):
+            params, opt_state = carry
+            toks = sample_stream(
+                jax.random.fold_in(kdata, step), batch, seq_len
+            )
+            loss, grads = jax.value_and_grad(loss_fn)(params, toks)
+            updates, opt_state = opt.update(grads, opt_state)
+            return (optax.apply_updates(params, updates), opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            step_fn, (params, opt_state), jnp.arange(steps)
+        )
+        return params, losses
+
+    params, _losses = train(params, opt_state)
+    dtype = jnp.dtype(cfg.dtype)
+    return jax.tree.map(lambda a: a.astype(dtype), params), sample_stream
+
+
 def emit(result: Dict[str, Any]) -> None:
     print(json.dumps(result))
 

@@ -7,6 +7,13 @@ an analytic accept-rate SIMULATOR (:123-272); this one runs the actual
 on-device tree draft→verify→accept loop and an identical vanilla decode for
 the speedup denominator.
 
+Methodology: random-init weights have near-uniform logits no draft can
+match, so the harness first TRAINS the target on a learnable synthetic task
+(noisy Markov chain, ``benchmarks/common.train_toy_lm``) and then distills
+the EAGLE draft head against it on-device
+(``runtime.speculative.distill_draft_params``) — every number is real
+compute on real (trained) weights, no simulated accept rates.
+
 Usage:
     python -m benchmarks.speculative --model llama3-mini --requests 4 \
         --max-tokens 64
@@ -26,7 +33,7 @@ from benchmarks.common import (
     emit,
     make_request,
     resolve_backend_model,
-    synth_prompts,
+    train_toy_lm,
 )
 
 
@@ -38,14 +45,19 @@ def main() -> None:
     ap.add_argument("--max-tokens", type=int, default=64)
     ap.add_argument("--widths", default="4,2,2",
                     help="tree widths per level, comma-separated")
+    ap.add_argument("--train-steps", type=int, default=1500,
+                    help="target-model training steps on the synthetic task")
+    ap.add_argument("--distill-steps", type=int, default=800,
+                    help="EAGLE draft-head distillation steps")
     add_platform_arg(ap)
     args = ap.parse_args()
 
     import jax
 
-    backend, model = resolve_backend_model(args)
+    backend, model = resolve_backend_model(args, tpu_default="llama3-tiny")
     widths = tuple(int(w) for w in args.widths.split(","))
 
+    from distributed_gpu_inference_tpu.models.configs import get_model_config
     from distributed_gpu_inference_tpu.runtime.engine import (
         EngineConfig,
         TPUEngine,
@@ -53,17 +65,31 @@ def main() -> None:
     from distributed_gpu_inference_tpu.runtime.speculative import (
         SpeculativeConfig,
         SpeculativeDecoder,
+        distill_draft_params,
     )
+
+    cfg = get_model_config(model)
+    with Timer() as t_train:
+        params, sample_stream = train_toy_lm(
+            cfg, jax.random.PRNGKey(0), steps=args.train_steps
+        )
+    with Timer() as t_distill:
+        draft_params = distill_draft_params(
+            cfg, params, jax.random.PRNGKey(1), steps=args.distill_steps
+        )
+
     max_seq = args.prompt_len + args.max_tokens + 64
     spec = SpeculativeDecoder(
-        model,
+        cfg,
+        params=params,
+        draft_params=draft_params,
         spec_cfg=SpeculativeConfig(widths=widths),
         max_batch_size=args.requests,
         max_seq_len=max_seq,
         prefill_buckets=(args.prompt_len,),
     )
     vanilla = TPUEngine(
-        model,
+        cfg,
         EngineConfig(
             max_batch_size=args.requests, max_seq_len=max_seq,
             prefill_buckets=(args.prompt_len,), enable_prefix_cache=False,
@@ -71,9 +97,12 @@ def main() -> None:
         params=spec.params,  # same weights: same tokens, fair timing
     )
 
-    prompts = synth_prompts(
-        args.requests, args.prompt_len, spec.model_cfg.vocab_size
-    )
+    prompts = [
+        [int(t) for t in row]
+        for row in sample_stream(
+            jax.random.PRNGKey(42), args.requests, args.prompt_len
+        )
+    ]
 
     def reqs():
         return [make_request(p, args.max_tokens) for p in prompts]
@@ -113,6 +142,8 @@ def main() -> None:
         "vanilla_tokens_per_s": round(van_tps, 2),
         "spec_elapsed_s": round(t_spec.elapsed, 3),
         "vanilla_elapsed_s": round(t_van.elapsed, 3),
+        "target_train_s": round(t_train.elapsed, 1),
+        "draft_distill_s": round(t_distill.elapsed, 1),
     })
 
 

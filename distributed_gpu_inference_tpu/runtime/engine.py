@@ -427,6 +427,8 @@ class TPUEngine:
             for k in ("requests", "prefill_tokens", "prefill_calls",
                       "generated_tokens")
         }
+        mgr_stats_snapshot = dict(self.manager.stats.__dict__)
+        downloads_before = len(self.manager.pending.downloads)
 
         def _rollback() -> None:
             for slot, seq_id in admitted:
@@ -437,16 +439,24 @@ class TPUEngine:
             # pending device ops staged for now-freed blocks must not apply
             # later: a freed id gets reallocated, and an orphaned upload or
             # CoW copy would clobber the new owner's pages (allocate_sequence
-            # scrubs its own staging on OutOfBlocksError the same way)
+            # scrubs its own staging on OutOfBlocksError the same way).
+            # Downloads are NOT filtered: a spill-on-evict download's source
+            # block is popped from metas when staged, and dropping it would
+            # lose the evicted page's only copy.
             alive = self.manager.metas
             p = self.manager.pending
             p.uploads = [u for u in p.uploads if u[0] in alive]
             p.copies = [
                 c for c in p.copies if c[0] in alive and c[1] in alive
             ]
-            p.downloads = [dl for dl in p.downloads if dl[0] in alive]
-            # stats must not double-count requests a retry will re-admit
+            # stats must not double-count requests a retry will re-admit —
+            # engine counters and the manager's cache stats alike. Spills
+            # staged by this wave survive the rollback (their downloads are
+            # kept above), so those stay counted.
+            kept_wave_spills = len(p.downloads) - downloads_before
             self.stats.update(stats_snapshot)
+            self.manager.stats.__dict__.update(mgr_stats_snapshot)
+            self.manager.stats.spills += max(kept_wave_spills, 0)
 
         try:
             for request, slot in zip(requests, free):

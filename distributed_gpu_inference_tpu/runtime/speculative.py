@@ -157,6 +157,112 @@ def draft_apply(
     return llama.rms_norm(x, dp["norm"], cfg.rms_norm_eps)
 
 
+def distill_draft_params(
+    cfg: ModelConfig,
+    params: llama.Params,
+    key: jax.Array,
+    steps: int = 400,
+    batch: int = 8,
+    seq_len: int = 64,
+    num_batches: int = 8,
+    lr: float = 2e-3,
+    ce_weight: float = 0.2,
+) -> Dict[str, jax.Array]:
+    """EAGLE-style draft-head distillation against the frozen target.
+
+    The reference assumes pretrained EAGLE/Medusa weights exist
+    (``worker/engines/speculative.py`` only runs inference); here the head
+    can be fit on-device in seconds: teacher-force the target over token
+    streams, then regress ``draft(h_t, e(x_{t+1})) → h_{t+1}`` with a
+    feature MSE plus a CE term against the target's next-token distribution
+    (the EAGLE recipe: feature-level supervision dominates, logits align
+    the part that matters for acceptance).
+
+    Teacher hidden states are precomputed once for ``num_batches`` fixed
+    random streams; the training loop then runs ``steps`` cheap MLP updates
+    jitted on device. Returns draft params in the model dtype.
+    """
+    import optax
+
+    bs = 16
+    kd, kt = jax.random.split(key)
+    # ---- teacher pass: hidden states over random token streams
+    m = -(-seq_len // bs)
+    tokens_all = jax.random.randint(
+        kt, (num_batches, batch, seq_len), 0, cfg.vocab_size, jnp.int32
+    )
+    positions = jnp.tile(jnp.arange(seq_len, dtype=jnp.int32), (batch, 1))
+    lens = jnp.full((batch,), seq_len, jnp.int32)
+    tables = jnp.asarray(
+        np.arange(1, 1 + batch * m, dtype=np.int32).reshape(batch, m)
+    )
+
+    @jax.jit
+    def teacher(tokens):
+        kv = llama.init_kv_pools(cfg, 1 + batch * m, bs)
+        out = llama.forward_chunk(
+            cfg, params, tokens, positions, kv, tables, lens,
+            block_size=bs, last_only=False,
+        )
+        # target next-token distribution at every position (frozen labels)
+        logits = llama.project_logits(cfg, params, out.hidden)
+        return out.hidden.astype(jnp.float32), jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1
+        )
+
+    hiddens, logps = [], []
+    for i in range(num_batches):
+        h, lp = teacher(tokens_all[i])
+        hiddens.append(h)
+        logps.append(lp)
+    hiddens = jnp.stack(hiddens)   # [N, B, S, H] float32
+    logps = jnp.stack(logps)       # [N, B, S, V]
+
+    # ---- student: train in float32
+    dp = jax.tree.map(
+        lambda a: a.astype(jnp.float32), init_draft_params(cfg, kd)
+    )
+    opt = optax.adam(lr)
+    opt_state = opt.init(dp)
+    cfg32 = cfg  # rms eps etc. unchanged; draft_apply respects input dtype
+
+    def loss_fn(dp, tokens, hidden, logp):
+        # inputs at t: (h_t, emb(x_{t+1})) → predict h_{t+1}
+        emb_next = llama.embed_tokens(params, tokens[:, 1:], cfg).astype(
+            jnp.float32
+        )
+        pred = draft_apply(cfg32, dp, hidden[:, :-1], emb_next)  # [B,S-1,H]
+        mse = jnp.mean(jnp.square(pred - hidden[:, 1:]))
+        pred_logits = llama.project_logits(cfg, params, pred)
+        pred_logp = jax.nn.log_softmax(pred_logits, axis=-1)
+        # CE against the target's (frozen) next-step distribution
+        ce = -jnp.mean(
+            jnp.sum(jnp.exp(logp[:, 1:]) * pred_logp, axis=-1)
+        )
+        return mse + ce_weight * ce
+
+    # single scan = one compile + one device call (tunnel-friendly)
+    @jax.jit
+    def train(dp, opt_state):
+        def step_fn(carry, step):
+            dp, opt_state = carry
+            i = step % num_batches
+            loss, grads = jax.value_and_grad(loss_fn)(
+                dp, tokens_all[i], hiddens[i], logps[i]
+            )
+            updates, opt_state = opt.update(grads, opt_state)
+            return (optax.apply_updates(dp, updates), opt_state), loss
+
+        (dp, _), losses = jax.lax.scan(
+            step_fn, (dp, opt_state), jnp.arange(steps)
+        )
+        return dp, losses
+
+    dp, _losses = train(dp, opt_state)
+    dtype = jnp.dtype(cfg.dtype)
+    return jax.tree.map(lambda a: a.astype(dtype), dp)
+
+
 def init_medusa_params(
     cfg: ModelConfig, key: jax.Array, num_heads: int = 4,
     dtype: Optional[jnp.dtype] = None,

@@ -1,0 +1,95 @@
+"""Draft-head distillation + toy-task target training (the speculative
+benchmark's methodology: real trained weights, no simulated accept rates)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from benchmarks.common import train_toy_lm
+from distributed_gpu_inference_tpu.models import llama
+from distributed_gpu_inference_tpu.models.configs import get_model_config
+from distributed_gpu_inference_tpu.runtime.speculative import (
+    distill_draft_params,
+    draft_apply,
+    init_draft_params,
+)
+
+CFG = get_model_config("llama3-tiny", dtype="float32")
+
+
+def _chain_ce(cfg, params, sample_stream, key):
+    """Mean CE of the model on held-out chain streams."""
+    b, s, bs = 4, 32, 16
+    toks = sample_stream(key, b, s)
+    m = -(-s // bs)
+    kv = llama.init_kv_pools(cfg, 1 + b * m, bs, jnp.float32)
+    tables = jnp.asarray(np.arange(1, 1 + b * m, dtype=np.int32).reshape(b, m))
+    pos = jnp.tile(jnp.arange(s, dtype=jnp.int32), (b, 1))
+    out = llama.forward_chunk(
+        cfg, params, toks, pos, kv, tables, jnp.full((b,), s, jnp.int32),
+        block_size=bs, last_only=False,
+    )
+    logp = jax.nn.log_softmax(out.logits[:, :-1].astype(jnp.float32), -1)
+    return float(-jnp.mean(
+        jnp.take_along_axis(logp, toks[:, 1:, None], axis=-1)
+    ))
+
+
+def test_toy_training_learns_the_chain():
+    params, sample_stream = train_toy_lm(
+        CFG, jax.random.PRNGKey(0), steps=80, batch=8, seq_len=32
+    )
+    rand = llama.init_params(CFG, jax.random.PRNGKey(9), jnp.float32)
+    key = jax.random.PRNGKey(123)
+    ce_rand = _chain_ce(CFG, rand, sample_stream, key)
+    ce_trained = _chain_ce(
+        CFG, jax.tree.map(lambda a: a.astype(jnp.float32), params),
+        sample_stream, key,
+    )
+    # uniform baseline CE = ln(512) ≈ 6.24; training must clearly beat it
+    assert ce_rand > 5.0
+    assert ce_trained < ce_rand - 1.0
+
+
+def test_distilled_draft_beats_random():
+    """Distillation must cut the draft's next-hidden regression error well
+    below a random head's (argmax agreement additionally needs a sharply
+    trained target — the TPU benchmark exercises that end to end)."""
+    params = llama.init_params(CFG, jax.random.PRNGKey(1), jnp.float32)
+    dp = distill_draft_params(
+        CFG, params, jax.random.PRNGKey(2), steps=150, batch=4,
+        seq_len=32, num_batches=2,
+    )
+
+    def feature_mse(dp):
+        b, s, bs = 4, 32, 16
+        toks = jax.random.randint(jax.random.PRNGKey(77), (b, s), 0,
+                                  CFG.vocab_size, jnp.int32)
+        m = -(-s // bs)
+        kv = llama.init_kv_pools(CFG, 1 + b * m, bs, jnp.float32)
+        tables = jnp.asarray(
+            np.arange(1, 1 + b * m, dtype=np.int32).reshape(b, m)
+        )
+        pos = jnp.tile(jnp.arange(s, dtype=jnp.int32), (b, 1))
+        out = llama.forward_chunk(
+            CFG, params, toks, pos, kv, tables,
+            jnp.full((b,), s, jnp.int32), block_size=bs, last_only=False,
+        )
+        h = out.hidden
+        emb = llama.embed_tokens(params, toks[:, 1:], CFG)
+        pred = draft_apply(
+            CFG, jax.tree.map(lambda a: a.astype(jnp.float32), dp),
+            h[:, :-1], emb,
+        )
+        return float(jnp.mean(jnp.square(pred - h[:, 1:])))
+
+    rand_dp = init_draft_params(CFG, jax.random.PRNGKey(3), jnp.float32)
+    assert feature_mse(dp) < 0.8 * feature_mse(rand_dp)
+
+
+def test_distill_returns_model_dtype():
+    cfg = get_model_config("llama3-tiny")  # bfloat16 default
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    dp = distill_draft_params(cfg, params, jax.random.PRNGKey(1), steps=3,
+                              batch=2, seq_len=16, num_batches=1)
+    assert all(a.dtype == jnp.bfloat16 for a in jax.tree.leaves(dp))
