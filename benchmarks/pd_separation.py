@@ -58,11 +58,37 @@ def _req(p, max_tokens):
     return make_request(p, max_tokens)
 
 
+_DECODE_T = 4  # decode scan length per round (amortizes host round-trips)
+
+
+def _warm(eng, prompt):
+    """Compile every graph the measured loops touch: the batched-wave
+    prefill (generate), the single-request [1, bucket] prefill (submit),
+    and the T-step decode scan — mid-measurement XLA compiles would
+    otherwise dominate the percentiles."""
+    eng.generate([_req(prompt, 2)])
+    slot = eng.submit(_req(prompt, 3))
+    while eng.slots[slot] is not None and \
+            eng.slots[slot].finish_reason is None:
+        eng.decode_multi(_DECODE_T)
+    eng.finish_slot(slot, cache=False)
+
+
+def _decode_round(eng, tpots):
+    d0 = time.perf_counter()
+    out = eng.decode_multi(_DECODE_T)
+    n = sum(len(v) for v in out.values())
+    if n:
+        per_tok = (time.perf_counter() - d0) * 1000.0 / _DECODE_T
+        tpots.extend([per_tok] * (n // max(len(out), 1) or 1))
+    return out
+
+
 def run_hybrid(model, prompts, args, params):
     """One engine, staggered arrivals: prefills interleave with decodes."""
     eng = _mk_engine(model, args.requests, args.max_seq, params,
                      (args.prompt_len,))
-    eng.generate([_req(prompts[0], 2)])  # warmup compile
+    _warm(eng, prompts[0])
 
     ttfts, tpots = [], []
     with Timer() as t:
@@ -71,20 +97,12 @@ def run_hybrid(model, prompts, args, params):
             t0 = time.perf_counter()
             eng.submit(_req(p, args.max_tokens))
             ttfts.append((time.perf_counter() - t0) * 1000.0)
-            # run a few decode steps for everyone between arrivals
+            # run a few decode rounds for everyone between arrivals
             for _ in range(args.decode_per_arrival):
-                d0 = time.perf_counter()
-                out = eng.decode_step()
-                if out:
-                    tpots.append(
-                        (time.perf_counter() - d0) * 1000.0
-                    )
+                _decode_round(eng, tpots)
         # drain
         while eng.num_active:
-            d0 = time.perf_counter()
-            out = eng.decode_step()
-            if out:
-                tpots.append((time.perf_counter() - d0) * 1000.0)
+            _decode_round(eng, tpots)
             for i, s in enumerate(list(eng.slots)):
                 if s is not None and s.finish_reason is not None:
                     eng.finish_slot(i)
@@ -103,10 +121,17 @@ def run_separated(model, prompts, args, params):
     pre = _mk_engine(model, 2, args.max_seq, params, (args.prompt_len,))
     dec = _mk_engine(model, args.requests, args.max_seq, pre.params,
                      (args.prompt_len,))
-    pre.generate([_req(prompts[0], 2)])   # warmup both engines
-    dec.generate([_req(prompts[0], 2)])
+    _warm(pre, prompts[0])
+    _warm(dec, prompts[0])
+    # warm the migration path (export gather + adopt upload graphs)
+    wslot = pre.submit(_req(prompts[0], 3))
+    wire = serialize_handoff(export_slot_kv(pre, wslot))
+    pre.finish_slot(wslot, cache=False)
+    aslot = adopt_kv(dec, deserialize_handoff(wire))
+    dec.finish_slot(aslot, cache=False)
 
     ttfts, tpots, migrate_ms = [], [], []
+    migrate_bytes = 0
     with Timer() as t:
         pending = list(prompts)
         active = 0
@@ -118,23 +143,21 @@ def run_separated(model, prompts, args, params):
                 ttfts.append((time.perf_counter() - t0) * 1000.0)
                 m0 = time.perf_counter()
                 wire = serialize_handoff(export_slot_kv(pre, slot))
+                migrate_bytes += len(wire)
                 pre.finish_slot(slot, cache=False)
                 adopt_kv(dec, deserialize_handoff(wire))
                 migrate_ms.append((time.perf_counter() - m0) * 1000.0)
                 active += 1
             # decode pool advances independently of prefill arrivals
             for _ in range(args.decode_per_arrival):
-                d0 = time.perf_counter()
-                out = dec.decode_step()
-                if out:
-                    tpots.append((time.perf_counter() - d0) * 1000.0)
+                _decode_round(dec, tpots)
             for i, s in enumerate(list(dec.slots)):
                 if s is not None and s.finish_reason is not None:
                     dec.finish_slot(i)
                     active -= 1
             if not pending and not dec.num_active:
                 break
-    return ttfts, tpots, migrate_ms, t.elapsed
+    return ttfts, tpots, migrate_ms, migrate_bytes, t.elapsed
 
 
 def main() -> None:
@@ -160,7 +183,7 @@ def main() -> None:
     prompts = synth_prompts(args.requests, args.prompt_len, cfg.vocab_size)
 
     hy_ttft, hy_tpot, hy_s = run_hybrid(model, prompts, args, params)
-    sep_ttft, sep_tpot, mig_ms, sep_s = run_separated(
+    sep_ttft, sep_tpot, mig_ms, mig_bytes, sep_s = run_separated(
         model, prompts, args, params
     )
 
@@ -186,8 +209,18 @@ def main() -> None:
             "ttft_ms": percentiles(sep_ttft),
             "tpot_ms": sep,
             "migration_ms": percentiles(mig_ms),
+            "migration_mb": round(mig_bytes / 1e6, 2),
+            "migration_mb_s": round(
+                (mig_bytes / 1e6) / (sum(mig_ms) / 1e3), 2
+            ) if mig_ms and sum(mig_ms) else None,
             "elapsed_s": round(sep_s, 3),
         },
+        # both pools share ONE chip here, so device work serializes and the
+        # TPOT comparison cannot show disaggregation's benefit — on a real
+        # deployment the pools run on disjoint slices (BASELINE.json
+        # config 5: v5e-64); what this measures for real is the migration
+        # path cost (export → wire → adopt)
+        "single_chip_note": "pools share one device; see migration_*",
     })
 
 

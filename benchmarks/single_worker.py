@@ -43,6 +43,10 @@ def main() -> None:
     ap.add_argument("--shared-prefix", type=int, default=64,
                     help="tokens of shared system prefix (prefix-cache hits)")
     ap.add_argument("--no-prefix-cache", action="store_true")
+    ap.add_argument("--target-step-ms", type=float, default=400.0,
+                    help="batcher round-latency target; must exceed the "
+                    "host↔device round-trip or the adaptive horizon "
+                    "collapses to 1 step (≈110 ms through a TPU tunnel)")
     add_platform_arg(ap)
     args = ap.parse_args()
 
@@ -76,12 +80,23 @@ def main() -> None:
     def req(p):
         return make_request(p, args.max_tokens)
 
-    # warmup compile (prefill bucket + decode graphs)
+    # warmup compile: prefill bucket + EVERY decode-horizon graph the
+    # batcher may request (each distinct scan length T is its own XLA
+    # compile — they must not land mid-measurement)
     eng.generate([req(prompts[0])])
+    for T in BatcherConfig().horizon_levels:
+        # 2 tokens suffice: on-device budgets finish the slot inside the
+        # T-step scan, and the T graph still compiles
+        slot = eng.submit(make_request(prompts[0], 2))
+        while eng.slots[slot] is not None and \
+                eng.slots[slot].finish_reason is None:
+            eng.decode_multi(T)
+        eng.finish_slot(slot)
 
     async def run():
         batcher = ContinuousBatcher(
-            eng, BatcherConfig(default_timeout_s=600.0)
+            eng, BatcherConfig(default_timeout_s=600.0,
+                               target_step_latency_ms=args.target_step_ms)
         )
         batcher.start()
         sem = asyncio.Semaphore(args.concurrency)

@@ -50,6 +50,21 @@ class BatcherConfig:
     target_step_latency_ms: float = 100.0  # per host round-trip
     queue_limit: int = 1024
     default_timeout_s: float = 300.0
+    # horizon when admission work is waiting: bounded so a queued request
+    # never waits more than this many decode steps for a slot, while still
+    # amortizing host round-trips (decode_step per token would pay one RTT
+    # per token on a tunneled TPU)
+    busy_multi_step: int = 4
+
+    @property
+    def horizon_levels(self) -> Tuple[int, ...]:
+        """The ONLY decode horizons the batcher may request. decode_multi
+        compiles one scan per distinct T — an unquantized adaptive horizon
+        triggers an XLA compile mid-serving for nearly every retune. Powers
+        of four between the min/max bound the graph count at 4."""
+        levels = [t for t in (1, 4, 16, 64)
+                  if self.min_multi_step <= t <= self.max_multi_step]
+        return tuple(levels) or (self.min_multi_step,)
 
 
 @dataclass(order=True)
@@ -72,7 +87,13 @@ class ContinuousBatcher:
         self._stopping = False
         self._run_task: Optional[asyncio.Task] = None
         self._exec = ThreadPoolExecutor(max_workers=1, thread_name_prefix="engine")
-        self._horizon = float(self.cfg.multi_step)
+        self._levels = self.cfg.horizon_levels
+        # start at the level closest to the configured multi_step
+        self._level = min(
+            range(len(self._levels)),
+            key=lambda i: abs(self._levels[i] - self.cfg.multi_step),
+        )
+        self._horizon = float(self._levels[self._level])
         self._slot_items: Dict[int, _QueueItem] = {}
         self.stats: Dict[str, Any] = {
             "submitted": 0, "completed": 0, "rejected": 0, "timeouts": 0,
@@ -207,25 +228,29 @@ class ContinuousBatcher:
     def _engine_round(self) -> float:
         """One blocking engine round on the worker thread. Returns latency ms."""
         t0 = time.perf_counter()
+        steps = self._levels[self._level]
         if self._heap:
-            # work is waiting: shallow step so admission latency stays low
-            self.engine.decode_step()
-        else:
-            self.engine.decode_multi(max(1, int(self._horizon)))
+            # work is waiting: bounded horizon so admission latency stays
+            # low without falling back to one-RTT-per-token stepping
+            steps = min(steps, self.cfg.busy_multi_step)
+            steps = max(t for t in self._levels if t <= steps)
+        self.engine.decode_multi(steps)
         return (time.perf_counter() - t0) * 1000.0
 
     def _retune(self, latency_ms: float) -> None:
-        """AdaptiveBatcher analogue (reference :413-431): ±20% against the
-        latency target, clamped."""
+        """AdaptiveBatcher analogue (reference :413-431): one quantized
+        horizon level up/down against the latency target — levels only, so
+        the set of compiled decode graphs stays bounded."""
         ema = self.stats["step_latency_ema_ms"]
         ema = latency_ms if ema == 0 else 0.8 * ema + 0.2 * latency_ms
         self.stats["step_latency_ema_ms"] = ema
         if not self.cfg.adaptive:
             return
         if ema > self.cfg.target_step_latency_ms * 1.1:
-            self._horizon = max(self.cfg.min_multi_step, self._horizon * 0.8)
+            self._level = max(0, self._level - 1)
         elif ema < self.cfg.target_step_latency_ms * 0.9:
-            self._horizon = min(self.cfg.max_multi_step, self._horizon * 1.2)
+            self._level = min(len(self._levels) - 1, self._level + 1)
+        self._horizon = float(self._levels[self._level])
         self.stats["horizon"] = self._horizon
 
     async def _run(self) -> None:
