@@ -77,10 +77,14 @@ def _warm(eng, prompt):
 def _decode_round(eng, tpots):
     d0 = time.perf_counter()
     out = eng.decode_multi(_DECODE_T)
-    n = sum(len(v) for v in out.values())
-    if n:
-        per_tok = (time.perf_counter() - d0) * 1000.0 / _DECODE_T
-        tpots.extend([per_tok] * (n // max(len(out), 1) or 1))
+    if out:
+        # normalize by the steps the round actually advanced (a slot can
+        # finish mid-scan) — dividing by the fixed T would understate
+        # per-token latency in tail rounds
+        steps_run = max(len(v) for v in out.values())
+        if steps_run:
+            per_tok = (time.perf_counter() - d0) * 1000.0 / steps_run
+            tpots.extend([per_tok] * steps_run)
     return out
 
 

@@ -82,22 +82,32 @@ def main() -> None:
 
     # warmup compile: prefill bucket + EVERY decode-horizon graph the
     # batcher may request (each distinct scan length T is its own XLA
-    # compile — they must not land mid-measurement)
-    eng.generate([req(prompts[0])])
-    for T in BatcherConfig().horizon_levels:
+    # compile — they must not land mid-measurement). Warm with a prompt
+    # OUTSIDE the measured set (and cache=False) so the warmup neither
+    # pre-warms the prefix cache for a measured prompt nor skews the
+    # reported hit rate.
+    bcfg = BatcherConfig(default_timeout_s=600.0,
+                         target_step_latency_ms=args.target_step_ms)
+    warm_prompt = synth_prompts(
+        1, args.prompt_len, eng.model_cfg.vocab_size, seed=987,
+        shared_prefix_len=0,
+    )[0]
+    eng.generate([make_request(warm_prompt, 2)])
+    for T in bcfg.horizon_levels:
         # 2 tokens suffice: on-device budgets finish the slot inside the
         # T-step scan, and the T graph still compiles
-        slot = eng.submit(make_request(prompts[0], 2))
+        slot = eng.submit(make_request(warm_prompt, 2))
         while eng.slots[slot] is not None and \
                 eng.slots[slot].finish_reason is None:
             eng.decode_multi(T)
-        eng.finish_slot(slot)
+        eng.finish_slot(slot, cache=False)
+    # counters accumulated by warmup must not enter the report
+    eng.manager.stats.prefix_queries = 0
+    eng.manager.stats.prefix_hit_tokens = 0
+    eng.manager.stats.prefix_total_tokens = 0
 
     async def run():
-        batcher = ContinuousBatcher(
-            eng, BatcherConfig(default_timeout_s=600.0,
-                               target_step_latency_ms=args.target_step_ms)
-        )
+        batcher = ContinuousBatcher(eng, bcfg)
         batcher.start()
         sem = asyncio.Semaphore(args.concurrency)
         results = []

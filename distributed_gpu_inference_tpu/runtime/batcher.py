@@ -87,7 +87,13 @@ class ContinuousBatcher:
         self._stopping = False
         self._run_task: Optional[asyncio.Task] = None
         self._exec = ThreadPoolExecutor(max_workers=1, thread_name_prefix="engine")
-        self._levels = self.cfg.horizon_levels
+        if self.cfg.adaptive:
+            self._levels = self.cfg.horizon_levels
+        else:
+            # a fixed horizon compiles exactly one graph — honor it verbatim
+            self._levels = (max(self.cfg.min_multi_step,
+                                min(self.cfg.multi_step,
+                                    self.cfg.max_multi_step)),)
         # start at the level closest to the configured multi_step
         self._level = min(
             range(len(self._levels)),
@@ -231,9 +237,12 @@ class ContinuousBatcher:
         steps = self._levels[self._level]
         if self._heap:
             # work is waiting: bounded horizon so admission latency stays
-            # low without falling back to one-RTT-per-token stepping
-            steps = min(steps, self.cfg.busy_multi_step)
-            steps = max(t for t in self._levels if t <= steps)
+            # low without falling back to one-RTT-per-token stepping; snap
+            # to the largest level ≤ the cap, or the smallest level when
+            # every level exceeds it (only compiled lengths may run)
+            cap = min(steps, self.cfg.busy_multi_step)
+            eligible = [t for t in self._levels if t <= cap]
+            steps = max(eligible) if eligible else min(self._levels)
         self.engine.decode_multi(steps)
         return (time.perf_counter() - t0) * 1000.0
 

@@ -179,3 +179,36 @@ def test_adaptive_horizon_moves():
     stats = _run(go())
     # steps are far cheaper than target → horizon must have grown
     assert stats["horizon"] > 4
+
+
+def test_busy_horizon_with_high_min_multi_step():
+    """min_multi_step above busy_multi_step must snap to the smallest
+    level, not crash (regression: empty max() in _engine_round)."""
+    from distributed_gpu_inference_tpu.runtime.batcher import BatcherConfig
+
+    cfg = BatcherConfig(min_multi_step=8)
+    assert cfg.horizon_levels == (16, 64)
+    # the snap logic itself: no level <= cap -> smallest level
+    cap = min(16, cfg.busy_multi_step)
+    eligible = [t for t in cfg.horizon_levels if t <= cap]
+    assert (max(eligible) if eligible else min(cfg.horizon_levels)) == 16
+
+
+def test_non_adaptive_honors_configured_multi_step():
+    from distributed_gpu_inference_tpu.runtime.batcher import (
+        BatcherConfig,
+        ContinuousBatcher,
+    )
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        EngineConfig,
+        TPUEngine,
+    )
+
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=1, max_seq_len=64, block_size=16,
+                     prefill_buckets=(16,), dtype="float32"),
+    )
+    b = ContinuousBatcher(eng, BatcherConfig(adaptive=False, multi_step=8))
+    assert b._levels == (8,)
+    assert b._horizon == 8.0
