@@ -250,10 +250,25 @@ def _deq(w: Any, dtype) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def _use_fused_decode(
+    cfg: ModelConfig, s: int, block_tables: jax.Array, block_size: int
+) -> bool:
+    """Trace-time choice of the fused Pallas write+attention decode path
+    (same dispatch facts as ops.attention.resolve_impl)."""
+    from distributed_gpu_inference_tpu.ops.attention import resolve_impl
+
+    return s == 1 and resolve_impl(
+        q_seq=s,
+        head_dim=cfg.head_dim,
+        padded_ctx=block_tables.shape[1] * block_size,
+    ) == "pallas"
+
+
 class ChunkOutput(NamedTuple):
     hidden: jax.Array       # [B, S, H] final-layer hidden states (pre-norm)
     kv: KVPools             # updated pools
-    logits: jax.Array       # [B, S, V] (or [B, 1, V] if last_only)
+    logits: Optional[jax.Array]  # [B, S, V] ([B, 1, V] if last_only; None if
+                                 # with_logits=False — intermediate chunks)
 
 
 def _layer_step(
@@ -267,10 +282,19 @@ def _layer_step(
     cos: jax.Array,
     sin: jax.Array,
     attn_fn,                      # (q, layer_k, layer_v) -> attention output
+    fused_decode: bool = False,   # S=1 TPU path: one kernel writes + attends
+    kv_lens: Optional[jax.Array] = None,  # required when fused_decode
 ) -> Tuple[Tuple[jax.Array, jax.Array, jax.Array, jax.Array], None]:
     """One transformer layer over paged KV — shared by the causal decode path
     and the speculative tree-verify path (they differ only in the attention
-    mask and in where KV rows are written)."""
+    mask and in where KV rows are written).
+
+    ``fused_decode`` routes the whole KV path through the Pallas fused
+    write+attention kernel on the STACKED pools (ops/paged_attention_pallas).
+    The alternative — XLA scatter into a dynamically-indexed layer slice —
+    forced two pool-sized HBM copies per decode step at serving pool sizes
+    (scatter-preferred vs kernel-required layout, plus custom-call operand
+    materialization; round-2 profiling)."""
     hidden, k_pool, v_pool, layer_idx = carry
     b, s, _ = hidden.shape
     nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -289,14 +313,25 @@ def _layer_step(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    layer_k = lax.dynamic_index_in_dim(k_pool, layer_idx, 0, keepdims=False)
-    layer_v = lax.dynamic_index_in_dim(v_pool, layer_idx, 0, keepdims=False)
-    layer_k = _write_kv_pages(layer_k, k, block_tables, write_positions, block_size)
-    layer_v = _write_kv_pages(layer_v, v, block_tables, write_positions, block_size)
-    k_pool = lax.dynamic_update_index_in_dim(k_pool, layer_k, layer_idx, 0)
-    v_pool = lax.dynamic_update_index_in_dim(v_pool, layer_v, layer_idx, 0)
+    if fused_decode:
+        from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+            paged_decode_attention_fused,
+        )
 
-    attn = attn_fn(q, layer_k, layer_v)
+        attn, k_pool, v_pool = paged_decode_attention_fused(
+            q, k, v, k_pool, v_pool, layer_idx, block_tables,
+            write_positions, kv_lens, block_size,
+            window=cfg.sliding_window,
+        )
+    else:
+        layer_k = lax.dynamic_index_in_dim(k_pool, layer_idx, 0, keepdims=False)
+        layer_v = lax.dynamic_index_in_dim(v_pool, layer_idx, 0, keepdims=False)
+        layer_k = _write_kv_pages(layer_k, k, block_tables, write_positions, block_size)
+        layer_v = _write_kv_pages(layer_v, v, block_tables, write_positions, block_size)
+        k_pool = lax.dynamic_update_index_in_dim(k_pool, layer_k, layer_idx, 0)
+        v_pool = lax.dynamic_update_index_in_dim(v_pool, layer_v, layer_idx, 0)
+        attn = attn_fn(q, layer_k, layer_v)
+
     hidden = hidden + qmm(attn.reshape(b, s, nh * d), lp["wo"]).astype(hidden.dtype)
     mlp_in = rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     if "w_router" in lp:
@@ -317,11 +352,17 @@ def forward_chunk(
     *,
     block_size: int = 16,
     last_only: bool = True,
+    with_logits: bool = True,
 ) -> ChunkOutput:
     """Run S tokens per sequence through all layers against the paged cache.
 
     Covers prefill (S = prompt chunk, positions start at the cached prefix
     length) and decode (S = 1) with one traced graph per (B, S).
+
+    ``with_logits=False`` skips the LM-head projection entirely — an
+    intermediate chunk of a long prefill only needs its KV side effects, and
+    the head matmul reads the full [V, H] embedding from HBM (0.77 GB on
+    Llama-3 vocab) for logits nobody consumes.
     """
     b, s = token_ids.shape
     hidden = embed_tokens(params, token_ids, cfg)
@@ -344,6 +385,8 @@ def forward_chunk(
         cos=cos,
         sin=sin,
         attn_fn=attn_fn,
+        fused_decode=_use_fused_decode(cfg, s, block_tables, block_size),
+        kv_lens=kv_lens,
     )
     (hidden, k_pool, v_pool, _), _ = lax.scan(
         lambda c, lp: step(c, lp),
@@ -351,6 +394,10 @@ def forward_chunk(
         params["layers"],
     )
 
+    if not with_logits:
+        return ChunkOutput(
+            hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=None
+        )
     if last_only:
         # last valid token per sequence = kv_lens - 1 mapped into the chunk:
         # chunk covers positions [kv_len - n_valid, kv_len); the last valid
@@ -464,6 +511,10 @@ def forward_hidden_chunk(
         cos=cos,
         sin=sin,
         attn_fn=attn_fn,
+        fused_decode=_use_fused_decode(
+            cfg, hidden.shape[1], block_tables, block_size
+        ),
+        kv_lens=kv_lens,
     )
     (hidden, k_pool, v_pool, _), _ = lax.scan(
         lambda c, lp: step(c, lp),

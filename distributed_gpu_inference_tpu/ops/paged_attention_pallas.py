@@ -1,175 +1,388 @@
-"""Pallas TPU paged-attention decode kernel.
+"""Pallas TPU paged-attention decode kernel (fused KV-write + attention).
 
 First-party replacement for vLLM's PagedAttention CUDA kernel (SURVEY §2.3).
-Decode (S = 1) is HBM-bandwidth-bound: the XLA fallback in ``ops/attention.py``
-materializes a gathered ``[B, J, Hkv, D]`` context (one full extra HBM
-round-trip over the whole padded table width M), while this kernel
+Decode (S = 1) is HBM-bandwidth-bound; this kernel owns the WHOLE per-layer
+decode KV path:
 
-- walks only the **live** pages of each sequence (``fori_loop`` bound is the
-  traced ``ceil(kv_len / group)``, not the static table width),
-- DMAs each KV page HBM→VMEM exactly once (whole ``[Hkv, Bk, D]`` pages —
-  a full-suffix slice stays contiguous, so no TPU-tiling constraint is hit)
-  and runs flash-style online softmax accumulation per page group,
-- skips page groups entirely behind a sliding window (Mistral), starting
-  the walk at the window's first live group,
+- **Fused token write**: the new K/V rows for the step are DMA'd into their
+  page slots inside the kernel (pools are input/output-aliased), replacing
+  the XLA scatter. Round-2 profiling showed the scatter forced a
+  scatter-preferred pool layout inside the decode loop while the kernel
+  required the natural layout — XLA reconciled them by COPYING both pools
+  every step (~10-20 ms/step at serving pool sizes, scaling with pool size).
+- **Full-pool operands + layer index**: the kernel takes the stacked
+  ``[L, N, Hkv, Bk, D]`` pools and a scalar ``layer_idx`` instead of a
+  per-layer slice — a custom-call operand must be materialized, so the old
+  single-layer API made XLA copy the layer slice (pool_bytes/L per layer per
+  pool per step) just to pass it in.
+- Walks only the **live** page groups of each sequence — the grid is
+  ``(B, max_groups)`` and dead cells skip in a few cycles,
+- DMAs each KV page HBM→VMEM exactly once (whole ``[Hkv, Bk, D]`` pages stay
+  contiguous) and runs flash-style online softmax per page group,
+- **Pipelines DMA across the whole (sequence, group) walk** — while group g
+  of sequence b computes, the next live group's pages (even of sequence
+  b+1) are in flight into the other buffer slot (mutable scalar
+  ``buffer_index``/``init_flag``, the standard TPU pattern, cf.
+  jax.experimental.pallas.ops.tpu.paged_attention). Round-1's kernel
+  double-buffered only within one sequence, so short contexts ran DMA and
+  compute serialized and lost to the XLA gather path (ADVICE r1 #3),
+- sizes page groups by a VMEM byte budget instead of a fixed token count
+  (ADVICE r1 #2: Gemma-7B-geometry pages are 16x llama pages),
 - computes every (kv-head, GQA-query-group) in one batched MXU contraction
-  per group.
+  per group, in the pool dtype (bf16 in, f32 accumulation) — converting
+  staged pages to f32 was a VPU-bound relayout that dominated large-batch
+  steps.
 
-Correctness contract is identical to ``paged_attention_xla`` (same masking
-semantics, including window and padded-query handling); the parametrized
-parity tests drive both through the same cases (CPU: interpret mode).
+Write/read ordering: all token writes are issued AND waited in the first
+grid cell, before any read DMA is issued (read prefetches only start in live
+cells, which come later in the sequential grid), so a step's written token is
+visible to its own attention (its position is within ``kv_lens``).
+
+Correctness contract is identical to ``paged_attention_xla`` over the
+written pool (same masking semantics, including window and padded-query
+handling); parametrized parity tests drive both through the same cases
+(CPU: interpret mode).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+# VMEM budget for the four KV staging buffers (2 pools x 2 slots); the rest
+# of VMEM stays free for q/out blocks and compute temporaries.
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
 
 
-def _pages_per_group(block_size: int) -> int:
-    """Pages DMA'd per loop iteration — targets 512-token groups: the
-    fori_loop has a fixed per-iteration cost (semaphore waits, scalar loop
-    bookkeeping) of ~2us on v5e, so groups must be large enough to amortize
-    it against the ~0.6us/128-token HBM transfer."""
-    return max(1, 512 // block_size)
+def _pages_per_group(
+    block_size: int, hkv: int, head_dim: int, itemsize: int, max_pages: int,
+    staging_pages: int = 0,
+) -> int:
+    """Pages DMA'd per loop iteration.
+
+    Target ~512-token groups (the grid step has a fixed cost of ~2us on
+    v5e, amortized against ~0.6us/128-token HBM transfer), but scale DOWN so
+    2 slots x G pages x 2 pools — plus ``staging_pages`` write-staging pages
+    — fits the VMEM budget regardless of page geometry, and never exceed the
+    static table width."""
+    page_bytes = hkv * block_size * head_dim * itemsize
+    budget = _VMEM_BUDGET_BYTES - staging_pages * page_bytes
+    g = max(1, budget // (4 * page_bytes))
+    g = min(g, max(512 // block_size, 1), max_pages)
+    return max(g, 1)
 
 
 def _decode_kernel(
-    # scalar prefetch
+    # scalar prefetch (SMEM; bidx/init are MUTABLE and persist across the
+    # sequential grid — they carry the DMA pipeline state)
     bt_ref,        # [B, M] int32 block tables
-    lens_ref,      # [B] int32 kv lengths
+    lens_ref,      # [B] int32 kv lengths (incl. the token written this step)
     pos_ref,       # [B] int32 query positions (kv_len - 1; <0 = inactive)
+    wpos_ref,      # [B] int32 write positions (<0 = no write for this row)
+    layer_ref,     # [1] int32 layer index into the stacked pools
+    bidx_ref,      # [1] int32 current double-buffer slot
+    init_ref,      # [1] int32 1 until the first live chunk issues its DMA
     # blocked operands
     q_ref,         # [1, 1, Nh, D] — this sequence's query heads
-    k_hbm,         # [N, Hkv, Bk, D] full pool (ANY/HBM)
-    v_hbm,         # [N, Hkv, Bk, D]
+    newk_ref,      # [B, Hkv, D] new K rows (VMEM; whole-batch block)
+    newv_ref,      # [B, Hkv, D]
+    k_hbm,         # [L, N, Hkv, Bk, D] full stacked pool (ANY/HBM, aliased)
+    v_hbm,         # [L, N, Hkv, Bk, D]
     out_ref,       # [1, 1, Nh, D]
+    ko_hbm,        # aliased outputs of k_hbm / v_hbm (same buffers)
+    vo_hbm,
     # scratch
     kbuf,          # VMEM [2, G, Hkv, Bk, D] (double-buffered)
     vbuf,          # VMEM [2, G, Hkv, Bk, D]
     sems,          # DMA semaphores [2, 2, G]
+    wsems,         # write semaphores [2, Bmax]
+    wk_stage,      # VMEM [B, Hkv, Bk, D] write staging (1 page per row)
+    wv_stage,      # VMEM [B, Hkv, Bk, D]
+    m_scr,         # VMEM [Hkv, qpk] f32 running max
+    l_scr,         # VMEM [Hkv, qpk] f32 running denominator
+    acc_scr,       # VMEM [Hkv, qpk, D] f32 running numerator
     *,
+    batch: int,
     block_size: int,
+    pages_per_group: int,
     max_pages: int,
     window: Optional[int],
     scale: float,
+    fused_write: bool,
 ):
-    ib = pl.program_id(0)
-    kv_len = lens_ref[ib]
-    pos = pos_ref[ib]
-    gp = _pages_per_group(block_size)
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    gp = pages_per_group
     gsz = gp * block_size
     nh, d = q_ref.shape[2], q_ref.shape[3]
-    hkv = k_hbm.shape[1]
+    hkv = k_hbm.shape[2]
     qpk = nh // hkv
+    layer = layer_ref[0]
+    max_groups = pl.num_programs(1)
 
-    # [Hkv, qpk, D] — GQA head h = g*qpk + j belongs to kv head g
-    qf = q_ref[0, 0].astype(jnp.float32).reshape(hkv, qpk, d) * scale
+    def num_groups(s):
+        s = jnp.clip(s, 0, batch - 1)
+        # clamp to the grid bound: a kv_len beyond the table capacity (caller
+        # bug) must not leave a prefetched DMA un-waited at kernel exit —
+        # that wedges the chip with a hung semaphore instead of just
+        # returning garbage for the out-of-range tail
+        return jnp.minimum(pl.cdiv(lens_ref[s], gsz), max_groups)
 
-    num_groups = pl.cdiv(kv_len, gsz)                     # traced bound
-    if window is not None:
+    def start_group(s):
+        if window is None:
+            return jnp.int32(0)
+        s = jnp.clip(s, 0, batch - 1)
         # first visible key = max(0, pos - window + 1) → its group
-        start = jnp.maximum(pos - window + 1, 0) // gsz
-    else:
-        start = jnp.int32(0)
+        return jnp.maximum(pos_ref[s] - window + 1, 0) // gsz
 
-    def _group_copies(j, slot):
-        """The (deterministic) DMA descriptors of group j into buffer slot."""
-        out = []
+    ng_b = num_groups(b)
+    start_b = start_group(b)
+    live = (i >= start_b) & (i < ng_b)
+
+    if fused_write:
+        # ---- token writes: ALL rows handled in the FIRST grid cell,
+        # strictly before any read DMA is issued (reads start in live cells,
+        # which are at or after (0,0) in the sequential grid). The HBM pool
+        # is (8,128)-tiled on its last two dims, so a single token slot is
+        # not DMA-addressable — each row's page is staged whole into VMEM,
+        # the slot row is blended in with a vectorized select (no dynamic
+        # sublane store), and the page is written back whole. All four DMA
+        # phases are issued batch-wide before being waited, so latency is
+        # paid ~twice, not 4B times. Distinct rows never share a page (each
+        # sequence owns its block chain and CoW gives writers exclusive
+        # pages), so whole-page write-back cannot clobber a sibling write.
+        n_stage = wk_stage.shape[0]
+
+        def row_page(r):
+            wpos = wpos_ref[r]
+            safe = jnp.maximum(wpos, 0)
+            page = bt_ref[r, jnp.minimum(safe // block_size, max_pages - 1)]
+            return wpos >= 0, page, safe % block_size
+
+        def stage_copies(r, dst_first):
+            valid, page, _ = row_page(r)
+            st = r % n_stage
+            ck = pltpu.make_async_copy(
+                ko_hbm.at[layer, page], wk_stage.at[st], wsems.at[0, st]
+            ) if dst_first else pltpu.make_async_copy(
+                wk_stage.at[st], ko_hbm.at[layer, page], wsems.at[0, st]
+            )
+            cv = pltpu.make_async_copy(
+                vo_hbm.at[layer, page], wv_stage.at[st], wsems.at[1, st]
+            ) if dst_first else pltpu.make_async_copy(
+                wv_stage.at[st], vo_hbm.at[layer, page], wsems.at[1, st]
+            )
+            return valid, ck, cv
+
+        @pl.when((b == 0) & (i == 0))
+        def _():
+            # rows are processed in chunks of n_stage staging pages so the
+            # scratch footprint stays within the VMEM budget at any
+            # batch x page geometry; within a chunk the four DMA phases are
+            # issued batch-wide before being waited
+            for c0 in range(0, batch, n_stage):
+                rows = range(c0, min(c0 + n_stage, batch))
+                for r in rows:  # static unroll over rows
+                    valid, ck, cv = stage_copies(r, dst_first=True)
+
+                    @pl.when(valid)
+                    def _():
+                        ck.start()
+                        cv.start()
+
+                for r in rows:
+                    valid, ck, cv = stage_copies(r, dst_first=True)
+
+                    @pl.when(valid)
+                    def _():
+                        ck.wait()
+                        cv.wait()
+
+                for r in rows:
+                    valid, _page, slot = row_page(r)
+                    st = r % n_stage
+
+                    @pl.when(valid)
+                    def _():
+                        sel = (
+                            lax.broadcasted_iota(
+                                jnp.int32, (hkv, block_size, d), 1
+                            )
+                            == slot
+                        )
+                        wk_stage[st] = jnp.where(
+                            sel, newk_ref[r][:, None, :], wk_stage[st]
+                        )
+                        wv_stage[st] = jnp.where(
+                            sel, newv_ref[r][:, None, :], wv_stage[st]
+                        )
+
+                for r in rows:
+                    valid, ck, cv = stage_copies(r, dst_first=False)
+
+                    @pl.when(valid)
+                    def _():
+                        ck.start()
+                        cv.start()
+
+                for r in rows:
+                    valid, ck, cv = stage_copies(r, dst_first=False)
+
+                    @pl.when(valid)
+                    def _():
+                        ck.wait()
+                        cv.wait()
+
+    def start_dma(s, j, slot):
+        """Issue the page DMAs of group j of sequence s into buffer slot.
+        Reads go through the ALIASED output refs so they observe the token
+        writes above."""
         for p in range(gp):  # static unroll: G paired page DMAs
             idx = jnp.minimum(j * gp + p, max_pages - 1)  # clamp, mask later
-            page = bt_ref[ib, idx]
+            page = bt_ref[jnp.clip(s, 0, batch - 1), idx]
             # whole-page slice [Hkv, Bk, D]: contiguous, tiling-safe
-            out.append((
-                pltpu.make_async_copy(
-                    k_hbm.at[page], kbuf.at[slot, p], sems.at[0, slot, p]
-                ),
-                pltpu.make_async_copy(
-                    v_hbm.at[page], vbuf.at[slot, p], sems.at[1, slot, p]
-                ),
-            ))
-        return out
+            pltpu.make_async_copy(
+                ko_hbm.at[layer, page], kbuf.at[slot, p], sems.at[0, slot, p]
+            ).start()
+            pltpu.make_async_copy(
+                vo_hbm.at[layer, page], vbuf.at[slot, p], sems.at[1, slot, p]
+            ).start()
 
-    def _start(j, slot):
-        for dk, dv in _group_copies(j, slot):
-            dk.start()
-            dv.start()
+    def wait_dma(s, j, slot):
+        for p in range(gp):
+            idx = jnp.minimum(j * gp + p, max_pages - 1)
+            page = bt_ref[jnp.clip(s, 0, batch - 1), idx]
+            pltpu.make_async_copy(
+                ko_hbm.at[layer, page], kbuf.at[slot, p], sems.at[0, slot, p]
+            ).wait()
+            pltpu.make_async_copy(
+                vo_hbm.at[layer, page], vbuf.at[slot, p], sems.at[1, slot, p]
+            ).wait()
 
-    # prologue: prefetch the first group
-    @pl.when(start < num_groups)
+    def next_chunk(s, j):
+        """Grid-order successor of live chunk (s, j): (s, j+1) within the
+        sequence, else the first live group of the next non-empty sequence;
+        (batch, 0) when the walk is done."""
+
+        def advance_seq():
+            def step(_, ss):
+                return jnp.where(
+                    (ss < batch) & (num_groups(ss) == 0), ss + 1, ss
+                )
+
+            ns = lax.fori_loop(0, batch, step, s + 1)
+            return ns, jnp.where(ns < batch, start_group(ns), 0)
+
+        return lax.cond(
+            j + 1 < num_groups(s), lambda: (s, j + 1), advance_seq
+        )
+
+    # inactive sequence: its output block must still be written once
+    @pl.when((ng_b == 0) & (i == 0))
     def _():
-        _start(start, jax.lax.rem(start, 2))
+        out_ref[0, 0] = jnp.zeros((nh, d), out_ref.dtype)
 
-    def group_step(j, carry):
-        m_prev, l_prev, acc = carry
-        slot = jax.lax.rem(j, 2)
-        # overlap: launch group j+1 into the other buffer before waiting
-        @pl.when(j + 1 < num_groups)
+    @pl.when(live)
+    def _():
+        slot = bidx_ref[0]
+
+        # very first live chunk of the whole walk: nothing prefetched it
+        @pl.when(init_ref[0] == 1)
         def _():
-            _start(j + 1, jax.lax.rem(j + 1, 2))
-        for dk, dv in _group_copies(j, slot):
-            dk.wait()
-            dv.wait()
+            start_dma(b, i, slot)
+
+        init_ref[0] = 0
+
+        # pipeline: issue the NEXT live chunk (possibly of the next
+        # sequence) into the other slot before waiting on this one
+        nb, ni = next_chunk(b, i)
+
+        @pl.when(nb < batch)
+        def _():
+            start_dma(nb, ni, 1 - slot)
+
+        bidx_ref[0] = 1 - slot
+
+        wait_dma(b, i, slot)
+
+        @pl.when(i == start_b)
+        def _():
+            m_scr[...] = jnp.full((hkv, qpk), _NEG_INF, jnp.float32)
+            l_scr[...] = jnp.zeros((hkv, qpk), jnp.float32)
+            acc_scr[...] = jnp.zeros((hkv, qpk, d), jnp.float32)
+
+        kv_len = lens_ref[b]
+        pos = pos_ref[b]
+        # [Hkv, qpk, D] — GQA head h = g*qpk + j belongs to kv head g.
+        # The dot runs in the pool dtype (the MXU consumes bf16 natively
+        # with f32 accumulation; converting the staged K/V pages to f32 in
+        # VMEM is a VPU-bound relayout of megabytes per grid cell that
+        # dominated the kernel at large batch); the softmax scale is applied
+        # to the f32 scores so q itself carries no extra rounding.
+        qf = q_ref[0, 0].reshape(hkv, qpk, d).astype(kbuf.dtype)
 
         # [G, Hkv, Bk, D] → [Hkv, G*Bk, D] (leading-dim relabel, no relayout)
-        k = kbuf[slot].astype(jnp.float32).transpose(1, 0, 2, 3).reshape(hkv, gsz, d)
-        v = vbuf[slot].astype(jnp.float32).transpose(1, 0, 2, 3).reshape(hkv, gsz, d)
-        scores = jax.lax.dot_general(
+        k = kbuf[slot].transpose(1, 0, 2, 3).reshape(hkv, gsz, d)
+        v = vbuf[slot].transpose(1, 0, 2, 3).reshape(hkv, gsz, d)
+        scores = lax.dot_general(
             qf, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
-        )                                                 # [Hkv, qpk, gsz]
-        col = j * gsz + jax.lax.broadcasted_iota(
-            jnp.int32, (hkv, qpk, gsz), 2
-        )
+        ) * scale                                         # [Hkv, qpk, gsz]
+        col = i * gsz + lax.broadcasted_iota(jnp.int32, (hkv, qpk, gsz), 2)
         valid = (col < kv_len) & (col <= pos)
         if window is not None:
             valid &= col > pos - window
         scores = jnp.where(valid, scores, _NEG_INF)
 
+        m_prev, l_prev = m_scr[...], l_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1))   # [Hkv, qpk]
         alpha = jnp.exp(m_prev - m_new)
         probs = jnp.exp(scores - m_new[..., None])
         probs = jnp.where(valid, probs, 0.0)
         l_new = l_prev * alpha + jnp.sum(probs, axis=-1)
-        acc_new = acc * alpha[..., None] + jax.lax.dot_general(
-            probs, v, (((2,), (1,)), ((0,), (0,))),
+        # P·V in the pool dtype (f32 accumulation): bf16 probs is the
+        # standard flash-attention trade — error is bounded by the softmax
+        # normalization and the parity tests hold at bf16 tolerance
+        acc_new = acc_scr[...] * alpha[..., None] + lax.dot_general(
+            probs.astype(vbuf.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )                                                 # [Hkv, qpk, D]
-        return m_new, l_new, acc_new
+        m_scr[...], l_scr[...], acc_scr[...] = m_new, l_new, acc_new
 
-    m0 = jnp.full((hkv, qpk), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((hkv, qpk), jnp.float32)
-    a0 = jnp.zeros((hkv, qpk, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(start, num_groups, group_step, (m0, l0, a0))
+        # last live group of this sequence: normalize and emit
+        @pl.when(i == ng_b - 1)
+        def _():
+            safe_l = jnp.where(l_new > 0, l_new, 1.0)[..., None]
+            # minor-dim insertion on i1 vectors is unsupported by Mosaic —
+            # expand the f32 operand and compare after
+            out = jnp.where(safe_l > 0, acc_new / safe_l, 0.0)
+            out = jnp.where(l_new[..., None] > 0, out, 0.0)
+            out_ref[0, 0] = out.reshape(nh, d).astype(out_ref.dtype)
 
-    # inactive slot (kv_len 0) or fully-masked rows → exact zeros
-    safe_l = jnp.where(l > 0, l, 1.0)
-    out = jnp.where((l > 0)[..., None], acc / safe_l[..., None], 0.0)
-    out_ref[0, 0] = out.reshape(nh, d).astype(out_ref.dtype)
 
-
-@functools.partial(
-    jax.jit,
-    static_argnames=("block_size", "window", "interpret"),
-)
-def paged_attention_pallas(
+def _call_decode_kernel(
     q: jax.Array,             # [B, 1, Nh, D]
-    k_pool: jax.Array,        # [N, Hkv, Bk, D] (head-major pages)
+    new_k: jax.Array,         # [B, Hkv, D]
+    new_v: jax.Array,
+    k_pool: jax.Array,        # [L, N, Hkv, Bk, D] stacked pools
     v_pool: jax.Array,
+    layer_idx: jax.Array,     # scalar int32
     block_tables: jax.Array,  # [B, M] int32
-    positions: jax.Array,     # [B, 1] int32 (-1 = inactive)
+    positions: jax.Array,     # [B] int32 query positions (-1 = inactive)
+    write_positions: jax.Array,  # [B] int32 (-1 = no write)
     kv_lens: jax.Array,       # [B] int32
-    block_size: int = 16,
-    window: Optional[int] = None,
-    interpret: bool = False,
-) -> jax.Array:
+    block_size: int,
+    window: Optional[int],
+    fused_write: bool,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     b, s, nh, d = q.shape
     if s != 1:
         raise ValueError("pallas paged attention is the decode (S=1) kernel")
@@ -178,57 +391,150 @@ def paged_attention_pallas(
         # head_dim is not expressible without relayout — dispatch keeps such
         # models on the XLA path (ops/attention.py impl="auto")
         raise ValueError(f"pallas decode kernel needs head_dim % 128 == 0, got {d}")
-    n, hkv, bk, _ = k_pool.shape
+    L, n, hkv, bk, _ = k_pool.shape
     if bk != block_size:
         raise ValueError(f"pool block dim {bk} != block_size {block_size}")
     m = block_tables.shape[1]
+    # write staging: up to `b` pages per pool, capped so 2 pools of staging
+    # never take more than half the VMEM budget (rows are chunked through
+    # the staging pages when b exceeds the cap)
+    page_bytes = hkv * block_size * d * k_pool.dtype.itemsize
+    if fused_write:
+        n_stage = max(1, min(b, _VMEM_BUDGET_BYTES // 2 // (2 * page_bytes)))
+    else:
+        n_stage = 1
+    gp = _pages_per_group(
+        block_size, hkv, d, k_pool.dtype.itemsize, m,
+        staging_pages=2 * n_stage,
+    )
+    max_groups = -(-m // gp)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(b,),
+        num_scalar_prefetch=7,
+        grid=(b, max_groups),
         in_specs=[
             pl.BlockSpec(
                 (1, 1, nh, d),
-                lambda i, *_refs: (i, 0, 0, 0),
+                lambda i, j, *_refs: (i, 0, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # new_k (whole array)
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # new_v
             # pools must STAY in HBM (ANY lets the compiler pull the whole
             # pool into VMEM, where the padded lane dim breaks page slices)
             pl.BlockSpec(memory_space=pltpu.HBM),
             pl.BlockSpec(memory_space=pltpu.HBM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, nh, d),
-            lambda i, *_refs: (i, 0, 0, 0),
-            memory_space=pltpu.VMEM,
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, nh, d),
+                lambda i, j, *_refs: (i, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+            pl.BlockSpec(memory_space=pltpu.HBM),
+        ],
         scratch_shapes=[
-            pltpu.VMEM(
-                (2, _pages_per_group(block_size), hkv, block_size, d),
-                k_pool.dtype,
-            ),
-            pltpu.VMEM(
-                (2, _pages_per_group(block_size), hkv, block_size, d),
-                v_pool.dtype,
-            ),
-            pltpu.SemaphoreType.DMA((2, 2, _pages_per_group(block_size))),
+            pltpu.VMEM((2, gp, hkv, block_size, d), k_pool.dtype),
+            pltpu.VMEM((2, gp, hkv, block_size, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, gp)),
+            pltpu.SemaphoreType.DMA((2, b)),
+            pltpu.VMEM((n_stage, hkv, block_size, d), k_pool.dtype),
+            pltpu.VMEM((n_stage, hkv, block_size, d), v_pool.dtype),
+            pltpu.VMEM((hkv, nh // hkv), jnp.float32),
+            pltpu.VMEM((hkv, nh // hkv), jnp.float32),
+            pltpu.VMEM((hkv, nh // hkv, d), jnp.float32),
         ],
     )
     kernel = functools.partial(
         _decode_kernel,
+        batch=b,
         block_size=block_size,
+        pages_per_group=gp,
         max_pages=m,
         window=window,
         scale=d**-0.5,
+        fused_write=fused_write,
     )
-    return pl.pallas_call(
+    out, k_pool, v_pool = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, 1, nh, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1, nh, d), q.dtype),
+            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+        ],
         grid_spec=grid_spec,
+        # operand order: 7 scalar-prefetch args, then q, new_k, new_v,
+        # k_pool (idx 10), v_pool (idx 11) → aliased to outputs 1, 2
+        input_output_aliases={10: 1, 11: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
         interpret=interpret,
     )(
         block_tables.astype(jnp.int32),
         kv_lens.astype(jnp.int32),
-        positions[:, 0].astype(jnp.int32),
-        q, k_pool, v_pool,
+        positions.astype(jnp.int32),
+        write_positions.astype(jnp.int32),
+        jnp.asarray(layer_idx, jnp.int32).reshape(1),
+        jnp.zeros((1,), jnp.int32),   # buffer_index
+        jnp.ones((1,), jnp.int32),    # init_flag
+        q, new_k, new_v, k_pool, v_pool,
     )
+    return out, k_pool, v_pool
+
+
+def paged_decode_attention_fused(
+    q: jax.Array,             # [B, 1, Nh, D]
+    new_k: jax.Array,         # [B, 1, Hkv, D] this step's K rows
+    new_v: jax.Array,
+    k_pool: jax.Array,        # [L, N, Hkv, Bk, D] stacked pools
+    v_pool: jax.Array,
+    layer_idx: jax.Array,     # scalar int32
+    block_tables: jax.Array,  # [B, M] int32
+    positions: jax.Array,     # [B, 1] int32 (-1 = inactive); ALSO the write
+                              # position of the new row
+    kv_lens: jax.Array,       # [B] int32, INCLUDING the written token
+    block_size: int = 16,
+    window: Optional[int] = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The per-layer decode step: write this step's K/V rows into their page
+    slots AND attend over the updated paged context, in one kernel with the
+    pools aliased in place. → (attn [B, 1, Nh, D], k_pool, v_pool)."""
+    pos = positions[:, 0]
+    return _call_decode_kernel(
+        q, new_k[:, 0], new_v[:, 0], k_pool, v_pool, layer_idx,
+        block_tables, pos, pos, kv_lens, block_size, window,
+        fused_write=True, interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "window", "interpret"),
+)
+def paged_attention_pallas(
+    q: jax.Array,             # [B, 1, Nh, D]
+    k_pool: jax.Array,        # [N, Hkv, Bk, D] (head-major pages, 1 layer)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, M] int32
+    positions: jax.Array,     # [B, 1] int32 (-1 = inactive)
+    kv_lens: jax.Array,       # [B] int32
+    block_size: int = 16,
+    window: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Read-only single-layer variant (micro-benchmarks, parity tests, and
+    callers that manage KV writes themselves)."""
+    b, _, nh, d = q.shape
+    hkv = k_pool.shape[1]
+    zeros = jnp.zeros((b, hkv, d), k_pool.dtype)
+    out, _, _ = _call_decode_kernel(
+        q, zeros, zeros, k_pool[None], v_pool[None], jnp.int32(0),
+        block_tables, positions[:, 0],
+        jnp.full((b,), -1, jnp.int32),   # no writes
+        kv_lens, block_size, window,
+        fused_write=False, interpret=interpret,
+    )
+    return out

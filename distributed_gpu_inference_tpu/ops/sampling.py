@@ -20,7 +20,13 @@ def _nucleus_logits(
     top_k: jax.Array,         # [B] int32; <= 0 → disabled
     top_p: jax.Array,         # [B] float32; >= 1 → disabled
 ):
-    """Shared top-k/top-p masking → (greedy_tok, nucleus_logits)."""
+    """Shared top-k/top-p masking → (greedy_tok, nucleus_logits).
+
+    ONE descending sort serves both filters: masking entries below the k-th
+    largest value to -inf preserves the sorted order, so the top-p pass can
+    reuse the same sorted array with an index mask instead of re-sorting the
+    masked copy (a second [B, V] sort costs ~1.5 ms/step at Llama-3 vocab on
+    v5e — measured round 2, the decode-path hotspot this fuses away)."""
     b, v = logits.shape
     logits = logits.astype(jnp.float32)
 
@@ -36,8 +42,11 @@ def _nucleus_logits(
     kth = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)  # [B,1]
     masked = jnp.where(scaled >= kth, scaled, _NEG_INF)
 
-    # top-p (nucleus) over the top-k-masked distribution
-    sorted_masked = jnp.sort(masked, axis=-1)[:, ::-1]
+    # top-p (nucleus) over the top-k-masked distribution: sort(masked) desc
+    # == sorted_logits with ranks >= k forced to -inf (order is preserved
+    # under the threshold mask), so no second sort is needed
+    rank = jnp.arange(v, dtype=jnp.int32)[None, :]
+    sorted_masked = jnp.where(rank < k[:, None], sorted_logits, _NEG_INF)
     probs_sorted = jax.nn.softmax(sorted_masked, axis=-1)
     cumprobs = jnp.cumsum(probs_sorted, axis=-1)
     p = jnp.clip(top_p, 0.0, 1.0)[:, None]

@@ -121,3 +121,125 @@ def test_rejects_prefill_shapes():
             jnp.zeros((1, 4), jnp.int32), jnp.zeros((1,), jnp.int32),
             16, interpret=True,
         )
+
+
+# ---------------------------------------------------------------------------
+# Fused write+attention kernel (round 2): the decode step writes this step's
+# K/V rows into their page slots inside the kernel (pools aliased in place).
+# Reference = XLA scatter (models.llama._write_kv_pages) + paged_attention_xla
+# over the same inputs.
+# ---------------------------------------------------------------------------
+
+
+def _mk_fused_case(seed, b, hkv, qpk, d, bs, m, n_layers, nblocks, lens):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    nh = hkv * qpk
+    q = rng.standard_normal((b, 1, nh, d), dtype=np.float32)
+    new_k = rng.standard_normal((b, 1, hkv, d), dtype=np.float32)
+    new_v = rng.standard_normal((b, 1, hkv, d), dtype=np.float32)
+    k_pool = rng.standard_normal(
+        (n_layers, nblocks, hkv, bs, d), dtype=np.float32
+    )
+    v_pool = rng.standard_normal(
+        (n_layers, nblocks, hkv, bs, d), dtype=np.float32
+    )
+    tables = np.zeros((b, m), np.int32)
+    for i in range(b):
+        tables[i] = 1 + (np.arange(m) * b + i) % (nblocks - 1)
+    lens = np.asarray(lens, np.int32)
+    positions = (lens - 1)[:, None].astype(np.int32)  # write pos = len - 1
+    return q, new_k, new_v, k_pool, v_pool, tables, positions, lens
+
+
+@pytest.mark.parametrize("lens", [
+    [33, 5, 64, 1],          # mixed short
+    [0, 40, 0, 17],          # inactive rows (no write, zero out)
+    [64, 64, 64, 64],        # full tables
+])
+def test_fused_write_attention_parity(lens):
+    import numpy as np
+
+    from distributed_gpu_inference_tpu.models.llama import _write_kv_pages
+    from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
+    from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_fused,
+    )
+
+    b, hkv, qpk, d, bs, m, L, nblocks = 4, 2, 3, 128, 16, 4, 3, 40
+    layer = 1
+    q, new_k, new_v, k_pool, v_pool, tables, positions, lens_a = \
+        _mk_fused_case(0, b, hkv, qpk, d, bs, m, L, nblocks, lens)
+
+    out, k2, v2 = paged_decode_attention_fused(
+        jnp.asarray(q), jnp.asarray(new_k), jnp.asarray(new_v),
+        jnp.asarray(k_pool), jnp.asarray(v_pool), jnp.int32(layer),
+        jnp.asarray(tables), jnp.asarray(positions), jnp.asarray(lens_a),
+        block_size=bs, interpret=True,
+    )
+
+    # reference: scatter the rows into the layer slice, then XLA attention
+    ref_k = _write_kv_pages(
+        jnp.asarray(k_pool[layer]), jnp.asarray(new_k),
+        jnp.asarray(tables), jnp.asarray(positions), bs,
+    )
+    ref_v = _write_kv_pages(
+        jnp.asarray(v_pool[layer]), jnp.asarray(new_v),
+        jnp.asarray(tables), jnp.asarray(positions), bs,
+    )
+    ref_out = paged_attention_xla(
+        jnp.asarray(q), ref_k, ref_v, jnp.asarray(tables),
+        jnp.asarray(positions), jnp.asarray(lens_a), block_size=bs,
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref_out), rtol=2e-2, atol=2e-2
+    )
+    # pool side effects: written layer matches the scatter reference bit-for
+    # bit on touched pages; other layers untouched
+    np.testing.assert_allclose(np.asarray(k2[layer]), np.asarray(ref_k),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v2[layer]), np.asarray(ref_v),
+                               rtol=1e-6, atol=1e-6)
+    for other in (0, 2):
+        np.testing.assert_array_equal(
+            np.asarray(k2[other]), k_pool[other]
+        )
+
+
+def test_fused_write_respects_window():
+    import numpy as np
+
+    from distributed_gpu_inference_tpu.models.llama import _write_kv_pages
+    from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
+    from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_fused,
+    )
+
+    b, hkv, qpk, d, bs, m, L, nblocks = 2, 2, 2, 128, 16, 6, 1, 30
+    q, new_k, new_v, k_pool, v_pool, tables, positions, lens_a = \
+        _mk_fused_case(3, b, hkv, qpk, d, bs, m, L, nblocks, [80, 41])
+
+    out, k2, v2 = paged_decode_attention_fused(
+        jnp.asarray(q), jnp.asarray(new_k), jnp.asarray(new_v),
+        jnp.asarray(k_pool), jnp.asarray(v_pool), jnp.int32(0),
+        jnp.asarray(tables), jnp.asarray(positions), jnp.asarray(lens_a),
+        block_size=bs, window=32, interpret=True,
+    )
+    ref_k = _write_kv_pages(
+        jnp.asarray(k_pool[0]), jnp.asarray(new_k),
+        jnp.asarray(tables), jnp.asarray(positions), bs,
+    )
+    ref_v = _write_kv_pages(
+        jnp.asarray(v_pool[0]), jnp.asarray(new_v),
+        jnp.asarray(tables), jnp.asarray(positions), bs,
+    )
+    ref_out = paged_attention_xla(
+        jnp.asarray(q), ref_k, ref_v, jnp.asarray(tables),
+        jnp.asarray(positions), jnp.asarray(lens_a), block_size=bs,
+        window=32,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref_out), rtol=2e-2, atol=2e-2
+    )
