@@ -208,13 +208,27 @@ def run_flagship(args) -> None:
 
 def run_spec(args) -> None:
     """TPU-measured speculative decoding: accept rate + speedup vs plain
-    decode with a distilled draft head (VERDICT r1 #7)."""
-    import jax
+    decode with a distilled draft head (VERDICT r1 #7). Delegates to the
+    real-compute harness in benchmarks/speculative.py (trained target +
+    distilled EAGLE head — no simulated accept rates), which prints one
+    JSON line via benchmarks.common.emit."""
+    import sys
 
-    backend = jax.default_backend()
-    from benchmarks.speculative import main as spec_main
+    from benchmarks import speculative as spec_bench
 
-    spec_main(json_line=True, backend=backend)
+    argv = [
+        "bench-spec",
+        "--model", args.model or "llama3-mini",
+        "--requests", "4",
+        "--prompt-len", "32",
+        "--max-tokens", str(args.decode_tokens),
+    ]
+    old = sys.argv
+    sys.argv = argv
+    try:
+        spec_bench.main()
+    finally:
+        sys.argv = old
 
 
 def main() -> None:

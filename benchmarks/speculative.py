@@ -69,9 +69,14 @@ def main() -> None:
     )
 
     cfg = get_model_config(model)
+    # big models: adafactor fits f32 training in HBM; a bounded task vocab
+    # keeps the synthetic chain learnable at Llama-3's 128k vocab
+    big = cfg.num_params > 5e8
     with Timer() as t_train:
         params, sample_stream = train_toy_lm(
-            cfg, jax.random.PRNGKey(0), steps=args.train_steps
+            cfg, jax.random.PRNGKey(0), steps=args.train_steps,
+            optimizer="adafactor" if big else "adam",
+            task_vocab=4096,
         )
     with Timer() as t_distill:
         draft_params = distill_draft_params(

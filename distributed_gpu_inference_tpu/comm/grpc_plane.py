@@ -1,0 +1,305 @@
+"""Real gRPC data plane for cross-host pipeline hops and PD KV transfer.
+
+Closes the reference's unwired-gRPC gap for real (SURVEY gap #2 /
+VERDICT r1 next-step #9): the reference declares a gRPC contract and serves
+everything over ad-hoc HTTP JSON because stub registration was never
+implemented (``worker/distributed/grpc_server.py:427-429``). Here the
+service in ``proto/inference.proto`` is served over REAL gRPC (HTTP/2,
+multiplexed, deadline-aware) without generated code: ``grpc``'s generic
+method handlers take the hand-written proto3 codecs from :mod:`comm.pb`,
+so the wire bytes are conformant protobuf any stub-generated client can
+interoperate with.
+
+On top of the unary surface the HTTP plane already serves
+(``comm/data_plane.py``), this adds the **bidirectional-streaming Forward**
+the reference declared and dropped (its ``StreamInference``,
+ref ``proto/inference.proto:13``): one long-lived HTTP/2 stream per
+pipeline session carries every decode-step hop — no per-token connection
+or header overhead, in-order delivery guaranteed by the stream.
+
+Transport choice stays layered (SURVEY §5.8): intra-slice hops are XLA
+collectives (parallel/pipeline.py, no RPC at all); this plane is the
+CROSS-HOST fallback, and deployments can pick HTTP (curl-debuggable) or
+gRPC (streaming, multiplexed) — both carry the same TPUT tensor frames.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from distributed_gpu_inference_tpu.comm import pb
+from distributed_gpu_inference_tpu.comm.stage_worker import (
+    PipelineStageWorker,
+    StageOutOfBlocksError,
+)
+from distributed_gpu_inference_tpu.utils.serialization import TensorSerializer
+
+_SERVICE = "dgi_tpu.dataplane.v1.PipelineDataPlane"
+
+
+def _tensor_msg(arr: np.ndarray, ser: TensorSerializer) -> Dict[str, bytes]:
+    return {"frame": ser.serialize(np.asarray(arr))}
+
+
+def _tensor_arr(msg: Optional[Dict[str, Any]],
+                ser: TensorSerializer) -> Optional[np.ndarray]:
+    if not msg or not msg.get("frame"):
+        return None
+    return ser.deserialize(msg["frame"])
+
+
+class GrpcDataPlane:
+    """gRPC front for one stage worker (and optionally a PD KV receiver).
+
+    Same behavior surface as :class:`comm.data_plane.DataPlaneServer`,
+    different transport."""
+
+    def __init__(
+        self,
+        stage: PipelineStageWorker,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        kv_receiver: Optional[Callable[[bytes], Dict[str, Any]]] = None,
+        max_workers: int = 8,
+    ) -> None:
+        import grpc
+
+        self.stage = stage
+        self.kv_receiver = kv_receiver
+        self._ser = TensorSerializer(compress=True)
+        # the engine/stage is single-threaded — serialize compute calls
+        self._stage_lock = threading.Lock()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers)
+        )
+        self._server.add_generic_rpc_handlers((self._make_handler(grpc),))
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+
+    # ------------------------------------------------------------ handlers
+
+    def _make_handler(self, grpc):
+        def unary(fn, req_spec, resp_spec):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=pb.deserializer(req_spec),
+                response_serializer=pb.serializer(resp_spec),
+            )
+
+        method_handlers = {
+            "CreateSession": unary(
+                self._create_session,
+                pb.CREATE_SESSION_REQUEST, pb.CREATE_SESSION_RESPONSE),
+            "Forward": unary(
+                self._forward, pb.FORWARD_REQUEST, pb.FORWARD_RESPONSE),
+            "StreamForward": grpc.stream_stream_rpc_method_handler(
+                self._stream_forward,
+                request_deserializer=pb.deserializer(pb.FORWARD_REQUEST),
+                response_serializer=pb.serializer(pb.FORWARD_RESPONSE),
+            ),
+            "TransferKVCache": unary(
+                self._transfer_kv,
+                pb.TRANSFER_KV_REQUEST, pb.TRANSFER_KV_RESPONSE),
+            "CloseSession": unary(
+                self._close_session,
+                pb.CLOSE_SESSION_REQUEST, pb.CLOSE_SESSION_RESPONSE),
+            "HealthCheck": unary(
+                self._health, pb.HEALTH_REQUEST, pb.HEALTH_RESPONSE),
+        }
+        return grpc.method_handlers_generic_handler(_SERVICE, method_handlers)
+
+    def _create_session(self, request, context):
+        out = self.stage.create_session(request["session_id"])
+        return {"session_id": out.get("session_id", request["session_id"]),
+                "existing": bool(out.get("existing", False))}
+
+    def _do_forward(self, request, context):
+        import grpc
+
+        x = _tensor_arr(request["x"], self._ser)
+        positions = _tensor_arr(request["positions"], self._ser)
+        if x is None or positions is None:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "x and positions tensors required")
+        try:
+            with self._stage_lock:
+                out = self.stage.forward(
+                    request["session_id"], x, positions,
+                    int(request["kv_len_after"]),
+                )
+        except KeyError as exc:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(exc))
+        except StageOutOfBlocksError as exc:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(exc))
+        resp: Dict[str, Any] = {"session_id": request["session_id"]}
+        if "hidden" in out:
+            resp["hidden"] = _tensor_msg(out["hidden"], self._ser)
+        if "logits" in out:
+            resp["logits"] = _tensor_msg(out["logits"], self._ser)
+        return resp
+
+    def _forward(self, request, context):
+        return self._do_forward(request, context)
+
+    def _stream_forward(self, request_iterator, context):
+        """Bidi stream: one response per request, in order — a pipeline
+        session's whole decode runs on one HTTP/2 stream."""
+        for request in request_iterator:
+            yield self._do_forward(request, context)
+
+    def _transfer_kv(self, request, context):
+        import grpc
+
+        if self.kv_receiver is None:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED,
+                          "this endpoint is not a KV receiver")
+        try:
+            result = self.kv_receiver(request["handoff"])
+        except Exception as exc:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(exc))
+        return {"slot": int(result.get("slot", -1)),
+                "bytes_received": len(request["handoff"])}
+
+    def _close_session(self, request, context):
+        self.stage.close_session(request["session_id"])
+        return {"status": "closed"}
+
+    def _health(self, request, context):
+        h = self.stage.health()
+        return {
+            "status": h.get("status", "ok"),
+            "layer_start": int(h.get("layer_start", 0)),
+            "layer_end": int(h.get("layer_end", 0)),
+            "is_first": bool(h.get("is_first", False)),
+            "is_last": bool(h.get("is_last", False)),
+            "active_sessions": int(h.get("active_sessions", 0)),
+            "free_blocks": int(h.get("free_blocks", 0)),
+        }
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+
+class GrpcStageClient:
+    """Client for one remote stage over gRPC. Mirrors the call surface the
+    HTTP pipeline session uses, plus a persistent streaming channel."""
+
+    def __init__(self, target: str, timeout_s: float = 30.0) -> None:
+        import grpc
+
+        self._grpc = grpc
+        self.timeout_s = timeout_s
+        self._ser = TensorSerializer(compress=True)
+        self.channel = grpc.insecure_channel(target)
+
+        def u(method, req_spec, resp_spec):
+            return self.channel.unary_unary(
+                f"/{_SERVICE}/{method}",
+                request_serializer=pb.serializer(req_spec),
+                response_deserializer=pb.deserializer(resp_spec),
+            )
+
+        self._create = u("CreateSession", pb.CREATE_SESSION_REQUEST,
+                         pb.CREATE_SESSION_RESPONSE)
+        self._forward = u("Forward", pb.FORWARD_REQUEST, pb.FORWARD_RESPONSE)
+        self._transfer = u("TransferKVCache", pb.TRANSFER_KV_REQUEST,
+                           pb.TRANSFER_KV_RESPONSE)
+        self._close = u("CloseSession", pb.CLOSE_SESSION_REQUEST,
+                        pb.CLOSE_SESSION_RESPONSE)
+        self._health = u("HealthCheck", pb.HEALTH_REQUEST, pb.HEALTH_RESPONSE)
+        self._stream = self.channel.stream_stream(
+            f"/{_SERVICE}/StreamForward",
+            request_serializer=pb.serializer(pb.FORWARD_REQUEST),
+            response_deserializer=pb.deserializer(pb.FORWARD_RESPONSE),
+        )
+
+    def create_session(self, session_id: str) -> Dict[str, Any]:
+        return self._create({"session_id": session_id},
+                            timeout=self.timeout_s)
+
+    def forward(self, session_id: str, x: np.ndarray,
+                positions: np.ndarray, kv_len_after: int) -> Dict[str, Any]:
+        resp = self._forward(
+            {
+                "session_id": session_id,
+                "kv_len_after": int(kv_len_after),
+                "x": _tensor_msg(x, self._ser),
+                "positions": _tensor_msg(positions, self._ser),
+            },
+            timeout=self.timeout_s,
+        )
+        return self._unpack_forward(resp)
+
+    def open_stream(self) -> "ForwardStream":
+        return ForwardStream(self)
+
+    def transfer_kv(self, handoff: bytes) -> Dict[str, Any]:
+        resp = self._transfer({"handoff": handoff}, timeout=self.timeout_s)
+        return {"slot": resp["slot"], "bytes_received": resp["bytes_received"]}
+
+    def close_session(self, session_id: str) -> None:
+        self._close({"session_id": session_id}, timeout=self.timeout_s)
+
+    def health(self) -> Dict[str, Any]:
+        return dict(self._health({}, timeout=self.timeout_s))
+
+    def close(self) -> None:
+        self.channel.close()
+
+    def _unpack_forward(self, resp) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        hidden = _tensor_arr(resp.get("hidden"), self._ser)
+        if hidden is not None:
+            out["hidden"] = hidden
+        logits = _tensor_arr(resp.get("logits"), self._ser)
+        if logits is not None:
+            out["logits"] = logits
+        return out
+
+
+class ForwardStream:
+    """One bidi StreamForward stream: ``step()`` sends a hop and blocks for
+    its (in-order) response. Close with ``close()`` or use as a context
+    manager."""
+
+    def __init__(self, client: GrpcStageClient) -> None:
+        import queue
+
+        self._client = client
+        self._q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        self._call = client._stream(iter(self._q.get, None))
+        self._responses: Iterator = iter(self._call)
+
+    def step(self, session_id: str, x: np.ndarray, positions: np.ndarray,
+             kv_len_after: int) -> Dict[str, Any]:
+        self._q.put(
+            {
+                "session_id": session_id,
+                "kv_len_after": int(kv_len_after),
+                "x": _tensor_msg(x, self._client._ser),
+                "positions": _tensor_msg(positions, self._client._ser),
+            }
+        )
+        return self._client._unpack_forward(next(self._responses))
+
+    def close(self) -> None:
+        self._q.put(None)        # ends the request iterator → half-close
+        try:
+            for _ in self._responses:
+                pass
+        except Exception:  # noqa: BLE001 — stream teardown races are benign
+            pass
+
+    def __enter__(self) -> "ForwardStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

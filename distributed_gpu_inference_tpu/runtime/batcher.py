@@ -31,7 +31,10 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from distributed_gpu_inference_tpu.runtime.engine import TPUEngine
+from distributed_gpu_inference_tpu.runtime.engine import (
+    ChunkedAdmission,
+    TPUEngine,
+)
 from distributed_gpu_inference_tpu.utils.data_structures import (
     InferenceRequest,
     InferenceResponse,
@@ -101,10 +104,15 @@ class ContinuousBatcher:
         )
         self._horizon = float(self._levels[self._level])
         self._slot_items: Dict[int, _QueueItem] = {}
+        # at most one chunk-interleaved long-prompt admission in flight;
+        # its prefill advances one chunk per loop iteration, between decode
+        # rounds (VERDICT r1 next-step #4)
+        self._chunked: Optional[Tuple[ChunkedAdmission, _QueueItem]] = None
         self.stats: Dict[str, Any] = {
             "submitted": 0, "completed": 0, "rejected": 0, "timeouts": 0,
             "decode_rounds": 0, "admitted": 0, "queue_peak": 0,
             "step_latency_ema_ms": 0.0, "occupancy_sum": 0, "horizon": self._horizon,
+            "chunked_admissions": 0, "batched_waves": 0,
         }
 
     # ---------------------------------------------------------------- API
@@ -193,12 +201,21 @@ class ContinuousBatcher:
         """Admit queued requests into free slots. Heap mutation and future
         resolution happen HERE on the event-loop thread (asyncio futures and
         the heap are not thread-safe); only the engine call itself runs on the
-        engine executor thread."""
+        engine executor thread.
+
+        Short prompts are collected into a WAVE and admitted through
+        ``engine.submit_batch`` — one batched prefill device call per bucket
+        instead of one per request (VERDICT r1 next-step #3). Prompts longer
+        than the largest prefill bucket start a chunk-interleaved admission
+        instead (one at a time); their chunks run between decode rounds in
+        ``_run``."""
         admitted = 0
         free = self.engine.free_slots()
         if not free or not self._heap:
             return 0
         loop = asyncio.get_running_loop()
+        max_bucket = self.engine.cfg.prefill_buckets[-1]
+        wave: List[_QueueItem] = []
         for item in self._admission_order():
             if not free:
                 break
@@ -210,34 +227,109 @@ class ContinuousBatcher:
                 continue  # already handled
             if item.future.cancelled():
                 continue
-            target_slot = free.pop(0)
-            try:
-                slot = await loop.run_in_executor(
-                    self._exec, self.engine.submit, item.request, target_slot
-                )
-            except Exception as e:  # OutOfBlocks, bad request, ...
-                free.insert(0, target_slot)
-                if not item.future.done():
-                    item.future.set_result(
-                        InferenceResponse(
-                            request_id=item.request.request_id, error=str(e)
-                        )
+            n_prompt = len(item.request.prompt_token_ids or [])
+            if n_prompt > max_bucket:
+                if self._chunked is not None:
+                    # one interleaved admission at a time — requeue this one
+                    # and keep admitting the rest (a second long prompt must
+                    # not starve short requests behind it)
+                    heapq.heappush(self._heap, item)
+                    continue
+                free.pop(0)
+                try:
+                    adm = await loop.run_in_executor(
+                        self._exec, self.engine.submit_chunked_start,
+                        item.request,
                     )
+                except Exception as e:
+                    if not item.future.done():
+                        item.future.set_result(
+                            InferenceResponse(
+                                request_id=item.request.request_id,
+                                error=str(e),
+                            )
+                        )
+                    continue
+                self._chunked = (adm, item)
+                self.stats["chunked_admissions"] += 1
                 continue
-            self._slot_items[slot] = item
-            admitted += 1
+            free.pop(0)
+            wave.append(item)
+
+        if wave:
+            try:
+                slots = await loop.run_in_executor(
+                    self._exec, self.engine.submit_batch,
+                    [it.request for it in wave],
+                )
+            except Exception:
+                # the wave is all-or-nothing (engine rolls back); isolate the
+                # failing request(s) by falling back to per-request admission
+                for item in wave:
+                    try:
+                        slot = await loop.run_in_executor(
+                            self._exec, self.engine.submit, item.request
+                        )
+                    except Exception as e:
+                        if not item.future.done():
+                            item.future.set_result(
+                                InferenceResponse(
+                                    request_id=item.request.request_id,
+                                    error=str(e),
+                                )
+                            )
+                        continue
+                    self._slot_items[slot] = item
+                    admitted += 1
+            else:
+                self.stats["batched_waves"] += 1
+                for item, slot in zip(wave, slots):
+                    self._slot_items[slot] = item
+                admitted += len(wave)
+
         if self._heap:
             heapq.heapify(self._heap)
         self.stats["admitted"] += admitted
         return admitted
 
+    async def _step_chunked(self) -> None:
+        """Advance the in-flight chunk-interleaved admission by ONE chunk."""
+        if self._chunked is None:
+            return
+        adm, item = self._chunked
+        loop = asyncio.get_running_loop()
+        if item.future.done():  # caller gave up (timeout/cancel): release
+            await loop.run_in_executor(
+                self._exec, self.engine.abort_chunked, adm
+            )
+            self._chunked = None
+            return
+        try:
+            done = await loop.run_in_executor(
+                self._exec, self.engine.submit_chunked_step, adm
+            )
+        except Exception as e:
+            self._chunked = None
+            if not item.future.done():
+                item.future.set_result(
+                    InferenceResponse(
+                        request_id=item.request.request_id, error=str(e)
+                    )
+                )
+            return
+        if done:
+            self._slot_items[adm.slot] = item
+            self._chunked = None
+            self.stats["admitted"] += 1
+
     def _engine_round(self) -> float:
         """One blocking engine round on the worker thread. Returns latency ms."""
         t0 = time.perf_counter()
         steps = self._levels[self._level]
-        if self._heap:
-            # work is waiting: bounded horizon so admission latency stays
-            # low without falling back to one-RTT-per-token stepping; snap
+        if self._heap or self._chunked is not None:
+            # work is waiting (queued requests or a mid-prefill chunked
+            # admission): bounded horizon so admission latency stays low
+            # without falling back to one-RTT-per-token stepping; snap
             # to the largest level ≤ the cap, or the smallest level when
             # every level exceeds it (only compiled lengths may run)
             cap = min(steps, self.cfg.busy_multi_step)
@@ -278,6 +370,10 @@ class ContinuousBatcher:
                     len(self._heap) < len(self.engine.slots):
                 await asyncio.sleep(0.001)
             await self._admit()
+            # one prefill chunk of the in-flight long admission per loop
+            # iteration — decode rounds below run between chunks, so active
+            # slots stall at most one chunk per round
+            await self._step_chunked()
             if not self.engine.num_active:
                 continue
             try:
@@ -302,6 +398,25 @@ class ContinuousBatcher:
                 # a failed round must not wedge the batcher: fail every
                 # in-flight request, abort its slot, keep serving the queue
                 self.stats["engine_errors"] = self.stats.get("engine_errors", 0) + 1
+                if self._chunked is not None:
+                    # the mid-prefill admission isn't in _slot_items yet —
+                    # release its slot and resolve its future here or the
+                    # caller hangs until timeout
+                    adm, chunk_item = self._chunked
+                    self._chunked = None
+                    try:
+                        await loop.run_in_executor(
+                            self._exec, self.engine.abort_chunked, adm
+                        )
+                    except Exception:
+                        pass
+                    if not chunk_item.future.done():
+                        chunk_item.future.set_result(
+                            InferenceResponse(
+                                request_id=chunk_item.request.request_id,
+                                error=f"engine error: {e}",
+                            )
+                        )
                 for i, s in enumerate(list(self.engine.slots)):
                     if s is None:
                         continue

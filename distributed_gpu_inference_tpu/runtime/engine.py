@@ -96,6 +96,30 @@ class _Slot:
     start_time: float = field(default_factory=time.time)
     first_token_time: Optional[float] = None
     finish_reason: Optional[str] = None
+    # True while a chunk-interleaved admission is mid-prefill: the slot's KV
+    # is incomplete and its last_token is garbage, so decode rounds MUST
+    # skip it until the final chunk samples the first token
+    prefilling: bool = False
+
+
+@dataclass
+class ChunkedAdmission:
+    """In-flight chunk-interleaved admission (``submit_chunked_start``).
+
+    The scheduler runs one prefill chunk at a time via
+    ``submit_chunked_step`` and interleaves bounded decode rounds for the
+    other slots between chunks, so a long prompt never stalls active
+    decodes longer than one chunk (vLLM-style chunked-prefill scheduling;
+    VERDICT r1 next-step #4 — the repo's own benchmarks/pd_separation.py
+    quantifies the interference this removes)."""
+
+    request: InferenceRequest
+    slot: int
+    seq_id: str
+    fresh: List[int]
+    off: int
+    mode: str
+    done: bool = False
 
 
 class TPUEngine:
@@ -434,7 +458,8 @@ class TPUEngine:
 
     def _decode_mode(self) -> str:
         for i, s in enumerate(self.slots):
-            if s is not None and s.finish_reason is None and self._temps[i] > 0:
+            if s is not None and s.finish_reason is None \
+                    and not s.prefilling and self._temps[i] > 0:
                 return "mixed"
         return "greedy"
 
@@ -692,34 +717,120 @@ class TPUEngine:
         while True:
             piece = fresh[: max_bucket]
             fresh = fresh[max_bucket:]
-            n = len(piece)
-            bucket = max_bucket if fresh else self._bucket_len(n)
-            toks_pos = np.zeros((2, 1, bucket), np.int32)
-            toks_pos[1] = -1
-            toks_pos[0, 0, :n] = piece
-            toks_pos[1, 0, :n] = np.arange(off, off + n)
-            # final chunk samples the first token IN-GRAPH (the eager sampler
-            # here used to cost ~15 dispatch round-trips on a tunneled TPU);
-            # intermediate chunks skip the LM head entirely
-            first, self.kv = self._prefill_chunk_fn(
-                self.params, self.kv, toks_pos,
-                self._block_tables[slot : slot + 1],
-                np.asarray([off + n], np.int32),
-                self._slot_keys[slot : slot + 1],
-                self._temps[slot : slot + 1],
-                self._top_ks[slot : slot + 1],
-                self._top_ps[slot : slot + 1],
-                mode, not fresh,
-            )
-            off += n
-            self.stats["prefill_tokens"] += n
-            self.stats["prefill_calls"] += 1
-            if not fresh:
+            is_last = not fresh
+            first = self._prefill_one_chunk(slot, piece, off, is_last, mode)
+            off += len(piece)
+            if is_last:
                 break
 
         tok = int(np.asarray(first)[0])
         self._record_token(slot, tok)
         return slot
+
+    def _prefill_one_chunk(self, slot: int, piece: List[int], off: int,
+                           is_last: bool, mode: str):
+        """One single-sequence prefill chunk. The final chunk samples the
+        first token IN-GRAPH (the eager sampler here used to cost ~15
+        dispatch round-trips on a tunneled TPU); intermediate chunks skip
+        the LM head entirely."""
+        n = len(piece)
+        bucket = (
+            self._bucket_len(max(n, 1)) if is_last
+            else self.cfg.prefill_buckets[-1]
+        )
+        toks_pos = np.zeros((2, 1, bucket), np.int32)
+        toks_pos[1] = -1
+        toks_pos[0, 0, :n] = piece
+        toks_pos[1, 0, :n] = np.arange(off, off + n)
+        first, self.kv = self._prefill_chunk_fn(
+            self.params, self.kv, toks_pos,
+            self._block_tables[slot : slot + 1],
+            np.asarray([off + n], np.int32),
+            self._slot_keys[slot : slot + 1],
+            self._temps[slot : slot + 1],
+            self._top_ks[slot : slot + 1],
+            self._top_ps[slot : slot + 1],
+            mode, is_last,
+        )
+        self.stats["prefill_tokens"] += n
+        self.stats["prefill_calls"] += 1
+        return first
+
+    # ------------------------------------------- chunk-interleaved admission
+
+    def submit_chunked_start(
+        self, request: InferenceRequest, slot: Optional[int] = None
+    ) -> ChunkedAdmission:
+        """Begin a chunk-interleaved admission: allocate + bind the slot but
+        run NO prefill yet. The slot is marked ``prefilling`` so decode
+        rounds skip it until ``submit_chunked_step`` finishes the prompt."""
+        if slot is None:
+            free = self.free_slots()
+            if not free:
+                raise RuntimeError("no free slots")
+            slot = free[0]
+        if self.slots[slot] is not None:
+            raise RuntimeError(f"slot {slot} busy")
+        token_ids = self._validate_request(request)
+        seq_id = request.session_id or uuid.uuid4().hex
+        _, cached = self.manager.allocate_sequence(seq_id, token_ids)
+        try:
+            self._apply_pending()
+            s = _Slot(request=request, seq_id=seq_id,
+                      prompt_len=len(token_ids), cached_tokens=cached,
+                      prefilling=True)
+            self._bind_slot(slot, s, kv_len=len(token_ids))
+        except Exception:
+            self.slots[slot] = None
+            self._kv_lens[slot] = 0
+            self.manager.free_sequence(seq_id, cache=False)
+            raise
+        return ChunkedAdmission(
+            request=request, slot=slot, seq_id=seq_id,
+            fresh=list(token_ids[cached:]), off=cached,
+            mode="greedy" if request.sampling.temperature <= 0 else "mixed",
+        )
+
+    def submit_chunked_step(self, adm: ChunkedAdmission) -> bool:
+        """Run ONE prefill chunk of an in-flight admission; True once the
+        admission completed (first token sampled). Work per call is bounded
+        by the largest bucket, so a scheduler can interleave decode rounds
+        between calls and no active slot stalls longer than one chunk."""
+        if adm.done:
+            return True
+        s = self.slots[adm.slot]
+        if s is None or s.seq_id != adm.seq_id:
+            raise RuntimeError("chunked admission slot was freed")
+        self._apply_pending()
+        max_bucket = self.cfg.prefill_buckets[-1]
+        piece = adm.fresh[: max_bucket]
+        adm.fresh = adm.fresh[max_bucket:]
+        is_last = not adm.fresh
+        try:
+            first = self._prefill_one_chunk(
+                adm.slot, piece, adm.off, is_last, adm.mode
+            )
+        except Exception:
+            self.abort_chunked(adm)
+            raise
+        adm.off += len(piece)
+        if is_last:
+            s.prefilling = False
+            tok = int(np.asarray(first)[0])
+            self._record_token(adm.slot, tok)
+            adm.done = True
+        return adm.done
+
+    def abort_chunked(self, adm: ChunkedAdmission) -> None:
+        """Release a failed/cancelled chunked admission's slot and blocks."""
+        s = self.slots[adm.slot]
+        adm.done = True
+        if s is None or s.seq_id != adm.seq_id:
+            return
+        self.slots[adm.slot] = None
+        self._kv_lens[adm.slot] = 0
+        self.manager.free_sequence(adm.seq_id, cache=False)
+        self._core_dirty = True
 
     def _record_token(self, slot: int, tok: int, already_committed: bool = False,
                       device_synced: bool = False) -> None:
@@ -784,7 +895,8 @@ class TPUEngine:
         next. Returns {slot: sampled_token} (stop tokens included, then the
         slot finishes)."""
         active = [
-            i for i, s in enumerate(self.slots) if s is not None and s.finish_reason is None
+            i for i, s in enumerate(self.slots)
+            if s is not None and s.finish_reason is None and not s.prefilling
         ]
         if not active:
             return {}
@@ -820,7 +932,8 @@ class TPUEngine:
         path — amortizes per-token host round-trips."""
         num_steps = num_steps or self.cfg.multi_step
         active_mask = np.array(
-            [s is not None and s.finish_reason is None for s in self.slots]
+            [s is not None and s.finish_reason is None and not s.prefilling
+             for s in self.slots]
         )
         if not active_mask.any():
             return {}

@@ -584,6 +584,29 @@ class PagedKVCacheManager:
             self.stats.window_released_blocks += len(released)
         return released
 
+    def seed_window_front(self, seq_id: str, front_blocks: int) -> List[int]:
+        """Replicate a donor's sliding-window release state on an adopted
+        sequence (PD handoff): force-release the leading ``front_blocks``
+        logical blocks — decref/free the physical blocks, pin the chain
+        entries to pad block 0, and record ``seq_window_front`` so
+        ``free_sequence`` keeps the truncated chain out of the radix index
+        (ADVICE r1 #1). Returns the released logical indices."""
+        blocks = self.seq_blocks[seq_id]
+        released: List[int] = []
+        lb = self.seq_window_front.get(seq_id, 0)
+        while lb < min(front_blocks, len(blocks)):
+            bid = blocks[lb]
+            if bid != 0:
+                meta = self.metas.get(bid)
+                if meta is not None and meta.decref() == 0:
+                    self._deactivate_block(bid)
+            blocks[lb] = 0
+            released.append(lb)
+            lb += 1
+        if lb > self.seq_window_front.get(seq_id, 0):
+            self.seq_window_front[seq_id] = lb
+        return released
+
     def free_sequence(self, seq_id: str, cache: bool = True) -> None:
         """Release a sequence's blocks; full blocks are kept as prefix cache
         (ref 0, LRU-ordered) when ``cache=True``."""

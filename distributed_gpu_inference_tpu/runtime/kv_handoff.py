@@ -66,6 +66,11 @@ class KVHandoff:
     # per-slot PRNG key: an UNSEEDED sampled generation keeps its exact
     # random stream across migration (seeded ones re-derive from the seed)
     slot_key: Optional[List[int]] = None
+    # sliding-window models: leading logical blocks the donor already
+    # released (their exported pages are pad-block garbage — the recipient
+    # must skip uploading them and replicate the release state, or a
+    # no-decode adopt could cache a garbage-prefixed chain; ADVICE r1 #1)
+    window_front: int = 0
     # pages: [n_blocks, L, 2, n_kv_heads, block_size, head_dim] (head-major)
     pages: np.ndarray = field(repr=False, default=None)  # type: ignore[assignment]
 
@@ -107,6 +112,7 @@ def export_slot_kv(engine: "TPUEngine", slot: int) -> KVHandoff:
         start_time=s.start_time,
         first_token_time=s.first_token_time,
         slot_key=[int(x) for x in engine._slot_keys[slot]],
+        window_front=engine.manager.seq_window_front.get(s.seq_id, 0),
         pages=pages,
     )
 
@@ -161,9 +167,18 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
     try:
         cached_blocks = cached_tokens // engine.cfg.block_size
         for i in range(cached_blocks, len(blocks)):
+            if i < handoff.window_front:
+                # donor released this block (sliding window): its exported
+                # page is pad garbage — never upload it
+                continue
             # pages[i] is [L, 2, Hkv, Bk, D] — the engine upload layout
             engine.manager.pending.uploads.append((blocks[i], handoff.pages[i]))
             staged.append(blocks[i])
+        # replicate the donor's release state BEFORE binding so the slot's
+        # block table starts with the released entries pinned to pad block 0
+        # and free_sequence keeps the truncated chain out of the radix
+        if handoff.window_front > 0:
+            engine.manager.seed_window_front(seq_id, handoff.window_front)
 
         s = _Slot(
             request=req,
@@ -230,6 +245,7 @@ def serialize_handoff(h: KVHandoff, compress: bool = True) -> bytes:
         "start_time": h.start_time,
         "first_token_time": h.first_token_time,
         "slot_key": h.slot_key,
+        "window_front": h.window_front,
     }
     buf = io.BytesIO()
     mb = _pack_header(meta)
@@ -270,5 +286,6 @@ def deserialize_handoff(data: bytes) -> KVHandoff:
         start_time=meta["start_time"],
         first_token_time=meta["first_token_time"],
         slot_key=meta.get("slot_key"),
+        window_front=meta.get("window_front", 0),
         pages=pages,
     )

@@ -626,6 +626,7 @@ class SpeculativeDecoder:
                 self.stats["drafted"] += topo_n - 1
                 self.stats["accepted"] += int(n_acc[i])
                 self.stats["emitted"] += int(n_acc[i]) + 1
+                self.stats["row_steps"] = self.stats.get("row_steps", 0) + 1
             # adapt on ACTIVE rows only — finished rows draft stale state
             live_rate = float(n_acc[active].mean()) / max(1, dmax)
             self.accept_rate_ema = (
@@ -669,9 +670,16 @@ class SpeculativeDecoder:
 
     def get_stats(self) -> Dict[str, Any]:
         out = dict(self.stats)
+        # path-level acceptance (the reference's notion, speculative.py:456):
+        # accepted tokens per step per sequence over the max draft depth —
+        # NOT accepted/drafted nodes, which is structurally low for trees
+        # (most sibling branches are always discarded)
         out["accept_rate_ema"] = self.accept_rate_ema
         if out["steps"]:
-            out["tokens_per_step"] = out["emitted"] / out["steps"]
+            # emitted is batch-aggregate; steps counts batch rounds
+            out["tokens_per_step_batch"] = out["emitted"] / out["steps"]
+            rows = max(self.stats.get("row_steps", 0), 1)
+            out["tokens_per_step"] = out["emitted"] / rows
         out["current_widths"] = list(self._widths)
         return out
 

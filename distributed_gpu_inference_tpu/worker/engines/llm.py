@@ -95,6 +95,13 @@ class TPULLMEngine(LLMBaseEngine):
             self.tokenizer = (
                 _load_hf_tokenizer(tok_id) if tok_id else ByteTokenizer()
             )
+        # KV spill tiers: host-RAM L2 block budget + optional L3 remote
+        # store from a config URL (redis://host:port/db — the real RESP
+        # client in runtime/redis_kv.py; memory:// for single-node tests)
+        from distributed_gpu_inference_tpu.runtime.redis_kv import (
+            remote_store_from_url,
+        )
+
         eng_cfg = EngineConfig(
             max_batch_size=int(self.config.get("max_batch_size", 8)),
             max_seq_len=int(self.config.get("max_seq_len", 2048)),
@@ -103,6 +110,11 @@ class TPULLMEngine(LLMBaseEngine):
                 self.config.get("enable_prefix_cache", True)
             ),
             quantization=self.config.get("quantization"),
+            spill_host_blocks=int(self.config.get("kv_spill_host_blocks", 0)),
+            spill_remote_store=remote_store_from_url(
+                self.config.get("kv_remote_url"),
+                ttl_s=float(self.config.get("kv_remote_ttl_s", 3600.0)),
+            ),
         )
         # first-class TP: tp_size > 1 builds a model-axis mesh over local
         # devices (the reference forwarded tensor_parallel_size to vLLM;

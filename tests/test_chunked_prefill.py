@@ -74,3 +74,47 @@ def test_prompt_exceeding_max_seq_len_rejected(params):
     # rejection leaked nothing
     assert eng.num_active == 0
     assert eng.manager.num_free == eng.num_blocks - 1
+
+def test_submit_chunked_matches_submit():
+    """Chunk-interleaved admission is equivalent to atomic submit: same
+    first token, same continuation; decode rounds run between chunks skip
+    the mid-prefill slot."""
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        EngineConfig,
+        TPUEngine,
+    )
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+
+    cfg = EngineConfig(max_batch_size=2, max_seq_len=256,
+                       prefill_buckets=(16, 32), multi_step=4,
+                       enable_prefix_cache=False)
+    prompt = [(i * 11) % 500 for i in range(100)]
+
+    ref = TPUEngine("llama3-tiny", cfg)
+    r_ref = ref.generate([InferenceRequest(
+        prompt_token_ids=prompt,
+        sampling=SamplingParams(max_new_tokens=8))])[0]
+
+    eng = TPUEngine("llama3-tiny", cfg)
+    # an active short sequence decodes while the long one admits
+    eng.submit(InferenceRequest(prompt_token_ids=list(range(20, 30)),
+                                sampling=SamplingParams(max_new_tokens=30)))
+    adm = eng.submit_chunked_start(InferenceRequest(
+        prompt_token_ids=prompt, sampling=SamplingParams(max_new_tokens=8)))
+    long_slot = adm.slot
+    steps = 0
+    while not eng.submit_chunked_step(adm):
+        steps += 1
+        # interleaved decode round must not touch the prefilling slot
+        out = eng.decode_multi(2)
+        assert long_slot not in out
+    assert steps == 3  # 100 tokens / 32 → 4 chunks total
+    # finish both
+    while any(s is not None and s.finish_reason is None
+              for s in eng.slots):
+        eng.decode_multi()
+    resp = eng.finish_slot(long_slot)
+    assert resp.token_ids == r_ref.token_ids

@@ -1,0 +1,251 @@
+"""Real gRPC data plane (comm/grpc_plane.py): proto3 wire codec round-trips,
+unary RPCs against a live stage worker, the bidi StreamForward stream, PD
+KV transfer, and parity with the HTTP plane (VERDICT r1 next-step #9)."""
+
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.comm import pb
+from distributed_gpu_inference_tpu.comm.stage_worker import PipelineStageWorker
+from distributed_gpu_inference_tpu.models import llama
+from distributed_gpu_inference_tpu.models.configs import get_model_config
+
+MODEL = "llama3-tiny"
+
+
+# ---------------------------------------------------------------------------
+# proto3 wire codec
+# ---------------------------------------------------------------------------
+
+
+def test_pb_roundtrip_all_kinds():
+    msg = {
+        "session_id": "sess-1",
+        "kv_len_after": 300,
+        "x": {"frame": b"\x01\x02\x03"},
+        "positions": {"frame": b""},
+    }
+    data = pb.encode(pb.FORWARD_REQUEST, msg)
+    out = pb.decode(pb.FORWARD_REQUEST, data)
+    assert out["session_id"] == "sess-1"
+    assert out["kv_len_after"] == 300
+    assert out["x"]["frame"] == b"\x01\x02\x03"
+    # empty bytes field omitted on the wire → decoded as default
+    assert out["positions"] is None or out["positions"]["frame"] == b""
+
+
+def test_pb_defaults_and_unknown_fields():
+    # defaults
+    out = pb.decode(pb.HEALTH_RESPONSE, b"")
+    assert out["status"] == "" and out["free_blocks"] == 0
+    assert out["is_last"] is False
+    # unknown field (number 99, varint) is skipped, known ones survive
+    data = pb.encode(pb.CLOSE_SESSION_RESPONSE, {"status": "closed"})
+    data += pb._encode_varint(99 << 3 | 0) + pb._encode_varint(7)
+    assert pb.decode(pb.CLOSE_SESSION_RESPONSE, data)["status"] == "closed"
+
+
+def test_pb_negative_and_bool():
+    spec = {1: ("a", "varint"), 2: ("b", "bool")}
+    data = pb.encode(spec, {"a": -5, "b": True})
+    out = pb.decode(spec, data)
+    assert out["a"] == -5 and out["b"] is True
+
+
+def test_pb_wire_compat_with_protobuf_manual():
+    """Field 1 string 'hi' must encode as the canonical proto3 bytes."""
+    assert pb.encode(pb.CREATE_SESSION_REQUEST, {"session_id": "hi"}) == \
+        b"\x0a\x02hi"
+
+
+# ---------------------------------------------------------------------------
+# live gRPC plane over a full-model single stage
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def plane():
+    from distributed_gpu_inference_tpu.comm.grpc_plane import (
+        GrpcDataPlane,
+        GrpcStageClient,
+    )
+
+    cfg = get_model_config(MODEL)
+    import jax
+
+    full_params = llama.init_params(
+        get_model_config(MODEL, dtype="float32"), jax.random.PRNGKey(0),
+    )
+    stage = PipelineStageWorker(
+        MODEL, (0, cfg.num_layers), full_params=full_params,
+        num_blocks=64, max_blocks_per_seq=8, dtype="float32",
+    )
+    server = GrpcDataPlane(stage, host="127.0.0.1", port=0)
+    server.start()
+    client = GrpcStageClient(f"127.0.0.1:{server.port}", timeout_s=60.0)
+    yield server, client, stage
+    client.close()
+    server.stop()
+
+
+def _chunk(tokens, start):
+    x = np.asarray([tokens], np.int32)
+    pos = np.asarray([range(start, start + len(tokens))], np.int32)
+    return x, pos
+
+
+def test_grpc_health_and_session_lifecycle(plane):
+    _, client, _ = plane
+    h = client.health()
+    assert h["status"] == "ok" and h["is_first"] and h["is_last"]
+    out = client.create_session("g-1")
+    assert out["session_id"] == "g-1" and out["existing"] is False
+    out2 = client.create_session("g-1")
+    assert out2["existing"] is True
+    client.close_session("g-1")
+
+
+def test_grpc_forward_matches_http_plane(plane):
+    """The same chunk through gRPC and through the HTTP plane gives
+    identical logits — two transports, one contract."""
+    import httpx
+
+    from distributed_gpu_inference_tpu.comm.data_plane import DataPlaneServer
+    from distributed_gpu_inference_tpu.comm.wire import (
+        pack_message,
+        unpack_message,
+    )
+
+    _, client, stage = plane
+    http_srv = DataPlaneServer(stage, host="127.0.0.1", port=0)
+    http_srv.start()
+    try:
+        prompt = list(range(60, 76))
+        x, pos = _chunk(prompt, 0)
+
+        client.create_session("cmp-grpc")
+        out_grpc = client.forward("cmp-grpc", x, pos,
+                                  kv_len_after=len(prompt))
+        client.close_session("cmp-grpc")
+
+        base = f"http://127.0.0.1:{http_srv.bound_port}"
+        httpx.post(f"{base}/inference/create_session",
+                   json={"session_id": "cmp-http"}).raise_for_status()
+        r = httpx.post(
+            f"{base}/inference/forward",
+            content=pack_message(
+                {"session_id": "cmp-http", "kv_len_after": len(prompt)},
+                {"x": x, "positions": pos},
+            ),
+        )
+        r.raise_for_status()
+        _, tensors = unpack_message(r.content)
+        httpx.post(f"{base}/inference/close",
+                   json={"session_id": "cmp-http"})
+
+        np.testing.assert_allclose(
+            out_grpc["logits"], tensors["logits"], rtol=1e-5, atol=1e-5
+        )
+    finally:
+        http_srv.stop()
+
+
+def test_grpc_forward_unary(plane):
+    _, client, stage = plane
+    client.create_session("g-fwd")
+    x, pos = _chunk(list(range(10, 26)), 0)
+    out = client.forward("g-fwd", x, pos, kv_len_after=16)
+    assert out["logits"].shape[-1] == get_model_config(MODEL).vocab_size
+    assert out["hidden"].shape[:2] == (1, 16)
+    client.close_session("g-fwd")
+
+
+def test_grpc_forward_errors(plane):
+    import grpc
+
+    _, client, _ = plane
+    x, pos = _chunk(list(range(4)), 0)
+    with pytest.raises(grpc.RpcError) as ei:
+        client.forward("no-such-session", x, pos, kv_len_after=4)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+def test_grpc_stream_forward_decodes_greedily(plane):
+    """A whole greedy generation over ONE bidi stream matches the unary
+    path token for token."""
+    _, client, _ = plane
+    prompt = list(range(30, 46))
+
+    def greedy(logits):
+        return int(np.argmax(logits[0, -1]))
+
+    # unary reference
+    client.create_session("u")
+    x, pos = _chunk(prompt, 0)
+    out = client.forward("u", x, pos, kv_len_after=len(prompt))
+    toks_unary = [greedy(out["logits"])]
+    n = len(prompt)
+    for _ in range(5):
+        x, pos = _chunk([toks_unary[-1]], n)
+        out = client.forward("u", x, pos, kv_len_after=n + 1)
+        toks_unary.append(greedy(out["logits"]))
+        n += 1
+    client.close_session("u")
+
+    # streaming path
+    client.create_session("s")
+    with client.open_stream() as stream:
+        x, pos = _chunk(prompt, 0)
+        out = stream.step("s", x, pos, kv_len_after=len(prompt))
+        toks_stream = [greedy(out["logits"])]
+        n = len(prompt)
+        for _ in range(5):
+            x, pos = _chunk([toks_stream[-1]], n)
+            out = stream.step("s", x, pos, kv_len_after=n + 1)
+            toks_stream.append(greedy(out["logits"]))
+            n += 1
+    client.close_session("s")
+    assert toks_stream == toks_unary
+
+
+def test_grpc_transfer_kv_receiver():
+    from distributed_gpu_inference_tpu.comm.grpc_plane import (
+        GrpcDataPlane,
+        GrpcStageClient,
+    )
+    import jax
+
+    cfg = get_model_config(MODEL)
+    full_params = llama.init_params(
+        get_model_config(MODEL, dtype="float32"), jax.random.PRNGKey(0),
+    )
+    stage = PipelineStageWorker(
+        MODEL, (0, cfg.num_layers), full_params=full_params,
+        num_blocks=64, max_blocks_per_seq=8, dtype="float32",
+    )
+    received = {}
+
+    def receiver(raw: bytes):
+        received["bytes"] = len(raw)
+        return {"slot": 3}
+
+    server = GrpcDataPlane(stage, host="127.0.0.1", port=0,
+                           kv_receiver=receiver)
+    server.start()
+    client = GrpcStageClient(f"127.0.0.1:{server.port}")
+    try:
+        out = client.transfer_kv(b"\x00" * 1024)
+        assert out == {"slot": 3, "bytes_received": 1024}
+        assert received["bytes"] == 1024
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_grpc_transfer_kv_unimplemented(plane):
+    import grpc
+
+    _, client, _ = plane
+    with pytest.raises(grpc.RpcError) as ei:
+        client.transfer_kv(b"x")
+    assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED

@@ -129,13 +129,13 @@ def test_priority_admission(engine):
     )
 
     order = []
-    orig_submit = small.submit
+    orig_submit_batch = small.submit_batch
 
-    def tracking_submit(request, slot=None):
-        order.append(request.priority)
-        return orig_submit(request, slot)
+    def tracking_submit_batch(requests):
+        order.extend(r.priority for r in requests)
+        return orig_submit_batch(requests)
 
-    small.submit = tracking_submit
+    small.submit_batch = tracking_submit_batch
 
     async def go():
         b = ContinuousBatcher(small, BatcherConfig(max_wait_ms=30))
@@ -212,3 +212,89 @@ def test_non_adaptive_honors_configured_multi_step():
     b = ContinuousBatcher(eng, BatcherConfig(adaptive=False, multi_step=8))
     assert b._levels == (8,)
     assert b._horizon == 8.0
+
+
+# ---------------------------------------------------------------------------
+# Round 2: batched wave admission + chunk-interleaved long prompts
+# ---------------------------------------------------------------------------
+
+
+def test_wave_admission_one_prefill_call_per_bucket():
+    """A same-bucket wave admits via ONE batched prefill device call
+    (engine.submit_batch), not one per request (VERDICT r1 #3)."""
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=4, max_seq_len=128,
+                     prefill_buckets=(16, 32), multi_step=4),
+    )
+
+    async def drive():
+        b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=20.0,
+                                                 multi_step=4))
+        b.start()
+        before = eng.stats["prefill_calls"]
+        reqs = [
+            InferenceRequest(
+                prompt_token_ids=list(range(10 + i, 26 + i)),
+                sampling=SamplingParams(max_new_tokens=4),
+            )
+            for i in range(4)
+        ]
+        outs = await asyncio.gather(*(b.submit(r) for r in reqs))
+        await b.stop()
+        return outs, eng.stats["prefill_calls"] - before, b.get_stats()
+
+    outs, prefill_calls, stats = asyncio.run(drive())
+    assert all(o.error is None and o.completion_tokens == 4 for o in outs)
+    # all 4 prompts share the 16-token bucket → exactly one prefill call
+    assert prefill_calls == 1, prefill_calls
+    assert stats["batched_waves"] == 1
+
+
+def test_chunked_admission_interleaves_decode():
+    """A long prompt admits chunk by chunk, and decode rounds for the other
+    slots run BETWEEN its chunks — no decode stall longer than one chunk
+    (VERDICT r1 #4)."""
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=2, max_seq_len=256,
+                     prefill_buckets=(16, 32), multi_step=2,
+                     enable_prefix_cache=False),
+    )
+    decode_calls_at_chunk = []
+    orig_step = eng.submit_chunked_step
+
+    def spy_step(adm):
+        decode_calls_at_chunk.append(eng.stats["decode_calls"])
+        return orig_step(adm)
+
+    eng.submit_chunked_step = spy_step
+
+    async def drive():
+        b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=1.0,
+                                                 multi_step=2))
+        b.start()
+        # short request keeps decoding while the long one admits
+        short = b.submit(InferenceRequest(
+            prompt_token_ids=list(range(10, 26)),
+            sampling=SamplingParams(max_new_tokens=40),
+        ))
+        await asyncio.sleep(0.05)  # let the short one start decoding
+        long = b.submit(InferenceRequest(
+            prompt_token_ids=[(i * 7) % 500 for i in range(150)],
+            sampling=SamplingParams(max_new_tokens=4),
+        ))
+        outs = await asyncio.gather(short, long)
+        await b.stop()
+        return outs, b.get_stats()
+
+    (short_out, long_out), stats = asyncio.run(drive())
+    assert short_out.error is None and short_out.completion_tokens == 40
+    assert long_out.error is None and long_out.completion_tokens == 4
+    assert long_out.prompt_tokens == 150
+    assert stats["chunked_admissions"] == 1
+    # 150 fresh tokens / 32-token max bucket → 5 chunk steps
+    assert len(decode_calls_at_chunk) == 5, decode_calls_at_chunk
+    # decode progressed between chunk steps (strictly increasing somewhere)
+    assert decode_calls_at_chunk[-1] > decode_calls_at_chunk[0], \
+        decode_calls_at_chunk

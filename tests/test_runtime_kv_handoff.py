@@ -128,3 +128,77 @@ def test_adopt_rolls_back_on_full_engine(shared_params):
     recipient.submit(_req())
     with pytest.raises(RuntimeError, match="no free slots"):
         adopt_kv(recipient, h)
+
+
+# ---------------------------------------------------------------------------
+# Sliding-window release state across handoff (ADVICE r1 #1)
+# ---------------------------------------------------------------------------
+
+
+def _wreq(prompt, max_new):
+    return InferenceRequest(
+        prompt_token_ids=list(prompt),
+        sampling=SamplingParams(max_new_tokens=max_new, temperature=0.0),
+    )
+
+
+def test_handoff_carries_window_release_state():
+    """A Mistral-style donor that already released out-of-window blocks must
+    hand that state over: the recipient skips the garbage pages, pins the
+    released chain entries to pad block 0, and continues bit-exact."""
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=96,
+                        prefill_buckets=(16, 32), multi_step=4,
+                        enable_prefix_cache=True)
+    prompt = [(i * 13) % 500 for i in range(30)]
+
+    ref = TPUEngine("mistral-tiny", ecfg)   # sliding_window = 8
+    want = ref.generate([_wreq(prompt, 24)])[0]
+
+    donor = TPUEngine("mistral-tiny", ecfg)
+    recv = TPUEngine("mistral-tiny", ecfg, params=donor.params)
+    slot = donor.submit(_wreq(prompt, 24))
+    for _ in range(10):  # decode past the window so blocks release
+        donor.decode_step()
+    h = export_slot_kv(donor, slot)
+    assert h.window_front > 0, "donor must have released leading blocks"
+    donor.finish_slot(slot, cache=False)
+
+    dslot = adopt_kv(recv, deserialize_handoff(serialize_handoff(h)))
+    # released chain entries are pinned to pad block 0 on the recipient
+    seq_id = recv.slots[dslot].seq_id
+    assert all(b == 0 for b in
+               recv.manager.seq_blocks[seq_id][: h.window_front])
+    assert recv.manager.seq_window_front[seq_id] == h.window_front
+    while recv.slots[dslot] is not None and \
+            recv.slots[dslot].finish_reason is None:
+        recv.decode_step()
+    got = recv.finish_slot(dslot)
+    assert got.token_ids == want.token_ids
+
+
+def test_adopted_window_chain_never_enters_radix():
+    """Corner from ADVICE r1 #1: adopt with zero remaining budget →
+    free_sequence(cache=True) must NOT insert the garbage-prefixed chain
+    into the radix prefix index."""
+    ecfg = EngineConfig(max_batch_size=2, max_seq_len=96,
+                        prefill_buckets=(16, 32), multi_step=4,
+                        enable_prefix_cache=True)
+    prompt = [(i * 7) % 500 for i in range(30)]
+
+    donor = TPUEngine("mistral-tiny", ecfg)
+    recv = TPUEngine("mistral-tiny", ecfg, params=donor.params)
+    slot = donor.submit(_wreq(prompt, 12))
+    for _ in range(11):
+        donor.decode_step()
+    h = export_slot_kv(donor, slot)
+    assert h.window_front > 0
+    donor.finish_slot(slot, cache=False)
+
+    dslot = adopt_kv(recv, deserialize_handoff(serialize_handoff(h)))
+    # finish immediately — no decode step ever runs on the recipient
+    recv.finish_slot(dslot, cache=True)
+    # a new prompt sharing the prefix must MISS (the truncated chain is not
+    # a valid prefix), not silently reuse garbage KV
+    probe_slot = recv.submit(_wreq(prompt, 2))
+    assert recv.slots[probe_slot].cached_tokens == 0
+    recv.finish_slot(probe_slot, cache=False)
