@@ -117,6 +117,7 @@ def run_flagship(args) -> None:
             prefill_buckets=buckets,
             multi_step=args.multi_step,
             enable_prefix_cache=False,  # throughput bench: no reuse
+            quantization=args.quantization,
         ),
     )
     rng = np.random.default_rng(0)
@@ -176,6 +177,7 @@ def run_flagship(args) -> None:
                 "vs_baseline": round(decode_tps / BASELINE_TPS, 3),
                 "model": model,
                 "backend": backend,
+                "quantization": args.quantization,
                 "attention_impl": impl,
                 "batch": args.batch,
                 "prompt_len": args.prompt_len,
@@ -219,8 +221,8 @@ def run_spec(args) -> None:
     argv = [
         "bench-spec",
         "--model", args.model or "llama3-mini",
-        "--requests", "4",
-        "--prompt-len", "32",
+        "--requests", str(args.batch),
+        "--prompt-len", str(args.prompt_len),
         "--max-tokens", str(args.decode_tokens),
     ]
     old = sys.argv
@@ -241,6 +243,8 @@ def main() -> None:
     ap.add_argument("--block-size", type=int, default=32)
     ap.add_argument("--allow-xla", action="store_true",
                     help="skip the Pallas-in-path assertion")
+    ap.add_argument("--quantization", default=None,
+                    help="weight-only quantization: int8 | fp8")
     ap.add_argument("--spec", action="store_true",
                     help="speculative-decoding benchmark instead")
     args = ap.parse_args()

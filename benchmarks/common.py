@@ -76,6 +76,36 @@ def make_request(prompt_token_ids: Sequence[int], max_new_tokens: int):
     )
 
 
+def make_chain_sampler(perm, noise: float = 0.05):
+    """Reconstructable sampler for the noisy Markov chain a trained toy LM
+    models — (perm, noise) fully determine the data distribution, so a
+    subprocess-trained model's prompts can be drawn in the parent."""
+    import jax
+    import jax.numpy as jnp
+
+    perm = jnp.asarray(perm)
+    tv = int(perm.shape[0])
+
+    def sample_stream(k, b, s):
+        ks = jax.random.split(k, s)
+        x0 = jax.random.randint(ks[0], (b,), 0, tv, jnp.int32)
+
+        def step(x, kk):
+            k_u, k_r = jax.random.split(kk)
+            nxt = perm[x]
+            u = jax.random.uniform(k_u, (b,))
+            rnd = jax.random.randint(k_r, (b,), 0, tv, jnp.int32)
+            x2 = jnp.where(u < noise, rnd, nxt).astype(jnp.int32)
+            return x2, x2
+
+        _, xs = jax.lax.scan(step, x0, ks[1:])
+        return jnp.concatenate([x0[:, None], xs.T], axis=1)   # [B, S]
+
+    sample_stream.perm = perm
+    sample_stream.noise = noise
+    return sample_stream
+
+
 def train_toy_lm(cfg, key, steps: int = 600, batch: int = 16,
                  seq_len: int = 64, lr: float = 3e-3, noise: float = 0.05,
                  optimizer: str = "adam", task_vocab: int = 0):
@@ -108,21 +138,7 @@ def train_toy_lm(cfg, key, steps: int = 600, batch: int = 16,
     # vocab; only the data visits a subset)
     tv = min(task_vocab, cfg.vocab_size) if task_vocab else cfg.vocab_size
     perm = jax.random.permutation(kperm, tv)
-
-    def sample_stream(k, b, s):
-        ks = jax.random.split(k, s)
-        x0 = jax.random.randint(ks[0], (b,), 0, tv, jnp.int32)
-
-        def step(x, kk):
-            k_u, k_r = jax.random.split(kk)
-            nxt = perm[x]
-            u = jax.random.uniform(k_u, (b,))
-            rnd = jax.random.randint(k_r, (b,), 0, tv, jnp.int32)
-            x2 = jnp.where(u < noise, rnd, nxt).astype(jnp.int32)
-            return x2, x2
-
-        _, xs = jax.lax.scan(step, x0, ks[1:])
-        return jnp.concatenate([x0[:, None], xs.T], axis=1)   # [B, S]
+    sample_stream = make_chain_sampler(perm, noise)
 
     bs = 16
     m = -(-seq_len // bs)

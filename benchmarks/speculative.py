@@ -37,6 +37,54 @@ from benchmarks.common import (
 )
 
 
+def _flatten_params(params, prefix=""):
+    """Nested dict-of-arrays → ({'a.b.c': array}, {'a.b.c': dtype_name}).
+
+    bfloat16 does not survive np.savez/np.load (comes back as raw void
+    ``|V2``), so extended dtypes ride as uint16 bit patterns with their
+    dtype name in a sidecar map."""
+    import numpy as np
+
+    out, dtypes = {}, {}
+    for k, v in params.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            sub, subd = _flatten_params(v, key + ".")
+            out.update(sub)
+            dtypes.update(subd)
+        else:
+            arr = np.asarray(v)
+            dtypes[key] = arr.dtype.name
+            if arr.dtype.name == "bfloat16":
+                arr = arr.view(np.uint16)
+            out[key] = arr
+    return out, dtypes
+
+
+def _unflatten_params(data):
+    """Inverse of _flatten_params over an npz (ignoring non 'p.' keys)."""
+    import json as _json
+
+    import ml_dtypes
+    import numpy as np
+
+    dtypes = _json.loads(str(data["dtypes"])) if "dtypes" in data.files else {}
+    out = {}
+    for key in data.files:
+        if not key.startswith("p."):
+            continue
+        arr = data[key]
+        name = dtypes.get(key[2:])
+        if name == "bfloat16":
+            arr = arr.view(ml_dtypes.bfloat16)
+        parts = key[2:].split(".")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None)
@@ -49,15 +97,67 @@ def main() -> None:
                     help="target-model training steps on the synthetic task")
     ap.add_argument("--distill-steps", type=int, default=800,
                     help="EAGLE draft-head distillation steps")
+    ap.add_argument("--train-out", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--measure-from", default=None, help=argparse.SUPPRESS)
     add_platform_arg(ap)
     args = ap.parse_args()
+
+    from distributed_gpu_inference_tpu.models.configs import get_model_config
+
+    widths = tuple(int(w) for w in args.widths.split(","))
+    # big models train in a SUBPROCESS that must run BEFORE this process
+    # opens its TPU client: the tunnel pins a client's memory view at
+    # connect time, so a parent that initialized the backend first never
+    # sees the trainer's ~12 GB again (observed: distill OOMs in the parent
+    # while succeeding in any fresh process). Decide everything jax-free.
+    big = bool(args.model) and \
+        get_model_config(args.model).num_params > 5e8
+    if big and not args.train_out and not args.measure_from \
+            and args.platform != "cpu":
+        # ORCHESTRATE ONLY: the tunnel client connects at interpreter start
+        # and pins its memory view, so a process that was alive while the
+        # f32 trainer held the chip can never allocate afterwards. Phase 1
+        # (train) and phase 2 (distill + measure) therefore each run in
+        # their own fresh process; this one just shuttles the npz.
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            out = f"{td}/trained.npz"
+            base = [_sys.executable, "-m", "benchmarks.speculative",
+                    "--model", args.model,
+                    "--train-steps", str(args.train_steps),
+                    "--distill-steps", str(args.distill_steps),
+                    "--requests", str(args.requests),
+                    "--prompt-len", str(args.prompt_len),
+                    "--max-tokens", str(args.max_tokens),
+                    "--widths", args.widths]
+            import time as _time
+
+            t0 = _time.perf_counter()
+            subprocess.run(base + ["--train-out", out], check=True)
+            t_train_s = _time.perf_counter() - t0
+            import os as _os
+
+            _os.environ["DGI_SPEC_TRAIN_S"] = f"{t_train_s:.1f}"
+            # let the tunnel reclaim the trainer's memory before the
+            # measure process connects — a client's memory view pins at
+            # connect time, so connecting during lazy reclaim starves it
+            _time.sleep(45.0)
+            subprocess.run(base + ["--measure-from", out], check=True)
+        return
+
+    trained_blob = None
+    if args.measure_from:
+        import numpy as _np
+
+        trained_blob = _np.load(args.measure_from, allow_pickle=False)
 
     import jax
 
     backend, model = resolve_backend_model(args, tpu_default="llama3-tiny")
-    widths = tuple(int(w) for w in args.widths.split(","))
 
-    from distributed_gpu_inference_tpu.models.configs import get_model_config
     from distributed_gpu_inference_tpu.runtime.engine import (
         EngineConfig,
         TPUEngine,
@@ -69,19 +169,64 @@ def main() -> None:
     )
 
     cfg = get_model_config(model)
-    # big models: adafactor fits f32 training in HBM; a bounded task vocab
-    # keeps the synthetic chain learnable at Llama-3's 128k vocab
     big = cfg.num_params > 5e8
-    with Timer() as t_train:
-        params, sample_stream = train_toy_lm(
+
+    def run_training():
+        return train_toy_lm(
             cfg, jax.random.PRNGKey(0), steps=args.train_steps,
             optimizer="adafactor" if big else "adam",
             task_vocab=4096,
+            batch=8 if big else 16,
         )
+
+    if args.train_out:
+        # subprocess mode: train, dump bf16 params + chain spec, exit —
+        # the process boundary is the only reliable way to return the
+        # f32 training peak to the tunnel-side allocator
+        import numpy as _np
+
+        params, sample_stream = run_training()
+        import json as _json
+
+        flat, dtypes = _flatten_params(params)
+        _np.savez(args.train_out, perm=_np.asarray(sample_stream.perm),
+                  noise=sample_stream.noise, dtypes=_json.dumps(dtypes),
+                  **{f"p.{k}": v for k, v in flat.items()})
+        return
+
+    if trained_blob is not None:
+        import os as _os
+
+        from benchmarks.common import make_chain_sampler
+
+        class _T:  # orchestrator-measured training wall time
+            elapsed = float(_os.environ.get("DGI_SPEC_TRAIN_S", "0"))
+
+        t_train = _T()
+        params = _unflatten_params(trained_blob)
+        sample_stream = make_chain_sampler(
+            trained_blob["perm"], float(trained_blob["noise"]))
+    else:
+        with Timer() as t_train:
+            params, sample_stream = run_training()
     with Timer() as t_distill:
-        draft_params = distill_draft_params(
-            cfg, params, jax.random.PRNGKey(1), steps=args.distill_steps
-        )
+        # the tunnel frees an exited process's device memory asynchronously;
+        # right after subprocess training the first allocation burst can
+        # race that reclaim — retry with backoff instead of dying
+        import time as _time
+
+        for attempt in range(4):
+            try:
+                draft_params = distill_draft_params(
+                    cfg, params, jax.random.PRNGKey(1),
+                    steps=args.distill_steps,
+                )
+                break
+            except Exception as exc:  # noqa: BLE001
+                if "RESOURCE_EXHAUSTED" not in str(exc) or attempt == 3:
+                    raise
+                jax.clear_caches()
+                _time.sleep(10.0 * (attempt + 1))
 
     max_seq = args.prompt_len + args.max_tokens + 64
     spec = SpeculativeDecoder(

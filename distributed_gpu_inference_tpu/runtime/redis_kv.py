@@ -166,7 +166,9 @@ class RedisKVStore:
             return None
         try:
             self._conn = self._factory()
-        except OSError:
+        except (OSError, ConnectionError, RESPError):
+            # RESPError covers AUTH/SELECT rejections at connect: a wrong
+            # password must degrade to misses, not break the serving path
             self._down_until = time.monotonic() + self._backoff
             self.stats["errors"] += 1
             return None
@@ -230,7 +232,7 @@ class RedisKVStore:
                 if conn is None:
                     try:
                         conn = self._factory()
-                    except OSError:
+                    except (OSError, ConnectionError, RESPError):
                         self.stats["errors"] += 1
                         if self._stop.wait(self._backoff):
                             return
@@ -239,9 +241,14 @@ class RedisKVStore:
                     conn.command(b"SET", self._key(key), data, b"PX", px)
                     break
                 except (OSError, ConnectionError, RESPError):
+                    # server-side rejections (MISCONF/OOM/READONLY) must
+                    # back off like connect failures — a tight
+                    # reconnect+SET spin would peg a core and hammer redis
                     self.stats["errors"] += 1
                     conn.close()
                     conn = None
+                    if self._stop.wait(self._backoff):
+                        return
 
     def flush(self, timeout_s: float = 5.0) -> bool:
         """Drain pending writebacks (tests, graceful shutdown)."""

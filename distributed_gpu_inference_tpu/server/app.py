@@ -660,6 +660,308 @@ async def admin_push_config(request: web.Request) -> web.Response:
     return web.json_response(cfg.to_dict())
 
 
+async def admin_realtime(request: web.Request) -> web.Response:
+    """Realtime fleet stats (reference admin.py:74-141): worker states by
+    region, queue depths, jobs completed/failed in the last hour."""
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    stats = await st.store.queue_stats()
+    workers = await st.store.list_workers()
+    by_region: Dict[str, Dict[str, int]] = {}
+    for w in workers:
+        r = by_region.setdefault(w.get("region") or "unknown",
+                                 {"online": 0, "busy": 0, "offline": 0})
+        r[w.get("status", "offline")] = r.get(w.get("status", "offline"), 0) + 1
+    hour_ago = time.time() - 3600.0
+    recent = await st.store.query(
+        "SELECT status, COUNT(*) AS n FROM jobs "
+        "WHERE completed_at >= ? GROUP BY status", (hour_ago,),
+    )
+    return web.json_response(
+        {
+            "ts": time.time(),
+            "queue": stats,
+            "workers_by_region": by_region,
+            "jobs_last_hour": {r["status"]: r["n"] for r in recent},
+        }
+    )
+
+
+async def admin_list_workers(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    workers = await st.store.list_workers()
+    out = []
+    for w in workers:
+        out.append({
+            "id": w["id"], "name": w.get("name"),
+            "region": w.get("region"), "status": w.get("status"),
+            "current_job_id": w.get("current_job_id"),
+            "reliability_score": w.get("reliability_score"),
+            "total_jobs": w.get("total_jobs"),
+            "completed_jobs": w.get("completed_jobs"),
+            "failed_jobs": w.get("failed_jobs"),
+            "last_heartbeat": w.get("last_heartbeat"),
+            "supported_types": w.get("supported_types"),
+            "loaded_models": w.get("loaded_models"),
+            "config_version": w.get("config_version"),
+        })
+    return web.json_response({"workers": out})
+
+
+async def admin_worker_detail(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    w = await st.store.get_worker(request.match_info["worker_id"])
+    if w is None:
+        return _json_error(404, "worker not found")
+    w.pop("auth_token_hash", None)
+    w.pop("refresh_token_hash", None)
+    w.pop("signing_secret", None)
+    w["predicted_online_probability"] = \
+        st.reliability.predict_online_probability(w)
+    return web.json_response(w)
+
+
+async def admin_worker_force_offline(request: web.Request) -> web.Response:
+    """Admin action: mark a worker offline and requeue its running jobs
+    (reference worker admin actions, admin.py:172-320)."""
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    wid = request.match_info["worker_id"]
+    if await st.store.get_worker(wid) is None:
+        return _json_error(404, "worker not found")
+    requeued = await st.guarantee.handle_worker_offline(
+        wid, graceful=False
+    )
+    await st.store.audit("admin_force_offline", actor="admin",
+                         detail={"worker_id": wid})
+    return web.json_response({"status": "offline", "requeued": requeued})
+
+
+async def admin_worker_delete(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    wid = request.match_info["worker_id"]
+    if await st.store.get_worker(wid) is None:
+        return _json_error(404, "worker not found")
+    await st.guarantee.handle_worker_offline(wid, graceful=False)
+    await st.store.delete_worker(wid)
+    await st.store.audit("admin_delete_worker", actor="admin",
+                         detail={"worker_id": wid})
+    return web.json_response({"status": "deleted"})
+
+
+async def admin_list_enterprises(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    rows = await st.store.query(
+        "SELECT e.*, (SELECT COUNT(*) FROM api_keys k "
+        " WHERE k.enterprise_id = e.id AND k.active = 1) AS active_keys "
+        "FROM enterprises e ORDER BY e.created_at DESC"
+    )
+    return web.json_response({"enterprises": rows})
+
+
+async def admin_get_enterprise(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent = await st.store.get("enterprises",
+                             request.match_info["enterprise_id"])
+    if ent is None:
+        return _json_error(404, "enterprise not found")
+    return web.json_response(ent)
+
+
+_ENTERPRISE_FIELDS = (
+    "name", "contact_email", "custom_pricing", "price_plan_id",
+    "allow_logging", "retention_days", "anonymize_data", "encrypt_fields",
+)
+
+
+async def admin_update_enterprise(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent_id = request.match_info["enterprise_id"]
+    if await st.store.get("enterprises", ent_id) is None:
+        return _json_error(404, "enterprise not found")
+    body = await request.json()
+    fields = {k: body[k] for k in _ENTERPRISE_FIELDS if k in body}
+    if not fields:
+        return _json_error(400, "no updatable fields given")
+    sets = ", ".join(f"{k} = ?" for k in fields)
+    import json as _json
+
+    vals = [
+        _json.dumps(v) if isinstance(v, (dict, list)) else v
+        for v in fields.values()
+    ]
+    await st.store.execute(
+        f"UPDATE enterprises SET {sets} WHERE id = ?", (*vals, ent_id)
+    )
+    return web.json_response(await st.store.get("enterprises", ent_id))
+
+
+async def admin_delete_enterprise(request: web.Request) -> web.Response:
+    """Delete an enterprise AND its data (jobs/usage/bills/keys) — the
+    reference's enterprise offboarding path."""
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent_id = request.match_info["enterprise_id"]
+    if await st.store.get("enterprises", ent_id) is None:
+        return _json_error(404, "enterprise not found")
+    purged = await st.privacy.delete_enterprise_data(ent_id)
+    await st.store.execute("DELETE FROM api_keys WHERE enterprise_id = ?",
+                           (ent_id,))
+    await st.store.execute("DELETE FROM enterprises WHERE id = ?", (ent_id,))
+    await st.store.audit("admin_delete_enterprise", actor="admin",
+                         detail={"enterprise_id": ent_id})
+    return web.json_response({"status": "deleted", "purged": purged})
+
+
+async def admin_list_api_keys(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    rows = await st.store.query(
+        "SELECT id, enterprise_id, name, active, created_at, last_used_at "
+        "FROM api_keys WHERE enterprise_id = ? ORDER BY created_at DESC",
+        (request.match_info["enterprise_id"],),
+    )
+    return web.json_response({"api_keys": rows})
+
+
+async def admin_revoke_api_key(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    key_id = request.match_info["key_id"]
+    if await st.store.get("api_keys", key_id) is None:
+        return _json_error(404, "api key not found")
+    await st.store.execute("UPDATE api_keys SET active = 0 WHERE id = ?",
+                           (key_id,))
+    await st.store.audit("admin_revoke_api_key", actor="admin",
+                         detail={"key_id": key_id})
+    return web.json_response({"status": "revoked"})
+
+
+async def admin_usage_records(request: web.Request) -> web.Response:
+    """Raw usage records, newest first (reference admin.py:561-735)."""
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent = request.query.get("enterprise_id")
+    limit = min(int(request.query.get("limit", 100)), 1000)
+    if ent:
+        rows = await st.store.query(
+            "SELECT * FROM usage_records WHERE enterprise_id = ? "
+            "ORDER BY created_at DESC LIMIT ?", (ent, limit),
+        )
+    else:
+        rows = await st.store.query(
+            "SELECT * FROM usage_records ORDER BY created_at DESC LIMIT ?",
+            (limit,),
+        )
+    return web.json_response({"usage_records": rows})
+
+
+async def admin_list_bills(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent = request.query.get("enterprise_id")
+    if ent:
+        rows = await st.store.query(
+            "SELECT * FROM bills WHERE enterprise_id = ? "
+            "ORDER BY created_at DESC", (ent,),
+        )
+    else:
+        rows = await st.store.query(
+            "SELECT * FROM bills ORDER BY created_at DESC LIMIT 200"
+        )
+    return web.json_response({"bills": rows})
+
+
+_PRIVACY_FIELDS = ("allow_logging", "retention_days", "anonymize_data",
+                   "encrypt_fields")
+
+
+async def admin_get_privacy(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent = await st.store.get("enterprises",
+                             request.match_info["enterprise_id"])
+    if ent is None:
+        return _json_error(404, "enterprise not found")
+    return web.json_response({k: ent.get(k) for k in _PRIVACY_FIELDS})
+
+
+async def admin_put_privacy(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent_id = request.match_info["enterprise_id"]
+    if await st.store.get("enterprises", ent_id) is None:
+        return _json_error(404, "enterprise not found")
+    body = await request.json()
+    fields = {k: int(body[k]) for k in _PRIVACY_FIELDS if k in body}
+    if not fields:
+        return _json_error(400, "no privacy fields given")
+    sets = ", ".join(f"{k} = ?" for k in fields)
+    await st.store.execute(
+        f"UPDATE enterprises SET {sets} WHERE id = ?",
+        (*fields.values(), ent_id),
+    )
+    await st.store.audit("admin_update_privacy", actor="admin",
+                         detail={"enterprise_id": ent_id, **fields})
+    ent = await st.store.get("enterprises", ent_id)
+    return web.json_response({k: ent.get(k) for k in _PRIVACY_FIELDS})
+
+
+async def admin_privacy_cleanup(request: web.Request) -> web.Response:
+    """Run retention cleanup now (reference retention sweep :273-395)."""
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    result = await st.privacy.retention.cleanup()
+    await st.store.audit("admin_retention_cleanup", actor="admin",
+                         detail=result)
+    return web.json_response(result)
+
+
+async def admin_privacy_export(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent_id = request.match_info["enterprise_id"]
+    if await st.store.get("enterprises", ent_id) is None:
+        return _json_error(404, "enterprise not found")
+    return web.json_response(await st.privacy.export_enterprise_data(ent_id))
+
+
+async def admin_privacy_delete_data(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    ent_id = request.match_info["enterprise_id"]
+    if await st.store.get("enterprises", ent_id) is None:
+        return _json_error(404, "enterprise not found")
+    purged = await st.privacy.delete_enterprise_data(ent_id)
+    await st.store.audit("admin_delete_enterprise_data", actor="admin",
+                         detail={"enterprise_id": ent_id})
+    return web.json_response({"status": "deleted", "purged": purged})
+
+
 # ---------------------------------------------------------------------------
 # misc
 # ---------------------------------------------------------------------------
@@ -727,6 +1029,40 @@ def create_app(state: Optional[ServerState] = None,
     app.router.add_delete(f"{API}/jobs/{{job_id}}", cancel_job)
 
     app.router.add_get(f"{API}/admin/stats/dashboard", admin_dashboard)
+    app.router.add_get(f"{API}/admin/stats/realtime", admin_realtime)
+    app.router.add_get(f"{API}/admin/workers", admin_list_workers)
+    app.router.add_get(f"{API}/admin/workers/{{worker_id}}",
+                       admin_worker_detail)
+    app.router.add_post(f"{API}/admin/workers/{{worker_id}}/offline",
+                        admin_worker_force_offline)
+    app.router.add_delete(f"{API}/admin/workers/{{worker_id}}",
+                          admin_worker_delete)
+    app.router.add_get(f"{API}/admin/enterprises", admin_list_enterprises)
+    app.router.add_get(f"{API}/admin/enterprises/{{enterprise_id}}",
+                       admin_get_enterprise)
+    app.router.add_put(f"{API}/admin/enterprises/{{enterprise_id}}",
+                       admin_update_enterprise)
+    app.router.add_delete(f"{API}/admin/enterprises/{{enterprise_id}}",
+                          admin_delete_enterprise)
+    app.router.add_get(f"{API}/admin/enterprises/{{enterprise_id}}/api-keys",
+                       admin_list_api_keys)
+    app.router.add_delete(f"{API}/admin/api-keys/{{key_id}}",
+                          admin_revoke_api_key)
+    app.router.add_get(f"{API}/admin/usage/records", admin_usage_records)
+    app.router.add_get(f"{API}/admin/bills", admin_list_bills)
+    # static privacy paths FIRST: aiohttp matches in registration order and
+    # /privacy/{enterprise_id} would otherwise swallow /privacy/compliance
+    app.router.add_post(f"{API}/admin/privacy/cleanup",
+                        admin_privacy_cleanup)
+    app.router.add_get(f"{API}/admin/privacy/compliance", admin_compliance)
+    app.router.add_get(f"{API}/admin/privacy/export/{{enterprise_id}}",
+                       admin_privacy_export)
+    app.router.add_delete(f"{API}/admin/privacy/data/{{enterprise_id}}",
+                          admin_privacy_delete_data)
+    app.router.add_get(f"{API}/admin/privacy/{{enterprise_id}}",
+                       admin_get_privacy)
+    app.router.add_put(f"{API}/admin/privacy/{{enterprise_id}}",
+                       admin_put_privacy)
     app.router.add_post(f"{API}/admin/enterprises", admin_create_enterprise)
     app.router.add_post(
         f"{API}/admin/enterprises/{{enterprise_id}}/api-keys", admin_create_api_key
@@ -735,7 +1071,6 @@ def create_app(state: Optional[ServerState] = None,
         f"{API}/admin/enterprises/{{enterprise_id}}/bills", admin_generate_bill
     )
     app.router.add_get(f"{API}/admin/usage/summary", admin_usage_summary)
-    app.router.add_get(f"{API}/admin/privacy/compliance", admin_compliance)
     app.router.add_put(
         f"{API}/admin/workers/{{worker_id}}/config", admin_push_config
     )

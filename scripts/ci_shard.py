@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""Deterministic test-file sharding for the full CI gate.
+
+Usage: python scripts/ci_shard.py SHARD_INDEX NUM_SHARDS [-m MARK_EXPR]
+Prints the test files of the shard (interleaved assignment so heavy model/
+parallel files spread across shards), for xargs into pytest.
+"""
+import argparse
+import pathlib
+
+ap = argparse.ArgumentParser()
+ap.add_argument("index", type=int)
+ap.add_argument("num", type=int)
+args = ap.parse_args()
+
+files = sorted(p.as_posix() for p in pathlib.Path("tests").glob("test_*.py"))
+for i, f in enumerate(files):
+    if i % args.num == args.index:
+        print(f)

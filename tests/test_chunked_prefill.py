@@ -8,6 +8,9 @@ a single-shot prefill over a big enough bucket.
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
 from distributed_gpu_inference_tpu.utils.data_structures import (
     InferenceRequest,

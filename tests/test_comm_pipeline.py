@@ -12,6 +12,9 @@ from typing import List
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.comm.data_plane import DataPlaneServer
 from distributed_gpu_inference_tpu.comm.session import (
     DistributedInferenceSession,

@@ -13,6 +13,9 @@ import threading
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.runtime.batcher import (
     BatcherConfig,
     ContinuousBatcher,

@@ -10,6 +10,9 @@ import jax
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.parallel.mesh import MeshPlan, make_mesh
 from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
 from distributed_gpu_inference_tpu.utils.data_structures import (

@@ -12,6 +12,9 @@ import wave
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.worker.engines import create_engine
 from distributed_gpu_inference_tpu.worker.engines.image_gen import ImageGenEngine
 from distributed_gpu_inference_tpu.worker.engines.vision import VisionEngine

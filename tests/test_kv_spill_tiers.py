@@ -10,6 +10,9 @@ pending-ops path, verified by token-level equality against a no-cache run.
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
 from distributed_gpu_inference_tpu.runtime.kv_cache import RemoteKVStore
 from distributed_gpu_inference_tpu.utils.data_structures import (

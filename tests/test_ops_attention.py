@@ -3,6 +3,9 @@
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 

@@ -9,6 +9,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
 from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
     paged_attention_pallas,

@@ -11,6 +11,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.models import llama
 from distributed_gpu_inference_tpu.models.configs import get_model_config
 from distributed_gpu_inference_tpu.parallel.mesh import MeshPlan, make_mesh

@@ -9,6 +9,9 @@ must produce exactly the tokens the donor would have produced.
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
 from distributed_gpu_inference_tpu.runtime.kv_handoff import (
     adopt_kv,

@@ -8,6 +8,9 @@ A failure here means tree masking, KV compaction, or acceptance is wrong.
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 

@@ -14,6 +14,9 @@ position), so:
 import numpy as np
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
 from distributed_gpu_inference_tpu.utils.data_structures import (
     InferenceRequest,

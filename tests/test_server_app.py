@@ -86,7 +86,8 @@ def test_admin_page_served():
         assert resp.status == 200
         assert "text/html" in resp.headers["Content-Type"]
         text = await resp.text()
-        assert "admin/stats/dashboard" in text
+        assert "/api/v1/admin" in text          # SPA API base
+        assert "X-Admin-Key" in text            # client-side auth header
         await client.close()
 
     run(body())
@@ -400,6 +401,127 @@ def test_admission_policy_enforced_on_next_job():
         # worker not left busy
         resp = await client.get(f"/api/v1/workers/{wid}")
         assert (await resp.json())["status"] == "idle"
+        await client.close()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# Round 2: full admin surface (reference admin.py:74-989 parity)
+# ---------------------------------------------------------------------------
+
+
+def test_admin_realtime_and_worker_actions():
+    async def body():
+        client = await make_client(admin_key="adm")
+        hdr = {"X-Admin-Key": "adm"}
+        reg = await register(client)
+        wid = reg["worker_id"]
+
+        # realtime stats
+        resp = await client.get("/api/v1/admin/stats/realtime", headers=hdr)
+        assert resp.status == 200
+        rt = await resp.json()
+        assert "us-west" in rt["workers_by_region"]
+
+        # worker list + detail (secrets must be scrubbed)
+        resp = await client.get("/api/v1/admin/workers", headers=hdr)
+        workers = (await resp.json())["workers"]
+        assert [w["id"] for w in workers] == [wid]
+        resp = await client.get(f"/api/v1/admin/workers/{wid}", headers=hdr)
+        detail = await resp.json()
+        assert "auth_token_hash" not in detail
+        assert 0.0 <= detail["predicted_online_probability"] <= 1.0
+
+        # force offline, then remove
+        resp = await client.post(f"/api/v1/admin/workers/{wid}/offline",
+                                 headers=hdr)
+        assert resp.status == 200
+        resp = await client.delete(f"/api/v1/admin/workers/{wid}",
+                                   headers=hdr)
+        assert resp.status == 200
+        resp = await client.get("/api/v1/admin/workers", headers=hdr)
+        assert (await resp.json())["workers"] == []
+
+        # auth required
+        resp = await client.get("/api/v1/admin/workers")
+        assert resp.status == 401
+        await client.close()
+
+    run(body())
+
+
+def test_admin_enterprise_crud_keys_privacy_bills():
+    async def body():
+        client = await make_client(admin_key="adm")
+        hdr = {"X-Admin-Key": "adm"}
+
+        # create + list + update
+        resp = await client.post(
+            "/api/v1/admin/enterprises", headers=hdr,
+            json={"name": "acme", "contact_email": "x@acme.io",
+                  "retention_days": 7},
+        )
+        assert resp.status == 201
+        ent = (await resp.json())["enterprise_id"]
+        resp = await client.get("/api/v1/admin/enterprises", headers=hdr)
+        ents = (await resp.json())["enterprises"]
+        assert ents[0]["name"] == "acme" and ents[0]["active_keys"] == 0
+        resp = await client.put(
+            f"/api/v1/admin/enterprises/{ent}", headers=hdr,
+            json={"contact_email": "ops@acme.io"},
+        )
+        assert (await resp.json())["contact_email"] == "ops@acme.io"
+
+        # api keys: create → list → revoke
+        resp = await client.post(
+            f"/api/v1/admin/enterprises/{ent}/api-keys", headers=hdr,
+            json={"name": "prod"},
+        )
+        key_id = (await resp.json())["api_key_id"]
+        resp = await client.get(
+            f"/api/v1/admin/enterprises/{ent}/api-keys", headers=hdr)
+        keys = (await resp.json())["api_keys"]
+        assert keys[0]["name"] == "prod" and keys[0]["active"] == 1
+        resp = await client.delete(f"/api/v1/admin/api-keys/{key_id}",
+                                   headers=hdr)
+        assert resp.status == 200
+        resp = await client.get(
+            f"/api/v1/admin/enterprises/{ent}/api-keys", headers=hdr)
+        assert (await resp.json())["api_keys"][0]["active"] == 0
+
+        # privacy settings: static routes must not be shadowed by the
+        # parameterized one
+        resp = await client.get("/api/v1/admin/privacy/compliance",
+                                headers=hdr)
+        assert resp.status == 200
+        resp = await client.post("/api/v1/admin/privacy/cleanup", headers=hdr)
+        assert resp.status == 200
+        resp = await client.get(f"/api/v1/admin/privacy/{ent}", headers=hdr)
+        assert (await resp.json())["retention_days"] == 7
+        resp = await client.put(
+            f"/api/v1/admin/privacy/{ent}", headers=hdr,
+            json={"anonymize_data": 1, "retention_days": 14},
+        )
+        p = await resp.json()
+        assert p["anonymize_data"] == 1 and p["retention_days"] == 14
+
+        # usage records + bills listings (empty but well-formed)
+        resp = await client.get("/api/v1/admin/usage/records", headers=hdr)
+        assert (await resp.json())["usage_records"] == []
+        resp = await client.get("/api/v1/admin/bills", headers=hdr)
+        assert (await resp.json())["bills"] == []
+
+        # export then delete
+        resp = await client.get(f"/api/v1/admin/privacy/export/{ent}",
+                                headers=hdr)
+        assert resp.status == 200
+        resp = await client.delete(f"/api/v1/admin/enterprises/{ent}",
+                                   headers=hdr)
+        assert resp.status == 200
+        resp = await client.get(f"/api/v1/admin/enterprises/{ent}",
+                                headers=hdr)
+        assert resp.status == 404
         await client.close()
 
     run(body())

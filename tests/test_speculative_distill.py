@@ -1,6 +1,10 @@
 """Draft-head distillation + toy-task target training (the speculative
 benchmark's methodology: real trained weights, no simulated accept rates)."""
 
+import pytest
+
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
 import jax
 import jax.numpy as jnp
 import numpy as np

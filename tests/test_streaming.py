@@ -10,6 +10,9 @@ import json
 
 import httpx
 import pytest
+
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
 from aiohttp.test_utils import TestClient, TestServer
 
 from distributed_gpu_inference_tpu.utils.data_structures import WorkerState

@@ -6,6 +6,9 @@ didn't accept it — nothing drove this path end-to-end.)
 
 import pytest
 
+# compile-heavy (jit/scan graphs): excluded from the fast CI gate
+pytestmark = pytest.mark.slow
+
 from distributed_gpu_inference_tpu.worker.engines.base import EngineLoadError
 from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
 
