@@ -90,16 +90,39 @@ def matmul(x: jax.Array, w: Any) -> jax.Array:
 
 
 def quantize_params(
-    params: Dict[str, Any], mode: Optional[str]
+    params: Dict[str, Any], mode: Optional[str], consume: bool = False
 ) -> Dict[str, Any]:
     """Quantize every eligible matmul weight in a model params pytree.
 
-    Structure-preserving everywhere else; returns a new pytree (input leaves
-    are not mutated). ``mode=None`` is the identity.
+    Structure-preserving everywhere else; returns a new pytree. ``mode=None``
+    is the identity.
+
+    ``consume=True`` drops each source leaf's reference as soon as its
+    quantized replacement exists (the input ``params['layers']`` dict is
+    emptied). Peak HBM is then full-precision + ONE quantized leaf instead
+    of full-precision + the whole quantized tree — the difference between
+    fitting and OOM when cold-starting an int8 model near chip capacity.
     """
     if mode is None:
         return params
     out = dict(params)
+    if consume:
+        src = params["layers"]
+        new_layers: Dict[str, Any] = {}
+        for k in list(src.keys()):
+            v = src.pop(k)
+            if k in QUANT_KEYS and not is_quantized(v):
+                new_layers[k] = quantize_weight(v, mode)
+                # block so the source buffer is actually dead before the
+                # next leaf allocates (lazy tunnel-side reclaim)
+                jax.block_until_ready(
+                    jax.tree.leaves(new_layers[k])[0]
+                )
+                del v
+            else:
+                new_layers[k] = v
+        out["layers"] = new_layers
+        return out
     out["layers"] = {
         k: (quantize_weight(v, mode)
             if (k in QUANT_KEYS and not is_quantized(v)) else v)

@@ -55,6 +55,10 @@ _COPY_BUCKETS = (1, 2, 4, 8, 16, 32)
 # stop_ids x MAX_STOP_IDS, top_k
 _CORE_I_COLS = 5 + MAX_STOP_IDS
 _BIG_BUDGET = 1 << 30
+# quantized loads: full-precision trees up to this size init on-device
+# (fast) before consume-quantization; larger ones build on host CPU so
+# they never stage full-size in HBM (v5e = 16 GB, leave compile headroom)
+_QUANT_DEVICE_BUILD_LIMIT = 11 * 1024**3
 
 
 @dataclass
@@ -241,12 +245,40 @@ class TPUEngine:
         )
 
         if self.mesh is None:
-            return quantize_params(
-                load_or_init_params(
+            if self.cfg.quantization is None:
+                return load_or_init_params(
                     self.model_cfg, checkpoint_path=checkpoint_path,
                     dtype=self.cfg.dtype, seed=seed,
-                ),
-                self.cfg.quantization,
+                )
+            # quantized single-chip load. Two regimes:
+            # - full-precision tree fits HBM transiently → init on device
+            #   (fast) and quantize with consume=True, freeing each source
+            #   leaf as its replacement lands (peak = full tree + 1 leaf);
+            # - it does NOT fit (llama3-8b bf16 = 16.1 GB on 16 GB) →
+            #   build + quantize on host CPU, upload only quantized bytes.
+            fp_bytes = self.model_cfg.param_bytes(jnp.dtype(self.cfg.dtype).itemsize)
+            if fp_bytes <= _QUANT_DEVICE_BUILD_LIMIT:
+                return quantize_params(
+                    load_or_init_params(
+                        self.model_cfg, checkpoint_path=checkpoint_path,
+                        dtype=self.cfg.dtype, seed=seed,
+                    ),
+                    self.cfg.quantization,
+                    consume=True,
+                )
+            cpu = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu):
+                host_params = quantize_params(
+                    load_or_init_params(
+                        self.model_cfg, checkpoint_path=checkpoint_path,
+                        dtype=self.cfg.dtype, seed=seed,
+                    ),
+                    self.cfg.quantization,
+                    consume=True,
+                )
+            dev = jax.devices()[0]
+            return jax.tree.map(
+                lambda a: jax.device_put(a, dev), host_params
             )
         # build (and quantize) on the host CPU backend, then device_put
         # host→shards direct — int8/fp8 leaves ship half the bytes

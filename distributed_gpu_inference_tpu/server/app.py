@@ -807,6 +807,9 @@ async def admin_update_enterprise(request: web.Request) -> web.Response:
     await st.store.execute(
         f"UPDATE enterprises SET {sets} WHERE id = ?", (*vals, ent_id)
     )
+    await st.store.audit("admin_update_enterprise", actor="admin",
+                         detail={"enterprise_id": ent_id,
+                                 "fields": sorted(fields)})
     return web.json_response(await st.store.get("enterprises", ent_id))
 
 
@@ -860,7 +863,11 @@ async def admin_usage_records(request: web.Request) -> web.Response:
         return err
     st = _state(request)
     ent = request.query.get("enterprise_id")
-    limit = min(int(request.query.get("limit", 100)), 1000)
+    try:
+        limit = int(request.query.get("limit", 100))
+    except ValueError:
+        return _json_error(400, "limit must be an integer")
+    limit = max(0, min(limit, 1000))  # negative LIMIT = unlimited in sqlite
     if ent:
         rows = await st.store.query(
             "SELECT * FROM usage_records WHERE enterprise_id = ? "
@@ -914,7 +921,19 @@ async def admin_put_privacy(request: web.Request) -> web.Response:
     if await st.store.get("enterprises", ent_id) is None:
         return _json_error(404, "enterprise not found")
     body = await request.json()
-    fields = {k: int(body[k]) for k in _PRIVACY_FIELDS if k in body}
+    fields: Dict[str, int] = {}
+    for k in _PRIVACY_FIELDS:
+        if k not in body:
+            continue
+        v = body[k]
+        # the enterprise-update endpoint accepts richer shapes (e.g. a list
+        # of field names for encrypt_fields); this endpoint's contract is
+        # int flags/days — reject anything else with a 400, not a 500
+        if isinstance(v, bool):
+            v = int(v)
+        if not isinstance(v, int):
+            return _json_error(400, f"{k} must be an integer (got {type(v).__name__})")
+        fields[k] = v
     if not fields:
         return _json_error(400, "no privacy fields given")
     sets = ", ".join(f"{k} = ?" for k in fields)

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Deterministic test-file sharding for the full CI gate.
 
-Usage: python scripts/ci_shard.py SHARD_INDEX NUM_SHARDS [-m MARK_EXPR]
+Usage: python scripts/ci_shard.py SHARD_INDEX NUM_SHARDS
 Prints the test files of the shard (interleaved assignment so heavy model/
-parallel files spread across shards), for xargs into pytest.
+parallel files spread across shards), for xargs into pytest. Run from the
+repo root (globs tests/).
 """
 import argparse
 import pathlib
