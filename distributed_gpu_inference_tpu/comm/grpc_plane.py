@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import threading
 from concurrent import futures
-from typing import Any, Callable, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
@@ -267,19 +267,38 @@ class GrpcStageClient:
 
 class ForwardStream:
     """One bidi StreamForward stream: ``step()`` sends a hop and blocks for
-    its (in-order) response. Close with ``close()`` or use as a context
-    manager."""
+    its (in-order) response **up to the client timeout** — a hung remote
+    stage cancels the call and raises instead of wedging the pipeline
+    driver forever (ADVICE r2: the stream_stream call has no deadline of
+    its own, unlike the unary calls). Responses are pulled by a reader
+    thread so the per-step wait can be bounded; ``close()`` half-closes,
+    waits briefly for the server to finish, then cancels."""
 
     def __init__(self, client: GrpcStageClient) -> None:
         import queue
 
         self._client = client
         self._q: "queue.Queue[Optional[Dict[str, Any]]]" = queue.Queue()
+        self._resp_q: "queue.Queue" = queue.Queue()
         self._call = client._stream(iter(self._q.get, None))
-        self._responses: Iterator = iter(self._call)
+        self._reader = threading.Thread(
+            target=self._read_loop, name="grpc-forward-stream-reader",
+            daemon=True,
+        )
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            for resp in self._call:
+                self._resp_q.put(("ok", resp))
+            self._resp_q.put(("end", None))
+        except Exception as e:  # noqa: BLE001 — surfaced to step()/close()
+            self._resp_q.put(("err", e))
 
     def step(self, session_id: str, x: np.ndarray, positions: np.ndarray,
              kv_len_after: int) -> Dict[str, Any]:
+        import queue
+
         self._q.put(
             {
                 "session_id": session_id,
@@ -288,15 +307,36 @@ class ForwardStream:
                 "positions": _tensor_msg(positions, self._client._ser),
             }
         )
-        return self._client._unpack_forward(next(self._responses))
+        try:
+            kind, payload = self._resp_q.get(
+                timeout=self._client.timeout_s
+            )
+        except queue.Empty:
+            self._call.cancel()
+            raise TimeoutError(
+                f"StreamForward hop timed out after "
+                f"{self._client.timeout_s}s"
+            ) from None
+        if kind == "ok":
+            return self._client._unpack_forward(payload)
+        if kind == "err":
+            raise payload
+        raise ConnectionError("StreamForward closed by remote")
 
     def close(self) -> None:
+        import queue
+        import time as _time
+
         self._q.put(None)        # ends the request iterator → half-close
-        try:
-            for _ in self._responses:
-                pass
-        except Exception:  # noqa: BLE001 — stream teardown races are benign
-            pass
+        deadline = _time.monotonic() + min(self._client.timeout_s, 2.0)
+        while _time.monotonic() < deadline:
+            try:
+                kind, _ = self._resp_q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if kind in ("end", "err"):
+                return
+        self._call.cancel()      # remote never finished: don't wait forever
 
     def __enter__(self) -> "ForwardStream":
         return self

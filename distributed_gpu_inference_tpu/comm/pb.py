@@ -139,8 +139,12 @@ def decode(spec: Dict[int, Tuple[str, Any]], data: bytes) -> Dict[str, Any]:
                         f"field {name} kind {kind} can't be length-delimited"
                     )
         elif wtype == 5:       # fixed32 (unused by this IDL) — skip
+            if pos + 4 > len(buf):
+                raise ValueError("truncated fixed32 field")
             pos += 4
         elif wtype == 1:       # fixed64 — skip
+            if pos + 8 > len(buf):
+                raise ValueError("truncated fixed64 field")
             pos += 8
         else:
             raise ValueError(f"unsupported wire type {wtype}")

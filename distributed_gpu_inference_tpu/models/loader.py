@@ -10,8 +10,10 @@ consume, and a pipeline stage can load only its layer range.
 
 from __future__ import annotations
 
+import functools
 import json
 import re
+import zlib
 from pathlib import Path
 from typing import Any, Dict, Optional, Tuple
 
@@ -166,6 +168,123 @@ def _validate(params: Dict[str, Any], cfg: ModelConfig, rng: BlockRange) -> None
         raise ValueError("first stage missing embedding")
     if rng.end == cfg.num_layers and "final_norm" not in params:
         raise ValueError("last stage missing final_norm")
+
+
+def init_quantized_streamed(
+    cfg: ModelConfig,
+    mode: str,
+    dtype: Optional[Any] = None,
+    seed: int = 0,
+) -> Dict[str, Any]:
+    """Random-init a model DIRECTLY on device in quantized form, one layer
+    slice at a time — the cold-start path for models whose full-precision
+    tree exceeds device HBM (llama3-8b bf16 = 16.1 GB on a 16 GB v5e).
+
+    Each quantized leaf is produced by ONE jitted ``lax.scan`` over the layer
+    axis: the scan body generates a float32 layer slice on device, quantizes
+    it (``ops.quantization.quantize_weight``), and the scan stacks the int8/
+    fp8 outputs. Peak transient HBM = one f32 layer slice (~0.25 GB for 8B)
+    on top of the growing quantized tree — no host-side init (minutes of
+    single-core numpy for 8B) and no multi-GB host→device upload (~1 GB/s
+    over a tunneled chip). Per distinct leaf shape there is one compile.
+
+    The random stream is deterministic in ``seed`` but differs from
+    ``llama.init_params`` (which draws each leaf in one full-shape call);
+    random-init weights serve benchmarks/tests, not checkpoints, so only
+    determinism matters, not cross-path equality.
+
+    Reference analogue: none — its engines inherit load-time behavior from
+    HF/vLLM (``worker/engines/llm.py:33-36``); cold-starting a quantized
+    model that doesn't fit in fp16 is delegated to pre-quantized
+    checkpoints there.
+    """
+    import jax
+    from distributed_gpu_inference_tpu.models import llama
+    from distributed_gpu_inference_tpu.ops.quantization import (
+        QUANT_KEYS,
+        quantize_weight,
+    )
+
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    h, d = cfg.hidden_size, cfg.head_dim
+    nh, nkv, i = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    L, v = cfg.num_layers, cfg.vocab_size
+
+    root = jax.random.PRNGKey(seed)
+
+    @functools.lru_cache(maxsize=None)
+    def _scan_fn(shape: Tuple[int, ...], fan_in: int):
+        def gen(keys):
+            def body(carry, k):
+                w = jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)
+                q = quantize_weight(w, mode)
+                return carry, (q["qw"], q["scale"])
+
+            _, (qw, scale) = jax.lax.scan(body, 0, keys)
+            return {"qw": qw, "scale": scale}
+
+        return jax.jit(gen)
+
+    def _name_key(name: str):
+        # stable across processes (str hash() is salted per interpreter)
+        return jax.random.fold_in(root, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+
+    def _q_leaf(name: str, shape: Tuple[int, ...], fan_in: int):
+        keys = jax.random.split(_name_key(name), L)
+        out = _scan_fn(shape, fan_in)(keys)
+        jax.block_until_ready(out["qw"])  # bound transient f32 live range
+        return out
+
+    def _dense_leaf(name: str, shape: Tuple[int, ...], fan_in: int):
+        k = _name_key(name)
+        f = jax.jit(
+            lambda k: (
+                jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)
+            ).astype(dtype)
+        )
+        return f(k)
+
+    norm_init = jnp.zeros if cfg.norm_offset else jnp.ones
+    layers: Dict[str, Any] = {
+        "attn_norm": norm_init((L, h), dtype),
+        "mlp_norm": norm_init((L, h), dtype),
+    }
+    leaf_specs = {
+        "wq": ((h, nh * d), h),
+        "wk": ((h, nkv * d), h),
+        "wv": ((h, nkv * d), h),
+        "wo": ((nh * d, h), nh * d),
+    }
+    if cfg.num_experts:
+        E = cfg.num_experts
+        layers["w_router"] = _dense_leaf("w_router", (L, h, E), h)
+        leaf_specs.update({
+            "we_gate": ((E, h, i), h),
+            "we_up": ((E, h, i), h),
+            "we_down": ((E, i, h), i),
+        })
+    else:
+        leaf_specs.update({
+            "w_gate": ((h, i), h),
+            "w_up": ((h, i), h),
+            "w_down": ((i, h), i),
+        })
+    for name, (shape, fan_in) in leaf_specs.items():
+        assert name in QUANT_KEYS
+        layers[name] = _q_leaf(name, shape, fan_in)
+    if cfg.attention_bias:
+        layers["bq"] = _dense_leaf("bq", (L, nh * d), nh * d)
+        layers["bk"] = _dense_leaf("bk", (L, nkv * d), nkv * d)
+        layers["bv"] = _dense_leaf("bv", (L, nkv * d), nkv * d)
+
+    params: Dict[str, Any] = {
+        "embedding": _dense_leaf("embedding", (v, h), h),
+        "layers": layers,
+        "final_norm": norm_init((h,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = _dense_leaf("lm_head", (v, h), h)
+    return params
 
 
 # ---------------------------------------------------------------------------

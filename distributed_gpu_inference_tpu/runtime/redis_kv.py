@@ -132,12 +132,20 @@ class RedisKVStore:
         ttl_s: float = 3600.0,
         key_prefix: str = "dgi:kv:",
         timeout_s: float = 2.0,
+        probe_timeout_s: float = 0.25,
         writeback_queue: int = 256,
         reconnect_backoff_s: float = 5.0,
         conn_factory=None,           # tests inject a fake-connection factory
     ) -> None:
         self.ttl_s = ttl_s
         self.key_prefix = key_prefix
+        self._timeout_s = timeout_s
+        # reads sit on the engine admission path, serialized under _lock: a
+        # slow-but-responsive server must not stall admissions for the full
+        # connect timeout per probe, so GETs run under this much tighter
+        # deadline and a breach trips the same _down_until backoff a
+        # connection failure does (latency fail-open, ADVICE r2 medium)
+        self.probe_timeout_s = probe_timeout_s
         self._factory = conn_factory or (
             lambda: _Conn(host, port, db, password, timeout_s)
         )
@@ -146,7 +154,7 @@ class RedisKVStore:
         self._conn: Optional[_Conn] = None
         self._down_until = 0.0
         self.stats = {"gets": 0, "hits": 0, "puts": 0, "dropped": 0,
-                      "errors": 0}
+                      "errors": 0, "slow_trips": 0}
         # async writeback: bounded queue + daemon writer (its own conn)
         self._q: "queue.Queue[Tuple[str, bytes]]" = queue.Queue(
             maxsize=writeback_queue
@@ -160,10 +168,13 @@ class RedisKVStore:
     # ------------------------------------------------------------ plumbing
 
     def _get_conn(self) -> Optional[_Conn]:
-        if self._conn is not None:
-            return self._conn
+        # backoff window suppresses probes even while a connection is live —
+        # the slow-trip path (get() below) backs off WITHOUT dropping the
+        # socket, so this check must come first
         if time.monotonic() < self._down_until:
             return None
+        if self._conn is not None:
+            return self._conn
         try:
             self._conn = self._factory()
         except (OSError, ConnectionError, RESPError):
@@ -188,17 +199,39 @@ class RedisKVStore:
 
     def get(self, key: str) -> Optional[bytes]:
         """Synchronous read (the spill probe is on the admission path and a
-        hit saves a whole prefill chunk); fail-open to a miss."""
+        hit saves a whole prefill chunk); fail-open to a miss — on
+        connection errors AND on latency: the probe runs under
+        ``probe_timeout_s`` (much tighter than the connect timeout), and a
+        deadline breach or a slow-but-successful reply trips the same
+        ``_down_until`` backoff, so a degraded server costs at most one slow
+        probe per backoff window instead of one per admission."""
         self.stats["gets"] += 1
         with self._lock:
             conn = self._get_conn()
             if conn is None:
                 return None
+            t0 = time.monotonic()
             try:
+                conn.sock.settimeout(self.probe_timeout_s)
                 data = conn.command(b"GET", self._key(key))
+            except socket.timeout:
+                self.stats["slow_trips"] += 1
+                self._drop_conn()
+                return None
             except (OSError, ConnectionError, RESPError):
                 self._drop_conn()
                 return None
+            finally:
+                if self._conn is not None:
+                    try:
+                        self._conn.sock.settimeout(self._timeout_s)
+                    except OSError:
+                        pass
+            # a large payload can exceed the per-recv deadline in aggregate:
+            # keep the hit, but stop probing for a backoff window
+            if time.monotonic() - t0 > self.probe_timeout_s:
+                self.stats["slow_trips"] += 1
+                self._down_until = time.monotonic() + self._backoff
         if data is not None:
             self.stats["hits"] += 1
         return data

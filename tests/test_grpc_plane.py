@@ -58,6 +58,27 @@ def test_pb_wire_compat_with_protobuf_manual():
         b"\x0a\x02hi"
 
 
+def test_pb_truncated_fixed_fields_raise():
+    """A frame ending mid-fixed32/fixed64 must raise like the
+    length-delimited path does, not silently decode to defaults
+    (ADVICE r2)."""
+    spec = {1: ("a", "varint")}
+    good = pb.encode(spec, {"a": 3})
+    # unknown field 9, fixed64 wire type, but only 3 payload bytes present
+    with pytest.raises(ValueError, match="truncated fixed64"):
+        pb.decode(spec, good + pb._encode_varint(9 << 3 | 1) + b"\x00\x01\x02")
+    # unknown field 9, fixed32 wire type, 2 payload bytes
+    with pytest.raises(ValueError, match="truncated fixed32"):
+        pb.decode(spec, good + pb._encode_varint(9 << 3 | 5) + b"\x00\x01")
+    # intact fixed-width unknown fields still skip cleanly
+    out = pb.decode(
+        spec,
+        good + pb._encode_varint(9 << 3 | 1) + b"\x00" * 8
+        + pb._encode_varint(10 << 3 | 5) + b"\x00" * 4,
+    )
+    assert out["a"] == 3
+
+
 # ---------------------------------------------------------------------------
 # live gRPC plane over a full-model single stage
 # ---------------------------------------------------------------------------
@@ -206,6 +227,52 @@ def test_grpc_stream_forward_decodes_greedily(plane):
             n += 1
     client.close_session("s")
     assert toks_stream == toks_unary
+
+
+def test_grpc_stream_step_times_out_on_hung_stage():
+    """A hung remote stage must not wedge the pipeline driver: step()
+    bounds its wait by the client timeout, cancels the call, and raises
+    (ADVICE r2: the stream call carried no deadline)."""
+    import threading
+    import time
+
+    from distributed_gpu_inference_tpu.comm.grpc_plane import (
+        GrpcDataPlane,
+        GrpcStageClient,
+    )
+
+    release = threading.Event()
+
+    class HungStage:
+        def create_session(self, sid):
+            return {"session_id": sid, "existing": False}
+
+        def close_session(self, sid):
+            return None
+
+        def health(self):
+            return {}
+
+        def forward(self, sid, x, positions, kv_len_after):
+            release.wait(timeout=10.0)
+            raise KeyError(sid)
+
+    server = GrpcDataPlane(HungStage(), host="127.0.0.1", port=0)
+    server.start()
+    client = GrpcStageClient(f"127.0.0.1:{server.port}", timeout_s=0.4)
+    try:
+        stream = client.open_stream()
+        x, pos = _chunk([1, 2, 3, 4], 0)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="timed out"):
+            stream.step("s", x, pos, kv_len_after=4)
+        assert time.monotonic() - t0 < 5.0
+        stream.close()   # bounded too: cancel, not an unbounded drain
+        assert time.monotonic() - t0 < 8.0
+    finally:
+        release.set()    # unblock the handler thread so teardown is prompt
+        client.close()
+        server.stop(grace=0)
 
 
 def test_grpc_transfer_kv_receiver():

@@ -25,6 +25,8 @@ class FakeRedisServer:
         self.expiry = {}
         self.commands = []
         self.conns = []
+        self.get_delay = 0.0     # stall before replying (deadline breach)
+        self.dribble_s = 0.0     # split the GET reply, pause mid-send
         self.sock = socket.socket()
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind(("127.0.0.1", 0))
@@ -92,7 +94,17 @@ class FakeRedisServer:
                         buf += chunk
                     args.append(buf[:ln])
                     buf = buf[ln + 2:]
-                conn.sendall(self._dispatch([a for a in args]))
+                reply = self._dispatch([a for a in args])
+                if self.dribble_s and args[0].upper() == b"GET" \
+                        and len(reply) > 2:
+                    # three chunks with sub-deadline gaps: each recv is
+                    # fast, the aggregate GET is slow
+                    third = max(len(reply) // 3, 1)
+                    for i0 in range(0, len(reply), third):
+                        conn.sendall(reply[i0:i0 + third])
+                        time.sleep(self.dribble_s)
+                else:
+                    conn.sendall(reply)
         except (ConnectionError, OSError, AssertionError):
             conn.close()
 
@@ -110,6 +122,8 @@ class FakeRedisServer:
                 self.expiry[key] = time.monotonic() + int(args[4]) / 1000.0
             return b"+OK\r\n"
         if cmd == b"GET":
+            if self.get_delay:
+                time.sleep(self.get_delay)
             key = args[1]
             exp = self.expiry.get(key)
             if exp is not None and time.monotonic() > exp:
@@ -213,6 +227,54 @@ def test_reconnects_after_outage(server):
                 st2.close()
         finally:
             s2.close()
+    finally:
+        st.close()
+
+
+def test_slow_server_trips_latency_backoff(server):
+    """A slow-but-responsive server must not stall the admission path for
+    the full connect timeout per probe: the GET runs under probe_timeout_s
+    and a breach fails open AND trips the reconnect backoff (ADVICE r2
+    medium)."""
+    st = _store(server, probe_timeout_s=0.05, timeout_s=2.0,
+                reconnect_backoff_s=0.5)
+    try:
+        st.put("k", b"v")
+        assert st.flush()
+        server.get_delay = 0.3
+        t0 = time.monotonic()
+        assert st.get("k") is None           # deadline breach → miss
+        assert time.monotonic() - t0 < 0.4   # bounded by probe, not 2 s
+        assert st.stats["slow_trips"] == 1
+        # inside the backoff window the socket isn't even touched
+        n_cmds = len(server.commands)
+        assert st.get("k") is None
+        assert len(server.commands) == n_cmds
+        # after the window, a healthy server serves hits again
+        server.get_delay = 0.0
+        time.sleep(0.55)
+        assert st.get("k") == b"v"
+    finally:
+        st.close()
+
+
+def test_slow_but_successful_reply_still_backs_off(server):
+    """A reply that lands under the per-recv deadline on every chunk but
+    over it in aggregate keeps the hit, yet trips the backoff — and the
+    backoff must hold even though the connection stays alive."""
+    st = _store(server, probe_timeout_s=0.08, timeout_s=2.0,
+                reconnect_backoff_s=0.5)
+    try:
+        st.put("k", b"v")
+        assert st.flush()
+        server.dribble_s = 0.05   # 3 chunks, each gap < 0.08s deadline,
+        # aggregate ~0.15s > probe_timeout_s
+        assert st.get("k") == b"v"           # hit survives
+        assert st.stats["slow_trips"] == 1
+        # live connection + backoff window: next probe skips the socket
+        n_cmds = len(server.commands)
+        assert st.get("k") is None
+        assert len(server.commands) == n_cmds
     finally:
         st.close()
 
