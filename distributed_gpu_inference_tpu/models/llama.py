@@ -31,7 +31,11 @@ from jax import lax
 
 from distributed_gpu_inference_tpu.models.configs import ModelConfig
 from distributed_gpu_inference_tpu.ops.attention import paged_attention
-from distributed_gpu_inference_tpu.ops.quantization import matmul as qmm
+from distributed_gpu_inference_tpu.ops.quantization import (
+    matmul as qmm,
+    matmul_stacked,
+    split_stacked_quant,
+)
 
 Params = Dict[str, Any]
 KVPools = Dict[str, jax.Array]  # {"k": [L,N,Hkv,Bk,D], "v": [L,N,Hkv,Bk,D]}
@@ -177,7 +181,8 @@ def _write_kv_pages(
     phys = jnp.where(valid, phys, num_blocks)
     flat_phys = phys.reshape(-1)
     flat_slot = slot.reshape(-1)
-    flat_new = new.reshape(b * s, *new.shape[2:])          # [T, Hkv, D]
+    # pool may store a narrower dtype than the activations (fp8 KV cache)
+    flat_new = new.astype(pool.dtype).reshape(b * s, *new.shape[2:])  # [T,Hkv,D]
     # advanced indices (dims 0 and 2) separated by the head slice: result
     # dims order as [T, Hkv, D] — exactly flat_new's layout.
     # no unique_indices: padded rows all collapse to the same OOB index, and
@@ -185,12 +190,12 @@ def _write_kv_pages(
     return pool.at[flat_phys, :, flat_slot].set(flat_new, mode="drop")
 
 
-def _mlp(x: jax.Array, lp: Dict[str, jax.Array], activation: str = "silu") -> jax.Array:
+def _mlp(x: jax.Array, proj, activation: str = "silu") -> jax.Array:
     act = jax.nn.silu if activation == "silu" else functools.partial(
         jax.nn.gelu, approximate=True  # Gemma GeGLU (gelu_pytorch_tanh)
     )
-    gate = act(qmm(x, lp["w_gate"]))
-    return qmm(gate * qmm(x, lp["w_up"]), lp["w_down"]).astype(x.dtype)
+    gate = act(proj(x, "w_gate"))
+    return proj(gate * proj(x, "w_up"), "w_down").astype(x.dtype)
 
 
 def _moe_mlp(
@@ -284,6 +289,7 @@ def _layer_step(
     attn_fn,                      # (q, layer_k, layer_v) -> attention output
     fused_decode: bool = False,   # S=1 TPU path: one kernel writes + attends
     kv_lens: Optional[jax.Array] = None,  # required when fused_decode
+    stacked: Optional[Dict[str, Any]] = None,  # quantized weights kept whole
 ) -> Tuple[Tuple[jax.Array, jax.Array, jax.Array, jax.Array], None]:
     """One transformer layer over paged KV — shared by the causal decode path
     and the speculative tree-verify path (they differ only in the attention
@@ -294,15 +300,25 @@ def _layer_step(
     The alternative — XLA scatter into a dynamically-indexed layer slice —
     forced two pool-sized HBM copies per decode step at serving pool sizes
     (scatter-preferred vs kernel-required layout, plus custom-call operand
-    materialization; round-2 profiling)."""
+    materialization; round-2 profiling).
+
+    ``stacked`` holds quantized matmul weights with their layer axis intact
+    (``split_stacked_quant``): projections then run through the Pallas
+    VMEM-dequant kernel addressed by ``layer_idx``, so no per-layer weight
+    slice is ever materialized for the custom call."""
     hidden, k_pool, v_pool, layer_idx = carry
     b, s, _ = hidden.shape
     nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
+    def proj(x_, name):
+        if stacked is not None and name in stacked:
+            return matmul_stacked(x_, stacked[name], layer_idx)
+        return qmm(x_, lp[name])
+
     x = rms_norm(hidden, lp["attn_norm"], cfg.rms_norm_eps, cfg.norm_offset)
-    q = qmm(x, lp["wq"])
-    k = qmm(x, lp["wk"])
-    v = qmm(x, lp["wv"])
+    q = proj(x, "wq")
+    k = proj(x, "wk")
+    v = proj(x, "wv")
     if "bq" in lp:  # Qwen2-style attention biases (static at trace time)
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -319,7 +335,8 @@ def _layer_step(
         )
 
         attn, k_pool, v_pool = paged_decode_attention_fused(
-            q, k, v, k_pool, v_pool, layer_idx, block_tables,
+            q, k.astype(k_pool.dtype), v.astype(v_pool.dtype),
+            k_pool, v_pool, layer_idx, block_tables,
             write_positions, kv_lens, block_size,
             window=cfg.sliding_window,
         )
@@ -332,12 +349,12 @@ def _layer_step(
         v_pool = lax.dynamic_update_index_in_dim(v_pool, layer_v, layer_idx, 0)
         attn = attn_fn(q, layer_k, layer_v)
 
-    hidden = hidden + qmm(attn.reshape(b, s, nh * d), lp["wo"]).astype(hidden.dtype)
+    hidden = hidden + proj(attn.reshape(b, s, nh * d), "wo").astype(hidden.dtype)
     mlp_in = rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
     if "w_router" in lp:
         hidden = hidden + _moe_mlp(mlp_in, lp, cfg)
     else:
-        hidden = hidden + _mlp(mlp_in, lp, cfg.activation)
+        hidden = hidden + _mlp(mlp_in, proj, cfg.activation)
     return (hidden, k_pool, v_pool, layer_idx + 1), None
 
 
@@ -376,6 +393,7 @@ def forward_chunk(
             window=cfg.sliding_window,
         )
 
+    scanned, stacked = split_stacked_quant(params["layers"])
     step = functools.partial(
         _layer_step,
         cfg,
@@ -387,11 +405,12 @@ def forward_chunk(
         attn_fn=attn_fn,
         fused_decode=_use_fused_decode(cfg, s, block_tables, block_size),
         kv_lens=kv_lens,
+        stacked=stacked,
     )
     (hidden, k_pool, v_pool, _), _ = lax.scan(
         lambda c, lp: step(c, lp),
         (hidden, kv["k"], kv["v"], jnp.int32(0)),
-        params["layers"],
+        scanned,
     )
 
     if not with_logits:
@@ -456,6 +475,7 @@ def forward_tree_chunk(
             window=cfg.sliding_window,
         )
 
+    scanned, stacked = split_stacked_quant(params["layers"])
     step = functools.partial(
         _layer_step,
         cfg,
@@ -465,10 +485,11 @@ def forward_tree_chunk(
         cos=cos,
         sin=sin,
         attn_fn=attn_fn,
+        stacked=stacked,
     )
     (hidden, k_pool, v_pool, _), _ = lax.scan(
         lambda c, lp: step(c, lp), (hidden, kv["k"], kv["v"], jnp.int32(0)),
-        params["layers"],
+        scanned,
     )
     logits = project_logits(cfg, params, hidden)
     return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=logits)
@@ -502,6 +523,7 @@ def forward_hidden_chunk(
             window=cfg.sliding_window,
         )
 
+    scanned, stacked = split_stacked_quant(params["layers"])
     step = functools.partial(
         _layer_step,
         cfg,
@@ -515,11 +537,12 @@ def forward_hidden_chunk(
             cfg, hidden.shape[1], block_tables, block_size
         ),
         kv_lens=kv_lens,
+        stacked=stacked,
     )
     (hidden, k_pool, v_pool, _), _ = lax.scan(
         lambda c, lp: step(c, lp),
         (hidden, kv["k"], kv["v"], jnp.int32(0)),
-        params["layers"],
+        scanned,
     )
     return hidden, {"k": k_pool, "v": v_pool}
 

@@ -321,16 +321,19 @@ def _decode_kernel(
         kv_len = lens_ref[b]
         pos = pos_ref[b]
         # [Hkv, qpk, D] — GQA head h = g*qpk + j belongs to kv head g.
-        # The dot runs in the pool dtype (the MXU consumes bf16 natively
-        # with f32 accumulation; converting the staged K/V pages to f32 in
-        # VMEM is a VPU-bound relayout of megabytes per grid cell that
-        # dominated the kernel at large batch); the softmax scale is applied
-        # to the f32 scores so q itself carries no extra rounding.
-        qf = q_ref[0, 0].reshape(hkv, qpk, d).astype(kbuf.dtype)
+        # The dot runs in the pool dtype when it is MXU-native (bf16 with
+        # f32 accumulation; converting the staged K/V pages to f32 in VMEM
+        # is a VPU-bound relayout of megabytes per grid cell that dominated
+        # the kernel at large batch). An fp8 pool (kv_cache_dtype="fp8") is
+        # NOT MXU-native on v5e — pages are upcast to bf16 in VMEM right at
+        # the dot operand, so HBM still only saw the fp8 bytes. The softmax
+        # scale is applied to the f32 scores so q carries no extra rounding.
+        cdt = jnp.bfloat16 if kbuf.dtype.itemsize == 1 else kbuf.dtype
+        qf = q_ref[0, 0].reshape(hkv, qpk, d).astype(cdt)
 
         # [G, Hkv, Bk, D] → [Hkv, G*Bk, D] (leading-dim relabel, no relayout)
-        k = kbuf[slot].transpose(1, 0, 2, 3).reshape(hkv, gsz, d)
-        v = vbuf[slot].transpose(1, 0, 2, 3).reshape(hkv, gsz, d)
+        k = kbuf[slot].transpose(1, 0, 2, 3).reshape(hkv, gsz, d).astype(cdt)
+        v = vbuf[slot].transpose(1, 0, 2, 3).reshape(hkv, gsz, d).astype(cdt)
         scores = lax.dot_general(
             qf, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -351,7 +354,7 @@ def _decode_kernel(
         # standard flash-attention trade — error is bounded by the softmax
         # normalization and the parity tests hold at bf16 tolerance
         acc_new = acc_scr[...] * alpha[..., None] + lax.dot_general(
-            probs.astype(vbuf.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            probs.astype(cdt), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
         )                                                 # [Hkv, qpk, D]
         m_scr[...], l_scr[...], acc_scr[...] = m_new, l_new, acc_new

@@ -9,13 +9,13 @@ first-party and TPU-shaped:
   float32 per-output-channel scale — half the bytes, so a chip fits ~2x
   the model (or correspondingly more KV pages). That capacity win is the
   primary benefit today.
-- **Compute**: the MXU consumes bf16; the int8→bf16 convert is expressed
-  inline in the matmul so XLA *can* fuse it into the operand read.
-  Measured on v5e (2026-07), decode throughput is ≈ parity with bf16 —
-  XLA materializes the converted operand rather than streaming it, so the
-  bandwidth saving is not yet realized; a Pallas matmul kernel that
-  converts in VMEM after the int8 HBM read is the designated upgrade path
-  if decode speed (not capacity) is the goal.
+- **Compute**: the MXU consumes bf16. On the decode path (small activation
+  row counts) the contraction runs through the Pallas kernel in
+  ``ops/qmm_pallas.py``: int8 tiles are DMA'd HBM→VMEM and converted
+  in-kernel, so HBM sees half the bytes. Everywhere else (prefill,
+  CPU/tests) the convert is expressed inline in the XLA matmul — XLA can
+  materialize the converted operand there, but those paths are
+  compute-bound, not weight-bandwidth-bound.
 - **Pytree shape**: a quantized weight is a sub-dict ``{"qw", "scale"}`` whose
   leaves both carry the stacked leading L axis, so ``lax.scan`` over layers,
   GSPMD sharding, and pipeline stage slicing all keep working unchanged.
@@ -26,10 +26,12 @@ projection and it transparently handles plain or quantized leaves.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 QUANT_MODES = ("int8", "fp8")
 
@@ -74,19 +76,108 @@ def dequantize(w: Dict[str, jax.Array], dtype: Any = jnp.float32) -> jax.Array:
     return (w["qw"].astype(jnp.float32) * w["scale"]).astype(dtype)
 
 
+def _pallas_qmm_ok(m: int, k_dim: int, n: int, qdtype) -> bool:
+    """Trace-time gate for the in-kernel-dequant Pallas matmul: TPU backend,
+    int8 storage, a bandwidth-bound row count, and tileable K/N."""
+    if os.environ.get("DGI_DISABLE_PALLAS"):
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:  # pragma: no cover
+        return False
+    from distributed_gpu_inference_tpu.ops import qmm_pallas
+
+    return (
+        qdtype in (jnp.int8, jnp.float8_e4m3fn)
+        and qmm_pallas.qmm_rows_ok(m)
+        and qmm_pallas.pick_tiles(k_dim, n) is not None
+    )
+
+
 def matmul(x: jax.Array, w: Any) -> jax.Array:
     """``x @ w`` where ``w`` is a plain array or a quantized sub-dict.
 
-    Quantized path: convert-on-read matmul in x.dtype (bf16 on the MXU),
-    then scale the output channels. The scale broadcast ``[..., 1, out]``
-    collapses against ``x @ qw``'s trailing [..., out].
+    Quantized decode-shaped calls go through the Pallas VMEM-dequant kernel
+    (int8 on the HBM wire); otherwise convert-on-read matmul in x.dtype
+    (bf16 on the MXU), then scale the output channels. The scale broadcast
+    ``[..., 1, out]`` collapses against ``x @ qw``'s trailing [..., out].
     """
     if not is_quantized(w):
         return x @ w
-    out = x @ w["qw"].astype(x.dtype)
+    qw = w["qw"]
+    if qw.ndim == 2:
+        lead = x.shape[:-1]
+        m = 1
+        for d in lead:
+            m *= d
+        if _pallas_qmm_ok(m, qw.shape[0], qw.shape[1], qw.dtype):
+            # single dispatch point: lift to a 1-layer stack
+            return matmul_stacked(
+                x, {"qw": qw[None], "scale": w["scale"][None]}, jnp.int32(0)
+            )
+    out = x @ qw.astype(x.dtype)
     # scale shape [..., 1, out] → drop the kept contraction axis for broadcast
     scale = jnp.squeeze(w["scale"], axis=-2).astype(jnp.float32)
     return (out.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+# weight keys large enough to be worth the stacked-scan treatment (the MoE
+# expert weights route through the einsum combine instead)
+STACKED_KEYS = frozenset(
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+)
+
+
+def split_stacked_quant(layers: Dict[str, Any]):
+    """Partition a stacked layer tree for the scan in ``models/llama.py``:
+    quantized matmul weights are pulled OUT of the scan xs (so the Pallas
+    kernel can take the whole stacked array + a layer index instead of a
+    materialized per-layer slice) and everything else stays scanned.
+
+    → (scanned_layers, stacked_or_None)
+    """
+    stacked = {
+        k: v for k, v in layers.items()
+        if k in STACKED_KEYS and is_quantized(v)
+    }
+    if not stacked:
+        return layers, None
+    scanned = {k: v for k, v in layers.items() if k not in stacked}
+    return scanned, stacked
+
+
+def matmul_stacked(x: jax.Array, w: Dict[str, jax.Array], layer_idx) -> jax.Array:
+    """``x @ dequant(w[layer_idx])`` for a stacked quantized weight
+    ``{"qw": [L, K, N], "scale": [L, 1, N]}`` — the scan-body entry point.
+
+    Decode-shaped calls hit the Pallas kernel with the STACKED operand (no
+    per-layer slice ever materializes); other shapes slice the layer and
+    take the XLA convert-on-read path (equivalent HLO to scanning the
+    weight as an xs leaf, so nothing regresses).
+    """
+    qw = w["qw"]
+    _, k_dim, n = qw.shape
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= d
+    if _pallas_qmm_ok(m, k_dim, n, qw.dtype):
+        from distributed_gpu_inference_tpu.ops.qmm_pallas import (
+            qmm_stacked_pallas,
+        )
+
+        out = qmm_stacked_pallas(
+            x.reshape(m, k_dim), qw, w["scale"], layer_idx
+        )
+        return out.reshape(*lead, n)
+    sliced = {
+        "qw": lax.dynamic_index_in_dim(qw, layer_idx, 0, keepdims=False),
+        "scale": lax.dynamic_index_in_dim(
+            w["scale"], layer_idx, 0, keepdims=False
+        ),
+    }
+    return matmul(x, sliced)
 
 
 def quantize_params(

@@ -12,6 +12,12 @@ implements that reconstructed contract on stdlib sqlite3:
 - ``claim_next_job`` provides the atomic pull the reference gets from
   ``SELECT … FOR UPDATE SKIP LOCKED`` (``scheduler.py:194-234``) — sqlite has
   a single writer, so ``BEGIN IMMEDIATE`` + conditional UPDATE is equivalent.
+- **Versioned in-place migrations** via ``PRAGMA user_version`` (the role
+  alembic plays for the reference, ``server/alembic/env.py``): ``_SCHEMA``
+  is the frozen v1 baseline, every later change is an entry in
+  ``_MIGRATIONS``, and ``Store.__init__`` upgrades any older database file
+  atomically per version. Fresh databases replay the full migration list,
+  so the upgrade path is exercised on every open, not just on legacy files.
 
 Rows are returned as plain dicts; JSON-typed columns are transparently
 encoded/decoded.
@@ -173,6 +179,24 @@ CREATE TABLE IF NOT EXISTS audit_log (
 );
 """
 
+_BASELINE_VERSION = 1
+
+# Ordered (version, statement) pairs. All statements of one version apply in
+# one transaction and ``PRAGMA user_version`` advances with it — a crash
+# mid-version leaves the file at the previous version, to be retried. The
+# baseline ``_SCHEMA`` is FROZEN at v1: schema evolution happens here.
+_MIGRATIONS = [
+    # v2: jobs carry the enterprise that submitted them, so usage/billing can
+    # attribute work without joining through api_keys at query time
+    (2, "ALTER TABLE jobs ADD COLUMN enterprise_id TEXT"),
+    (2, "CREATE INDEX IF NOT EXISTS idx_jobs_enterprise "
+        "ON jobs (enterprise_id)"),
+]
+
+SCHEMA_VERSION = max(
+    [v for v, _ in _MIGRATIONS], default=_BASELINE_VERSION
+)
+
 
 def _encode(table_json: set, row: Dict[str, Any]) -> Dict[str, Any]:
     out = {}
@@ -210,8 +234,47 @@ class Store:
         if path != ":memory:":
             self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
-        self._conn.executescript(_SCHEMA)
+        try:
+            self._migrate()
+        except BaseException:
+            self._conn.close()
+            raise
         self._lock = asyncio.Lock()
+
+    def _migrate(self) -> None:
+        """Bring the database to ``SCHEMA_VERSION`` in place.
+
+        version 0 means either a fresh file or a legacy pre-versioning
+        database; both get the v1 baseline (``IF NOT EXISTS`` makes it a
+        no-op on legacy files, whose tables ARE the v1 shape) and then
+        replay every migration beyond their version.
+        """
+        (ver,) = self._conn.execute("PRAGMA user_version").fetchone()
+        if ver == 0:
+            # executescript issues an implicit COMMIT, so the baseline runs
+            # in autocommit; the version stamp lands right after it
+            self._conn.executescript(_SCHEMA)
+            ver = _BASELINE_VERSION
+            self._conn.execute(f"PRAGMA user_version={ver}")
+        if ver > SCHEMA_VERSION:
+            raise RuntimeError(
+                f"database {self._path!r} is at schema version {ver}, newer "
+                f"than this build's {SCHEMA_VERSION} — refusing to open"
+            )
+        pending = sorted(
+            {v for v, _ in _MIGRATIONS if v > ver}
+        )
+        for v in pending:
+            self._conn.execute("BEGIN")
+            try:
+                for mv, sql in _MIGRATIONS:
+                    if mv == v:
+                        self._conn.execute(sql)
+                self._conn.execute(f"PRAGMA user_version={v}")
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
 
     async def _run(self, fn, *args):
         async with self._lock:

@@ -72,15 +72,27 @@ class ConfigWizard:
         return cast(str(default))
 
     def run(self, base: Optional[WorkerConfig] = None) -> WorkerConfig:
-        from .main import probe_topology
+        from .main import probe_topology, probe_tpu_runtime
 
         cfg = base or WorkerConfig()
         self._print("== TPU worker setup ==")
 
+        runtime = probe_tpu_runtime()
+        if runtime["libtpu"] or runtime["accel_devices"]:
+            self._print(
+                "tpu runtime: libtpu="
+                + ("found" if runtime["libtpu"] else "MISSING")
+                + (f", devices={len(runtime['accel_devices'])}"
+                   if runtime["accel_devices"] else "")
+                + (f", type={runtime['accelerator_type']}"
+                   if runtime["accelerator_type"] else "")
+            )
         topo = probe_topology()
         self._print(
             f"detected accelerator: {topo.chip_type} x{topo.num_chips} "
-            f"({topo.hbm_gb_per_chip:.0f} GB HBM/chip)"
+            f"({topo.hbm_gb_per_chip:.0f} GB HBM/chip, mesh "
+            f"{'x'.join(map(str, topo.mesh_shape))}, "
+            f"{topo.peak_bf16_tflops:.0f} bf16 TFLOP/s/chip)"
         )
 
         cfg.name = self._ask("worker name", cfg.name)

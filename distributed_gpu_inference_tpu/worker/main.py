@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
+
 from ..utils.config import WorkerConfig
 from ..utils.data_structures import TpuTopology, WorkerState
 from .api_client import APIClient, APIError
@@ -40,32 +42,117 @@ from .machine_id import MachineFingerprint
 log = logging.getLogger("tpu_worker")
 
 
+# per-generation chip facts: HBM GB, per-link ICI GB/s, peak bf16 TFLOP/s
+_TPU_GEN = {
+    "v4":  (32.0, 300.0, 275.0),
+    "v5e": (16.0, 400.0, 197.0),
+    "v5p": (95.0, 600.0, 459.0),
+    "v6e": (32.0, 900.0, 918.0),
+}
+
+
+def probe_tpu_runtime() -> dict:
+    """Environment-level TPU runtime probe — the analogue of the reference
+    wizard's nvidia-smi/CUDA-version detection (``cli.py:77-133,298-651``),
+    but for libtpu: works BEFORE any jax backend initializes (a probe that
+    must first dial the chip cannot diagnose a broken runtime).
+
+    Returns {libtpu, accel_devices, accelerator_type, worker_id, hosts} where
+    ``accelerator_type`` is the platform-provided string (e.g.
+    ``v5litepod-16``) GKE/GCE export via TPU_ACCELERATOR_TYPE.
+    """
+    import glob
+    import importlib.util
+    import os
+
+    libtpu = bool(
+        os.environ.get("TPU_LIBRARY_PATH")
+        or importlib.util.find_spec("libtpu") is not None
+        or glob.glob("/usr/lib/libtpu*")
+        or glob.glob("/lib/libtpu*")
+    )
+    accel = sorted(glob.glob("/dev/accel*")) + sorted(glob.glob("/dev/vfio/*"))
+    return {
+        "libtpu": libtpu,
+        "accel_devices": accel,
+        "accelerator_type": os.environ.get("TPU_ACCELERATOR_TYPE")
+        or os.environ.get("TPU_TYPE") or "",
+        "worker_id": os.environ.get("TPU_WORKER_ID", ""),
+        "hosts": (os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+                  if os.environ.get("TPU_WORKER_HOSTNAMES") else []),
+    }
+
+
+def _gen_from_string(s: str) -> str:
+    s = s.lower()
+    if "v5p" in s or "v5 pod" in s:
+        return "v5p"
+    if "v5lite" in s or "v5e" in s or "v5" in s:
+        return "v5e"
+    if "v6" in s:
+        return "v6e"
+    if "v4" in s:
+        return "v4"
+    return "v5e"
+
+
 def probe_topology() -> TpuTopology:
-    """Describe local accelerators from jax (the TPU analogue of the
-    reference's nvidia-smi probe, ``cli.py:77``). Falls back to a CPU
-    topology when jax is unavailable or sees no accelerator."""
+    """Describe local accelerators (the TPU analogue of the reference's
+    nvidia-smi probe, ``cli.py:77``): libtpu/env runtime facts first
+    (``probe_tpu_runtime``), then jax device enumeration with physical
+    mesh-shape discovery from device coords. Falls back to a CPU topology
+    when no accelerator is reachable. The result rides in worker
+    registration (``Worker.register`` → ``topology``) so schedulers see
+    generation, chip count, HBM, and mesh shape (VERDICT r2 next #10)."""
+    runtime = probe_tpu_runtime()
     try:
         import jax
 
         devices = jax.devices()
         kind = devices[0].device_kind.lower()
-        if "tpu" in kind or "v5" in kind or "v4" in kind or "v6" in kind:
-            chip = (
-                "v5p" if "v5p" in kind or "v5 pod" in kind
-                else "v5e" if "v5" in kind
-                else "v6e" if "v6" in kind
-                else "v4" if "v4" in kind
-                else "v5e"
-            )
-            hbm = {"v4": 32.0, "v5e": 16.0, "v5p": 95.0, "v6e": 32.0}[chip]
+        is_tpu = any(t in kind for t in ("tpu", "v4", "v5", "v6"))
+        if is_tpu:
+            chip = _gen_from_string(runtime["accelerator_type"] or kind)
+            hbm, ici, tflops = _TPU_GEN[chip]
+            # physical mesh from device coords (bounding box of the slice);
+            # fall back to a flat axis when coords are unavailable
+            try:
+                coords = [d.coords for d in devices]
+                dims = tuple(
+                    max(c[i] for c in coords) - min(c[i] for c in coords) + 1
+                    for i in range(len(coords[0]))
+                )
+                dims = tuple(d for d in dims if d > 1) or (len(devices),)
+                if int(np.prod(dims)) != len(devices):
+                    dims = (len(devices),)
+            except Exception:
+                dims = (len(devices),)
             return TpuTopology(
                 chip_type=chip, num_chips=len(devices), hbm_gb_per_chip=hbm,
-                mesh_shape=(len(devices),), mesh_axis_names=("data",),
+                mesh_shape=dims,
+                mesh_axis_names=tuple(f"ici{i}" for i in range(len(dims)))
+                if len(dims) > 1 else ("data",),
+                ici_bandwidth_gbps=ici, peak_bf16_tflops=tflops,
             )
         return TpuTopology(chip_type="cpu", num_chips=len(devices),
                            hbm_gb_per_chip=4.0, ici_bandwidth_gbps=10.0,
                            dcn_bandwidth_gbps=10.0, peak_bf16_tflops=0.2)
-    except Exception:  # pragma: no cover - jax always importable in-repo
+    except Exception:
+        # no jax backend — if the runtime probe still smells TPU hardware,
+        # report what the environment declares instead of "cpu" (a worker
+        # with a broken driver should register as a TPU host needing repair)
+        if runtime["libtpu"] and runtime["accelerator_type"]:
+            chip = _gen_from_string(runtime["accelerator_type"])
+            hbm, ici, tflops = _TPU_GEN[chip]
+            import re as _re
+
+            m = _re.search(r"-(\d+)$", runtime["accelerator_type"])
+            chips = int(m.group(1)) if m else 1
+            return TpuTopology(
+                chip_type=chip, num_chips=chips, hbm_gb_per_chip=hbm,
+                mesh_shape=(chips,), ici_bandwidth_gbps=ici,
+                peak_bf16_tflops=tflops,
+            )
         return TpuTopology(chip_type="cpu", num_chips=1, hbm_gb_per_chip=4.0)
 
 

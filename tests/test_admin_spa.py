@@ -1,0 +1,91 @@
+"""Admin SPA security invariants (server/static/admin.html).
+
+Two XSS classes were found and fixed across round-2 commits (700ff72,
+f487300): entity-escaped values inside inline event-handler attributes
+(attribute decoding undoes the escaping before the JS runs), and
+un-URL-encoded client-supplied ids interpolated into request paths. No
+browser runs in CI, so these are STRUCTURAL regressions over the source:
+they fail on reintroduction of either class (VERDICT r2 next #8).
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+SPA = (
+    Path(__file__).parent.parent
+    / "distributed_gpu_inference_tpu" / "server" / "static" / "admin.html"
+).read_text()
+
+
+def test_esc_escapes_all_five_metacharacters():
+    m = re.search(r"function esc\(s\)\s*{(.*?)}", SPA, re.S)
+    assert m, "esc() helper missing"
+    body = m.group(0)
+    # the replacement map must cover & < > " '
+    for ch in ["&", "<", ">", '"', "'"]:
+        assert ch in body, f"esc() no longer handles {ch!r}"
+    assert "&amp;" in body and "&lt;" in body and "&#39;" in body or "&#x27;" in body
+
+
+def test_no_inline_event_handlers():
+    """XSS class 1: onclick="...${esc(id)}..." — attribute decoding undoes
+    entity escaping before evaluation. All actions must go through
+    delegated listeners on data-act/data-id attributes."""
+    assert not re.search(r"\son[a-z]+\s*=", SPA, re.I), (
+        "inline event handler found — use delegated data-act listeners"
+    )
+
+
+def test_delegated_action_wiring_present():
+    # the replacement mechanism for inline handlers must still exist
+    assert 'data-act' in SPA
+    assert re.search(r"addEventListener\(\s*['\"]click['\"]", SPA)
+
+
+def test_every_url_path_interpolation_is_encoded():
+    """XSS/robustness class 2: ids interpolated into request paths must be
+    encodeURIComponent'd (ADVICE r2: genBill missed it)."""
+    # template-literal URL paths passed to call("METHOD", `...${expr}...`)
+    for m in re.finditer(r"call\(\s*\"[A-Z]+\",\s*`([^`]*)`", SPA):
+        path = m.group(1)
+        for expr in re.findall(r"\$\{([^}]*)\}", path):
+            assert expr.strip().startswith("encodeURIComponent("), (
+                f"unencoded path interpolation: ${{{expr}}} in {path!r}"
+            )
+
+
+def test_attribute_interpolations_escaped():
+    """Every ${...} inside an HTML attribute in a template literal must run
+    through esc() (ids are client-supplied). querySelector templates are
+    CSS-selector context, not HTML — CSS.escape() is correct THERE and only
+    there, so those spans are excluded from the scan."""
+    spa = re.sub(r"querySelector\(`[^`]*`\)", "", SPA)
+    offenders = []
+    for attr, expr in re.findall(
+        r"(data-id|data-ent|data-act|title|class)=\"[^\"]*\$\{([^}]*)\}",
+        spa,
+    ):
+        e = expr.strip()
+        if e.startswith("esc(") or e.startswith("encodeURIComponent("):
+            continue
+        # boolean-ternary of string literals is statically safe
+        if re.match(r"^[\w.$]+\s*\?\s*\"[\w -]*\"\s*:\s*\"[\w -]*\"$", e):
+            continue
+        offenders.append((attr, e))
+    assert not offenders, f"unescaped attribute interpolations: {offenders}"
+
+
+def test_text_interpolations_of_server_fields_escaped():
+    """Spot-check: object-field interpolations rendered as element text go
+    through esc()/formatters — a raw ${w.name}-style hole is the classic
+    stored-XSS regression."""
+    allowed = ("esc(", "fmtTs(", "fmtBytes(", "Number(", "JSON.stringify(",
+               "encodeURIComponent(")
+    offenders = []
+    for expr in re.findall(r">\s*\$\{([^}]*)\}\s*<", SPA):
+        e = expr.strip()
+        if re.match(r"^[a-zA-Z_$][\w$]*\.[\w$]+$", e):  # bare obj.field
+            offenders.append(e)
+    assert not offenders, f"raw object-field text interpolations: {offenders}"

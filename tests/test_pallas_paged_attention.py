@@ -246,3 +246,70 @@ def test_fused_write_respects_window():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref_out), rtol=2e-2, atol=2e-2
     )
+
+
+def test_fp8_pools_parity():
+    """fp8 KV pools (kv_cache_dtype="fp8"): kernel upcasts pages to bf16 in
+    VMEM; parity vs the XLA path over the SAME fp8-rounded values."""
+    q, k_pool, v_pool, tables, positions, lens = _setup(
+        2, [33, 60], nh=4, hkv=2, d=64, block=16, m=4
+    )
+    q = q.astype(jnp.bfloat16)
+    k_pool = k_pool.astype(jnp.float8_e4m3fn)
+    v_pool = v_pool.astype(jnp.float8_e4m3fn)
+    want = paged_attention_xla(q, k_pool, v_pool, tables, positions, lens, 16)
+    got = paged_attention_pallas(q, k_pool, v_pool, tables, positions, lens,
+                                 16, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_fused_write_fp8_pools():
+    """Fused write+attention with fp8 pools: new rows are cast to fp8 before
+    the kernel (models/llama._layer_step does this); the written layer must
+    match the XLA scatter of the same fp8 rows and attention must agree."""
+    import numpy as np
+
+    from distributed_gpu_inference_tpu.models.llama import _write_kv_pages
+    from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
+    from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_fused,
+    )
+
+    b, hkv, qpk, d, bs, m, L, nblocks = 2, 2, 2, 128, 16, 4, 2, 20
+    q, new_k, new_v, k_pool, v_pool, tables, positions, lens_a = \
+        _mk_fused_case(7, b, hkv, qpk, d, bs, m, L, nblocks, [33, 5])
+    fp8 = jnp.float8_e4m3fn
+    k_pool8 = jnp.asarray(k_pool).astype(fp8)
+    v_pool8 = jnp.asarray(v_pool).astype(fp8)
+    nk8 = jnp.asarray(new_k).astype(fp8)
+    nv8 = jnp.asarray(new_v).astype(fp8)
+
+    out, k2, v2 = paged_decode_attention_fused(
+        jnp.asarray(q, jnp.bfloat16), nk8, nv8,
+        k_pool8, v_pool8, jnp.int32(1),
+        jnp.asarray(tables), jnp.asarray(positions), jnp.asarray(lens_a),
+        block_size=bs, interpret=True,
+    )
+    ref_k = _write_kv_pages(
+        k_pool8[1], nk8, jnp.asarray(tables), jnp.asarray(positions), bs
+    )
+    ref_v = _write_kv_pages(
+        v_pool8[1], nv8, jnp.asarray(tables), jnp.asarray(positions), bs
+    )
+    ref_out = paged_attention_xla(
+        jnp.asarray(q, jnp.bfloat16), ref_k, ref_v, jnp.asarray(tables),
+        jnp.asarray(positions), jnp.asarray(lens_a), block_size=bs,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_out, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(k2[1], np.float32), np.asarray(ref_k, np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(v2[1], np.float32), np.asarray(ref_v, np.float32)
+    )

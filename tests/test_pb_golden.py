@@ -1,0 +1,114 @@
+"""comm/pb.py vs protoc: committed golden vectors.
+
+``tests/golden/pb_golden.json`` was produced by the REAL protoc + python
+protobuf runtime from ``proto/inference.proto`` (``scripts/gen_pb_golden.py``)
+— edge values included (negative int32/int64, all byte values, unicode,
+empty messages, unset optional submessages). If the hand-written codec and
+protoc ever disagree on any IDL message, these fail (VERDICT r2 next #7).
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from distributed_gpu_inference_tpu.comm import pb
+
+GOLDEN = json.loads(
+    (Path(__file__).parent / "golden" / "pb_golden.json").read_text()
+)
+
+SPECS = {
+    "CreateSessionRequest": pb.CREATE_SESSION_REQUEST,
+    "CreateSessionResponse": pb.CREATE_SESSION_RESPONSE,
+    "ForwardRequest": pb.FORWARD_REQUEST,
+    "ForwardResponse": pb.FORWARD_RESPONSE,
+    "TransferKVRequest": pb.TRANSFER_KV_REQUEST,
+    "TransferKVResponse": pb.TRANSFER_KV_RESPONSE,
+    "CloseSessionRequest": pb.CLOSE_SESSION_REQUEST,
+    "CloseSessionResponse": pb.CLOSE_SESSION_RESPONSE,
+    "HealthRequest": pb.HEALTH_REQUEST,
+    "HealthResponse": pb.HEALTH_RESPONSE,
+}
+
+
+def _thaw(v):
+    if isinstance(v, dict) and "__bytes__" in v:
+        return bytes.fromhex(v["__bytes__"])
+    if isinstance(v, dict):
+        return {k: _thaw(x) for k, x in v.items()}
+    return v
+
+
+def _defaults(spec):
+    out = {}
+    for _, (name, kind) in spec.items():
+        if kind == "string":
+            out[name] = ""
+        elif kind == "bytes":
+            out[name] = b""
+        elif kind == "varint":
+            out[name] = 0
+        elif kind == "bool":
+            out[name] = False
+        else:
+            out[name] = None
+    return out
+
+
+def _expected_decoded(spec, fields):
+    out = _defaults(spec)
+    by_name = {name: kind for _, (name, kind) in spec.items()}
+    for k, v in fields.items():
+        kind = by_name[k]
+        if isinstance(kind, tuple) and kind[0] == "msg":
+            out[k] = {**_defaults(kind[1]), **v}
+        else:
+            out[k] = v
+    return out
+
+
+@pytest.mark.parametrize("case", GOLDEN, ids=[c["name"] for c in GOLDEN])
+def test_encode_matches_protoc(case):
+    spec = SPECS[case["message"]]
+    fields = {k: _thaw(v) for k, v in case["fields"].items()}
+    assert pb.encode(spec, fields).hex() == case["hex"]
+
+
+@pytest.mark.parametrize("case", GOLDEN, ids=[c["name"] for c in GOLDEN])
+def test_decode_matches_protoc(case):
+    spec = SPECS[case["message"]]
+    fields = {k: _thaw(v) for k, v in case["fields"].items()}
+    got = pb.decode(spec, bytes.fromhex(case["hex"]))
+    assert got == _expected_decoded(spec, fields)
+
+
+def test_unknown_fields_skipped_forward_compat():
+    # protoc bytes for CreateSessionRequest + an unknown field 9 (string) and
+    # an unknown varint field 10 appended — a v2 peer talking to this codec
+    base = bytes.fromhex(
+        next(c for c in GOLDEN if c["name"] == "create_session_basic")["hex"]
+    )
+    unknown = bytes([9 << 3 | 2, 3]) + b"abc" + bytes([10 << 3 | 0, 42])
+    got = pb.decode(pb.CREATE_SESSION_REQUEST, base + unknown)
+    assert got["session_id"] == "sess-1"
+
+
+def test_packed_repeated_on_scalar_field_is_guarded():
+    # if the IDL ever grows `repeated int32` on an existing varint field,
+    # protoc packs it as wire type 2 — the codec must refuse loudly, not
+    # decode garbage (explicit guard until packed support lands)
+    packed = bytes([2 << 3 | 2, 2, 1, 2])  # field 2 (kv_len_after), packed
+    with pytest.raises(ValueError, match="length-delimited"):
+        pb.decode(pb.FORWARD_REQUEST, packed)
+
+
+def test_unknown_packed_repeated_field_skips():
+    # packed repeated on an UNKNOWN field number is just an unknown
+    # length-delimited field: skipped fine
+    base = bytes.fromhex(
+        next(c for c in GOLDEN if c["name"] == "close_resp")["hex"]
+    )
+    packed = bytes([12 << 3 | 2, 3, 1, 2, 3])
+    got = pb.decode(pb.CLOSE_SESSION_RESPONSE, base + packed)
+    assert got["status"] == "closed"

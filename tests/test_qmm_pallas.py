@@ -1,0 +1,135 @@
+"""Parity tests for the Pallas VMEM-dequant matmul (ops/qmm_pallas.py).
+
+CPU runs the kernel in interpret mode against the XLA convert-on-read
+reference (ops/quantization.matmul) — same contract the paged-attention
+kernel's parity tests use.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.ops.qmm_pallas import (
+    pick_tiles,
+    qmm_stacked_pallas,
+)
+from distributed_gpu_inference_tpu.ops.quantization import (
+    matmul,
+    matmul_stacked,
+    quantize_weight,
+    split_stacked_quant,
+)
+
+
+def _stacked_quant(key, l, k, n, mode="int8"):
+    w = jax.random.normal(key, (l, k, n), jnp.float32) * 0.05
+    return quantize_weight(w, mode), w
+
+
+@pytest.mark.parametrize("m", [1, 16, 32, 100])
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_qmm_parity_rows(m, dtype):
+    key = jax.random.PRNGKey(0)
+    qw, _ = _stacked_quant(key, 1, 256, 256)
+    x = (jax.random.normal(jax.random.PRNGKey(1), (m, 256)) * 0.1).astype(dtype)
+    got = qmm_stacked_pallas(
+        x, qw["qw"], qw["scale"], jnp.int32(0), interpret=True
+    )
+    want = matmul(x, {"qw": qw["qw"][0], "scale": qw["scale"][0]})
+    assert got.shape == (m, 256)
+    assert got.dtype == x.dtype
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def test_qmm_layer_index_selects_layer():
+    key = jax.random.PRNGKey(2)
+    qw, _ = _stacked_quant(key, 3, 128, 128)
+    x = jnp.asarray(
+        jax.random.normal(jax.random.PRNGKey(3), (16, 128)) * 0.1, jnp.bfloat16
+    )
+    for idx in range(3):
+        got = qmm_stacked_pallas(
+            x, qw["qw"], qw["scale"], jnp.int32(idx), interpret=True
+        )
+        want = matmul(
+            x, {"qw": qw["qw"][idx], "scale": qw["scale"][idx]}
+        )
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+def test_qmm_multi_k_tiles_accumulate():
+    # K = 512 with BK=512 single tile vs K=2048 (BK=2048): exercise the
+    # accumulator by using a K that forces multiple tiles relative to the
+    # menu — 2048+256 isn't tileable, so use K=2560 (BK=512, 5 tiles)
+    key = jax.random.PRNGKey(4)
+    qw, _ = _stacked_quant(key, 1, 2560, 128)
+    assert pick_tiles(2560, 128) == (512, 128)
+    x = jnp.asarray(
+        jax.random.normal(jax.random.PRNGKey(5), (8, 2560)) * 0.05,
+        jnp.bfloat16,
+    )
+    got = qmm_stacked_pallas(
+        x, qw["qw"], qw["scale"], jnp.int32(0), interpret=True
+    )
+    want = matmul(x, {"qw": qw["qw"][0], "scale": qw["scale"][0]})
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=3e-2, atol=3e-2,
+    )
+
+
+def test_qmm_fp8_storage():
+    key = jax.random.PRNGKey(6)
+    qw, _ = _stacked_quant(key, 1, 128, 128, mode="fp8")
+    x = jnp.asarray(
+        jax.random.normal(jax.random.PRNGKey(7), (16, 128)) * 0.1, jnp.bfloat16
+    )
+    got = qmm_stacked_pallas(
+        x, qw["qw"], qw["scale"], jnp.int32(0), interpret=True
+    )
+    want = matmul(x, {"qw": qw["qw"][0], "scale": qw["scale"][0]})
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=4e-2, atol=4e-2,
+    )
+
+
+def test_pick_tiles_untileable():
+    assert pick_tiles(100, 256) is None
+    assert pick_tiles(256, 100) is None
+    assert pick_tiles(14336, 4096) == (2048, 512)
+
+
+def test_split_stacked_quant_partition():
+    key = jax.random.PRNGKey(8)
+    layers = {
+        "attn_norm": jnp.ones((2, 8)),
+        "wq": quantize_weight(
+            jax.random.normal(key, (2, 8, 8)), "int8"
+        ),
+        "wo": jax.random.normal(key, (2, 8, 8)),  # NOT quantized → scanned
+    }
+    scanned, stacked = split_stacked_quant(layers)
+    assert set(stacked) == {"wq"}
+    assert set(scanned) == {"attn_norm", "wo"}
+    # nothing quantized → identity, no split
+    s2, st2 = split_stacked_quant({"attn_norm": layers["attn_norm"]})
+    assert st2 is None and set(s2) == {"attn_norm"}
+
+
+def test_matmul_stacked_xla_fallback_matches():
+    # on CPU the pallas gate is off: matmul_stacked must slice + match the
+    # plain path bit-for-bit
+    key = jax.random.PRNGKey(9)
+    qw, _ = _stacked_quant(key, 4, 64, 48)  # untileable on purpose
+    x = jax.random.normal(jax.random.PRNGKey(10), (3, 5, 64))
+    got = matmul_stacked(x, qw, jnp.int32(2))
+    want = matmul(x, {"qw": qw["qw"][2], "scale": qw["scale"][2]})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)

@@ -244,3 +244,52 @@ def test_subwave_interleave_advances_existing_slots():
     ))
     solo = ref.generate([_req(list(range(30, 50)), max_new=24)])[0]
     assert resp0.token_ids == solo.token_ids
+
+
+def test_fp8_kv_cache_serves():
+    """kv_cache_dtype="fp8": pools store float8_e4m3, generation still works
+    and is deterministic; spill round-trips keep the fp8 dtype."""
+    import jax.numpy as jnp
+
+    cfg = EngineConfig(
+        max_batch_size=2, max_seq_len=64, block_size=16,
+        prefill_buckets=(16, 32), multi_step=4, kv_cache_dtype="fp8",
+    )
+    e = TPUEngine("llama3-tiny", cfg)
+    assert e.kv["k"].dtype == jnp.float8_e4m3fn
+    assert e.kv["v"].dtype == jnp.float8_e4m3fn
+    p = list(range(10, 26))
+    r1 = e.generate([_req(p)])[0]
+    r2 = e.generate([_req(p)])[0]
+    assert r1.token_ids == r2.token_ids
+    assert r1.completion_tokens == 8
+    assert all(0 <= t < e.model_cfg.vocab_size for t in r1.token_ids)
+
+
+def test_fp8_kv_outputs_close_to_bf16_kv():
+    """fp8 KV is a rounding of the same cache values: greedy outputs on a
+    short prompt should agree with the bf16-KV engine (tiny model, short
+    horizon — divergence would mean a plumbing bug, not rounding)."""
+    base = EngineConfig(
+        max_batch_size=2, max_seq_len=64, block_size=16,
+        prefill_buckets=(16,), multi_step=4,
+    )
+    fp8 = EngineConfig(
+        max_batch_size=2, max_seq_len=64, block_size=16,
+        prefill_buckets=(16,), multi_step=4, kv_cache_dtype="fp8",
+    )
+    e_bf16 = TPUEngine("llama3-tiny", base, seed=3)
+    e_fp8 = TPUEngine("llama3-tiny", fp8, seed=3)
+    p = list(range(30, 44))
+    t_bf16 = e_bf16.generate([_req(p, max_new=4)])[0].token_ids
+    t_fp8 = e_fp8.generate([_req(p, max_new=4)])[0].token_ids
+    assert t_fp8[0] == t_bf16[0]  # first token: same prefill numerics
+
+
+def test_bad_kv_dtype_rejected():
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        TPUEngine(
+            "llama3-tiny",
+            EngineConfig(max_batch_size=1, max_seq_len=32,
+                         kv_cache_dtype="int4"),
+        )

@@ -419,3 +419,72 @@ def test_claim_scans_past_region_restricted_head():
         s.close()
 
     run(body())
+
+
+# -- schema migrations (PRAGMA user_version runner) -------------------------
+
+
+def test_fresh_db_lands_at_schema_version(tmp_path):
+    from distributed_gpu_inference_tpu.server import store as store_mod
+
+    s = Store(str(tmp_path / "fresh.sqlite"))
+    ver = s._conn.execute("PRAGMA user_version").fetchone()[0]
+    assert ver == store_mod.SCHEMA_VERSION
+    # v2 column exists on a fresh db too (fresh files replay migrations)
+    cols = [r[1] for r in s._conn.execute("PRAGMA table_info(jobs)")]
+    assert "enterprise_id" in cols
+    s.close()
+
+
+def test_migrates_legacy_v1_file_in_place(tmp_path):
+    import sqlite3
+
+    from distributed_gpu_inference_tpu.server import store as store_mod
+
+    path = str(tmp_path / "legacy.sqlite")
+    # a legacy pre-versioning database: v1 tables, user_version 0, plus a row
+    conn = sqlite3.connect(path)
+    conn.executescript(store_mod._SCHEMA)
+    conn.execute(
+        "INSERT INTO jobs (id, type, created_at) VALUES ('j1', 'llm', 1.0)"
+    )
+    conn.commit()
+    assert conn.execute("PRAGMA user_version").fetchone()[0] == 0
+    conn.close()
+
+    s = Store(path)
+    assert (
+        s._conn.execute("PRAGMA user_version").fetchone()[0]
+        == store_mod.SCHEMA_VERSION
+    )
+    # old data survived, new column usable
+    s._conn.execute(
+        "UPDATE jobs SET enterprise_id='e1' WHERE id='j1'"
+    )
+    row = s._conn.execute(
+        "SELECT enterprise_id FROM jobs WHERE id='j1'"
+    ).fetchone()
+    assert row[0] == "e1"
+    s.close()
+
+
+def test_reopen_at_current_version_is_noop(tmp_path):
+    path = str(tmp_path / "db.sqlite")
+    s1 = Store(path)
+    run(s1.create_job({"id": "j1", "type": "llm"}))
+    s1.close()
+    s2 = Store(path)  # must not raise or re-apply
+    assert run(s2.get_job("j1"))["type"] == "llm"
+    s2.close()
+
+
+def test_newer_db_refused(tmp_path):
+    import sqlite3
+
+    path = str(tmp_path / "future.sqlite")
+    conn = sqlite3.connect(path)
+    conn.execute("PRAGMA user_version=9999")
+    conn.commit()
+    conn.close()
+    with pytest.raises(RuntimeError, match="newer"):
+        Store(path)

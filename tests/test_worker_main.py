@@ -381,3 +381,88 @@ def test_get_status_shape():
     assert st["state"] == "initializing"
     assert st["task_types"] == ["llm"]
     assert "topology" in st and "stats" in st
+
+
+# -- TPU-aware onboarding probe (faked environments) -------------------------
+
+
+def test_probe_tpu_runtime_reads_env(monkeypatch):
+    from distributed_gpu_inference_tpu.worker.main import probe_tpu_runtime
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1")
+    monkeypatch.setenv("TPU_LIBRARY_PATH", "/opt/libtpu.so")
+    r = probe_tpu_runtime()
+    assert r["libtpu"] is True
+    assert r["accelerator_type"] == "v5litepod-16"
+    assert r["worker_id"] == "3"
+    assert r["hosts"] == ["h0", "h1"]
+
+
+def test_probe_topology_mesh_from_coords(monkeypatch):
+    import distributed_gpu_inference_tpu.worker.main as wm
+
+    class FakeDev:
+        def __init__(self, coords):
+            self.device_kind = "TPU v5e"
+            self.coords = coords
+
+    class FakeJax:
+        @staticmethod
+        def devices():
+            # a 2x4 slice: coords span (2, 4, 1)
+            return [FakeDev((x, y, 0)) for x in range(2) for y in range(4)]
+
+    monkeypatch.setattr(wm, "probe_tpu_runtime", lambda: {
+        "libtpu": True, "accel_devices": [], "accelerator_type": "",
+        "worker_id": "", "hosts": [],
+    })
+    import sys
+    monkeypatch.setitem(sys.modules, "jax", FakeJax())
+    t = wm.probe_topology()
+    assert t.chip_type == "v5e"
+    assert t.num_chips == 8
+    assert t.mesh_shape == (2, 4)
+    assert t.peak_bf16_tflops == 197.0
+
+
+def test_probe_topology_env_fallback_without_jax(monkeypatch):
+    """Broken driver: jax raises, but libtpu + accelerator type declare a
+    TPU host — register what the platform says, not 'cpu'."""
+    import distributed_gpu_inference_tpu.worker.main as wm
+
+    class Boom:
+        def devices(self):
+            raise RuntimeError("no backend")
+
+        def __getattr__(self, k):
+            raise RuntimeError("no backend")
+
+    monkeypatch.setattr(wm, "probe_tpu_runtime", lambda: {
+        "libtpu": True, "accel_devices": ["/dev/accel0"],
+        "accelerator_type": "v5litepod-8", "worker_id": "", "hosts": [],
+    })
+    import sys
+    monkeypatch.setitem(sys.modules, "jax", Boom())
+    t = wm.probe_topology()
+    assert t.chip_type == "v5e"
+    assert t.num_chips == 8
+    assert t.hbm_gb_per_chip == 16.0
+
+
+def test_wizard_reports_runtime(monkeypatch):
+    from distributed_gpu_inference_tpu.worker.cli import ConfigWizard
+    import distributed_gpu_inference_tpu.worker.main as wm
+
+    monkeypatch.setattr(wm, "probe_tpu_runtime", lambda: {
+        "libtpu": True, "accel_devices": ["/dev/accel0"],
+        "accelerator_type": "v5litepod-4", "worker_id": "", "hosts": [],
+    })
+    lines = []
+    wiz = ConfigWizard(input_fn=lambda p: "", print_fn=lines.append)
+    cfg = wiz.run()
+    assert cfg is not None
+    joined = "\n".join(lines)
+    assert "libtpu=found" in joined
+    assert "type=v5litepod-4" in joined
