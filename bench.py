@@ -317,6 +317,8 @@ def run_spec(args) -> None:
         "--prompt-len", str(args.prompt_len),
         "--max-tokens", str(args.decode_tokens),
     ]
+    if args.spec_no_train:
+        argv.append("--no-train")
     old = sys.argv
     sys.argv = argv
     try:
@@ -344,6 +346,10 @@ def main() -> None:
                          "activation dtype)")
     ap.add_argument("--spec", action="store_true",
                     help="speculative-decoding benchmark instead")
+    ap.add_argument("--spec-no-train", action="store_true",
+                    help="spec bench: skip target training (random target, "
+                         "distilled draft) — for chips where 1B+ f32 "
+                         "training kernel-faults")
     args = ap.parse_args()
     _enable_compile_cache()
     if args.spec:

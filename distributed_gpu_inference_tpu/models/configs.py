@@ -115,6 +115,28 @@ MODEL_REGISTRY: Dict[str, ModelConfig] = {
         head_dim=64, tie_word_embeddings=True,
         max_position_embeddings=131072,
     ),
+    # llama3-1b body with a bench-sized vocab: the speculative harness must
+    # TRAIN its target for real accept rates (benchmarks/speculative.py),
+    # and f32 training with a 128k-vocab logits tensor kernel-faults the
+    # tunneled chip (observed rounds 2-3, llama3-1b AND qwen2.5-0.5b).
+    # Same per-token transformer compute as llama3-1b; only the LM head
+    # shrinks. num_params ~1.0B.
+    "llama3-1b-bench": _llama(
+        "llama3-1b-bench", vocab_size=8192, hidden_size=2048, num_layers=16,
+        num_heads=16, num_kv_heads=8, intermediate_size=8192,
+        head_dim=128, tie_word_embeddings=True,
+        max_position_embeddings=8192,
+    ),
+    # ~200M sibling: the largest scale the tunnel chip trains without
+    # kernel-faulting (1B-bench, llama3-1b, and qwen2.5-0.5b all crash the
+    # TPU worker process during f32 training) — the biggest TRAINED
+    # speculative-decoding measurement point available in this environment
+    "llama3-200m-bench": _llama(
+        "llama3-200m-bench", vocab_size=8192, hidden_size=1024,
+        num_layers=12, num_heads=8, num_kv_heads=4, intermediate_size=4096,
+        head_dim=128, tie_word_embeddings=True,
+        max_position_embeddings=8192,
+    ),
     # Llama 3.2 3B geometry
     "llama3-3b": _llama(
         "llama3-3b", vocab_size=128256, hidden_size=3072, num_layers=28,

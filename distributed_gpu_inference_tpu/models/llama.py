@@ -290,6 +290,7 @@ def _layer_step(
     fused_decode: bool = False,   # S=1 TPU path: one kernel writes + attends
     kv_lens: Optional[jax.Array] = None,  # required when fused_decode
     stacked: Optional[Dict[str, Any]] = None,  # quantized weights kept whole
+    dense_attn_fn=None,           # (q, k, v dense chunk) → attn; see below
 ) -> Tuple[Tuple[jax.Array, jax.Array, jax.Array, jax.Array], None]:
     """One transformer layer over paged KV — shared by the causal decode path
     and the speculative tree-verify path (they differ only in the attention
@@ -305,7 +306,15 @@ def _layer_step(
     ``stacked`` holds quantized matmul weights with their layer axis intact
     (``split_stacked_quant``): projections then run through the Pallas
     VMEM-dequant kernel addressed by ``layer_idx``, so no per-layer weight
-    slice is ever materialized for the custom call."""
+    slice is ever materialized for the custom call.
+
+    ``dense_attn_fn`` routes attention over this chunk's DENSE K/V instead
+    of the paged pools — valid exactly when the chunk IS the whole context
+    (a from-scratch prefill with no cached prefix). This is the
+    sequence-parallel entry: the engine passes ring/Ulysses attention
+    (``parallel/ring_attention.py``) here so a long prompt's attention
+    spreads over the ``seq`` mesh axis while KV pages still land in the
+    same paged pools decode reads (SURVEY §5.7)."""
     hidden, k_pool, v_pool, layer_idx = carry
     b, s, _ = hidden.shape
     nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -347,7 +356,12 @@ def _layer_step(
         layer_v = _write_kv_pages(layer_v, v, block_tables, write_positions, block_size)
         k_pool = lax.dynamic_update_index_in_dim(k_pool, layer_k, layer_idx, 0)
         v_pool = lax.dynamic_update_index_in_dim(v_pool, layer_v, layer_idx, 0)
-        attn = attn_fn(q, layer_k, layer_v)
+        if dense_attn_fn is not None:
+            # pages written above for decode; attention itself runs over the
+            # chunk's dense K/V (== whole context for a from-scratch prefill)
+            attn = dense_attn_fn(q, k, v)
+        else:
+            attn = attn_fn(q, layer_k, layer_v)
 
     hidden = hidden + proj(attn.reshape(b, s, nh * d), "wo").astype(hidden.dtype)
     mlp_in = rms_norm(hidden, lp["mlp_norm"], cfg.rms_norm_eps, cfg.norm_offset)
@@ -370,6 +384,7 @@ def forward_chunk(
     block_size: int = 16,
     last_only: bool = True,
     with_logits: bool = True,
+    dense_attn_fn=None,
 ) -> ChunkOutput:
     """Run S tokens per sequence through all layers against the paged cache.
 
@@ -403,9 +418,13 @@ def forward_chunk(
         cos=cos,
         sin=sin,
         attn_fn=attn_fn,
-        fused_decode=_use_fused_decode(cfg, s, block_tables, block_size),
+        fused_decode=(
+            _use_fused_decode(cfg, s, block_tables, block_size)
+            and dense_attn_fn is None
+        ),
         kv_lens=kv_lens,
         stacked=stacked,
+        dense_attn_fn=dense_attn_fn,
     )
     (hidden, k_pool, v_pool, _), _ = lax.scan(
         lambda c, lp: step(c, lp),

@@ -119,6 +119,12 @@ class EngineConfig:
     # tokens between chunks instead of stalling for the whole admission.
     # 0 = no interleave (pure TTFT staggering).
     admission_interleave_steps: int = 0
+    # long-context prefill strategy on a mesh with a ``seq`` axis: a fresh
+    # prompt longer than the largest prefill bucket runs ONE seq-sharded
+    # pass (ring or ulysses attention over the seq axis,
+    # parallel/ring_attention.py) instead of single-chip chunking; KV pages
+    # land in the same paged pools decode reads (SURVEY §5.7)
+    seq_parallel_impl: str = "ring"   # ring | ulysses
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -206,9 +212,11 @@ class TPUEngine:
                 f"block_size % 32 == 0 on TPU, got {self.cfg.block_size}"
             )
         self.mesh = mesh
+        self._seq_axis = 1
         if mesh is not None:
             sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
             tp = sizes.get("model", 1)
+            self._seq_axis = sizes.get("seq", 1)
             if sizes.get("data", 1) > 1:
                 raise ValueError(
                     "engine mesh must not carry a data axis (DP is "
@@ -528,6 +536,37 @@ class TPUEngine:
 
         self._prefill_chunk_fn = jax.jit(
             prefill_chunk, static_argnames=("mode", "sample"),
+            donate_argnums=(1,),
+        )
+
+        def prefill_seq_parallel(params, kv, toks_pos, table, kv_len, keys,
+                                 temps, top_ks, top_ps, mode):
+            # one seq-sharded pass over the WHOLE long prompt: attention
+            # runs ring/ulysses over the mesh's seq axis; KV pages are
+            # written to the paged pools exactly as chunked prefill would
+            from distributed_gpu_inference_tpu.parallel import ring_attention
+
+            dense = (
+                ring_attention.ring_self_attention
+                if self.cfg.seq_parallel_impl == "ring"
+                else ring_attention.ulysses_self_attention
+            )
+
+            def dense_attn(q, k_, v_):
+                return dense(q, k_, v_, kv_len, self.mesh)
+
+            out = llama.forward_chunk(
+                cfg, params, toks_pos[0], toks_pos[1], kv, table, kv_len,
+                block_size=bs, last_only=True, dense_attn_fn=dense_attn,
+            )
+            first = sample_mode(
+                out.logits[:, 0, :], keys, kv_len, temps, top_ks, top_ps,
+                mode,
+            )
+            return first, out.kv
+
+        self._prefill_seq_fn = jax.jit(
+            prefill_seq_parallel, static_argnames=("mode",),
             donate_argnums=(1,),
         )
 
@@ -988,6 +1027,16 @@ class TPUEngine:
         max_bucket = self.cfg.prefill_buckets[-1]
         off = cached
         mode = "greedy" if request.sampling.temperature <= 0 else "mixed"
+        if (
+            self._seq_axis > 1
+            and cached == 0
+            and len(fresh) > max_bucket
+        ):
+            # sequence-parallel long-context prefill (mesh seq axis)
+            first = self._prefill_seq_parallel(slot, fresh, mode)
+            tok = int(np.asarray(first)[0])
+            self._record_token(slot, tok)
+            return slot
         first = None
         while True:
             piece = fresh[: max_bucket]
@@ -1001,6 +1050,36 @@ class TPUEngine:
         tok = int(np.asarray(first)[0])
         self._record_token(slot, tok)
         return slot
+
+    def _prefill_seq_parallel(self, slot: int, fresh: List[int], mode: str):
+        """Whole-prompt seq-sharded prefill (mesh ``seq`` axis): ring/ulysses
+        attention spreads the S² work over the axis; KV pages land in the
+        same paged pools decode reads. Pad length buckets to multiples of
+        (seq_axis x block_size) so long prompts compile per bucket, not per
+        length."""
+        n = len(fresh)
+        step = self._seq_axis * max(self.cfg.block_size, 16)
+        padded = -(-n // step) * step
+        toks_pos = np.zeros((2, 1, padded), np.int32)
+        toks_pos[1] = -1
+        toks_pos[0, 0, :n] = fresh
+        toks_pos[1, 0, :n] = np.arange(n)
+        first, self.kv = self._prefill_seq_fn(
+            self.params, self.kv, toks_pos,
+            self._block_tables[slot : slot + 1],
+            np.asarray([n], np.int32),
+            self._slot_keys[slot : slot + 1],
+            self._temps[slot : slot + 1],
+            self._top_ks[slot : slot + 1],
+            self._top_ps[slot : slot + 1],
+            mode,
+        )
+        self.stats["prefill_tokens"] += n
+        self.stats["prefill_calls"] += 1
+        self.stats["seq_parallel_prefills"] = (
+            self.stats.get("seq_parallel_prefills", 0) + 1
+        )
+        return first
 
     def _prefill_one_chunk(self, slot: int, piece: List[int], off: int,
                            is_last: bool, mode: str):

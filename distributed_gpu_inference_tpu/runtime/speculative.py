@@ -241,6 +241,17 @@ def distill_draft_params(
     dp = jax.tree.map(
         lambda a: a.astype(jnp.float32), init_draft_params(cfg, kd)
     )
+    # draft_apply's output rms_norm pins the prediction's magnitude at the
+    # norm gain — initialized at 1, while a TIED-embedding target's hiddens
+    # must be large (its head rows stay near unit norm, so logit sharpness
+    # lives in |h|; an untied lm_head absorbs the magnitude instead). A
+    # unit-gain draft starts with a magnitude floor the optimizer must climb
+    # ~|h|x to escape — measured round 3: tied mini accepted 1/732 vs the
+    # untied 23/492 purely from this. Initialize the gain at the teacher's
+    # hidden RMS so the draft starts on the teacher's scale for ANY head
+    # convention.
+    teacher_rms = jnp.sqrt(jnp.mean(jnp.square(hiddens)))
+    dp["norm"] = dp["norm"] * teacher_rms
     opt = optax.adam(lr)
     opt_state = opt.init(dp)
     cfg32 = cfg  # rms eps etc. unchanged; draft_apply respects input dtype
@@ -428,12 +439,16 @@ class SpeculativeDecoder:
             # ---- draft phase: grow the tree level by level (static shapes)
             tokens = jnp.zeros((b, n), jnp.int32).at[:, 0].set(pending)
             h_root = draft_apply(cfg, dp, h_last, emb_of(pending))
-            head = params.get("lm_head", params["embedding"]).astype(jnp.float32)
             frontier_h = h_root[:, None, :]           # [B, F, H]
             for li, w in enumerate(widths):
-                logits = jnp.einsum(
-                    "bfh,vh->bfv", frontier_h.astype(jnp.float32), head
-                )
+                # draft logits MUST go through project_logits (final_norm +
+                # head) — the distillation CE trains the draft against
+                # exactly that readout (distill_draft_params loss_fn), and a
+                # raw frontier_h @ head readout diverges from it badly
+                # enough to zero the accept rate on tied-embedding models
+                # (round-3 probe: tied mini accepted 1/732 without the norm,
+                # 20x more with it)
+                logits = llama.project_logits(cfg, params, frontier_h)
                 _, cand = jax.lax.top_k(logits, w)    # [B, F, w]
                 start, end = level_slices[li]
                 tokens = tokens.at[:, start:end].set(cand.reshape(b, -1))

@@ -33,6 +33,7 @@ from .reliability import ReliabilityService
 from .scheduler import REGIONS, SmartScheduler, estimate_job_duration_s, region_distance
 from .security import LockoutState, SecurityService
 from .store import Store
+from .pd_flow import PDFlowError, PDFlowService
 from .task_guarantee import TaskGuaranteeBackgroundWorker, TaskGuaranteeService
 from .usage import UsageService
 from .privacy import EnterprisePrivacyService
@@ -60,6 +61,7 @@ class ServerState:
         self.geo = GeoService()
         self.worker_config = WorkerConfigService(self.store)
         self.usage = UsageService(self.store)
+        self.pd_flow = PDFlowService(self.store)
         self.privacy = EnterprisePrivacyService(self.store)
         self.metrics = MetricsCollector()
         self.tracing = TracingManager()
@@ -174,6 +176,7 @@ async def register_worker(request: web.Request) -> web.Response:
         "last_heartbeat": time.time(),
         "supports_direct": bool(body.get("supports_direct")),
         "direct_url": body.get("direct_url"),
+        "data_plane_url": body.get("data_plane_url"),
         **stored,
     }
     await st.store.upsert_worker(row)
@@ -308,9 +311,13 @@ async def complete_job(request: web.Request) -> web.Response:
         job["type"], "completed" if success else "failed",
         latency_s=(dur_ms or 0) / 1000.0,
     )
+    job2 = await st.store.get_job(job_id)
     if success:
-        job2 = await st.store.get_job(job_id)
         await st.usage.record_job_usage(job2, enterprise_id=None)
+    if job2 is not None and st.pd_flow.is_pd_child(job2):
+        # advance the PD flow (prefill done → enqueue pinned decode child;
+        # decode done → merge results into the parent container job)
+        await st.pd_flow.on_child_complete(job2)
     return web.json_response({"ok": True})
 
 
@@ -449,6 +456,25 @@ async def create_job(request: web.Request) -> web.Response:
     st = _state(request)
     body = await request.json()
     row = await _make_job_row(request, body)
+    if (row.get("params") or {}).get("pd_disaggregated"):
+        # PD container job: created RUNNING (never claimable); the flow
+        # service places prefill/decode and enqueues the pinned stage jobs
+        row["status"] = JobStatus.RUNNING.value
+        row["started_at"] = time.time()
+        job_id = await st.store.create_job(row)
+        job = await st.store.get_job(job_id)
+        try:
+            await st.pd_flow.submit(job)
+        except PDFlowError as exc:
+            await st.store.update_job(
+                job_id, status=JobStatus.FAILED.value, error=str(exc),
+                completed_at=time.time(),
+            )
+            return _json_error(503, str(exc))
+        st.metrics.record_request(row["type"], "queued")
+        return web.json_response(
+            {"job_id": job_id, "status": "running", "pd": True}, status=201
+        )
     job_id = await st.store.create_job(row)
     st.metrics.record_request(row["type"], "queued")
     return web.json_response({"job_id": job_id, "status": "queued"}, status=201)

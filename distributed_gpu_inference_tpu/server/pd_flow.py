@@ -1,0 +1,220 @@
+"""Control-plane orchestration of prefill/decode-disaggregated jobs.
+
+This is the wiring the reference never built: its ``pd_scheduler.py`` is a
+standalone service no API route consults (SURVEY C30 "standalone; not wired
+into C24/C25"), and its KV migration is a 50 ms sleep
+(``server/app/services/pd_scheduler.py:462-472``). Here a job submitted with
+``params.pd_disaggregated`` flows end to end through the REAL pieces:
+
+1. **Placement** — role-tagged registered workers (store ``role`` column,
+   ``WorkerRole`` in C1) are mirrored into :class:`PrefillDecodeScheduler`
+   capabilities (topology-derived TFLOPs/bandwidth) and the request is
+   placed on a prefill worker and a decode worker at submission.
+2. **Prefill stage** — a child job pinned to the prefill worker
+   (``params.target_worker``; the store's claim loop enforces the pin). The
+   worker's LLM engine prefills, samples the first token (TTFT), exports the
+   sequence's KV pages (``runtime/kv_handoff.py``), and POSTs the serialized
+   handoff DIRECTLY to the decode worker's data plane (``/kv/transfer``,
+   the HTTP twin of grpc TransferKVCache) — KV bytes never pass through the
+   control plane.
+3. **Decode stage** — a second child pinned to the decode worker, which
+   resumes the adopted slot and streams the rest of the generation
+   (bit-exact greedy continuation — the kv_handoff invariant).
+4. **Merge** — the parent job completes with the full token stream plus
+   end-to-end TTFT and real migration bytes/ms in the result.
+
+Parent jobs are created RUNNING (never claimable); children carry
+``pd_parent`` and the flow advances in the ``complete_job`` hook.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from ..utils.data_structures import TpuTopology, WorkerRole
+from .pd_scheduler import PDRequest, PrefillDecodeScheduler, WorkerCapability
+from .store import Store
+
+
+class PDFlowError(RuntimeError):
+    pass
+
+
+class PDFlowService:
+    """Drives pd-disaggregated jobs through prefill → handoff → decode."""
+
+    def __init__(self, store: Store,
+                 scheduler: Optional[PrefillDecodeScheduler] = None) -> None:
+        self.store = store
+        self.scheduler = scheduler or PrefillDecodeScheduler()
+        # request_id → PDRequest (placement state released on completion)
+        self._live: Dict[str, PDRequest] = {}
+        self.stats = {"submitted": 0, "completed": 0, "failed": 0}
+
+    # ---------------------------------------------------------------- sync
+
+    async def _sync_workers(self) -> None:
+        """Mirror role-tagged, live workers into scheduler capabilities."""
+        rows = await self.store.list_workers(status=("idle", "online", "busy"))
+        seen = set()
+        for w in rows:
+            if "llm" not in (w.get("supported_types") or []):
+                continue
+            topo = TpuTopology.from_dict(w["topology"]) if w.get("topology") \
+                else TpuTopology()
+            role = WorkerRole(w.get("role") or "hybrid")
+            cap = WorkerCapability.from_topology(w["id"], topo, role=role)
+            existing = self.scheduler.worker(w["id"])
+            if existing is not None:
+                # refresh the capability IN PLACE — register_worker would
+                # replace the pool entry and zero active_prefill/active_decode
+                # for live placements, unbinding the batch caps
+                existing.cap = cap
+            else:
+                self.scheduler.register_worker(cap)
+            seen.add(w["id"])
+        for wid in [w.cap.worker_id for w in
+                    self.scheduler._workers.values()]:
+            if wid not in seen:
+                self.scheduler.remove_worker(wid)
+
+    # -------------------------------------------------------------- submit
+
+    async def submit(self, parent: Dict[str, Any]) -> None:
+        """Place a pd job and enqueue its prefill child. Parent is already
+        stored with status=running (unclaimable container)."""
+        await self._sync_workers()
+        params = parent.get("params") or {}
+        prompt = params.get("prompt_token_ids") or params.get("prompt") or []
+        # token lists count exactly; raw text estimates ~4 chars/token so the
+        # scheduler's prefill scoring isn't skewed 4-5x by character counts
+        n_prompt = len(prompt) if isinstance(prompt, list) \
+            else max(1, len(prompt) // 4)
+        req = PDRequest(
+            request_id=parent["id"],
+            prompt_tokens=n_prompt,
+            max_new_tokens=int(params.get("max_tokens") or 256),
+            model_name=params.get("model") or "llama3-8b",
+        )
+        pw = self.scheduler.place_prefill(req)
+        if pw is None:
+            raise PDFlowError("no prefill-capable worker available")
+        # decode placed up front so the prefill worker knows where to push
+        # KV; kv_holder is the prefill worker once prefill lands
+        req.kv_holder = pw
+        dw = self.scheduler.place_decode(req)
+        if dw is None:
+            self.scheduler.release(req)
+            raise PDFlowError("no decode-capable worker available")
+        decode_row = await self.store.get_worker(dw)
+        decode_url = (decode_row or {}).get("data_plane_url")
+        if dw != pw and not decode_url:
+            self.scheduler.release(req)
+            raise PDFlowError(
+                f"decode worker {dw} advertises no data_plane_url for the "
+                "KV handoff"
+            )
+        req.kv_cache_key = f"pd-{parent['id']}-{uuid.uuid4().hex[:8]}"
+        self._live[parent["id"]] = req
+        self.stats["submitted"] += 1
+        child_params = {
+            **params,
+            "pd_stage": "prefill",
+            "pd_parent": parent["id"],
+            "target_worker": pw,
+            "decode_worker": dw,
+            "decode_url": decode_url,
+            "kv_cache_key": req.kv_cache_key,
+        }
+        await self.store.create_job({
+            "id": f"{parent['id']}-prefill",
+            "type": parent["type"],
+            "params": child_params,
+            "priority": int(parent.get("priority") or 0) + 5,
+            "timeout_seconds": parent.get("timeout_seconds") or 300.0,
+        })
+
+    # ------------------------------------------------------------ advance
+
+    def is_pd_child(self, job: Dict[str, Any]) -> bool:
+        p = job.get("params") or {}
+        return bool(p.get("pd_parent") and p.get("pd_stage"))
+
+    async def on_child_complete(self, child: Dict[str, Any]) -> None:
+        """Advance the flow when a pinned stage job finishes."""
+        params = child.get("params") or {}
+        parent_id = params["pd_parent"]
+        stage = params["pd_stage"]
+        parent = await self.store.get_job(parent_id)
+        if parent is None:
+            return
+        if child["status"] != "completed":
+            await self._fail(parent_id, stage,
+                             child.get("error") or f"{stage} stage failed")
+            return
+        result = child.get("result") or {}
+        if stage == "prefill":
+            decode_params = {
+                k: v for k, v in params.items()
+                if k not in ("pd_stage", "target_worker")
+            }
+            decode_params.update({
+                "pd_stage": "decode",
+                "target_worker": params["decode_worker"],
+                "kv_cache_key": params["kv_cache_key"],
+                # carried so the final merge needs no extra store round-trip
+                "pd_prefill_result": {
+                    "first_token": result.get("first_token"),
+                    "ttft_ms": result.get("ttft_ms"),
+                    "migration_bytes": result.get("migration_bytes"),
+                    "migration_ms": result.get("migration_ms"),
+                    "prefill_worker": child.get("worker_id"),
+                },
+            })
+            await self.store.create_job({
+                "id": f"{parent_id}-decode",
+                "type": parent["type"],
+                "params": decode_params,
+                "priority": int(parent.get("priority") or 0) + 5,
+                "timeout_seconds": parent.get("timeout_seconds") or 300.0,
+            })
+            return
+        # stage == "decode": merge and complete the parent
+        pre = params.get("pd_prefill_result") or {}
+        merged = {
+            **result,
+            "pd_disaggregated": True,
+            "prefill_worker": pre.get("prefill_worker"),
+            "decode_worker": child.get("worker_id"),
+            "ttft_ms": pre.get("ttft_ms", result.get("ttft_ms")),
+            "migration_bytes": pre.get("migration_bytes"),
+            "migration_ms": pre.get("migration_ms"),
+        }
+        now = time.time()
+        await self.store.update_job(
+            parent_id, status="completed", result=merged, completed_at=now,
+            actual_duration_ms=(
+                (now - float(parent["started_at"])) * 1000.0
+                if parent.get("started_at") else None
+            ),
+        )
+        self._finish(parent_id, ok=True)
+
+    async def _fail(self, parent_id: str, stage: str, error: str) -> None:
+        await self.store.update_job(
+            parent_id, status="failed",
+            error=f"pd {stage} stage: {error}", completed_at=time.time(),
+        )
+        self._finish(parent_id, ok=False)
+
+    def _finish(self, parent_id: str, ok: bool) -> None:
+        req = self._live.pop(parent_id, None)
+        if req is not None:
+            self.scheduler.release(req)
+        self.stats["completed" if ok else "failed"] += 1
+
+    def get_stats(self) -> Dict[str, Any]:
+        return {**self.stats, "live": len(self._live),
+                "scheduler": self.scheduler.get_stats()}

@@ -216,6 +216,26 @@ class PrefillDecodeScheduler:
 
     # -- assignment (reference :245-323) -------------------------------------
 
+    # -- direct placement (control-plane flow, server/pd_flow.py) ------------
+
+    def place_prefill(self, req: PDRequest) -> Optional[str]:
+        """Assign a prefill worker immediately (no queue wait) — the jobs-API
+        path (``server/pd_flow.py``) places at submission; the queued
+        ``submit_job``/``get_batch`` machinery serves pool-level batching."""
+        return self._assign_prefill(req)
+
+    def place_decode(self, req: PDRequest) -> Optional[str]:
+        """Assign a decode worker immediately (KV-affinity first)."""
+        return self._assign_decode(req)
+
+    def release(self, req: PDRequest) -> None:
+        """Return a placed request's worker slots (job finished or failed)."""
+        for wid, attr in ((req.prefill_worker, "active_prefill"),
+                          (req.decode_worker, "active_decode")):
+            w = self._workers.get(wid or "")
+            if w is not None and getattr(w, attr) > 0:
+                setattr(w, attr, getattr(w, attr) - 1)
+
     def _assign_prefill(self, req: PDRequest) -> Optional[str]:
         best, best_score = None, -1.0
         for w in self.prefill_workers:

@@ -191,6 +191,9 @@ _MIGRATIONS = [
     (2, "ALTER TABLE jobs ADD COLUMN enterprise_id TEXT"),
     (2, "CREATE INDEX IF NOT EXISTS idx_jobs_enterprise "
         "ON jobs (enterprise_id)"),
+    # v3: PD disaggregation — decode-capable workers advertise the data-plane
+    # URL prefill peers push KV handoffs to (server/pd_flow.py)
+    (3, "ALTER TABLE workers ADD COLUMN data_plane_url TEXT"),
 ]
 
 SCHEMA_VERSION = max(
@@ -444,6 +447,22 @@ class Store:
                         and not r["allow_cross_region"]
                     ):
                         continue
+                    # PD stage jobs are pinned to the worker holding (or
+                    # receiving) the KV — nobody else may claim them
+                    # (server/pd_flow.py sets target_worker). Substring
+                    # pre-check keeps the hot claim path from JSON-parsing
+                    # every candidate's (possibly multi-MB prompt-bearing)
+                    # params inside the write transaction.
+                    raw_params = r["params"] or "{}"
+                    if '"target_worker"' in raw_params:
+                        try:
+                            target = json.loads(raw_params).get(
+                                "target_worker"
+                            )
+                        except ValueError:
+                            target = None
+                        if target and target != worker_id:
+                            continue
                     pick = r
                     break
                 if pick is None:

@@ -98,6 +98,11 @@ class WorkerConfig(BaseModel):
     name: str = "tpu-worker"
     region: str = "us-central"
     task_types: List[str] = Field(default_factory=lambda: ["llm"])
+    # PD disaggregation role (reference pd_scheduler WorkerCapability roles):
+    # "prefill" | "decode" | "hybrid". Decode-capable workers should also set
+    # pd_data_plane_url so prefill peers can push KV handoffs to them.
+    role: str = "hybrid"
+    pd_data_plane_url: Optional[str] = None
     server: ServerConfig = Field(default_factory=ServerConfig)
     tpu: TpuConfig = Field(default_factory=TpuConfig)
     direct: DirectConfig = Field(default_factory=DirectConfig)

@@ -17,8 +17,11 @@ boxes — no network fetch, mirroring the reference's offline-test strategy).
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from typing import Any, Dict, List, Optional
+
+import httpx
 
 from ...runtime.engine import EngineConfig, TPUEngine
 from ...utils.data_structures import InferenceRequest, SamplingParams
@@ -85,6 +88,12 @@ class TPULLMEngine(LLMBaseEngine):
         self.engine: Optional[TPUEngine] = None
         self._spec = None            # EAGLE-style decoder (engine=jax-speculative)
         self.tokenizer = self.config.get("tokenizer")
+        # PD disaggregation: kv_cache_key → engine slot holding an adopted
+        # (or locally retained) sequence awaiting its decode-stage job
+        self._pd_slots: Dict[str, int] = {}
+        # serializes engine mutation between the job path and the
+        # data-plane KV receiver thread (adoption arrives asynchronously)
+        self._engine_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -233,6 +242,159 @@ class TPULLMEngine(LLMBaseEngine):
                 seed=cfg.seed,
             ),
         )
+
+    # -- PD disaggregation stages (server/pd_flow.py drives these) ----------
+
+    def inference(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        # the lock covers EVERY job path, not just the PD stages: the
+        # data-plane kv_receiver thread adopts handoffs asynchronously, and
+        # an unlocked ordinary generate would race it on the same engine
+        with self._engine_lock:
+            stage = params.get("pd_stage")
+            if stage == "prefill":
+                return self.pd_prefill(params)
+            if stage == "decode":
+                return self.pd_decode(params)
+            return super().inference(params)
+
+    def pd_prefill(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Prefill stage: run the prompt, sample the first token (TTFT),
+        export the sequence's KV pages, and push them to the decode worker's
+        data plane (``/kv/transfer`` — HTTP twin of grpc TransferKVCache).
+        When this worker IS the decode target (KV affinity), the slot is
+        simply retained — zero migration bytes."""
+        from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+            export_slot_kv,
+            serialize_handoff,
+        )
+
+        if not self.loaded or self.engine is None:
+            raise EngineLoadError("engine not loaded")
+        cfg = GenerationConfig.from_params(params)
+        prompt = params.get("prompt_token_ids") or params.get("messages") \
+            or params.get("prompt") or ""
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            req = InferenceRequest(
+                prompt_token_ids=[int(t) for t in prompt],
+                sampling=SamplingParams(
+                    max_new_tokens=cfg.max_new_tokens,
+                    temperature=cfg.temperature,
+                    top_k=cfg.top_k, top_p=cfg.top_p,
+                    stop_token_ids=self._stop_ids(cfg), seed=cfg.seed,
+                ),
+            )
+        else:
+            req = self._build_request(prompt, cfg)
+        key = params.get("kv_cache_key") or f"pd-{req.request_id}"
+        # the key rides IN the handoff (session_id) so the receiver can
+        # index the adopted slot for the decode-stage job
+        req.session_id = key
+        slot = self.engine.submit_batch([req])[0]
+        s = self.engine.slots[slot]
+        first_token = int(self.engine._last_tokens[slot])
+        ttft_ms = (
+            (s.first_token_time - s.start_time) * 1000.0
+            if s.first_token_time else None
+        )
+        prompt_tokens = s.prompt_len
+        decode_url = params.get("decode_url")
+        local = not decode_url or params.get("decode_worker") in (
+            None, params.get("target_worker"),
+        )
+        if local:
+            # KV affinity: this worker decodes too — retain the slot
+            self._pd_slots[key] = slot
+            return {
+                "pd_stage": "prefill", "kv_cache_key": key,
+                "first_token": first_token, "ttft_ms": ttft_ms,
+                "migration_bytes": 0, "migration_ms": 0.0,
+                "decode_slot": slot, "local": True,
+                # prefill compute billed on this child; the decode child
+                # bills the completion (usage shape = units_from_result)
+                "usage": {"prompt_tokens": prompt_tokens,
+                          "completion_tokens": 0,
+                          "total_tokens": prompt_tokens},
+            }
+        try:
+            handoff = export_slot_kv(self.engine, slot)
+            raw = serialize_handoff(handoff)
+            t0 = time.perf_counter()
+            resp = httpx.post(
+                decode_url.rstrip("/") + "/kv/transfer",
+                content=raw,
+                headers={"content-type": "application/octet-stream"},
+                timeout=60.0,
+            )
+            resp.raise_for_status()
+            migration_ms = (time.perf_counter() - t0) * 1000.0
+            remote = resp.json()
+        finally:
+            # donor side is done with the sequence either way: a failed push
+            # must not leak the slot and its blocks (repeated failures would
+            # exhaust the engine); success caches the prefix for reuse
+            self.engine.finish_slot(slot)
+        return {
+            "pd_stage": "prefill", "kv_cache_key": key,
+            "first_token": first_token, "ttft_ms": ttft_ms,
+            "migration_bytes": len(raw), "migration_ms": migration_ms,
+            "decode_slot": remote.get("slot"), "local": False,
+            "usage": {"prompt_tokens": prompt_tokens,
+                      "completion_tokens": 0,
+                      "total_tokens": prompt_tokens},
+        }
+
+    def pd_decode(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Decode stage: resume the adopted (or retained) slot and stream
+        the rest of the generation. TTFT/E2E stay end-to-end truthful — the
+        handoff carries the original start/first-token times."""
+        if not self.loaded or self.engine is None:
+            raise EngineLoadError("engine not loaded")
+        key = params.get("kv_cache_key") or ""
+        slot = self._pd_slots.pop(key, None)
+        if slot is None:
+            raise RuntimeError(
+                f"no adopted KV for key {key!r} — handoff never arrived"
+            )
+        eng = self.engine
+        while eng.slots[slot] is not None and \
+                eng.slots[slot].finish_reason is None:
+            eng.decode_multi()
+        resp = eng.finish_slot(slot)
+        text = self.tokenizer.decode(resp.token_ids) if self.tokenizer else ""
+        return {
+            "pd_stage": "decode", "kv_cache_key": key,
+            "text": text,
+            "token_ids": list(resp.token_ids),
+            "prompt_tokens": resp.prompt_tokens,
+            "completion_tokens": resp.completion_tokens,
+            "finish_reason": resp.finish_reason,
+            "ttft_ms": resp.ttft_ms,
+            "e2e_ms": resp.e2e_ms,
+            # decode child bills the completion (prefill child billed the
+            # prompt — together they equal the non-PD job's total)
+            "usage": {"prompt_tokens": 0,
+                      "completion_tokens": resp.completion_tokens,
+                      "total_tokens": resp.completion_tokens},
+        }
+
+    def kv_receiver(self, raw: bytes) -> Dict[str, Any]:
+        """Data-plane ``/kv/transfer`` hook: adopt a pushed handoff into this
+        engine and index the slot by the kv_cache_key carried in the
+        handoff's session_id."""
+        from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+            adopt_kv,
+            deserialize_handoff,
+        )
+
+        if not self.loaded or self.engine is None:
+            raise EngineLoadError("engine not loaded")
+        handoff = deserialize_handoff(raw)
+        key = handoff.request.session_id or handoff.request.request_id
+        with self._engine_lock:
+            slot = adopt_kv(self.engine, handoff)
+            self._pd_slots[key] = slot
+        return {"slot": slot, "bytes_received": len(raw),
+                "kv_cache_key": key}
 
     def _generate(self, prompt_or_messages: Any,
                   cfg: GenerationConfig) -> GenerationResult:

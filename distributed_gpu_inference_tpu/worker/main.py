@@ -156,6 +156,26 @@ def probe_topology() -> TpuTopology:
         return TpuTopology(chip_type="cpu", num_chips=1, hbm_gb_per_chip=4.0)
 
 
+class _PDReceiverShim:
+    """Stage adapter for a PD KV-receiving DataPlaneServer: only /health and
+    /kv/transfer are served; pipeline-session endpoints 404."""
+
+    def __init__(self, llm_engine: Any) -> None:
+        self._eng = llm_engine
+
+    def health(self) -> Dict[str, Any]:
+        return {**self._eng.health(), "pd_kv_receiver": True}
+
+    def create_session(self, *a: Any, **kw: Any) -> None:
+        raise KeyError("not a pipeline stage (PD KV receiver only)")
+
+    def close_session(self, *a: Any, **kw: Any) -> None:
+        raise KeyError("not a pipeline stage (PD KV receiver only)")
+
+    def forward(self, *a: Any, **kw: Any) -> None:
+        raise KeyError("not a pipeline stage (PD KV receiver only)")
+
+
 class Worker:
     """The volunteer/fleet worker process (reference ``Worker``, main.py:28)."""
 
@@ -212,6 +232,8 @@ class Worker:
                 "topology": self.topology.to_dict(),
                 "supports_direct": self.config.direct.enabled,
                 "direct_url": self.config.direct.public_url,
+                "role": self.config.role,
+                "data_plane_url": self.config.pd_data_plane_url,
             }
             data = self.api.register(info)
             if self._on_credentials:
@@ -453,6 +475,20 @@ class Worker:
                 port=self.config.direct.port,
             )
             self._direct.start()
+        if self.config.pd_data_plane_url and "llm" in self.engines:
+            # decode-capable PD worker: run a data plane so prefill peers
+            # can push KV handoffs (server/pd_flow.py stage 2)
+            from urllib.parse import urlparse
+
+            from ..comm.data_plane import DataPlaneServer
+
+            llm_eng = self.engines["llm"]
+            port = urlparse(self.config.pd_data_plane_url).port or 8472
+            self._pd_plane = DataPlaneServer(
+                _PDReceiverShim(llm_eng), port=port,
+                kv_receiver=llm_eng.kv_receiver,
+            )
+            self._pd_plane.start()
         self.state = WorkerState.IDLE
         if install_signal_handlers:
             try:
@@ -496,6 +532,8 @@ class Worker:
         self.state = WorkerState.OFFLINE
         if getattr(self, "_direct", None) is not None:
             self._direct.stop()
+        if getattr(self, "_pd_plane", None) is not None:
+            self._pd_plane.stop()
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=5.0)
         for eng in self.engines.values():

@@ -1,0 +1,98 @@
+"""Sequence-parallel long-context prefill wired into the SERVING engine.
+
+VERDICT r2 next #5's done-criterion: serve a prompt ≥4x the single-chip
+prefill bucket on an 8-device mesh via ring/Ulysses over the ``seq`` axis,
+with the output matching the single-chip oracle — and the KV landing in the
+same paged pools decode reads (decode continues on the regular paged path
+after the seq-sharded prefill).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # compiles multi-device graphs
+
+from distributed_gpu_inference_tpu.parallel.mesh import MeshPlan, make_mesh
+from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+
+def _cfg(**kw):
+    base = dict(
+        max_batch_size=2, max_seq_len=256, block_size=16,
+        prefill_buckets=(16,), multi_step=4, dtype="float32",
+        enable_prefix_cache=False,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _req(prompt, max_new=6):
+    return InferenceRequest(
+        prompt_token_ids=prompt,
+        sampling=SamplingParams(max_new_tokens=max_new, temperature=0.0),
+    )
+
+
+def _seq_mesh(n):
+    return make_mesh(MeshPlan(seq=n), jax.devices()[:n],
+                     keep_trivial_axes=False)
+
+
+def test_ring_long_prefill_matches_single_chip_oracle():
+    # 128-token prompt = 8x the largest bucket (16): chunked path on the
+    # oracle, ONE ring-sharded pass on the 8-device mesh
+    prompt = [int(t) for t in
+              np.random.default_rng(0).integers(1, 500, 128)]
+    mesh = _seq_mesh(8)
+    eng_sp = TPUEngine("llama3-tiny", _cfg(), mesh=mesh)
+    assert eng_sp._seq_axis == 8
+    oracle = TPUEngine("llama3-tiny", _cfg())
+
+    got = eng_sp.generate([_req(prompt)])[0]
+    want = oracle.generate([_req(prompt)])[0]
+    assert eng_sp.stats.get("seq_parallel_prefills", 0) == 1
+    assert got.token_ids == want.token_ids
+    assert got.prompt_tokens == 128
+
+
+def test_ulysses_long_prefill_matches_oracle():
+    # ulysses needs num_kv_heads % seq_axis == 0: tiny has 2 kv heads → seq=2
+    prompt = [int(t) for t in
+              np.random.default_rng(1).integers(1, 500, 96)]
+    mesh = _seq_mesh(2)
+    eng_sp = TPUEngine(
+        "llama3-tiny", _cfg(seq_parallel_impl="ulysses"), mesh=mesh
+    )
+    oracle = TPUEngine("llama3-tiny", _cfg())
+    got = eng_sp.generate([_req(prompt)])[0]
+    want = oracle.generate([_req(prompt)])[0]
+    assert eng_sp.stats.get("seq_parallel_prefills", 0) == 1
+    assert got.token_ids == want.token_ids
+
+
+def test_seq_parallel_decode_continues_on_paged_pools():
+    """After the seq-sharded prefill, decode reads the SAME paged pools —
+    verify several decode steps continue correctly (multi-step scan path)."""
+    prompt = [int(t) for t in
+              np.random.default_rng(2).integers(1, 500, 128)]
+    mesh = _seq_mesh(8)
+    eng_sp = TPUEngine("llama3-tiny", _cfg(), mesh=mesh)
+    oracle = TPUEngine("llama3-tiny", _cfg())
+    got = eng_sp.generate([_req(prompt, max_new=12)], use_multi_step=True)[0]
+    want = oracle.generate([_req(prompt, max_new=12)], use_multi_step=True)[0]
+    assert got.token_ids == want.token_ids
+    assert got.completion_tokens == 12
+
+
+def test_short_prompts_keep_batched_path_on_seq_mesh():
+    # prompts inside the bucket must not detour through the seq path
+    mesh = _seq_mesh(8)
+    eng = TPUEngine("llama3-tiny", _cfg(), mesh=mesh)
+    r = eng.generate([_req(list(range(10, 24)), max_new=4)])[0]
+    assert eng.stats.get("seq_parallel_prefills", 0) == 0
+    assert r.completion_tokens == 4
