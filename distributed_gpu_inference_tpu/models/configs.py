@@ -137,6 +137,17 @@ MODEL_REGISTRY: Dict[str, ModelConfig] = {
         head_dim=128, tie_word_embeddings=True,
         max_position_embeddings=8192,
     ),
+    # untied sibling: round-3 probes showed EAGLE-head distillation
+    # acceptance collapses on TIED-embedding targets specifically (the
+    # draft must hit embedding rows rather than a trained discriminative
+    # head) — this variant isolates the serving-stack speedup from that
+    # draft-modeling limitation at 200M scale
+    "llama3-200m-bench-untied": _llama(
+        "llama3-200m-bench-untied", vocab_size=8192, hidden_size=1024,
+        num_layers=12, num_heads=8, num_kv_heads=4, intermediate_size=4096,
+        head_dim=128, tie_word_embeddings=False,
+        max_position_embeddings=8192,
+    ),
     # Llama 3.2 3B geometry
     "llama3-3b": _llama(
         "llama3-3b", vocab_size=128256, hidden_size=3072, num_layers=28,

@@ -385,6 +385,10 @@ def forward_chunk(
     last_only: bool = True,
     with_logits: bool = True,
     dense_attn_fn=None,
+    attn_override=None,   # (q, layer_k, layer_v, tables, positions, kv_lens)
+                          # replaces the paged-attention read (e.g. the
+                          # seq-sharded-pool shard_map op); disables the
+                          # fused Pallas path
 ) -> ChunkOutput:
     """Run S tokens per sequence through all layers against the paged cache.
 
@@ -402,11 +406,17 @@ def forward_chunk(
     safe_pos = jnp.maximum(positions, 0)
     cos, sin = _rope_angles(safe_pos, cfg.head_dim, cfg.rope_theta)
 
-    def attn_fn(q, layer_k, layer_v):
-        return paged_attention(
-            q, layer_k, layer_v, block_tables, positions, kv_lens, block_size,
-            window=cfg.sliding_window,
-        )
+    if attn_override is not None:
+        def attn_fn(q, layer_k, layer_v):
+            return attn_override(
+                q, layer_k, layer_v, block_tables, positions, kv_lens
+            )
+    else:
+        def attn_fn(q, layer_k, layer_v):
+            return paged_attention(
+                q, layer_k, layer_v, block_tables, positions, kv_lens,
+                block_size, window=cfg.sliding_window,
+            )
 
     scanned, stacked = split_stacked_quant(params["layers"])
     step = functools.partial(
@@ -421,6 +431,7 @@ def forward_chunk(
         fused_decode=(
             _use_fused_decode(cfg, s, block_tables, block_size)
             and dense_attn_fn is None
+            and attn_override is None
         ),
         kv_lens=kv_lens,
         stacked=stacked,

@@ -229,6 +229,113 @@ def _decode_local(
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, nh, d).astype(q.dtype)
 
 
+def _paged_decode_local(
+    q: jax.Array,            # [B, 1, Nh, D] (replicated over the seq axis)
+    k_shard: jax.Array,      # [Nloc, Hkv, Bk, D] — this device's pool shard
+    v_shard: jax.Array,
+    block_tables: jax.Array,  # [B, M] GLOBAL physical block ids (replicated)
+    positions: jax.Array,    # [B] query positions (-1 = inactive)
+    kv_lens: jax.Array,      # [B] global context lengths
+    axis_name: str,
+    block_size: int,
+) -> jax.Array:
+    """Per-device body: attend over the LOCAL subset of each sequence's
+    pages, then merge the partial (max, sum, acc) across the axis."""
+    idx = jax.lax.axis_index(axis_name)
+    b, _, nh, d = q.shape
+    nloc, hkv = k_shard.shape[0], k_shard.shape[1]
+    qpk = nh // hkv
+    m = block_tables.shape[1]
+    j = m * block_size
+
+    # global page id → local shard slot; out-of-shard pages gather slot 0
+    # and are masked out of the softmax
+    local = block_tables - idx * nloc                       # [B, M]
+    in_shard = (local >= 0) & (local < nloc)
+    safe = jnp.where(in_shard, local, 0)
+    # [B, M, Hkv, Bk, D] → [B, J, Hkv, D] token-major context
+    k_ctx = jnp.take(k_shard, safe, axis=0).transpose(0, 1, 3, 2, 4).reshape(
+        b, j, hkv, d
+    )
+    v_ctx = jnp.take(v_shard, safe, axis=0).transpose(0, 1, 3, 2, 4).reshape(
+        b, j, hkv, d
+    )
+
+    qg = q.reshape(b, 1, hkv, qpk, d).astype(jnp.float32)
+    scores = jnp.einsum(
+        "bsgqd,bjgd->bgqsj", qg, k_ctx.astype(jnp.float32)
+    ) * (d**-0.5)                                           # [B,Hkv,qpk,1,J]
+
+    key_pos = jnp.arange(j, dtype=jnp.int32)[None, :]       # [1, J]
+    visible = (
+        (key_pos < kv_lens[:, None])
+        & (key_pos <= positions[:, None])
+        & jnp.repeat(in_shard, block_size, axis=1)
+    )                                                       # [B, J]
+    mask = visible[:, None, None, None, :]
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    m_loc = scores.max(axis=-1)
+    m_glob = jax.lax.pmax(m_loc, axis_name)
+    p = jnp.exp(scores - m_glob[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jax.lax.psum(p.sum(axis=-1), axis_name)
+    acc = jax.lax.psum(
+        jnp.einsum("bgqsj,bjgd->bgqsd", p, v_ctx.astype(jnp.float32)),
+        axis_name,
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((l > 0)[..., None], out, 0.0)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, 1, nh, d).astype(q.dtype)
+
+
+def seq_parallel_paged_decode_attention(
+    q: jax.Array,             # [B, 1, Nh, D]
+    k_pool: jax.Array,        # [N, Hkv, Bk, D] — sharded over ``seq`` on N
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, M] int32 global block ids
+    positions: jax.Array,     # [B, 1] int32 (-1 = inactive)
+    kv_lens: jax.Array,       # [B]
+    mesh: Mesh,
+    block_size: int = 16,
+) -> jax.Array:
+    """Decode attention over a PAGED pool whose block axis is sharded over
+    the ``seq`` mesh axis — the memory-scaling completion of ring prefill
+    (SURVEY §5.7): each device stores and reads only its block range, and
+    one pmax + two psum merge the partial softmax ([B, Nh, D]-sized partials
+    cross ICI; pages never move).
+
+    Semantics match ``ops.attention.paged_attention_xla`` over the same pool
+    (causal by ``positions``, bounded by ``kv_lens``, inactive rows zero).
+    The pool's N must divide evenly by the seq axis.
+    """
+    n = dict(mesh.shape).get(AXIS_SEQ, 1)
+    if k_pool.shape[0] % n:
+        raise ValueError(
+            f"pool blocks {k_pool.shape[0]} not divisible by seq axis {n}"
+        )
+    fn = jax.shard_map(
+        functools.partial(
+            _paged_decode_local, axis_name=AXIS_SEQ, block_size=block_size
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, None, None),
+            P(AXIS_SEQ, None, None, None),
+            P(AXIS_SEQ, None, None, None),
+            P(None, None),
+            P(None),
+            P(None),
+        ),
+        out_specs=P(None, None, None, None),
+        check_vma=False,
+    )
+    return fn(
+        q, k_pool, v_pool, block_tables.astype(jnp.int32),
+        positions[:, 0].astype(jnp.int32), kv_lens.astype(jnp.int32),
+    )
+
+
 def seq_parallel_decode_attention(
     q: jax.Array,        # [B, 1, Nh, D]
     k: jax.Array,        # [B, Sctx, Hkv, D] — full context, sharded by caller

@@ -35,7 +35,11 @@ from typing import Any, Dict, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_gpu_inference_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL
+from distributed_gpu_inference_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+)
 
 
 def _ns(mesh: Mesh, *spec) -> NamedSharding:
@@ -80,6 +84,16 @@ def kv_sharding(mesh: Mesh) -> NamedSharding:
     """KV pools [L, N, Hkv, Bk, D]: heads sharded over ``model`` so each TP
     shard attends with its own KV heads — pages never cross chips."""
     return _ns(mesh, None, None, AXIS_MODEL, None, None)
+
+
+def kv_sharding_seq(mesh: Mesh) -> NamedSharding:
+    """KV pools with the BLOCK axis sharded over ``seq`` (heads still over
+    ``model``): per-device pool memory scales 1/seq — the storage side of
+    long-context serving (decode reads via
+    ``ring_attention.seq_parallel_paged_decode_attention``; page writes are
+    GSPMD-partitioned scatters, verified to keep this sharding without
+    replication)."""
+    return _ns(mesh, None, AXIS_SEQ, AXIS_MODEL, None, None)
 
 
 def batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:

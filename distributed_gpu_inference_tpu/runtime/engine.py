@@ -125,6 +125,13 @@ class EngineConfig:
     # parallel/ring_attention.py) instead of single-chip chunking; KV pages
     # land in the same paged pools decode reads (SURVEY §5.7)
     seq_parallel_impl: str = "ring"   # ring | ulysses
+    # storage-side sequence parallelism: shard the KV pools' BLOCK axis
+    # over ``seq`` so per-device pool memory scales 1/seq (servable context
+    # scales with the mesh). Decode reads route through the shard_map
+    # partial-softmax op (pages never move); prefill attention runs dense
+    # over the chunk, so this mode serves FRESH prompts only — it forces
+    # enable_prefix_cache=False and rejects chunked/cached admission paths.
+    kv_seq_sharded: bool = False
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -217,6 +224,8 @@ class TPUEngine:
             sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
             tp = sizes.get("model", 1)
             self._seq_axis = sizes.get("seq", 1)
+        if mesh is not None:
+            # general mesh validations (ANY mesh, not just seq-sharded)
             if sizes.get("data", 1) > 1:
                 raise ValueError(
                     "engine mesh must not carry a data axis (DP is "
@@ -233,6 +242,24 @@ class TPUEngine:
                 raise ValueError(
                     f"num_experts {self.model_cfg.num_experts} not "
                     f"divisible by model axis {tp} (EP shards experts)"
+                )
+        if self.cfg.kv_seq_sharded:
+            if self._seq_axis <= 1:
+                raise ValueError(
+                    "kv_seq_sharded needs a mesh with a seq axis > 1"
+                )
+            if self.cfg.enable_prefix_cache:
+                raise ValueError(
+                    "kv_seq_sharded serves fresh prompts only — set "
+                    "enable_prefix_cache=False (prefill attention runs "
+                    "dense over the chunk, so cached prefixes cannot be "
+                    "attended)"
+                )
+            if self.cfg.resolved_num_blocks() % self._seq_axis:
+                # round the pool UP so the block axis shards evenly
+                blocks = self.cfg.resolved_num_blocks()
+                self.cfg.num_blocks = (
+                    -(-blocks // self._seq_axis) * self._seq_axis
                 )
         if params is not None:
             self.params = quantize_params(params, self.cfg.quantization)
@@ -450,7 +477,10 @@ class TPUEngine:
         # staging allocation)
         from distributed_gpu_inference_tpu.parallel import sharding as _sh
 
-        s = _sh.kv_sharding(self.mesh)
+        s = (
+            _sh.kv_sharding_seq(self.mesh)
+            if self.cfg.kv_seq_sharded else _sh.kv_sharding(self.mesh)
+        )
         make = jax.jit(
             lambda: llama.init_kv_pools(
                 self.model_cfg, self.num_blocks, self.cfg.block_size,
@@ -465,6 +495,37 @@ class TPUEngine:
     def _build_jit_fns(self) -> None:
         cfg, bs = self.model_cfg, self.cfg.block_size
         m = self.cfg.max_blocks_per_seq
+
+        # seq-sharded pools: decode reads go through the shard_map
+        # partial-softmax op (a GSPMD gather from an N-sharded pool would
+        # all-gather it); prefill attends DENSE over the chunk (fresh
+        # prompts: chunk == whole context), so pool pages are never read
+        # during admission
+        decode_attn_override = None
+        prefill_dense_fn = None
+        if self.cfg.kv_seq_sharded:
+            if cfg.sliding_window is not None:
+                raise ValueError(
+                    "kv_seq_sharded does not support sliding-window models"
+                )
+            from distributed_gpu_inference_tpu.ops.attention import (
+                dense_causal_attention,
+            )
+            from distributed_gpu_inference_tpu.parallel.ring_attention import (
+                seq_parallel_paged_decode_attention,
+            )
+
+            mesh = self.mesh
+
+            def decode_attn_override(q, layer_k, layer_v, tables, positions,
+                                     kv_lens):
+                return seq_parallel_paged_decode_attention(
+                    q, layer_k, layer_v, tables, positions, kv_lens, mesh,
+                    block_size=bs,
+                )
+
+            def prefill_dense_fn(q, k, v, kv_lens):
+                return dense_causal_attention(q, k, v, lengths=kv_lens)
 
         # --- device-state pack/unpack (ONE upload per packed buffer: on a
         # remote-tunnel TPU every host→device transfer is a control RTT, so
@@ -506,6 +567,10 @@ class TPUEngine:
             out = llama.forward_chunk(
                 cfg, params, toks_pos[0], toks_pos[1], kv, tables, lens_after,
                 block_size=bs, last_only=True,
+                dense_attn_fn=(
+                    (lambda q, k, v: prefill_dense_fn(q, k, v, lens_after))
+                    if prefill_dense_fn else None
+                ),
             )
             first = sample_mode(
                 out.logits[:, 0, :], core["keys"], lens_after, core["temps"],
@@ -525,6 +590,12 @@ class TPUEngine:
             out = llama.forward_chunk(
                 cfg, params, toks_pos[0], toks_pos[1], kv, table, kv_len,
                 block_size=bs, last_only=True, with_logits=sample,
+                dense_attn_fn=(
+                    # fresh single-chunk prompts only in kv_seq_sharded mode
+                    # (chunk == whole context; _prefill_one_chunk enforces)
+                    (lambda q, k, v: prefill_dense_fn(q, k, v, kv_len))
+                    if prefill_dense_fn else None
+                ),
             )
             if not sample:
                 # intermediate chunk: KV side effects only — no LM head read
@@ -592,6 +663,7 @@ class TPUEngine:
                 out = llama.forward_chunk(
                     cfg, params, last[:, None], positions, kv, tables, cur,
                     block_size=bs, last_only=True,
+                    attn_override=decode_attn_override,
                 )
                 toks = sample_mode(
                     out.logits[:, 0, :], core["keys"], cur, core["temps"],
@@ -1087,6 +1159,12 @@ class TPUEngine:
         first token IN-GRAPH (the eager sampler here used to cost ~15
         dispatch round-trips on a tunneled TPU); intermediate chunks skip
         the LM head entirely."""
+        if self.cfg.kv_seq_sharded and off > 0:
+            raise RuntimeError(
+                "kv_seq_sharded serves fresh prompts in one pass (dense "
+                "chunk attention cannot see prior context); chunked/"
+                "continued prefill is unsupported in this mode"
+            )
         n = len(piece)
         bucket = (
             self._bucket_len(max(n, 1)) if is_last

@@ -172,7 +172,10 @@ async def register_worker(request: web.Request) -> web.Response:
         "supported_types": body.get("supported_types") or ["llm"],
         "loaded_models": body.get("loaded_models") or [],
         "status": WorkerState.IDLE.value,
-        "role": body.get("role") or "hybrid",
+        # validated: an unknown role string would poison PD placement later
+        "role": body.get("role") if body.get("role") in (
+            "prefill", "decode", "hybrid", "pipeline_stage"
+        ) else "hybrid",
         "last_heartbeat": time.time(),
         "supports_direct": bool(body.get("supports_direct")),
         "direct_url": body.get("direct_url"),
@@ -471,6 +474,13 @@ async def create_job(request: web.Request) -> web.Response:
                 completed_at=time.time(),
             )
             return _json_error(503, str(exc))
+        except Exception as exc:  # noqa: BLE001 — parent must not strand
+            await st.store.update_job(
+                job_id, status=JobStatus.FAILED.value,
+                error=f"pd placement error: {exc}",
+                completed_at=time.time(),
+            )
+            return _json_error(500, f"pd placement error: {exc}")
         st.metrics.record_request(row["type"], "queued")
         return web.json_response(
             {"job_id": job_id, "status": "running", "pd": True}, status=201
@@ -537,6 +547,20 @@ async def cancel_job(request: web.Request) -> web.Response:
             await st.store.update_worker(
                 wid, current_job_id=None, status=WorkerState.IDLE.value
             )
+    if (job.get("params") or {}).get("pd_disaggregated"):
+        # cancelling a PD container must not orphan its pinned stage jobs:
+        # queued children cancel outright (a RUNNING child finishes on its
+        # worker and the completion hook finds the parent terminal — no-op)
+        for child_id in (f"{job_id}-prefill", f"{job_id}-decode"):
+            child = await st.store.get_job(child_id)
+            if child is not None and \
+                    child["status"] == JobStatus.QUEUED.value:
+                await st.store.update_job(
+                    child_id, status=JobStatus.CANCELLED.value,
+                    completed_at=time.time(),
+                )
+        # release the PD scheduler placement (active_prefill/active_decode)
+        await st.pd_flow.on_parent_terminal(job_id)
     return web.json_response({"job_id": job_id, "status": "cancelled"})
 
 

@@ -82,9 +82,26 @@ class PDFlowService:
 
     # -------------------------------------------------------------- submit
 
+    async def on_parent_terminal(self, parent_id: str) -> None:
+        """Release placement state for a parent that went terminal outside
+        the normal child-completion path (cancellation, sweep timeout)."""
+        self._finish(parent_id, ok=False)
+
+    async def _prune_live(self) -> None:
+        """Drop placements whose parent went terminal without passing
+        through on_child_complete (e.g. swept by the stale-job timeout) so
+        worker active-counters cannot leak."""
+        for pid in list(self._live.keys()):
+            job = await self.store.get_job(pid)
+            if job is None or job["status"] in (
+                "completed", "failed", "cancelled"
+            ):
+                self._finish(pid, ok=False)
+
     async def submit(self, parent: Dict[str, Any]) -> None:
         """Place a pd job and enqueue its prefill child. Parent is already
         stored with status=running (unclaimable container)."""
+        await self._prune_live()
         await self._sync_workers()
         params = parent.get("params") or {}
         prompt = params.get("prompt_token_ids") or params.get("prompt") or []
@@ -150,15 +167,25 @@ class PDFlowService:
         parent = await self.store.get_job(parent_id)
         if parent is None:
             return
+        if parent["status"] in ("completed", "failed", "cancelled"):
+            # late child of a terminal (e.g. cancelled) parent: release any
+            # placement state, never overwrite the terminal status
+            self._finish(parent_id, ok=False)
+            return
         if child["status"] != "completed":
             await self._fail(parent_id, stage,
                              child.get("error") or f"{stage} stage failed")
             return
         result = child.get("result") or {}
         if stage == "prefill":
+            # decode needs only the sampling config + flow keys — NOT the
+            # prompt (its KV already moved) or prefill-only routing. A
+            # multi-MB prompt stored a third time would also hit the claim
+            # path's params parse.
             decode_params = {
                 k: v for k, v in params.items()
-                if k not in ("pd_stage", "target_worker")
+                if k not in ("pd_stage", "target_worker", "prompt",
+                             "prompt_token_ids", "messages", "decode_url")
             }
             decode_params.update({
                 "pd_stage": "decode",
@@ -212,8 +239,10 @@ class PDFlowService:
     def _finish(self, parent_id: str, ok: bool) -> None:
         req = self._live.pop(parent_id, None)
         if req is not None:
+            # stats count each flow once — a late child arriving after the
+            # parent went terminal finds _live already drained and is a no-op
             self.scheduler.release(req)
-        self.stats["completed" if ok else "failed"] += 1
+            self.stats["completed" if ok else "failed"] += 1
 
     def get_stats(self) -> Dict[str, Any]:
         return {**self.stats, "live": len(self._live),

@@ -50,6 +50,21 @@ class TaskGuaranteeService:
                 if w.get("status") == WorkerState.BUSY.value:
                     fields["status"] = WorkerState.IDLE.value
                 await self._store.update_worker(wid, **fields)
+        if (job.get("params") or {}).get("pd_disaggregated"):
+            # a PD CONTAINER job must never become claimable: requeueing it
+            # would hand the whole generation to an arbitrary worker while
+            # its pinned stage children still run (double execution). On
+            # timeout the flow fails; a late stage completion finds the
+            # parent terminal and no-ops (pd_flow.on_child_complete guard).
+            # Stage children themselves requeue normally — their
+            # target_worker pin rides in params.
+            await self._store.update_job(
+                job["id"],
+                status=JobStatus.FAILED.value,
+                error=f"pd flow timed out: {reason}",
+                completed_at=time.time(),
+            )
+            return JobStatus.FAILED.value
         retries = int(job.get("retry_count") or 0)
         max_retries = int(job.get("max_retries") or 3)
         if retries + 1 > max_retries:

@@ -246,13 +246,17 @@ class TPULLMEngine(LLMBaseEngine):
     # -- PD disaggregation stages (server/pd_flow.py drives these) ----------
 
     def inference(self, params: Dict[str, Any]) -> Dict[str, Any]:
-        # the lock covers EVERY job path, not just the PD stages: the
-        # data-plane kv_receiver thread adopts handoffs asynchronously, and
-        # an unlocked ordinary generate would race it on the same engine
+        # the lock covers EVERY engine-touching job path, not just the PD
+        # stages: the data-plane kv_receiver thread adopts handoffs
+        # asynchronously, and an unlocked ordinary generate would race it
+        # on the same engine. pd_prefill manages its own lock scope — the
+        # KV push is network I/O that must happen OUTSIDE the lock (two
+        # hybrid workers pushing to each other while holding their locks
+        # would deadlock until the HTTP timeout).
+        stage = params.get("pd_stage")
+        if stage == "prefill":
+            return self.pd_prefill(params)
         with self._engine_lock:
-            stage = params.get("pd_stage")
-            if stage == "prefill":
-                return self.pd_prefill(params)
             if stage == "decode":
                 return self.pd_decode(params)
             return super().inference(params)
@@ -289,50 +293,54 @@ class TPULLMEngine(LLMBaseEngine):
         # the key rides IN the handoff (session_id) so the receiver can
         # index the adopted slot for the decode-stage job
         req.session_id = key
-        slot = self.engine.submit_batch([req])[0]
-        s = self.engine.slots[slot]
-        first_token = int(self.engine._last_tokens[slot])
-        ttft_ms = (
-            (s.first_token_time - s.start_time) * 1000.0
-            if s.first_token_time else None
-        )
-        prompt_tokens = s.prompt_len
         decode_url = params.get("decode_url")
         local = not decode_url or params.get("decode_worker") in (
             None, params.get("target_worker"),
         )
-        if local:
-            # KV affinity: this worker decodes too — retain the slot
-            self._pd_slots[key] = slot
-            return {
-                "pd_stage": "prefill", "kv_cache_key": key,
-                "first_token": first_token, "ttft_ms": ttft_ms,
-                "migration_bytes": 0, "migration_ms": 0.0,
-                "decode_slot": slot, "local": True,
-                # prefill compute billed on this child; the decode child
-                # bills the completion (usage shape = units_from_result)
-                "usage": {"prompt_tokens": prompt_tokens,
-                          "completion_tokens": 0,
-                          "total_tokens": prompt_tokens},
-            }
-        try:
-            handoff = export_slot_kv(self.engine, slot)
-            raw = serialize_handoff(handoff)
-            t0 = time.perf_counter()
-            resp = httpx.post(
-                decode_url.rstrip("/") + "/kv/transfer",
-                content=raw,
-                headers={"content-type": "application/octet-stream"},
-                timeout=60.0,
+        with self._engine_lock:
+            slot = self.engine.submit_batch([req])[0]
+            s = self.engine.slots[slot]
+            first_token = int(self.engine._last_tokens[slot])
+            ttft_ms = (
+                (s.first_token_time - s.start_time) * 1000.0
+                if s.first_token_time else None
             )
-            resp.raise_for_status()
-            migration_ms = (time.perf_counter() - t0) * 1000.0
-            remote = resp.json()
-        finally:
-            # donor side is done with the sequence either way: a failed push
-            # must not leak the slot and its blocks (repeated failures would
-            # exhaust the engine); success caches the prefix for reuse
-            self.engine.finish_slot(slot)
+            prompt_tokens = s.prompt_len
+            if local:
+                # KV affinity: this worker decodes too — retain the slot
+                self._pd_slots[key] = slot
+                return {
+                    "pd_stage": "prefill", "kv_cache_key": key,
+                    "first_token": first_token, "ttft_ms": ttft_ms,
+                    "migration_bytes": 0, "migration_ms": 0.0,
+                    "decode_slot": slot, "local": True,
+                    # prefill compute billed on this child; the decode child
+                    # bills the completion (usage shape = units_from_result)
+                    "usage": {"prompt_tokens": prompt_tokens,
+                              "completion_tokens": 0,
+                              "total_tokens": prompt_tokens},
+                }
+            try:
+                handoff = export_slot_kv(self.engine, slot)
+                raw = serialize_handoff(handoff)
+            finally:
+                # donor side is done with the sequence once the bytes are
+                # serialized: free the slot before the network hop so a
+                # failed or slow push cannot leak it
+                self.engine.finish_slot(slot)
+        # network push OUTSIDE the engine lock: a peer pushing to US can
+        # adopt concurrently (kv_receiver takes the lock the engine work
+        # above released) — no crossed-push deadlock
+        t0 = time.perf_counter()
+        resp = httpx.post(
+            decode_url.rstrip("/") + "/kv/transfer",
+            content=raw,
+            headers={"content-type": "application/octet-stream"},
+            timeout=60.0,
+        )
+        resp.raise_for_status()
+        migration_ms = (time.perf_counter() - t0) * 1000.0
+        remote = resp.json()
         return {
             "pd_stage": "prefill", "kv_cache_key": key,
             "first_token": first_token, "ttft_ms": ttft_ms,

@@ -170,3 +170,62 @@ def test_ulysses_under_jit_with_data_axis(cpu_devices):
     got = run(q, k, v, lengths)
     want = dense_causal_attention(q, k, v, lengths)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+# -- paged decode over a seq-sharded pool (round 3) --------------------------
+
+
+def test_paged_decode_seq_sharded_pool_matches_oracle():
+    """Pool block axis sharded over seq: partial-softmax merge must match
+    single-device paged attention over the same (global) pool."""
+    import numpy as np
+
+    from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
+    from distributed_gpu_inference_tpu.parallel.mesh import MeshPlan, make_mesh
+    from distributed_gpu_inference_tpu.parallel.ring_attention import (
+        seq_parallel_paged_decode_attention,
+    )
+
+    mesh = make_mesh(MeshPlan(seq=4), jax.devices()[:4],
+                     keep_trivial_axes=False)
+    b, nh, hkv, d, bs, m, nblocks = 3, 4, 2, 32, 16, 6, 32
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 3)
+    k_pool = jax.random.normal(ks[0], (nblocks, hkv, bs, d), jnp.float32)
+    v_pool = jax.random.normal(ks[1], (nblocks, hkv, bs, d), jnp.float32)
+    q = jax.random.normal(ks[2], (b, 1, nh, d), jnp.float32)
+    # tables deliberately scatter pages across ALL shards (stride b)
+    tables = np.zeros((b, m), np.int32)
+    for i in range(b):
+        tables[i] = (1 + i + np.arange(m) * b) % nblocks
+    lens = jnp.asarray([70, 9, 0], jnp.int32)   # multi-shard, tiny, inactive
+    positions = (lens - 1)[:, None].astype(jnp.int32)
+
+    want = paged_attention_xla(
+        q, k_pool, v_pool, jnp.asarray(tables), positions, lens, bs
+    )
+    got = seq_parallel_paged_decode_attention(
+        q, k_pool, v_pool, jnp.asarray(tables), positions, lens, mesh,
+        block_size=bs,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    assert np.all(np.asarray(got)[2] == 0.0)  # inactive row exactly zero
+
+
+def test_paged_decode_seq_sharded_rejects_ragged_pool():
+    from distributed_gpu_inference_tpu.parallel.mesh import MeshPlan, make_mesh
+    from distributed_gpu_inference_tpu.parallel.ring_attention import (
+        seq_parallel_paged_decode_attention,
+    )
+
+    mesh = make_mesh(MeshPlan(seq=4), jax.devices()[:4],
+                     keep_trivial_axes=False)
+    k = jnp.zeros((30, 2, 16, 32))  # 30 % 4 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        seq_parallel_paged_decode_attention(
+            k[:1, :, :1, :].reshape(1, 1, 2, 32), k, k,
+            jnp.zeros((1, 2), jnp.int32), jnp.zeros((1, 1), jnp.int32),
+            jnp.ones((1,), jnp.int32), mesh,
+        )

@@ -246,3 +246,109 @@ def test_pd_local_affinity_no_migration():
 
     got = run(body())
     assert got == _oracle_tokens(eng_oracle, 6)
+
+
+def test_pd_cancel_parent_cancels_queued_children():
+    eng = _llm_engine()
+
+    async def body():
+        client = await make_client()
+        reg = await _register(client, "hybrid", "hybrid")
+        w = reg["worker_id"]
+        resp = await client.post("/api/v1/jobs", json={
+            "type": "llm",
+            "params": {"pd_disaggregated": True,
+                       "prompt_token_ids": PROMPT, "max_tokens": 6},
+        })
+        parent_id = (await resp.json())["job_id"]
+        # cancel while the prefill child is still queued
+        resp = await client.delete(f"/api/v1/jobs/{parent_id}")
+        assert resp.status == 200
+        resp = await client.get(f"/api/v1/jobs/{parent_id}-prefill")
+        child = await resp.json()
+        assert child["status"] == "cancelled"
+        # nothing claimable afterwards
+        resp = await client.get(f"/api/v1/workers/{w}/next-job",
+                                headers=_auth(reg))
+        assert resp.status == 204
+        resp = await client.get(f"/api/v1/jobs/{parent_id}")
+        assert (await resp.json())["status"] == "cancelled"
+        await client.close()
+
+    run(body())
+
+
+def test_pd_flow_survives_control_plane_restart(tmp_path):
+    """The merge path is stateless (everything rides in child params), so a
+    decode child completing against a RESTARTED server still merges the
+    parent. Only in-memory scheduler counters are lost — by design."""
+    eng = _llm_engine()
+    db = str(tmp_path / "cp.sqlite")
+
+    async def phase1():
+        from distributed_gpu_inference_tpu.server.app import (
+            ServerState, create_app,
+        )
+        state = ServerState(db_path=db)
+        app = create_app(state, start_background=False)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        reg = await _register(client, "hybrid", "hybrid")
+        resp = await client.post("/api/v1/jobs", json={
+            "type": "llm",
+            "params": {"pd_disaggregated": True,
+                       "prompt_token_ids": PROMPT,
+                       "max_tokens": 4, "temperature": 0},
+        })
+        parent_id = (await resp.json())["job_id"]
+        resp = await client.get(
+            f"/api/v1/workers/{reg['worker_id']}/next-job",
+            headers=_auth(reg))
+        job = (await resp.json())["job"]
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, eng.inference, job["params"]
+        )
+        resp = await client.post(
+            f"/api/v1/workers/{reg['worker_id']}/jobs/{job['id']}/complete",
+            json={"success": True, "result": result}, headers=_auth(reg),
+        )
+        assert resp.status == 200
+        await client.close()
+        state.store.close()
+        return reg, parent_id
+
+    async def phase2(reg, parent_id):
+        from distributed_gpu_inference_tpu.server.app import (
+            ServerState, create_app,
+        )
+        # FRESH server over the same DB file: pd_flow._live is empty
+        state = ServerState(db_path=db)
+        app = create_app(state, start_background=False)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        # worker re-registers with its old id (credentials reissued)
+        reg2 = await _register(client, "hybrid", "hybrid",
+                               worker_id=reg["worker_id"])
+        resp = await client.get(
+            f"/api/v1/workers/{reg2['worker_id']}/next-job",
+            headers=_auth(reg2))
+        assert resp.status == 200, "decode child lost across restart"
+        job = (await resp.json())["job"]
+        assert job["params"]["pd_stage"] == "decode"
+        result = await asyncio.get_running_loop().run_in_executor(
+            None, eng.inference, job["params"]
+        )
+        resp = await client.post(
+            f"/api/v1/workers/{reg2['worker_id']}/jobs/{job['id']}/complete",
+            json={"success": True, "result": result}, headers=_auth(reg2),
+        )
+        assert resp.status == 200
+        resp = await client.get(f"/api/v1/jobs/{parent_id}")
+        parent = await resp.json()
+        assert parent["status"] == "completed"
+        assert parent["result"]["pd_disaggregated"] is True
+        await client.close()
+        state.store.close()
+
+    reg, parent_id = run(phase1())
+    run(phase2(reg, parent_id))
