@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Focused PD KV-handoff benchmark: blocking vs streamed vs device migration.
+
+Measures the DECODE-READY DELAY — first token sampled on the prefill engine
+→ sequence adopted and resumable on the decode engine — for the three
+migration paths (VERDICT r3 #3):
+
+- **blocking**: the round-3 one-shot path — export every page, pull to host,
+  serialize, one POST over the real data plane, adopt. The whole cost lands
+  after prefill.
+- **streamed**: ``StreamedExport`` begin/piece/commit over the same data
+  plane — pages cross the wire while later prefill chunks compute (the
+  donor uses a small prefill bucket so a 512-token prompt spans chunks);
+  only the tail piece + commit remain after the first token samples. Runs
+  the PRODUCT path (``TPULLMEngine.pd_prefill`` with its sender thread).
+- **device**: ``migrate_kv_device`` — pool→pool jitted gather-scatter for
+  same-chip/same-slice pools; zero host bytes (the intra-slice shape,
+  BASELINE config 5).
+
+Reference contrast: its migration body is a 50 ms sleep
+(``server/app/services/pd_scheduler.py:462-472``); the per-layer transfer
+proto (:121-127) is never wired.
+
+Usage:
+    python -m benchmarks.pd_handoff --prompt-len 512 --reps 3
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from benchmarks.common import (
+    add_platform_arg,
+    emit,
+    make_request,
+    percentiles,
+    resolve_backend_model,
+)
+
+
+class _StubStage:
+    def health(self):
+        return {"status": "ok", "role": "pd-handoff-bench"}
+
+
+def _mk_engine(model, batch, max_seq, buckets, quant=None, params=None,
+               cache_dir=None):
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        EngineConfig,
+        TPUEngine,
+    )
+
+    return TPUEngine(
+        model,
+        EngineConfig(
+            max_batch_size=batch, max_seq_len=max_seq,
+            prefill_buckets=buckets, enable_prefix_cache=False,
+            quantization=quant, quant_cache_dir=cache_dir,
+        ),
+        params=params,
+    )
+
+
+def _wrap(engine):
+    """A TPULLMEngine with an injected engine (shared weights between the
+    donor and receiver wrappers — two independent loads would not fit two
+    8B trees on one chip)."""
+    from distributed_gpu_inference_tpu.worker.engines.llm import (
+        ByteTokenizer,
+        TPULLMEngine,
+    )
+
+    w = TPULLMEngine({})
+    w.engine = engine
+    w.tokenizer = ByteTokenizer()
+    w.loaded = True
+    return w
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--prompt-len", type=int, default=512)
+    ap.add_argument("--max-tokens", type=int, default=4)
+    ap.add_argument("--prefill-bucket", type=int, default=128,
+                    help="donor prefill bucket (chunks per prompt = "
+                         "prompt_len / bucket — what streaming overlaps)")
+    ap.add_argument("--piece-blocks", type=int, default=4)
+    ap.add_argument("--reps", type=int, default=3)
+    add_platform_arg(ap)
+    args = ap.parse_args()
+
+    import jax
+
+    backend, model = resolve_backend_model(
+        args, tpu_default="llama3-8b", cpu_default="llama3-tiny"
+    )
+    quant = "int8" if model == "llama3-8b" else None
+    cache_dir = str(Path(__file__).resolve().parent.parent / ".cache" /
+                    "quant") if quant else None
+    max_seq = args.prompt_len + args.max_tokens + 32
+
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        export_slot_kv,
+        migrate_kv_device,
+        serialize_handoff,
+    )
+    from distributed_gpu_inference_tpu.comm.data_plane import DataPlaneServer
+    from distributed_gpu_inference_tpu.models.configs import get_model_config
+
+    cfg = get_model_config(model)
+    donor = _mk_engine(model, 2, max_seq, (args.prefill_bucket,),
+                       quant, cache_dir=cache_dir)
+    recv = _mk_engine(model, 2, max_seq, (args.prefill_bucket,),
+                      None, params=donor.params)
+    donor_w, recv_w = _wrap(donor), _wrap(recv)
+
+    plane = DataPlaneServer(_StubStage(), host="127.0.0.1", port=0,
+                            kv_receiver=recv_w.kv_receiver)
+    plane.start()
+    url = f"http://127.0.0.1:{plane.bound_port}"
+
+    rng = np.random.default_rng(0)
+
+    def prompt():
+        return rng.integers(1, cfg.vocab_size, args.prompt_len).tolist()
+
+    import httpx
+
+    def run_blocking():
+        req = make_request(prompt(), args.max_tokens)
+        req.session_id = f"blk-{req.request_id}"
+        slot = donor.submit(req)
+        t0 = time.perf_counter()
+        raw = serialize_handoff(export_slot_kv(donor, slot))
+        donor.finish_slot(slot, cache=False)
+        r = httpx.post(url + "/kv/transfer", content=raw, timeout=300.0)
+        r.raise_for_status()
+        ms = (time.perf_counter() - t0) * 1000.0
+        _drain(r.json()["slot"])
+        return ms, len(raw), 0
+
+    def run_streamed():
+        req_ids = prompt()
+        out = donor_w.pd_prefill({
+            "prompt_token_ids": req_ids,
+            "max_new_tokens": args.max_tokens,
+            "kv_cache_key": f"st-{time.monotonic_ns()}",
+            "decode_url": url,
+            "decode_worker": "w2", "target_worker": "w1",
+            "pd_stream": True,
+            "pd_stream_piece_blocks": args.piece_blocks,
+        })
+        assert out.get("pd_streamed"), "streamed path did not engage"
+        _drain(out["decode_slot"])
+        return (out["migration_ms"], out["migration_bytes"],
+                out["bytes_before_first_token"])
+
+    def run_device():
+        req = make_request(prompt(), args.max_tokens)
+        slot = donor.submit(req)
+        t0 = time.perf_counter()
+        dslot = migrate_kv_device(donor, recv, slot)
+        # sync: the copy must have EXECUTED, not just dispatched
+        np.asarray(recv.kv["k"][0, :1, 0, 0, 0])
+        ms = (time.perf_counter() - t0) * 1000.0
+        donor.finish_slot(slot, cache=False)
+        _drain(dslot)
+        return ms, 0, 0
+
+    def _drain(slot):
+        while recv.slots[slot] is not None and \
+                recv.slots[slot].finish_reason is None:
+            recv.decode_multi(4)
+        recv.finish_slot(slot, cache=False)
+
+    # warm every graph + wire path once
+    for fn in (run_blocking, run_streamed, run_device):
+        fn()
+
+    results = {}
+    for name, fn in (("blocking", run_blocking), ("streamed", run_streamed),
+                     ("device", run_device)):
+        ms, mb, early = [], 0, 0
+        for _ in range(args.reps):
+            m, b, e = fn()
+            ms.append(m)
+            mb = b
+            early = e
+        results[name] = {
+            "migration_ms": percentiles(ms),
+            "wire_mb": round(mb / 1e6, 2),
+            "bytes_before_first_token_mb": round(early / 1e6, 2),
+        }
+    plane.stop()
+
+    blk = results["blocking"]["migration_ms"]["p50"]
+    emit({
+        "benchmark": "pd_handoff",
+        "metric": "migration_p50_cut_vs_blocking",
+        "value": {
+            "streamed": round(
+                100 * (1 - results["streamed"]["migration_ms"]["p50"] / blk),
+                1),
+            "device": round(
+                100 * (1 - results["device"]["migration_ms"]["p50"] / blk),
+                1),
+        },
+        "unit": "% decode-ready delay cut (p50)",
+        "model": model,
+        "backend": backend,
+        "quantization": quant,
+        "prompt_len": args.prompt_len,
+        "prefill_bucket": args.prefill_bucket,
+        "piece_blocks": args.piece_blocks,
+        **results,
+    })
+
+
+if __name__ == "__main__":
+    main()

@@ -30,6 +30,9 @@ class GenerationConfig:
     top_k: int = 0
     top_p: float = 1.0
     stop: List[str] = field(default_factory=list)
+    # token-level stops (merged with the tokenizer's eos; vLLM-parity knob —
+    # the reference forwards it to vLLM as stop_token_ids)
+    stop_token_ids: List[int] = field(default_factory=list)
     seed: Optional[int] = None
 
     @classmethod
@@ -42,6 +45,8 @@ class GenerationConfig:
             top_k=int(params.get("top_k") or 0),
             top_p=float(params.get("top_p") or 1.0),
             stop=list(params.get("stop") or []),
+            stop_token_ids=[int(t) for t in
+                            (params.get("stop_token_ids") or [])],
             seed=params.get("seed"),
         )
 

@@ -94,6 +94,8 @@ class TPULLMEngine(LLMBaseEngine):
         # serializes engine mutation between the job path and the
         # data-plane KV receiver thread (adoption arrives asynchronously)
         self._engine_lock = threading.Lock()
+        # streamed-handoff session machine (created with the engine)
+        self._handoff_rx = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -214,9 +216,9 @@ class TPULLMEngine(LLMBaseEngine):
         raise ValueError(f"bad prompt type {type(prompt_or_messages)}")
 
     def _stop_ids(self, cfg: GenerationConfig) -> tuple:
-        ids = []
+        ids = list(cfg.stop_token_ids)
         eos = getattr(self.tokenizer, "eos_token_id", None)
-        if eos is not None:
+        if eos is not None and eos not in ids:
             ids.append(int(eos))
         return tuple(ids[:4])
 
@@ -297,6 +299,25 @@ class TPULLMEngine(LLMBaseEngine):
         local = not decode_url or params.get("decode_worker") in (
             None, params.get("target_worker"),
         )
+        # streamed push (VERDICT r3 #3): chunk the export per page range and
+        # overlap the wire hop with remaining prefill compute. Default on
+        # for cross-host pushes; sliding-window models fall back to the
+        # one-shot blob (the streamed protocol rejects them).
+        stream_ok = (
+            not local
+            and bool(params.get("pd_stream",
+                                self.config.get("pd_stream", True)))
+            and self.engine.model_cfg.sliding_window is None
+            and not self.engine.cfg.kv_seq_sharded
+        )
+        if stream_ok:
+            return self._pd_prefill_streamed(
+                req, key, decode_url,
+                piece_blocks=int(
+                    params.get("pd_stream_piece_blocks")
+                    or self.config.get("pd_stream_piece_blocks", 4)
+                ),
+            )
         with self._engine_lock:
             slot = self.engine.submit_batch([req])[0]
             s = self.engine.slots[slot]
@@ -351,6 +372,118 @@ class TPULLMEngine(LLMBaseEngine):
                       "total_tokens": prompt_tokens},
         }
 
+    def _pd_prefill_streamed(self, req: InferenceRequest, key: str,
+                             decode_url: str,
+                             piece_blocks: int = 4) -> Dict[str, Any]:
+        """Streamed prefill stage: pages cross the wire WHILE the prompt is
+        still computing (``runtime.kv_handoff.StreamedExport``). A sender
+        thread drains the message queue so network I/O never runs under the
+        engine lock (same no-crossed-push-deadlock stance as the one-shot
+        path); ``migration_ms`` is the decode-ready delay — first token
+        sampled → commit acked — the number the one-shot path pays in full
+        after prefill."""
+        import queue as _queue
+
+        from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+            StreamedExport,
+            abort_message,
+        )
+
+        url = decode_url.rstrip("/") + "/kv/transfer"
+        exp = StreamedExport(self.engine, req, key,
+                             piece_blocks=piece_blocks)
+        q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        state: Dict[str, Any] = {"exc": None, "last": None, "t_ack": None}
+
+        def _sender() -> None:
+            with httpx.Client(timeout=60.0) as client:
+                while True:
+                    item = q.get()
+                    if item is None:
+                        return
+                    if state["exc"] is not None:
+                        continue        # drain after failure
+                    try:
+                        r = client.post(
+                            url, content=item,
+                            headers={"content-type":
+                                     "application/octet-stream"},
+                        )
+                        r.raise_for_status()
+                        state["last"] = r.json()
+                        state["t_ack"] = time.perf_counter()
+                    except Exception as exc:  # noqa: BLE001
+                        state["exc"] = exc
+
+        sender = threading.Thread(target=_sender, daemon=True,
+                                  name="pd-stream-sender")
+        sender.start()
+        t_prefill_end = None
+
+        def _abort_remote() -> None:
+            # direct POST, not via the queue — the sender drains (skips)
+            # queued items once state["exc"] is set, and the receiver's
+            # half-built session would otherwise pin its KV blocks
+            try:
+                httpx.post(url, content=abort_message(key), timeout=10.0)
+            except Exception:  # noqa: BLE001
+                pass
+
+        gen = exp.messages()
+        try:
+            with self._engine_lock:
+                # the generator's cleanup (abort_chunked/finish_slot)
+                # mutates the engine, so it must run INSIDE the lock —
+                # close explicitly rather than leaving it to GC after the
+                # lock is released (it would race the kv_receiver thread)
+                try:
+                    for msg in gen:
+                        if state["exc"] is not None:
+                            # fail fast: the push is already doomed — stop
+                            # prefilling/gathering and release the engine
+                            raise state["exc"]
+                        if t_prefill_end is None and \
+                                exp.first_token is not None:
+                            t_prefill_end = time.perf_counter()
+                        q.put(msg)
+                finally:
+                    gen.close()
+        except Exception:
+            q.put(None)
+            sender.join(timeout=60.0)
+            _abort_remote()
+            raise
+        q.put(None)
+        # generous wire budget: bytes / ~1 MB/s, floor 120 s — a slower link
+        # is treated as failed, never silently reported as success
+        sender.join(timeout=max(120.0, exp.bytes_sent / 1e6))
+        if sender.is_alive():
+            state["exc"] = state["exc"] or TimeoutError(
+                f"streamed KV push did not finish ({exp.bytes_sent} bytes)"
+            )
+        if state["exc"] is not None:
+            _abort_remote()
+            raise state["exc"]
+        remote = state["last"] or {}
+        migration_ms = (
+            (state["t_ack"] - t_prefill_end) * 1000.0
+            if state["t_ack"] is not None and t_prefill_end is not None
+            else None
+        )
+        return {
+            "pd_stage": "prefill", "kv_cache_key": key,
+            "first_token": exp.first_token, "ttft_ms": exp.ttft_ms,
+            "migration_bytes": exp.bytes_sent,
+            "migration_ms": migration_ms,
+            "pd_streamed": True,
+            "pieces": exp.pieces_sent,
+            "bytes_before_first_token": exp.bytes_before_first_token,
+            "decode_slot": remote.get("slot"), "local": False,
+            "usage": {"prompt_tokens": exp.prompt_tokens,
+                      "completion_tokens": 0,
+                      "total_tokens": exp.prompt_tokens},
+        }
+
     def pd_decode(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Decode stage: resume the adopted (or retained) slot and stream
         the rest of the generation. TTFT/E2E stay end-to-end truthful — the
@@ -387,22 +520,24 @@ class TPULLMEngine(LLMBaseEngine):
 
     def kv_receiver(self, raw: bytes) -> Dict[str, Any]:
         """Data-plane ``/kv/transfer`` hook: adopt a pushed handoff into this
-        engine and index the slot by the kv_cache_key carried in the
-        handoff's session_id."""
+        engine and index the slot by the kv_cache_key. Handles both the
+        one-shot blob AND the streamed begin/piece/commit/abort messages
+        (``runtime.kv_handoff.HandoffReceiver`` dispatches on the frame
+        magic) — one endpoint, two wire modes."""
         from distributed_gpu_inference_tpu.runtime.kv_handoff import (
-            adopt_kv,
-            deserialize_handoff,
+            HandoffReceiver,
         )
 
         if not self.loaded or self.engine is None:
             raise EngineLoadError("engine not loaded")
-        handoff = deserialize_handoff(raw)
-        key = handoff.request.session_id or handoff.request.request_id
         with self._engine_lock:
-            slot = adopt_kv(self.engine, handoff)
-            self._pd_slots[key] = slot
-        return {"slot": slot, "bytes_received": len(raw),
-                "kv_cache_key": key}
+            if self._handoff_rx is None or \
+                    self._handoff_rx.engine is not self.engine:
+                self._handoff_rx = HandoffReceiver(self.engine)
+            result = self._handoff_rx.handle(raw)
+            if result.get("slot") is not None:
+                self._pd_slots[result["kv_cache_key"]] = result["slot"]
+        return result
 
     def _generate(self, prompt_or_messages: Any,
                   cfg: GenerationConfig) -> GenerationResult:

@@ -81,12 +81,13 @@ def test_pd_separation_bench(capsys):
 
     res = _run(main, [
         "pd_separation", "--model", "llama3-tiny", "--requests", "3",
-        "--prompt-len", "16", "--max-tokens", "6",
+        "--prompt-len", "16", "--max-tokens", "6", "--migration", "both",
     ], capsys)
     assert res["benchmark"] == "pd_separation"
     assert res["hybrid"]["tpot_ms"]["p50"] is not None
-    assert res["separated"]["tpot_ms"]["p50"] is not None
-    assert res["separated"]["migration_ms"]["p50"] is not None
+    for mode in ("host", "device"):
+        assert res[f"separated_{mode}"]["tpot_ms"]["p50"] is not None
+        assert res[f"separated_{mode}"]["migration_ms"]["p50"] is not None
 
 
 def test_spec_params_npz_roundtrip_preserves_bfloat16(tmp_path=None):
