@@ -335,6 +335,8 @@ def run_spec(args) -> None:
     ]
     if args.spec_no_train:
         argv.append("--no-train")
+    if args.quantization:
+        argv += ["--quantization", args.quantization]
     old = sys.argv
     sys.argv = argv
     try:

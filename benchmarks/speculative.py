@@ -102,6 +102,12 @@ def main() -> None:
                          "smaller = sharper target at a fixed step budget "
                          "(the tunnel chip kernel-faults under sustained "
                          "training, so steps cannot simply be raised)")
+    ap.add_argument("--quantization", default=None,
+                    help="weight-only target quantization (int8 | fp8): the "
+                         "flagship 8B target only fits the chip quantized; "
+                         "implies --no-train (a quantized target cannot be "
+                         "trained) — measures the real tree machinery cost "
+                         "at flagship scale (VERDICT r3 #1a)")
     ap.add_argument("--no-train", action="store_true",
                     help="skip target training (random-init target): the "
                          "draft is still distilled against the real frozen "
@@ -124,7 +130,8 @@ def main() -> None:
     # while succeeding in any fresh process). Decide everything jax-free.
     big = bool(args.model) and \
         get_model_config(args.model).num_params > 5e8
-    if big and not args.no_train and not args.train_out \
+    if big and not args.no_train and not args.quantization \
+            and not args.train_out \
             and not args.measure_from and args.platform != "cpu":
         # ORCHESTRATE ONLY: the tunnel client connects at interpreter start
         # and pins its memory view, so a process that was alive while the
@@ -219,14 +226,33 @@ def main() -> None:
         params = _unflatten_params(trained_blob)
         sample_stream = make_chain_sampler(
             trained_blob["perm"], float(trained_blob["noise"]))
-    elif args.no_train:
-        from distributed_gpu_inference_tpu.models import llama
-
+    elif args.no_train or args.quantization:
         class _T0:
             elapsed = 0.0
 
         t_train = _T0()
-        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        if args.quantization:
+            # flagship-scale target (8B int8): build through the engine's
+            # quantized loader so the content-keyed orbax cache applies —
+            # a second run restores int8 from disk instead of re-initing
+            from distributed_gpu_inference_tpu.runtime.engine import (
+                EngineConfig as _EC,
+                TPUEngine as _TE,
+            )
+
+            cache = str(Path(__file__).resolve().parent.parent / ".cache" /
+                        "quant")
+            loader = _TE(cfg, _EC(
+                max_batch_size=1, max_seq_len=64, num_blocks=4,
+                prefill_buckets=(32,), quantization=args.quantization,
+                quant_cache_dir=cache,
+            ))
+            params = loader.params
+            del loader
+        else:
+            from distributed_gpu_inference_tpu.models import llama
+
+            params = llama.init_params(cfg, jax.random.PRNGKey(0))
 
         def sample_stream(key, n, length):
             return jax.random.randint(
@@ -320,7 +346,8 @@ def main() -> None:
         "vanilla_elapsed_s": round(t_van.elapsed, 3),
         "target_train_s": round(t_train.elapsed, 1),
         "draft_distill_s": round(t_distill.elapsed, 1),
-        "target_trained": not args.no_train,
+        "target_trained": not (args.no_train or args.quantization),
+        "quantization": args.quantization,
     })
 
 

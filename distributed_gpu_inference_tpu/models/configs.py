@@ -167,6 +167,18 @@ MODEL_REGISTRY: Dict[str, ModelConfig] = {
         num_heads=64, num_kv_heads=8, intermediate_size=28672,
         max_position_embeddings=8192,
     ),
+    # 70B PIPELINE-SCHEDULE geometry for the 8-device virtual-mesh dryrun
+    # (benchmarks/distributed.py --mode spmd, BENCH_NOTES_r04): true per-
+    # layer width (hidden 8192, GQA 64/8, intermediate 28672 — the shapes
+    # every ppermute hop and per-stage matmul see) with 8 layers (1 per
+    # stage) and a cut vocab so the f32 host tree stays ~27 GB. The CHIP
+    # slice measurement uses the full llama3-70b config with num_layers
+    # overridden (benchmarks/pipeline_70b.py).
+    "llama3-70b-micro": _llama(
+        "llama3-70b-micro", vocab_size=2048, hidden_size=8192, num_layers=8,
+        num_heads=64, num_kv_heads=8, intermediate_size=28672,
+        max_position_embeddings=8192,
+    ),
     # Qwen2.5 family (the reference's single-worker benchmark default is
     # Qwen2.5-7B, benchmarks/single_worker.py:446) — same decoder recipe
     # with QKV biases and 1e6 rope theta

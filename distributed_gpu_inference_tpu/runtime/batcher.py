@@ -58,6 +58,12 @@ class BatcherConfig:
     # amortizing host round-trips (decode_step per token would pay one RTT
     # per token on a tunneled TPU)
     busy_multi_step: int = 4
+    # adaptive speculation (VERDICT r3 #7): when a SpeculativeDecoder is
+    # attached and the ENTIRE waiting load is <= this many greedy requests
+    # (and the paged engine is idle), they decode through the spec tree —
+    # the low-depth regime where drafting wins; deeper load decodes vanilla
+    # (batched weight streaming already amortizes better there). 0 = never.
+    spec_max_batch: int = 2
 
     @property
     def horizon_levels(self) -> Tuple[int, ...]:
@@ -81,9 +87,18 @@ class _QueueItem:
 class ContinuousBatcher:
     """Admission queue + decode loop over a :class:`TPUEngine`."""
 
-    def __init__(self, engine: TPUEngine, cfg: Optional[BatcherConfig] = None) -> None:
+    def __init__(self, engine: TPUEngine, cfg: Optional[BatcherConfig] = None,
+                 spec: Optional[Any] = None) -> None:
+        """``spec``: a ``runtime.speculative.SpeculativeDecoder`` sharing the
+        engine's target weights (its own KV pool). When set, low-depth
+        all-greedy load routes through the incremental spec-wave API
+        (one bounded fused dispatch per loop iteration, interleaved with
+        paged decode rounds — never a blocking whole-generation call)."""
         self.engine = engine
         self.cfg = cfg or BatcherConfig()
+        self.spec = spec
+        # (wave, items) while a speculative wave is in flight
+        self._spec_wave: Optional[Tuple[Any, List["_QueueItem"]]] = None
         self._heap: List[_QueueItem] = []
         self._seq = itertools.count()
         self._wake = asyncio.Event()
@@ -113,7 +128,109 @@ class ContinuousBatcher:
             "decode_rounds": 0, "admitted": 0, "queue_peak": 0,
             "step_latency_ema_ms": 0.0, "occupancy_sum": 0, "horizon": self._horizon,
             "chunked_admissions": 0, "batched_waves": 0,
+            "spec_waves": 0, "spec_completed": 0,
         }
+
+    # ---------------------------------------------------- speculative routing
+
+    def _spec_eligible(self, item: "_QueueItem") -> bool:
+        """A request may decode through the spec tree iff it is greedy
+        (verify is an argmax match), its prompt fits one spec prefill
+        bucket, the generation fits the spec pool, and it did not opt out
+        (``request.params['speculative'] = False``)."""
+        r = item.request
+        ids = r.prompt_token_ids or []
+        if not ids or r.sampling.temperature > 0.0:
+            return False
+        if r.params.get("speculative") is False:
+            return False
+        s = self.spec
+        if len(ids) > s.prefill_buckets[-1]:
+            return False
+        # headroom must cover the WORST verify tree (incl. adaptive depth
+        # growth): the spec fits-freeze ends a row early at
+        # prefix + nodes + 1 > ctx, which would return fewer tokens than
+        # the paged engine serves for the same request
+        margin = s.worst_case_tree_nodes() + 1
+        return len(ids) + r.sampling.max_new_tokens + margin <= s.max_seq_len
+
+    async def _maybe_start_spec_wave(self) -> bool:
+        """Route the ENTIRE waiting queue through the spec decoder when it
+        is a low-depth all-greedy moment: queue depth <= spec_max_batch,
+        every request eligible, paged engine idle, no wave in flight.
+        Mixed/deep load never waits on drafting."""
+        spec_cap = (
+            min(self.cfg.spec_max_batch, self.spec.max_batch_size)
+            if self.spec is not None else 0
+        )
+        if (
+            self.spec is None
+            or spec_cap <= 0
+            or self._spec_wave is not None
+            or self._chunked is not None
+            or not self._heap
+            or len(self._heap) > spec_cap
+            or self.engine.num_active > 0
+        ):
+            return False
+        items = [it for it in list(self._heap) if not it.future.cancelled()]
+        if not items or not all(self._spec_eligible(it) for it in items):
+            return False
+        loop = asyncio.get_running_loop()
+        self._heap.clear()
+        try:
+            wave = await loop.run_in_executor(
+                self._exec, self.spec.start_wave,
+                [it.request for it in items],
+            )
+        except Exception:
+            # fall back to the paged engine, which can serve these requests
+            # (a transient spec failure must not error a servable request);
+            # mark them so a persistent spec fault can't retry-loop
+            for it in items:
+                it.request.params["speculative"] = False
+                heapq.heappush(self._heap, it)
+            return False
+        self._spec_wave = (wave, items)
+        self.stats["spec_waves"] += 1
+        self.stats["admitted"] += len(items)
+        return True
+
+    async def _step_spec_wave(self) -> None:
+        """Advance the in-flight spec wave by ONE fused dispatch; finish and
+        resolve futures when every row is done (or a caller gave up)."""
+        if self._spec_wave is None:
+            return
+        wave, items = self._spec_wave
+        loop = asyncio.get_running_loop()
+        if all(it.future.done() for it in items):
+            self._spec_wave = None          # every caller timed out/cancelled
+            await loop.run_in_executor(self._exec, self.spec.abort_wave, wave)
+            return
+        try:
+            done = await loop.run_in_executor(
+                self._exec, self.spec.advance_wave, wave
+            )
+        except Exception as e:
+            self._spec_wave = None
+            await loop.run_in_executor(self._exec, self.spec.abort_wave, wave)
+            for it in items:
+                if not it.future.done():
+                    it.future.set_result(InferenceResponse(
+                        request_id=it.request.request_id,
+                        error=f"speculative engine error: {e}",
+                    ))
+            return
+        if done:
+            self._spec_wave = None
+            resps = await loop.run_in_executor(
+                self._exec, self.spec.finish_wave, wave
+            )
+            for it, resp in zip(items, resps):
+                if not it.future.done():
+                    it.future.set_result(resp)
+                self.stats["completed"] += 1
+                self.stats["spec_completed"] += 1
 
     # ---------------------------------------------------------------- API
 
@@ -161,7 +278,8 @@ class ContinuousBatcher:
         self._stopping = True
         self._wake.set()
         if drain:
-            while self._heap or self.engine.num_active:
+            while self._heap or self.engine.num_active \
+                    or self._spec_wave is not None:
                 await asyncio.sleep(0.01)
         if self._run_task:
             self._run_task.cancel()
@@ -358,7 +476,8 @@ class ContinuousBatcher:
         loop = asyncio.get_running_loop()
         latch_until = 0.0
         while True:
-            if not self._heap and not self.engine.num_active:
+            if not self._heap and not self.engine.num_active \
+                    and self._spec_wave is None:
                 self._wake.clear()
                 if self._stopping:
                     return
@@ -369,11 +488,17 @@ class ContinuousBatcher:
             while time.time() < latch_until and \
                     len(self._heap) < len(self.engine.slots):
                 await asyncio.sleep(0.001)
+            # low-depth all-greedy load routes through the spec tree BEFORE
+            # paged admission claims it; requests arriving mid-wave admit to
+            # paged slots below and the two interleave round for round
+            await self._maybe_start_spec_wave()
             await self._admit()
             # one prefill chunk of the in-flight long admission per loop
             # iteration — decode rounds below run between chunks, so active
             # slots stall at most one chunk per round
             await self._step_chunked()
+            # one bounded fused dispatch of the in-flight spec wave
+            await self._step_spec_wave()
             if not self.engine.num_active:
                 continue
             try:
@@ -440,6 +565,9 @@ class ContinuousBatcher:
         out = dict(self.stats)
         out["queue_depth"] = len(self._heap)
         out["active_slots"] = self.engine.num_active
+        out["spec_wave_active"] = self._spec_wave is not None
+        if self.spec is not None:
+            out["spec"] = self.spec.get_stats()
         if out["decode_rounds"]:
             out["avg_occupancy"] = out["occupancy_sum"] / out["decode_rounds"]
         return out

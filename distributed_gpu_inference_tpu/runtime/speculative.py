@@ -327,6 +327,47 @@ def medusa_logits(
 # ---------------------------------------------------------------------------
 
 
+@dataclass
+class _SpecWave:
+    """In-flight speculative wave state (``SpeculativeDecoder.start_wave``).
+
+    Exists so a serving loop can interleave bounded spec dispatches with
+    other engine work (adaptive speculation in the batcher, VERDICT r3 #7)
+    instead of blocking on a whole generation."""
+
+    requests: List[InferenceRequest]
+    seq_ids: List[str]
+    start: float
+    first_token_time: float
+    pendings: np.ndarray
+    h_last: Any
+    tables: np.ndarray
+    prefix_lens: np.ndarray
+    cached_counts: List[int]
+    emitted: List[List[int]]
+    done: List[bool]
+    finish: List[Optional[str]]
+    stops: List[set]
+    stop_pad: np.ndarray
+    budgets_full: np.ndarray
+
+    def emit(self, i: int, tok: int) -> None:
+        if self.done[i]:
+            return
+        if tok in self.stops[i]:
+            self.done[i] = True
+            self.finish[i] = "stop"
+            return
+        self.emitted[i].append(tok)
+        if len(self.emitted[i]) >= self.requests[i].sampling.max_new_tokens:
+            self.done[i] = True
+            self.finish[i] = "length"
+
+    @property
+    def all_done(self) -> bool:
+        return all(self.done)
+
+
 class SpeculativeDecoder:
     """Greedy speculative generation over the paged-KV substrate.
 
@@ -657,7 +698,17 @@ class SpeculativeDecoder:
         pending = int(jnp.argmax(logits[0]))
         return pending, h_last[0], cached
 
-    def _generate_wave(self, requests: Sequence[InferenceRequest]) -> List[InferenceResponse]:
+    def start_wave(self, requests: Sequence[InferenceRequest]) -> "_SpecWave":
+        """Prefill a wave (≤ max_batch_size greedy requests) and return its
+        state object. Drive with :meth:`advance_wave` (one fused multi-round
+        dispatch per call — bounded work, so a serving loop can interleave
+        other engine rounds between calls) and collect with
+        :meth:`finish_wave`."""
+        requests = list(requests)
+        if not requests or len(requests) > self.max_batch_size:
+            raise ValueError(
+                f"wave of {len(requests)} requests (max {self.max_batch_size})"
+            )
         b = len(requests)
         seq_ids = [r.session_id or uuid.uuid4().hex for r in requests]
         start = time.time()
@@ -666,51 +717,66 @@ class SpeculativeDecoder:
         cached_counts = []
         tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
         prefix_lens = np.zeros((b,), np.int32)
-        for i, (r, sid) in enumerate(zip(requests, seq_ids)):
-            pending, h_last, cached = self._prefill(r, sid)
-            pendings[i] = pending
-            h_lasts.append(h_last)
-            cached_counts.append(cached)
-            prefix_lens[i] = len(r.prompt_token_ids or [])
-            tables[i] = self.manager.block_table_for(sid, self.max_blocks_per_seq)
+        try:
+            for i, (r, sid) in enumerate(zip(requests, seq_ids)):
+                pending, h_last, cached = self._prefill(r, sid)
+                pendings[i] = pending
+                h_lasts.append(h_last)
+                cached_counts.append(cached)
+                prefix_lens[i] = len(r.prompt_token_ids or [])
+                tables[i] = self.manager.block_table_for(
+                    sid, self.max_blocks_per_seq
+                )
+        except Exception:
+            # a failed prefill must not strand the rows already allocated —
+            # in a serving loop each leak would shrink the spec pool forever
+            for sid in seq_ids:
+                if sid in self.manager.seq_blocks:
+                    self.manager.free_sequence(sid, cache=False)
+            raise
         h_last = jnp.stack(h_lasts)
         first_token_time = time.time()
 
-        emitted: List[List[int]] = [[] for _ in range(b)]
-        done = [False] * b
-        finish: List[Optional[str]] = [None] * b
         stops = [set(r.sampling.stop_token_ids) |
                  ({self.eos_token_id} if self.eos_token_id is not None else set())
                  for r in requests]
-
-        def emit(i: int, tok: int) -> None:
-            if done[i]:
-                return
-            if tok in stops[i]:
-                done[i] = True
-                finish[i] = "stop"
-                return
-            emitted[i].append(tok)
-            if len(emitted[i]) >= requests[i].sampling.max_new_tokens:
-                done[i] = True
-                finish[i] = "length"
-
-        # the prefill-sampled token is the first generated token
-        for i in range(b):
-            emit(i, int(pendings[i]))
-
         # device stop-id table (pad -1 never matches: ordered tokens are >= 0)
         max_stops = max(1, max(len(s) for s in stops) if stops else 1)
         stop_pad = np.full((b, max_stops), -1, np.int32)
         for i, s in enumerate(stops):
             for si, tok in enumerate(sorted(s)):
                 stop_pad[i, si] = tok
-        budgets_full = np.asarray(
-            [r.sampling.max_new_tokens for r in requests], np.int32
+
+        wave = _SpecWave(
+            requests=requests, seq_ids=seq_ids, start=start,
+            first_token_time=first_token_time,
+            pendings=pendings, h_last=h_last, tables=tables,
+            prefix_lens=prefix_lens, cached_counts=cached_counts,
+            emitted=[[] for _ in range(b)], done=[False] * b,
+            finish=[None] * b, stops=stops, stop_pad=stop_pad,
+            budgets_full=np.asarray(
+                [r.sampling.max_new_tokens for r in requests], np.int32
+            ),
         )
+        # the prefill-sampled token is the first generated token
+        for i in range(b):
+            wave.emit(i, int(pendings[i]))
+        return wave
+
+    def advance_wave(self, wave: "_SpecWave") -> bool:
+        """Run ONE fused multi-round dispatch for the wave; True when every
+        sequence finished. Work per call is bounded by
+        ``spec_cfg.rounds_per_dispatch`` tree rounds."""
+        b = len(wave.requests)
+        requests, seq_ids = wave.requests, wave.seq_ids
+        emitted, done, finish = wave.emitted, wave.done, wave.finish
+        emit = wave.emit
+        pendings, h_last = wave.pendings, wave.h_last
+        prefix_lens, tables = wave.prefix_lens, wave.tables
+        stop_pad, budgets_full = wave.stop_pad, wave.budgets_full
         max_ctx = min(self.max_seq_len, self.max_blocks_per_seq * self.block_size)
 
-        while not all(done):
+        if not all(done):
             widths = self._widths
             topo = TreeTopology(widths)
             topo_n, dmax = topo.num_nodes, topo.max_depth
@@ -722,7 +788,7 @@ class SpeculativeDecoder:
                     finish[i] = "length"
             active_rows = [i for i in range(b) if not done[i]]
             if not active_rows:
-                break
+                return True
             # rounds per dispatch: capped by the largest remaining budget
             # (each active round emits >= 1 token) and bucketed to a power of
             # two so at most log2(rounds_per_dispatch) graphs compile
@@ -814,8 +880,9 @@ class SpeculativeDecoder:
                     self.spec_cfg.ema * self.accept_rate_ema
                     + (1 - self.spec_cfg.ema) * live_rate
                 )
-            pendings = np.asarray(pend_dev)
-            prefix_lens = np.asarray(prefix_dev)
+            wave.pendings = np.asarray(pend_dev)
+            wave.prefix_lens = np.asarray(prefix_dev)
+            wave.h_last = h_last
             # rows the device froze for capacity (fits-check) but the host
             # didn't finish otherwise: label them now so the loop terminates
             done_dev_np = np.asarray(done_dev)
@@ -824,24 +891,54 @@ class SpeculativeDecoder:
                     done[i] = True
                     finish[i] = "length"
             self._maybe_adapt()
+        return all(done)
 
+    def finish_wave(self, wave: "_SpecWave") -> List[InferenceResponse]:
+        """Free the wave's sequences (prefix-cached) and build responses."""
         responses = []
         now = time.time()
-        for i, (r, sid) in enumerate(zip(requests, seq_ids)):
+        for i, (r, sid) in enumerate(zip(wave.requests, wave.seq_ids)):
             self.manager.free_sequence(sid, cache=True)
             responses.append(
                 InferenceResponse(
                     request_id=r.request_id,
-                    token_ids=emitted[i][: r.sampling.max_new_tokens],
-                    finish_reason=finish[i] or "length",
+                    token_ids=wave.emitted[i][: r.sampling.max_new_tokens],
+                    finish_reason=wave.finish[i] or "length",
                     prompt_tokens=len(r.prompt_token_ids or []),
-                    completion_tokens=len(emitted[i][: r.sampling.max_new_tokens]),
-                    cached_tokens=cached_counts[i],
-                    ttft_ms=(first_token_time - start) * 1000.0,
-                    e2e_ms=(now - start) * 1000.0,
+                    completion_tokens=len(
+                        wave.emitted[i][: r.sampling.max_new_tokens]
+                    ),
+                    cached_tokens=wave.cached_counts[i],
+                    ttft_ms=(wave.first_token_time - wave.start) * 1000.0,
+                    e2e_ms=(now - wave.start) * 1000.0,
                 )
             )
         return responses
+
+    def abort_wave(self, wave: "_SpecWave") -> None:
+        """Release a wave's sequences without caching (serving-loop error
+        recovery: the batcher must be able to drop a wedged wave)."""
+        for sid in wave.seq_ids:
+            if sid in self.manager.seq_blocks:
+                self.manager.free_sequence(sid, cache=False)
+
+    def _generate_wave(self, requests: Sequence[InferenceRequest]) -> List[InferenceResponse]:
+        wave = self.start_wave(requests)
+        while not self.advance_wave(wave):
+            pass
+        return self.finish_wave(wave)
+
+    def worst_case_tree_nodes(self) -> int:
+        """Upper bound on the verify-tree size over adaptive depth growth —
+        what an admission policy must budget per round on top of the
+        generation itself (the fits-freeze ends a row at
+        ``prefix + nodes + 1 > max ctx``)."""
+        widths = tuple(self._widths)
+        if self.spec_cfg.adaptive:
+            widths = widths + (1,) * max(
+                0, self.spec_cfg.max_depth - len(widths)
+            )
+        return TreeTopology(widths).num_nodes
 
     def _maybe_adapt(self) -> None:
         """Reference _adapt_depth:456-463: shrink when acceptance is poor,
