@@ -47,7 +47,10 @@ V5E_HBM_GB = 16.0
 ICI_GBPS = 45.0          # v5e per-link ICI, one direction (public spec)
 
 
-def _mk_slice_engine(cfg70, n_layers, args, quant, cache_dir):
+def _mk_slice_engine(cfg70, n_layers, args, quant):
+    from distributed_gpu_inference_tpu.models.loader import (
+        init_quantized_streamed,
+    )
     from distributed_gpu_inference_tpu.runtime.engine import (
         EngineConfig,
         TPUEngine,
@@ -56,13 +59,26 @@ def _mk_slice_engine(cfg70, n_layers, args, quant, cache_dir):
     cfg = dataclasses.replace(cfg70, name=f"llama3-70b-slice{n_layers}",
                               num_layers=n_layers)
     max_seq = args.prompt_len + args.decode_tokens + 32
+    # ALWAYS stream-init quantized: a 4-layer 70B-width slice is ~11 GB
+    # bf16 — the engine's full-precision-then-consume path nominally fits,
+    # but the tunnel frees the consumed bf16 leaves lazily and the
+    # follow-on prefill OOMs (observed this round). Streamed init peaks at
+    # the int8 tree + one f32 layer slice.
+    params = (
+        init_quantized_streamed(cfg, quant, dtype="bfloat16", seed=0)
+        if quant else None
+    )
+    # no quant_cache_dir: explicit params bypass the engine's orbax cache
+    # entirely (it only applies to engine-built trees), and the streamed
+    # init IS the fast path for random-init weights (~30 s incl. compiles)
     return TPUEngine(
         cfg,
         EngineConfig(
             max_batch_size=args.batch, max_seq_len=max_seq, block_size=32,
             prefill_buckets=(args.prompt_len,), enable_prefix_cache=False,
-            quantization=quant, quant_cache_dir=cache_dir,
+            quantization=quant,
         ),
+        params=params,
     ), cfg
 
 
@@ -121,12 +137,11 @@ def main() -> None:
     from distributed_gpu_inference_tpu.models.configs import get_model_config
 
     cfg70 = get_model_config("llama3-70b")
-    cache = str(Path(__file__).resolve().parent.parent / ".cache" / "quant")
     l_lo, l_hi = (int(x) for x in args.layers.split(","))
 
     measured = {}
     for n in (l_lo, l_hi):
-        eng, cfg = _mk_slice_engine(cfg70, n, args, args.quantization, cache)
+        eng, cfg = _mk_slice_engine(cfg70, n, args, args.quantization)
         t_prefill, t_step = _measure_slice(eng, cfg, args)
         measured[n] = {"prefill_s": round(t_prefill, 3),
                        "decode_step_ms": round(t_step * 1e3, 2)}
@@ -134,6 +149,12 @@ def main() -> None:
         import gc
 
         gc.collect()
+        if n != l_hi:
+            # the tunnel reclaims a freed engine's HBM lazily; give it time
+            # before the NEXT slice allocates ~11 GB (same trap as the
+            # benchmarks/speculative.py subprocess gap). Nothing follows
+            # the last slice, so no sleep there.
+            time.sleep(45.0)
 
     # per-layer cost from the slice DIFFERENCE (embed/head cancel)
     d_layers = l_hi - l_lo

@@ -336,6 +336,142 @@ def seq_parallel_paged_decode_attention(
     )
 
 
+def _paged_chunk_local(
+    q: jax.Array,            # [B, S, Nh, D] (replicated over the seq axis)
+    k_shard: jax.Array,      # [Nloc, Hkv, Bk, D] — this device's pool shard
+    v_shard: jax.Array,
+    block_tables: jax.Array,  # [B, M] GLOBAL physical block ids (replicated)
+    positions: jax.Array,    # [B, S] query positions (-1 = padding)
+    kv_lens: jax.Array,      # [B] context lengths AFTER the chunk
+    axis_name: str,
+    block_size: int,
+    pages_per_step: int,
+) -> jax.Array:
+    """Chunk (q_len ≥ 1) attention over the LOCAL pool shard, flash-style:
+    a ``lax.scan`` over page groups keeps the per-step score tile at
+    [B, Nh, S, G·Bk] instead of materializing [S, whole-context] — the
+    long-context case this op exists for. Partial (m, l, acc) merge across
+    the axis afterwards, exactly like the decode op."""
+    idx = jax.lax.axis_index(axis_name)
+    b, s, nh, d = q.shape
+    nloc, hkv = k_shard.shape[0], k_shard.shape[1]
+    qpk = nh // hkv
+    m_tab = block_tables.shape[1]
+    g = min(pages_per_step, m_tab)
+    n_steps = -(-m_tab // g)
+    pad = n_steps * g - m_tab
+    # pad the table with out-of-shard ids (masked): every scan step sees G
+    tables_p = jnp.pad(block_tables, ((0, 0), (0, pad)),
+                       constant_values=-1)
+
+    local = tables_p - idx * nloc                            # [B, M']
+    in_shard = (local >= 0) & (local < nloc) & (tables_p >= 0)
+    safe = jnp.where(in_shard, local, 0)
+
+    qg = q.reshape(b, s, hkv, qpk, d).astype(jnp.float32)
+    scale = d**-0.5
+    qpos = positions                                         # [B, S]
+
+    def step(carry, grp):
+        m_run, l_run, acc = carry
+        ids, shard_ok, page0 = grp                           # [B,G],[B,G],[]
+        # [B, G, Hkv, Bk, D] → [B, G*Bk, Hkv, D]
+        k_ctx = jnp.take(k_shard, ids, axis=0).transpose(
+            0, 1, 3, 2, 4
+        ).reshape(b, g * block_size, hkv, d)
+        v_ctx = jnp.take(v_shard, ids, axis=0).transpose(
+            0, 1, 3, 2, 4
+        ).reshape(b, g * block_size, hkv, d)
+        key_pos = (
+            page0 * block_size
+            + jnp.arange(g * block_size, dtype=jnp.int32)
+        )[None, :]                                           # [1, G*Bk]
+        scores = jnp.einsum(
+            "bsgqd,bjgd->bgqsj", qg, k_ctx.astype(jnp.float32)
+        ) * scale                                            # [B,Hkv,qpk,S,J]
+        visible = (
+            (key_pos < kv_lens[:, None])[:, None, :]         # [B, 1, J]
+            & (key_pos[:, None, :] <= qpos[:, :, None])      # [B, S, J]
+            & jnp.repeat(shard_ok, block_size, axis=1)[:, None, :]
+        )                                                    # [B, S, J]
+        mask = visible[:, None, None, :, :]
+        scores = jnp.where(mask, scores, _NEG_INF)
+        m_new = jnp.maximum(m_run, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m_run - m_new)
+        l_new = l_run * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bgqsj,bjgd->bgqsd", p, v_ctx.astype(jnp.float32)
+        )
+        return (m_new, l_new, acc_new), None
+
+    ids_g = safe.reshape(b, n_steps, g).transpose(1, 0, 2)
+    ok_g = in_shard.reshape(b, n_steps, g).transpose(1, 0, 2)
+    page0_g = jnp.arange(n_steps, dtype=jnp.int32) * g
+    m0 = jnp.full((b, hkv, qpk, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, qpk, s), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, qpk, s, d), jnp.float32)
+    (m_loc, l_loc, acc_loc), _ = jax.lax.scan(
+        step, (m0, l0, acc0), (ids_g, ok_g, page0_g)
+    )
+    # cross-device partial-softmax merge (same math as the decode op)
+    m_glob = jax.lax.pmax(m_loc, axis_name)
+    corr = jnp.exp(m_loc - m_glob)
+    l = jax.lax.psum(l_loc * corr, axis_name)
+    acc = jax.lax.psum(acc_loc * corr[..., None], axis_name)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    out = jnp.where((l > 0)[..., None], out, 0.0)
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s, nh, d).astype(q.dtype)
+
+
+def seq_parallel_paged_chunk_attention(
+    q: jax.Array,             # [B, S, Nh, D]
+    k_pool: jax.Array,        # [N, Hkv, Bk, D] — sharded over ``seq`` on N
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, M] int32 global block ids
+    positions: jax.Array,     # [B, S] int32 (-1 = padding)
+    kv_lens: jax.Array,       # [B]
+    mesh: Mesh,
+    block_size: int = 16,
+    pages_per_step: int = 16,
+) -> jax.Array:
+    """Chunk attention (q_len ≥ 1) over a seq-sharded paged pool — what lets
+    ``kv_seq_sharded`` engines serve PREFIX-CACHED and CHUNKED/continuation
+    prompts (VERDICT r3 #6): the chunk's KV is already written to the
+    (sharded) pool by the layer step, so one partial-softmax read over the
+    block axis covers cached prefix + prior chunks + in-chunk causal keys.
+    Generalizes :func:`seq_parallel_paged_decode_attention` (S = 1) with a
+    flash-style page-group scan so long contexts never materialize
+    [S, ctx] scores."""
+    n = dict(mesh.shape).get(AXIS_SEQ, 1)
+    if k_pool.shape[0] % n:
+        raise ValueError(
+            f"pool blocks {k_pool.shape[0]} not divisible by seq axis {n}"
+        )
+    fn = jax.shard_map(
+        functools.partial(
+            _paged_chunk_local, axis_name=AXIS_SEQ, block_size=block_size,
+            pages_per_step=pages_per_step,
+        ),
+        mesh=mesh,
+        in_specs=(
+            P(None, None, None, None),
+            P(AXIS_SEQ, None, None, None),
+            P(AXIS_SEQ, None, None, None),
+            P(None, None),
+            P(None, None),
+            P(None),
+        ),
+        out_specs=P(None, None, None, None),
+        check_vma=False,
+    )
+    return fn(
+        q, k_pool, v_pool, block_tables.astype(jnp.int32),
+        positions.astype(jnp.int32), kv_lens.astype(jnp.int32),
+    )
+
+
 def seq_parallel_decode_attention(
     q: jax.Array,        # [B, 1, Nh, D]
     k: jax.Array,        # [B, Sctx, Hkv, D] — full context, sharded by caller

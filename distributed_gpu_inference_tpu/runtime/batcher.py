@@ -99,6 +99,9 @@ class ContinuousBatcher:
         self.spec = spec
         # (wave, items) while a speculative wave is in flight
         self._spec_wave: Optional[Tuple[Any, List["_QueueItem"]]] = None
+        # True while start_wave runs on the executor: the requests are off
+        # the heap but the wave isn't registered yet — drain must wait
+        self._spec_starting = False
         self._heap: List[_QueueItem] = []
         self._seq = itertools.count()
         self._wake = asyncio.Event()
@@ -178,6 +181,7 @@ class ContinuousBatcher:
             return False
         loop = asyncio.get_running_loop()
         self._heap.clear()
+        self._spec_starting = True
         try:
             wave = await loop.run_in_executor(
                 self._exec, self.spec.start_wave,
@@ -191,6 +195,8 @@ class ContinuousBatcher:
                 it.request.params["speculative"] = False
                 heapq.heappush(self._heap, it)
             return False
+        finally:
+            self._spec_starting = False
         self._spec_wave = (wave, items)
         self.stats["spec_waves"] += 1
         self.stats["admitted"] += len(items)
@@ -279,7 +285,7 @@ class ContinuousBatcher:
         self._wake.set()
         if drain:
             while self._heap or self.engine.num_active \
-                    or self._spec_wave is not None:
+                    or self._spec_wave is not None or self._spec_starting:
                 await asyncio.sleep(0.01)
         if self._run_task:
             self._run_task.cancel()

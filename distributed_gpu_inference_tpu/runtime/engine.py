@@ -56,9 +56,12 @@ _COPY_BUCKETS = (1, 2, 4, 8, 16, 32)
 _CORE_I_COLS = 5 + MAX_STOP_IDS
 _BIG_BUDGET = 1 << 30
 # quantized loads: full-precision trees up to this size init on-device
-# (fast) before consume-quantization; larger ones build on host CPU so
-# they never stage full-size in HBM (v5e = 16 GB, leave compile headroom)
-_QUANT_DEVICE_BUILD_LIMIT = 11 * 1024**3
+# (fast) before consume-quantization; larger ones stream/build so they
+# never stage full-size in HBM. 8 GB, not "just fits 16": the tunnel frees
+# consume-quantized bf16 leaves LAZILY, so an 11 GB device build passed
+# this gate and then OOMed the follow-on prefill (observed round 4 on a
+# 4-layer 70B-width slice) — leave real headroom for the reclaim lag.
+_QUANT_DEVICE_BUILD_LIMIT = 8 * 1024**3
 
 
 def _resolve_kv_dtype(kv_cache_dtype: Optional[str], activation_dtype) -> Any:
@@ -248,13 +251,11 @@ class TPUEngine:
                 raise ValueError(
                     "kv_seq_sharded needs a mesh with a seq axis > 1"
                 )
-            if self.cfg.enable_prefix_cache:
-                raise ValueError(
-                    "kv_seq_sharded serves fresh prompts only — set "
-                    "enable_prefix_cache=False (prefill attention runs "
-                    "dense over the chunk, so cached prefixes cannot be "
-                    "attended)"
-                )
+            # prefix caching and chunked/continuation admission compose with
+            # sharded pools since round 4: continuation chunks attend prior
+            # context through the shard_map partial-softmax chunk op
+            # (parallel/ring_attention.seq_parallel_paged_chunk_attention);
+            # only sliding-window models stay fenced (below).
             if self.cfg.resolved_num_blocks() % self._seq_axis:
                 # round the pool UP so the block axis shards evenly
                 blocks = self.cfg.resolved_num_blocks()
@@ -503,6 +504,7 @@ class TPUEngine:
         # during admission
         decode_attn_override = None
         prefill_dense_fn = None
+        chunk_attn_override = None
         if self.cfg.kv_seq_sharded:
             if cfg.sliding_window is not None:
                 raise ValueError(
@@ -512,6 +514,7 @@ class TPUEngine:
                 dense_causal_attention,
             )
             from distributed_gpu_inference_tpu.parallel.ring_attention import (
+                seq_parallel_paged_chunk_attention,
                 seq_parallel_paged_decode_attention,
             )
 
@@ -526,6 +529,16 @@ class TPUEngine:
 
             def prefill_dense_fn(q, k, v, kv_lens):
                 return dense_causal_attention(q, k, v, lengths=kv_lens)
+
+            # continuation/cached chunks: the chunk's KV is in the sharded
+            # pool by the time attention runs, so one partial-softmax read
+            # covers cached prefix + prior chunks + in-chunk causal keys
+            def chunk_attn_override(q, layer_k, layer_v, tables, positions,
+                                    kv_lens):
+                return seq_parallel_paged_chunk_attention(
+                    q, layer_k, layer_v, tables, positions, kv_lens, mesh,
+                    block_size=bs,
+                )
 
         # --- device-state pack/unpack (ONE upload per packed buffer: on a
         # remote-tunnel TPU every host→device transfer is a control RTT, so
@@ -609,6 +622,33 @@ class TPUEngine:
             prefill_chunk, static_argnames=("mode", "sample"),
             donate_argnums=(1,),
         )
+
+        # continuation/cached chunk prefill over seq-sharded pools: same
+        # shape contract as prefill_chunk, but attention reads the pool
+        # through the shard_map partial-softmax chunk op (prior context +
+        # in-chunk keys; the layer step wrote the chunk's KV first)
+        self._prefill_chunk_paged_fn = None
+        if chunk_attn_override is not None:
+            def prefill_chunk_paged(params, kv, toks_pos, table, kv_len,
+                                    keys, temps, top_ks, top_ps, mode,
+                                    sample):
+                out = llama.forward_chunk(
+                    cfg, params, toks_pos[0], toks_pos[1], kv, table, kv_len,
+                    block_size=bs, last_only=True, with_logits=sample,
+                    attn_override=chunk_attn_override,
+                )
+                if not sample:
+                    return None, out.kv
+                first = sample_mode(
+                    out.logits[:, 0, :], keys, kv_len, temps, top_ks,
+                    top_ps, mode,
+                )
+                return first, out.kv
+
+            self._prefill_chunk_paged_fn = jax.jit(
+                prefill_chunk_paged, static_argnames=("mode", "sample"),
+                donate_argnums=(1,),
+            )
 
         def prefill_seq_parallel(params, kv, toks_pos, table, kv_len, keys,
                                  temps, top_ks, top_ps, mode):
@@ -891,8 +931,14 @@ class TPUEngine:
                 admitted.append((slot, seq_id))
                 slots_out.append(slot)
                 n_fresh = len(token_ids) - cached
-                if n_fresh > max_bucket:
-                    # chunked long-prompt path (per request)
+                if n_fresh > max_bucket or (
+                    self.cfg.kv_seq_sharded and cached > 0
+                ):
+                    # chunked long-prompt path (per request). Sharded pools
+                    # also route CACHED prompts here: the batched/sub-wave
+                    # prefill graphs attend dense over the chunk only, which
+                    # cannot see a cached prefix — the chunked path reads it
+                    # through the sharded-pool chunk op.
                     self._submit_allocated(request, slot, seq_id, token_ids, cached)
                     continue
                 bucket = self._bucket_len(max(n_fresh, 1))
@@ -1159,12 +1205,6 @@ class TPUEngine:
         first token IN-GRAPH (the eager sampler here used to cost ~15
         dispatch round-trips on a tunneled TPU); intermediate chunks skip
         the LM head entirely."""
-        if self.cfg.kv_seq_sharded and off > 0:
-            raise RuntimeError(
-                "kv_seq_sharded serves fresh prompts in one pass (dense "
-                "chunk attention cannot see prior context); chunked/"
-                "continued prefill is unsupported in this mode"
-            )
         n = len(piece)
         bucket = (
             self._bucket_len(max(n, 1)) if is_last
@@ -1174,7 +1214,14 @@ class TPUEngine:
         toks_pos[1] = -1
         toks_pos[0, 0, :n] = piece
         toks_pos[1, 0, :n] = np.arange(off, off + n)
-        first, self.kv = self._prefill_chunk_fn(
+        # seq-sharded pools: a chunk with PRIOR context (cached prefix or an
+        # earlier chunk) must read it through the sharded-pool chunk op; a
+        # fresh first chunk keeps the cheaper dense path (off == 0 means
+        # nothing precedes it)
+        prefill_fn = self._prefill_chunk_fn
+        if self.cfg.kv_seq_sharded and off > 0:
+            prefill_fn = self._prefill_chunk_paged_fn
+        first, self.kv = prefill_fn(
             self.params, self.kv, toks_pos,
             self._block_tables[slot : slot + 1],
             np.asarray([off + n], np.int32),
