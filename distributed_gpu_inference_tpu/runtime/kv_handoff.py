@@ -504,9 +504,9 @@ class StreamedExport:
                 "streamed handoff does not support sliding-window models "
                 "(use the one-shot path)"
             )
-        if engine.cfg.kv_seq_sharded:
-            raise ValueError("streamed handoff: kv_seq_sharded engines "
-                             "export via the one-shot path")
+        # kv_seq_sharded donors stream fine since round 4: chunked prefill
+        # composes with sharded pools, and the page gather collects shards
+        # through GSPMD before the host pull
         self.engine = engine
         self.request = request
         self.key = key

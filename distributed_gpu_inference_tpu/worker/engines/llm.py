@@ -308,7 +308,6 @@ class TPULLMEngine(LLMBaseEngine):
             and bool(params.get("pd_stream",
                                 self.config.get("pd_stream", True)))
             and self.engine.model_cfg.sliding_window is None
-            and not self.engine.cfg.kv_seq_sharded
         )
         if stream_ok:
             return self._pd_prefill_streamed(
