@@ -44,6 +44,9 @@ def main() -> None:
     ap.add_argument("--mixed", action="store_true",
                     help="heterogeneous lens 50..ctx (continuous batching)")
     ap.add_argument("--iters", type=int, default=500)
+    ap.add_argument("--int8", action="store_true",
+                    help="also measure the int8-KV (per-token scales) "
+                         "kernel path")
     args = ap.parse_args()
 
     import jax
@@ -88,23 +91,41 @@ def main() -> None:
     pos = (lens - 1)[:, None]
     q = jax.random.normal(ks[3], (b, 1, nh, d), jnp.bfloat16)
 
+    variants = [
+        ("xla", partial(paged_attention_xla, block_size=block), (kp, vp)),
+        ("pallas", partial(paged_attention_pallas, block_size=block),
+         (kp, vp)),
+    ]
+    if args.int8:
+        # int8 pools + per-(page, token) scales (VERDICT r3 #4): HBM sees
+        # ~62% of the bf16 bytes per token; the kernel dequantizes in-page
+        from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+            quantize_kv_pool,
+        )
+
+        kp8, kss = quantize_kv_pool(kp)
+        vp8, vss = quantize_kv_pool(vp)
+        variants.append((
+            "pallas_int8",
+            partial(paged_attention_pallas, block_size=block,
+                    k_scale=kss, v_scale=vss),
+            (kp8, vp8),
+        ))
+
     results = {}
-    for name, att in (
-        ("xla", partial(paged_attention_xla, block_size=block)),
-        ("pallas", partial(paged_attention_pallas, block_size=block)),
-    ):
+    for name, att, pools in variants:
         @jax.jit
-        def many(q, _a=att):
+        def many(q, _a=att, _p=pools):
             def body(i, o):
                 return _a(q + (o * 1e-9).astype(q.dtype),
-                          kp, vp, tables, pos, lens)
+                          _p[0], _p[1], tables, pos, lens)
             return jax.lax.fori_loop(0, iters, body, q)
 
         dt = (timed(many, q) - rtt) / iters
         results[name] = dt * 1e6
 
     live = int(np.sum(np.asarray(lens)))
-    print(json.dumps({
+    out = {
         "metric": "paged_attention_decode_us",
         "xla_us": round(results["xla"], 1),
         "pallas_us": round(results["pallas"], 1),
@@ -114,7 +135,13 @@ def main() -> None:
         ),
         "config": {"batch": b, "ctx": ctx, "mixed": args.mixed,
                    "block_size": block, "backend": jax.default_backend()},
-    }))
+    }
+    if "pallas_int8" in results:
+        out["pallas_int8_us"] = round(results["pallas_int8"], 1)
+        out["int8_vs_bf16"] = round(
+            results["pallas"] / results["pallas_int8"], 2
+        )
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -102,6 +102,15 @@ def main() -> None:
                          "smaller = sharper target at a fixed step budget "
                          "(the tunnel chip kernel-faults under sustained "
                          "training, so steps cannot simply be raised)")
+    ap.add_argument("--feature-layers", default=None,
+                    help="EAGLE-3 multi-layer draft features: comma layer "
+                         "indices (e.g. 1,2,3) or 'auto' (low/mid/high). "
+                         "Default: last layer only (EAGLE-1)")
+    ap.add_argument("--distill-data", default="random",
+                    choices=("random", "on-policy", "task"),
+                    help="distill streams: uniform-random tokens (round-3 "
+                         "behavior), the target's own sampled generations "
+                         "(on-policy), or the trained task distribution")
     ap.add_argument("--quantization", default=None,
                     help="weight-only target quantization (int8 | fp8): the "
                          "flagship 8B target only fits the chip quantized; "
@@ -152,7 +161,10 @@ def main() -> None:
                     "--prompt-len", str(args.prompt_len),
                     "--max-tokens", str(args.max_tokens),
                     "--widths", args.widths,
-                    "--task-vocab", str(args.task_vocab)]
+                    "--task-vocab", str(args.task_vocab),
+                    "--distill-data", args.distill_data]
+            if args.feature_layers:
+                base += ["--feature-layers", args.feature_layers]
             import time as _time
 
             t0 = _time.perf_counter()
@@ -261,6 +273,22 @@ def main() -> None:
     else:
         with Timer() as t_train:
             params, sample_stream = run_training()
+    # EAGLE-3 knobs: multi-layer features + distill-data distribution
+    if args.feature_layers == "auto":
+        L = cfg.num_layers
+        fl = tuple(sorted({max(L // 4, 0), L // 2, L - 1}))
+    elif args.feature_layers:
+        fl = tuple(int(x) for x in args.feature_layers.split(","))
+    else:
+        fl = None
+    distill_kw = dict(feature_layers=fl)
+    if args.distill_data == "on-policy":
+        distill_kw["on_policy"] = True
+    elif args.distill_data == "task":
+        if args.no_train or args.quantization:
+            raise SystemExit("--distill-data task needs a trained target")
+        distill_kw["data_stream"] = sample_stream
+
     with Timer() as t_distill:
         # the tunnel frees an exited process's device memory asynchronously;
         # right after subprocess training the first allocation burst can
@@ -271,7 +299,7 @@ def main() -> None:
             try:
                 draft_params = distill_draft_params(
                     cfg, params, jax.random.PRNGKey(1),
-                    steps=args.distill_steps,
+                    steps=args.distill_steps, **distill_kw,
                 )
                 break
             except Exception as exc:  # noqa: BLE001
@@ -285,7 +313,7 @@ def main() -> None:
         cfg,
         params=params,
         draft_params=draft_params,
-        spec_cfg=SpeculativeConfig(widths=widths),
+        spec_cfg=SpeculativeConfig(widths=widths, feature_layers=fl),
         max_batch_size=args.requests,
         max_seq_len=max_seq,
         prefill_buckets=(args.prompt_len,),
@@ -348,6 +376,8 @@ def main() -> None:
         "draft_distill_s": round(t_distill.elapsed, 1),
         "target_trained": not (args.no_train or args.quantization),
         "quantization": args.quantization,
+        "feature_layers": list(fl) if fl else None,
+        "distill_data": args.distill_data,
     })
 
 

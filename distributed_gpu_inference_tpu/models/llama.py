@@ -274,6 +274,11 @@ class ChunkOutput(NamedTuple):
     kv: KVPools             # updated pools
     logits: Optional[jax.Array]  # [B, S, V] ([B, 1, V] if last_only; None if
                                  # with_logits=False — intermediate chunks)
+    # [B, S, k*H] concat of the requested layers' post-layer hiddens
+    # (collect_layers; EAGLE-3-style multi-layer draft features) — None
+    # unless asked for: stacking every layer's hidden is layer-count x the
+    # activation memory, so only small spec/distill shapes request it
+    features: Optional[jax.Array] = None
 
 
 def _layer_step(
@@ -291,7 +296,8 @@ def _layer_step(
     kv_lens: Optional[jax.Array] = None,  # required when fused_decode
     stacked: Optional[Dict[str, Any]] = None,  # quantized weights kept whole
     dense_attn_fn=None,           # (q, k, v dense chunk) → attn; see below
-) -> Tuple[Tuple[jax.Array, jax.Array, jax.Array, jax.Array], None]:
+    emit_hidden: bool = False,    # scan-emit this layer's hidden (features)
+) -> Tuple[Tuple[jax.Array, jax.Array, jax.Array, jax.Array], Optional[jax.Array]]:
     """One transformer layer over paged KV — shared by the causal decode path
     and the speculative tree-verify path (they differ only in the attention
     mask and in where KV rows are written).
@@ -369,7 +375,9 @@ def _layer_step(
         hidden = hidden + _moe_mlp(mlp_in, lp, cfg)
     else:
         hidden = hidden + _mlp(mlp_in, proj, cfg.activation)
-    return (hidden, k_pool, v_pool, layer_idx + 1), None
+    return (hidden, k_pool, v_pool, layer_idx + 1), (
+        hidden if emit_hidden else None
+    )
 
 
 def forward_chunk(
@@ -389,6 +397,11 @@ def forward_chunk(
                           # replaces the paged-attention read (e.g. the
                           # seq-sharded-pool shard_map op); disables the
                           # fused Pallas path
+    collect_layers: Optional[Tuple[int, ...]] = None,
+                          # also return ChunkOutput.features = concat of
+                          # these layers' post-layer hiddens (EAGLE-3 draft
+                          # features) — costs L x hidden activation memory,
+                          # request only on small spec/distill shapes
 ) -> ChunkOutput:
     """Run S tokens per sequence through all layers against the paged cache.
 
@@ -436,16 +449,22 @@ def forward_chunk(
         kv_lens=kv_lens,
         stacked=stacked,
         dense_attn_fn=dense_attn_fn,
+        emit_hidden=collect_layers is not None,
     )
-    (hidden, k_pool, v_pool, _), _ = lax.scan(
+    (hidden, k_pool, v_pool, _), layer_hs = lax.scan(
         lambda c, lp: step(c, lp),
         (hidden, kv["k"], kv["v"], jnp.int32(0)),
         scanned,
     )
+    features = (
+        jnp.concatenate([layer_hs[i] for i in collect_layers], axis=-1)
+        if collect_layers is not None else None
+    )
 
     if not with_logits:
         return ChunkOutput(
-            hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=None
+            hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=None,
+            features=features,
         )
     if last_only:
         # last valid token per sequence = kv_lens - 1 mapped into the chunk:
@@ -459,7 +478,8 @@ def forward_chunk(
     else:
         logits_in = hidden
     logits = project_logits(cfg, params, logits_in)
-    return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=logits)
+    return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool},
+                       logits=logits, features=features)
 
 
 def forward_tree_chunk(
@@ -474,6 +494,7 @@ def forward_tree_chunk(
     tree_mask: jax.Array,       # [N, N] ancestor-visibility mask
     *,
     block_size: int = 16,
+    collect_layers: Optional[Tuple[int, ...]] = None,
 ) -> ChunkOutput:
     """Target forward over a speculative token tree (the verify pass).
 
@@ -516,13 +537,19 @@ def forward_tree_chunk(
         sin=sin,
         attn_fn=attn_fn,
         stacked=stacked,
+        emit_hidden=collect_layers is not None,
     )
-    (hidden, k_pool, v_pool, _), _ = lax.scan(
+    (hidden, k_pool, v_pool, _), layer_hs = lax.scan(
         lambda c, lp: step(c, lp), (hidden, kv["k"], kv["v"], jnp.int32(0)),
         scanned,
     )
+    features = (
+        jnp.concatenate([layer_hs[i] for i in collect_layers], axis=-1)
+        if collect_layers is not None else None
+    )
     logits = project_logits(cfg, params, hidden)
-    return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=logits)
+    return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool},
+                       logits=logits, features=features)
 
 
 def forward_hidden_chunk(

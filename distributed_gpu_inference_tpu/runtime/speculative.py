@@ -129,6 +129,12 @@ class SpeculativeConfig:
     # lost to vanilla at 0.90x, VERDICT r2 weak #2). Effective depth is
     # bucketed to powers of two so at most log2 variants compile.
     rounds_per_dispatch: int = 8
+    # EAGLE-3-style multi-layer draft features (VERDICT r3 #1b): indices of
+    # target LAYERS whose post-layer hiddens concat into the draft input
+    # (e.g. low/mid/high). None = last-layer-only (EAGLE-1 behavior). The
+    # draft gains a learned [k*H, H] input projection; verify forwards
+    # collect the same layers so the recursion stays consistent.
+    feature_layers: Optional[Tuple[int, ...]] = None
 
 
 # ---------------------------------------------------------------------------
@@ -137,28 +143,45 @@ class SpeculativeConfig:
 
 
 def init_draft_params(
-    cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = None
+    cfg: ModelConfig, key: jax.Array, dtype: Optional[jnp.dtype] = None,
+    num_feature_layers: int = 1,
 ) -> Dict[str, jax.Array]:
     """EAGLE-style draft net: h_next = W2 · silu(W1 · [h ; e(tok)]).
 
     Shares the target's embedding and LM head (reference :94) — only the
-    fusion MLP is new (~2·H² params)."""
+    fusion MLP is new (~2·H² params). ``num_feature_layers > 1`` adds the
+    EAGLE-3 multi-layer input projection W_feat: [k·H] features (concat of
+    k target layers' hiddens) project to H before fusion; deeper draft
+    levels feed the head's own H-dim predictions, so only the projection
+    sees the wide input."""
     dtype = dtype or jnp.dtype(cfg.dtype)
     h = cfg.hidden_size
-    k1, k2 = jax.random.split(key)
-    return {
+    k1, k2, k3 = jax.random.split(key, 3)
+    dp = {
         "w_fuse": (jax.random.normal(k1, (2 * h, h), jnp.float32) * (2 * h) ** -0.5
                    ).astype(dtype),
         "w_out": (jax.random.normal(k2, (h, h), jnp.float32) * h**-0.5
                   ).astype(dtype),
         "norm": jnp.ones((h,), dtype),
     }
+    if num_feature_layers > 1:
+        kh = num_feature_layers * h
+        dp["w_feat"] = (
+            jax.random.normal(k3, (kh, h), jnp.float32) * kh**-0.5
+        ).astype(dtype)
+    return dp
 
 
 def draft_apply(
     cfg: ModelConfig, dp: Dict[str, jax.Array], hidden: jax.Array, tok_emb: jax.Array
 ) -> jax.Array:
-    """[..., H] × [..., H] → predicted next hidden [..., H]."""
+    """[..., H or k·H] × [..., H] → predicted next hidden [..., H].
+
+    A k·H-wide input (multi-layer features from a verify pass) goes through
+    the learned W_feat projection first; H-wide inputs (the draft's own
+    deeper-level predictions) skip it — static shape dispatch."""
+    if "w_feat" in dp and hidden.shape[-1] == dp["w_feat"].shape[0]:
+        hidden = (hidden @ dp["w_feat"].astype(hidden.dtype))
     x = jnp.concatenate([hidden, tok_emb], axis=-1)
     x = jax.nn.silu(x @ dp["w_fuse"]) @ dp["w_out"]
     return llama.rms_norm(x, dp["norm"], cfg.rms_norm_eps)
@@ -174,6 +197,9 @@ def distill_draft_params(
     num_batches: int = 8,
     lr: float = 2e-3,
     ce_weight: float = 0.2,
+    feature_layers: Optional[Tuple[int, ...]] = None,
+    on_policy: bool = False,
+    data_stream=None,
 ) -> Dict[str, jax.Array]:
     """EAGLE-style draft-head distillation against the frozen target.
 
@@ -186,23 +212,69 @@ def distill_draft_params(
     the part that matters for acceptance).
 
     Teacher hidden states are precomputed once for ``num_batches`` fixed
-    random streams; the training loop then runs ``steps`` cheap MLP updates
+    streams; the training loop then runs ``steps`` cheap MLP updates
     jitted on device. Returns draft params in the model dtype.
+
+    EAGLE-3 knobs (VERDICT r3 #1b):
+    - ``feature_layers``: distill the draft on CONCATENATED hiddens of
+      these target layers (adds the ``w_feat`` projection; pass the same
+      tuple as ``SpeculativeConfig.feature_layers`` at serving).
+    - ``on_policy``: draw the distill streams from the TARGET's own
+      sampled generations instead of uniform-random tokens — the
+      distribution the draft must match at serving time.
+    - ``data_stream``: ``fn(key, batch, seq_len) -> [B, S] int32`` custom
+      stream sampler (e.g. the toy-task chain); overrides both defaults.
     """
     import optax
 
     bs = 16
     kd, kt = jax.random.split(key)
-    # ---- teacher pass: hidden states over random token streams
     m = -(-seq_len // bs)
-    tokens_all = jax.random.randint(
-        kt, (num_batches, batch, seq_len), 0, cfg.vocab_size, jnp.int32
-    )
     positions = jnp.tile(jnp.arange(seq_len, dtype=jnp.int32), (batch, 1))
     lens = jnp.full((batch,), seq_len, jnp.int32)
     tables = jnp.asarray(
         np.arange(1, 1 + batch * m, dtype=np.int32).reshape(batch, m)
     )
+
+    # ---- distill streams: custom sampler > on-policy rollouts > random
+    if data_stream is not None:
+        tokens_all = jnp.stack([
+            data_stream(jax.random.fold_in(kt, i), batch, seq_len)
+            for i in range(num_batches)
+        ]).astype(jnp.int32)
+    elif on_policy:
+        @jax.jit
+        def rollout(params, kk):
+            k0, kseq = jax.random.split(kk)
+            first = jax.random.randint(k0, (batch,), 0, cfg.vocab_size,
+                                       jnp.int32)
+            kvp = llama.init_kv_pools(cfg, 1 + batch * m, bs)
+
+            def step(carry, ks_):
+                kvp, tok, pos = carry
+                out = llama.forward_chunk(
+                    cfg, params, tok[:, None], pos[:, None], kvp, tables,
+                    pos + 1, block_size=bs, last_only=True,
+                )
+                nxt = jax.random.categorical(
+                    ks_, out.logits[:, 0].astype(jnp.float32), axis=-1
+                ).astype(jnp.int32)
+                return (out.kv, nxt, pos + 1), nxt
+
+            keys = jax.random.split(kseq, seq_len - 1)
+            (_, _, _), rest = jax.lax.scan(
+                step, (kvp, first, jnp.zeros((batch,), jnp.int32)), keys
+            )
+            return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+        tokens_all = jnp.stack([
+            rollout(params, jax.random.fold_in(kt, i))
+            for i in range(num_batches)
+        ])
+    else:
+        tokens_all = jax.random.randint(
+            kt, (num_batches, batch, seq_len), 0, cfg.vocab_size, jnp.int32
+        )
 
     # teacher labels are TOP-K only: a full [N, B, S, V] float32 log-prob
     # table is ~20 GB at Llama-3/Qwen vocab sizes (this OOM'd 0.5B-scale
@@ -214,32 +286,44 @@ def distill_draft_params(
     # params ride as jit ARGUMENTS, not closure constants: traced closures
     # over multi-GB pytrees get inlined as IR constants (host-materialized),
     # which OOMs at 0.5B+ scale
+    collect = tuple(feature_layers) if feature_layers else None
+
     @jax.jit
     def teacher(params, tokens):
         kv = llama.init_kv_pools(cfg, 1 + batch * m, bs)
         out = llama.forward_chunk(
             cfg, params, tokens, positions, kv, tables, lens,
-            block_size=bs, last_only=False,
+            block_size=bs, last_only=False, collect_layers=collect,
         )
         # target next-token distribution at every position (frozen labels)
         logits = llama.project_logits(cfg, params, out.hidden)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         top_lp, top_idx = jax.lax.top_k(logp, label_k)
-        return out.hidden.astype(jnp.float32), top_lp, top_idx
+        h32 = out.hidden.astype(jnp.float32)
+        feats = out.features.astype(jnp.float32) if collect else h32
+        return h32, feats, top_lp, top_idx
 
-    hiddens, top_lps, top_idxs = [], [], []
+    hiddens, featss, top_lps, top_idxs = [], [], [], []
     for i in range(num_batches):
-        h, lp, idx = teacher(params, tokens_all[i])
+        h, f, lp, idx = teacher(params, tokens_all[i])
         hiddens.append(h)
+        featss.append(f)
         top_lps.append(lp)
         top_idxs.append(idx)
     hiddens = jnp.stack(hiddens)   # [N, B, S, H] float32
+    # no collect → features ARE the final hiddens: alias, don't duplicate
+    # (a second [N,B,S,H] f32 stack matters on the 16 GB chip this distill
+    # already OOM'd at 0.5B scale)
+    featss = hiddens if collect is None else jnp.stack(featss)
     top_lps = jnp.stack(top_lps)   # [N, B, S, K]
     top_idxs = jnp.stack(top_idxs)  # [N, B, S, K] int32
 
     # ---- student: train in float32
     dp = jax.tree.map(
-        lambda a: a.astype(jnp.float32), init_draft_params(cfg, kd)
+        lambda a: a.astype(jnp.float32),
+        init_draft_params(
+            cfg, kd, num_feature_layers=len(collect) if collect else 1
+        ),
     )
     # draft_apply's output rms_norm pins the prediction's magnitude at the
     # norm gain — initialized at 1, while a TIED-embedding target's hiddens
@@ -256,12 +340,14 @@ def distill_draft_params(
     opt_state = opt.init(dp)
     cfg32 = cfg  # rms eps etc. unchanged; draft_apply respects input dtype
 
-    def loss_fn(dp, params, tokens, hidden, top_lp, top_idx):
-        # inputs at t: (h_t, emb(x_{t+1})) → predict h_{t+1}
+    def loss_fn(dp, params, tokens, hidden, feats, top_lp, top_idx):
+        # inputs at t: (features_t, emb(x_{t+1})) → predict h_{t+1} — the
+        # TARGET stays the final-layer hidden (that is what project_logits
+        # reads at verify time); only the INPUT widens to multi-layer
         emb_next = llama.embed_tokens(params, tokens[:, 1:], cfg).astype(
             jnp.float32
         )
-        pred = draft_apply(cfg32, dp, hidden[:, :-1], emb_next)  # [B,S-1,H]
+        pred = draft_apply(cfg32, dp, feats[:, :-1], emb_next)  # [B,S-1,H]
         mse = jnp.mean(jnp.square(pred - hidden[:, 1:]))
         pred_logits = llama.project_logits(cfg, params, pred)
         pred_logp = jax.nn.log_softmax(pred_logits, axis=-1)
@@ -274,13 +360,14 @@ def distill_draft_params(
     # single scan = one compile + one device call (tunnel-friendly);
     # params/teacher data as arguments for the same closure-constant reason
     @jax.jit
-    def train(dp, opt_state, params, tokens_all, hiddens, top_lps, top_idxs):
+    def train(dp, opt_state, params, tokens_all, hiddens, featss, top_lps,
+              top_idxs):
         def step_fn(carry, step):
             dp, opt_state = carry
             i = step % num_batches
             loss, grads = jax.value_and_grad(loss_fn)(
-                dp, params, tokens_all[i], hiddens[i], top_lps[i],
-                top_idxs[i]
+                dp, params, tokens_all[i], hiddens[i], featss[i],
+                top_lps[i], top_idxs[i]
             )
             updates, opt_state = opt.update(grads, opt_state)
             return (optax.apply_updates(dp, updates), opt_state), loss
@@ -290,7 +377,7 @@ def distill_draft_params(
         )
         return dp, losses
 
-    dp, _losses = train(dp, opt_state, params, tokens_all, hiddens,
+    dp, _losses = train(dp, opt_state, params, tokens_all, hiddens, featss,
                         top_lps, top_idxs)
     dtype = jnp.dtype(cfg.dtype)
     return jax.tree.map(lambda a: a.astype(dtype), dp)
@@ -416,10 +503,19 @@ class SpeculativeDecoder:
         self.params = params if params is not None else llama.init_params(
             self.model_cfg, key
         )
+        self._collect = (
+            tuple(self.spec_cfg.feature_layers)
+            if self.spec_cfg.feature_layers else None
+        )
         self.draft_params = (
             draft_params
             if draft_params is not None
-            else init_draft_params(self.model_cfg, jax.random.PRNGKey(seed + 1))
+            else init_draft_params(
+                self.model_cfg, jax.random.PRNGKey(seed + 1),
+                num_feature_layers=(
+                    len(self._collect) if self._collect else 1
+                ),
+            )
         )
         self.kv = llama.init_kv_pools(self.model_cfg, self.num_blocks, block_size)
         self.manager = PagedKVCacheManager(self.num_blocks, block_size)
@@ -439,16 +535,18 @@ class SpeculativeDecoder:
 
     def _build_prefill(self):
         cfg, bs = self.model_cfg, self.block_size
+        collect = self._collect
 
         def prefill(params, kv, tokens, positions, block_table, kv_len):
             out = llama.forward_chunk(
                 cfg, params, tokens, positions, kv, block_table, kv_len,
-                block_size=bs, last_only=True,
+                block_size=bs, last_only=True, collect_layers=collect,
             )
+            src = out.features if collect else out.hidden
             n_valid = jnp.sum((positions >= 0).astype(jnp.int32), axis=1)
             last_idx = jnp.maximum(n_valid - 1, 0)
             h_last = jnp.take_along_axis(
-                out.hidden, last_idx[:, None, None].astype(jnp.int32), axis=1
+                src, last_idx[:, None, None].astype(jnp.int32), axis=1
             )[:, 0, :]
             return out.logits[:, 0, :], h_last, out.kv
 
@@ -460,6 +558,7 @@ class SpeculativeDecoder:
         topo = TreeTopology(widths)
         cfg = self.model_cfg
         bs = self.block_size
+        collect = self._collect
         parents = jnp.asarray(topo.parents)
         depths = jnp.asarray(topo.depths)
         tree_mask = jnp.asarray(topo.ancestor_mask)
@@ -512,6 +611,7 @@ class SpeculativeDecoder:
             out = llama.forward_tree_chunk(
                 cfg, params, tokens, rope_pos, cache_pos, kv, block_tables,
                 prefix_lens, tree_mask, block_size=bs,
+                collect_layers=collect,
             )
             target_pred = jnp.argmax(out.logits, axis=-1).astype(jnp.int32)  # [B,N]
 
@@ -549,7 +649,8 @@ class SpeculativeDecoder:
             )                                                       # [B, dmax]
             bonus = jnp.take_along_axis(target_pred, best[:, None], axis=1)[:, 0]
             new_h = jnp.take_along_axis(
-                out.hidden, best[:, None, None].astype(jnp.int32), axis=1
+                out.features if collect else out.hidden,
+                best[:, None, None].astype(jnp.int32), axis=1,
             )[:, 0, :]
 
             # ---- KV compaction: move accepted nodes' pages to depth order

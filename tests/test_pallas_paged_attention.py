@@ -313,3 +313,72 @@ def test_fused_write_fp8_pools():
     np.testing.assert_array_equal(
         np.asarray(v2[1], np.float32), np.asarray(ref_v, np.float32)
     )
+
+
+# ---------------------------------------------------------------------------
+# int8 KV pool: per-token scales, scores/PV rescale in-kernel (VERDICT r3 #4)
+# ---------------------------------------------------------------------------
+
+
+from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (  # noqa: E402
+    quantize_kv_pool as _quantize_pool,
+)
+
+
+def _compare_int8(args, block, window=None):
+    """Oracle = XLA attention over the DEQUANTIZED pool: the kernel must
+    reproduce the quantized-pool math, not hide extra error beyond it."""
+    q, k_pool, v_pool, tables, positions, lens = args
+    k_i8, ks = _quantize_pool(k_pool)
+    v_i8, vs = _quantize_pool(v_pool)
+    k_deq = k_i8.astype(jnp.float32) * ks.astype(jnp.float32)[:, None, :, :]
+    v_deq = v_i8.astype(jnp.float32) * vs.astype(jnp.float32)[:, None, :, :]
+    want = paged_attention_xla(
+        q, k_deq, v_deq, tables, positions, lens, block, window=window
+    )
+    got = paged_attention_pallas(
+        q, k_i8, v_i8, tables, positions, lens, block, window=window,
+        interpret=True, k_scale=ks, v_scale=vs,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_int8_pool_basic():
+    _compare_int8(_setup(2, [9, 23], nh=4, hkv=2, d=64, block=32, m=4), 32)
+
+
+def test_int8_pool_multi_group():
+    _compare_int8(_setup(2, [300, 17], nh=8, hkv=4, d=64, block=32, m=12), 32)
+
+
+def test_int8_pool_window():
+    _compare_int8(_setup(2, [200, 64], nh=4, hkv=2, d=64, block=32, m=8), 32,
+                  window=48)
+
+
+def test_int8_pool_inactive_rows():
+    args = _setup(3, [40, 1, 16], nh=4, hkv=2, d=64, block=32, m=4)
+    q, k_pool, v_pool, tables, positions, lens = args
+    positions = positions.at[1, 0].set(-1)   # row 1 inactive
+    _compare_int8((q, k_pool, v_pool, tables, positions, lens), 32)
+
+
+def test_int8_quantization_error_vs_full_precision_bounded():
+    """Sanity: int8-KV output stays within ~1% of the FULL-precision
+    attention (per-token amax scaling) — the capacity knob must not wreck
+    quality."""
+    args = _setup(2, [100, 50], nh=4, hkv=2, d=64, block=32, m=4)
+    q, k_pool, v_pool, tables, positions, lens = args
+    full = paged_attention_xla(
+        q, k_pool, v_pool, tables, positions, lens, 32
+    )
+    k_i8, ks = _quantize_pool(k_pool)
+    v_i8, vs = _quantize_pool(v_pool)
+    got = paged_attention_pallas(
+        q, k_i8, v_i8, tables, positions, lens, 32,
+        interpret=True, k_scale=ks, v_scale=vs,
+    )
+    err = float(jnp.max(jnp.abs(got - full)))
+    ref = float(jnp.max(jnp.abs(full)))
+    assert err < 0.02 * max(ref, 1.0), f"int8 KV error too large: {err}"
