@@ -102,6 +102,12 @@ def main() -> None:
                          "smaller = sharper target at a fixed step budget "
                          "(the tunnel chip kernel-faults under sustained "
                          "training, so steps cannot simply be raised)")
+    ap.add_argument("--no-adaptive", action="store_true",
+                    help="pin the tree widths (no adaptive depth changes): "
+                         "mid-measurement depth changes compile fresh "
+                         "step graphs (seconds of XLA time inside the timed "
+                         "window) and make accept rates incomparable "
+                         "across ablation cells")
     ap.add_argument("--feature-layers", default=None,
                     help="EAGLE-3 multi-layer draft features: comma layer "
                          "indices (e.g. 1,2,3) or 'auto' (low/mid/high). "
@@ -165,6 +171,8 @@ def main() -> None:
                     "--distill-data", args.distill_data]
             if args.feature_layers:
                 base += ["--feature-layers", args.feature_layers]
+            if args.no_adaptive:
+                base += ["--no-adaptive"]
             import time as _time
 
             t0 = _time.perf_counter()
@@ -313,7 +321,8 @@ def main() -> None:
         cfg,
         params=params,
         draft_params=draft_params,
-        spec_cfg=SpeculativeConfig(widths=widths, feature_layers=fl),
+        spec_cfg=SpeculativeConfig(widths=widths, feature_layers=fl,
+                                   adaptive=not args.no_adaptive),
         max_batch_size=args.requests,
         max_seq_len=max_seq,
         prefill_buckets=(args.prompt_len,),

@@ -63,7 +63,7 @@ _VMEM_BUDGET_BYTES = 8 * 1024 * 1024
 
 def _pages_per_group(
     block_size: int, hkv: int, head_dim: int, itemsize: int, max_pages: int,
-    staging_pages: int = 0,
+    staging_pages: int = 0, scale_page_bytes: int = 0,
 ) -> int:
     """Pages DMA'd per loop iteration.
 
@@ -71,12 +71,31 @@ def _pages_per_group(
     v5e, amortized against ~0.6us/128-token HBM transfer), but scale DOWN so
     2 slots x G pages x 2 pools — plus ``staging_pages`` write-staging pages
     — fits the VMEM budget regardless of page geometry, and never exceed the
-    static table width."""
-    page_bytes = hkv * block_size * head_dim * itemsize
+    static table width. ``scale_page_bytes``: per-page bytes of the int8
+    path's bf16 scale buffers ([Bk, D] per page, staged AND double-buffered
+    alongside the data pages) — at MQA-ish hkv they rival the int8 data
+    pages, so they must count against the same budget."""
+    page_bytes = hkv * block_size * head_dim * itemsize + scale_page_bytes
     budget = _VMEM_BUDGET_BYTES - staging_pages * page_bytes
     g = max(1, budget // (4 * page_bytes))
     g = min(g, max(512 // block_size, 1), max_pages)
     return max(g, 1)
+
+
+def _quantize_token_rows(x: jax.Array, axes) -> Tuple[jax.Array, jax.Array]:
+    """THE scalar int8-KV quantization contract, shared by the host-side
+    pool quantizer (:func:`quantize_kv_pool`) and the kernel's fused token
+    write so the two can never drift: one scale per token over every
+    (head, channel) element — amax over ``axes`` floored at 1e-6, /127,
+    ROUNDED TO bf16 BEFORE quantizing (the stored int8 must match the
+    stored bf16 scale exactly) — real = int * scale. Returns (int8 like x,
+    f32 scale with ``axes`` kept as size-1 dims)."""
+    amax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    scale = (jnp.maximum(amax, 1e-6) / 127.0).astype(jnp.bfloat16).astype(
+        jnp.float32
+    )
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 def _decode_kernel(
@@ -111,14 +130,16 @@ def _decode_kernel(
     # emulates in software: the round-3 2.2x loss) and the scale multiply
     # rides the same elementwise pass before the bf16 MXU dot
     if quantized:
-        (ks_hbm, vs_hbm, out_ref, ko_hbm, vo_hbm,
+        (_ks_in, _vs_in, out_ref, ko_hbm, vo_hbm, kso_hbm, vso_hbm,
          kbuf, vbuf, ksbuf, vsbuf, sems, ssems, wsems,
-         wk_stage, wv_stage, m_scr, l_scr, acc_scr) = rest
+         wk_stage, wv_stage, wks_stage, wvs_stage,
+         m_scr, l_scr, acc_scr) = rest
     else:
         (out_ref, ko_hbm, vo_hbm,
          kbuf, vbuf, sems, wsems,
          wk_stage, wv_stage, m_scr, l_scr, acc_scr) = rest
-        ks_hbm = vs_hbm = ksbuf = vsbuf = ssems = None
+        kso_hbm = vso_hbm = ksbuf = vsbuf = ssems = None
+        wks_stage = wvs_stage = None
     b = pl.program_id(0)
     i = pl.program_id(1)
     gp = pages_per_group
@@ -171,17 +192,20 @@ def _decode_kernel(
         def stage_copies(r, dst_first):
             valid, page, _ = row_page(r)
             st = r % n_stage
-            ck = pltpu.make_async_copy(
-                ko_hbm.at[layer, page], wk_stage.at[st], wsems.at[0, st]
-            ) if dst_first else pltpu.make_async_copy(
-                wk_stage.at[st], ko_hbm.at[layer, page], wsems.at[0, st]
-            )
-            cv = pltpu.make_async_copy(
-                vo_hbm.at[layer, page], wv_stage.at[st], wsems.at[1, st]
-            ) if dst_first else pltpu.make_async_copy(
-                wv_stage.at[st], vo_hbm.at[layer, page], wsems.at[1, st]
-            )
-            return valid, ck, cv
+
+            def cp(hbm, stage, sem):
+                return pltpu.make_async_copy(
+                    hbm.at[layer, page], stage.at[st], sem
+                ) if dst_first else pltpu.make_async_copy(
+                    stage.at[st], hbm.at[layer, page], sem
+                )
+
+            copies = [cp(ko_hbm, wk_stage, wsems.at[0, st]),
+                      cp(vo_hbm, wv_stage, wsems.at[1, st])]
+            if quantized:
+                copies += [cp(kso_hbm, wks_stage, wsems.at[2, st]),
+                           cp(vso_hbm, wvs_stage, wsems.at[3, st])]
+            return valid, copies
 
         @pl.when((b == 0) & (i == 0))
         def _():
@@ -192,20 +216,20 @@ def _decode_kernel(
             for c0 in range(0, batch, n_stage):
                 rows = range(c0, min(c0 + n_stage, batch))
                 for r in rows:  # static unroll over rows
-                    valid, ck, cv = stage_copies(r, dst_first=True)
+                    valid, copies = stage_copies(r, dst_first=True)
 
                     @pl.when(valid)
                     def _():
-                        ck.start()
-                        cv.start()
+                        for c in copies:
+                            c.start()
 
                 for r in rows:
-                    valid, ck, cv = stage_copies(r, dst_first=True)
+                    valid, copies = stage_copies(r, dst_first=True)
 
                     @pl.when(valid)
                     def _():
-                        ck.wait()
-                        cv.wait()
+                        for c in copies:
+                            c.wait()
 
                 for r in rows:
                     valid, _page, slot = row_page(r)
@@ -219,28 +243,56 @@ def _decode_kernel(
                             )
                             == slot
                         )
-                        wk_stage[st] = jnp.where(
-                            sel, newk_ref[r][:, None, :], wk_stage[st]
-                        )
-                        wv_stage[st] = jnp.where(
-                            sel, newv_ref[r][:, None, :], wv_stage[st]
-                        )
+                        if quantized:
+                            # quantize the new rows IN-KERNEL through the
+                            # shared contract: one scale over the token's
+                            # whole (Hkv, D) row block
+                            newk = newk_ref[r].astype(jnp.float32)
+                            newv = newv_ref[r].astype(jnp.float32)
+                            ki, sk = _quantize_token_rows(newk, (0, 1))
+                            vi, sv = _quantize_token_rows(newv, (0, 1))
+                            sk, sv = sk[0, 0], sv[0, 0]
+                            wk_stage[st] = jnp.where(
+                                sel, ki[:, None, :], wk_stage[st]
+                            )
+                            wv_stage[st] = jnp.where(
+                                sel, vi[:, None, :], wv_stage[st]
+                            )
+                            sel_s = (
+                                lax.broadcasted_iota(
+                                    jnp.int32, (block_size, d), 0
+                                )
+                                == slot
+                            )
+                            wks_stage[st] = jnp.where(
+                                sel_s, sk.astype(jnp.bfloat16), wks_stage[st]
+                            )
+                            wvs_stage[st] = jnp.where(
+                                sel_s, sv.astype(jnp.bfloat16), wvs_stage[st]
+                            )
+                        else:
+                            wk_stage[st] = jnp.where(
+                                sel, newk_ref[r][:, None, :], wk_stage[st]
+                            )
+                            wv_stage[st] = jnp.where(
+                                sel, newv_ref[r][:, None, :], wv_stage[st]
+                            )
 
                 for r in rows:
-                    valid, ck, cv = stage_copies(r, dst_first=False)
+                    valid, copies = stage_copies(r, dst_first=False)
 
                     @pl.when(valid)
                     def _():
-                        ck.start()
-                        cv.start()
+                        for c in copies:
+                            c.start()
 
                 for r in rows:
-                    valid, ck, cv = stage_copies(r, dst_first=False)
+                    valid, copies = stage_copies(r, dst_first=False)
 
                     @pl.when(valid)
                     def _():
-                        ck.wait()
-                        cv.wait()
+                        for c in copies:
+                            c.wait()
 
     def start_dma(s, j, slot):
         """Issue the page DMAs of group j of sequence s into buffer slot.
@@ -257,12 +309,14 @@ def _decode_kernel(
                 vo_hbm.at[layer, page], vbuf.at[slot, p], sems.at[1, slot, p]
             ).start()
             if quantized:
+                # via the ALIASED outputs: this step's written scales must
+                # be visible to its own attention, like the data pages
                 pltpu.make_async_copy(
-                    ks_hbm.at[layer, page], ksbuf.at[slot, p],
+                    kso_hbm.at[layer, page], ksbuf.at[slot, p],
                     ssems.at[0, slot, p],
                 ).start()
                 pltpu.make_async_copy(
-                    vs_hbm.at[layer, page], vsbuf.at[slot, p],
+                    vso_hbm.at[layer, page], vsbuf.at[slot, p],
                     ssems.at[1, slot, p],
                 ).start()
 
@@ -278,11 +332,11 @@ def _decode_kernel(
             ).wait()
             if quantized:
                 pltpu.make_async_copy(
-                    ks_hbm.at[layer, page], ksbuf.at[slot, p],
+                    kso_hbm.at[layer, page], ksbuf.at[slot, p],
                     ssems.at[0, slot, p],
                 ).wait()
                 pltpu.make_async_copy(
-                    vs_hbm.at[layer, page], vsbuf.at[slot, p],
+                    vso_hbm.at[layer, page], vsbuf.at[slot, p],
                     ssems.at[1, slot, p],
                 ).wait()
 
@@ -420,7 +474,8 @@ def _call_decode_kernel(
     interpret: bool,
     k_scale: Optional[jax.Array] = None,   # [L, N, Bk, D] bf16 lane-replicated
     v_scale: Optional[jax.Array] = None,   # (int8 pools; see paged_attention_pallas)
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, ...]:
+    # → (out, k_pool, v_pool) — plus (k_scale, v_scale) when quantized
     b, s, nh, d = q.shape
     if (k_scale is None) != (v_scale is None):
         raise ValueError(
@@ -429,11 +484,6 @@ def _call_decode_kernel(
             "codes as real values"
         )
     quantized = k_scale is not None
-    if quantized and fused_write:
-        raise ValueError(
-            "int8-KV fused write is not implemented; quantized pools serve "
-            "the read path (engine writes quantize in the layer step)"
-        )
     if s != 1:
         raise ValueError("pallas paged attention is the decode (S=1) kernel")
     if d % 128 != 0 and not interpret:
@@ -447,15 +497,19 @@ def _call_decode_kernel(
     m = block_tables.shape[1]
     # write staging: up to `b` pages per pool, capped so 2 pools of staging
     # never take more than half the VMEM budget (rows are chunked through
-    # the staging pages when b exceeds the cap)
-    page_bytes = hkv * block_size * d * k_pool.dtype.itemsize
+    # the staging pages when b exceeds the cap). int8 pools stage a bf16
+    # [Bk, D] scale page per data page (buffers AND staging), which at
+    # MQA-ish hkv rivals the int8 page itself — count it.
+    scale_page_bytes = block_size * d * 2 if quantized else 0
+    page_bytes = hkv * block_size * d * k_pool.dtype.itemsize \
+        + scale_page_bytes
     if fused_write:
         n_stage = max(1, min(b, _VMEM_BUDGET_BYTES // 2 // (2 * page_bytes)))
     else:
         n_stage = 1
     gp = _pages_per_group(
         block_size, hkv, d, k_pool.dtype.itemsize, m,
-        staging_pages=2 * n_stage,
+        staging_pages=2 * n_stage, scale_page_bytes=scale_page_bytes,
     )
     max_groups = -(-m // gp)
 
@@ -476,10 +530,23 @@ def _call_decode_kernel(
         pltpu.VMEM((2, gp, hkv, block_size, d), k_pool.dtype),
         pltpu.VMEM((2, gp, hkv, block_size, d), v_pool.dtype),
     ]
+    out_specs = [
+        pl.BlockSpec(
+            (1, 1, nh, d),
+            lambda i, j, *_refs: (i, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=pltpu.HBM),
+    ]
     if quantized:
         in_specs += [
             pl.BlockSpec(memory_space=pltpu.HBM),   # k_scale
             pl.BlockSpec(memory_space=pltpu.HBM),   # v_scale
+        ]
+        out_specs += [
+            pl.BlockSpec(memory_space=pltpu.HBM),   # k_scale (aliased)
+            pl.BlockSpec(memory_space=pltpu.HBM),   # v_scale (aliased)
         ]
         scratch += [
             pltpu.VMEM((2, gp, block_size, d), jnp.bfloat16),    # ksbuf
@@ -489,9 +556,16 @@ def _call_decode_kernel(
     if quantized:
         scratch += [pltpu.SemaphoreType.DMA((2, 2, gp))]         # ssems
     scratch += [
-        pltpu.SemaphoreType.DMA((2, b)),                         # wsems
+        pltpu.SemaphoreType.DMA((4 if quantized else 2, b)),     # wsems
         pltpu.VMEM((n_stage, hkv, block_size, d), k_pool.dtype),
         pltpu.VMEM((n_stage, hkv, block_size, d), v_pool.dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((n_stage, block_size, d), jnp.bfloat16),  # wks_stage
+            pltpu.VMEM((n_stage, block_size, d), jnp.bfloat16),  # wvs_stage
+        ]
+    scratch += [
         pltpu.VMEM((hkv, nh // hkv), jnp.float32),
         pltpu.VMEM((hkv, nh // hkv), jnp.float32),
         pltpu.VMEM((hkv, nh // hkv, d), jnp.float32),
@@ -501,15 +575,7 @@ def _call_decode_kernel(
         num_scalar_prefetch=7,
         grid=(b, max_groups),
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec(
-                (1, 1, nh, d),
-                lambda i, j, *_refs: (i, 0, 0, 0),
-                memory_space=pltpu.VMEM,
-            ),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-            pl.BlockSpec(memory_space=pltpu.HBM),
-        ],
+        out_specs=out_specs,
         scratch_shapes=scratch,
     )
     kernel = functools.partial(
@@ -533,27 +599,35 @@ def _call_decode_kernel(
         jnp.ones((1,), jnp.int32),    # init_flag
         q, new_k, new_v, k_pool, v_pool,
     ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, 1, nh, d), q.dtype),
+        jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+        jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
+    ]
+    # operand order: 7 scalar-prefetch args, then q, new_k, new_v,
+    # k_pool (idx 10), v_pool (idx 11) → aliased to outputs 1, 2;
+    # quantized adds scale pools (idx 12, 13) aliased to outputs 3, 4 so
+    # the fused write's quantization scales land in place
+    aliases = {10: 1, 11: 2}
     if quantized:
         operands += [k_scale.astype(jnp.bfloat16),
                      v_scale.astype(jnp.bfloat16)]
-    out, k_pool, v_pool = pl.pallas_call(
+        out_shape += [
+            jax.ShapeDtypeStruct(k_scale.shape, jnp.bfloat16),
+            jax.ShapeDtypeStruct(v_scale.shape, jnp.bfloat16),
+        ]
+        aliases.update({12: 3, 13: 4})
+    results = pl.pallas_call(
         kernel,
-        out_shape=[
-            jax.ShapeDtypeStruct((b, 1, nh, d), q.dtype),
-            jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
-            jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype),
-        ],
+        out_shape=out_shape,
         grid_spec=grid_spec,
-        # operand order: 7 scalar-prefetch args, then q, new_k, new_v,
-        # k_pool (idx 10), v_pool (idx 11) → aliased to outputs 1, 2
-        # (scale pools, when present, are read-only inputs 12, 13)
-        input_output_aliases={10: 1, 11: 2},
+        input_output_aliases=aliases,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
     )(*operands)
-    return out, k_pool, v_pool
+    return results  # (out, k, v[, k_scale, v_scale])
 
 
 def paged_decode_attention_fused(
@@ -570,35 +644,38 @@ def paged_decode_attention_fused(
     block_size: int = 16,
     window: Optional[int] = None,
     interpret: bool = False,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    k_scale: Optional[jax.Array] = None,   # [L, N, Bk, D] bf16 (int8 pools)
+    v_scale: Optional[jax.Array] = None,
+):
     """The per-layer decode step: write this step's K/V rows into their page
     slots AND attend over the updated paged context, in one kernel with the
-    pools aliased in place. → (attn [B, 1, Nh, D], k_pool, v_pool)."""
+    pools aliased in place. → (attn [B, 1, Nh, D], k_pool, v_pool) — plus
+    (k_scale, v_scale) when the pools are int8 (the kernel quantizes the
+    new rows in place and the step's scales ride the aliased scale
+    pools)."""
     pos = positions[:, 0]
     return _call_decode_kernel(
         q, new_k[:, 0], new_v[:, 0], k_pool, v_pool, layer_idx,
         block_tables, pos, pos, kv_lens, block_size, window,
         fused_write=True, interpret=interpret,
+        k_scale=k_scale, v_scale=v_scale,
     )
 
 
 def quantize_kv_pool(pool: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """bf16/f32 pool [N, Hkv, Bk, D] → (int8 pool, [N, Bk, D] bf16 scales).
 
-    THE storage contract of the int8-KV kernel path (single definition —
-    tests and benchmarks import it so the layout cannot drift): one scale
-    per (page, token), amax over (Hkv, D) shared across KV heads, floored
-    at 1e-6, /127, stored lane-replicated over D as bf16; real = int *
-    scale."""
+    The STORAGE layout of the int8-KV kernel path (tests and benchmarks
+    import it so it cannot drift): one scale per (page, token), amax over
+    (Hkv, D) shared across KV heads, stored lane-replicated over D as
+    bf16; real = int * scale. The scalar contract itself lives in
+    ``_quantize_token_rows`` — shared with the kernel's fused token
+    write."""
     n, _, bk, d = pool.shape
-    amax = jnp.max(jnp.abs(pool.astype(jnp.float32)), axis=(1, 3))  # [N, Bk]
-    scale = (jnp.maximum(amax, 1e-6) / 127.0).astype(jnp.bfloat16)
-    q = jnp.clip(
-        jnp.round(pool.astype(jnp.float32)
-                  / scale.astype(jnp.float32)[:, None, :, None]),
-        -127, 127,
-    ).astype(jnp.int8)
-    return q, jnp.broadcast_to(scale[:, :, None], (n, bk, d))
+    q, scale = _quantize_token_rows(pool.astype(jnp.float32), (1, 3))
+    return q, jnp.broadcast_to(
+        scale[:, 0, :, 0, None].astype(jnp.bfloat16), (n, bk, d)
+    )
 
 
 @functools.partial(
@@ -630,7 +707,7 @@ def paged_attention_pallas(
     b, _, nh, d = q.shape
     hkv = k_pool.shape[1]
     zeros = jnp.zeros((b, hkv, d), jnp.bfloat16)
-    out, _, _ = _call_decode_kernel(
+    results = _call_decode_kernel(
         q, zeros, zeros, k_pool[None], v_pool[None], jnp.int32(0),
         block_tables, positions[:, 0],
         jnp.full((b,), -1, jnp.int32),   # no writes
@@ -639,4 +716,4 @@ def paged_attention_pallas(
         k_scale=None if k_scale is None else k_scale[None],
         v_scale=None if v_scale is None else v_scale[None],
     )
-    return out
+    return results[0]

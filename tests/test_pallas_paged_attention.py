@@ -382,3 +382,68 @@ def test_int8_quantization_error_vs_full_precision_bounded():
     err = float(jnp.max(jnp.abs(got - full)))
     ref = float(jnp.max(jnp.abs(full)))
     assert err < 0.02 * max(ref, 1.0), f"int8 KV error too large: {err}"
+
+
+def test_int8_fused_write_quantizes_in_kernel():
+    """Fused decode on int8 pools: the kernel quantizes this step's K/V
+    rows in place (per-token scale, same contract as quantize_kv_pool) and
+    its own attention sees them. Oracle: quantize the row on the host with
+    the same contract, place it in the pool, run the read-only path."""
+    from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+        paged_decode_attention_fused,
+    )
+
+    b, nh, hkv, d, block, m = 2, 4, 2, 64, 32, 3
+    args = _setup(b, [33, 9], nh, hkv, d, block, m)
+    q, k_pool, v_pool, tables, positions, lens = args
+    # context BEFORE this step's token
+    prev_lens = jnp.asarray([32, 8], jnp.int32)
+    new_lens = prev_lens + 1
+    wpos = prev_lens[:, None]        # write at the next slot
+
+    key = jax.random.PRNGKey(9)
+    new_k = jax.random.normal(key, (b, 1, hkv, d), jnp.float32)
+    new_v = jax.random.normal(jax.random.fold_in(key, 1), (b, 1, hkv, d),
+                              jnp.float32)
+
+    k_i8, ks = _quantize_pool(k_pool)
+    v_i8, vs = _quantize_pool(v_pool)
+
+    out, k2, v2, ks2, vs2 = paged_decode_attention_fused(
+        q, new_k, new_v, k_i8[None], v_i8[None], jnp.int32(0),
+        tables, wpos, new_lens, block, interpret=True,
+        k_scale=ks[None], v_scale=vs[None],
+    )
+
+    # oracle: quantize the new rows host-side with the same contract and
+    # rebuild the dequantized pool the kernel should have attended over
+    def host_write(pool_i8, scales, new_rows):
+        pool_i8, scales = np.asarray(pool_i8).copy(), \
+            np.asarray(scales, np.float32).copy()
+        for r in range(b):
+            p = int(np.asarray(tables)[r, int(prev_lens[r]) // block])
+            slot = int(prev_lens[r]) % block
+            row = np.asarray(new_rows[r, 0], np.float32)      # [Hkv, D]
+            s = np.float32(max(np.abs(row).max(), 1e-6) / 127.0)
+            s = np.float32(jnp.bfloat16(s))                   # stored bf16
+            pool_i8[p, :, slot, :] = np.clip(
+                np.round(row / s), -127, 127
+            ).astype(np.int8)
+            scales[p, slot, :] = s
+        return pool_i8, scales
+
+    k_ref, ks_ref = host_write(k_i8, ks, new_k)
+    v_ref, vs_ref = host_write(v_i8, vs, new_v)
+    np.testing.assert_array_equal(np.asarray(k2[0]), k_ref)
+    np.testing.assert_array_equal(np.asarray(v2[0]), v_ref)
+    np.testing.assert_allclose(np.asarray(ks2[0], np.float32), ks_ref,
+                               rtol=1e-2, atol=1e-4)
+
+    k_deq = k_ref.astype(np.float32) * np.asarray(ks_ref)[:, None, :, :]
+    v_deq = v_ref.astype(np.float32) * np.asarray(vs_ref)[:, None, :, :]
+    want = paged_attention_xla(
+        q, jnp.asarray(k_deq), jnp.asarray(v_deq), tables,
+        prev_lens[:, None], new_lens, block
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)

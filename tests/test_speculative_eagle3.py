@@ -1,0 +1,110 @@
+"""EAGLE-3 ingredients (VERDICT r3 #1b): multi-layer draft features +
+on-policy distillation, unit-covered so the serving/distill paths can't
+silently break between benchmark rounds."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from distributed_gpu_inference_tpu.models import llama
+from distributed_gpu_inference_tpu.models.configs import get_model_config
+from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
+from distributed_gpu_inference_tpu.runtime.speculative import (
+    SpeculativeConfig,
+    SpeculativeDecoder,
+    distill_draft_params,
+    draft_apply,
+    init_draft_params,
+)
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+CFG = get_model_config("llama3-tiny", dtype="float32")
+FL = (1, 2, 3)      # low/mid/high of the 4-layer tiny model
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0), jnp.float32)
+
+
+def test_forward_chunk_collect_layers_shapes(params):
+    b, s, bs, m = 2, 16, 16, 2
+    kv = llama.init_kv_pools(CFG, 1 + b * m, bs, jnp.float32)
+    toks = jnp.zeros((b, s), jnp.int32)
+    pos = jnp.tile(jnp.arange(s, dtype=jnp.int32), (b, 1))
+    tables = jnp.asarray(
+        np.arange(1, 1 + b * m, dtype=np.int32).reshape(b, m))
+    lens = jnp.full((b,), s, jnp.int32)
+    out = llama.forward_chunk(CFG, params, toks, pos, kv, tables, lens,
+                              block_size=bs, last_only=False,
+                              collect_layers=FL)
+    assert out.features.shape == (b, s, len(FL) * CFG.hidden_size)
+    # the last collected layer IS the final hidden (post-layer == pre-norm)
+    np.testing.assert_allclose(
+        np.asarray(out.features[..., -CFG.hidden_size:]),
+        np.asarray(out.hidden), rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_draft_apply_w_feat_shape_dispatch():
+    dp = init_draft_params(CFG, jax.random.PRNGKey(1),
+                           num_feature_layers=len(FL))
+    assert dp["w_feat"].shape == (len(FL) * CFG.hidden_size, CFG.hidden_size)
+    h = CFG.hidden_size
+    wide = jnp.ones((2, len(FL) * h), jnp.float32)
+    narrow = jnp.ones((2, h), jnp.float32)
+    emb = jnp.ones((2, h), jnp.float32)
+    # both widths produce H-dim predictions (root vs deeper-level inputs)
+    assert draft_apply(CFG, dp, wide, emb).shape == (2, h)
+    assert draft_apply(CFG, dp, narrow, emb).shape == (2, h)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(feature_layers=FL),
+    dict(feature_layers=FL, on_policy=True),
+    dict(on_policy=True),
+])
+def test_distill_variants_and_serving_bit_exact(params, kw):
+    dp = distill_draft_params(CFG, params, jax.random.PRNGKey(2), steps=12,
+                              num_batches=2, **kw)
+    fl = kw.get("feature_layers")
+    assert ("w_feat" in dp) == (fl is not None)
+    spec = SpeculativeDecoder(
+        CFG, params=params, draft_params=dp,
+        spec_cfg=SpeculativeConfig(widths=(2, 2), adaptive=False,
+                                   feature_layers=fl),
+        max_batch_size=2, max_seq_len=128, block_size=16,
+        prefill_buckets=(16,),
+    )
+    eng = TPUEngine(CFG, EngineConfig(
+        max_batch_size=2, max_seq_len=128, block_size=16,
+        prefill_buckets=(16,), dtype="float32",
+        enable_prefix_cache=False), params=params)
+    prompt = [(i * 29 + 3) % 500 for i in range(14)]
+    req = lambda: InferenceRequest(  # noqa: E731
+        prompt_token_ids=list(prompt),
+        sampling=SamplingParams(max_new_tokens=10, temperature=0.0))
+    got = spec.generate([req()])[0]
+    want = eng.generate([req()])[0]
+    # the verify construction guarantees bit-exactness regardless of
+    # acceptance — this is the invariant a broken feature path would break
+    assert got.token_ids == want.token_ids
+    assert spec.get_stats()["drafted"] > 0
+
+
+def test_custom_data_stream(params):
+    calls = []
+
+    def stream(key, b, s):
+        calls.append((b, s))
+        return jax.random.randint(key, (b, s), 0, CFG.vocab_size, jnp.int32)
+
+    dp = distill_draft_params(CFG, params, jax.random.PRNGKey(3), steps=6,
+                              num_batches=2, data_stream=stream)
+    assert len(calls) == 2 and "w_fuse" in dp
