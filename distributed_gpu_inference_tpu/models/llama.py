@@ -112,10 +112,20 @@ def init_kv_pools(
     Layout ``[L, N, Hkv, Bk, D]`` (head-major pages, like vLLM's pools and
     the reference's CacheBlock [max_blocks, heads, block, head_dim],
     kv_cache.py:130-144): a (page, head) slice is a contiguous [Bk, D] tile,
-    which the Pallas decode kernel DMAs without breaking TPU tiling."""
-    dtype = dtype or jnp.dtype(cfg.dtype)
+    which the Pallas decode kernel DMAs without breaking TPU tiling.
+
+    ``dtype=int8``: quantized pools — the dict additionally carries
+    ``k_scale``/``v_scale`` ([L, N, Bk, D] bf16, lane-replicated): one
+    scale per (page, token) shared across KV heads (real = int * scale;
+    contract: ``ops.paged_attention_pallas._quantize_token_rows``)."""
+    dtype = jnp.dtype(dtype or cfg.dtype)
     shape = (cfg.num_layers, num_blocks, cfg.num_kv_heads, block_size, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    pools = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if dtype == jnp.int8:
+        sshape = (cfg.num_layers, num_blocks, block_size, cfg.head_dim)
+        pools["k_scale"] = jnp.zeros(sshape, jnp.bfloat16)
+        pools["v_scale"] = jnp.zeros(sshape, jnp.bfloat16)
+    return pools
 
 
 # ---------------------------------------------------------------------------
@@ -158,6 +168,25 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     ).astype(x.dtype)
 
 
+def _page_scatter_indices(
+    num_blocks: int, block_tables: jax.Array, positions: jax.Array,
+    block_size: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """(flat_phys, flat_slot) for scattering per-token rows into a paged
+    pool — THE one copy of the OOB-drop index math, shared by the data and
+    scale scatters so they can never desynchronize. Pad writes (position <
+    0) map to the OUT-OF-RANGE block ``num_blocks`` and are dropped: -1
+    would *wrap* to the last block under jax .at[] semantics (negative
+    indices stay in-bounds)."""
+    valid = positions >= 0
+    safe_pos = jnp.where(valid, positions, 0)
+    logical = safe_pos // block_size                       # [B, S]
+    slot = safe_pos % block_size                           # [B, S]
+    phys = jnp.take_along_axis(block_tables, logical, axis=1)  # [B, S]
+    phys = jnp.where(valid, phys, num_blocks)
+    return phys.reshape(-1), slot.reshape(-1)
+
+
 def _write_kv_pages(
     pool: jax.Array,          # [N, Hkv, Bk, D] (single layer)
     new: jax.Array,           # [B, S, Hkv, D]
@@ -170,17 +199,9 @@ def _write_kv_pages(
     Padded slots (position < 0) scatter out-of-bounds and are dropped.
     """
     b, s = positions.shape
-    num_blocks = pool.shape[0]
-    valid = positions >= 0
-    safe_pos = jnp.where(valid, positions, 0)
-    logical = safe_pos // block_size                       # [B, S]
-    slot = safe_pos % block_size                           # [B, S]
-    phys = jnp.take_along_axis(block_tables, logical, axis=1)  # [B, S]
-    # pad writes must go OUT OF RANGE to be dropped: -1 would *wrap* to the
-    # last block under jax .at[] semantics (negative indices stay in-bounds)
-    phys = jnp.where(valid, phys, num_blocks)
-    flat_phys = phys.reshape(-1)
-    flat_slot = slot.reshape(-1)
+    flat_phys, flat_slot = _page_scatter_indices(
+        pool.shape[0], block_tables, positions, block_size
+    )
     # pool may store a narrower dtype than the activations (fp8 KV cache)
     flat_new = new.astype(pool.dtype).reshape(b * s, *new.shape[2:])  # [T,Hkv,D]
     # advanced indices (dims 0 and 2) separated by the head slice: result
@@ -188,6 +209,23 @@ def _write_kv_pages(
     # no unique_indices: padded rows all collapse to the same OOB index, and
     # promising uniqueness there would be undefined behavior
     return pool.at[flat_phys, :, flat_slot].set(flat_new, mode="drop")
+
+
+def _write_scale_pages(
+    pool: jax.Array,          # [N, Bk, D] bf16 scale pool (single layer)
+    new: jax.Array,           # [B, S, D] per-token scale rows (lane-replicated)
+    block_tables: jax.Array,  # [B, M]
+    positions: jax.Array,     # [B, S] (-1 = pad)
+    block_size: int,
+) -> jax.Array:
+    """Scatter int8-KV scale rows — shares :func:`_page_scatter_indices`
+    with the data scatter (same OOB-drop semantics by construction)."""
+    b, s = positions.shape
+    flat_phys, flat_slot = _page_scatter_indices(
+        pool.shape[0], block_tables, positions, block_size
+    )
+    flat_new = new.astype(pool.dtype).reshape(b * s, new.shape[-1])
+    return pool.at[flat_phys, flat_slot].set(flat_new, mode="drop")
 
 
 def _mlp(x: jax.Array, proj, activation: str = "silu") -> jax.Array:
@@ -321,7 +359,16 @@ def _layer_step(
     (``parallel/ring_attention.py``) here so a long prompt's attention
     spreads over the ``seq`` mesh axis while KV pages still land in the
     same paged pools decode reads (SURVEY §5.7)."""
-    hidden, k_pool, v_pool, layer_idx = carry
+    hidden, k_ent, v_ent, layer_idx = carry
+    # int8-KV pools travel as (pool, scale_pool) tuples through the scan
+    # carry; bf16 pools stay bare arrays (static structure, zero overhead)
+    quant_kv = isinstance(k_ent, tuple)
+    if quant_kv:
+        k_pool, k_scale_pool = k_ent
+        v_pool, v_scale_pool = v_ent
+    else:
+        k_pool, v_pool = k_ent, v_ent
+        k_scale_pool = v_scale_pool = None
     b, s, _ = hidden.shape
     nh, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -349,23 +396,64 @@ def _layer_step(
             paged_decode_attention_fused,
         )
 
-        attn, k_pool, v_pool = paged_decode_attention_fused(
-            q, k.astype(k_pool.dtype), v.astype(v_pool.dtype),
-            k_pool, v_pool, layer_idx, block_tables,
-            write_positions, kv_lens, block_size,
-            window=cfg.sliding_window,
-        )
+        if quant_kv:
+            # the kernel quantizes the new rows in place (shared contract)
+            attn, k_pool, v_pool, k_scale_pool, v_scale_pool = \
+                paged_decode_attention_fused(
+                    q, k.astype(jnp.bfloat16), v.astype(jnp.bfloat16),
+                    k_pool, v_pool, layer_idx, block_tables,
+                    write_positions, kv_lens, block_size,
+                    window=cfg.sliding_window,
+                    k_scale=k_scale_pool, v_scale=v_scale_pool,
+                )
+        else:
+            attn, k_pool, v_pool = paged_decode_attention_fused(
+                q, k.astype(k_pool.dtype), v.astype(v_pool.dtype),
+                k_pool, v_pool, layer_idx, block_tables,
+                write_positions, kv_lens, block_size,
+                window=cfg.sliding_window,
+            )
     else:
         layer_k = lax.dynamic_index_in_dim(k_pool, layer_idx, 0, keepdims=False)
         layer_v = lax.dynamic_index_in_dim(v_pool, layer_idx, 0, keepdims=False)
-        layer_k = _write_kv_pages(layer_k, k, block_tables, write_positions, block_size)
-        layer_v = _write_kv_pages(layer_v, v, block_tables, write_positions, block_size)
+        layer_ks = layer_vs = None
+        if quant_kv:
+            from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+                _quantize_token_rows,
+            )
+
+            # per-token quantize over (Hkv, D), scale rows lane-replicated
+            k_q, k_s = _quantize_token_rows(k.astype(jnp.float32), (2, 3))
+            v_q, v_s = _quantize_token_rows(v.astype(jnp.float32), (2, 3))
+            layer_ks = lax.dynamic_index_in_dim(
+                k_scale_pool, layer_idx, 0, keepdims=False)
+            layer_vs = lax.dynamic_index_in_dim(
+                v_scale_pool, layer_idx, 0, keepdims=False)
+            layer_k = _write_kv_pages(
+                layer_k, k_q, block_tables, write_positions, block_size)
+            layer_v = _write_kv_pages(
+                layer_v, v_q, block_tables, write_positions, block_size)
+            layer_ks = _write_scale_pages(
+                layer_ks, jnp.broadcast_to(k_s[:, :, 0, :], (b, s, d)),
+                block_tables, write_positions, block_size)
+            layer_vs = _write_scale_pages(
+                layer_vs, jnp.broadcast_to(v_s[:, :, 0, :], (b, s, d)),
+                block_tables, write_positions, block_size)
+            k_scale_pool = lax.dynamic_update_index_in_dim(
+                k_scale_pool, layer_ks, layer_idx, 0)
+            v_scale_pool = lax.dynamic_update_index_in_dim(
+                v_scale_pool, layer_vs, layer_idx, 0)
+        else:
+            layer_k = _write_kv_pages(layer_k, k, block_tables, write_positions, block_size)
+            layer_v = _write_kv_pages(layer_v, v, block_tables, write_positions, block_size)
         k_pool = lax.dynamic_update_index_in_dim(k_pool, layer_k, layer_idx, 0)
         v_pool = lax.dynamic_update_index_in_dim(v_pool, layer_v, layer_idx, 0)
         if dense_attn_fn is not None:
             # pages written above for decode; attention itself runs over the
             # chunk's dense K/V (== whole context for a from-scratch prefill)
             attn = dense_attn_fn(q, k, v)
+        elif quant_kv:
+            attn = attn_fn(q, layer_k, layer_v, layer_ks, layer_vs)
         else:
             attn = attn_fn(q, layer_k, layer_v)
 
@@ -375,7 +463,9 @@ def _layer_step(
         hidden = hidden + _moe_mlp(mlp_in, lp, cfg)
     else:
         hidden = hidden + _mlp(mlp_in, proj, cfg.activation)
-    return (hidden, k_pool, v_pool, layer_idx + 1), (
+    k_out = (k_pool, k_scale_pool) if quant_kv else k_pool
+    v_out = (v_pool, v_scale_pool) if quant_kv else v_pool
+    return (hidden, k_out, v_out, layer_idx + 1), (
         hidden if emit_hidden else None
     )
 
@@ -419,16 +509,24 @@ def forward_chunk(
     safe_pos = jnp.maximum(positions, 0)
     cos, sin = _rope_angles(safe_pos, cfg.head_dim, cfg.rope_theta)
 
+    quant_kv = "k_scale" in kv
     if attn_override is not None:
+        if quant_kv:
+            raise NotImplementedError(
+                "attn_override (seq-sharded pools) does not compose with "
+                "int8 KV yet — the shard_map ops read raw pool values"
+            )
+
         def attn_fn(q, layer_k, layer_v):
             return attn_override(
                 q, layer_k, layer_v, block_tables, positions, kv_lens
             )
     else:
-        def attn_fn(q, layer_k, layer_v):
+        def attn_fn(q, layer_k, layer_v, layer_ks=None, layer_vs=None):
             return paged_attention(
                 q, layer_k, layer_v, block_tables, positions, kv_lens,
                 block_size, window=cfg.sliding_window,
+                k_scale=layer_ks, v_scale=layer_vs,
             )
 
     scanned, stacked = split_stacked_quant(params["layers"])
@@ -451,10 +549,17 @@ def forward_chunk(
         dense_attn_fn=dense_attn_fn,
         emit_hidden=collect_layers is not None,
     )
-    (hidden, k_pool, v_pool, _), layer_hs = lax.scan(
+    k0 = (kv["k"], kv["k_scale"]) if quant_kv else kv["k"]
+    v0 = (kv["v"], kv["v_scale"]) if quant_kv else kv["v"]
+    (hidden, k_out, v_out, _), layer_hs = lax.scan(
         lambda c, lp: step(c, lp),
-        (hidden, kv["k"], kv["v"], jnp.int32(0)),
+        (hidden, k0, v0, jnp.int32(0)),
         scanned,
+    )
+    new_kv = (
+        {"k": k_out[0], "v": v_out[0],
+         "k_scale": k_out[1], "v_scale": v_out[1]}
+        if quant_kv else {"k": k_out, "v": v_out}
     )
     features = (
         jnp.concatenate([layer_hs[i] for i in collect_layers], axis=-1)
@@ -463,7 +568,7 @@ def forward_chunk(
 
     if not with_logits:
         return ChunkOutput(
-            hidden=hidden, kv={"k": k_pool, "v": v_pool}, logits=None,
+            hidden=hidden, kv=new_kv, logits=None,
             features=features,
         )
     if last_only:
@@ -478,7 +583,7 @@ def forward_chunk(
     else:
         logits_in = hidden
     logits = project_logits(cfg, params, logits_in)
-    return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool},
+    return ChunkOutput(hidden=hidden, kv=new_kv,
                        logits=logits, features=features)
 
 
@@ -513,6 +618,11 @@ def forward_tree_chunk(
             f"speculative tree of {token_ids.shape[1]} nodes on a model with "
             f"sliding_window={cfg.sliding_window}: tree depth may reach the "
             "window, which the tree-attention window mask does not cover"
+        )
+    if "k_scale" in kv:
+        raise NotImplementedError(
+            "tree verification over int8 KV pools is not wired (the "
+            "speculative decoder owns bf16 pools)"
         )
     hidden = embed_tokens(params, token_ids, cfg)
     cos, sin = _rope_angles(
@@ -570,7 +680,13 @@ def forward_hidden_chunk(
     this on activations received from the previous stage (reference analogue:
     ``worker/distributed/model_shard.py:173-228`` ModelShard.forward).
     ``params['layers']`` holds only the owned layers; ``kv`` likewise.
+    int8 KV pools are fenced (stage pools are bf16/f32 today; a bare-array
+    scan carry would silently truncate rows into the int8 pool).
     """
+    if "k_scale" in kv:
+        raise NotImplementedError(
+            "forward_hidden_chunk over int8 KV pools is not wired"
+        )
     safe_pos = jnp.maximum(positions, 0)
     cos, sin = _rope_angles(safe_pos, cfg.head_dim, cfg.rope_theta)
 

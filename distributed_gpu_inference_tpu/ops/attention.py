@@ -81,11 +81,15 @@ def paged_attention(
     block_size: int = 16,
     impl: str = "auto",
     window: Optional[int] = None,  # Mistral sliding window (None = full causal)
+    k_scale: Optional[jax.Array] = None,   # [N, Bk, D] bf16 — int8 pools
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention of a chunk of queries against paged context. → [B, S, Nh, D].
 
     ``impl``: "auto" (pallas on TPU for decode, else xla), "xla", "pallas".
     ``window``: query at position p sees context positions (p-window, p].
+    ``k_scale``/``v_scale``: int8 pools' per-(page, token) scales — both
+    impls dequantize context-sized (Pallas in VMEM, XLA at the gather).
     """
     if impl == "auto":
         # the Pallas decode kernel needs lane-aligned pages: XLA:TPU stores
@@ -107,24 +111,35 @@ def paged_attention(
 
         return paged_attention_pallas(
             q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
-            window=window,
+            window=window, k_scale=k_scale, v_scale=v_scale,
         )
     return paged_attention_xla(
         q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
-        window=window,
+        window=window, k_scale=k_scale, v_scale=v_scale,
     )
 
 
 def _gather_ctx(
-    pool: jax.Array, block_tables: jax.Array, block_size: int
+    pool: jax.Array, block_tables: jax.Array, block_size: int,
+    scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Materialize a batch's paged context: head-major pool [N, Hkv, Bk, D]
-    gathered by [B, M] tables → [B, J, Hkv, D] token-major context."""
+    gathered by [B, M] tables → [B, J, Hkv, D] token-major context.
+
+    ``scale`` ([N, Bk, D] bf16, int8 pools): the per-(page, token) scales
+    gather alongside and dequantize the CONTEXT-sized result — never the
+    whole pool (a full-pool dequant copy would be GBs at serving sizes)."""
     b, m = block_tables.shape
     _, hkv, _, d = pool.shape
-    return jnp.take(pool, block_tables, axis=0).transpose(
+    ctx = jnp.take(pool, block_tables, axis=0).transpose(
         0, 1, 3, 2, 4
     ).reshape(b, m * block_size, hkv, d)
+    if scale is None:
+        return ctx
+    s_ctx = jnp.take(scale, block_tables, axis=0).reshape(
+        b, m * block_size, d
+    )
+    return ctx.astype(jnp.bfloat16) * s_ctx[:, :, None, :].astype(jnp.bfloat16)
 
 
 def paged_attention_xla(
@@ -136,6 +151,8 @@ def paged_attention_xla(
     kv_lens: jax.Array,
     block_size: int = 16,
     window: Optional[int] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     b, s, nh, d = q.shape
     hkv = k_pool.shape[1]
@@ -143,8 +160,8 @@ def paged_attention_xla(
     m = block_tables.shape[1]
     j = m * block_size
 
-    k_ctx = _gather_ctx(k_pool, block_tables, block_size)
-    v_ctx = _gather_ctx(v_pool, block_tables, block_size)
+    k_ctx = _gather_ctx(k_pool, block_tables, block_size, k_scale)
+    v_ctx = _gather_ctx(v_pool, block_tables, block_size, v_scale)
 
     qg = q.reshape(b, s, hkv, qpk, d).astype(jnp.float32)
     scores = jnp.einsum(
@@ -180,6 +197,8 @@ def paged_tree_attention(
     block_size: int = 16,
     node_positions: Optional[jax.Array] = None,  # [B, N] semantic positions
     window: Optional[int] = None,                # Mistral SWA over the prefix
+    k_scale: Optional[jax.Array] = None,         # [Nb, Bk, D] — int8 pools
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention for speculative tree verification.
 
@@ -195,8 +214,8 @@ def paged_tree_attention(
     m = block_tables.shape[1]
     j = m * block_size
 
-    k_ctx = _gather_ctx(k_pool, block_tables, block_size)
-    v_ctx = _gather_ctx(v_pool, block_tables, block_size)
+    k_ctx = _gather_ctx(k_pool, block_tables, block_size, k_scale)
+    v_ctx = _gather_ctx(v_pool, block_tables, block_size, v_scale)
 
     qg = q.reshape(b, n, hkv, qpk, d).astype(jnp.float32)
     scores = jnp.einsum("bsgqd,bjgd->bgqsj", qg, k_ctx.astype(jnp.float32)) * (

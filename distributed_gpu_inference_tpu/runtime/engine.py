@@ -75,6 +75,10 @@ def _resolve_kv_dtype(kv_cache_dtype: Optional[str], activation_dtype) -> Any:
         "float8_e4m3fn": jnp.float8_e4m3fn,
         "bf16": jnp.bfloat16,
         "bfloat16": jnp.bfloat16,
+        # int8 pools carry per-(page, token) scale pools alongside — the
+        # quantized-KV mode that WINS on v5e (int8→bf16 converts are
+        # HW-native; fp8's are software-emulated — BENCH_NOTES_r04)
+        "int8": jnp.int8,
     }
     if kv_cache_dtype not in alias:
         raise ValueError(
@@ -221,6 +225,19 @@ class TPUEngine:
                 f"kv_cache_dtype={self.cfg.kv_cache_dtype!r} needs "
                 f"block_size % 32 == 0 on TPU, got {self.cfg.block_size}"
             )
+        if self.kv_dtype == jnp.int8:
+            # v1 fences for scale-carrying pools: these surfaces move raw
+            # pages without their scales and would silently corrupt
+            if mesh is not None:
+                raise ValueError(
+                    "kv_cache_dtype='int8' is single-chip for now (sharded "
+                    "scale pools are not plumbed)"
+                )
+            if self.cfg.spill_host_blocks or self.cfg.spill_remote_store:
+                raise ValueError(
+                    "kv_cache_dtype='int8' does not compose with KV spill "
+                    "tiers yet (spilled pages would drop their scales)"
+                )
         self.mesh = mesh
         self._seq_axis = 1
         if mesh is not None:
@@ -733,10 +750,19 @@ class TPUEngine:
         )
 
         def apply_ops(kv, srcs, dsts):
-            # page copies (CoW): dst = -1 entries are dropped
-            k = kv["k"].at[:, dsts].set(kv["k"][:, srcs], mode="drop")
-            v = kv["v"].at[:, dsts].set(kv["v"][:, srcs], mode="drop")
-            return {"k": k, "v": v}
+            # page copies (CoW): dst = -1 entries are dropped. Scale pools
+            # (int8 KV) copy with their pages — a page without its scale is
+            # garbage
+            out = {
+                "k": kv["k"].at[:, dsts].set(kv["k"][:, srcs], mode="drop"),
+                "v": kv["v"].at[:, dsts].set(kv["v"][:, srcs], mode="drop"),
+            }
+            for sk in ("k_scale", "v_scale"):
+                if sk in kv:
+                    out[sk] = kv[sk].at[:, dsts].set(
+                        kv[sk][:, srcs], mode="drop"
+                    )
+            return out
 
         self._apply_ops_fn = jax.jit(apply_ops, donate_argnums=(0,))
 

@@ -97,6 +97,11 @@ def export_slot_kv(engine: "TPUEngine", slot: int) -> KVHandoff:
     s = engine.slots[slot]
     if s is None:
         raise ValueError(f"slot {slot} empty")
+    if "k_scale" in engine.kv:
+        raise NotImplementedError(
+            "PD handoff of int8-KV pools is not wired yet (pages would "
+            "travel without their scales); serve PD engines with bf16 KV"
+        )
     blocks = engine.manager.seq_blocks[s.seq_id]
     ids = jnp.asarray(np.asarray(blocks, np.int32))
     # one gather per pool, host pull in native dtype (the wire codec frames
@@ -186,6 +191,10 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
         )
     if engine.cfg.block_size != handoff.block_size:
         raise ValueError("block_size mismatch between engines")
+    if "k_scale" in engine.kv:
+        raise NotImplementedError(
+            "adopting into int8-KV pools is not wired yet"
+        )
     if slot is None:
         free = engine.free_slots()
         if not free:
@@ -330,6 +339,11 @@ def migrate_kv_device(src: "TPUEngine", dst: "TPUEngine", slot: int,
         raise ValueError("block_size mismatch between engines")
     if src.kv_dtype != dst.kv_dtype:
         raise ValueError("kv_cache_dtype mismatch between engines")
+    if "k_scale" in src.kv or "k_scale" in dst.kv:
+        raise NotImplementedError(
+            "device migration of int8-KV pools is not wired yet (the "
+            "pool copy would drop the scale pools)"
+        )
     src_devs = {d for leaf in (src.kv["k"],) for d in leaf.devices()}
     dst_devs = {d for leaf in (dst.kv["k"],) for d in leaf.devices()}
     if src_devs != dst_devs:
@@ -503,6 +517,11 @@ class StreamedExport:
             raise ValueError(
                 "streamed handoff does not support sliding-window models "
                 "(use the one-shot path)"
+            )
+        if "k_scale" in engine.kv:
+            raise NotImplementedError(
+                "streamed handoff of int8-KV pools is not wired yet (pages "
+                "would stream without their scales)"
             )
         # kv_seq_sharded donors stream fine since round 4: chunked prefill
         # composes with sharded pools, and the page gather collects shards
@@ -703,6 +722,10 @@ class HandoffReceiver:
             )
         if eng.cfg.block_size != meta["block_size"]:
             raise ValueError("block_size mismatch between engines")
+        if "k_scale" in eng.kv:
+            raise NotImplementedError(
+                "streamed adoption into int8-KV pools is not wired yet"
+            )
         key = meta["key"]
         if key in self._sessions:
             raise ValueError(f"streamed handoff {key!r} already begun")

@@ -1,0 +1,108 @@
+"""Engine-level int8 KV cache (kv_cache_dtype="int8", VERDICT r3 #4).
+
+Pools store int8 pages + per-(page, token) scale pools; writes quantize in
+the layer step (XLA path) or in the fused Pallas kernel (TPU decode); reads
+dequantize context-sized. int8 KV is LOSSY — greedy outputs are compared
+prefix-wise (near-ties may flip late), while structure (scale pools, CoW,
+fences) is exact."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from distributed_gpu_inference_tpu.models.configs import get_model_config
+from distributed_gpu_inference_tpu.runtime.engine import EngineConfig, TPUEngine
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+CFG = get_model_config("llama3-tiny", dtype="float32")
+
+
+def _kw(**over):
+    base = dict(max_batch_size=2, max_seq_len=128, block_size=32,
+                prefill_buckets=(32,), dtype="float32", multi_step=4,
+                enable_prefix_cache=False)
+    base.update(over)
+    return base
+
+
+def _req(prompt, n=12):
+    return InferenceRequest(
+        prompt_token_ids=list(prompt),
+        sampling=SamplingParams(max_new_tokens=n, temperature=0.0))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TPUEngine(CFG, EngineConfig(**_kw()), seed=0).params
+
+
+def test_int8_engine_builds_scale_pools(params):
+    eng = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                    params=params)
+    assert eng.kv["k"].dtype == jnp.int8
+    assert eng.kv["k_scale"].dtype == jnp.bfloat16
+    L, N, _, bk, d = eng.kv["k"].shape
+    assert eng.kv["k_scale"].shape == (L, N, bk, d)
+
+
+def test_int8_engine_greedy_close_to_bf16(params):
+    ref = TPUEngine(CFG, EngineConfig(**_kw()), params=params)
+    q8 = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                   params=params)
+    prompt = [(i * 29 + 3) % 500 for i in range(20)]
+    want = ref.generate([_req(prompt)], use_multi_step=True)[0]
+    got = q8.generate([_req(prompt)], use_multi_step=True)[0]
+    assert len(got.token_ids) == len(want.token_ids)
+    # the first several greedy steps must agree (per-token amax scaling is
+    # ~0.5% relative error; only near-ties can flip, and not immediately)
+    assert got.token_ids[:6] == want.token_ids[:6], (
+        got.token_ids, want.token_ids)
+
+
+def test_int8_prefix_cache_cow(params):
+    """Prefix hits + CoW on int8 pools: scale pages must travel with their
+    data pages through the copy path."""
+    q8 = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8",
+                                     **_kw(enable_prefix_cache=True)),
+                   params=params)
+    prefix = [(i * 13 + 1) % 500 for i in range(40)]
+    q8.generate([_req(prefix, 2)], use_multi_step=True)
+    full = prefix + [7, 8, 9, 10]
+    r = q8.generate([_req(full, 8)], use_multi_step=True)[0]
+    assert r.cached_tokens >= 32
+    assert len(r.token_ids) == 8
+
+
+def test_int8_fences(params):
+    with pytest.raises(ValueError, match="spill"):
+        TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8",
+                                    spill_host_blocks=4, **_kw()),
+                  params=params)
+    # PD handoff gates
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        export_slot_kv,
+    )
+
+    q8 = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                   params=params)
+    slot = q8.submit(_req([1, 2, 3, 4], 4))
+    with pytest.raises(NotImplementedError, match="int8"):
+        export_slot_kv(q8, slot)
+
+
+def test_int8_decode_matches_own_prefill_continuation(params):
+    """Internal consistency: decoding 1 token at a time equals the
+    multi-step scan on the SAME int8 engine (write/read paths agree)."""
+    a = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                  params=params)
+    b = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                  params=params)
+    prompt = [(i * 17 + 5) % 500 for i in range(24)]
+    r1 = a.generate([_req(prompt, 10)], use_multi_step=False)[0]
+    r2 = b.generate([_req(prompt, 10)], use_multi_step=True)[0]
+    assert r1.token_ids == r2.token_ids
