@@ -135,9 +135,10 @@ class EngineConfig:
     # storage-side sequence parallelism: shard the KV pools' BLOCK axis
     # over ``seq`` so per-device pool memory scales 1/seq (servable context
     # scales with the mesh). Decode reads route through the shard_map
-    # partial-softmax op (pages never move); prefill attention runs dense
-    # over the chunk, so this mode serves FRESH prompts only — it forces
-    # enable_prefix_cache=False and rejects chunked/cached admission paths.
+    # partial-softmax op (pages never move). Composes with the prefix
+    # cache and chunked/continuation admission since round 4: chunks with
+    # prior context read it through the sharded-pool CHUNK op; fresh first
+    # chunks keep the cheaper dense path. Sliding-window models fenced.
     kv_seq_sharded: bool = False
 
     @property
