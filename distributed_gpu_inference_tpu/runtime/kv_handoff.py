@@ -339,11 +339,9 @@ def migrate_kv_device(src: "TPUEngine", dst: "TPUEngine", slot: int,
         raise ValueError("block_size mismatch between engines")
     if src.kv_dtype != dst.kv_dtype:
         raise ValueError("kv_cache_dtype mismatch between engines")
-    if "k_scale" in src.kv or "k_scale" in dst.kv:
-        raise NotImplementedError(
-            "device migration of int8-KV pools is not wired yet (the "
-            "pool copy would drop the scale pools)"
-        )
+    # int8-KV pools migrate on the DEVICE path: the jitted copy moves scale
+    # pages with their data pages (the wire paths stay fenced — int8 pools
+    # compose with intra-slice PD, where decode pools want the capacity)
     src_devs = {d for leaf in (src.kv["k"],) for d in leaf.devices()}
     dst_devs = {d for leaf in (dst.kv["k"],) for d in leaf.devices()}
     if src_devs != dst_devs:
@@ -411,22 +409,25 @@ def migrate_kv_device(src: "TPUEngine", dst: "TPUEngine", slot: int,
 
 
 @functools.lru_cache(maxsize=8)
-def _device_copy_fn():
+def _device_copy_fn(keys: Tuple[str, ...]):
     import jax
 
-    def copy(src_k, src_v, dst_k, dst_v, src_ids, dst_ids):
+    def copy(src_kv, dst_kv, src_ids, dst_ids):
         return {
-            "k": dst_k.at[:, dst_ids].set(src_k[:, src_ids]),
-            "v": dst_v.at[:, dst_ids].set(src_v[:, src_ids]),
+            k: dst_kv[k].at[:, dst_ids].set(src_kv[k][:, src_ids])
+            for k in keys
         }
 
     # donate the destination pools: the copy mutates them in place
-    return jax.jit(copy, donate_argnums=(2, 3))
+    return jax.jit(copy, donate_argnums=(1,))
 
 
 def _device_copy_pages(src_kv, dst_kv, src_ids, dst_ids):
-    return _device_copy_fn()(
-        src_kv["k"], src_kv["v"], dst_kv["k"], dst_kv["v"], src_ids, dst_ids
+    # every pool entry with a block axis migrates — incl. int8 scale pools
+    keys = tuple(sorted(src_kv.keys()))
+    return _device_copy_fn(keys)(
+        {k: src_kv[k] for k in keys}, {k: dst_kv[k] for k in keys},
+        src_ids, dst_ids,
     )
 
 

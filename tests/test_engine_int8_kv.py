@@ -95,6 +95,35 @@ def test_int8_fences(params):
         export_slot_kv(q8, slot)
 
 
+def test_int8_device_migration_bit_exact(params):
+    """Intra-slice PD with int8 pools: migrate_kv_device moves the EXACT
+    int8 pages + their scale pages, so the recipient continues bit-for-bit
+    what the donor would have produced (no requantization anywhere)."""
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        migrate_kv_device,
+    )
+
+    donor = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                      params=params)
+    recv = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                     params=params)
+    oracle = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                       params=params)
+    prompt = [(i * 29 + 3) % 500 for i in range(24)]
+    want = oracle.generate([_req(prompt, 12)], use_multi_step=True)[0]
+
+    slot = donor.submit(_req(prompt, 12))
+    for _ in range(3):
+        donor.decode_step()
+    dslot = migrate_kv_device(donor, recv, slot)
+    donor.finish_slot(slot, cache=False)
+    while recv.slots[dslot] is not None and \
+            recv.slots[dslot].finish_reason is None:
+        recv.decode_step()
+    got = recv.finish_slot(dslot)
+    assert got.token_ids == want.token_ids, (got.token_ids, want.token_ids)
+
+
 def test_int8_decode_matches_own_prefill_continuation(params):
     """Internal consistency: decoding 1 token at a time equals the
     multi-step scan on the SAME int8 engine (write/read paths agree)."""
