@@ -841,8 +841,17 @@ class TPUEngine:
             k = jnp.asarray(host_kv[:, 0], dtype=self.kv_dtype)
             v = jnp.asarray(host_kv[:, 1], dtype=self.kv_dtype)
             self.kv = {
+                **self.kv,
                 "k": self.kv["k"].at[:, dst].set(k),
                 "v": self.kv["v"].at[:, dst].set(v),
+            }
+        for dst, host_sc in ops.scale_uploads:
+            ks = jnp.asarray(host_sc[:, 0], jnp.bfloat16)
+            vs = jnp.asarray(host_sc[:, 1], jnp.bfloat16)
+            self.kv = {
+                **self.kv,
+                "k_scale": self.kv["k_scale"].at[:, dst].set(ks),
+                "v_scale": self.kv["v_scale"].at[:, dst].set(vs),
             }
 
     def _bucket_len(self, n: int) -> int:
@@ -938,6 +947,7 @@ class TPUEngine:
             alive = self.manager.metas
             p = self.manager.pending
             p.uploads = [u for u in p.uploads if u[0] in alive]
+            p.scale_uploads = [u for u in p.scale_uploads if u[0] in alive]
             p.copies = [
                 c for c in p.copies if c[0] in alive and c[1] in alive
             ]

@@ -50,20 +50,30 @@ class PendingDeviceOps:
     copies:    (src_block, dst_block) page copies (CoW / defrag)
     uploads:   (dst_block, host_kv) spill-tier promotions; host_kv is
                ``np.ndarray [L, 2, Hkv, block, D]`` (k and v stacked on axis 1)
+    scale_uploads: (dst_block, host_scales) int8-KV scale pages riding with
+               an adopted handoff; host_scales is ``np.ndarray
+               [L, 2, block, D]`` (k and v scales stacked on axis 1). A
+               separate channel (not a wider uploads tuple) so the many
+               (bid, page) destructure sites stay valid.
     """
 
     downloads: List[Tuple[int, str]] = field(default_factory=list)
     copies: List[Tuple[int, int]] = field(default_factory=list)
     uploads: List[Tuple[int, np.ndarray]] = field(default_factory=list)
+    scale_uploads: List[Tuple[int, np.ndarray]] = field(default_factory=list)
 
     def merge(self, other: "PendingDeviceOps") -> None:
         self.downloads.extend(other.downloads)
         self.copies.extend(other.copies)
         self.uploads.extend(other.uploads)
+        self.scale_uploads.extend(other.scale_uploads)
 
     @property
     def empty(self) -> bool:
-        return not self.downloads and not self.copies and not self.uploads
+        return not (
+            self.downloads or self.copies or self.uploads
+            or self.scale_uploads
+        )
 
 
 class _RadixNode:
@@ -469,6 +479,10 @@ class PagedKVCacheManager:
             if ours:
                 self.pending.uploads = [
                     (b, p) for b, p in self.pending.uploads if b not in ours
+                ]
+                self.pending.scale_uploads = [
+                    (b, p) for b, p in self.pending.scale_uploads
+                    if b not in ours
                 ]
             for bid in blocks:
                 if self.metas[bid].decref() == 0:

@@ -77,6 +77,11 @@ class KVHandoff:
     # finishes with generated=[] and a stale last_token — the recipient must
     # not decode it (it would feed garbage for max_new_tokens)
     finish_reason: Optional[str] = None
+    # int8-KV donors: per-(page, token) scale pages [n, L, 2, Bk, D] bf16
+    # (k and v scales stacked on axis 2) — pages are raw int8 then, and the
+    # recipient must be an int8 engine (real = int * scale end to end, so
+    # continuation stays bit-exact with zero requantization)
+    scale_pages: Optional[np.ndarray] = field(repr=False, default=None)
     # pages: [n_blocks, L, 2, n_kv_heads, block_size, head_dim] (head-major)
     pages: np.ndarray = field(repr=False, default=None)  # type: ignore[assignment]
 
@@ -97,11 +102,6 @@ def export_slot_kv(engine: "TPUEngine", slot: int) -> KVHandoff:
     s = engine.slots[slot]
     if s is None:
         raise ValueError(f"slot {slot} empty")
-    if "k_scale" in engine.kv:
-        raise NotImplementedError(
-            "PD handoff of int8-KV pools is not wired yet (pages would "
-            "travel without their scales); serve PD engines with bf16 KV"
-        )
     blocks = engine.manager.seq_blocks[s.seq_id]
     ids = jnp.asarray(np.asarray(blocks, np.int32))
     # one gather per pool, host pull in native dtype (the wire codec frames
@@ -110,6 +110,11 @@ def export_slot_kv(engine: "TPUEngine", slot: int) -> KVHandoff:
     v = np.asarray(engine.kv["v"][:, ids])
     # → [n, L, 2, Hkv, Bk, D] so adoption can upload per block
     pages = np.stack([k, v], axis=0).transpose(2, 1, 0, 3, 4, 5)
+    scale_pages = None
+    if "k_scale" in engine.kv:
+        ks = np.asarray(engine.kv["k_scale"][:, ids])   # [L, n, Bk, D]
+        vs = np.asarray(engine.kv["v_scale"][:, ids])
+        scale_pages = np.stack([ks, vs], axis=0).transpose(2, 1, 0, 3, 4)
     tokens = list(engine.manager.seq_tokens[s.seq_id])
     return KVHandoff(
         request=s.request,
@@ -126,6 +131,7 @@ def export_slot_kv(engine: "TPUEngine", slot: int) -> KVHandoff:
         window_front=engine.manager.seq_window_front.get(s.seq_id, 0),
         finish_reason=s.finish_reason,
         pages=pages,
+        scale_pages=scale_pages,
     )
 
 
@@ -191,9 +197,12 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
         )
     if engine.cfg.block_size != handoff.block_size:
         raise ValueError("block_size mismatch between engines")
-    if "k_scale" in engine.kv:
-        raise NotImplementedError(
-            "adopting into int8-KV pools is not wired yet"
+    if (handoff.scale_pages is not None) != ("k_scale" in engine.kv):
+        raise ValueError(
+            "kv_cache_dtype mismatch: an int8-KV handoff (raw int8 pages + "
+            "scales) can only adopt into an int8-KV engine, and vice versa "
+            "— re-serving through a different KV dtype would need a "
+            "requantization pass this path does not do"
         )
     if slot is None:
         free = engine.free_slots()
@@ -227,6 +236,10 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
                 continue
             # pages[i] is [L, 2, Hkv, Bk, D] — the engine upload layout
             engine.manager.pending.uploads.append((blocks[i], handoff.pages[i]))
+            if handoff.scale_pages is not None:
+                engine.manager.pending.scale_uploads.append(
+                    (blocks[i], handoff.scale_pages[i])
+                )
             staged.append(blocks[i])
         # replicate the donor's release state BEFORE binding so the slot's
         # block table starts with the released entries pinned to pad block 0
@@ -254,6 +267,11 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
                 (bid, page) for bid, page in engine.manager.pending.uploads
                 if bid not in drop
             ]
+            engine.manager.pending.scale_uploads = [
+                (bid, page)
+                for bid, page in engine.manager.pending.scale_uploads
+                if bid not in drop
+            ]
         engine.manager.free_sequence(seq_id, cache=False)
         raise
     return slot
@@ -262,6 +280,27 @@ def adopt_kv(engine: "TPUEngine", handoff: KVHandoff,
 # ---------------------------------------------------------------------------
 # Wire format (DCN / cross-host handoff)
 # ---------------------------------------------------------------------------
+
+
+def _frame_blobs(*blobs: bytes) -> bytes:
+    """THE 8-byte-little-endian length-prefixed multi-blob framing, shared
+    by every handoff encoder (one-shot + streamed piece) so encoders and
+    decoders cannot drift on offset arithmetic."""
+    out = io.BytesIO()
+    for b in blobs:
+        out.write(len(b).to_bytes(8, "little"))
+        out.write(b)
+    return out.getvalue()
+
+
+def _read_blobs(data: bytes, count: int) -> List[bytes]:
+    view = memoryview(data)
+    off, out = 0, []
+    for _ in range(count):
+        n = int.from_bytes(view[off : off + 8], "little")
+        out.append(bytes(view[off + 8 : off + 8 + n]))
+        off += 8 + n
+    return out
 
 
 def serialize_handoff(h: KVHandoff, compress: bool = True) -> bytes:
@@ -293,16 +332,13 @@ def serialize_handoff(h: KVHandoff, compress: bool = True) -> bytes:
         "slot_key": h.slot_key,
         "window_front": h.window_front,
         "finish_reason": h.finish_reason,
+        "has_scales": h.scale_pages is not None,
     }
-    buf = io.BytesIO()
-    mb = _pack_header(meta)
-    buf.write(len(mb).to_bytes(8, "little"))
-    buf.write(mb)
     ser = TensorSerializer(compress=compress)
-    pb = ser.serialize(h.pages)
-    buf.write(len(pb).to_bytes(8, "little"))
-    buf.write(pb)
-    return buf.getvalue()
+    blobs = [_pack_header(meta), ser.serialize(h.pages)]
+    if h.scale_pages is not None:
+        blobs.append(ser.serialize(h.scale_pages))
+    return _frame_blobs(*blobs)
 
 
 # ---------------------------------------------------------------------------
@@ -339,9 +375,10 @@ def migrate_kv_device(src: "TPUEngine", dst: "TPUEngine", slot: int,
         raise ValueError("block_size mismatch between engines")
     if src.kv_dtype != dst.kv_dtype:
         raise ValueError("kv_cache_dtype mismatch between engines")
-    # int8-KV pools migrate on the DEVICE path: the jitted copy moves scale
-    # pages with their data pages (the wire paths stay fenced — int8 pools
-    # compose with intra-slice PD, where decode pools want the capacity)
+    # int8-KV pools migrate on every path: the jitted copy here moves scale
+    # pools by key; the wire paths (one-shot + streamed) frame scale pages
+    # alongside data pages. kv_dtype equality above guarantees both sides
+    # agree on whether scales exist.
     src_devs = {d for leaf in (src.kv["k"],) for d in leaf.devices()}
     dst_devs = {d for leaf in (dst.kv["k"],) for d in leaf.devices()}
     if src_devs != dst_devs:
@@ -519,14 +556,11 @@ class StreamedExport:
                 "streamed handoff does not support sliding-window models "
                 "(use the one-shot path)"
             )
-        if "k_scale" in engine.kv:
-            raise NotImplementedError(
-                "streamed handoff of int8-KV pools is not wired yet (pages "
-                "would stream without their scales)"
-            )
         # kv_seq_sharded donors stream fine since round 4: chunked prefill
         # composes with sharded pools, and the page gather collects shards
-        # through GSPMD before the host pull
+        # through GSPMD before the host pull. int8-KV donors stream their
+        # scale pages inside each piece (receiver must be int8 too).
+        self._quant = "k_scale" in engine.kv
         self.engine = engine
         self.request = request
         self.key = key
@@ -549,6 +583,7 @@ class StreamedExport:
             "key": self.key,
             "model_name": self.engine.model_cfg.name,
             "block_size": self.engine.cfg.block_size,
+            "int8_kv": self._quant,
             "request": {
                 "request_id": req.request_id,
                 "model": req.model,
@@ -559,22 +594,35 @@ class StreamedExport:
             },
         })
 
-    def _piece_msg(self, block_lo: int, k, v) -> bytes:
+    def _piece_msg(self, block_lo: int, k, v, ks=None, vs=None) -> bytes:
         # k/v: device gathers [L, n, Hkv, Bk, D]; pull + relayout host-side
         # to the adopt upload layout [n, L, 2, Hkv, Bk, D]
         pages = np.stack([np.asarray(k), np.asarray(v)], axis=0)
         pages = pages.transpose(2, 1, 0, 3, 4, 5)
         ser = TensorSerializer(compress=self.compress)
+        pb = ser.serialize(pages)
+        if ks is None:
+            return _pack_stream(
+                _KIND_PIECE, {"key": self.key, "block_lo": block_lo}, pb
+            )
+        scales = np.stack([np.asarray(ks), np.asarray(vs)], axis=0)
+        scales = scales.transpose(2, 1, 0, 3, 4)     # [n, L, 2, Bk, D]
+        payload = _frame_blobs(pb, ser.serialize(scales))
         return _pack_stream(
-            _KIND_PIECE, {"key": self.key, "block_lo": block_lo},
-            ser.serialize(pages),
+            _KIND_PIECE,
+            {"key": self.key, "block_lo": block_lo, "has_scales": True},
+            payload,
         )
 
     def _gather(self, blocks: List[int]):
         import jax.numpy as jnp
 
         ids = jnp.asarray(np.asarray(blocks, np.int32))
-        return self.engine.kv["k"][:, ids], self.engine.kv["v"][:, ids]
+        out = (self.engine.kv["k"][:, ids], self.engine.kv["v"][:, ids])
+        if self._quant:
+            out += (self.engine.kv["k_scale"][:, ids],
+                    self.engine.kv["v_scale"][:, ids])
+        return out
 
     # -- the driver ----------------------------------------------------------
 
@@ -587,14 +635,14 @@ class StreamedExport:
             yield self._begin_msg()
             chain = eng.manager.seq_blocks[adm.seq_id]
             sent = 0                    # blocks exported so far
-            pending: Optional[Tuple[int, Any, Any]] = None
+            pending: Optional[Tuple] = None  # (block_lo, *gathers)
             # donor-side prefix-cache hits are final before any chunk runs
             while not adm.done:
                 eng.submit_chunked_step(adm)    # dispatch chunk (async
                 # unless last — the final chunk samples + syncs in-graph)
                 full = adm.off // bs
                 if pending is not None:
-                    msg = self._piece_msg(pending[0], pending[1], pending[2])
+                    msg = self._piece_msg(pending[0], *pending[1:])
                     if self.first_token is None:
                         self.bytes_before_first_token += len(msg)
                     self.bytes_sent += len(msg)
@@ -616,7 +664,7 @@ class StreamedExport:
                 if s.first_token_time else None
             )
             if pending is not None:
-                msg = self._piece_msg(pending[0], pending[1], pending[2])
+                msg = self._piece_msg(pending[0], *pending[1:])
                 self.bytes_sent += len(msg)
                 self.pieces_sent += 1
                 yield msg
@@ -627,8 +675,7 @@ class StreamedExport:
             chain = eng.manager.seq_blocks[adm.seq_id]
             while sent < len(chain):
                 hi = min(len(chain), sent + self.piece_blocks)
-                k, v = self._gather(chain[sent:hi])
-                msg = self._piece_msg(sent, k, v)
+                msg = self._piece_msg(sent, *self._gather(chain[sent:hi]))
                 self.bytes_sent += len(msg)
                 self.pieces_sent += 1
                 yield msg
@@ -723,9 +770,11 @@ class HandoffReceiver:
             )
         if eng.cfg.block_size != meta["block_size"]:
             raise ValueError("block_size mismatch between engines")
-        if "k_scale" in eng.kv:
-            raise NotImplementedError(
-                "streamed adoption into int8-KV pools is not wired yet"
+        if bool(meta.get("int8_kv")) != ("k_scale" in eng.kv):
+            raise ValueError(
+                "kv_cache_dtype mismatch: int8-KV donors stream raw int8 "
+                "pages + scales and can only land in int8-KV engines "
+                "(and vice versa)"
             )
         key = meta["key"]
         if key in self._sessions:
@@ -762,7 +811,13 @@ class HandoffReceiver:
     def _piece(self, meta: Dict[str, Any], payload: bytes,
                raw_len: int) -> Dict[str, Any]:
         sess = self._require(meta["key"])
-        pages = TensorSerializer().deserialize(payload)
+        if meta.get("has_scales"):
+            pb, sb = _read_blobs(payload, 2)
+            pages = TensorSerializer().deserialize(pb)
+            scales = TensorSerializer().deserialize(sb)
+        else:
+            pages = TensorSerializer().deserialize(payload)
+            scales = None
         lo = int(meta["block_lo"])
         eng = self.engine
         cached_blocks = sess.cached_tokens // sess.block_size
@@ -777,6 +832,10 @@ class HandoffReceiver:
             if i < cached_blocks:
                 continue    # receiver-side prefix hit: page already resident
             eng.manager.pending.uploads.append((sess.blocks[i], pages[j]))
+            if scales is not None:
+                eng.manager.pending.scale_uploads.append(
+                    (sess.blocks[i], scales[j])
+                )
             sess.staged.append(sess.blocks[i])
             uploaded += 1
         eng._apply_pending()
@@ -850,6 +909,11 @@ class HandoffReceiver:
                 (bid, page) for bid, page in eng.manager.pending.uploads
                 if bid not in staged
             ]
+            eng.manager.pending.scale_uploads = [
+                (bid, page)
+                for bid, page in eng.manager.pending.scale_uploads
+                if bid not in staged
+            ]
         if sess.seq_id in eng.manager.seq_blocks:
             eng.manager.free_sequence(sess.seq_id, cache=False)
 
@@ -861,12 +925,15 @@ class HandoffReceiver:
 
 
 def deserialize_handoff(data: bytes) -> KVHandoff:
-    view = memoryview(data)
-    n = int.from_bytes(view[:8], "little")
-    meta: Dict[str, Any] = _unpack_header(bytes(view[8 : 8 + n]))
-    off = 8 + n
-    pn = int.from_bytes(view[off : off + 8], "little")
-    pages = TensorSerializer().deserialize(bytes(view[off + 8 : off + 8 + pn]))
+    mb = _read_blobs(data, 1)[0]
+    meta: Dict[str, Any] = _unpack_header(mb)
+    count = 3 if meta.get("has_scales") else 2
+    blobs = _read_blobs(data, count)
+    pages = TensorSerializer().deserialize(blobs[1])
+    scale_pages = (
+        TensorSerializer().deserialize(blobs[2])
+        if meta.get("has_scales") else None
+    )
     r = meta["request"]
     request = InferenceRequest(
         request_id=r["request_id"],
@@ -891,4 +958,5 @@ def deserialize_handoff(data: bytes) -> KVHandoff:
         window_front=meta.get("window_front", 0),
         finish_reason=meta.get("finish_reason"),
         pages=pages,
+        scale_pages=scale_pages,
     )

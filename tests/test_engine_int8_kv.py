@@ -78,21 +78,100 @@ def test_int8_prefix_cache_cow(params):
     assert len(r.token_ids) == 8
 
 
-def test_int8_fences(params):
+def test_int8_fences_and_dtype_mismatch(params):
     with pytest.raises(ValueError, match="spill"):
         TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8",
                                     spill_host_blocks=4, **_kw()),
                   params=params)
-    # PD handoff gates
+    # an int8 handoff must not land in a bf16 engine (raw int8 codes would
+    # be read as real values) — and vice versa
     from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        adopt_kv,
+        deserialize_handoff,
         export_slot_kv,
+        serialize_handoff,
     )
 
     q8 = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
                    params=params)
     slot = q8.submit(_req([1, 2, 3, 4], 4))
-    with pytest.raises(NotImplementedError, match="int8"):
-        export_slot_kv(q8, slot)
+    h = deserialize_handoff(serialize_handoff(export_slot_kv(q8, slot)))
+    assert h.scale_pages is not None
+    fp = TPUEngine(CFG, EngineConfig(**_kw()), params=params)
+    with pytest.raises(ValueError, match="kv_cache_dtype mismatch"):
+        adopt_kv(fp, h)
+
+
+def test_int8_oneshot_wire_handoff_bit_exact(params):
+    """int8 donor → wire → int8 recipient: pages AND scales cross, so the
+    continuation is bit-exact (no requantization anywhere)."""
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        adopt_kv,
+        deserialize_handoff,
+        export_slot_kv,
+        serialize_handoff,
+    )
+
+    donor = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                      params=params)
+    recv = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                     params=params)
+    oracle = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **_kw()),
+                       params=params)
+    prompt = [(i * 31 + 7) % 500 for i in range(24)]
+    want = oracle.generate([_req(prompt, 12)], use_multi_step=True)[0]
+
+    slot = donor.submit(_req(prompt, 12))
+    for _ in range(3):
+        donor.decode_step()
+    wire = serialize_handoff(export_slot_kv(donor, slot))
+    donor.finish_slot(slot, cache=False)
+    dslot = adopt_kv(recv, deserialize_handoff(wire))
+    while recv.slots[dslot] is not None and \
+            recv.slots[dslot].finish_reason is None:
+        recv.decode_step()
+    got = recv.finish_slot(dslot)
+    assert got.token_ids == want.token_ids, (got.token_ids, want.token_ids)
+
+
+def test_int8_streamed_handoff_bit_exact(params):
+    """int8 donor STREAMS to an int8 recipient: scale pages ride each
+    piece; continuation bit-exact. A bf16 receiver rejects at begin."""
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        HandoffReceiver,
+        StreamedExport,
+    )
+
+    kw = _kw(prefill_buckets=(32,), max_seq_len=192)
+    donor = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **kw),
+                      params=params)
+    recv = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **kw),
+                     params=params)
+    oracle = TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8", **kw),
+                       params=params)
+    prompt = [(i * 29 + 3) % 500 for i in range(80)]   # 3 chunks at 32
+    want = oracle.generate([_req(prompt, 10)], use_multi_step=True)[0]
+
+    rx = HandoffReceiver(recv)
+    exp = StreamedExport(donor, _req(prompt, 10), key="i8", piece_blocks=1)
+    result = None
+    for msg in exp.messages():
+        result = rx.handle(msg)
+    assert result["state"] == "committed"
+    slot = result["slot"]
+    while recv.slots[slot] is not None and \
+            recv.slots[slot].finish_reason is None:
+        recv.decode_step()
+    got = recv.finish_slot(slot)
+    assert got.token_ids == want.token_ids, (got.token_ids, want.token_ids)
+
+    fp = TPUEngine(CFG, EngineConfig(**kw), params=params)
+    rx_fp = HandoffReceiver(fp)
+    exp2 = StreamedExport(donor, _req(prompt, 4), key="i8b")
+    gen = exp2.messages()
+    with pytest.raises(ValueError, match="kv_cache_dtype mismatch"):
+        rx_fp.handle(next(gen))
+    gen.close()
 
 
 def test_int8_device_migration_bit_exact(params):
