@@ -64,7 +64,9 @@ def _mk_engine(model, batch, max_seq, buckets, quant=None, params=None,
             prefill_buckets=buckets, enable_prefix_cache=False,
             quantization=quant, quant_cache_dir=cache_dir,
             kv_cache_dtype=kv_dtype,
-            block_size=32 if kv_dtype == "int8" else 16,
+            # every byte-width KV dtype needs 32-token pages on TPU
+            block_size=32 if kv_dtype in
+            ("int8", "fp8", "float8_e4m3fn") else 16,
         ),
         params=params,
     )
@@ -97,7 +99,7 @@ def main() -> None:
     ap.add_argument("--piece-blocks", type=int, default=4)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--kv-dtype", default=None,
-                    help="int8: both pools quantized — handoffs move ~40% "
+                    help="int8: both pools quantized — handoffs move ~40%% "
                          "fewer bytes (int8 pages + bf16 scale pages vs "
                          "bf16 pages), which directly shrinks the host "
                          "path's D2H + wire time")
