@@ -131,7 +131,7 @@ class ContinuousBatcher:
             "decode_rounds": 0, "admitted": 0, "queue_peak": 0,
             "step_latency_ema_ms": 0.0, "occupancy_sum": 0, "horizon": self._horizon,
             "chunked_admissions": 0, "batched_waves": 0,
-            "spec_waves": 0, "spec_completed": 0,
+            "spec_waves": 0, "spec_completed": 0, "spec_errors": 0,
         }
 
     # ---------------------------------------------------- speculative routing
@@ -226,17 +226,22 @@ class ContinuousBatcher:
                         request_id=it.request.request_id,
                         error=f"speculative engine error: {e}",
                     ))
+                    self.stats["completed"] += 1
+                    self.stats["spec_errors"] += 1
             return
         if done:
             self._spec_wave = None
             resps = await loop.run_in_executor(
                 self._exec, self.spec.finish_wave, wave
             )
+            # completed counts responses actually DELIVERED — a row whose
+            # caller already timed out was counted by submit()'s timeout
+            # path, not here, so stats stay reconcilable per-request
             for it, resp in zip(items, resps):
                 if not it.future.done():
                     it.future.set_result(resp)
-                self.stats["completed"] += 1
-                self.stats["spec_completed"] += 1
+                    self.stats["completed"] += 1
+                    self.stats["spec_completed"] += 1
 
     # ---------------------------------------------------------------- API
 
@@ -522,7 +527,7 @@ class ContinuousBatcher:
                         item = self._slot_items.pop(i, None)
                         if item and not item.future.done():
                             item.future.set_result(resp)
-                        self.stats["completed"] += 1
+                            self.stats["completed"] += 1
             except asyncio.CancelledError:
                 raise
             except Exception as e:
@@ -548,6 +553,7 @@ class ContinuousBatcher:
                                 error=f"engine error: {e}",
                             )
                         )
+                        self.stats["completed"] += 1
                 for i, s in enumerate(list(self.engine.slots)):
                     if s is None:
                         continue
@@ -566,6 +572,7 @@ class ContinuousBatcher:
                                 error=f"engine error: {e}",
                             )
                         )
+                        self.stats["completed"] += 1
 
     def get_stats(self) -> Dict[str, Any]:
         out = dict(self.stats)

@@ -297,7 +297,17 @@ def _read_blobs(data: bytes, count: int) -> List[bytes]:
     view = memoryview(data)
     off, out = 0, []
     for _ in range(count):
+        if off + 8 > len(view):
+            raise ValueError(
+                f"malformed handoff frame: truncated length prefix at "
+                f"offset {off} (frame is {len(view)} bytes)"
+            )
         n = int.from_bytes(view[off : off + 8], "little")
+        if off + 8 + n > len(view):
+            raise ValueError(
+                f"malformed handoff frame: blob of {n} bytes at offset "
+                f"{off} overruns the {len(view)}-byte frame"
+            )
         out.append(bytes(view[off + 8 : off + 8 + n]))
         off += 8 + n
     return out
@@ -521,10 +531,20 @@ def _pack_stream(kind: int, meta: Dict[str, Any],
 def _unpack_stream(data: bytes) -> Tuple[int, Dict[str, Any], bytes]:
     if data[:4] != _STREAM_MAGIC:
         raise ValueError("not a streamed handoff message")
+    if len(data) < 10:
+        raise ValueError(
+            f"malformed handoff frame: {len(data)}-byte message is shorter "
+            "than the 10-byte stream header"
+        )
     if data[4] != 1:
         raise ValueError(f"unsupported stream version {data[4]}")
     kind = data[5]
     n = int.from_bytes(data[6:10], "little")
+    if n == 0 or 10 + n > len(data):
+        raise ValueError(
+            f"malformed handoff frame: {n}-byte stream header overruns "
+            f"the {len(data)}-byte message"
+        )
     meta = _unpack_header(bytes(data[10:10 + n]))
     return kind, meta, bytes(data[10 + n:])
 
@@ -722,7 +742,16 @@ class _AdoptSession:
     cached_tokens: int
     prompt_len: int
     staged: List[int] = field(default_factory=list)
-    created: float = field(default_factory=time.monotonic)
+    # last-activity time, refreshed on every piece: a long streamed
+    # migration (multi-GB KV at the documented ~4 MB/s tunnel D2H rate)
+    # must not be purged mid-stream by its own later messages — only
+    # sessions with no traffic for SESSION_TTL_S are stale.
+    last_activity: float = field(default_factory=time.monotonic)
+    # refreshed only when a piece stages a NOT-previously-staged block:
+    # legitimate migrations of any size keep making block progress (total
+    # refreshes bounded by the block count), while a trickler re-sending
+    # the same block forever stalls this clock and hits the backstop
+    last_progress: float = field(default_factory=time.monotonic)
 
 
 class HandoffReceiver:
@@ -735,6 +764,13 @@ class HandoffReceiver:
     """
 
     SESSION_TTL_S = 180.0
+    # no-progress backstop: a donor that keeps the session warm (pieces
+    # every <TTL) without ever staging a new block must not pin its
+    # allocated KV blocks forever. Progress-based, not a hard lifetime cap:
+    # a legitimate migration of ANY size stages new blocks as it goes (at
+    # the documented ~4 MB/s tunnel rate even a 2 MB block lands well
+    # inside this window), so only stalled/adversarial streams hit it.
+    SESSION_MAX_NO_PROGRESS_S = 10 * 180.0
 
     def __init__(self, engine: "TPUEngine") -> None:
         self.engine = engine
@@ -811,6 +847,7 @@ class HandoffReceiver:
     def _piece(self, meta: Dict[str, Any], payload: bytes,
                raw_len: int) -> Dict[str, Any]:
         sess = self._require(meta["key"])
+        sess.last_activity = time.monotonic()
         if meta.get("has_scales"):
             pb, sb = _read_blobs(payload, 2)
             pages = TensorSerializer().deserialize(pb)
@@ -822,6 +859,7 @@ class HandoffReceiver:
         eng = self.engine
         cached_blocks = sess.cached_tokens // sess.block_size
         uploaded = 0
+        already = set(sess.staged)
         for j in range(pages.shape[0]):
             i = lo + j
             if i >= len(sess.blocks):
@@ -836,6 +874,8 @@ class HandoffReceiver:
                 eng.manager.pending.scale_uploads.append(
                     (sess.blocks[i], scales[j])
                 )
+            if sess.blocks[i] not in already:
+                sess.last_progress = time.monotonic()
             sess.staged.append(sess.blocks[i])
             uploaded += 1
         eng._apply_pending()
@@ -920,7 +960,8 @@ class HandoffReceiver:
     def _purge_stale(self) -> None:
         now = time.monotonic()
         for key in [k for k, s in self._sessions.items()
-                    if now - s.created > self.SESSION_TTL_S]:
+                    if now - s.last_activity > self.SESSION_TTL_S
+                    or now - s.last_progress > self.SESSION_MAX_NO_PROGRESS_S]:
             self._drop(key)
 
 

@@ -1,0 +1,193 @@
+"""Pure-logic handoff wire-framing + receiver-session hygiene tests.
+
+No engine, no jit — these run in the fast gate. Covers the round-5
+hardening of the network-facing /kv/transfer frame parsers (malformed
+frames must fail loudly AT the framing layer, not as confusing
+serializer errors downstream) and the streamed-session purge policy
+(inactivity-based, so a long migration is never dropped mid-stream by
+its own later messages).
+"""
+
+import time
+import types
+
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+    HandoffReceiver,
+    _AdoptSession,
+    _frame_blobs,
+    _pack_stream,
+    _read_blobs,
+    _unpack_stream,
+)
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+
+# -- frame bounds ----------------------------------------------------------
+
+
+def test_read_blobs_roundtrip():
+    blobs = [b"alpha", b"", b"x" * 1000]
+    assert _read_blobs(_frame_blobs(*blobs), 3) == blobs
+
+
+def test_read_blobs_truncated_payload_raises():
+    framed = _frame_blobs(b"hello world")
+    with pytest.raises(ValueError, match="malformed handoff frame"):
+        _read_blobs(framed[:-3], 1)
+
+
+def test_read_blobs_truncated_length_prefix_raises():
+    framed = _frame_blobs(b"a", b"b")
+    # cut into the second blob's 8-byte length prefix
+    with pytest.raises(ValueError, match="malformed handoff frame"):
+        _read_blobs(framed[: 8 + 1 + 4], 2)
+
+
+def test_read_blobs_lying_length_raises():
+    # length prefix claims 1 GiB; frame holds 3 bytes
+    bad = (1 << 30).to_bytes(8, "little") + b"abc"
+    with pytest.raises(ValueError, match="overruns"):
+        _read_blobs(bad, 1)
+
+
+def test_unpack_stream_roundtrip():
+    msg = _pack_stream(1, {"key": "k", "block_lo": 0}, b"payload")
+    kind, meta, payload = _unpack_stream(msg)
+    assert kind == 1 and meta["key"] == "k" and payload == b"payload"
+
+
+def test_unpack_stream_truncated_header_raises():
+    msg = _pack_stream(2, {"key": "k", "token_ids": list(range(64))})
+    with pytest.raises(ValueError, match="malformed handoff frame"):
+        _unpack_stream(msg[: len(msg) // 2])
+
+
+@pytest.mark.parametrize("n_bytes", [4, 5, 9])
+def test_unpack_stream_short_frame_raises_cleanly(n_bytes):
+    # bodies shorter than the 10-byte header must get the framing error,
+    # not a bare IndexError surfacing as an HTTP 500 from the data plane
+    msg = _pack_stream(0, {"key": "k"})
+    with pytest.raises(ValueError, match="malformed handoff frame"):
+        _unpack_stream(msg[:n_bytes])
+
+
+def test_unpack_stream_zero_length_header_raises():
+    bad = b"TPUS" + bytes([1, 0]) + (0).to_bytes(4, "little")
+    with pytest.raises(ValueError, match="malformed handoff frame"):
+        _unpack_stream(bad)
+
+
+# -- session purge policy --------------------------------------------------
+
+
+def _fake_receiver():
+    """Fully-wired HandoffReceiver over a stub engine: enough surface for
+    _drop() and scale-free _piece()."""
+    manager = types.SimpleNamespace(
+        pending=types.SimpleNamespace(uploads=[], scale_uploads=[]),
+        seq_blocks={},
+        free_sequence=lambda *a, **kw: None,
+    )
+    engine = types.SimpleNamespace(
+        manager=manager, _apply_pending=lambda: None
+    )
+    rx = HandoffReceiver.__new__(HandoffReceiver)
+    rx.engine = engine
+    rx._sessions = {}
+    return rx
+
+
+def _session():
+    req = InferenceRequest(
+        prompt_token_ids=[1, 2, 3],
+        sampling=SamplingParams(max_new_tokens=4),
+    )
+    return _AdoptSession(
+        seq_id="s", request=req, block_size=16, blocks=[0],
+        cached_tokens=0, prompt_len=3,
+    )
+
+
+def test_purge_is_inactivity_based_not_age_based():
+    rx = _fake_receiver()
+    old = _session()
+    # session BEGUN long ago but with recent piece traffic must survive
+    old.last_activity = time.monotonic() - 1.0
+    rx._sessions = {"live": old}
+    rx._purge_stale()
+    assert "live" in rx._sessions
+
+    stale = _session()
+    stale.last_activity = time.monotonic() - HandoffReceiver.SESSION_TTL_S - 1
+    rx._sessions["stale"] = stale
+    rx._purge_stale()
+    assert "stale" not in rx._sessions
+    assert "live" in rx._sessions
+
+
+def test_no_progress_backstop_bounds_trickling_donors():
+    # a donor keeping the session warm (pieces every <TTL) without ever
+    # staging a NEW block must still be dropped — KV blocks can't be
+    # pinned forever. A migration making real block progress, however
+    # slow or large, is never dropped.
+    rx = _fake_receiver()
+    s = _session()
+    s.last_activity = time.monotonic()          # warm right now...
+    s.last_progress = (time.monotonic()
+                       - HandoffReceiver.SESSION_MAX_NO_PROGRESS_S - 1)
+    rx._sessions = {"trickle": s}
+
+    progressing = _session()
+    progressing.last_activity = time.monotonic()
+    progressing.last_progress = time.monotonic() - 60.0   # staged recently
+    rx._sessions["big-migration"] = progressing
+
+    rx._purge_stale()
+    assert "trickle" not in rx._sessions
+    assert "big-migration" in rx._sessions
+
+
+def test_piece_with_new_block_refreshes_progress_clock():
+    import numpy as np
+
+    from distributed_gpu_inference_tpu.utils.serialization import (
+        TensorSerializer,
+    )
+
+    rx = _fake_receiver()
+    s = _session()
+    stale = time.monotonic() - HandoffReceiver.SESSION_MAX_NO_PROGRESS_S + 9
+    s.last_progress = stale
+    rx._sessions = {"k": s}
+    payload = TensorSerializer().serialize(np.zeros((1, 2), np.float32))
+    # first delivery of block 0: progress
+    rx._piece({"key": "k", "block_lo": 0}, payload, len(payload))
+    assert s.last_progress > stale
+    # re-sending the SAME block is activity but NOT progress
+    s.last_progress = stale
+    rx._piece({"key": "k", "block_lo": 0}, payload, len(payload))
+    assert s.last_progress == stale
+
+
+def test_piece_refreshes_last_activity():
+    import numpy as np
+
+    from distributed_gpu_inference_tpu.utils.serialization import (
+        TensorSerializer,
+    )
+
+    rx = _fake_receiver()
+    sess = _session()
+    sess.last_activity = time.monotonic() - HandoffReceiver.SESSION_TTL_S + 5
+    rx._sessions = {"k": sess}
+    before = sess.last_activity
+    # a piece for an out-of-range block index is a no-op upload-wise but
+    # must still refresh the activity clock
+    payload = TensorSerializer().serialize(np.zeros((1, 2), np.float32))
+    rx._piece({"key": "k", "block_lo": 99}, payload, len(payload))
+    assert rx._sessions["k"].last_activity > before
