@@ -451,7 +451,20 @@ def _layer_step(
         if dense_attn_fn is not None:
             # pages written above for decode; attention itself runs over the
             # chunk's dense K/V (== whole context for a from-scratch prefill)
-            attn = dense_attn_fn(q, k, v)
+            if quant_kv:
+                # int8 pools: attend over the quantize→dequantize roundtrip
+                # of the chunk's K/V (THE shared dequant arithmetic) so a
+                # dense seq-sharded prefill matches a single-chip engine's
+                # paged-read prefill
+                from distributed_gpu_inference_tpu.ops.attention import (
+                    dequantize_kv,
+                )
+
+                attn = dense_attn_fn(
+                    q, dequantize_kv(k_q, k_s), dequantize_kv(v_q, v_s)
+                )
+            else:
+                attn = dense_attn_fn(q, k, v)
         elif quant_kv:
             attn = attn_fn(q, layer_k, layer_v, layer_ks, layer_vs)
         else:
@@ -483,10 +496,12 @@ def forward_chunk(
     last_only: bool = True,
     with_logits: bool = True,
     dense_attn_fn=None,
-    attn_override=None,   # (q, layer_k, layer_v, tables, positions, kv_lens)
-                          # replaces the paged-attention read (e.g. the
-                          # seq-sharded-pool shard_map op); disables the
-                          # fused Pallas path
+    attn_override=None,   # (q, layer_k, layer_v, tables, positions,
+                          # kv_lens, layer_ks, layer_vs) — replaces the
+                          # paged-attention read (e.g. the seq-sharded-pool
+                          # shard_map op); disables the fused Pallas path.
+                          # layer_ks/layer_vs are the layer's scale-pool
+                          # slices (int8 pools) or None
     collect_layers: Optional[Tuple[int, ...]] = None,
                           # also return ChunkOutput.features = concat of
                           # these layers' post-layer hiddens (EAGLE-3 draft
@@ -511,15 +526,13 @@ def forward_chunk(
 
     quant_kv = "k_scale" in kv
     if attn_override is not None:
-        if quant_kv:
-            raise NotImplementedError(
-                "attn_override (seq-sharded pools) does not compose with "
-                "int8 KV yet — the shard_map ops read raw pool values"
-            )
-
-        def attn_fn(q, layer_k, layer_v):
+        # int8 pools: the override receives the layer's scale pools too —
+        # the seq-sharded shard_map ops dequantize their local page shards
+        # (scales ride the same block axis; parallel/ring_attention.py)
+        def attn_fn(q, layer_k, layer_v, layer_ks=None, layer_vs=None):
             return attn_override(
-                q, layer_k, layer_v, block_tables, positions, kv_lens
+                q, layer_k, layer_v, block_tables, positions, kv_lens,
+                layer_ks, layer_vs,
             )
     else:
         def attn_fn(q, layer_k, layer_v, layer_ks=None, layer_vs=None):

@@ -119,6 +119,17 @@ def paged_attention(
     )
 
 
+def dequantize_kv(codes: jax.Array, scale: jax.Array) -> jax.Array:
+    """THE int8-KV dequant arithmetic: bf16 cast of BOTH operands, then
+    multiply. Every reader of int8 pages — the XLA gather here, the
+    seq-sharded shard_map locals (``parallel/ring_attention.py``), and the
+    dense prefill roundtrip (``models/llama._layer_step``) — must produce
+    bit-identical reals from the same (codes, scale), so the arithmetic
+    lives in exactly one place. ``scale`` must already broadcast against
+    ``codes``."""
+    return codes.astype(jnp.bfloat16) * scale.astype(jnp.bfloat16)
+
+
 def _gather_ctx(
     pool: jax.Array, block_tables: jax.Array, block_size: int,
     scale: Optional[jax.Array] = None,
@@ -139,7 +150,7 @@ def _gather_ctx(
     s_ctx = jnp.take(scale, block_tables, axis=0).reshape(
         b, m * block_size, d
     )
-    return ctx.astype(jnp.bfloat16) * s_ctx[:, :, None, :].astype(jnp.bfloat16)
+    return dequantize_kv(ctx, s_ctx[:, :, None, :])
 
 
 def paged_attention_xla(

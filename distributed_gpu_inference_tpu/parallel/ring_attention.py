@@ -229,6 +229,32 @@ def _decode_local(
     return out.transpose(0, 3, 1, 2, 4).reshape(b, sq, nh, d).astype(q.dtype)
 
 
+def _shard_map_paged(local, mesh, base_specs, args, k_scale, v_scale):
+    """Build + call the shard_map for a paged partial-softmax op, appending
+    the int8 scale-pool operands (block-axis-sharded exactly like their
+    data pools) when the pool is quantized — the ONE place the quant wiring
+    for the seq-sharded ops lives."""
+    if k_scale is not None:
+        def body(*a):
+            *base, ks_, vs_ = a
+            return local(*base, k_scale=ks_, v_scale=vs_)
+
+        in_specs = base_specs + (
+            P(AXIS_SEQ, None, None), P(AXIS_SEQ, None, None),
+        )
+        args = args + (k_scale, v_scale)
+    else:
+        body, in_specs = local, base_specs
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=P(None, None, None, None),
+        check_vma=False,
+    )
+    return fn(*args)
+
+
 def _paged_decode_local(
     q: jax.Array,            # [B, 1, Nh, D] (replicated over the seq axis)
     k_shard: jax.Array,      # [Nloc, Hkv, Bk, D] — this device's pool shard
@@ -238,9 +264,17 @@ def _paged_decode_local(
     kv_lens: jax.Array,      # [B] global context lengths
     axis_name: str,
     block_size: int,
+    k_scale: Optional[jax.Array] = None,  # [Nloc, Bk, D] bf16 — int8 pools
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-device body: attend over the LOCAL subset of each sequence's
-    pages, then merge the partial (max, sum, acc) across the axis."""
+    pages, then merge the partial (max, sum, acc) across the axis.
+
+    ``k_scale``/``v_scale``: int8 pools' per-(page, token) scale shards —
+    they ride the same block axis as their data pools, so dequantization is
+    entirely local (same arithmetic as ``ops.attention._gather_ctx``: bf16
+    cast then multiply, keeping numerics identical to the single-chip read).
+    """
     idx = jax.lax.axis_index(axis_name)
     b, _, nh, d = q.shape
     nloc, hkv = k_shard.shape[0], k_shard.shape[1]
@@ -260,6 +294,13 @@ def _paged_decode_local(
     v_ctx = jnp.take(v_shard, safe, axis=0).transpose(0, 1, 3, 2, 4).reshape(
         b, j, hkv, d
     )
+    if k_scale is not None:
+        from distributed_gpu_inference_tpu.ops.attention import dequantize_kv
+
+        ks_ctx = jnp.take(k_scale, safe, axis=0).reshape(b, j, d)
+        vs_ctx = jnp.take(v_scale, safe, axis=0).reshape(b, j, d)
+        k_ctx = dequantize_kv(k_ctx, ks_ctx[:, :, None, :])
+        v_ctx = dequantize_kv(v_ctx, vs_ctx[:, :, None, :])
 
     qg = q.reshape(b, 1, hkv, qpk, d).astype(jnp.float32)
     scores = jnp.einsum(
@@ -298,6 +339,8 @@ def seq_parallel_paged_decode_attention(
     kv_lens: jax.Array,       # [B]
     mesh: Mesh,
     block_size: int = 16,
+    k_scale: Optional[jax.Array] = None,  # [N, Bk, D] — sharded like pools
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode attention over a PAGED pool whose block axis is sharded over
     the ``seq`` mesh axis — the memory-scaling completion of ring prefill
@@ -306,7 +349,9 @@ def seq_parallel_paged_decode_attention(
     cross ICI; pages never move).
 
     Semantics match ``ops.attention.paged_attention_xla`` over the same pool
-    (causal by ``positions``, bounded by ``kv_lens``, inactive rows zero).
+    (causal by ``positions``, bounded by ``kv_lens``, inactive rows zero),
+    including int8 pools when ``k_scale``/``v_scale`` are given (scale
+    shards ride the block axis; dequantization is local to each device).
     The pool's N must divide evenly by the seq axis.
     """
     n = dict(mesh.shape).get(AXIS_SEQ, 1)
@@ -314,26 +359,22 @@ def seq_parallel_paged_decode_attention(
         raise ValueError(
             f"pool blocks {k_pool.shape[0]} not divisible by seq axis {n}"
         )
-    fn = jax.shard_map(
-        functools.partial(
-            _paged_decode_local, axis_name=AXIS_SEQ, block_size=block_size
-        ),
-        mesh=mesh,
-        in_specs=(
-            P(None, None, None, None),
-            P(AXIS_SEQ, None, None, None),
-            P(AXIS_SEQ, None, None, None),
-            P(None, None),
-            P(None),
-            P(None),
-        ),
-        out_specs=P(None, None, None, None),
-        check_vma=False,
+    local = functools.partial(
+        _paged_decode_local, axis_name=AXIS_SEQ, block_size=block_size
     )
-    return fn(
+    base_specs = (
+        P(None, None, None, None),
+        P(AXIS_SEQ, None, None, None),
+        P(AXIS_SEQ, None, None, None),
+        P(None, None),
+        P(None),
+        P(None),
+    )
+    args = (
         q, k_pool, v_pool, block_tables.astype(jnp.int32),
         positions[:, 0].astype(jnp.int32), kv_lens.astype(jnp.int32),
     )
+    return _shard_map_paged(local, mesh, base_specs, args, k_scale, v_scale)
 
 
 def _paged_chunk_local(
@@ -346,12 +387,16 @@ def _paged_chunk_local(
     axis_name: str,
     block_size: int,
     pages_per_step: int,
+    k_scale: Optional[jax.Array] = None,  # [Nloc, Bk, D] bf16 — int8 pools
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Chunk (q_len ≥ 1) attention over the LOCAL pool shard, flash-style:
     a ``lax.scan`` over page groups keeps the per-step score tile at
     [B, Nh, S, G·Bk] instead of materializing [S, whole-context] — the
     long-context case this op exists for. Partial (m, l, acc) merge across
-    the axis afterwards, exactly like the decode op."""
+    the axis afterwards, exactly like the decode op. int8 pools dequantize
+    per page group inside the scan (``_gather_ctx`` arithmetic), so the
+    dequantized tile never exceeds [B, G·Bk, Hkv, D]."""
     idx = jax.lax.axis_index(axis_name)
     b, s, nh, d = q.shape
     nloc, hkv = k_shard.shape[0], k_shard.shape[1]
@@ -382,6 +427,17 @@ def _paged_chunk_local(
         v_ctx = jnp.take(v_shard, ids, axis=0).transpose(
             0, 1, 3, 2, 4
         ).reshape(b, g * block_size, hkv, d)
+        if k_scale is not None:
+            from distributed_gpu_inference_tpu.ops.attention import (
+                dequantize_kv,
+            )
+
+            ks_ctx = jnp.take(k_scale, ids, axis=0).reshape(
+                b, g * block_size, d)
+            vs_ctx = jnp.take(v_scale, ids, axis=0).reshape(
+                b, g * block_size, d)
+            k_ctx = dequantize_kv(k_ctx, ks_ctx[:, :, None, :])
+            v_ctx = dequantize_kv(v_ctx, vs_ctx[:, :, None, :])
         key_pos = (
             page0 * block_size
             + jnp.arange(g * block_size, dtype=jnp.int32)
@@ -435,6 +491,8 @@ def seq_parallel_paged_chunk_attention(
     mesh: Mesh,
     block_size: int = 16,
     pages_per_step: int = 16,
+    k_scale: Optional[jax.Array] = None,  # [N, Bk, D] — sharded like pools
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Chunk attention (q_len ≥ 1) over a seq-sharded paged pool — what lets
     ``kv_seq_sharded`` engines serve PREFIX-CACHED and CHUNKED/continuation
@@ -443,33 +501,30 @@ def seq_parallel_paged_chunk_attention(
     block axis covers cached prefix + prior chunks + in-chunk causal keys.
     Generalizes :func:`seq_parallel_paged_decode_attention` (S = 1) with a
     flash-style page-group scan so long contexts never materialize
-    [S, ctx] scores."""
+    [S, ctx] scores. ``k_scale``/``v_scale`` (int8 pools) shard with their
+    data pools and dequantize locally."""
     n = dict(mesh.shape).get(AXIS_SEQ, 1)
     if k_pool.shape[0] % n:
         raise ValueError(
             f"pool blocks {k_pool.shape[0]} not divisible by seq axis {n}"
         )
-    fn = jax.shard_map(
-        functools.partial(
-            _paged_chunk_local, axis_name=AXIS_SEQ, block_size=block_size,
-            pages_per_step=pages_per_step,
-        ),
-        mesh=mesh,
-        in_specs=(
-            P(None, None, None, None),
-            P(AXIS_SEQ, None, None, None),
-            P(AXIS_SEQ, None, None, None),
-            P(None, None),
-            P(None, None),
-            P(None),
-        ),
-        out_specs=P(None, None, None, None),
-        check_vma=False,
+    local = functools.partial(
+        _paged_chunk_local, axis_name=AXIS_SEQ, block_size=block_size,
+        pages_per_step=pages_per_step,
     )
-    return fn(
+    base_specs = (
+        P(None, None, None, None),
+        P(AXIS_SEQ, None, None, None),
+        P(AXIS_SEQ, None, None, None),
+        P(None, None),
+        P(None, None),
+        P(None),
+    )
+    args = (
         q, k_pool, v_pool, block_tables.astype(jnp.int32),
         positions.astype(jnp.int32), kv_lens.astype(jnp.int32),
     )
+    return _shard_map_paged(local, mesh, base_specs, args, k_scale, v_scale)
 
 
 def seq_parallel_decode_attention(

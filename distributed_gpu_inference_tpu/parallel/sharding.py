@@ -96,6 +96,25 @@ def kv_sharding_seq(mesh: Mesh) -> NamedSharding:
     return _ns(mesh, None, AXIS_SEQ, AXIS_MODEL, None, None)
 
 
+def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    """int8-KV scale pools [L, N, Bk, D]: one scale per (page, token)
+    shared across KV heads, so there is no head axis to shard — the scale
+    pool rides replicated next to head-sharded data pools (it is Hkv x
+    smaller, so replication costs less HBM than data-pool sharding saves).
+    The quantize amax reduces over ALL heads (a cross-shard reduce XLA
+    lowers to an all-reduce-max over ``model``), keeping scales — and
+    therefore the stored int8 — bit-identical to a single-chip engine."""
+    return _ns(mesh, None, None, None, None)
+
+
+def kv_scale_sharding_seq(mesh: Mesh) -> NamedSharding:
+    """int8-KV scale pools under seq-sharded data pools: the scale pool's
+    BLOCK axis shards over ``seq`` exactly like its data pool, so a (page,
+    token)'s scale lives on the same device as its int8 rows and the
+    shard_map partial-softmax ops dequantize locally — no scale traffic."""
+    return _ns(mesh, None, AXIS_SEQ, None, None)
+
+
 def batch_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
     return {
         "tokens": _ns(mesh, AXIS_DATA, None),       # [B, S]

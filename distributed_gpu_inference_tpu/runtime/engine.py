@@ -226,19 +226,20 @@ class TPUEngine:
                 f"kv_cache_dtype={self.cfg.kv_cache_dtype!r} needs "
                 f"block_size % 32 == 0 on TPU, got {self.cfg.block_size}"
             )
-        if self.kv_dtype == jnp.int8:
-            # v1 fences for scale-carrying pools: these surfaces move raw
-            # pages without their scales and would silently corrupt
-            if mesh is not None:
-                raise ValueError(
-                    "kv_cache_dtype='int8' is single-chip for now (sharded "
-                    "scale pools are not plumbed)"
-                )
-            if self.cfg.spill_host_blocks or self.cfg.spill_remote_store:
-                raise ValueError(
-                    "kv_cache_dtype='int8' does not compose with KV spill "
-                    "tiers yet (spilled pages would drop their scales)"
-                )
+        # int8 KV composes with meshes since round 5: scale pools shard
+        # with their data pools (replicated under TP — no head axis to
+        # shard; block-axis-sharded under seq — parallel/sharding.py
+        # kv_scale_sharding*), the shard_map seq ops dequantize their local
+        # page shards, and the quantize amax reduce over sharded heads
+        # lowers to an all-reduce-max, keeping scales bit-identical to a
+        # single-chip engine.
+        if self.kv_dtype == jnp.int8 and (
+            self.cfg.spill_host_blocks or self.cfg.spill_remote_store
+        ):
+            raise ValueError(
+                "kv_cache_dtype='int8' does not compose with KV spill "
+                "tiers yet (spilled pages would drop their scales)"
+            )
         self.mesh = mesh
         self._seq_axis = 1
         if mesh is not None:
@@ -500,12 +501,20 @@ class TPUEngine:
             _sh.kv_sharding_seq(self.mesh)
             if self.cfg.kv_seq_sharded else _sh.kv_sharding(self.mesh)
         )
+        out_s = {"k": s, "v": s}
+        if self.kv_dtype == jnp.int8:
+            ss = (
+                _sh.kv_scale_sharding_seq(self.mesh)
+                if self.cfg.kv_seq_sharded
+                else _sh.kv_scale_sharding(self.mesh)
+            )
+            out_s["k_scale"] = out_s["v_scale"] = ss
         make = jax.jit(
             lambda: llama.init_kv_pools(
                 self.model_cfg, self.num_blocks, self.cfg.block_size,
                 self.kv_dtype,
             ),
-            out_shardings={"k": s, "v": s},
+            out_shardings=out_s,
         )
         return make()
 
@@ -539,10 +548,10 @@ class TPUEngine:
             mesh = self.mesh
 
             def decode_attn_override(q, layer_k, layer_v, tables, positions,
-                                     kv_lens):
+                                     kv_lens, layer_ks=None, layer_vs=None):
                 return seq_parallel_paged_decode_attention(
                     q, layer_k, layer_v, tables, positions, kv_lens, mesh,
-                    block_size=bs,
+                    block_size=bs, k_scale=layer_ks, v_scale=layer_vs,
                 )
 
             def prefill_dense_fn(q, k, v, kv_lens):
@@ -552,10 +561,10 @@ class TPUEngine:
             # pool by the time attention runs, so one partial-softmax read
             # covers cached prefix + prior chunks + in-chunk causal keys
             def chunk_attn_override(q, layer_k, layer_v, tables, positions,
-                                    kv_lens):
+                                    kv_lens, layer_ks=None, layer_vs=None):
                 return seq_parallel_paged_chunk_attention(
                     q, layer_k, layer_v, tables, positions, kv_lens, mesh,
-                    block_size=bs,
+                    block_size=bs, k_scale=layer_ks, v_scale=layer_vs,
                 )
 
         # --- device-state pack/unpack (ONE upload per packed buffer: on a
