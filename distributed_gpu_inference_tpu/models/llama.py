@@ -507,6 +507,14 @@ def forward_chunk(
                           # these layers' post-layer hiddens (EAGLE-3 draft
                           # features) — costs L x hidden activation memory,
                           # request only on small spec/distill shapes
+    allow_fused: bool = True,
+                          # gate for the fused Pallas decode path: an
+                          # engine serving over a GSPMD mesh must pass
+                          # False — a pallas_call has no partitioning
+                          # rules, and the kernel's in-VMEM per-token
+                          # quantize amax (int8 pools) would reduce over
+                          # LOCAL heads only, breaking the all-reduce-max
+                          # scale contract (parallel/sharding.py)
 ) -> ChunkOutput:
     """Run S tokens per sequence through all layers against the paged cache.
 
@@ -553,7 +561,8 @@ def forward_chunk(
         sin=sin,
         attn_fn=attn_fn,
         fused_decode=(
-            _use_fused_decode(cfg, s, block_tables, block_size)
+            allow_fused
+            and _use_fused_decode(cfg, s, block_tables, block_size)
             and dense_attn_fn is None
             and attn_override is None
         ),

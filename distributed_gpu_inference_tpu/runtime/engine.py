@@ -226,20 +226,15 @@ class TPUEngine:
                 f"kv_cache_dtype={self.cfg.kv_cache_dtype!r} needs "
                 f"block_size % 32 == 0 on TPU, got {self.cfg.block_size}"
             )
-        # int8 KV composes with meshes since round 5: scale pools shard
-        # with their data pools (replicated under TP — no head axis to
-        # shard; block-axis-sharded under seq — parallel/sharding.py
-        # kv_scale_sharding*), the shard_map seq ops dequantize their local
-        # page shards, and the quantize amax reduce over sharded heads
-        # lowers to an all-reduce-max, keeping scales bit-identical to a
-        # single-chip engine.
-        if self.kv_dtype == jnp.int8 and (
-            self.cfg.spill_host_blocks or self.cfg.spill_remote_store
-        ):
-            raise ValueError(
-                "kv_cache_dtype='int8' does not compose with KV spill "
-                "tiers yet (spilled pages would drop their scales)"
-            )
+        # int8 KV composes with meshes AND spill tiers since round 5:
+        # scale pools shard with their data pools (replicated under TP —
+        # no head axis to shard; block-axis-sharded under seq —
+        # parallel/sharding.py kv_scale_sharding*), the shard_map seq ops
+        # dequantize their local page shards, the quantize amax reduce
+        # over sharded heads lowers to an all-reduce-max (scales stay
+        # bit-identical to a single-chip engine), and evicted pages spill
+        # int8 codes + scale pages as an atomic pair through L2/L3
+        # (runtime/kv_cache.py store_spilled/_probe_spill).
         self.mesh = mesh
         self._seq_axis = 1
         if mesh is not None:
@@ -303,6 +298,7 @@ class TPUEngine:
             host_store=host_store,
             remote_store=self.cfg.spill_remote_store,
             spill_on_evict=spill,
+            kv_dtype=np.dtype(self.kv_dtype),
         )
         self.eos_token_id = eos_token_id
 
@@ -731,6 +727,11 @@ class TPUEngine:
                     cfg, params, last[:, None], positions, kv, tables, cur,
                     block_size=bs, last_only=True,
                     attn_override=decode_attn_override,
+                    # the fused Pallas decode kernel has no GSPMD
+                    # partitioning rules (and its in-kernel int8 quantize
+                    # amax would be per-shard): mesh engines stay on the
+                    # XLA paged path, which partitions + all-reduces
+                    allow_fused=self.mesh is None,
                 )
                 toks = sample_mode(
                     out.logits[:, 0, :], core["keys"], cur, core["temps"],
@@ -835,7 +836,16 @@ class TPUEngine:
         for bid, key in ops.downloads:
             k = np.asarray(self.kv["k"][:, bid])
             v = np.asarray(self.kv["v"][:, bid])
-            self.manager.store_spilled(key, np.stack([k, v], axis=1))
+            scale_page = None
+            if "k_scale" in self.kv:
+                # an int8 page without its scale is garbage: spill them as
+                # a pair (manager stores the scale under the paired key)
+                ks = np.asarray(self.kv["k_scale"][:, bid])
+                vs = np.asarray(self.kv["v_scale"][:, bid])
+                scale_page = np.stack([ks, vs], axis=1)
+            self.manager.store_spilled(
+                key, np.stack([k, v], axis=1), scale_page
+            )
         if ops.copies:
             n = len(ops.copies)
             bucket = next(c for c in _COPY_BUCKETS if c >= n) if n <= _COPY_BUCKETS[-1] else n

@@ -41,6 +41,40 @@ class OutOfBlocksError(RuntimeError):
     pass
 
 
+def _pack_spill(page: np.ndarray,
+                scale_page: Optional[np.ndarray]) -> bytes:
+    """L3 wire form of a spilled block: length-prefixed page blob, then the
+    optional scale blob. One entry per block — (page, scale) are atomic by
+    construction, so there is no orphaned-scale state to defend against."""
+    from distributed_gpu_inference_tpu.utils.serialization import (
+        TensorSerializer,
+    )
+
+    ser = TensorSerializer()
+    pb = ser.serialize(page)
+    out = len(pb).to_bytes(8, "little") + pb
+    if scale_page is not None:
+        out += ser.serialize(scale_page)
+    return out
+
+
+def _unpack_spill(raw: bytes) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    from distributed_gpu_inference_tpu.utils.serialization import (
+        TensorSerializer,
+    )
+
+    n = int.from_bytes(raw[:8], "little")
+    if 8 + n > len(raw):
+        raise ValueError(
+            f"malformed spill entry: {n}-byte page blob overruns the "
+            f"{len(raw)}-byte entry"
+        )
+    ser = TensorSerializer()
+    page = ser.deserialize(raw[8:8 + n])
+    scale = ser.deserialize(raw[8 + n:]) if len(raw) > 8 + n else None
+    return page, scale
+
+
 @dataclass
 class PendingDeviceOps:
     """Device-side effects for the engine to apply in its next jitted update.
@@ -215,7 +249,11 @@ class KVCacheStats:
 
 
 class HostKVStore:
-    """L2 host-RAM spill tier: block-content-keyed numpy pages with LRU cap.
+    """L2 host-RAM spill tier: block-content-keyed entries with LRU cap.
+
+    An entry is one spilled BLOCK: a bare page array, or a
+    ``(page, scale_page | None)`` tuple for int8 pools — one LRU slot per
+    block either way, so ``max_blocks`` means what it says.
 
     Reference analogue: DistributedKVCacheManager's CPU OrderedDict tier
     (kv_cache.py:326, promote-on-hit :447-462).
@@ -223,15 +261,15 @@ class HostKVStore:
 
     def __init__(self, max_blocks: int = 1024) -> None:
         self.max_blocks = max_blocks
-        self._store: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        self._store: "OrderedDict[str, Any]" = OrderedDict()
 
-    def get(self, key: str) -> Optional[np.ndarray]:
+    def get(self, key: str) -> Optional[Any]:
         arr = self._store.get(key)
         if arr is not None:
             self._store.move_to_end(key)
         return arr
 
-    def put(self, key: str, value: np.ndarray) -> None:
+    def put(self, key: str, value: Any) -> None:
         if self.max_blocks <= 0:
             return
         self._store[key] = value
@@ -296,7 +334,14 @@ class PagedKVCacheManager:
         host_store: Optional[HostKVStore] = None,
         remote_store: Optional[RemoteKVStore] = None,
         spill_on_evict: bool = False,
+        kv_dtype: Optional[Any] = None,
     ) -> None:
+        """``kv_dtype``: the engine's pool dtype — a probe hit must match
+        it exactly (a token-keyed store shared across engines must never
+        hand a bf16 engine int8 codes, f32 pages to a bf16 engine, etc.),
+        and int8 hits must carry their scale page (spilled as one atomic
+        (page, scale) entry). None disables the screen (manager used
+        standalone in tests)."""
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is reserved)")
         self.num_blocks = num_blocks
@@ -305,6 +350,8 @@ class PagedKVCacheManager:
         self.host_store = host_store
         self.remote_store = remote_store
         self.spill_on_evict = spill_on_evict
+        self.kv_dtype = np.dtype(kv_dtype) if kv_dtype is not None else None
+        self.quantized_kv = self.kv_dtype == np.int8
 
         self.metas: Dict[int, KVBlockMeta] = {}
         self.free_list: List[int] = list(range(num_blocks - 1, 0, -1))  # pop() → 1..
@@ -366,38 +413,62 @@ class PagedKVCacheManager:
 
     # -- spill tiers (reference get_or_compute chain, kv_cache.py:389-462) ---
 
-    def store_spilled(self, key: str, page: np.ndarray) -> None:
+    def store_spilled(self, key: str, page: np.ndarray,
+                      scale_page: Optional[np.ndarray] = None) -> None:
         """Engine callback with the evicted page bytes: L2 host store plus
-        write-through to L3 (reference async Redis writeback :506-520)."""
+        write-through to L3 (reference async Redis writeback :506-520).
+
+        ``scale_page`` (int8 pools, [L, 2, Bk, D] bf16): packed WITH the
+        page as one atomic entry per block in both tiers — a page without
+        its scale is garbage, the pair costs one LRU slot, and there is no
+        orphaned-scale state."""
         if self.host_store is not None:
-            self.host_store.put(key, page)
+            self.host_store.put(key, (page, scale_page))
         if self.remote_store is not None:
-            from distributed_gpu_inference_tpu.utils.serialization import (
-                TensorSerializer,
-            )
+            self.remote_store.put(key, _pack_spill(page, scale_page))
 
-            self.remote_store.put(key, TensorSerializer().serialize(page))
+    def _spill_entry_valid(self, page: np.ndarray,
+                           scale: Optional[np.ndarray]) -> bool:
+        """Screen a probed entry BEFORE adopting (or promoting) it: the
+        page dtype must match this engine's pools exactly — a token-keyed
+        store shared across engines of different dtypes must degrade to a
+        miss, never a silent cast — and int8 entries must carry scales."""
+        if self.kv_dtype is not None and page.dtype != self.kv_dtype:
+            return False
+        if self.quantized_kv and scale is None:
+            return False
+        return True
 
-    def _probe_spill(self, key: str) -> Optional[np.ndarray]:
-        """L2 then L3; an L3 hit is promoted to L2 (reference
-        promote-on-hit :447-462)."""
+    def _probe_spill(
+        self, key: str
+    ) -> Optional[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        """Probe the tiers for a spilled block → (page, scale_page | None),
+        or None on miss. An L3 hit is promoted to L2 (reference
+        promote-on-hit :447-462) — but only AFTER validation, so a
+        known-rejected entry never pollutes the bounded L2. A corrupt L3
+        entry likewise degrades to a miss."""
         if self.host_store is not None:
-            page = self.host_store.get(key)
-            if page is not None:
-                self.stats.l2_hits += 1
-                return page
+            entry = self.host_store.get(key)
+            if entry is not None:
+                page, scale = (
+                    entry if isinstance(entry, tuple) else (entry, None)
+                )
+                if self._spill_entry_valid(page, scale):
+                    self.stats.l2_hits += 1
+                    return page, scale
+                return None
         if self.remote_store is not None:
             raw = self.remote_store.get(key)
             if raw is not None:
-                from distributed_gpu_inference_tpu.utils.serialization import (
-                    TensorSerializer,
-                )
-
-                page = TensorSerializer().deserialize(raw)
-                self.stats.l3_hits += 1
-                if self.host_store is not None:
-                    self.host_store.put(key, page)
-                return page
+                try:
+                    page, scale = _unpack_spill(raw)
+                except Exception:
+                    return None     # corrupt entry = miss, not a crash
+                if self._spill_entry_valid(page, scale):
+                    self.stats.l3_hits += 1
+                    if self.host_store is not None:
+                        self.host_store.put(key, (page, scale))
+                    return page, scale
         return None
 
     # -- sequence lifecycle -------------------------------------------------
@@ -423,7 +494,7 @@ class PagedKVCacheManager:
         needed_blocks = max(1, -(-n_tokens // self.block_size))
 
         cached: List[int] = []
-        spill_pages: List[np.ndarray] = []
+        spill_pages: List[Tuple[np.ndarray, Optional[np.ndarray]]] = []
         if self.enable_prefix_cache:
             self.stats.prefix_queries += 1
             self.stats.prefix_total_tokens += n_tokens
@@ -441,10 +512,10 @@ class PagedKVCacheManager:
                     key = compute_prefix_hash(
                         token_ids, (idx + 1) * self.block_size
                     )
-                    page = self._probe_spill(key)
-                    if page is None:
+                    hit = self._probe_spill(key)
+                    if hit is None:
                         break
-                    spill_pages.append(page)
+                    spill_pages.append(hit)
                     idx += 1
         num_cached_tokens = (len(cached) + len(spill_pages)) * self.block_size
         self.stats.prefix_hit_tokens += num_cached_tokens
@@ -465,9 +536,11 @@ class PagedKVCacheManager:
                     meta.incref()
                 meta.touch()
                 blocks.append(bid)
-            for page in spill_pages:
+            for page, scale_page in spill_pages:
                 bid = self._pop_free_block()
                 self.pending.uploads.append((bid, page))
+                if scale_page is not None:
+                    self.pending.scale_uploads.append((bid, scale_page))
                 blocks.append(bid)
             for _ in range(needed_blocks - len(blocks)):
                 blocks.append(self._pop_free_block())

@@ -78,13 +78,11 @@ def test_int8_prefix_cache_cow(params):
     assert len(r.token_ids) == 8
 
 
-def test_int8_fences_and_dtype_mismatch(params):
-    with pytest.raises(ValueError, match="spill"):
-        TPUEngine(CFG, EngineConfig(kv_cache_dtype="int8",
-                                    spill_host_blocks=4, **_kw()),
-                  params=params)
+def test_int8_handoff_dtype_mismatch(params):
     # an int8 handoff must not land in a bf16 engine (raw int8 codes would
-    # be read as real values) — and vice versa
+    # be read as real values) — and vice versa. (The round-4 mesh and
+    # spill fences are gone: tests/test_engine_int8_mesh.py and the int8
+    # cases in tests/test_kv_spill_tiers.py cover those compositions.)
     from distributed_gpu_inference_tpu.runtime.kv_handoff import (
         adopt_kv,
         deserialize_handoff,

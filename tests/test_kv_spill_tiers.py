@@ -116,3 +116,126 @@ def test_restored_chain_is_radix_indexed():
     assert eng.slots[slot].cached_tokens >= 16
     assert eng.manager.get_stats()["l2_hits"] == l2_before
     eng.finish_slot(slot)
+
+
+# -- int8 pools × spill tiers (VERDICT r4 #2: the round-4 fence lifted) -----
+
+
+def test_int8_spill_host_restore_bit_exact():
+    """int8 pages spill WITH their scale pages and restore bit-exact: the
+    restored continuation matches a no-spill int8 engine (same quantized
+    codes + scales, no requantization anywhere)."""
+    ref = TPUEngine(MODEL, _cfg(kv_cache_dtype="int8"), seed=0)
+    expect = ref.generate([_req(PROMPT_A)])[0].token_ids
+
+    eng = TPUEngine(MODEL, _cfg(kv_cache_dtype="int8",
+                                spill_host_blocks=64),
+                    seed=0, params=ref.params)
+    eng.generate([_req(PROMPT_A)])
+    _evict_a_with_b(eng)
+    st = eng.manager.get_stats()
+    assert st["spills"] > 0
+    # one ATOMIC (page, scale) entry per spilled block: full L2 capacity
+    # accounting, no orphaned-scale state possible
+    entries = list(eng.manager.host_store._store.values())
+    assert len(entries) == st["spills"]
+    assert all(isinstance(e, tuple) and e[0].dtype == np.int8
+               and e[1] is not None for e in entries)
+
+    slot = eng.submit(_req(PROMPT_A))
+    assert eng.slots[slot].cached_tokens >= 16      # ≥1 block from L2
+    assert eng.manager.get_stats()["l2_hits"] >= 1
+    while eng.slots[slot] is not None and \
+            eng.slots[slot].finish_reason is None:
+        eng.decode_step()
+    assert eng.finish_slot(slot).token_ids == expect
+
+
+def test_int8_spill_through_remote_l3_restores():
+    remote = RemoteKVStore(ttl_s=3600.0)
+    ref = TPUEngine(MODEL, _cfg(kv_cache_dtype="int8"), seed=0)
+    expect = ref.generate([_req(PROMPT_A)])[0].token_ids
+
+    eng = TPUEngine(
+        MODEL, _cfg(kv_cache_dtype="int8", spill_host_blocks=1,
+                    spill_remote_store=remote),
+        seed=0, params=ref.params,
+    )
+    eng.generate([_req(PROMPT_A)])
+    _evict_a_with_b(eng)
+    assert len(remote._store) > 0
+
+    slot = eng.submit(_req(PROMPT_A))
+    assert eng.slots[slot].cached_tokens >= 16
+    assert eng.manager.get_stats()["l3_hits"] >= 1
+    while eng.slots[slot] is not None and \
+            eng.slots[slot].finish_reason is None:
+        eng.decode_step()
+    assert eng.finish_slot(slot).token_ids == expect
+
+
+def test_int8_spill_restored_chain_is_radix_indexed():
+    """The restored int8 chain re-enters the radix index (VERDICT r4 #2's
+    done criterion): a follow-up request is a pure L1 hit."""
+    eng = TPUEngine(MODEL, _cfg(kv_cache_dtype="int8",
+                                spill_host_blocks=64), seed=0)
+    eng.generate([_req(PROMPT_A)])
+    _evict_a_with_b(eng)
+    eng.generate([_req(PROMPT_A)])                  # restores via L2
+    l2_before = eng.manager.get_stats()["l2_hits"]
+    slot = eng.submit(_req(PROMPT_A))
+    assert eng.slots[slot].cached_tokens >= 16
+    assert eng.manager.get_stats()["l2_hits"] == l2_before
+    eng.finish_slot(slot)
+
+
+def test_int8_corrupt_l3_entry_degrades_to_miss():
+    """A truncated/garbage L3 entry must degrade to a clean recompute —
+    never a crash or a scale-less adopt."""
+    remote = RemoteKVStore(ttl_s=3600.0)
+    ref = TPUEngine(MODEL, _cfg(kv_cache_dtype="int8"), seed=0)
+    expect = ref.generate([_req(PROMPT_A)])[0].token_ids
+
+    eng = TPUEngine(
+        MODEL, _cfg(kv_cache_dtype="int8", spill_host_blocks=1,
+                    spill_remote_store=remote),
+        seed=0, params=ref.params,
+    )
+    eng.generate([_req(PROMPT_A)])
+    _evict_a_with_b(eng)
+    assert len(remote._store) > 0
+    for k, (exp, data) in list(remote._store.items()):
+        remote._store[k] = (exp, data[: len(data) // 3])  # truncate all
+
+    slot = eng.submit(_req(PROMPT_A))
+    assert eng.slots[slot].cached_tokens == 0       # clean miss, recompute
+    while eng.slots[slot] is not None and \
+            eng.slots[slot].finish_reason is None:
+        eng.decode_step()
+    assert eng.finish_slot(slot).token_ids == expect
+
+
+def test_dtype_blind_shared_store_never_cross_pollinates():
+    """A token-keyed L3 shared between an int8 and a bf16 worker must never
+    hand either one the other's pages (int8 codes read as reals, or reals
+    read as codes)."""
+    remote = RemoteKVStore(ttl_s=3600.0)
+    q8 = TPUEngine(
+        MODEL, _cfg(kv_cache_dtype="int8", spill_host_blocks=1,
+                    spill_remote_store=remote), seed=0,
+    )
+    q8.generate([_req(PROMPT_A)])
+    _evict_a_with_b(q8)
+    assert len(remote._store) > 0                   # int8 pages in L3
+
+    ref = TPUEngine(MODEL, _cfg(), seed=0)
+    expect = ref.generate([_req(PROMPT_A)])[0].token_ids
+    fp = TPUEngine(MODEL, _cfg(spill_host_blocks=1,
+                               spill_remote_store=remote),
+                   seed=0, params=ref.params)
+    slot = fp.submit(_req(PROMPT_A))
+    assert fp.slots[slot].cached_tokens == 0        # rejected, not adopted
+    while fp.slots[slot] is not None and \
+            fp.slots[slot].finish_reason is None:
+        fp.decode_step()
+    assert fp.finish_slot(slot).token_ids == expect
