@@ -49,10 +49,19 @@ def main() -> None:
                          "gather materializes [B, M*Bk, Hkv, D] context — "
                          "hundreds of MB at batch 32 x ctx 4k, which can "
                          "wedge/OOM the compile on the tunnel chip)")
+    ap.add_argument("--skip-pallas", action="store_true",
+                    help="skip the Pallas kernel variants (CPU smoke runs: "
+                         "interpret-mode pallas inside the timing fori_loop "
+                         "trips a JAX lowering-cache limitation)")
     ap.add_argument("--int8", action="store_true",
                     help="also measure the int8-KV (per-token scales) "
                          "kernel path")
     args = ap.parse_args()
+    if args.skip_xla and args.skip_pallas:
+        ap.error("--skip-xla and --skip-pallas leave nothing to measure")
+    if args.int8 and args.skip_pallas:
+        ap.error("--int8 measures the Pallas int8 kernel; it cannot be "
+                 "combined with --skip-pallas")
 
     import jax
     import jax.numpy as jnp
@@ -96,17 +105,19 @@ def main() -> None:
     pos = (lens - 1)[:, None]
     q = jax.random.normal(ks[3], (b, 1, nh, d), jnp.bfloat16)
 
-    variants = [
-        ("pallas", partial(paged_attention_pallas, block_size=block),
-         (kp, vp)),
-    ]
+    variants = []
+    if not args.skip_pallas:
+        variants.append(
+            ("pallas", partial(paged_attention_pallas, block_size=block),
+             (kp, vp), ())
+        )
     if not args.skip_xla:
         variants.insert(
             0,
             ("xla", partial(paged_attention_xla, block_size=block),
-             (kp, vp)),
+             (kp, vp), ()),
         )
-    if args.int8:
+    if args.int8 and not args.skip_pallas:
         # int8 pools + per-(page, token) scales (VERDICT r3 #4): HBM sees
         # ~62% of the bf16 bytes per token; the kernel dequantizes in-page
         from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
@@ -117,36 +128,47 @@ def main() -> None:
         vp8, vss = quantize_kv_pool(vp)
         variants.append((
             "pallas_int8",
-            partial(paged_attention_pallas, block_size=block,
-                    k_scale=kss, v_scale=vss),
-            (kp8, vp8),
+            partial(paged_attention_pallas, block_size=block),
+            (kp8, vp8), (kss, vss),
         ))
 
     results = {}
-    for name, att, pools in variants:
+    for name, att, pools, scales in variants:
+        # pools/scales/tables/lens are jit ARGUMENTS, never closure
+        # captures: a captured device array is baked into the computation
+        # as a literal, and through the remote-compile tunnel those
+        # literals ride the compile request body — at batch 32 x ctx 4096
+        # the two pools are ~540 MB and the tunnel rejects the upload with
+        # HTTP 413 (the round-4 "wedge"; smaller shapes merely made
+        # compile minutes-slow)
         @jax.jit
-        def many(q, _a=att, _p=pools):
+        def many(q, kpool, vpool, tables, pos, lens, scales, _a=att):
+            kw = (
+                {"k_scale": scales[0], "v_scale": scales[1]}
+                if scales else {}
+            )
+
             def body(i, o):
                 return _a(q + (o * 1e-9).astype(q.dtype),
-                          _p[0], _p[1], tables, pos, lens)
+                          kpool, vpool, tables, pos, lens, **kw)
             return jax.lax.fori_loop(0, iters, body, q)
 
-        dt = (timed(many, q) - rtt) / iters
+        dt = (timed(many, q, pools[0], pools[1], tables, pos, lens, scales)
+              - rtt) / iters
         results[name] = dt * 1e6
 
     live = int(np.sum(np.asarray(lens)))
-    out = {
-        "metric": "paged_attention_decode_us",
-        "pallas_us": round(results["pallas"], 1),
-    }
+    out = {"metric": "paged_attention_decode_us"}
+    if "pallas" in results:
+        out["pallas_us"] = round(results["pallas"], 1)
     if "xla" in results:
-        out.update(
-            xla_us=round(results["xla"], 1),
-            speedup=round(results["xla"] / results["pallas"], 2),
-        )
+        out["xla_us"] = round(results["xla"], 1)
+        if "pallas" in results:
+            out["speedup"] = round(results["xla"] / results["pallas"], 2)
+    best = results.get("pallas", results.get("xla"))
     out.update(**{
         "live_kv_gb_s": round(
-            (live * hkv * d * 2 * 2) / (results["pallas"] / 1e6) / 1e9, 1
+            (live * hkv * d * 2 * 2) / (best / 1e6) / 1e9, 1
         ),
         "config": {"batch": b, "ctx": ctx, "mixed": args.mixed,
                    "block_size": block, "backend": jax.default_backend()},

@@ -90,6 +90,57 @@ def test_pd_separation_bench(capsys):
         assert res[f"separated_{mode}"]["migration_ms"]["p50"] is not None
 
 
+def test_paged_attention_micro_no_baked_pool_literals(capsys):
+    """Regression for the round-4 batch-32 x ctx-4096 'wedge': the micro
+    bench's jitted loops take pools/scales as ARGUMENTS, so no pool-sized
+    literal is baked into the computation (through the remote-compile
+    tunnel such literals ride the compile request body and got a ~540 MB
+    upload rejected with HTTP 413). CPU smoke runs the XLA variant (the
+    Pallas variants need the chip — interpret-mode pallas inside the
+    timing fori_loop trips a JAX lowering-cache limitation); the kernel
+    variants are driven on-chip by bench.py and the round-5 notes."""
+    from benchmarks.paged_attention_micro import main
+
+    res = _run(main, [
+        "paged_attention_micro", "--batch", "2", "--kv-heads", "2",
+        "--q-heads", "4", "--head-dim", "128", "--ctx", "64",
+        "--iters", "3", "--mixed", "--skip-pallas",
+    ], capsys)
+    assert res["metric"] == "paged_attention_decode_us"
+    assert res["xla_us"] > 0 and res["live_kv_gb_s"] > 0
+
+    # the no-pool-literals property, checked structurally: a pool passed
+    # as an argument appears as a parameter in the lowered HLO; a captured
+    # pool appears as a multi-MB constant. Bench-style loop at a shape big
+    # enough that a baked literal would dominate the HLO text.
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_gpu_inference_tpu.ops.attention import (
+        paged_attention_xla,
+    )
+
+    kp = jnp.ones((129, 2, 16, 32), jnp.bfloat16)     # ~0.5 MB pool
+    tables = jnp.zeros((2, 4), jnp.int32)
+    pos = jnp.zeros((2, 1), jnp.int32)
+    lens = jnp.full((2,), 64, jnp.int32)
+    q = jnp.ones((2, 1, 4, 32), jnp.bfloat16)
+
+    def loop_args(q, kp, vp):
+        def body(i, o):
+            return paged_attention_xla(
+                q + (o * 1e-9).astype(q.dtype), kp, vp, tables, pos, lens
+            )
+        return jax.lax.fori_loop(0, 3, body, q)
+
+    text = jax.jit(loop_args).lower(q, kp, kp).as_text()
+    # a baked [129,2,16,32] bf16 literal would serialize to >100 kB of HLO
+    assert len(text) < 100_000, (
+        f"HLO unexpectedly large ({len(text)} B): pool-sized literal "
+        "baked into the computation?"
+    )
+
+
 def test_spec_params_npz_roundtrip_preserves_bfloat16(tmp_path=None):
     """bfloat16 does not survive a plain np.savez round-trip (loads back as
     void |V2); the spec benchmark's subprocess handoff must restore it."""
