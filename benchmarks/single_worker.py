@@ -47,6 +47,31 @@ def main() -> None:
                     help="batcher round-latency target; must exceed the "
                     "host↔device round-trip or the adaptive horizon "
                     "collapses to 1 step (≈110 ms through a TPU tunnel)")
+    # -- open-loop SLO mode (VERDICT r4 #3: publish a TTFT-SLO frontier) --
+    ap.add_argument("--arrival-rate", default=None,
+                    help="OPEN-loop mode: Poisson arrivals at this req/s "
+                    "(seeded), no concurrency gate — TTFT then includes "
+                    "queue wait, which is what an SLO means. "
+                    "--concurrency still sizes the engine's slot count. "
+                    "Comma-separated rates sweep a frontier on ONE "
+                    "engine (one line per rate; 8B engine init through "
+                    "the tunnel costs minutes, the sweep pays it once)")
+    ap.add_argument("--seed", type=int, default=7, help="arrival-process seed")
+    ap.add_argument("--quantization", default=None,
+                    help="weight quantization (e.g. int8 — the 8B flagship "
+                    "needs it to fit a 16 GB chip)")
+    ap.add_argument("--kv-dtype", default=None, help="kv_cache_dtype")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--subwave", type=int, default=0,
+                    help="admission sub-wave width (engine admission_subwave)")
+    ap.add_argument("--interleave", type=int, default=0,
+                    help="decode steps interleaved between admission "
+                    "sub-waves/chunks (engine admission_interleave_steps)")
+    ap.add_argument("--max-horizon", type=int, default=64,
+                    help="cap the adaptive decode horizon (batcher "
+                    "max_multi_step): an SLO config bounds the longest "
+                    "admission stall to max_horizon x step, trading "
+                    "peak decode throughput for TTFT")
     add_platform_arg(ap)
     args = ap.parse_args()
 
@@ -68,8 +93,13 @@ def main() -> None:
         EngineConfig(
             max_batch_size=args.concurrency,
             max_seq_len=max_seq,
+            block_size=args.block_size,
             prefill_buckets=(args.prompt_len,),
             enable_prefix_cache=not args.no_prefix_cache,
+            quantization=args.quantization,
+            kv_cache_dtype=args.kv_dtype,
+            admission_subwave=args.subwave,
+            admission_interleave_steps=args.interleave,
         ),
     )
     prompts = synth_prompts(
@@ -87,12 +117,28 @@ def main() -> None:
     # pre-warms the prefix cache for a measured prompt nor skews the
     # reported hit rate.
     bcfg = BatcherConfig(default_timeout_s=600.0,
-                         target_step_latency_ms=args.target_step_ms)
+                         target_step_latency_ms=args.target_step_ms,
+                         max_multi_step=args.max_horizon)
     warm_prompt = synth_prompts(
         1, args.prompt_len, eng.model_cfg.vocab_size, seed=987,
         shared_prefix_len=0,
     )[0]
     eng.generate([make_request(warm_prompt, 2)])
+    if args.subwave > 0:
+        # each power-of-2 sub-wave width is its own narrow prefill graph:
+        # _prefill_subwave buckets a chunk of k<=subwave requests to the
+        # next power of 2 CLAMPED to the slot count — warm exactly that
+        # set (e.g. subwave 6 can produce a width-8 graph; concurrency 6
+        # clamps it to width 6)
+        w = 1
+        while True:
+            width = min(w, args.concurrency)
+            eng.generate(
+                [make_request(warm_prompt, 2) for _ in range(width)]
+            )
+            if w >= args.subwave or width == args.concurrency:
+                break
+            w *= 2
     for T in bcfg.horizon_levels:
         # 2 tokens suffice: on-device budgets finish the slot inside the
         # T-step scan, and the T graph still compiles
@@ -106,51 +152,128 @@ def main() -> None:
     eng.manager.stats.prefix_hit_tokens = 0
     eng.manager.stats.prefix_total_tokens = 0
 
-    async def run():
+    async def run(rate):
         batcher = ContinuousBatcher(eng, bcfg)
         batcher.start()
-        sem = asyncio.Semaphore(args.concurrency)
         results = []
 
-        async def one(p):
-            async with sem:
+        if rate:
+            # open loop: the arrival process does not slow down when the
+            # server falls behind — sustained-rate TTFT is only a valid
+            # SLO statement under this regime. Requests are CONSTRUCTED at
+            # their arrival instant so the TTFT clock (engine slot
+            # start_time = request.arrival_time) includes queue wait.
+            import numpy as np
+
+            gaps = np.random.default_rng(args.seed).exponential(
+                1.0 / rate, len(prompts)
+            )
+            arrivals = np.cumsum(gaps)
+
+            async def one(p, at):
+                await asyncio.sleep(float(at))
                 t0 = time.perf_counter()
                 resp = await batcher.submit(req(p))
                 return resp, (time.perf_counter() - t0) * 1000.0
 
-        with Timer() as t:
-            results = await asyncio.gather(*(one(p) for p in prompts))
+            with Timer() as t:
+                results = await asyncio.gather(
+                    *(one(p, a) for p, a in zip(prompts, arrivals))
+                )
+            stats_snap = batcher.get_stats()
+            await batcher.stop()
+            return results, t.elapsed, float(arrivals[-1]), stats_snap
+        else:
+            sem = asyncio.Semaphore(args.concurrency)
+
+            async def one(p):
+                async with sem:
+                    t0 = time.perf_counter()
+                    resp = await batcher.submit(req(p))
+                    return resp, (time.perf_counter() - t0) * 1000.0
+
+            with Timer() as t:
+                results = await asyncio.gather(*(one(p) for p in prompts))
         await batcher.stop()
-        return results, t.elapsed
+        return results, t.elapsed, 0.0, batcher.get_stats()
 
-    results, elapsed = asyncio.run(run())
-    resps = [r for r, _ in results]
-    e2es = [ms for _, ms in results]
-    ok = [r for r in resps if r.error is None]
-    decoded = sum(r.completion_tokens for r in ok)
-    ttfts = [r.ttft_ms for r in ok if r.ttft_ms is not None]
-    stats = eng.get_stats()
+    rates = (
+        [float(r) for r in str(args.arrival_rate).split(",")]
+        if args.arrival_rate else [None]
+    )
+    for i, rate in enumerate(rates):
+        if i > 0:
+            # each rate must measure the same COLD state the first did:
+            # drop blocks the previous rate's requests left in the prefix
+            # cache (identical prompts would otherwise prefill as cache
+            # hits from rate 2 on) and re-zero the per-rate counters
+            eng.manager.clear_cached()
+            eng.manager.stats.prefix_queries = 0
+            eng.manager.stats.prefix_hit_tokens = 0
+            eng.manager.stats.prefix_total_tokens = 0
+        results, elapsed, arrival_span, last_batcher_stats = \
+            asyncio.run(run(rate))
+        resps = [r for r, _ in results]
+        e2es = [ms for _, ms in results]
+        ok = [r for r in resps if r.error is None]
+        decoded = sum(r.completion_tokens for r in ok)
+        ttfts = [r.ttft_ms for r in ok if r.ttft_ms is not None]
+        stats = eng.get_stats()
 
-    emit({
-        "benchmark": "single_worker",
-        "metric": "decode_tokens_per_s",
-        "value": round(decoded / elapsed, 2),
-        "unit": "tokens/s",
-        "model": model,
-        "backend": backend,
-        "requests": args.requests,
-        "ok": len(ok),
-        "concurrency": args.concurrency,
-        "prompt_len": args.prompt_len,
-        "max_tokens": args.max_tokens,
-        "elapsed_s": round(elapsed, 3),
-        "requests_per_s": round(len(ok) / elapsed, 3),
-        "ttft_ms": percentiles(ttfts),
-        "e2e_ms": percentiles(e2es),
-        "prefix_hit_rate": round(
-            stats["kv_cache"].get("prefix_hit_rate", 0.0), 4
-        ),
-    })
+        out = {
+            "benchmark": "single_worker",
+            "metric": "decode_tokens_per_s",
+            "value": round(decoded / elapsed, 2),
+            "unit": "tokens/s",
+            "model": model,
+            "backend": backend,
+            "requests": args.requests,
+            "ok": len(ok),
+            "concurrency": args.concurrency,
+            "prompt_len": args.prompt_len,
+            "max_tokens": args.max_tokens,
+            "elapsed_s": round(elapsed, 3),
+            "requests_per_s": round(len(ok) / elapsed, 3),
+            "ttft_ms": percentiles(ttfts),
+            "e2e_ms": percentiles(e2es),
+            "prefix_hit_rate": round(
+                stats["kv_cache"].get("prefix_hit_rate", 0.0), 4
+            ),
+        }
+        if rate:
+            tpots = [
+                (ms - r.ttft_ms) / (r.completion_tokens - 1)
+                for r, ms in results
+                if r.error is None and r.ttft_ms is not None
+                and r.completion_tokens > 1
+            ]
+            b = last_batcher_stats
+            out.update({
+                "mode": "open_loop",
+                "arrival_rate_rps": rate,
+                "batcher": {
+                    "decode_rounds": b.get("decode_rounds"),
+                    "avg_occupancy": round(b.get("avg_occupancy", 0.0), 2),
+                    "horizon": b.get("horizon"),
+                    "step_latency_ema_ms": round(
+                        b.get("step_latency_ema_ms", 0.0), 1
+                    ),
+                    "chunked_admissions": b.get("chunked_admissions"),
+                    "batched_waves": b.get("batched_waves"),
+                },
+                # sustained = the server kept up with the offered load:
+                # the run finishes within ~one service time of the last
+                # arrival, i.e. the queue was not growing without bound
+                "offered_span_s": round(float(arrival_span), 3),
+                "drain_s": round(elapsed - float(arrival_span), 3),
+                "tpot_ms": percentiles(tpots),
+                "quantization": args.quantization,
+                "kv_cache_dtype": args.kv_dtype,
+                "interleave": args.interleave,
+                "subwave": args.subwave,
+                "target_step_ms": args.target_step_ms,
+            })
+        emit(out)
 
 
 if __name__ == "__main__":

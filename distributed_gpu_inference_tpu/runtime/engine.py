@@ -159,13 +159,21 @@ class _Slot:
     prompt_len: int
     generated: List[int] = field(default_factory=list)
     cached_tokens: int = 0
-    start_time: float = field(default_factory=time.time)
+    # TTFT clock origin: the REQUEST's arrival time, not slot-bind time —
+    # queue wait is part of time-to-first-token or an SLO claim is a lie
+    # (reference single_worker.py:38-73 measures from submission too).
+    # Migration paths override with the donor's original start_time.
+    start_time: Optional[float] = None
     first_token_time: Optional[float] = None
     finish_reason: Optional[str] = None
     # True while a chunk-interleaved admission is mid-prefill: the slot's KV
     # is incomplete and its last_token is garbage, so decode rounds MUST
     # skip it until the final chunk samples the first token
     prefilling: bool = False
+
+    def __post_init__(self) -> None:
+        if self.start_time is None:
+            self.start_time = self.request.arrival_time
 
 
 @dataclass

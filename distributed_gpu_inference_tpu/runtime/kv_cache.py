@@ -397,9 +397,29 @@ class PagedKVCacheManager:
             "(all interior), all others pinned by active sequences"
         )
 
+    def clear_cached(self, spill: bool = False) -> int:
+        """Drop EVERY reclaimable cached block back to the free list →
+        count dropped. For bench sweeps (each measured configuration must
+        start cold) and admin cache flushes. ``spill`` False suppresses
+        spill-on-evict so a flush doesn't flood the spill tiers with
+        pages nobody asked to keep."""
+        n = 0
+        saved = self.spill_on_evict
+        self.spill_on_evict = spill and saved
+        try:
+            # leaf-at-a-time: parents become leaves as children go
+            while self.cached_lru:
+                self.free_list.append(self._evict_one())
+                n += 1
+        finally:
+            self.spill_on_evict = saved
+        return n
+
     def _evict_block(self, bid: int) -> None:
         meta = self.metas.pop(bid, None)
-        self.cached_lru.pop(bid, None)
+        if self.cached_lru.pop(bid, False) is None:
+            # was present (values are literal None): keep the gauge honest
+            self.stats.cached_blocks -= 1
         if self.spill_on_evict and meta is not None and meta.prefix_hash \
                 and (self.host_store is not None
                      or self.remote_store is not None):

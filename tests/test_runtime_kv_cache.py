@@ -242,6 +242,33 @@ class TestTiers:
         assert store2.purge_expired() == 0
 
 
+class TestClearCached:
+    def test_clear_cached_drops_reclaimable_to_free(self):
+        m = PagedKVCacheManager(num_blocks=8, block_size=BS)
+        m.allocate_sequence("a", toks(40))
+        m.allocate_sequence("b", toks(40, 500))
+        m.free_sequence("a")                       # cached (reclaimable)
+        free_before = m.num_free
+        n = m.clear_cached()
+        assert n == 2                              # a's two FULL blocks
+        assert m.num_free == free_before + n
+        assert m.stats.cached_blocks == 0
+        # a's prompt no longer hits the cache; b untouched
+        blocks, cached = m.allocate_sequence("a2", toks(40))
+        assert cached == 0
+        assert "b" in m.seq_blocks
+
+    def test_clear_cached_default_does_not_spill(self):
+        host = HostKVStore(max_blocks=16)
+        m = PagedKVCacheManager(num_blocks=8, block_size=BS,
+                                host_store=host, spill_on_evict=True)
+        m.allocate_sequence("a", toks(40))
+        m.free_sequence("a")
+        m.clear_cached()
+        assert len(m.pending.downloads) == 0       # no spill traffic
+        assert m.spill_on_evict is True            # flag restored
+
+
 class TestRadix:
     def test_match_insert(self):
         r = RadixPrefixIndex(BS)
