@@ -191,6 +191,38 @@ def train_toy_lm(cfg, key, steps: int = 600, batch: int = 16,
     return jax.tree.map(lambda a: a.astype(dtype), params), sample_stream
 
 
+async def open_loop_drive(batcher, prompts, max_tokens: int, rate: float,
+                          seed: int = 11):
+    """Drive an OPEN-loop Poisson workload through a started batcher:
+    arrivals do not slow down when the server falls behind (the only
+    regime where sustained-rate TTFT is a valid SLO statement), and each
+    request is CONSTRUCTED at its arrival instant so the engine's TTFT
+    clock (slot start_time = request.arrival_time) includes queue wait.
+
+    → (results [(response, e2e_ms)], elapsed_s, last_arrival_s). The ONE
+    arrival-process implementation for every serving harness
+    (single_worker + speculative) so TTFT semantics cannot drift."""
+    import asyncio
+    import time
+
+    import numpy as np
+
+    gaps = np.random.default_rng(seed).exponential(1.0 / rate, len(prompts))
+    arrivals = np.cumsum(gaps)
+
+    async def one(p, at):
+        await asyncio.sleep(float(at))
+        t0 = time.perf_counter()
+        resp = await batcher.submit(make_request(p, max_tokens))
+        return resp, (time.perf_counter() - t0) * 1000.0
+
+    t0 = time.perf_counter()
+    results = await asyncio.gather(
+        *(one(p, a) for p, a in zip(prompts, arrivals))
+    )
+    return results, time.perf_counter() - t0, float(arrivals[-1])
+
+
 def emit(result: Dict[str, Any]) -> None:
     print(json.dumps(result))
 

@@ -158,31 +158,16 @@ def main() -> None:
         results = []
 
         if rate:
-            # open loop: the arrival process does not slow down when the
-            # server falls behind — sustained-rate TTFT is only a valid
-            # SLO statement under this regime. Requests are CONSTRUCTED at
-            # their arrival instant so the TTFT clock (engine slot
-            # start_time = request.arrival_time) includes queue wait.
-            import numpy as np
+            # open loop via the shared driver (benchmarks/common.py
+            # open_loop_drive — the one arrival-process implementation)
+            from benchmarks.common import open_loop_drive
 
-            gaps = np.random.default_rng(args.seed).exponential(
-                1.0 / rate, len(prompts)
+            results, elapsed, span = await open_loop_drive(
+                batcher, prompts, args.max_tokens, rate, seed=args.seed
             )
-            arrivals = np.cumsum(gaps)
-
-            async def one(p, at):
-                await asyncio.sleep(float(at))
-                t0 = time.perf_counter()
-                resp = await batcher.submit(req(p))
-                return resp, (time.perf_counter() - t0) * 1000.0
-
-            with Timer() as t:
-                results = await asyncio.gather(
-                    *(one(p, a) for p, a in zip(prompts, arrivals))
-                )
             stats_snap = batcher.get_stats()
             await batcher.stop()
-            return results, t.elapsed, float(arrivals[-1]), stats_snap
+            return results, elapsed, span, stats_snap
         else:
             sem = asyncio.Semaphore(args.concurrency)
 

@@ -59,11 +59,18 @@ class BatcherConfig:
     # per token on a tunneled TPU)
     busy_multi_step: int = 4
     # adaptive speculation (VERDICT r3 #7): when a SpeculativeDecoder is
-    # attached and the ENTIRE waiting load is <= this many greedy requests
-    # (and the paged engine is idle), they decode through the spec tree —
-    # the low-depth regime where drafting wins; deeper load decodes vanilla
-    # (batched weight streaming already amortizes better there). 0 = never.
+    # attached and the ENTIRE waiting load is <= this many greedy requests,
+    # they decode through the spec tree — the low-depth regime where
+    # drafting wins; deeper load decodes vanilla (batched weight streaming
+    # already amortizes better there). 0 = never.
     spec_max_batch: int = 2
+    # a wave may START while up to this many paged slots are still active
+    # (spec dispatches and paged rounds interleave in the serving loop, so
+    # a busy slot only bounds, not blocks, the other path). 0 = round-4
+    # behavior: require a fully idle engine — which made routing STICKY at
+    # steady low rates (the first paged request kept the engine active
+    # when each next one arrived, so no wave ever started again).
+    spec_max_active: int = 2
 
     @property
     def horizon_levels(self) -> Tuple[int, ...]:
@@ -160,8 +167,9 @@ class ContinuousBatcher:
     async def _maybe_start_spec_wave(self) -> bool:
         """Route the ENTIRE waiting queue through the spec decoder when it
         is a low-depth all-greedy moment: queue depth <= spec_max_batch,
-        every request eligible, paged engine idle, no wave in flight.
-        Mixed/deep load never waits on drafting."""
+        every request eligible, at most spec_max_active paged slots still
+        decoding (waves and paged rounds interleave in the serving loop),
+        no wave in flight. Mixed/deep load never waits on drafting."""
         spec_cap = (
             min(self.cfg.spec_max_batch, self.spec.max_batch_size)
             if self.spec is not None else 0
@@ -173,7 +181,7 @@ class ContinuousBatcher:
             or self._chunked is not None
             or not self._heap
             or len(self._heap) > spec_cap
-            or self.engine.num_active > 0
+            or self.engine.num_active > self.cfg.spec_max_active
         ):
             return False
         items = [it for it in list(self._heap) if not it.future.cancelled()]

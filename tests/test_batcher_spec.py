@@ -182,3 +182,75 @@ def test_mid_wave_arrivals_decode_paged_concurrently(stack):
     assert [r.token_ids for r in done_mid] == want_mid
     assert stats["spec_waves"] == 1
     assert stats["batched_waves"] >= 1, "mid-wave arrivals must go paged"
+
+
+def test_spec_max_active_unsticks_routing(stack):
+    """Round-5 routing fix (VERDICT r4 #4): with spec_max_active > 0 a
+    greedy single arriving while a paged slot is STILL DECODING routes to
+    a spec wave — the round-4 idle-engine requirement made routing sticky
+    at steady rates (the first paged request kept the engine active
+    whenever the next arrived, so no wave ever started again)."""
+    eng, spec, oracle = stack
+    want_long = oracle.generate([_req(50, n=48, spec_opt=False)])[0].token_ids
+    want_next = oracle.generate([_req(51)])[0].token_ids
+
+    async def main():
+        b = ContinuousBatcher(
+            eng, BatcherConfig(max_wait_ms=5.0, spec_max_batch=2,
+                               spec_max_active=2),
+            spec=spec,
+        )
+        b.start()
+        # a long opted-out request occupies a paged slot for many rounds
+        t_long = asyncio.create_task(b.submit(_req(50, n=48, spec_opt=False)))
+        for _ in range(300):
+            if eng.num_active > 0:
+                break
+            await asyncio.sleep(0.005)
+        assert eng.num_active > 0, "paged request never became active"
+        # greedy single arrives while the engine is BUSY: must still spec
+        got_next = await b.submit(_req(51))
+        got_long = await t_long
+        stats = b.get_stats()
+        await b.stop()
+        return got_long, got_next, stats
+
+    got_long, got_next, stats = _run(main())
+    assert got_long.error is None and got_long.token_ids == want_long
+    assert got_next.error is None and got_next.token_ids == want_next
+    assert stats["spec_waves"] >= 1, (
+        "wave must start despite an active paged slot"
+    )
+    assert stats["spec_completed"] >= 1
+
+
+def test_spec_max_active_zero_keeps_round4_veto(stack):
+    """spec_max_active=0 restores the idle-engine requirement: a greedy
+    single arriving while a paged slot decodes stays on the paged path."""
+    eng, spec, oracle = stack
+    want_next = oracle.generate([_req(61)])[0].token_ids
+
+    async def main():
+        b = ContinuousBatcher(
+            eng, BatcherConfig(max_wait_ms=5.0, spec_max_batch=2,
+                               spec_max_active=0),
+            spec=spec,
+        )
+        b.start()
+        t_long = asyncio.create_task(b.submit(_req(60, n=48, spec_opt=False)))
+        for _ in range(300):
+            if eng.num_active > 0:
+                break
+            await asyncio.sleep(0.005)
+        waves_before = b.stats["spec_waves"]
+        got_next = await b.submit(_req(61))
+        await t_long
+        waves_after = b.stats["spec_waves"]
+        await b.stop()
+        return got_next, waves_before, waves_after
+
+    got_next, waves_before, waves_after = _run(main())
+    assert got_next.error is None and got_next.token_ids == want_next
+    assert waves_after == waves_before, (
+        "spec_max_active=0 must veto waves while the engine is active"
+    )
