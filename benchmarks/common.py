@@ -191,6 +191,48 @@ def train_toy_lm(cfg, key, steps: int = 600, batch: int = 16,
     return jax.tree.map(lambda a: a.astype(dtype), params), sample_stream
 
 
+def measure_slice(eng, cfg, batch: int, prompt_len: int,
+                  decode_tokens: int):
+    """THE measured-input slice probe shared by the projection artifacts
+    (pipeline_70b, mixtral_ep): warm the engine, then measure prefill wall
+    time and the decode_calls-delta-amortized per-step decode time for one
+    layer slice. Keeping it in one place keeps the two artifacts'
+    numbers method-comparable. → (prefill_s, step_s)."""
+    import time
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+
+    def reqs():
+        return [
+            make_request(
+                rng.integers(1, cfg.vocab_size, prompt_len).tolist(),
+                decode_tokens,
+            )
+            for _ in range(batch)
+        ]
+
+    warm = reqs()
+    for r in warm:
+        r.sampling.max_new_tokens = 8
+    eng.generate(warm, use_multi_step=True)
+
+    t0 = time.perf_counter()
+    eng.submit_batch(reqs())
+    t_prefill = time.perf_counter() - t0
+    calls0 = eng.stats["decode_calls"]
+    t1 = time.perf_counter()
+    while any(s is not None and s.finish_reason is None for s in eng.slots):
+        eng.decode_multi()
+    t_decode = time.perf_counter() - t1
+    steps = eng.stats["decode_calls"] - calls0
+    for i, s in enumerate(list(eng.slots)):
+        if s is not None:
+            eng.finish_slot(i, cache=False)
+    return t_prefill, t_decode / max(steps, 1)
+
+
 async def open_loop_drive(batcher, prompts, max_tokens: int, rate: float,
                           seed: int = 11):
     """Drive an OPEN-loop Poisson workload through a started batcher:

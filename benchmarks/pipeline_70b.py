@@ -39,13 +39,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-import numpy as np
-
-from benchmarks.common import add_platform_arg, emit, make_request
+from benchmarks.common import add_platform_arg, emit, measure_slice
 
 V5E_HBM_GB = 16.0
 ICI_GBPS = 45.0          # v5e per-link ICI, one direction (public spec)
-
 
 def _mk_slice_engine(cfg70, n_layers, args, quant):
     from distributed_gpu_inference_tpu.models.loader import (
@@ -81,40 +78,6 @@ def _mk_slice_engine(cfg70, n_layers, args, quant):
         params=params,
     ), cfg
 
-
-def _measure_slice(eng, cfg, args):
-    """Prefill wall time + amortized decode step time for one slice."""
-    rng = np.random.default_rng(0)
-
-    def reqs():
-        return [
-            make_request(
-                rng.integers(1, cfg.vocab_size, args.prompt_len).tolist(),
-                args.decode_tokens,
-            )
-            for _ in range(args.batch)
-        ]
-
-    warm = reqs()
-    for r in warm:
-        r.sampling.max_new_tokens = 8
-    eng.generate(warm, use_multi_step=True)
-
-    t0 = time.perf_counter()
-    eng.submit_batch(reqs())
-    t_prefill = time.perf_counter() - t0
-    calls0 = eng.stats["decode_calls"]
-    t1 = time.perf_counter()
-    while any(s is not None and s.finish_reason is None for s in eng.slots):
-        eng.decode_multi()
-    t_decode = time.perf_counter() - t1
-    steps = eng.stats["decode_calls"] - calls0
-    for i, s in enumerate(list(eng.slots)):
-        if s is not None:
-            eng.finish_slot(i, cache=False)
-    return t_prefill, t_decode / max(steps, 1)
-
-
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", default="4,8",
@@ -142,7 +105,9 @@ def main() -> None:
     measured = {}
     for n in (l_lo, l_hi):
         eng, cfg = _mk_slice_engine(cfg70, n, args, args.quantization)
-        t_prefill, t_step = _measure_slice(eng, cfg, args)
+        t_prefill, t_step = measure_slice(
+            eng, cfg, args.batch, args.prompt_len, args.decode_tokens
+        )
         measured[n] = {"prefill_s": round(t_prefill, 3),
                        "decode_step_ms": round(t_step * 1e3, 2)}
         del eng
@@ -237,7 +202,6 @@ def main() -> None:
                                "mesh, real ppermute microbatch schedule at "
                                "70B layer width)",
     })
-
 
 if __name__ == "__main__":
     main()

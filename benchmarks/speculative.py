@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import argparse
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
@@ -473,6 +472,12 @@ def main() -> None:
             ContinuousBatcher,
         )
 
+        # pin tree adaptation for the measurement: the scan cache is keyed
+        # by (widths, rounds), so a mid-serving depth change would
+        # cold-compile an unwarmed scan graph (~a minute through the
+        # tunnel) inside someone's TTFT — the warmup ladder below covers
+        # exactly the pinned widths
+        spec.spec_cfg.adaptive = False
         n = args.serving_requests
         srv_prompts = [
             [int(t) for t in row]
