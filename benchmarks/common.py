@@ -191,6 +191,12 @@ def train_toy_lm(cfg, key, steps: int = 600, batch: int = 16,
     return jax.tree.map(lambda a: a.astype(dtype), params), sample_stream
 
 
+# hardware constants the projection artifacts (pipeline_70b, mixtral_ep)
+# divide by — shared so the two projections can never model different chips
+V5E_HBM_GB = 16.0
+ICI_GBPS = 45.0          # v5e per-link ICI, one direction (public spec)
+
+
 def measure_slice(eng, cfg, batch: int, prompt_len: int,
                   decode_tokens: int):
     """THE measured-input slice probe shared by the projection artifacts
@@ -198,10 +204,6 @@ def measure_slice(eng, cfg, batch: int, prompt_len: int,
     time and the decode_calls-delta-amortized per-step decode time for one
     layer slice. Keeping it in one place keeps the two artifacts'
     numbers method-comparable. → (prefill_s, step_s)."""
-    import time
-
-    import numpy as np
-
     rng = np.random.default_rng(0)
 
     def reqs():
@@ -245,9 +247,6 @@ async def open_loop_drive(batcher, prompts, max_tokens: int, rate: float,
     arrival-process implementation for every serving harness
     (single_worker + speculative) so TTFT semantics cannot drift."""
     import asyncio
-    import time
-
-    import numpy as np
 
     gaps = np.random.default_rng(seed).exponential(1.0 / rate, len(prompts))
     arrivals = np.cumsum(gaps)

@@ -39,10 +39,13 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-from benchmarks.common import add_platform_arg, emit, measure_slice
-
-V5E_HBM_GB = 16.0
-ICI_GBPS = 45.0          # v5e per-link ICI, one direction (public spec)
+from benchmarks.common import (
+    ICI_GBPS,
+    V5E_HBM_GB,
+    add_platform_arg,
+    emit,
+    measure_slice,
+)
 
 def _mk_slice_engine(cfg70, n_layers, args, quant):
     from distributed_gpu_inference_tpu.models.loader import (

@@ -20,10 +20,12 @@ def fan_in_init(key: jax.Array, shape: Tuple[int, ...], fan_in: int,
             ).astype(dtype)
 
 
-def layer_norm(v: jax.Array, w: jax.Array, eps: float = 1e-6) -> jax.Array:
+def layer_norm(v: jax.Array, w: jax.Array, b: Optional[jax.Array] = None,
+               eps: float = 1e-6) -> jax.Array:
     mean = v.mean(-1, keepdims=True)
     var = v.var(-1, keepdims=True)
-    return (v - mean) * lax.rsqrt(var + eps) * w
+    out = (v - mean) * lax.rsqrt(var + eps) * w
+    return out + b if b is not None else out
 
 
 def init_encoder_layers(key: jax.Array, num_layers: int, hidden: int,
@@ -42,12 +44,16 @@ def init_encoder_layers(key: jax.Array, num_layers: int, hidden: int,
     }
 
 
-def mha(x: jax.Array, wqkv: jax.Array, wo: jax.Array,
-        num_heads: int) -> jax.Array:
+def mha(x: jax.Array, wqkv: jax.Array, wo: jax.Array, num_heads: int,
+        bqkv: Optional[jax.Array] = None,
+        bo: Optional[jax.Array] = None) -> jax.Array:
     """Bidirectional multi-head self-attention over [B, N, H]."""
     b, n, h = x.shape
     hd = h // num_heads
-    q, k, v = jnp.split(x @ wqkv, 3, axis=-1)
+    qkv = x @ wqkv
+    if bqkv is not None:
+        qkv = qkv + bqkv
+    q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(z):
         return z.reshape(b, n, num_heads, hd).transpose(0, 2, 1, 3)
@@ -55,15 +61,28 @@ def mha(x: jax.Array, wqkv: jax.Array, wo: jax.Array,
     attn = jax.nn.softmax(
         (heads(q) @ heads(k).transpose(0, 1, 3, 2)) / math.sqrt(hd), -1
     )
-    return (attn @ heads(v)).transpose(0, 2, 1, 3).reshape(b, n, h) @ wo
+    out = (attn @ heads(v)).transpose(0, 2, 1, 3).reshape(b, n, h) @ wo
+    return out + bo if bo is not None else out
 
 
 def encoder_block(x: jax.Array, lp: Dict[str, jax.Array],
                   num_heads: int) -> jax.Array:
-    """Pre-norm transformer encoder block (attention + GELU MLP)."""
-    x = x + mha(layer_norm(x, lp["norm1"]), lp["wqkv"], lp["wo"], num_heads)
-    y = layer_norm(x, lp["norm2"])
-    return x + jax.nn.gelu(y @ lp["w1"]) @ lp["w2"]
+    """Pre-norm transformer encoder block (attention + GELU MLP).
+
+    Bias keys (``bqkv``/``bo``/``b1``/``b2``/``norm1_b``/``norm2_b``) are
+    OPTIONAL: first-party inits are bias-free (round-1 design), while
+    imported HF ViT-class checkpoints carry all of them
+    (``models/loader.py load_hf_vit``) — the pytree's key set is static
+    per jit trace, so the branch costs nothing."""
+    x = x + mha(
+        layer_norm(x, lp["norm1"], lp.get("norm1_b")),
+        lp["wqkv"], lp["wo"], num_heads,
+        bqkv=lp.get("bqkv"), bo=lp.get("bo"),
+    )
+    y = layer_norm(x, lp["norm2"], lp.get("norm2_b"))
+    y = jax.nn.gelu(y @ lp["w1"] + (lp["b1"] if "b1" in lp else 0.0))
+    y = y @ lp["w2"] + (lp["b2"] if "b2" in lp else 0.0)
+    return x + y
 
 
 def run_encoder(x: jax.Array, layers: Dict[str, jax.Array],

@@ -288,6 +288,175 @@ def init_quantized_streamed(
 
 
 # ---------------------------------------------------------------------------
+# HF ViT-class checkpoints → vit.Params (VERDICT r4 #8: one non-Llama
+# family with a real-checkpoint import path)
+# ---------------------------------------------------------------------------
+
+# HF ViT encoder-layer name → (our key, needs_transpose). q/k/v weights
+# fuse into our wqkv separately below.
+_HF_VIT_LAYER_MAP = {
+    "layernorm_before.weight": ("norm1", False),
+    "layernorm_before.bias": ("norm1_b", False),
+    "attention.output.dense.weight": ("wo", True),
+    "attention.output.dense.bias": ("bo", False),
+    "layernorm_after.weight": ("norm2", False),
+    "layernorm_after.bias": ("norm2_b", False),
+    "intermediate.dense.weight": ("w1", True),
+    "intermediate.dense.bias": ("b1", False),
+    "output.dense.weight": ("w2", True),
+    "output.dense.bias": ("b2", False),
+}
+_VIT_LAYER_RE = re.compile(r"^vit\.encoder\.layer\.(\d+)\.(.+)$")
+_VIT_QKV_RE = re.compile(
+    r"^attention\.attention\.(query|key|value)\.(weight|bias)$"
+)
+
+
+def load_hf_vit(model_dir: str | Path, cfg, dtype: Optional[Any] = None,
+                head_seed: int = 0) -> Dict[str, Any]:
+    """Load an HF ViT-class safetensors checkpoint (google/vit-* layout)
+    into the :mod:`models.vit` params pytree.
+
+    Faithful for everything the architectures share — both are PRE-norm
+    encoders, so patch projection (the conv kernel reshaped to our matmul
+    layout), position embeddings, every encoder layer incl. all biases,
+    and the final layernorm import exactly. What does NOT come from the
+    checkpoint, by design: the CLS token (our model pools through learned
+    perceiver queries instead — its position-embedding slot is dropped)
+    and the ``query_emb``/``out_proj`` resampler head, which is
+    fresh-initialized from ``head_seed`` — the LLaVA-style projector that
+    is always trained against the paired decoder (reference bar:
+    /root/reference/worker/engines/vision.py:57-78 serves a pretrained
+    VLM whose projector shipped with the checkpoint; ours is the part a
+    deployment fine-tunes).
+    """
+    import jax
+
+    from safetensors import safe_open
+
+    from distributed_gpu_inference_tpu.models.encoder_common import (
+        fan_in_init,
+    )
+
+    model_dir = Path(model_dir)
+    dtype = jnp.dtype(dtype or "float32")
+    L, h = cfg.num_layers, cfg.hidden_size
+    files = sorted(model_dir.glob("*.safetensors"))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {model_dir}")
+
+    layers: Dict[str, np.ndarray] = {}
+    qkv_w = np.zeros((L, 3, h, h), np.float32)
+    qkv_b = np.zeros((L, 3, h), np.float32)
+    params: Dict[str, Any] = {}
+    _QKV_IDX = {"query": 0, "key": 1, "value": 2}
+    # every (key, layer) slot must be FILLED from the checkpoint: a missing
+    # shard would otherwise leave zero placeholders (zero norms = silent
+    # near-no-op blocks) — same contract as the Llama path's _validate
+    filled: set = set()
+
+    def _slot(our_key: str, shape: Tuple[int, ...]) -> np.ndarray:
+        if our_key not in layers:
+            layers[our_key] = np.zeros((L, *shape), dtype=dtype)
+        return layers[our_key]
+
+    for f in files:
+        with safe_open(str(f), framework="np") as st:
+            for name in st.keys():
+                m = _VIT_LAYER_RE.match(name)
+                if m:
+                    li = int(m.group(1))
+                    if li >= L:
+                        raise ValueError(
+                            f"checkpoint layer {li} exceeds config "
+                            f"num_layers={L}"
+                        )
+                    sub = m.group(2)
+                    qm = _VIT_QKV_RE.match(sub)
+                    if qm:
+                        idx = _QKV_IDX[qm.group(1)]
+                        w = st.get_tensor(name)
+                        if qm.group(2) == "weight":
+                            qkv_w[li, idx] = w.T    # HF stores [out, in]
+                        else:
+                            qkv_b[li, idx] = w
+                        filled.add((f"{qm.group(1)}.{qm.group(2)}", li))
+                        continue
+                    if sub not in _HF_VIT_LAYER_MAP:
+                        continue
+                    our_key, transpose = _HF_VIT_LAYER_MAP[sub]
+                    w = st.get_tensor(name)
+                    if transpose:
+                        w = w.T
+                    _slot(our_key, w.shape)[li] = w.astype(dtype)
+                    filled.add((our_key, li))
+                elif name == ("vit.embeddings.patch_embeddings."
+                              "projection.weight"):
+                    # conv kernel [H, C, P, P] → matmul over patchify's
+                    # (row, col, channel) flattening → [P*P*C, H]
+                    w = st.get_tensor(name).transpose(2, 3, 1, 0)
+                    params["patch_proj"] = jnp.asarray(
+                        w.reshape(-1, w.shape[-1]), dtype
+                    )
+                elif name == ("vit.embeddings.patch_embeddings."
+                              "projection.bias"):
+                    params["patch_bias"] = jnp.asarray(
+                        st.get_tensor(name), dtype
+                    )
+                elif name == "vit.embeddings.position_embeddings":
+                    # [1, 1+N, H]: slot 0 is the CLS position — dropped
+                    # (we pool through perceiver queries, not CLS)
+                    params["pos_emb"] = jnp.asarray(
+                        st.get_tensor(name)[0, 1:], dtype
+                    )
+                elif name == "vit.layernorm.weight":
+                    params["out_norm"] = jnp.asarray(
+                        st.get_tensor(name), dtype
+                    )
+                elif name == "vit.layernorm.bias":
+                    params["out_norm_b"] = jnp.asarray(
+                        st.get_tensor(name), dtype
+                    )
+
+    # wqkv columns order (q | k | v) to match the encoder's split:
+    # [L, 3, H_in, H_out] → [L, H_in, 3, H_out] → [L, H, 3H]
+    layers["wqkv"] = qkv_w.transpose(0, 2, 1, 3).reshape(L, h, 3 * h)
+    layers["bqkv"] = qkv_b.reshape(L, 3 * h)
+    params["layers"] = {
+        k: jnp.asarray(v, dtype) for k, v in layers.items()
+    }
+
+    missing = {"patch_proj", "pos_emb", "out_norm"} - set(params)
+    if missing:
+        raise ValueError(f"checkpoint is missing ViT tensors: {missing}")
+    expected_keys = (
+        {v[0] for v in _HF_VIT_LAYER_MAP.values()}
+        | {f"{q}.{t}" for q in _QKV_IDX for t in ("weight", "bias")}
+    )
+    gaps = sorted(
+        (k, li) for k in expected_keys for li in range(L)
+        if (k, li) not in filled
+    )
+    if gaps:
+        raise ValueError(
+            f"checkpoint left {len(gaps)} encoder tensors unfilled "
+            f"(missing shard / shallower model?): first few {gaps[:4]}"
+        )
+    if params["pos_emb"].shape[0] != cfg.num_patches:
+        raise ValueError(
+            f"position embeddings cover {params['pos_emb'].shape[0]} "
+            f"patches, config expects {cfg.num_patches} "
+            f"(image {cfg.image_size} / patch {cfg.patch_size})"
+        )
+
+    # resampler head: fresh init (trained against the paired decoder)
+    ks = jax.random.split(jax.random.PRNGKey(head_seed), 2)
+    params["query_emb"] = fan_in_init(ks[0], (cfg.num_prefix, h), h, dtype)
+    params["out_proj"] = fan_in_init(ks[1], (h, cfg.out_dim), h, dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
 # Native checkpoints (orbax) — serving snapshots / resume (SURVEY §5.4 notes
 # the reference has none; we add weight checkpointing as a first-class op)
 # ---------------------------------------------------------------------------

@@ -83,6 +83,8 @@ def encode_image(cfg: ViTConfig, params: Params,
     b = images.shape[0]
     x = patchify(images, cfg.patch_size)
     x = x @ params["patch_proj"] + params["pos_emb"][None]
+    if "patch_bias" in params:      # HF ViT imports carry a conv bias
+        x = x + params["patch_bias"]
     # perceiver-style: prepend learned queries; after the encoder, only the
     # query positions feed the decoder (fixed prefix length, static shapes)
     q = jnp.broadcast_to(
@@ -91,5 +93,6 @@ def encode_image(cfg: ViTConfig, params: Params,
     x = jnp.concatenate([q, x], axis=1)
     x = run_encoder(x, params["layers"], cfg.num_heads)
     return layer_norm(
-        x[:, : cfg.num_prefix], params["out_norm"]
+        x[:, : cfg.num_prefix], params["out_norm"],
+        params.get("out_norm_b"),
     ) @ params["out_proj"]

@@ -89,9 +89,19 @@ class VisionEngine(BaseEngine):
             self._llm_cfg, checkpoint_path=self.config.get("checkpoint_path"),
             dtype="float32",
         )
-        self._vit_params = vit.init_params(
-            self._vit_cfg, jax.random.PRNGKey(7)
-        )
+        vit_ckpt = self.config.get("vit_checkpoint_path")
+        if vit_ckpt:
+            # real pretrained ViT encoder (HF google/vit-* safetensors):
+            # everything the architectures share imports exactly; the
+            # perceiver resampler head stays fresh (models/loader.py
+            # load_hf_vit docstring)
+            from ...models.loader import load_hf_vit
+
+            self._vit_params = load_hf_vit(vit_ckpt, self._vit_cfg)
+        else:
+            self._vit_params = vit.init_params(
+                self._vit_cfg, jax.random.PRNGKey(7)
+            )
         tok = self.config.get("tokenizer")
         if tok is None:
             tok_id = self.config.get("tokenizer_id")
