@@ -89,3 +89,85 @@ def test_text_interpolations_of_server_fields_escaped():
         if re.match(r"^[a-zA-Z_$][\w$]*\.[\w$]+$", e):  # bare obj.field
             offenders.append(e)
     assert not offenders, f"raw object-field text interpolations: {offenders}"
+
+
+# -- round 5: beyond-regex checks (no browser in CI, but the API contract
+# and DOM wiring are testable without one) ---------------------------------
+
+
+def _spa_endpoints():
+    """Every (method, path) the SPA's call() helper can issue, with
+    ${...} interpolations normalized to a path segment."""
+    calls = re.findall(
+        r"call\(\"(GET|POST|PUT|DELETE)\",\s*(?:\"([^\"]*)\"|`([^`]*)`)",
+        SPA,
+    )
+    out = []
+    for method, dq, bq in calls:
+        path = dq or bq
+        path = path.split("?")[0]
+        path = re.sub(r"\$\{[^}]*\}", "SEG", path)
+        out.append((method, "/api/v1/admin" + path))
+    assert out, "no call() sites extracted — helper renamed?"
+    # coverage guard: every call( site in the file must have matched the
+    # extraction regex (minus the helper's own definition) — a refactored
+    # call shape must fail loudly, not silently drop out of the contract
+    n_sites = len(re.findall(r"\bcall\(", SPA)) - 1   # -1: definition
+    assert len(calls) == n_sites, (
+        f"extracted {len(calls)} of {n_sites} call() sites — "
+        "call shape changed? update _spa_endpoints"
+    )
+    return sorted(set(out))
+
+
+def test_every_spa_endpoint_is_a_registered_route():
+    """SPA ↔ control-plane contract: every endpoint the dashboard can
+    call must resolve to a route the aiohttp app actually registers (a
+    renamed/removed admin route breaks the SPA silently otherwise)."""
+    from distributed_gpu_inference_tpu.server.app import create_app
+
+    app = create_app()
+    routes = []
+    for r in app.router.routes():
+        if r.method in ("HEAD", "OPTIONS"):
+            continue
+        canonical = r.resource.canonical if r.resource else ""
+        pattern = re.compile(
+            "^" + re.sub(r"\{[^}]+\}", "[^/]+", canonical) + "$"
+        )
+        routes.append((r.method, pattern, canonical))
+
+    missing = []
+    for method, path in _spa_endpoints():
+        if not any(m == method and p.match(path) for m, p, _ in routes):
+            missing.append((method, path))
+    assert not missing, (
+        f"SPA calls endpoints the server does not register: {missing}"
+    )
+
+
+def test_dom_ids_referenced_by_js_exist():
+    """Every getElementById target must exist in the markup — a renamed
+    element turns a dashboard panel into a silent no-op."""
+    bs4 = pytest.importorskip("bs4")
+    doc = bs4.BeautifulSoup(SPA, "html.parser")
+    dom_ids = {el.get("id") for el in doc.find_all(attrs={"id": True})}
+    # views render their panels via innerHTML template literals — ids
+    # declared inside script text count as creatable too (lookbehind so
+    # data-id="..." attribute tails don't masquerade as element ids)
+    dom_ids |= set(re.findall(r"(?<![-\w])id=\"([^\"$]+)\"", SPA))
+    referenced = set(re.findall(r"getElementById\(\"([^\"]+)\"\)", SPA))
+    referenced |= set(re.findall(r"getElementById\('([^']+)'\)", SPA))
+    missing = referenced - dom_ids
+    assert not missing, f"JS references missing DOM ids: {missing}"
+
+
+def test_nav_views_have_sections():
+    """Each nav item's data-view must have a matching view container."""
+    bs4 = pytest.importorskip("bs4")
+    doc = bs4.BeautifulSoup(SPA, "html.parser")
+    views = {el.get("data-view") for el in doc.find_all(
+        attrs={"data-view": True})}
+    targets = {el.get("id") for el in doc.find_all(attrs={"id": True})}
+    missing = {v for v in views if v and f"view-{v}" not in targets}
+    assert not missing, f"nav views without view-* sections: {missing}"
