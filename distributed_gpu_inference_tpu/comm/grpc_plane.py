@@ -36,6 +36,7 @@ from distributed_gpu_inference_tpu.comm.stage_worker import (
     PipelineStageWorker,
     StageOutOfBlocksError,
 )
+from distributed_gpu_inference_tpu.testing import faults as _faults
 from distributed_gpu_inference_tpu.utils.serialization import TensorSerializer
 
 _SERVICE = "dgi_tpu.dataplane.v1.PipelineDataPlane"
@@ -227,14 +228,20 @@ class GrpcStageClient:
 
     def forward(self, session_id: str, x: np.ndarray,
                 positions: np.ndarray, kv_len_after: int) -> Dict[str, Any]:
-        resp = self._forward(
-            {
-                "session_id": session_id,
-                "kv_len_after": int(kv_len_after),
-                "x": _tensor_msg(x, self._ser),
-                "positions": _tensor_msg(positions, self._ser),
-            },
-            timeout=self.timeout_s,
+        # chaos seam: drop/delay this hop like a flaky cross-host link
+        # (no-op passthrough without an installed FaultPlan)
+        resp = _faults.wrap_rpc(
+            "comm.grpc.forward",
+            lambda: self._forward(
+                {
+                    "session_id": session_id,
+                    "kv_len_after": int(kv_len_after),
+                    "x": _tensor_msg(x, self._ser),
+                    "positions": _tensor_msg(positions, self._ser),
+                },
+                timeout=self.timeout_s,
+            ),
+            session_id=session_id,
         )
         return self._unpack_forward(resp)
 
@@ -242,7 +249,12 @@ class GrpcStageClient:
         return ForwardStream(self)
 
     def transfer_kv(self, handoff: bytes) -> Dict[str, Any]:
-        resp = self._transfer({"handoff": handoff}, timeout=self.timeout_s)
+        resp = _faults.wrap_rpc(
+            "comm.grpc.transfer_kv",
+            lambda: self._transfer({"handoff": handoff},
+                                   timeout=self.timeout_s),
+            size=len(handoff),
+        )
         return {"slot": resp["slot"], "bytes_received": resp["bytes_received"]}
 
     def close_session(self, session_id: str) -> None:

@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import httpx
 import numpy as np
 
+from distributed_gpu_inference_tpu.testing import faults as _faults
 from distributed_gpu_inference_tpu.utils.data_structures import (
     BlockRange,
     SessionConfig,
@@ -69,9 +70,16 @@ class WorkerSession:
             {"session_id": session_id, "kv_len_after": kv_len_after},
             {"x": x, "positions": positions},
         )
-        resp = self._client.post(
-            "/inference/forward", content=body,
-            headers={"Content-Type": "application/octet-stream"},
+        # chaos seam: drop/delay/error this hop like a flaky stage worker
+        # (no-op passthrough without an installed FaultPlan) — exercises
+        # the per-hop retry + spare-reroute-and-replay recovery above
+        resp = _faults.wrap_http(
+            "comm.session.forward",
+            lambda: self._client.post(
+                "/inference/forward", content=body,
+                headers={"Content-Type": "application/octet-stream"},
+            ),
+            url=self.base_url, method="POST",
         )
         if resp.status_code != 200:
             detail = ""

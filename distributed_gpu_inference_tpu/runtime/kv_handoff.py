@@ -35,6 +35,7 @@ from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from distributed_gpu_inference_tpu.testing import faults as _faults
 from distributed_gpu_inference_tpu.utils.data_structures import (
     InferenceRequest,
     SamplingParams,
@@ -777,6 +778,9 @@ class HandoffReceiver:
         self._sessions: Dict[str, _AdoptSession] = {}
 
     def handle(self, raw: bytes) -> Dict[str, Any]:
+        # chaos seam: an installed FaultPlan can truncate or lose this
+        # message in transit (no-op passthrough otherwise)
+        raw = _faults.mutate_bytes("kv.receiver.message", raw)
         self._purge_stale()
         if not is_stream_message(raw):
             handoff = deserialize_handoff(raw)
@@ -788,7 +792,15 @@ class HandoffReceiver:
         if kind == _KIND_BEGIN:
             return self._begin(meta)
         if kind == _KIND_PIECE:
-            return self._piece(meta, payload, len(raw))
+            try:
+                return self._piece(meta, payload, len(raw))
+            except Exception:
+                # a malformed/truncated piece poisons the whole stream (its
+                # block range can never be staged, so the commit could only
+                # bind garbage): abort the session NOW so its blocks free
+                # immediately instead of pinning KV until the TTL purge
+                self._drop(str(meta.get("key", "")))
+                raise
         if kind == _KIND_COMMIT:
             return self._commit(meta)
         if kind == _KIND_ABORT:
@@ -888,6 +900,25 @@ class HandoffReceiver:
         eng = self.engine
         req = sess.request
         token_ids = list(meta["token_ids"])
+        # every block covering the committed KV range must have been staged
+        # (or be resident via the receiver's prefix cache): committing over
+        # a lost piece would bind a slot to unwritten pages and the resumed
+        # decode would silently diverge — abort instead, so the control
+        # plane retries the stage cleanly
+        cached_blocks = sess.cached_tokens // sess.block_size
+        needed = -(-int(meta["kv_len"]) // sess.block_size)
+        staged = set(sess.staged)
+        missing = [
+            i for i in range(cached_blocks, min(needed, len(sess.blocks)))
+            if sess.blocks[i] not in staged
+        ]
+        if missing:
+            self._drop(key)
+            raise ValueError(
+                f"streamed handoff {key!r}: commit with unstaged blocks "
+                f"{missing[:8]}{'...' if len(missing) > 8 else ''} "
+                f"(piece lost in transit?) — session aborted"
+            )
         try:
             _validate_capacity(
                 eng, len(token_ids), int(meta["kv_len"]),

@@ -17,10 +17,14 @@ Behavioral parity with the reference's ``sdk/python/inference_client.py``:
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
 import httpx
+
+from ..testing import faults as _faults
+from ..utils.backoff import full_jitter_delay
 
 DIRECT_CACHE_TTL_S = 60.0  # reference inference_client.py:284-306
 
@@ -48,6 +52,7 @@ class InferenceClient:
         max_retries: int = 2,
         backoff_s: float = 0.5,
         transport: Optional[httpx.BaseTransport] = None,
+        rng: Optional[random.Random] = None,
     ) -> None:
         self.servers = (
             [server_url] if isinstance(server_url, str) else list(server_url)
@@ -56,6 +61,8 @@ class InferenceClient:
         self.api_key = api_key
         self._max_retries = max_retries
         self._backoff_s = backoff_s
+        # full-jitter source; injectable so tests can pin the schedule
+        self._rng = rng if rng is not None else random.Random()
         self._client = httpx.Client(timeout=timeout_s, transport=transport)
         self._direct_cache: Optional[Dict[str, Any]] = None
         self._direct_cache_at = 0.0
@@ -77,6 +84,11 @@ class InferenceClient:
             h["X-API-Key"] = self.api_key
         return h
 
+    def _sleep_backoff(self, attempt: int) -> None:
+        """Full-jitter exponential backoff (``utils.backoff``); bounded by
+        the attempt count, de-synchronized across a fleet of clients."""
+        time.sleep(full_jitter_delay(self._backoff_s, attempt, self._rng))
+
     def _request(self, method: str, path: str,
                  payload: Optional[Dict[str, Any]] = None,
                  params: Optional[Dict[str, str]] = None,
@@ -92,10 +104,15 @@ class InferenceClient:
         for server in self.servers:
             for attempt in range(self._max_retries + 1):
                 try:
-                    resp = self._client.request(
-                        method, f"{server}{path}", json=payload,
-                        params=params, headers=self._headers(),
-                        **({"timeout": timeout} if timeout is not None else {}),
+                    resp = _faults.wrap_http(
+                        "sdk.client.request",
+                        lambda srv=server: self._client.request(
+                            method, f"{srv}{path}", json=payload,
+                            params=params, headers=self._headers(),
+                            **({"timeout": timeout}
+                               if timeout is not None else {}),
+                        ),
+                        method=method, path=path,
                     )
                 except httpx.TransportError as exc:
                     last = exc
@@ -104,7 +121,7 @@ class InferenceClient:
                             599, f"transport failed: {exc}"
                         ) from exc
                     if attempt < self._max_retries:
-                        time.sleep(self._backoff_s * (2**attempt))
+                        self._sleep_backoff(attempt)
                     continue
                 if resp.status_code == 503:
                     saw_503 = True
@@ -123,7 +140,7 @@ class InferenceClient:
                     if not idempotent:  # the job may have run: don't re-run it
                         raise last
                     if attempt < self._max_retries:
-                        time.sleep(self._backoff_s * (2**attempt))
+                        self._sleep_backoff(attempt)
                     continue
                 return resp
             if not idempotent and not saw_503:
@@ -153,7 +170,22 @@ class InferenceClient:
                      poll_s: float = 0.5) -> Dict[str, Any]:
         deadline = time.time() + timeout_s
         while True:
-            job = self.get_job(job_id)
+            try:
+                job = self.get_job(job_id)
+            except InferenceClientError as exc:
+                # GET /jobs/{id} is idempotent: a transient blip (transport
+                # failure = 599, or a 5xx the retry ladder exhausted on)
+                # must not abort a long wait — keep polling until the
+                # deadline. 4xx are real answers and surface immediately.
+                if exc.status < 500:
+                    raise
+                if time.time() >= deadline:
+                    raise TimeoutError(
+                        f"job {job_id}: server unreachable at deadline "
+                        f"({exc})"
+                    ) from exc
+                time.sleep(poll_s * self._rng.uniform(0.5, 1.0))
+                continue
             if job["status"] in ("completed", "failed", "cancelled"):
                 return job
             if time.time() >= deadline:

@@ -54,14 +54,18 @@ class ServerState:
         self.security = SecurityService()
         self.reliability = ReliabilityService(self.store)
         self.scheduler = SmartScheduler(self.store, self.reliability)
+        self.pd_flow = PDFlowService(self.store)
         self.guarantee = TaskGuaranteeService(
-            self.store, self.reliability, heartbeat_timeout_s
+            self.store, self.reliability, heartbeat_timeout_s,
+            # sweeps that permanently fail a PD stage child must fail its
+            # container promptly (and cancel orphaned siblings) instead of
+            # stranding the parent until its own timeout
+            on_permanent_failure=self.pd_flow.on_job_permanently_failed,
         )
         self.background = TaskGuaranteeBackgroundWorker(self.guarantee)
         self.geo = GeoService()
         self.worker_config = WorkerConfigService(self.store)
         self.usage = UsageService(self.store)
-        self.pd_flow = PDFlowService(self.store)
         self.privacy = EnterprisePrivacyService(self.store)
         self.metrics = MetricsCollector()
         self.tracing = TracingManager()
@@ -69,6 +73,11 @@ class ServerState:
         self.api_key = api_key
         self.admin_key = admin_key or api_key
         self.require_signing = require_signing
+        # serializes reserve→issue→upsert in register_worker: a retry racing
+        # its own slow original must not interleave, or the store could end
+        # up holding the ORIGINAL's token hashes while the client keeps the
+        # retry's tokens (instant lockout spiral)
+        self.register_lock = asyncio.Lock()
         self.started_at = time.time()
 
 
@@ -101,7 +110,12 @@ def _check_admin_key(request: web.Request) -> Optional[web.Response]:
 
 async def _auth_worker(request: web.Request, worker_id: str
                        ) -> tuple[Optional[Dict[str, Any]], Optional[web.Response]]:
-    """Bearer-token auth with lockout; returns (worker_row, error_response)."""
+    """Bearer-token auth with lockout; returns (worker_row, error_response).
+
+    Callers MUST test the error with ``is not None`` — ``web.Response``
+    subclasses Mapping, so an empty 401/423 response is FALSY and a
+    truthiness check silently waves the request through unauthenticated.
+    """
     st = _state(request)
     w = await st.store.get_worker(worker_id)
     if w is None:
@@ -152,7 +166,30 @@ async def _auth_worker(request: web.Request, worker_id: str
 async def register_worker(request: web.Request) -> web.Response:
     st = _state(request)
     body = await request.json()
-    worker_id = body.get("worker_id") or str(uuid.uuid4())
+    # the whole resolve→issue→upsert sequence runs under register_lock: a
+    # retry racing its own slow original must not interleave, or the last
+    # upsert could store the ORIGINAL's token hashes while the client keeps
+    # the retry's tokens — every later call 401s into lockout
+    async with st.register_lock:
+        return await _register_worker_locked(st, body)
+
+
+async def _register_worker_locked(st: ServerState,
+                                  body: Dict[str, Any]) -> web.Response:
+    worker_id = body.get("worker_id")
+    fingerprint = body.get("machine_fingerprint")
+    if not worker_id and fingerprint:
+        # registration idempotency under a flapping server: a register whose
+        # response was lost gets retried by the client — the retry must land
+        # on the SAME row (keyed by machine fingerprint), not mint a
+        # duplicate worker that would double fleet counts and strand the
+        # first row's credentials. The reservation is atomic in the store,
+        # so even a retry racing its own still-in-flight original resolves
+        # to one row.
+        worker_id = await st.store.reserve_worker_id_for_fingerprint(
+            fingerprint, str(uuid.uuid4())
+        )
+    worker_id = worker_id or str(uuid.uuid4())
     bundle, stored = st.security.tokens.issue()
     row: Dict[str, Any] = {
         "id": worker_id,
@@ -180,6 +217,7 @@ async def register_worker(request: web.Request) -> web.Response:
         "supports_direct": bool(body.get("supports_direct")),
         "direct_url": body.get("direct_url"),
         "data_plane_url": body.get("data_plane_url"),
+        "machine_fingerprint": fingerprint,
         **stored,
     }
     await st.store.upsert_worker(row)
@@ -199,7 +237,7 @@ async def register_worker(request: web.Request) -> web.Response:
 async def heartbeat(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     st = _state(request)
     body = await request.json() if request.can_read_body else {}
@@ -207,19 +245,47 @@ async def heartbeat(request: web.Request) -> web.Response:
     for key in ("status", "hbm_used_gb", "loaded_models", "current_job_id"):
         if key in body:
             fields[key] = body[key]
+    stale_job = False
+    claimed = fields.get("current_job_id")
+    if claimed:
+        # a delayed/duplicate heartbeat can carry a claim the sweeps already
+        # requeued (or another worker already finished): accepting it would
+        # resurrect a phantom BUSY worker shadowing the real assignment
+        job = await st.store.get_job(claimed)
+        if job is None or job.get("worker_id") != worker_id:
+            # requeued (worker_id cleared) or taken over: a true zombie
+            stale_job = True
+            fields["current_job_id"] = None
+            if fields.get("status") == WorkerState.BUSY.value:
+                fields["status"] = WorkerState.IDLE.value
+        elif job["status"] != JobStatus.RUNNING.value:
+            # terminal but still ours: the heartbeat thread raced our own
+            # just-reported completion — drop the claim quietly, this is
+            # NOT zombie work and must not trip the worker's stale alarm
+            fields["current_job_id"] = None
+            if fields.get("status") == WorkerState.BUSY.value:
+                fields["status"] = WorkerState.IDLE.value
+    if w.get("status") == WorkerState.OFFLINE.value:
+        # swept offline but evidently alive: revive (a heartbeat IS proof of
+        # life) and open a fresh reliability session so online-time
+        # accounting resumes
+        fields.setdefault("status", WorkerState.IDLE.value)
+        await st.reliability.start_session(worker_id)
     await st.store.update_worker(worker_id, **fields)
     await st.reliability.update_online_pattern(worker_id, online=True)
     client_version = int(body.get("config_version") or 0)
     changed = await st.worker_config.config_changed_since(
         worker_id, client_version
     )
-    return web.json_response({"ok": True, "config_changed": changed})
+    return web.json_response(
+        {"ok": True, "config_changed": changed, "stale_job": stale_job}
+    )
 
 
 async def next_job(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     st = _state(request)
     job = await st.scheduler.atomic_assign_job(worker_id)
@@ -252,7 +318,7 @@ async def release_job(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     job_id = request.match_info["job_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     st = _state(request)
     job = await st.store.get_job(job_id)
@@ -273,35 +339,57 @@ async def complete_job(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     job_id = request.match_info["job_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     st = _state(request)
     job = await st.store.get_job(job_id)
     if job is None or job.get("worker_id") != worker_id:
         return _json_error(404, "job not assigned to this worker")
-    if job["status"] != JobStatus.RUNNING.value:
-        # late completion of a cancelled/requeued job: release the worker but
-        # never overwrite the terminal status or bill usage for it
+    body = await request.json()
+    success = bool(body.get("success", True))
+
+    async def _already_terminal(status: str) -> web.Response:
+        # always release this worker's capacity claim on the job
         w2 = await st.store.get_worker(worker_id)
         if w2 is not None and w2.get("current_job_id") == job_id:
             await st.store.update_worker(
                 worker_id, current_job_id=None, status=WorkerState.IDLE.value
             )
-        return _json_error(409, f"job is {job['status']}, not running")
-    body = await request.json()
-    success = bool(body.get("success", True))
+        expected = (
+            JobStatus.COMPLETED.value if success else JobStatus.FAILED.value
+        )
+        if status == expected:
+            # duplicate delivery (response lost → client retried, or the
+            # request was replayed in flight): the first delivery already
+            # applied the status change, reliability delta, and usage —
+            # acknowledge idempotently, never double-apply
+            return web.json_response({"ok": True, "duplicate": True})
+        # late completion of a cancelled/requeued job: never overwrite the
+        # terminal status or bill usage for it
+        return _json_error(409, f"job is {status}, not running")
+
+    if job["status"] != JobStatus.RUNNING.value:
+        return await _already_terminal(job["status"])
     now = time.time()
     dur_ms = (
         (now - float(job["started_at"])) * 1000.0 if job.get("started_at") else None
     )
-    await st.store.update_job(
-        job_id,
+    # atomic RUNNING→terminal claim: of N concurrent duplicate deliveries
+    # exactly ONE wins and applies the reliability/usage/PD effects below;
+    # losers re-read the row and take the duplicate/conflict path above
+    won = await st.store.try_transition_job(
+        job_id, JobStatus.RUNNING.value, owned_by=worker_id,
         status=JobStatus.COMPLETED.value if success else JobStatus.FAILED.value,
         result=body.get("result"),
         error=body.get("error"),
         completed_at=now,
         actual_duration_ms=dur_ms,
     )
+    if not won:
+        job2 = await st.store.get_job(job_id)
+        return await _already_terminal(
+            job2["status"] if job2 is not None else "gone"
+        )
     await st.store.update_worker(
         worker_id, current_job_id=None, status=WorkerState.IDLE.value
     )
@@ -327,7 +415,7 @@ async def complete_job(request: web.Request) -> web.Response:
 async def going_offline(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     st = _state(request)
     await st.store.update_worker(worker_id, status=WorkerState.DRAINING.value)
@@ -337,7 +425,7 @@ async def going_offline(request: web.Request) -> web.Response:
 async def offline(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     st = _state(request)
     requeued = await st.guarantee.handle_worker_offline(worker_id, graceful=True)
@@ -347,7 +435,7 @@ async def offline(request: web.Request) -> web.Response:
 async def verify_worker(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     return web.json_response({"ok": True, "worker_id": worker_id})
 
@@ -372,7 +460,7 @@ async def refresh_token(request: web.Request) -> web.Response:
 async def get_worker_config(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     st = _state(request)
     cfg = await st.worker_config.get_config(worker_id)
@@ -383,7 +471,7 @@ async def get_worker_config(request: web.Request) -> web.Response:
 async def put_worker_config(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     w, err = await _auth_worker(request, worker_id)
-    if err:
+    if err is not None:
         return err
     st = _state(request)
     updates = await request.json()
@@ -549,17 +637,9 @@ async def cancel_job(request: web.Request) -> web.Response:
             )
     if (job.get("params") or {}).get("pd_disaggregated"):
         # cancelling a PD container must not orphan its pinned stage jobs:
-        # queued children cancel outright (a RUNNING child finishes on its
-        # worker and the completion hook finds the parent terminal — no-op)
-        for child_id in (f"{job_id}-prefill", f"{job_id}-decode"):
-            child = await st.store.get_job(child_id)
-            if child is not None and \
-                    child["status"] == JobStatus.QUEUED.value:
-                await st.store.update_job(
-                    child_id, status=JobStatus.CANCELLED.value,
-                    completed_at=time.time(),
-                )
-        # release the PD scheduler placement (active_prefill/active_decode)
+        # on_parent_terminal cancels queued children (a RUNNING child
+        # finishes on its worker and the completion hook finds the parent
+        # terminal — no-op) and releases the scheduler placement
         await st.pd_flow.on_parent_terminal(job_id)
     return web.json_response({"job_id": job_id, "status": "cancelled"})
 

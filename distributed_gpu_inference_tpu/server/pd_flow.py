@@ -83,9 +83,46 @@ class PDFlowService:
     # -------------------------------------------------------------- submit
 
     async def on_parent_terminal(self, parent_id: str) -> None:
-        """Release placement state for a parent that went terminal outside
-        the normal child-completion path (cancellation, sweep timeout)."""
+        """A parent went terminal outside the normal child-completion path
+        (cancellation, sweep timeout, permanent child failure): release the
+        placement state and cancel any still-queued stage children — a
+        pinned child of a dead container would otherwise sit QUEUED forever
+        (nothing else may claim it) and pin scheduler capacity."""
         self._finish(parent_id, ok=False)
+        await self._cancel_queued_children(parent_id)
+
+    async def _cancel_queued_children(self, parent_id: str) -> None:
+        for child_id in (f"{parent_id}-prefill", f"{parent_id}-decode"):
+            child = await self.store.get_job(child_id)
+            if child is not None and child["status"] == "queued":
+                await self.store.update_job(
+                    child_id, status="cancelled", completed_at=time.time(),
+                )
+
+    async def on_job_permanently_failed(self, job: Dict[str, Any]) -> None:
+        """TaskGuarantee hook: the sweeps failed ``job`` for good (retries
+        exhausted, container timeout, pinned worker gone). PD containers
+        release placement and cancel orphaned children; PD stage children
+        fail their container NOW instead of stranding it RUNNING until its
+        own timeout — a stranded parent holds a scheduler placement and
+        keeps its sync waiters hanging the full window."""
+        params = job.get("params") or {}
+        # child check FIRST: stage children inherit the container's params
+        # (pd_disaggregated included) and would otherwise match the
+        # container branch and silently orphan their parent
+        if self.is_pd_child(job):
+            parent_id = params["pd_parent"]
+            parent = await self.store.get_job(parent_id)
+            if parent is not None and parent["status"] not in (
+                "completed", "failed", "cancelled"
+            ):
+                await self._fail(
+                    parent_id, params["pd_stage"],
+                    job.get("error") or "stage failed permanently",
+                )
+            return
+        if params.get("pd_disaggregated"):
+            await self.on_parent_terminal(job["id"])
 
     async def _prune_live(self) -> None:
         """Drop placements whose parent went terminal without passing
@@ -220,21 +257,31 @@ class PDFlowService:
             "migration_ms": pre.get("migration_ms"),
         }
         now = time.time()
-        await self.store.update_job(
-            parent_id, status="completed", result=merged, completed_at=now,
+        # conditional: a cancel racing this merge between the status check
+        # above and here must keep its terminal state (terminal is terminal)
+        won = await self.store.try_transition_job(
+            parent_id, "running",
+            status="completed", result=merged, completed_at=now,
             actual_duration_ms=(
                 (now - float(parent["started_at"])) * 1000.0
                 if parent.get("started_at") else None
             ),
         )
-        self._finish(parent_id, ok=True)
+        self._finish(parent_id, ok=won)
 
     async def _fail(self, parent_id: str, stage: str, error: str) -> None:
-        await self.store.update_job(
-            parent_id, status="failed",
+        # conditional: a cancel or completion racing this failure between
+        # the caller's status check and here keeps its terminal state —
+        # placement is released either way, but only the transition winner
+        # cancels queued children (the racing path owns its own cleanup)
+        won = await self.store.try_transition_job(
+            parent_id, "running",
+            status="failed",
             error=f"pd {stage} stage: {error}", completed_at=time.time(),
         )
         self._finish(parent_id, ok=False)
+        if won:
+            await self._cancel_queued_children(parent_id)
 
     def _finish(self, parent_id: str, ok: bool) -> None:
         req = self._live.pop(parent_id, None)

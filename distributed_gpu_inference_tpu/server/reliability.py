@@ -51,7 +51,11 @@ class ReliabilityService:
         if w is None:
             return None
         now = time.time() if now is None else now
-        score = float(w.get("reliability_score") or 0.5)
+        # NOT `or 0.5`: a worker pinned at the 0.0 rail must stay there —
+        # falsy-0.0 coercion would bounce it back to the neutral prior on
+        # every subsequent event, erasing the penalty history
+        raw = w.get("reliability_score")
+        score = 0.5 if raw is None else float(raw)
         fields: Dict[str, Any] = {}
 
         delta = SCORE_DELTAS.get(event, 0.0)
@@ -143,7 +147,8 @@ class ReliabilityService:
         hour = str(int(time.gmtime(now).tm_hour))
         pattern = worker.get("online_pattern") or {}
         p_hour = float(pattern.get(hour, 0.5))
-        score = float(worker.get("reliability_score") or 0.5)
+        raw = worker.get("reliability_score")   # 0.0 is a real score, not
+        score = 0.5 if raw is None else float(raw)  # "unknown"
         return _clamp(0.7 * p_hour + 0.3 * score)
 
     def predict_remaining_online_time(self, worker: Dict[str, Any],

@@ -32,6 +32,7 @@ import time
 import uuid
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
+from ..testing import faults as _faults
 from ..utils.data_structures import JobStatus, WorkerState
 
 # Columns stored as JSON text.
@@ -194,6 +195,12 @@ _MIGRATIONS = [
     # v3: PD disaggregation — decode-capable workers advertise the data-plane
     # URL prefill peers push KV handoffs to (server/pd_flow.py)
     (3, "ALTER TABLE workers ADD COLUMN data_plane_url TEXT"),
+    # v4: registration idempotency — a register retried after a lost
+    # response (server flap) must land on the SAME worker row, keyed by the
+    # machine fingerprint the worker already sends (worker/machine_id.py)
+    (4, "ALTER TABLE workers ADD COLUMN machine_fingerprint TEXT"),
+    (4, "CREATE INDEX IF NOT EXISTS idx_workers_fingerprint "
+        "ON workers (machine_fingerprint)"),
 ]
 
 SCHEMA_VERSION = max(
@@ -296,6 +303,11 @@ class Store:
         return self._conn.execute(sql, params).fetchall()
 
     async def execute(self, sql: str, params: Sequence[Any] = ()) -> None:
+        # chaos seam: an installed FaultPlan can lose this mutation (drop)
+        # or fail it like a wedged backend (error) — no-op passthrough
+        # otherwise (testing/faults.py)
+        if _faults.store_fault("server.store.execute", sql=sql):
+            return
         await self._run(self._exec, sql, params)
 
     async def query(
@@ -359,6 +371,75 @@ class Store:
 
     async def delete_worker(self, worker_id: str) -> None:
         await self.execute("DELETE FROM workers WHERE id=?", (worker_id,))
+
+    async def reserve_worker_id_for_fingerprint(
+        self, fingerprint: str, candidate_id: str
+    ) -> str:
+        """Atomic lookup-or-reserve of the worker row for a machine
+        fingerprint (registration idempotency). A plain SELECT-then-INSERT
+        in the handler is check-then-act: two concurrent registers (a
+        client retry racing its own slow original) would both see no row
+        and mint duplicate workers. ``BEGIN IMMEDIATE`` + conditional
+        insert makes the reservation atomic — the same pattern
+        ``claim_next_job`` uses."""
+        # chaos seam: a dropped reservation write models a lost insert —
+        # the candidate id is still returned, and the follow-up upsert
+        # creates the row (the retry path the scenario exercises)
+        if _faults.store_fault(
+            "server.store.execute", sql="INSERT INTO workers (reserve)"
+        ):
+            return candidate_id
+
+        def txn() -> str:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                row = self._conn.execute(
+                    "SELECT id FROM workers WHERE machine_fingerprint=?",
+                    (fingerprint,),
+                ).fetchone()
+                if row is not None:
+                    self._conn.execute("COMMIT")
+                    return row["id"]
+                self._conn.execute(
+                    "INSERT INTO workers (id, machine_fingerprint, "
+                    "registered_at) VALUES (?, ?, ?)",
+                    (candidate_id, fingerprint, time.time()),
+                )
+                self._conn.execute("COMMIT")
+                return candidate_id
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+
+        return await self._run(txn)
+
+    async def try_transition_job(self, job_id: str, from_status: str,
+                                 owned_by: Optional[str] = None,
+                                 **fields: Any) -> bool:
+        """Conditionally update a job only if it is still in
+        ``from_status`` (and, when ``owned_by`` is given, still assigned to
+        that worker); returns True when this caller won the transition.
+        The single UPDATE is atomic, so two concurrent duplicate
+        completions cannot both apply terminal effects."""
+        # chaos seam: a dropped transition is a lost write — the job stays
+        # in from_status and the caller takes its lost-the-race path
+        if _faults.store_fault(
+            "server.store.execute", sql=f"UPDATE jobs (transition {from_status})"
+        ):
+            return False
+        row = _encode(_JOB_JSON, fields)
+        sets = ", ".join(f"{k}=?" for k in row)
+        sql = f"UPDATE jobs SET {sets} WHERE id=? AND status=?"
+        params: List[Any] = [*row.values(), job_id, from_status]
+        if owned_by is not None:
+            sql += " AND worker_id=?"
+            params.append(owned_by)
+
+        def txn() -> bool:
+            cur = self._conn.execute(sql, params)
+            return cur.rowcount == 1
+
+        return await self._run(txn)
 
     # -- jobs --------------------------------------------------------------
 

@@ -14,12 +14,15 @@ Behavioral parity with the reference's ``server/app/services/task_guarantee.py``
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Awaitable, Callable, Dict, List, Optional
 
 from ..utils.data_structures import JobStatus, WorkerState
 from .reliability import ReliabilityService
 from .store import Store
+
+log = logging.getLogger("dgi-tpu.task_guarantee")
 
 HEARTBEAT_TIMEOUT_S = 90.0
 STALE_JOB_CAP_S = 30 * 60.0
@@ -30,18 +33,47 @@ SYNC_POLL_INTERVAL_S = 0.5
 class TaskGuaranteeService:
     def __init__(self, store: Store,
                  reliability: Optional[ReliabilityService] = None,
-                 heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S) -> None:
+                 heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
+                 on_permanent_failure: Optional[
+                     Callable[[Dict[str, Any]], Awaitable[None]]
+                 ] = None) -> None:
         self._store = store
         self._reliability = reliability or ReliabilityService(store)
         self._heartbeat_timeout_s = heartbeat_timeout_s
+        # called with the job row whenever a sweep marks a job FAILED for
+        # good (retries exhausted, container timeout, pinned worker gone);
+        # the PD flow uses it to fail containers promptly (server/app.py)
+        self.on_permanent_failure = on_permanent_failure
+
+    async def _notify_failed(self, job_id: str) -> None:
+        if self.on_permanent_failure is None:
+            return
+        job = await self._store.get_job(job_id)
+        if job is None:
+            return
+        try:
+            await self.on_permanent_failure(job)
+        except Exception:  # noqa: BLE001 — propagation must not break sweeps
+            log.exception(
+                "permanent-failure hook failed for job %s (its PD container,"
+                " if any, will only terminate via its own timeout)",
+                job.get("id"),
+            )
 
     # -- requeue machinery ---------------------------------------------------
 
     async def requeue_job(self, job: Dict[str, Any],
                           reason: str = "worker_offline") -> str:
-        """Requeue one job (or fail it if retries exhausted). Returns the new
-        status value. Frees the assigned worker's capacity state so a
-        timed-out job doesn't leave a phantom BUSY worker."""
+        """Requeue one job (or fail it if retries exhausted). Returns the
+        job's resulting status value. Frees the assigned worker's capacity
+        state so a timed-out job doesn't leave a phantom BUSY worker.
+
+        Every job write is a CONDITIONAL transition from the caller's
+        snapshot status: a completion racing the sweep (slow-but-alive
+        worker reporting just as the sweep fires) must keep its terminal
+        status — an unconditional overwrite would revert COMPLETED to
+        QUEUED and re-execute the job, double-applying reliability and
+        usage."""
         wid = job.get("worker_id")
         if wid:
             w = await self._store.get_worker(wid)
@@ -50,38 +82,53 @@ class TaskGuaranteeService:
                 if w.get("status") == WorkerState.BUSY.value:
                     fields["status"] = WorkerState.IDLE.value
                 await self._store.update_worker(wid, **fields)
-        if (job.get("params") or {}).get("pd_disaggregated"):
+
+        async def _lost_race() -> str:
+            cur = await self._store.get_job(job["id"])
+            return cur["status"] if cur is not None else JobStatus.FAILED.value
+
+        params = job.get("params") or {}
+        if params.get("pd_disaggregated") and not params.get("pd_stage"):
             # a PD CONTAINER job must never become claimable: requeueing it
             # would hand the whole generation to an arbitrary worker while
             # its pinned stage children still run (double execution). On
             # timeout the flow fails; a late stage completion finds the
             # parent terminal and no-ops (pd_flow.on_child_complete guard).
-            # Stage children themselves requeue normally — their
-            # target_worker pin rides in params.
-            await self._store.update_job(
-                job["id"],
+            # Stage children requeue normally below — they INHERIT the
+            # parent's params (pd_disaggregated included), so the pd_stage
+            # exclusion above is what keeps them out of this branch.
+            won = await self._store.try_transition_job(
+                job["id"], job["status"],
                 status=JobStatus.FAILED.value,
                 error=f"pd flow timed out: {reason}",
                 completed_at=time.time(),
             )
+            if not won:
+                return await _lost_race()
+            await self._notify_failed(job["id"])
             return JobStatus.FAILED.value
         retries = int(job.get("retry_count") or 0)
         max_retries = int(job.get("max_retries") or 3)
         if retries + 1 > max_retries:
-            await self._store.update_job(
-                job["id"],
+            won = await self._store.try_transition_job(
+                job["id"], job["status"], owned_by=wid,
                 status=JobStatus.FAILED.value,
                 error=f"exceeded max_retries ({max_retries}): {reason}",
                 completed_at=time.time(),
             )
+            if not won:
+                return await _lost_race()
+            await self._notify_failed(job["id"])
             return JobStatus.FAILED.value
-        await self._store.update_job(
-            job["id"],
+        won = await self._store.try_transition_job(
+            job["id"], job["status"], owned_by=wid,
             status=JobStatus.QUEUED.value,
             worker_id=None,
             started_at=None,
             retry_count=retries + 1,
         )
+        if not won:
+            return await _lost_race()
         return JobStatus.QUEUED.value
 
     async def handle_worker_offline(self, worker_id: str,
@@ -141,10 +188,75 @@ class TaskGuaranteeService:
                 dead.append(w["id"])
         return dead
 
+    async def sweep_orphaned_pins(
+        self, now: Optional[float] = None
+    ) -> List[str]:
+        """QUEUED jobs pinned to a worker (``params.target_worker`` — PD
+        stage children, whose KV lives or lands on exactly that worker)
+        can only ever be claimed by their pin. When the pinned worker is
+        gone for good the job is unrunnable — no retry can help, because
+        the pin IS the point — so fail it; the permanent-failure hook
+        fails the container in the same pass. Without this sweep such a
+        child sits QUEUED forever (the stale sweep covers only RUNNING)
+        and strands its parent for the full container timeout.
+
+        A freshly-OFFLINE worker gets a grace window of one extra
+        heartbeat timeout: heartbeats revive OFFLINE workers (a flap is
+        recoverable), and failing every pinned generation on a single
+        missed heartbeat would turn a transient blip into data loss."""
+        import json as _json
+
+        now = time.time() if now is None else now
+        # substring pre-filter (same idiom as the claim path): pinned jobs
+        # are the rare case, so select exactly them — no LIMIT cap that
+        # could silently exempt low-priority pins under a deep backlog
+        rows = await self._store.query(
+            "SELECT id, params FROM jobs WHERE status=? AND params LIKE ?",
+            (JobStatus.QUEUED.value, '%"target_worker"%'),
+        )
+        failed = []
+        worker_cache: Dict[str, Optional[Dict[str, Any]]] = {}
+        for job in rows:
+            try:
+                target = (_json.loads(job["params"] or "{}")
+                          .get("target_worker"))
+            except ValueError:
+                continue
+            if not target:
+                continue
+            if target not in worker_cache:
+                worker_cache[target] = await self._store.get_worker(target)
+            w = worker_cache[target]
+            if w is not None:
+                if w.get("status") != WorkerState.OFFLINE.value:
+                    continue
+                hb = w.get("last_heartbeat")
+                if hb is not None and \
+                        now - float(hb) < 2.0 * self._heartbeat_timeout_s:
+                    continue    # flap grace: the pin may still come back
+            # conditional transition: a revived pin racing this sweep may
+            # have just claimed the job (QUEUED→RUNNING) — never clobber a
+            # live claim with FAILED
+            won = await self._store.try_transition_job(
+                job["id"], JobStatus.QUEUED.value,
+                status=JobStatus.FAILED.value,
+                error=f"pinned worker {target} offline",
+                completed_at=now,
+            )
+            if not won:
+                continue
+            await self._notify_failed(job["id"])
+            failed.append(job["id"])
+        return failed
+
     async def sweep(self, now: Optional[float] = None) -> Dict[str, List[str]]:
         return {
             "dead_workers": await self.sweep_dead_workers(now=now),
             "stale_jobs": await self.sweep_stale_jobs(now=now),
+            # after the dead-worker pass: once a pinned worker's flap grace
+            # (2× heartbeat timeout) has elapsed, its freshly-OFFLINE state
+            # and its children's orphaning land in the same sweep pass
+            "orphaned_pins": await self.sweep_orphaned_pins(now=now),
         }
 
     # -- sync wait (reference :187-228) ---------------------------------------

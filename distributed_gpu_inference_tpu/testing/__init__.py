@@ -1,0 +1,25 @@
+"""Deterministic test instrumentation for the mesh.
+
+``testing.faults`` is the seeded fault-injection (chaos) subsystem: a
+:class:`~distributed_gpu_inference_tpu.testing.faults.FaultPlan` installs
+per-site rules (drop / delay / error / truncate / duplicate / flap) behind
+the injection seams threaded through the production clients, store, comm
+planes, and KV-handoff receiver. With no plan installed every seam is a
+no-op passthrough — production paths never construct plan state.
+
+``testing.fakes`` holds lightweight engine stand-ins for receiver-side
+protocol tests; ``testing.harness`` runs a real control plane on a loopback
+socket so synchronous worker/SDK clients can be driven end-to-end on CPU.
+
+See ``docs/failure-semantics.md`` for the delivery guarantees these tools
+exist to verify and for how to write a chaos scenario.
+"""
+
+from .faults import (  # noqa: F401
+    FaultPlan,
+    FaultRule,
+    active,
+    current,
+    install,
+    uninstall,
+)

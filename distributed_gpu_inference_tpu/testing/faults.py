@@ -1,0 +1,333 @@
+"""Deterministic, seed-driven fault injection for chaos scenarios.
+
+A :class:`FaultPlan` is a seeded RNG plus an ordered list of
+:class:`FaultRule`\\ s. Injection seams threaded through the production code
+(``worker.api_client``, ``sdk.client``, ``server.store``, ``comm.session``,
+``comm.grpc_plane``, ``runtime.kv_handoff``) consult the installed plan on
+every hit; the FIRST matching rule that fires decides the effect. Rules are
+matched by glob against a dotted site name (e.g. ``worker.api.request``)
+and optionally against call context (``match={"path": "*/complete"}``).
+
+Determinism contract: with the same seed, the same rules, and the same call
+sequence, a plan fires identically and records an identical ``trace`` —
+chaos scenarios assert this (same seed → same fault trace) and replay
+across many seeds.
+
+Zero cost when disabled: no plan is ever constructed in production paths,
+and every seam helper starts with ``if _ACTIVE is None: passthrough``.
+
+Rule kinds and where they apply:
+
+=========  =======================================================
+kind       effect at a seam
+=========  =======================================================
+drop       HTTP/RPC: raise a transport error. ``where="response"``
+           performs the call first (delivered, response lost) —
+           the building block for duplicate-delivery scenarios.
+           Store: silently skip the mutation (lost write).
+           Byte/stream: message lost in transit.
+delay      sleep ``delay_s`` then proceed.
+error      HTTP: synthesize a ``status`` response without calling.
+           Store: raise ``sqlite3.OperationalError``.
+truncate   byte seams: keep only the first ``cut`` bytes.
+duplicate  HTTP: perform the call twice, return the second
+           response. Stream filter: deliver the message twice.
+flap       unconditional drop for the next ``times`` hits — a
+           server/link that is down for a window, then recovers.
+reorder    stream filter only: hold the message and deliver it
+           right after the next delivered message (or last).
+=========  =======================================================
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field, replace
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+_KINDS = {
+    "drop", "delay", "error", "truncate", "duplicate", "flap", "reorder",
+}
+
+
+@dataclass
+class FaultRule:
+    """One injection rule; see the module docstring for kind semantics."""
+
+    site: str                      # glob over the dotted site name
+    kind: str
+    prob: float = 1.0              # per-hit firing probability (seeded RNG)
+    after: int = 0                 # skip the first N matching hits
+    times: Optional[int] = None    # max firings (None = unlimited)
+    where: str = "request"         # drop: "request" | "response"
+    status: int = 500              # error: synthesized HTTP status
+    delay_s: float = 0.0
+    cut: int = 64                  # truncate: bytes kept
+    match: Dict[str, str] = field(default_factory=dict)  # ctx key → glob
+    # live counters, owned by the plan (plans copy rules on construction
+    # so one rule list can seed many replays)
+    hits: int = 0
+    fired: int = 0
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (one of {sorted(_KINDS)})"
+            )
+        if self.where not in ("request", "response"):
+            raise ValueError("where must be 'request' or 'response'")
+
+
+def flap(site: str, times: int = 1, after: int = 0, **kw: Any) -> FaultRule:
+    """Sugar: the site is hard-down for the next ``times`` hits."""
+    return FaultRule(site=site, kind="flap", prob=1.0, times=times,
+                     after=after, **kw)
+
+
+class FaultInjected(ConnectionError):
+    """Raised at non-HTTP seams for injected drops (bytes/RPC)."""
+
+
+class FaultPlan:
+    """Seeded rule set + trace. Install with :func:`install` /
+    :func:`active`; seams consult it via the module-level helpers."""
+
+    def __init__(self, seed: int = 0,
+                 rules: Sequence[FaultRule] = ()) -> None:
+        self.seed = seed
+        # private copies: firing mutates counters, and scenario code reuses
+        # one rule list across seeded replays
+        self.rules: List[FaultRule] = [
+            replace(r, hits=0, fired=0) for r in rules
+        ]
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.trace: List[Tuple[str, str, str]] = []
+
+    # -- core ---------------------------------------------------------------
+
+    def fire(self, site: str, **ctx: Any) -> Optional[FaultRule]:
+        """Return the first rule that fires for this hit, else None.
+        Thread-safe; every firing is appended to ``trace``."""
+        with self._lock:
+            for r in self.rules:
+                if not fnmatch.fnmatchcase(site, r.site):
+                    continue
+                if any(
+                    not fnmatch.fnmatchcase(str(ctx.get(k, "")), pat)
+                    for k, pat in r.match.items()
+                ):
+                    continue
+                r.hits += 1
+                if r.hits <= r.after:
+                    continue
+                if r.times is not None and r.fired >= r.times:
+                    continue
+                if r.prob < 1.0 and self._rng.random() >= r.prob:
+                    continue
+                r.fired += 1
+                self.trace.append((site, r.kind, _ctx_str(ctx)))
+                return r
+        return None
+
+    # -- stream filtering (transport-level loss/reorder/dup) ----------------
+
+    def filter_stream(
+        self,
+        site: str,
+        messages: Iterable[bytes],
+        ctx_fn: Optional[Callable[[bytes], Dict[str, Any]]] = None,
+    ) -> Iterator[bytes]:
+        """Model an unreliable in-flight message sequence: apply drop /
+        duplicate / reorder / truncate rules to each message of ``site``.
+        ``ctx_fn(msg)`` supplies per-message match context (e.g. the stream
+        message kind) so rules can target, say, only ``commit`` frames.
+
+        ``reorder`` holds the message and releases it right after the next
+        DELIVERED message (messages dropped in between don't flush it, and
+        consecutive reorders queue up in order); anything still held when
+        the sequence ends is delivered last."""
+        held: List[bytes] = []
+        for msg in messages:
+            ctx = ctx_fn(msg) if ctx_fn is not None else {}
+            rule = self.fire(site, **ctx)
+            if rule is None:
+                out = [msg]
+            elif rule.kind in ("drop", "flap"):
+                out = []
+            elif rule.kind == "duplicate":
+                out = [msg, msg]
+            elif rule.kind == "truncate":
+                out = [msg[: rule.cut]]
+            elif rule.kind == "reorder":
+                held.append(msg)
+                continue
+            elif rule.kind == "delay":
+                time.sleep(rule.delay_s)
+                out = [msg]
+            else:
+                raise ValueError(
+                    f"rule kind {rule.kind!r} unsupported in filter_stream"
+                )
+            for m in out:
+                yield m
+                if held:
+                    yield from held
+                    held = []
+        yield from held
+
+
+def _ctx_str(ctx: Dict[str, Any]) -> str:
+    return ",".join(f"{k}={ctx[k]}" for k in sorted(ctx))
+
+
+# ---------------------------------------------------------------------------
+# plan installation
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError(
+            "a FaultPlan is already installed — uninstall it first "
+            "(leaked plan from a previous scenario?)"
+        )
+    _ACTIVE = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def current() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+@contextmanager
+def active(plan: FaultPlan) -> Iterator[FaultPlan]:
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()
+
+
+# ---------------------------------------------------------------------------
+# injection seams (all no-ops when no plan is installed)
+# ---------------------------------------------------------------------------
+
+
+def wrap_http(site: str, call: Callable[[], Any], **ctx: Any):
+    """HTTP client seam: ``call`` performs the real transport request and
+    returns an ``httpx.Response``. Injected effects surface exactly like
+    real network behavior so the caller's retry ladder is exercised."""
+    plan = _ACTIVE
+    if plan is None:
+        return call()
+    rule = plan.fire(site, **ctx)
+    if rule is None:
+        return call()
+    import httpx
+
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return call()
+    if rule.kind in ("drop", "flap"):
+        if rule.where == "response":
+            call()  # delivered server-side; the response is lost
+        raise httpx.ConnectError(f"fault injected: {rule.kind} at {site}")
+    if rule.kind == "error":
+        req = httpx.Request(
+            str(ctx.get("method", "GET")), f"http://fault.invalid/{site}"
+        )
+        return httpx.Response(
+            rule.status, request=req,
+            json={"detail": f"fault injected at {site}"},
+        )
+    if rule.kind == "duplicate":
+        call()
+        return call()
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at HTTP seam")
+
+
+def wrap_rpc(site: str, call: Callable[[], Any], **ctx: Any):
+    """Generic RPC seam (gRPC data plane): drops surface as
+    :class:`FaultInjected` (a ``ConnectionError``)."""
+    plan = _ACTIVE
+    if plan is None:
+        return call()
+    rule = plan.fire(site, **ctx)
+    if rule is None:
+        return call()
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return call()
+    if rule.kind in ("drop", "flap", "error"):
+        if rule.where == "response":
+            call()
+        raise FaultInjected(f"fault injected: {rule.kind} at {site}")
+    if rule.kind == "duplicate":
+        call()
+        return call()
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at RPC seam")
+
+
+def store_fault(site: str, **ctx: Any) -> bool:
+    """Store mutation seam. Returns True when the write must be SKIPPED
+    (injected lost write); raises ``sqlite3.OperationalError`` for injected
+    backend errors."""
+    plan = _ACTIVE
+    if plan is None:
+        return False
+    rule = plan.fire(site, **ctx)
+    if rule is None:
+        return False
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return False
+    if rule.kind == "drop":
+        return True
+    if rule.kind in ("error", "flap"):
+        import sqlite3
+
+        raise sqlite3.OperationalError(f"fault injected at {site}")
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at store seam")
+
+
+def mutate_bytes(site: str, data: bytes, **ctx: Any) -> bytes:
+    """Byte-message seam (KV handoff receiver): truncate or lose a message
+    in transit. Drops raise :class:`FaultInjected`, which the transport
+    layer reports to the sender like any receive failure."""
+    plan = _ACTIVE
+    if plan is None:
+        return data
+    rule = plan.fire(site, size=len(data), **ctx)
+    if rule is None:
+        return data
+    if rule.kind == "truncate":
+        return data[: rule.cut]
+    if rule.kind in ("drop", "flap"):
+        raise FaultInjected(f"fault injected: {rule.kind} at {site}")
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return data
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at byte seam")

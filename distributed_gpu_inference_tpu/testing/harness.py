@@ -1,0 +1,99 @@
+"""Chaos-scenario harness: a REAL control plane on a loopback socket.
+
+The worker ``APIClient`` and SDK ``InferenceClient`` are synchronous httpx
+clients, while the control plane is an aiohttp app. To drive both ends of
+the real protocol in one test, :class:`LiveControlPlane` runs the server's
+event loop on a background thread and binds the app to an ephemeral
+loopback port; the test thread then talks real HTTP through the real
+clients (retry ladders, signing, fault seams and all), and can reach into
+the server's services (sweeps with a simulated clock, store queries) via
+:meth:`call`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+from typing import Any, Coroutine, Dict, List, Optional
+
+from aiohttp import web
+
+from ..server.app import ServerState, create_app
+
+
+class LiveControlPlane:
+    """Context manager: a served control plane + direct service access."""
+
+    def __init__(self, **state_kw: Any) -> None:
+        self._state_kw = state_kw
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._runner: Optional[web.AppRunner] = None
+        self.state: Optional[ServerState] = None
+        self.port: int = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self) -> "LiveControlPlane":
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="live-control-plane",
+            daemon=True,
+        )
+        self._thread.start()
+        self.call(self._start())
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        try:
+            self.call(self._stop())
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=10.0)
+            self._loop.close()
+
+    async def _start(self) -> None:
+        # ServerState (and its Store/asyncio primitives) is created on the
+        # server loop so nothing binds to the test thread
+        self.state = ServerState(**self._state_kw)
+        app = create_app(self.state, start_background=False)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        self.port = sock.getsockname()[1]
+        site = web.SockSite(self._runner, sock)
+        await site.start()
+
+    async def _stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        if self.state is not None:
+            self.state.store.close()
+
+    # -- access --------------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def call(self, coro: Coroutine, timeout_s: float = 30.0) -> Any:
+        """Run a coroutine on the server loop from the test thread."""
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._loop
+        ).result(timeout=timeout_s)
+
+    # -- common shortcuts ----------------------------------------------------
+
+    def sweep(self, now: Optional[float] = None) -> Dict[str, List[str]]:
+        return self.call(self.state.guarantee.sweep(now=now))
+
+    def query(self, sql: str, params: tuple = ()) -> List[Dict[str, Any]]:
+        return self.call(self.state.store.query(sql, params))
+
+    def job(self, job_id: str) -> Optional[Dict[str, Any]]:
+        return self.call(self.state.store.get_job(job_id))
+
+    def worker(self, worker_id: str) -> Optional[Dict[str, Any]]:
+        return self.call(self.state.store.get_worker(worker_id))

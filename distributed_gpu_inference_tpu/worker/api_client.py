@@ -14,12 +14,15 @@ reference's). The signing canonicalization matches
 from __future__ import annotations
 
 import json
+import random
 import time
 from typing import Any, Dict, List, Optional
 
 import httpx
 
 from ..server.security import RequestSigner
+from ..testing import faults as _faults
+from ..utils.backoff import full_jitter_delay
 
 
 class APIError(Exception):
@@ -39,8 +42,10 @@ class APIClient:
         signing_secret: Optional[str] = None,
         max_retries: int = 3,
         backoff_s: float = 0.5,
+        retry_budget_s: float = 15.0,
         timeout_s: float = 30.0,
         transport: Optional[httpx.BaseTransport] = None,
+        rng: Optional[random.Random] = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.worker_id = worker_id
@@ -49,6 +54,9 @@ class APIClient:
         self.signing_secret = signing_secret
         self._max_retries = max_retries
         self._backoff_s = backoff_s
+        self._retry_budget_s = retry_budget_s
+        # full-jitter source; injectable so tests can pin the schedule
+        self._rng = rng if rng is not None else random.Random()
         self._signer = RequestSigner()
         self._client = httpx.Client(
             base_url=self.base_url, timeout=timeout_s, transport=transport
@@ -69,25 +77,52 @@ class APIClient:
             )
         return headers
 
+    def _backoff(self, attempt: int, remaining_s: float) -> Optional[float]:
+        """Sleep one full-jitter backoff step (``utils.backoff``); returns
+        the slept seconds, or None when the retry budget is exhausted
+        (caller stops retrying)."""
+        delay = full_jitter_delay(
+            self._backoff_s, attempt, self._rng, remaining_s
+        )
+        if delay is None:
+            return None
+        time.sleep(delay)
+        return delay
+
     def _request(self, method: str, path: str,
                  payload: Optional[Dict[str, Any]] = None,
                  retries: Optional[int] = None) -> httpx.Response:
         body = json.dumps(payload).encode() if payload is not None else b""
         attempts = (self._max_retries if retries is None else retries) + 1
+        budget = self._retry_budget_s
         last_exc: Optional[Exception] = None
         for attempt in range(attempts):
             try:
-                resp = self._client.request(
-                    method, path, content=body or None,
-                    headers=self._headers(method, path, body),
+                resp = _faults.wrap_http(
+                    "worker.api.request",
+                    lambda: self._client.request(
+                        method, path, content=body or None,
+                        headers=self._headers(method, path, body),
+                    ),
+                    method=method, path=path,
                 )
             except httpx.TransportError as exc:
                 last_exc = exc
-                if attempt + 1 < attempts:
-                    time.sleep(self._backoff_s * (2**attempt))
+                if attempt + 1 >= attempts:
+                    break
+                slept = self._backoff(attempt, budget)
+                if slept is None:
+                    break
+                budget -= slept
                 continue
-            if resp.status_code >= 500 and attempt + 1 < attempts:
-                time.sleep(self._backoff_s * (2**attempt))
+            if resp.status_code >= 500:
+                last_exc = APIError(resp.status_code, resp.text[:200])
+                if attempt + 1 >= attempts:
+                    raise last_exc
+                slept = self._backoff(attempt, budget)
+                if slept is None:
+                    raise last_exc
+                budget -= slept
                 continue
             if 400 <= resp.status_code < 500:  # never retried (:87)
                 detail = ""
@@ -96,8 +131,6 @@ class APIClient:
                 except ValueError:
                     pass
                 raise APIError(resp.status_code, detail)
-            if resp.status_code >= 500:
-                raise APIError(resp.status_code, resp.text[:200])
             return resp
         raise APIError(599, f"transport failed: {last_exc}")
 

@@ -307,6 +307,18 @@ class Worker:
                 },
             )
             self.stats["heartbeats"] += 1
+            if resp.get("stale_job") and self.current_job_id:
+                # the server requeued our claim (we looked dead): the
+                # in-flight inference cannot be cancelled mid-graph, but
+                # flag it loudly — the eventual complete_job will hit the
+                # 409/duplicate path and the result will be discarded
+                log.warning(
+                    "server reports job %s is no longer ours (requeued "
+                    "after a heartbeat gap); finishing as zombie work",
+                    self.current_job_id,
+                )
+                self.stats["stale_claims"] = \
+                    self.stats.get("stale_claims", 0) + 1
             if resp.get("config_changed"):
                 self._fetch_remote_config()
         except APIError as exc:

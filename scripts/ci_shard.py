@@ -2,12 +2,28 @@
 """Deterministic test-file sharding for the full CI gate.
 
 Usage: python scripts/ci_shard.py SHARD_INDEX NUM_SHARDS
-Prints the test files of the shard (interleaved assignment so heavy model/
-parallel files spread across shards), for xargs into pytest. Run from the
-repo root (globs tests/).
+Prints the test files of the shard (interleaved assignment so heavy files
+spread across shards), for xargs into pytest. Run from the repo root
+(globs tests/).
+
+Known-heavy files — compile-heavy model/parallel suites and the seeded
+chaos-replay suite (tests/test_chaos_scenarios.py, 50 replays per
+scenario) — are placed at the head of the interleave order, so every
+shard receives at most ceil(len(HEAVY)/NUM_SHARDS) of them instead of a
+chance clustering that blows one shard's wall clock.
 """
 import argparse
 import pathlib
+
+# ordered heaviest-first; files absent from the checkout are skipped
+HEAVY = [
+    "tests/test_chaos_scenarios.py",     # 50-seed replays per scenario
+    "tests/test_parallel_pipeline.py",
+    "tests/test_parallel_ring_attention.py",
+    "tests/test_model_moe.py",
+    "tests/test_kv_handoff_stream.py",
+    "tests/test_engine_tp.py",
+]
 
 ap = argparse.ArgumentParser()
 ap.add_argument("index", type=int)
@@ -15,6 +31,8 @@ ap.add_argument("num", type=int)
 args = ap.parse_args()
 
 files = sorted(p.as_posix() for p in pathlib.Path("tests").glob("test_*.py"))
-for i, f in enumerate(files):
+heavy = [f for f in HEAVY if f in files]
+ordered = heavy + [f for f in files if f not in heavy]
+for i, f in enumerate(ordered):
     if i % args.num == args.index:
         print(f)

@@ -1,0 +1,121 @@
+"""APIClient retry ladder: full-jitter backoff and the per-request retry
+budget (thundering-herd hardening). Mocked transport + recorded sleeps —
+no sockets.
+"""
+
+import random
+from typing import List
+
+import httpx
+import pytest
+
+from distributed_gpu_inference_tpu.worker.api_client import APIClient, APIError
+
+
+def _client(handler, monkeypatch, sleeps: List[float], **kw) -> APIClient:
+    import distributed_gpu_inference_tpu.worker.api_client as mod
+
+    monkeypatch.setattr(mod.time, "sleep", sleeps.append)
+    return APIClient(
+        "http://s1", transport=httpx.MockTransport(handler), **kw
+    )
+
+
+def test_backoff_is_full_jitter_bounded_by_cap(monkeypatch):
+    """Each retry sleep is U(0, base·2^attempt): never above the cap, and
+    two clients with different RNG streams retry on different schedules
+    (no fleet lockstep after a server restart)."""
+    def handler(req):
+        return httpx.Response(503, json={"detail": "restarting"})
+
+    schedules = []
+    for seed in (1, 2):
+        sleeps: List[float] = []
+        c = _client(handler, monkeypatch, sleeps, max_retries=3,
+                    backoff_s=0.5, rng=random.Random(seed))
+        with pytest.raises(APIError):
+            c._request("GET", "/x")
+        assert len(sleeps) == 3
+        for attempt, s in enumerate(sleeps):
+            assert 0.0 <= s <= 0.5 * 2**attempt
+        schedules.append(sleeps)
+        c.close()
+    assert schedules[0] != schedules[1]
+
+
+def test_retry_budget_caps_total_sleep(monkeypatch):
+    """With a worst-case (max-draw) RNG the cumulative backoff is clamped
+    to retry_budget_s and retrying stops once it is spent."""
+    calls = []
+
+    def handler(req):
+        calls.append(1)
+        return httpx.Response(503, json={"detail": "down"})
+
+    class MaxRng:
+        def uniform(self, a, b):
+            return b
+
+    sleeps: List[float] = []
+    c = _client(handler, monkeypatch, sleeps, max_retries=6, backoff_s=1.0,
+                retry_budget_s=4.0, rng=MaxRng())
+    with pytest.raises(APIError) as ei:
+        c._request("GET", "/x")
+    assert ei.value.status == 503
+    # caps would be 1,2,4,8,16,32; budget 4 allows 1 + 2 + (clamped) 1
+    assert sleeps == [1.0, 2.0, 1.0]
+    assert sum(sleeps) == pytest.approx(4.0)
+    assert len(calls) == 4          # initial + 3 budgeted retries, not 7
+    c.close()
+
+
+def test_transport_errors_respect_budget_and_raise_599(monkeypatch):
+    def handler(req):
+        raise httpx.ConnectError("down")
+
+    class MaxRng:
+        def uniform(self, a, b):
+            return b
+
+    sleeps: List[float] = []
+    c = _client(handler, monkeypatch, sleeps, max_retries=10, backoff_s=1.0,
+                retry_budget_s=2.0, rng=MaxRng())
+    with pytest.raises(APIError) as ei:
+        c._request("GET", "/x")
+    assert ei.value.status == 599
+    assert sum(sleeps) == pytest.approx(2.0)
+    c.close()
+
+
+def test_4xx_never_retried_never_sleeps(monkeypatch):
+    calls = []
+
+    def handler(req):
+        calls.append(1)
+        return httpx.Response(403, json={"detail": "nope"})
+
+    sleeps: List[float] = []
+    c = _client(handler, monkeypatch, sleeps, max_retries=5)
+    with pytest.raises(APIError) as ei:
+        c._request("GET", "/x")
+    assert ei.value.status == 403
+    assert calls == [1] and sleeps == []
+    c.close()
+
+
+def test_success_after_transient_5xx(monkeypatch):
+    state = {"n": 0}
+
+    def handler(req):
+        state["n"] += 1
+        if state["n"] < 3:
+            return httpx.Response(500, text="boom")
+        return httpx.Response(200, json={"ok": True})
+
+    sleeps: List[float] = []
+    c = _client(handler, monkeypatch, sleeps, max_retries=3,
+                rng=random.Random(0))
+    resp = c._request("GET", "/x")
+    assert resp.json()["ok"] is True
+    assert state["n"] == 3 and len(sleeps) == 2
+    c.close()

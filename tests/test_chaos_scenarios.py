@@ -1,0 +1,704 @@
+"""End-to-end chaos scenarios: the whole control plane + worker/SDK protocol
+driven through seeded fault injection (testing/faults.py), asserting the
+delivery guarantees of docs/failure-semantics.md hold under crashes,
+flaps, duplicate deliveries, and mangled KV-handoff streams.
+
+Each scenario is a function of a seed: the FaultPlan's RNG (and a derived
+scenario RNG) decides which faults fire and when, the scenario asserts the
+invariants — job-count conservation, capacity never leaks, terminal states
+are terminal, effects applied exactly once — in EVERY branch, and returns a
+deterministic summary. The suite replays every scenario across N_SEEDS
+seeds and separately proves same-seed → same-fault-trace determinism.
+
+The HTTP scenarios run a REAL aiohttp control plane on a loopback socket
+(testing/harness.py) and drive it with the REAL worker APIClient / SDK
+InferenceClient — retry ladders, auth, and fault seams all engaged. The
+KV-stream scenario drives the production HandoffReceiver over a FakeKVEngine
+(real wire framing and block accounting, no device) so 50 replays stay
+cheap.
+"""
+
+import random
+import time
+from typing import Any, Dict, List, Tuple
+
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+    HandoffReceiver,
+    _KIND_PIECE,
+    _unpack_stream,
+    is_stream_message,
+)
+from distributed_gpu_inference_tpu.sdk.client import InferenceClient
+from distributed_gpu_inference_tpu.testing import faults
+from distributed_gpu_inference_tpu.testing.fakes import (
+    FakeKVEngine,
+    make_stream_messages,
+    stream_kind,
+)
+from distributed_gpu_inference_tpu.testing.faults import FaultPlan, FaultRule
+from distributed_gpu_inference_tpu.testing.harness import LiveControlPlane
+from distributed_gpu_inference_tpu.worker.api_client import APIClient, APIError
+
+pytestmark = pytest.mark.chaos
+
+N_SEEDS = 50
+DET_SEED = 1234     # fixed seed for the same-seed→same-trace proofs
+
+
+def _trace(plan: FaultPlan) -> List[Tuple[str, str]]:
+    """The (site, kind) fault trace — ids (uuids) stripped from ctx."""
+    return [(site, kind) for site, kind, _ in plan.trace]
+
+
+def _api(cp: LiveControlPlane, worker_id=None) -> APIClient:
+    return APIClient(cp.url, worker_id=worker_id, backoff_s=0.0)
+
+
+def _register(api: APIClient, name: str, **extra) -> Dict[str, Any]:
+    return api.register({
+        "name": name, "region": "us-west", "supported_types": ["llm"],
+        "chip_generation": "v5e", **extra,
+    })
+
+
+def _assert_capacity_clean(cp: LiveControlPlane) -> None:
+    """Capacity never leaks: no worker left BUSY or holding a claim."""
+    for w in cp.query("SELECT id, status, current_job_id FROM workers"):
+        assert w["current_job_id"] is None, w
+        assert w["status"] != "busy", w
+
+
+# ---------------------------------------------------------------------------
+# scenario 1: worker crash mid-job → requeued exactly once, no phantom BUSY
+# ---------------------------------------------------------------------------
+
+
+def scenario_crash_mid_job(seed: int) -> Dict[str, Any]:
+    plan = FaultPlan(seed, [
+        FaultRule(site="worker.api.request", kind="drop", prob=0.7,
+                  match={"path": "*/complete"}),
+    ])
+    rng = random.Random(seed ^ 0x5EED)
+    with LiveControlPlane() as cp:
+        a = _api(cp, worker_id="w-a")
+        _register(a, "wa")
+        sdk = InferenceClient(cp.url, backoff_s=0.0)
+        job_id = sdk.create_job("llm", {"prompt": "x"})
+        job = a.fetch_next_job()
+        assert job is not None and job["id"] == job_id
+
+        crashed = False
+        with faults.active(plan):   # the chaos window: worker A's network
+            try:
+                a.complete_job(job_id, success=True, result={"text": "done"})
+            except APIError:
+                crashed = True    # every delivery attempt was dropped: the
+                #                   worker process dies without reporting
+        if crashed:
+            heartbeat_first = rng.random() < 0.5
+            cp.sweep(now=time.time() + 200.0)    # heartbeat timeout fires
+            if heartbeat_first:
+                # zombie heartbeat BEFORE another worker claims: must not
+                # resurrect the requeued claim as a phantom BUSY worker
+                resp = a.heartbeat(status="busy", current_job_id=job_id)
+                assert resp["stale_job"] is True
+            b = _api(cp, worker_id="w-b")
+            _register(b, "wb")
+            j2 = b.fetch_next_job()
+            assert j2 is not None and j2["id"] == job_id
+            if not heartbeat_first:
+                # zombie heartbeat AFTER the re-claim: same guarantee
+                resp = a.heartbeat(status="busy", current_job_id=job_id)
+                assert resp["stale_job"] is True
+            b.complete_job(job_id, success=True, result={"text": "done"})
+            b.close()
+
+        # -- invariants (hold in BOTH branches) ---------------------------
+        row = cp.job(job_id)
+        assert row["status"] == "completed"              # terminal, once
+        assert row["retry_count"] == (1 if crashed else 0)  # exactly once
+        assert cp.query("SELECT COUNT(*) AS n FROM jobs")[0]["n"] == 1
+        _assert_capacity_clean(cp)
+        workers = cp.query("SELECT completed_jobs FROM workers")
+        assert sum(w["completed_jobs"] for w in workers) == 1  # scored once
+        n_usage = cp.query("SELECT COUNT(*) AS n FROM usage_records")[0]["n"]
+        assert n_usage == 1                              # billed once
+        a.close()
+        sdk.close()
+    return {"crashed": crashed, "trace": _trace(plan)}
+
+
+# ---------------------------------------------------------------------------
+# scenario 2: duplicate complete_job delivery → idempotent, scored once
+# ---------------------------------------------------------------------------
+
+
+def scenario_duplicate_complete(seed: int) -> Dict[str, Any]:
+    plan = FaultPlan(seed, [
+        # delivered but the response is lost → APIClient retries → the
+        # server sees the same completion twice
+        FaultRule(site="worker.api.request", kind="drop", where="response",
+                  times=1, prob=0.5, match={"path": "*/complete"}),
+        # or the request itself is replayed in flight
+        FaultRule(site="worker.api.request", kind="duplicate",
+                  times=1, prob=0.5, match={"path": "*/complete"}),
+    ])
+    with LiveControlPlane() as cp, faults.active(plan):
+        a = _api(cp, worker_id="w-a")
+        _register(a, "wa")
+        sdk = InferenceClient(cp.url, backoff_s=0.0)
+        job_id = sdk.create_job("llm", {"prompt": "x"})
+        job = a.fetch_next_job()
+        assert job["id"] == job_id
+        resp = a.complete_job(job_id, success=True, result={"text": "ok"})
+        assert resp["ok"] is True                    # client always succeeds
+
+        row = cp.job(job_id)
+        assert row["status"] == "completed"
+        w = cp.worker("w-a")
+        assert w["total_jobs"] == 1 and w["completed_jobs"] == 1
+        assert w["success_rate"] == pytest.approx(1.0)
+        # reliability applied exactly once: +0.02 complete, +0.01 fast
+        assert w["reliability_score"] == pytest.approx(0.53)
+        n_usage = cp.query("SELECT COUNT(*) AS n FROM usage_records")[0]["n"]
+        assert n_usage == 1
+        _assert_capacity_clean(cp)
+        a.close()
+        sdk.close()
+    return {"dup": resp.get("duplicate", False), "trace": _trace(plan)}
+
+
+# ---------------------------------------------------------------------------
+# scenario 3: server flap during registration → one worker row, valid creds
+# ---------------------------------------------------------------------------
+
+
+def scenario_register_flap(seed: int) -> Dict[str, Any]:
+    plan = FaultPlan(seed, [
+        FaultRule(site="worker.api.request", kind="drop", where="response",
+                  times=1 + seed % 2, prob=0.8,
+                  match={"path": "*/register"}),
+    ])
+    with LiveControlPlane() as cp, faults.active(plan):
+        a = APIClient(cp.url, backoff_s=0.0)     # no pinned id: fresh worker
+        reg = _register(a, "wa", machine_fingerprint=f"fp-{seed}")
+        # every lost-response retry re-delivered the register: the
+        # fingerprint keys them all onto ONE row
+        assert cp.query("SELECT COUNT(*) AS n FROM workers")[0]["n"] == 1
+        # the credentials the client holds (from the LAST delivery) are the
+        # ones stored — verify round-trips
+        assert a.verify_credentials() is True
+        w = cp.worker(reg["worker_id"])
+        assert w["machine_fingerprint"] == f"fp-{seed}"
+        # a full worker restart re-registers with the same fingerprint and
+        # keeps the same identity (no fleet double-count)
+        a2 = APIClient(cp.url, backoff_s=0.0)
+        reg2 = _register(a2, "wa", machine_fingerprint=f"fp-{seed}")
+        assert reg2["worker_id"] == reg["worker_id"]
+        assert cp.query("SELECT COUNT(*) AS n FROM workers")[0]["n"] == 1
+        assert a2.verify_credentials() is True
+        a.close()
+        a2.close()
+    return {"trace": _trace(plan)}
+
+
+# ---------------------------------------------------------------------------
+# scenario 4: KV handoff stream mangled → receiver aborts, nothing leaks
+# ---------------------------------------------------------------------------
+
+
+def scenario_stream_chaos(seed: int) -> Dict[str, Any]:
+    plan = FaultPlan(seed, [
+        FaultRule(site="kv.stream.transit", kind="drop", prob=0.15,
+                  match={"kind": "piece"}),
+        FaultRule(site="kv.stream.transit", kind="reorder", prob=0.15,
+                  match={"kind": "piece"}),
+        # payload mangled in flight: header (and session key) survive, the
+        # page tensor doesn't — the receiver must abort the session
+        FaultRule(site="kv.stream.transit", kind="truncate", cut=40,
+                  prob=0.1, match={"kind": "piece"}),
+        FaultRule(site="kv.stream.transit", kind="duplicate", prob=0.3,
+                  match={"kind": "commit"}),
+        # receive-edge loss (the production seam inside handle())
+        FaultRule(site="kv.receiver.message", kind="drop", prob=0.05),
+    ])
+    eng = FakeKVEngine(num_blocks=16)
+    rx = HandoffReceiver(eng)
+    prompt = list(range(10))
+    msgs = make_stream_messages("k1", prompt, piece_blocks=1)
+    delivered = list(plan.filter_stream(
+        "kv.stream.transit", msgs, lambda m: {"kind": stream_kind(m)}
+    ))
+    committed = False
+    errors = 0
+    with faults.active(plan):
+        for m in delivered:
+            try:
+                out = rx.handle(m)
+            except faults.FaultInjected:
+                errors += 1               # lost at the receive edge: the
+                continue                  # receiver never saw it
+            except Exception:
+                errors += 1
+                # a piece the receiver PROCESSED and choked on must abort
+                # its session IMMEDIATELY (not linger until TTL purge)
+                if is_stream_message(m) and len(m) >= 10 \
+                        and m[5] == _KIND_PIECE:
+                    try:
+                        _, meta, _ = _unpack_stream(m)
+                    except ValueError:
+                        pass              # mangled beyond parsing
+                    else:
+                        assert meta["key"] not in rx._sessions
+                continue
+            if out.get("state") == "committed":
+                committed = True
+
+    # -- invariants -------------------------------------------------------
+    assert eng.binds == (1 if committed else 0)   # never bound twice
+    if committed:
+        # the commit-coverage guard guarantees: every block underlying the
+        # committed KV actually reached the device
+        blocks = eng.manager.seq_blocks["r-k1-pd"]
+        needed = -(-len(prompt) // eng.cfg.block_size)
+        assert all(blocks[i] in eng.manager.applied for i in range(needed))
+        assert "k1" not in rx._sessions
+    else:
+        # aborted — or still awaiting a commit that was lost: the stall
+        # purge must free everything
+        for sess in rx._sessions.values():
+            sess.last_activity -= rx.SESSION_TTL_S + 1.0
+        rx._purge_stale()
+        assert rx._sessions == {}
+    # block conservation: everything is either free or owned by the (at
+    # most one) live committed sequence — nothing dangles
+    assert eng.leaked_blocks() == 0
+    if not committed:
+        assert len(eng.manager.free_blocks) == eng.manager.num_blocks
+    assert eng.manager.pending.uploads == []
+    return {"committed": committed, "errors": errors, "trace": _trace(plan)}
+
+
+# ---------------------------------------------------------------------------
+# scenario 5: heartbeat loss during the PD container flow → container fails
+#             promptly, no stage double-execution, placement released
+# ---------------------------------------------------------------------------
+
+
+def scenario_pd_heartbeat_loss(seed: int) -> Dict[str, Any]:
+    rng = random.Random(seed ^ 0x9D)
+    branch = rng.randrange(4)
+    plan = FaultPlan(seed, [
+        # branch 2's decode worker dies mid-report: its completion POST
+        # never gets through
+        FaultRule(site="worker.api.request", kind="drop",
+                  match={"path": "*-decode/complete"}),
+    ] if branch == 2 else [])
+    with LiveControlPlane() as cp, faults.active(plan):
+        p = _api(cp, worker_id="w-p")
+        _register(p, "prefill-w", role="prefill")
+        d = _api(cp, worker_id="w-d")
+        _register(d, "decode-w", role="decode",
+                  data_plane_url="http://127.0.0.1:1/dp")
+        sdk = InferenceClient(cp.url, backoff_s=0.0)
+        parent_id = sdk.create_job("llm", {
+            "pd_disaggregated": True,
+            "prompt_token_ids": list(range(16)),
+            "max_tokens": 8,
+        })
+        prefill_id, decode_id = f"{parent_id}-prefill", f"{parent_id}-decode"
+        assert cp.job(parent_id)["status"] == "running"
+        assert cp.job(prefill_id)["status"] == "queued"
+
+        pre_result = {"first_token": 5, "ttft_ms": 3.0,
+                      "migration_bytes": 123, "migration_ms": 1.0,
+                      "usage": {"prompt_tokens": 16, "completion_tokens": 0,
+                                "total_tokens": 16}}
+        if branch == 0:
+            # prefill worker claims, then dies silently (heartbeat loss)
+            job = p.fetch_next_job()
+            assert job["id"] == prefill_id
+            cp.sweep(now=time.time() + 200.0)
+        else:
+            job = p.fetch_next_job()
+            assert job["id"] == prefill_id
+            p.complete_job(prefill_id, success=True, result=pre_result)
+            assert cp.job(decode_id)["status"] == "queued"
+            if branch == 1:
+                # decode worker dies before ever claiming its pinned child
+                cp.sweep(now=time.time() + 200.0)
+            elif branch == 2:
+                # decode worker claims, runs, but its completion is dropped
+                # and then its heartbeats stop
+                job = d.fetch_next_job()
+                assert job["id"] == decode_id
+                with pytest.raises(APIError):
+                    d.complete_job(decode_id, success=True,
+                                   result={"text": "hello"})
+                cp.sweep(now=time.time() + 200.0)
+            else:
+                # healthy flow
+                job = d.fetch_next_job()
+                assert job["id"] == decode_id
+                d.complete_job(decode_id, success=True, result={
+                    "text": "hello", "finish_reason": "stop",
+                    "usage": {"prompt_tokens": 16, "completion_tokens": 8,
+                              "total_tokens": 24},
+                })
+
+        # -- invariants ---------------------------------------------------
+        parent = cp.job(parent_id)
+        terminal = ("completed", "failed", "cancelled")
+        if branch == 3:
+            assert parent["status"] == "completed"
+            merged = parent["result"]
+            assert merged["pd_disaggregated"] is True
+            assert merged["ttft_ms"] == 3.0          # prefill's TTFT carried
+            assert merged["prefill_worker"] == "w-p"
+            assert merged["decode_worker"] == "w-d"
+        else:
+            # the container fails PROMPTLY (same sweep pass), not after its
+            # own 300 s timeout
+            assert parent["status"] == "failed"
+        # stage children: terminal, created at most once, never duplicated
+        rows = cp.query("SELECT id, status, retry_count FROM jobs")
+        assert len(rows) == (2 if branch == 0 else 3)  # conservation
+        for r in rows:
+            assert r["status"] in terminal, r
+        prefill = cp.job(prefill_id)
+        if branch == 0:
+            assert prefill["status"] == "failed"
+            assert cp.job(decode_id) is None      # never spawned
+        else:
+            # prefill ran exactly once — its result is never re-executed
+            assert prefill["status"] == "completed"
+            assert prefill["retry_count"] == 0
+            decode = cp.job(decode_id)
+            if branch == 1:
+                assert decode["status"] == "failed"
+                assert decode["retry_count"] == 0
+            elif branch == 2:
+                assert decode["status"] == "failed"
+                assert decode["retry_count"] == 1  # requeued exactly once
+            else:
+                assert decode["status"] == "completed"
+        # placement state fully released — no leaked PD capacity
+        stats = cp.state.pd_flow.get_stats()
+        assert stats["live"] == 0
+        _assert_capacity_clean(cp)
+        p.close()
+        d.close()
+        sdk.close()
+    return {"branch": branch, "trace": _trace(plan)}
+
+
+# ---------------------------------------------------------------------------
+# scenario 6: transient flaps mid-wait_for_job → SDK survives to the result
+# ---------------------------------------------------------------------------
+
+
+def scenario_sdk_wait_flap(seed: int) -> Dict[str, Any]:
+    k = 1 + seed % 4
+    plan = FaultPlan(seed, [
+        FaultRule(site="sdk.client.request", kind="flap", times=k,
+                  match={"path": "*/jobs/*"}),
+    ])
+    with LiveControlPlane() as cp:
+        a = _api(cp, worker_id="w-a")
+        _register(a, "wa")
+        sdk = InferenceClient(cp.url, backoff_s=0.0, max_retries=0)
+        job_id = sdk.create_job("llm", {"prompt": "x"})
+        job = a.fetch_next_job()
+        a.complete_job(job_id, success=True, result={"text": "done"})
+        with faults.active(plan):
+            # every one of the first k polls dies at the transport; the
+            # wait must ride them out (GET is idempotent) and return the
+            # terminal job well inside the deadline
+            out = sdk.wait_for_job(job_id, timeout_s=30.0, poll_s=0.01)
+        assert out["status"] == "completed"
+        assert out["result"]["text"] == "done"
+        assert len(plan.trace) == k           # each flap fired exactly once
+        _assert_capacity_clean(cp)
+        a.close()
+        sdk.close()
+    return {"flaps": k, "trace": _trace(plan)}
+
+
+# ---------------------------------------------------------------------------
+# the suite
+# ---------------------------------------------------------------------------
+
+SCENARIOS = {
+    "crash_mid_job": scenario_crash_mid_job,
+    "duplicate_complete": scenario_duplicate_complete,
+    "register_flap": scenario_register_flap,
+    "stream_chaos": scenario_stream_chaos,
+    "pd_heartbeat_loss": scenario_pd_heartbeat_loss,
+    "sdk_wait_flap": scenario_sdk_wait_flap,
+}
+
+
+def test_stream_chaos_50_seeds():
+    outcomes = [scenario_stream_chaos(s) for s in range(N_SEEDS)]
+    # the rule probabilities must actually exercise both terminal branches
+    assert any(o["committed"] for o in outcomes)
+    assert any(not o["committed"] for o in outcomes)
+
+
+def test_crash_mid_job_50_seeds():
+    outcomes = [scenario_crash_mid_job(s) for s in range(N_SEEDS)]
+    assert any(o["crashed"] for o in outcomes)
+    assert any(not o["crashed"] for o in outcomes)
+
+
+def test_duplicate_complete_50_seeds():
+    outcomes = [scenario_duplicate_complete(s) for s in range(N_SEEDS)]
+    assert any(o["dup"] for o in outcomes)      # the guard really fired
+
+
+def test_register_flap_50_seeds():
+    outcomes = [scenario_register_flap(s) for s in range(N_SEEDS)]
+    assert any(o["trace"] for o in outcomes)
+
+
+def test_pd_heartbeat_loss_50_seeds():
+    outcomes = [scenario_pd_heartbeat_loss(s) for s in range(N_SEEDS)]
+    assert {o["branch"] for o in outcomes} == {0, 1, 2, 3}
+
+
+def test_sdk_wait_flap_50_seeds():
+    outcomes = [scenario_sdk_wait_flap(s) for s in range(N_SEEDS)]
+    assert {o["flaps"] for o in outcomes} == {1, 2, 3, 4}
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_same_seed_same_fault_trace(name):
+    fn = SCENARIOS[name]
+    first = fn(DET_SEED)
+    second = fn(DET_SEED)
+    assert first == second
+
+
+# ---------------------------------------------------------------------------
+# concurrency regressions: the duplicate-delivery guards must hold when the
+# duplicates are IN FLIGHT TOGETHER, not just sequential (check-then-act)
+# ---------------------------------------------------------------------------
+
+
+def _inproc_client():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from distributed_gpu_inference_tpu.server.app import (
+        ServerState,
+        create_app,
+    )
+
+    async def make():
+        state = ServerState()
+        app = create_app(state, start_background=False)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        return state, client
+
+    return make
+
+
+def test_concurrent_duplicate_completion_applies_effects_once():
+    import asyncio
+
+    async def body():
+        state, client = await _inproc_client()()
+        resp = await client.post("/api/v1/workers/register", json={
+            "name": "w", "region": "us-west", "supported_types": ["llm"],
+        })
+        reg = await resp.json()
+        wid = reg["worker_id"]
+        hdr = {"Authorization": f"Bearer {reg['auth_token']}"}
+        resp = await client.post("/api/v1/jobs",
+                                 json={"type": "llm", "params": {}})
+        job_id = (await resp.json())["job_id"]
+        resp = await client.get(f"/api/v1/workers/{wid}/next-job",
+                                headers=hdr)
+        assert resp.status == 200
+        payload = {"success": True, "result": {"text": "ok"}}
+        r1, r2 = await asyncio.gather(
+            client.post(f"/api/v1/workers/{wid}/jobs/{job_id}/complete",
+                        json=payload, headers=hdr),
+            client.post(f"/api/v1/workers/{wid}/jobs/{job_id}/complete",
+                        json=payload, headers=hdr),
+        )
+        assert r1.status == 200 and r2.status == 200
+        outs = [await r1.json(), await r2.json()]
+        assert sorted(o.get("duplicate", False) for o in outs) == \
+            [False, True]                       # exactly one winner
+        w = await state.store.get_worker(wid)
+        assert w["total_jobs"] == 1 and w["completed_jobs"] == 1
+        n = await state.store.query(
+            "SELECT COUNT(*) AS n FROM usage_records")
+        assert n[0]["n"] == 1                   # billed once
+        await client.close()
+
+    asyncio.run(body())
+
+
+def test_sweep_requeue_never_clobbers_a_racing_completion():
+    """A sweep holding a stale RUNNING snapshot must not overwrite a
+    completion that landed in between: terminal states are terminal, and
+    a reverted COMPLETED would re-execute the job and double-bill."""
+    import asyncio
+
+    async def body():
+        state, client = await _inproc_client()()
+        resp = await client.post("/api/v1/workers/register", json={
+            "name": "w", "region": "us-west", "supported_types": ["llm"],
+        })
+        reg = await resp.json()
+        wid = reg["worker_id"]
+        hdr = {"Authorization": f"Bearer {reg['auth_token']}"}
+        resp = await client.post("/api/v1/jobs",
+                                 json={"type": "llm", "params": {}})
+        job_id = (await resp.json())["job_id"]
+        await client.get(f"/api/v1/workers/{wid}/next-job", headers=hdr)
+        snapshot = await state.store.get_job(job_id)   # RUNNING, ours
+        # the worker's completion wins the race...
+        await client.post(
+            f"/api/v1/workers/{wid}/jobs/{job_id}/complete",
+            json={"success": True, "result": {"text": "ok"}}, headers=hdr)
+        # ...then the sweep fires with its stale snapshot
+        out = await state.guarantee.requeue_job(snapshot, reason="job_timeout")
+        assert out == "completed"                      # lost race reported
+        job = await state.store.get_job(job_id)
+        assert job["status"] == "completed"            # never reverted
+        assert job["retry_count"] == 0
+        assert job["result"]["text"] == "ok"
+        await client.close()
+
+    asyncio.run(body())
+
+
+def test_heartbeat_racing_own_completion_is_not_stale():
+    """The worker's heartbeat thread can report current_job_id for a job
+    the main thread JUST completed: the claim is cleared quietly, but it
+    must NOT be flagged stale (that would fire zombie alarms on every
+    heartbeat/completion race)."""
+    import asyncio
+
+    async def body():
+        state, client = await _inproc_client()()
+        resp = await client.post("/api/v1/workers/register", json={
+            "name": "w", "region": "us-west", "supported_types": ["llm"],
+        })
+        reg = await resp.json()
+        wid = reg["worker_id"]
+        hdr = {"Authorization": f"Bearer {reg['auth_token']}"}
+        resp = await client.post("/api/v1/jobs",
+                                 json={"type": "llm", "params": {}})
+        job_id = (await resp.json())["job_id"]
+        await client.get(f"/api/v1/workers/{wid}/next-job", headers=hdr)
+        await client.post(
+            f"/api/v1/workers/{wid}/jobs/{job_id}/complete",
+            json={"success": True, "result": {}}, headers=hdr)
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/heartbeat",
+            json={"status": "busy", "current_job_id": job_id}, headers=hdr)
+        out = await resp.json()
+        assert out["stale_job"] is False          # our own completion
+        w = await state.store.get_worker(wid)
+        assert w["current_job_id"] is None        # claim still cleared
+        assert w["status"] == "idle"              # and no phantom BUSY
+        await client.close()
+
+    asyncio.run(body())
+
+
+def test_orphan_pin_grace_window_lets_flapped_worker_resume():
+    """A pinned PD child survives a TRANSIENT flap of its worker: within
+    the grace window (2× heartbeat timeout) the orphan sweep spares it,
+    the worker's next heartbeat revives it, and the flow completes."""
+    import asyncio
+    import time as _time
+
+    async def body():
+        state, client = await _inproc_client()()
+
+        async def reg(name, **extra):
+            resp = await client.post("/api/v1/workers/register", json={
+                "name": name, "region": "us-west",
+                "supported_types": ["llm"], **extra,
+            })
+            return await resp.json()
+
+        p = await reg("p", role="prefill")
+        d = await reg("d", role="decode",
+                      data_plane_url="http://127.0.0.1:1/dp")
+
+        def hdr(r):
+            return {"Authorization": f"Bearer {r['auth_token']}"}
+
+        resp = await client.post("/api/v1/jobs", json={
+            "type": "llm",
+            "params": {"pd_disaggregated": True,
+                       "prompt_token_ids": list(range(8)),
+                       "max_tokens": 4},
+        })
+        parent_id = (await resp.json())["job_id"]
+        resp = await client.get(
+            f"/api/v1/workers/{p['worker_id']}/next-job", headers=hdr(p))
+        assert resp.status == 200
+        await client.post(
+            f"/api/v1/workers/{p['worker_id']}/jobs/{parent_id}-prefill"
+            "/complete",
+            json={"success": True, "result": {"first_token": 1,
+                                              "ttft_ms": 1.0}},
+            headers=hdr(p),
+        )
+        # decode worker misses ONE heartbeat window: swept offline, but its
+        # pinned child is inside the grace window → spared
+        await state.guarantee.sweep(now=_time.time() + 100.0)
+        d_row = await state.store.get_worker(d["worker_id"])
+        assert d_row["status"] == "offline"
+        child = await state.store.get_job(f"{parent_id}-decode")
+        assert child["status"] == "queued"           # NOT failed
+        parent = await state.store.get_job(parent_id)
+        assert parent["status"] == "running"
+        # the worker comes back, is revived, and finishes the generation
+        resp = await client.post(
+            f"/api/v1/workers/{d['worker_id']}/heartbeat",
+            json={"status": "idle"}, headers=hdr(d))
+        assert resp.status == 200
+        resp = await client.get(
+            f"/api/v1/workers/{d['worker_id']}/next-job", headers=hdr(d))
+        assert resp.status == 200
+        await client.post(
+            f"/api/v1/workers/{d['worker_id']}/jobs/{parent_id}-decode"
+            "/complete",
+            json={"success": True, "result": {"text": "ok"}},
+            headers=hdr(d),
+        )
+        parent = await state.store.get_job(parent_id)
+        assert parent["status"] == "completed"
+        await client.close()
+
+    asyncio.run(body())
+
+
+def test_concurrent_registration_same_fingerprint_one_row():
+    import asyncio
+
+    async def body():
+        state, client = await _inproc_client()()
+        info = {"name": "w", "region": "us-west",
+                "supported_types": ["llm"], "machine_fingerprint": "fp-x"}
+        r1, r2 = await asyncio.gather(
+            client.post("/api/v1/workers/register", json=info),
+            client.post("/api/v1/workers/register", json=info),
+        )
+        ids = {(await r1.json())["worker_id"], (await r2.json())["worker_id"]}
+        assert len(ids) == 1                    # both landed on one row
+        n = await state.store.query("SELECT COUNT(*) AS n FROM workers")
+        assert n[0]["n"] == 1
+        await client.close()
+
+    asyncio.run(body())

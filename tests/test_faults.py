@@ -1,0 +1,188 @@
+"""Unit contract of the fault-injection subsystem (testing/faults.py):
+seeded determinism, rule matching/budgets, seam effect semantics, and the
+zero-cost-when-disabled guarantee the production seams rely on.
+"""
+
+import sqlite3
+
+import httpx
+import pytest
+
+from distributed_gpu_inference_tpu.testing import faults
+from distributed_gpu_inference_tpu.testing.faults import (
+    FaultInjected,
+    FaultPlan,
+    FaultRule,
+    flap,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def test_same_seed_same_trace():
+    rules = [FaultRule(site="a.*", kind="drop", prob=0.4)]
+    runs = []
+    for _ in range(2):
+        plan = FaultPlan(seed=123, rules=rules)
+        for i in range(40):
+            plan.fire("a.site", i=i)
+        runs.append(list(plan.trace))
+    assert runs[0] == runs[1]
+    assert 0 < len(runs[0]) < 40  # probabilistic rule actually filtered
+
+
+def test_different_seeds_differ():
+    rules = [FaultRule(site="a.*", kind="drop", prob=0.5)]
+    t1 = FaultPlan(1, rules)
+    t2 = FaultPlan(2, rules)
+    for i in range(64):
+        t1.fire("a.x", i=i)
+        t2.fire("a.x", i=i)
+    assert t1.trace != t2.trace
+
+
+def test_rules_are_copied_per_plan():
+    rules = [FaultRule(site="a", kind="drop", times=1)]
+    p1 = FaultPlan(0, rules)
+    assert p1.fire("a") is not None
+    assert p1.fire("a") is None          # times budget spent on p1 ...
+    p2 = FaultPlan(0, rules)
+    assert p2.fire("a") is not None      # ... but not on a fresh plan
+    assert rules[0].fired == 0           # nor on the template
+
+
+def test_after_and_times_and_ctx_match():
+    plan = FaultPlan(0, [
+        FaultRule(site="w.*", kind="drop", after=2, times=2,
+                  match={"path": "*/complete"}),
+    ])
+    assert plan.fire("w.api", path="/x/other") is None    # ctx mismatch
+    assert plan.fire("w.api", path="/x/complete") is None  # after: hit 1
+    assert plan.fire("w.api", path="/x/complete") is None  # after: hit 2
+    assert plan.fire("w.api", path="/x/complete") is not None
+    assert plan.fire("w.api", path="/x/complete") is not None
+    assert plan.fire("w.api", path="/x/complete") is None  # times spent
+
+
+def test_flap_sugar():
+    plan = FaultPlan(0, [flap("s", times=2)])
+    assert plan.fire("s").kind == "flap"
+    assert plan.fire("s").kind == "flap"
+    assert plan.fire("s") is None
+
+
+# -- seams -------------------------------------------------------------------
+
+
+def test_wrap_http_passthrough_without_plan():
+    assert faults.current() is None
+    calls = []
+    out = faults.wrap_http("any.site", lambda: calls.append(1) or "resp")
+    assert out == "resp" and calls == [1]
+
+
+def test_wrap_http_effects():
+    calls = []
+
+    def call():
+        calls.append(1)
+        return httpx.Response(200, request=httpx.Request("GET", "http://x/"))
+
+    with faults.active(FaultPlan(0, [FaultRule("s", "drop", times=1)])):
+        with pytest.raises(httpx.ConnectError):
+            faults.wrap_http("s", call)
+        assert calls == []               # request never delivered
+    with faults.active(FaultPlan(0, [
+        FaultRule("s", "drop", where="response", times=1)
+    ])):
+        with pytest.raises(httpx.ConnectError):
+            faults.wrap_http("s", call)
+        assert calls == [1]              # delivered, response lost
+    with faults.active(FaultPlan(0, [FaultRule("s", "error", status=503)])):
+        resp = faults.wrap_http("s", call)
+        assert resp.status_code == 503 and len(calls) == 1
+        assert "fault injected" in resp.json()["detail"]
+    with faults.active(FaultPlan(0, [FaultRule("s", "duplicate")])):
+        resp = faults.wrap_http("s", call)
+        assert resp.status_code == 200 and len(calls) == 3  # two more sends
+
+
+def test_store_fault_effects():
+    assert faults.store_fault("server.store.execute", sql="UPDATE x") is False
+    with faults.active(FaultPlan(0, [
+        FaultRule("server.store.*", "drop", match={"sql": "UPDATE jobs*"})
+    ])):
+        assert faults.store_fault(
+            "server.store.execute", sql="UPDATE jobs SET x=1") is True
+        assert faults.store_fault(
+            "server.store.execute", sql="INSERT INTO jobs") is False
+    with faults.active(FaultPlan(0, [FaultRule("server.store.*", "error")])):
+        with pytest.raises(sqlite3.OperationalError):
+            faults.store_fault("server.store.execute", sql="UPDATE x")
+
+
+def test_mutate_bytes_effects():
+    data = bytes(range(100))
+    assert faults.mutate_bytes("kv.x", data) is data
+    with faults.active(FaultPlan(0, [FaultRule("kv.*", "truncate", cut=10)])):
+        assert faults.mutate_bytes("kv.x", data) == data[:10]
+    with faults.active(FaultPlan(0, [FaultRule("kv.*", "drop")])):
+        with pytest.raises(FaultInjected):
+            faults.mutate_bytes("kv.x", data)
+
+
+def test_filter_stream_drop_duplicate_reorder():
+    msgs = [b"m0", b"m1", b"m2", b"m3"]
+
+    def ctx(m):
+        return {"idx": msgs.index(m)}
+
+    plan = FaultPlan(0, [FaultRule("st", "drop", match={"idx": "1"})])
+    assert list(plan.filter_stream("st", msgs, ctx)) == [b"m0", b"m2", b"m3"]
+
+    plan = FaultPlan(0, [FaultRule("st", "duplicate", match={"idx": "2"})])
+    assert list(plan.filter_stream("st", msgs, ctx)) == [
+        b"m0", b"m1", b"m2", b"m2", b"m3"
+    ]
+
+    plan = FaultPlan(0, [FaultRule("st", "reorder", match={"idx": "1"})])
+    assert list(plan.filter_stream("st", msgs, ctx)) == [
+        b"m0", b"m2", b"m1", b"m3"
+    ]
+
+
+def test_filter_stream_reorder_edge_cases():
+    msgs = [b"m0", b"m1", b"m2", b"m3"]
+
+    def ctx(m):
+        return {"idx": msgs.index(m)}
+
+    # consecutive reorders both take effect (queue up, flush in order
+    # after the next delivered message)
+    plan = FaultPlan(0, [FaultRule("st", "reorder", match={"idx": "[12]"})])
+    assert list(plan.filter_stream("st", msgs, ctx)) == [
+        b"m0", b"m3", b"m1", b"m2"
+    ]
+    # a drop between hold and flush does not release the held message early
+    plan = FaultPlan(0, [
+        FaultRule("st", "reorder", match={"idx": "1"}),
+        FaultRule("st", "drop", match={"idx": "2"}),
+    ])
+    assert list(plan.filter_stream("st", msgs, ctx)) == [
+        b"m0", b"m3", b"m1"
+    ]
+    # held at end of sequence is still delivered (never silently lost)
+    plan = FaultPlan(0, [FaultRule("st", "reorder", match={"idx": "3"})])
+    assert list(plan.filter_stream("st", msgs, ctx)) == [
+        b"m0", b"m1", b"m2", b"m3"
+    ]
+
+
+def test_install_guard_rejects_leaked_plan():
+    faults.install(FaultPlan(0, []))
+    try:
+        with pytest.raises(RuntimeError):
+            faults.install(FaultPlan(1, []))
+    finally:
+        faults.uninstall()
+    assert faults.current() is None

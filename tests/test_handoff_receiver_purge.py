@@ -1,0 +1,121 @@
+"""HandoffReceiver session-hygiene coverage: TTL expiry, the
+no-progress backstop, and the piece-error/commit-coverage hardening —
+every path must free staged blocks and reject late pieces for a purged
+session. Driven on a :class:`FakeKVEngine` (real receiver code, real block
+accounting, no device/model) so the suite stays in the fast tier-1 gate.
+"""
+
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.kv_handoff import HandoffReceiver
+from distributed_gpu_inference_tpu.testing.fakes import (
+    FakeKVEngine,
+    make_stream_messages,
+    stream_kind,
+)
+
+pytestmark = pytest.mark.chaos
+
+PROMPT = list(range(10))     # 10 tokens, block_size 4 → 3 blocks (with pend.)
+
+
+def _receiver():
+    eng = FakeKVEngine(num_blocks=16)
+    return eng, HandoffReceiver(eng)
+
+
+def test_full_stream_commits_on_fake_engine():
+    eng, rx = _receiver()
+    out = None
+    for msg in make_stream_messages("k1", PROMPT):
+        out = rx.handle(msg)
+    assert out["state"] == "committed"
+    assert eng.binds == 1
+    assert rx._sessions == {}
+    # every block covering the committed KV reached the "device"
+    seq_id = "r-k1-pd"
+    blocks = eng.manager.seq_blocks[seq_id]
+    needed = -(-len(PROMPT) // eng.cfg.block_size)
+    assert all(blocks[i] in eng.manager.applied for i in range(needed))
+    assert eng.leaked_blocks() == 0
+
+
+def test_ttl_expiry_frees_blocks_and_rejects_late_pieces():
+    eng, rx = _receiver()
+    msgs = make_stream_messages("k1", PROMPT)
+    rx.handle(msgs[0])                   # begin: blocks allocated
+    rx.handle(msgs[1])                   # first piece staged
+    assert len(eng.manager.free_blocks) < eng.manager.num_blocks
+    sess = rx._sessions["k1"]
+    sess.last_activity -= rx.SESSION_TTL_S + 1.0
+    rx._purge_stale()
+    assert "k1" not in rx._sessions
+    assert eng.leaked_blocks() == 0
+    assert len(eng.manager.free_blocks) == eng.manager.num_blocks
+    assert eng.manager.pending.uploads == []
+    # a late piece for the purged session is rejected, not re-staged
+    with pytest.raises(ValueError, match="no streamed handoff session"):
+        rx.handle(msgs[2])
+    # and a late commit equally so
+    with pytest.raises(ValueError, match="no streamed handoff session"):
+        rx.handle(msgs[-1])
+    assert eng.binds == 0
+
+
+def test_no_progress_backstop_purges_warm_but_stalled_session():
+    eng, rx = _receiver()
+    msgs = make_stream_messages("k1", PROMPT)
+    rx.handle(msgs[0])
+    rx.handle(msgs[1])
+    sess = rx._sessions["k1"]
+    # a trickler re-sending the same block keeps last_activity fresh but
+    # must NOT refresh the progress clock
+    progress_before = sess.last_progress
+    rx.handle(msgs[1])                   # duplicate piece: no new block
+    assert rx._sessions["k1"].last_progress == progress_before
+    # a genuinely new block DOES count as progress
+    rx.handle(msgs[2])
+    assert rx._sessions["k1"].last_progress >= progress_before
+    # stall past the backstop with activity still warm → purged anyway
+    sess = rx._sessions["k1"]
+    sess.last_progress -= rx.SESSION_MAX_NO_PROGRESS_S + 1.0
+    sess.last_activity = sess.last_activity  # explicitly warm
+    rx._purge_stale()
+    assert "k1" not in rx._sessions
+    assert eng.leaked_blocks() == 0
+    with pytest.raises(ValueError, match="no streamed handoff session"):
+        rx.handle(msgs[-1])
+
+
+def test_malformed_piece_aborts_session_immediately():
+    """A truncated/undecodable piece poisons the stream: the session must
+    drop NOW (blocks freed), not linger until the TTL purge."""
+    eng, rx = _receiver()
+    msgs = make_stream_messages("k1", PROMPT)
+    rx.handle(msgs[0])
+    broken = msgs[1][:40]                # valid header, mangled payload
+    with pytest.raises(Exception):
+        rx.handle(broken)
+    assert "k1" not in rx._sessions
+    assert eng.leaked_blocks() == 0
+
+
+def test_commit_with_lost_piece_aborts_instead_of_binding_garbage():
+    eng, rx = _receiver()
+    msgs = make_stream_messages("k1", PROMPT)
+    rx.handle(msgs[0])
+    rx.handle(msgs[1])                   # piece for blocks 0-1
+    # piece for block 2 lost in transit; commit arrives anyway
+    with pytest.raises(ValueError, match="unstaged blocks"):
+        rx.handle(msgs[-1])
+    assert "k1" not in rx._sessions
+    assert eng.binds == 0                # never bound over a hole
+    assert eng.leaked_blocks() == 0
+
+
+def test_stream_kind_helper():
+    msgs = make_stream_messages("k1", PROMPT)
+    assert stream_kind(msgs[0]) == "begin"
+    assert stream_kind(msgs[1]) == "piece"
+    assert stream_kind(msgs[-1]) == "commit"
+    assert stream_kind(b"notastream") == "blob"

@@ -1,0 +1,101 @@
+"""ReliabilityService.record_event edge cases: unknown events are no-op
+deltas, the score clamps at both rails, and the avg_latency_ms running mean
+stays correct under interleaved complete/fail events.
+"""
+
+import asyncio
+
+import pytest
+
+from distributed_gpu_inference_tpu.server.reliability import ReliabilityService
+from distributed_gpu_inference_tpu.server.store import Store
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _setup(**worker_fields):
+    store = Store(":memory:")
+    svc = ReliabilityService(store)
+    await store.upsert_worker({"id": "w1", **worker_fields})
+    return store, svc
+
+
+def test_unknown_event_is_noop_delta():
+    async def body():
+        store, svc = await _setup(reliability_score=0.4)
+        score = await svc.record_event("w1", "cosmic_ray_detected")
+        assert score == pytest.approx(0.4)
+        w = await store.get_worker("w1")
+        assert w["reliability_score"] == pytest.approx(0.4)
+        assert w["total_jobs"] == 0 and w["completed_jobs"] == 0
+        assert w["success_rate"] == pytest.approx(1.0)  # untouched default
+        store.close()
+
+    run(body())
+
+
+def test_unknown_worker_returns_none():
+    async def body():
+        store, svc = await _setup()
+        assert await svc.record_event("ghost", "job_completed") is None
+        store.close()
+
+    run(body())
+
+
+def test_score_clamps_at_one():
+    async def body():
+        store, svc = await _setup(reliability_score=0.995)
+        # +0.02 complete +0.01 fast-response would overshoot → clamp
+        score = await svc.record_event("w1", "job_completed", latency_ms=50.0)
+        assert score == 1.0
+        store.close()
+
+    run(body())
+
+
+def test_score_clamps_at_zero():
+    async def body():
+        store, svc = await _setup(reliability_score=0.05)
+        score = await svc.record_event("w1", "unexpected_offline")
+        assert score == 0.0
+        w = await store.get_worker("w1")
+        assert w["unexpected_offline_count"] == 1
+        # further penalties stay pinned at the rail
+        assert await svc.record_event("w1", "job_failed") == 0.0
+        store.close()
+
+    run(body())
+
+
+def test_avg_latency_running_mean_interleaved():
+    async def body():
+        store, svc = await _setup()
+        await svc.record_event("w1", "job_completed", latency_ms=100.0)
+        # failures must not perturb the completion-latency mean (their
+        # latency argument is ignored by design)
+        await svc.record_event("w1", "job_failed", latency_ms=9999.0)
+        await svc.record_event("w1", "job_completed", latency_ms=300.0)
+        w = await store.get_worker("w1")
+        assert w["completed_jobs"] == 2 and w["failed_jobs"] == 1
+        assert w["total_jobs"] == 3
+        assert w["avg_latency_ms"] == pytest.approx(200.0)
+        assert w["success_rate"] == pytest.approx(2 / 3)
+        store.close()
+
+    run(body())
+
+
+def test_completion_without_latency_keeps_mean():
+    async def body():
+        store, svc = await _setup()
+        await svc.record_event("w1", "job_completed", latency_ms=400.0)
+        await svc.record_event("w1", "job_completed")     # latency unknown
+        w = await store.get_worker("w1")
+        assert w["completed_jobs"] == 2
+        assert w["avg_latency_ms"] == pytest.approx(400.0)
+        store.close()
+
+    run(body())
