@@ -45,6 +45,11 @@ def _use_pallas() -> bool:
 # 1400+. The threshold is on the STATIC padded table width, so dispatch is
 # trace-time and costs nothing.
 _PALLAS_MIN_PADDED_CTX = 512
+# small-q ceiling for the multi-query decode path (speculative verify:
+# q_len = K+1 per slot). Each query row becomes one decode-kernel row, so
+# pages re-stage once per query — past ~8 queries the re-staged HBM traffic
+# beats one gather and the prefill-shaped XLA path wins anyway.
+_PALLAS_MAX_MULTIQUERY = 8
 
 
 def resolve_impl(
@@ -58,16 +63,20 @@ def resolve_impl(
     capacity ``block_tables.shape[1] * block_size``. Exposed so callers
     (bench.py, engines) can ASSERT the Pallas kernel is in the measured
     path instead of discovering a silent fallback after the fact
-    (VERDICT r1 weak #1)."""
+    (VERDICT r1 weak #1). q_seq in 2..8 resolves to ``pallas_mq`` — the
+    small-q multi-query decode path serving speculative verify windows
+    (q_len = K+1 per slot rather than 1)."""
     if backend_is_tpu is None:
         backend_is_tpu = _use_pallas()
     if (
         backend_is_tpu
-        and q_seq == 1
         and head_dim % 128 == 0
         and padded_ctx >= _PALLAS_MIN_PADDED_CTX
     ):
-        return "pallas"
+        if q_seq == 1:
+            return "pallas"
+        if 1 < q_seq <= _PALLAS_MAX_MULTIQUERY:
+            return "pallas_mq"
     return "xla"
 
 
@@ -110,6 +119,15 @@ def paged_attention(
         )
 
         return paged_attention_pallas(
+            q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
+            window=window, k_scale=k_scale, v_scale=v_scale,
+        )
+    if impl == "pallas_mq":
+        from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
+            paged_attention_pallas_multiquery,
+        )
+
+        return paged_attention_pallas_multiquery(
             q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
             window=window, k_scale=k_scale, v_scale=v_scale,
         )

@@ -682,6 +682,57 @@ def quantize_kv_pool(pool: jax.Array) -> Tuple[jax.Array, jax.Array]:
     jax.jit,
     static_argnames=("block_size", "window", "interpret"),
 )
+def paged_attention_pallas_multiquery(
+    q: jax.Array,             # [B, S, Nh, D], small S (2..8)
+    k_pool: jax.Array,        # [N, Hkv, Bk, D] (head-major pages, 1 layer)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, M] int32
+    positions: jax.Array,     # [B, S] int32 (-1 = pad)
+    kv_lens: jax.Array,       # [B] int32
+    block_size: int = 16,
+    window: Optional[int] = None,
+    interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,   # [N, Bk, D] bf16 lane-replicated
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Small-q paged attention — the speculative verify pass's multi-query
+    path (q_len = K+1 per slot rather than 1).
+
+    Each of the S queries becomes one decode-kernel row with its own
+    effective context length ``min(position + 1, kv_len)``: causal masking
+    within the chunk falls out of the kernel's existing in-length mask
+    (the chunk's KV rows are already scattered into the pool before
+    attention runs, and chain positions are sequential). Pages re-stage
+    once per query row, which is why dispatch caps S at
+    ``ops.attention._PALLAS_MAX_MULTIQUERY``; masking semantics (causal,
+    in-length, window, padded queries → exact zeros) are identical to
+    ``paged_attention_xla`` over the same chunk."""
+    b, s, nh, d = q.shape
+    hkv = k_pool.shape[1]
+    qf = q.reshape(b * s, 1, nh, d)
+    pos_f = positions.reshape(b * s)
+    tables_f = jnp.repeat(block_tables, s, axis=0)
+    lens_f = jnp.minimum(pos_f + 1, jnp.repeat(kv_lens, s, axis=0))
+    zeros = jnp.zeros((b * s, hkv, d), jnp.bfloat16)
+    out = _call_decode_kernel(
+        qf, zeros, zeros, k_pool[None], v_pool[None], jnp.int32(0),
+        tables_f, pos_f,
+        jnp.full((b * s,), -1, jnp.int32),   # no writes
+        lens_f, block_size, window,
+        fused_write=False, interpret=interpret,
+        k_scale=None if k_scale is None else k_scale[None],
+        v_scale=None if v_scale is None else v_scale[None],
+    )[0]
+    # padded queries must be exact zeros (the XLA contract); inactive
+    # kernel rows may carry stale buffer contents
+    out = jnp.where((pos_f >= 0)[:, None, None, None], out, 0.0)
+    return out.reshape(b, s, nh, d)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "window", "interpret"),
+)
 def paged_attention_pallas(
     q: jax.Array,             # [B, 1, Nh, D]
     k_pool: jax.Array,        # [N, Hkv, Bk, D] (head-major pages, 1 layer)

@@ -104,6 +104,13 @@ class ContinuousBatcher:
         self.engine = engine
         self.cfg = cfg or BatcherConfig()
         self.spec = spec
+        if spec is not None and \
+                getattr(engine.cfg, "speculative", None) is not None:
+            raise ValueError(
+                "engine already speculates in-engine "
+                "(EngineConfig.speculative); attaching a standalone "
+                "SpeculativeDecoder would draft twice — pick one"
+            )
         # (wave, items) while a speculative wave is in flight
         self._spec_wave: Optional[Tuple[Any, List["_QueueItem"]]] = None
         # True while start_wave runs on the executor: the requests are off
@@ -589,6 +596,19 @@ class ContinuousBatcher:
         out["spec_wave_active"] = self._spec_wave is not None
         if self.spec is not None:
             out["spec"] = self.spec.get_stats()
+        if getattr(self.engine.cfg, "speculative", None) is not None:
+            # engine-integrated speculation: every decode round commits
+            # 1..K+1 tokens per slot, so these are THE serving-efficiency
+            # numbers for this batcher (accept-rate, weight-stream
+            # amortization factor)
+            es = self.engine.get_stats()
+            out["spec_integrated"] = {
+                "accept_rate": es.get("spec_accept_rate", 0.0),
+                "tokens_per_step": es.get("spec_tokens_per_step", 0.0),
+                "steps": es.get("spec_steps", 0),
+                "accepted": es.get("spec_accepted", 0),
+                "drafted": es.get("spec_drafted", 0),
+            }
         if out["decode_rounds"]:
             out["avg_occupancy"] = out["occupancy_sum"] / out["decode_rounds"]
         return out

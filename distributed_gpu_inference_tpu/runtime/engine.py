@@ -43,6 +43,11 @@ from distributed_gpu_inference_tpu.runtime.kv_cache import (
     PagedKVCacheManager,
     PendingDeviceOps,
 )
+from distributed_gpu_inference_tpu.runtime.speculative import (
+    SpecDecodeConfig,
+    draft_apply,
+    init_draft_params,
+)
 from distributed_gpu_inference_tpu.utils.data_structures import (
     InferenceRequest,
     InferenceResponse,
@@ -140,6 +145,15 @@ class EngineConfig:
     # prior context read it through the sharded-pool CHUNK op; fresh first
     # chunks keep the cheaper dense path. Sliding-window models fenced.
     kv_seq_sharded: bool = False
+    # engine-integrated speculative decoding: chain drafts (EAGLE-style
+    # head) amortize the per-step weight stream over several accepted
+    # tokens per slot. decode_multi then runs fused draft→verify→accept
+    # steps; each slot commits 1..K+1 tokens per step and slots join/leave
+    # mid-flight exactly as in plain continuous batching. Greedy outputs
+    # are byte-identical to the non-speculative engine (the verify pass is
+    # the target's own argmax); sampled slots ride the same graph at one
+    # token per step. Single-chip only (no mesh).
+    speculative: Optional[SpecDecodeConfig] = None
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -268,6 +282,16 @@ class TPUEngine:
                     f"num_experts {self.model_cfg.num_experts} not "
                     f"divisible by model axis {tp} (EP shards experts)"
                 )
+        if self.cfg.speculative is not None:
+            self.cfg.speculative.validate(self.cfg)
+            if mesh is not None:
+                # the draft head would need its own sharding rules and the
+                # verify chunk its own partitioning; keep the mode
+                # single-chip until that exists
+                raise ValueError(
+                    "speculative decode mode is single-chip: drop the mesh "
+                    "or EngineConfig.speculative"
+                )
         if self.cfg.kv_seq_sharded:
             if self._seq_axis <= 1:
                 raise ValueError(
@@ -340,11 +364,35 @@ class TPUEngine:
         self._dev_core: Optional[Dict[str, jax.Array]] = None
         self._core_dirty = True
 
+        # integrated speculative decoding: EAGLE-style draft head weights +
+        # per-slot last-verified hidden state (device-resident between
+        # rounds, like _dev_core). The hidden starts at zeros for a fresh
+        # slot — the first step then drafts garbage and accepts ~nothing,
+        # which is CORRECT (emission is target-verified regardless of draft
+        # quality) and seeds the real hidden from that verify pass.
+        self._draft_params: Optional[Dict[str, jax.Array]] = None
+        self._dev_spec_h: Optional[jax.Array] = None
+        self._spec_h_zero: set = set()
+        if self.cfg.speculative is not None:
+            sp = self.cfg.speculative
+            self._draft_params = (
+                sp.draft_params if sp.draft_params is not None
+                else init_draft_params(
+                    self.model_cfg, jax.random.PRNGKey(sp.draft_seed),
+                    dtype=self.dtype,
+                )
+            )
+
         self._build_jit_fns()
         self.stats: Dict[str, Any] = {
             "requests": 0, "completed": 0, "generated_tokens": 0,
             "prefill_tokens": 0, "prefill_calls": 0, "decode_calls": 0,
         }
+        if self.cfg.speculative is not None:
+            self.stats.update({
+                "spec_steps": 0, "spec_slot_steps": 0, "spec_drafted": 0,
+                "spec_accepted": 0, "spec_emitted": 0,
+            })
 
     # -------------------------------------------------- sharded weight init
 
@@ -768,6 +816,170 @@ class TPUEngine:
             donate_argnums=(1, 2),
         )
 
+        # --- integrated speculative decoding: R fused draft→verify→accept
+        # rounds per dispatch (lax.scan — the spec analogue of decode_multi's
+        # scan, same per-dispatch RTT amortization; the round-2 lesson from
+        # the standalone decoder was that one host round per tree round
+        # loses to vanilla outright). Per round, the draft head chains K
+        # greedy tokens from the last verified hidden; one multi-query
+        # target pass (q_len = K+1 per slot — ops.attention's small-q path)
+        # verifies them; each slot accepts its longest matching prefix plus
+        # the target's bonus token. Chain positions are sequential, so
+        # accepted KV is already at its final position and a rejected
+        # suffix is dead weight the next round overwrites — no tree
+        # compaction, no KV movement. Per-round records (emission order,
+        # accept counts, active mask) return to the host, which replays
+        # stop/budget bookkeeping EXACTLY as the per-step path would.
+        self._spec_rounds_fn = None
+        if self.cfg.speculative is not None:
+            spec_k = self.cfg.speculative.num_draft_tokens
+
+            def spec_rounds(params, dp, kv, core, h_last, tables, active,
+                            caps, budgets, rounds, mode):
+                # caps[b] = token positions the slot's reserved blocks
+                # cover for the WHOLE dispatch; writes beyond drop to the
+                # pad block, acceptance is clamped, and a row freezes when
+                # its next window no longer fits (host re-reserves next
+                # dispatch). budgets[b] = remaining max_new_tokens.
+                keys, temps = core["keys"], core["temps"]
+                top_ks, top_ps, stops = (
+                    core["top_ks"], core["top_ps"], core["stops"]
+                )
+                offs = jnp.arange(spec_k + 1, dtype=jnp.int32)[None, :]
+
+                def emb(ids):
+                    return llama.embed_tokens(params, ids, cfg)
+
+                def body(carry, _):
+                    kv, lens, pending, h, done, n_emit = carry
+                    act = ~done
+                    b = lens.shape[0]
+                    # ---- draft phase: K-token greedy chain. Draft logits
+                    # go through project_logits (final norm + head) — the
+                    # readout distillation trains against (the round-3
+                    # tied-embedding finding, runtime/speculative.py).
+                    toks = [pending]
+                    hh = h
+                    for _ in range(spec_k):
+                        hh = draft_apply(cfg, dp, hh, emb(toks[-1]))
+                        dl = llama.project_logits(
+                            cfg, params, hh[:, None, :]
+                        )
+                        toks.append(
+                            jnp.argmax(dl[:, 0, :], axis=-1).astype(
+                                jnp.int32
+                            )
+                        )
+                    chunk = jnp.stack(toks, axis=1)              # [B, K+1]
+
+                    # ---- verify phase: one target pass over the chain.
+                    # t0 (the pending token) commits its KV exactly as a
+                    # vanilla step would; drafts write ahead of
+                    # verification into reserved blocks.
+                    pos = lens[:, None] + offs
+                    pos = jnp.where(
+                        act[:, None] & (pos < caps[:, None]), pos, -1
+                    )
+                    kv_lens_after = jnp.where(
+                        act, lens + spec_k + 1, 0
+                    ).astype(jnp.int32)
+                    out = llama.forward_chunk(
+                        cfg, params, chunk, pos, kv, tables, kv_lens_after,
+                        block_size=bs, last_only=False, allow_fused=False,
+                    )
+                    target_pred = jnp.argmax(out.logits, axis=-1).astype(
+                        jnp.int32
+                    )                                            # [B, K+1]
+
+                    # ---- acceptance: longest matching prefix (greedy
+                    # match), clamped so committed + pending stays inside
+                    # block coverage
+                    match = (chunk[:, 1:] == target_pred[:, :-1]).astype(
+                        jnp.int32
+                    )
+                    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                    n_acc = jnp.minimum(
+                        n_acc, jnp.maximum(caps - lens - 2, 0)
+                    )
+                    bonus = jnp.take_along_axis(
+                        target_pred, n_acc[:, None], axis=1
+                    )[:, 0]
+                    if mode == "mixed":
+                        # sampled slots ride the same graph at one token
+                        # per round: sample from the pending token's logits
+                        # exactly as a vanilla step would (same key fold
+                        # position), never accept drafts
+                        sampled0 = sample_tokens_per_slot(
+                            out.logits[:, 0, :], keys, lens + 1, temps,
+                            top_ks, top_ps,
+                        )
+                        is_sampled = temps > 0.0
+                        n_acc = jnp.where(is_sampled, 0, n_acc)
+                        bonus = jnp.where(is_sampled, sampled0, bonus)
+
+                    # ---- ordered emission [B, K+1]: accepted drafts then
+                    # the bonus; -1 pads the rejected tail. The host
+                    # replays stop/budget truncation from this record; the
+                    # device mirrors it below only to gate later rounds.
+                    acc_pad = jnp.concatenate(
+                        [chunk[:, 1:], jnp.zeros((b, 1), jnp.int32)],
+                        axis=1,
+                    )
+                    emitted = jnp.where(
+                        offs < n_acc[:, None], acc_pad,
+                        jnp.where(offs == n_acc[:, None],
+                                  bonus[:, None], -1),
+                    )
+                    emitted = jnp.where(act[:, None], emitted, -1)
+
+                    # ---- device stop/budget masking (gates later rounds;
+                    # same construction as the tree decoder's scan)
+                    is_stop = (
+                        (emitted[:, :, None] == stops[:, None, :]).any(-1)
+                        & (emitted >= 0)
+                    )
+                    cum = jnp.cumsum(is_stop.astype(jnp.int32), axis=1)
+                    pre_stop = (cum - is_stop.astype(jnp.int32)) == 0
+                    emit_j = (emitted >= 0) & pre_stop & ~is_stop
+                    rank = jnp.cumsum(emit_j.astype(jnp.int32), axis=1) \
+                        - emit_j.astype(jnp.int32)
+                    emit_mask = emit_j & (
+                        n_emit[:, None] + rank < budgets[:, None]
+                    )
+                    n_emit2 = n_emit + emit_mask.sum(axis=1)
+                    stop_hit = (is_stop & pre_stop).any(axis=1)
+
+                    # ---- advance slot state; freeze rows whose next
+                    # window no longer fits the reservation
+                    new_h = jnp.take_along_axis(
+                        out.hidden, n_acc[:, None, None].astype(jnp.int32),
+                        axis=1,
+                    )[:, 0, :]
+                    lens2 = jnp.where(act, lens + n_acc + 1, lens)
+                    pending2 = jnp.where(act, bonus, pending)
+                    h2 = jnp.where(act[:, None], new_h, h)
+                    done2 = done | (
+                        act & (stop_hit | (n_emit2 >= budgets)
+                               | (lens2 + 2 > caps))
+                    )
+                    rec = (emitted, n_acc, act)
+                    return (out.kv, lens2, pending2, h2, done2, n_emit2), rec
+
+                (kv, lens, pending, h_last, _done, _n), recs = jax.lax.scan(
+                    body,
+                    (kv, core["lens"], core["last"], h_last, ~active,
+                     jnp.zeros_like(core["lens"])),
+                    None, length=rounds,
+                )
+                core = dict(core)
+                core["lens"], core["last"] = lens, pending
+                return kv, core, h_last, recs
+
+            self._spec_rounds_fn = jax.jit(
+                spec_rounds, static_argnames=("rounds", "mode"),
+                donate_argnums=(2, 3, 4),
+            )
+
         def apply_ops(kv, srcs, dsts):
             # page copies (CoW): dst = -1 entries are dropped. Scale pools
             # (int8 KV) copy with their pages — a page without its scale is
@@ -830,9 +1042,31 @@ class TPUEngine:
 
     def _invalidate_device_state(self) -> None:
         """A failed donated call may have consumed the device core buffers —
-        rebuild from host mirrors on next use."""
+        rebuild from host mirrors on next use. The speculative draft hidden
+        rebuilds as zeros: that only lowers the next step's acceptance,
+        never correctness (emission is always target-verified)."""
         self._dev_core = None
         self._core_dirty = True
+        self._dev_spec_h = None
+
+    def _spec_h_device(self) -> jax.Array:
+        """Per-slot last-verified hidden for the draft head, device-resident
+        between rounds; rebinds/invalidations zero the affected rows."""
+        if self._dev_spec_h is None:
+            self._dev_spec_h = jnp.zeros(
+                (len(self.slots), self.model_cfg.hidden_size), self.dtype
+            )
+            self._spec_h_zero.clear()
+        elif self._spec_h_zero:
+            # fixed-shape mask multiply, NOT .at[rows].set — a dynamic row
+            # list would compile one scatter per distinct stale-set size
+            keep = np.ones((len(self.slots), 1), np.float32)
+            keep[sorted(self._spec_h_zero)] = 0.0
+            self._dev_spec_h = self._dev_spec_h * jnp.asarray(
+                keep, self.dtype
+            )
+            self._spec_h_zero.clear()
+        return self._dev_spec_h
 
     def _apply_pending(self) -> None:
         ops = self.manager.take_pending_ops()
@@ -1189,6 +1423,11 @@ class TPUEngine:
                 0, 2**32, size=2, dtype=np.uint32
             )
         self._core_dirty = True
+        if self.cfg.speculative is not None:
+            # fresh occupant: its draft feature starts at zeros (stale
+            # hidden would only cost acceptance, never correctness — but
+            # deterministic stats want a clean start)
+            self._spec_h_zero.add(slot)
         self.stats["requests"] += 1
 
     def _submit_allocated(self, request: InferenceRequest, slot: int,
@@ -1469,11 +1708,161 @@ class TPUEngine:
             self._record_token(i, tok, device_synced=True)
         return out
 
+    def spec_decode_step(self) -> Dict[int, List[int]]:
+        """One speculative round for all active slots: draft K tokens per
+        slot, verify the chain in one multi-query target pass, commit each
+        slot's accepted prefix + bonus (1..K+1 tokens). Returns
+        {slot: emitted_tokens} with the same contract as ``decode_multi``
+        (a stop token appears in the list, then the slot finishes)."""
+        return self._spec_decode_rounds(1)
+
+    def _spec_decode_rounds(self, num_steps: int) -> Dict[int, List[int]]:
+        """ONE fused dispatch of up to ``num_steps`` draft→verify→accept
+        rounds (a lax.scan with device-resident done/budget/stop state —
+        the same per-dispatch amortization decode_multi's scan buys vanilla
+        decode). Rounds bucket to powers of two so at most log2 variants
+        compile; per-round records replay on the host so cache-manager
+        commits and emission bookkeeping exactly match the per-step path."""
+        spec = self.cfg.speculative
+        assert spec is not None and self._spec_rounds_fn is not None
+        k = spec.num_draft_tokens
+        active = [
+            i for i, s in enumerate(self.slots)
+            if s is not None and s.finish_reason is None and not s.prefilling
+        ]
+        if not active:
+            return {}
+        b = len(self.slots)
+        active_mask = np.zeros(b, dtype=bool)
+        caps = np.zeros(b, dtype=np.int32)
+        budgets = np.zeros(b, dtype=np.int32)
+        for i in active:
+            s = self.slots[i]
+            budgets[i] = max(
+                s.request.sampling.max_new_tokens - len(s.generated), 0
+            )
+        active = [i for i in active if budgets[i] > 0]
+        if not active:
+            return {}
+        # every active round commits >= 1 token per slot, so rounds beyond
+        # the largest remaining budget are dead weight; bucket to a power
+        # of two so the compiled scan-length set stays logarithmic
+        rounds = max(1, min(int(num_steps),
+                            int(max(budgets[i] for i in active))))
+        rounds = 1 << (rounds.bit_length() - 1)
+        for i in active:
+            s = self.slots[i]
+            # reserve the dispatch's worst case up front — the device
+            # cannot allocate mid-scan: commits are bounded by
+            # min(rounds*(K+1), budget), plus K+1 so the final round's full
+            # window and the post-dispatch pending token stay covered.
+            # Near max_seq_len the window shrinks and the in-graph clamp +
+            # freeze honor the smaller cap.
+            cur = len(self.manager.seq_tokens[s.seq_id])
+            want = min(rounds * (k + 1), int(budgets[i])) + k + 1
+            n_res = max(min(want, self.cfg.max_seq_len - cur), 0)
+            if n_res > 0 and self.manager.reserve_tokens(s.seq_id, n_res):
+                # table rebuild only when the reservation actually added
+                # blocks (or CoW'd a shared tail)
+                self._block_tables[i] = self.manager.block_table_for(
+                    s.seq_id, self.cfg.max_blocks_per_seq
+                )
+            active_mask[i] = True
+            caps[i] = cur + n_res
+        self._apply_pending()
+        core = self._sync_core()
+        h_last = self._spec_h_device()
+        tables, act_d, caps_d = self._sched_arrays(active_mask, caps)
+        mode = self._decode_mode()
+        try:
+            (self.kv, self._dev_core, self._dev_spec_h,
+             recs) = self._spec_rounds_fn(
+                self.params, self._draft_params, self.kv, core, h_last,
+                tables, act_d, caps_d, jnp.asarray(budgets), rounds, mode,
+            )
+        except Exception:
+            self._invalidate_device_state()
+            raise
+        rec_emit, rec_nacc, rec_act = (np.asarray(r) for r in recs)
+        self.stats["decode_calls"] += rounds
+        out: Dict[int, List[int]] = {}
+        for r in range(rounds):
+            act = rec_act[r]
+            if not act.any():
+                break
+            self.stats["spec_steps"] += 1
+            for i in active:
+                if not act[i]:
+                    continue
+                s = self.slots[i]
+                if s is None or s.finish_reason is not None:
+                    continue
+                a = int(rec_nacc[r, i])
+                # the device committed t0..t_a (fed in the verify pass)
+                self._kv_lens[i] += a + 1
+                if self._temps[i] <= 0.0:
+                    # efficiency counters describe SPECULATING slots only:
+                    # sampled slots never accept drafts by design, and
+                    # counting their forced zeros would dilute the exported
+                    # accept-rate/tokens-per-step gauges under mixed traffic
+                    self.stats["spec_slot_steps"] += 1
+                    self.stats["spec_drafted"] += k
+                    self.stats["spec_accepted"] += a
+                    self.stats["spec_emitted"] += a + 1
+                commit: List[int] = []
+                for t in rec_emit[r, i]:
+                    if t < 0 or s.finish_reason is not None:
+                        break
+                    out.setdefault(i, []).append(int(t))
+                    self._record_token(i, int(t), already_committed=True,
+                                       device_synced=True)
+                    if s.finish_reason is None:
+                        # committed-or-pending-with-reserved-block, exactly
+                        # as decode_multi's bookkeeping (stop/length
+                        # trigger excluded)
+                        commit.append(int(t))
+                self.manager.commit_tokens(s.seq_id, commit)
+        for i in active:
+            s = self.slots[i]
+            if s is None:
+                continue
+            # precise rollback of the rejected windows: drop reserved
+            # blocks acceptance never reached, so the footprint matches a
+            # never-speculated per-step engine
+            if self.manager.trim_reserved(s.seq_id):
+                self._block_tables[i] = self.manager.block_table_for(
+                    s.seq_id, self.cfg.max_blocks_per_seq
+                )
+            self._maybe_release_window(i)
+        return out
+
+    def distill_draft(self, steps: int = 400, **kw: Any) -> None:
+        """Distill the integrated draft head against this engine's own
+        target weights (runtime.speculative.distill_draft_params) —
+        acceptance goes from ~0 (random head) to task-dependent useful."""
+        if self.cfg.speculative is None:
+            raise ValueError("engine has no speculative config to distill")
+        from distributed_gpu_inference_tpu.runtime.speculative import (
+            distill_draft_params,
+        )
+
+        self._draft_params = distill_draft_params(
+            self.model_cfg, self.params,
+            jax.random.PRNGKey(self.cfg.speculative.draft_seed),
+            steps=steps, **kw,
+        )
+
     def decode_multi(self, num_steps: Optional[int] = None) -> Dict[int, List[int]]:
         """Run T decode steps in one device call (lax.scan) with on-device
         stop masking; host sees tokens only at the end. TPU-first throughput
-        path — amortizes per-token host round-trips."""
+        path — amortizes per-token host round-trips.
+
+        With ``EngineConfig.speculative`` set, the T steps are fused
+        draft→verify→accept rounds instead — each commits 1..K+1 tokens per
+        slot, amortizing the weight stream over the accepted tokens."""
         num_steps = num_steps or self.cfg.multi_step
+        if self.cfg.speculative is not None:
+            return self._spec_decode_rounds(int(num_steps))
         active_mask = np.array(
             [s is not None and s.finish_reason is None and not s.prefilling
              for s in self.slots]
@@ -1597,4 +1986,16 @@ class TPUEngine:
         out = dict(self.stats)
         out["kv_cache"] = self.manager.get_stats()
         out["active_slots"] = self.num_active
+        if self.cfg.speculative is not None:
+            drafted = out.get("spec_drafted", 0)
+            slot_steps = out.get("spec_slot_steps", 0)
+            out["spec_accept_rate"] = (
+                out.get("spec_accepted", 0) / drafted if drafted else 0.0
+            )
+            # tokens emitted per slot per verify step (1..K+1): the weight-
+            # stream amortization factor the mode exists for
+            out["spec_tokens_per_step"] = (
+                out.get("spec_emitted", 0) / slot_steps if slot_steps
+                else 0.0
+            )
         return out

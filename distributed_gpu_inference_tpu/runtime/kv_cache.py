@@ -646,6 +646,29 @@ class PagedKVCacheManager:
             raise
         return added
 
+    def trim_reserved(self, seq_id: str) -> List[int]:
+        """Release trailing reserved blocks beyond what the sequence's
+        tokens (committed + pending) occupy — the precise rollback after a
+        partially rejected speculative verify window. Leaves the sequence
+        holding exactly ``ceil(len(seq_tokens)/block_size)`` blocks, i.e.
+        the same footprint a never-speculated per-step engine keeps.
+        Returns the freed block ids (the engine refreshes its block-table
+        mirror; device state never reads the trimmed tail — its positions
+        are beyond the committed length)."""
+        blocks = self.seq_blocks[seq_id]
+        needed = max(1, -(-len(self.seq_tokens[seq_id]) // self.block_size))
+        freed: List[int] = []
+        while len(blocks) > needed:
+            bid = blocks.pop()
+            meta = self.metas.get(bid)
+            # reserved tail blocks are exclusively owned and unindexed, but
+            # go through decref/_deactivate_block so an unexpected share
+            # can never be force-freed
+            if meta is not None and meta.decref() == 0:
+                self._deactivate_block(bid)
+            freed.append(bid)
+        return freed
+
     def commit_tokens(self, seq_id: str, token_ids: Sequence[int]) -> None:
         """Record tokens whose KV was written on-device into already-reserved
         blocks (the multi-step decode path's post-scan bookkeeping)."""

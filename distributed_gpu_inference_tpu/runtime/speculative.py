@@ -112,6 +112,83 @@ class TreeTopology:
 
 
 @dataclass
+class SpecDecodeConfig:
+    """Engine-integrated speculative decoding (``TPUEngine`` decode mode).
+
+    Unlike :class:`SpeculativeConfig` (the standalone tree decoder), this
+    drives CHAIN drafts inside the continuous-batching engine: every active
+    slot drafts ``num_draft_tokens`` greedily with the EAGLE-style head,
+    then ONE multi-query target pass (q_len = K+1 per slot) verifies the
+    chain and each slot commits 1..K+1 tokens. Chain positions are
+    sequential, so accepted KV is already in place and a rejected suffix is
+    simply overwritten by the next step — no tree compaction, and it
+    composes with prefix caching, CoW, int8 KV, and sliding windows.
+    """
+
+    # K drafted tokens per slot per step; the verify pass scores K+1
+    # queries. Keep K+1 <= 8 on TPU so dispatch stays on the small-q Pallas
+    # path (ops.attention.resolve_impl) instead of the prefill gather.
+    num_draft_tokens: int = 4
+    # EAGLE-style head weights (init_draft_params layout). None = random
+    # init from ``draft_seed`` — near-zero acceptance but still CORRECT
+    # (greedy outputs are target-verified regardless of draft quality);
+    # distill with ``TPUEngine.distill_draft`` / distill_draft_params.
+    draft_params: Optional[Dict[str, jax.Array]] = None
+    draft_seed: int = 1
+
+    def validate(self, engine_cfg: Any) -> None:
+        """Reject configs whose worst-case per-step block growth cannot fit
+        the engine's per-sequence block table. A step writes K+1 new KV
+        rows and keeps one pending token, so the worst case touches
+        ``ceil((K+2)/block_size) + 1`` blocks (straddle) on top of nothing —
+        that must fit ``max_blocks_per_seq`` or the very first speculative
+        step on a fresh sequence would outgrow its table."""
+        k = self.num_draft_tokens
+        if k < 1:
+            raise ValueError(
+                f"SpecDecodeConfig.num_draft_tokens={k}: need at least 1 "
+                "drafted token (0 would be vanilla decode — disable "
+                "speculative instead)"
+            )
+        from distributed_gpu_inference_tpu.ops.attention import (
+            _PALLAS_MAX_MULTIQUERY,
+        )
+
+        if k + 1 > _PALLAS_MAX_MULTIQUERY:
+            # a silent fall-through to the prefill-shaped gather would
+            # erase the speedup the mode exists for — the same no-silent-
+            # fallback stance as resolve_impl's exposure to bench.py
+            raise ValueError(
+                f"SpecDecodeConfig.num_draft_tokens={k}: the verify pass "
+                f"(q_len = K+1 = {k + 1}) would leave the small-q Pallas "
+                f"path (max {_PALLAS_MAX_MULTIQUERY} queries/slot, "
+                "ops.attention.resolve_impl) and decode through the "
+                "prefill-shaped gather on TPU; num_draft_tokens is the "
+                f"limiting field — keep it <= {_PALLAS_MAX_MULTIQUERY - 1}"
+            )
+        bs = engine_cfg.block_size
+        m = engine_cfg.max_blocks_per_seq
+        # per-step worst case: K+1 fed tokens + 1 pending bonus, straddling
+        # a block boundary
+        growth = -(-(k + 2) // bs) + 1
+        if growth > m:
+            raise ValueError(
+                f"SpecDecodeConfig.num_draft_tokens={k}: worst-case "
+                f"per-step block growth {growth} exceeds max_blocks_per_seq="
+                f"{m} (max_seq_len={engine_cfg.max_seq_len} / block_size="
+                f"{bs}); num_draft_tokens is the limiting field — reduce it "
+                "or raise max_seq_len"
+            )
+        if k + 2 >= engine_cfg.max_seq_len:
+            raise ValueError(
+                f"SpecDecodeConfig.num_draft_tokens={k}: a verify window of "
+                f"{k + 1} tokens does not fit max_seq_len="
+                f"{engine_cfg.max_seq_len}; num_draft_tokens is the "
+                "limiting field"
+            )
+
+
+@dataclass
 class SpeculativeConfig:
     """Reference SpeculativeConfig:28 analogue."""
 
@@ -135,6 +212,36 @@ class SpeculativeConfig:
     # draft gains a learned [k*H, H] input projection; verify forwards
     # collect the same layers so the recursion stays consistent.
     feature_layers: Optional[Tuple[int, ...]] = None
+
+    def validate_blocks(self, max_blocks_per_seq: int,
+                        block_size: int) -> None:
+        """Reject width/depth combinations whose worst-case per-round block
+        growth (the verify tree — including adaptive depth growth — plus
+        the pending root) exceeds the per-sequence block table: the first
+        round of a fresh sequence would outgrow it mid-flight otherwise."""
+        widths = tuple(self.widths)
+        if not widths or any(w < 1 for w in widths):
+            raise ValueError(
+                f"SpeculativeConfig.widths={self.widths}: every tree level "
+                "needs width >= 1; widths is the limiting field"
+            )
+        worst = widths
+        if self.adaptive:
+            worst = worst + (1,) * max(0, self.max_depth - len(worst))
+        nodes = TreeTopology(worst).num_nodes
+        growth = -(-(nodes + 1) // block_size) + 1
+        if growth > max_blocks_per_seq:
+            adapt = (
+                f" (adaptive depth growth to max_depth={self.max_depth})"
+                if self.adaptive else ""
+            )
+            raise ValueError(
+                f"SpeculativeConfig.widths={self.widths}{adapt}: worst-case "
+                f"verify tree of {nodes} nodes needs {growth} blocks per "
+                f"round, exceeding max_blocks_per_seq={max_blocks_per_seq} "
+                f"(block_size={block_size}); widths/max_depth are the "
+                "limiting fields"
+            )
 
 
 # ---------------------------------------------------------------------------
@@ -496,6 +603,7 @@ class SpeculativeDecoder:
         self.max_batch_size = max_batch_size
         self.max_seq_len = max_seq_len
         self.max_blocks_per_seq = -(-max_seq_len // block_size)
+        self.spec_cfg.validate_blocks(self.max_blocks_per_seq, block_size)
         self.num_blocks = num_blocks or int(
             max_batch_size * self.max_blocks_per_seq * 1.5
         ) + 1

@@ -273,6 +273,12 @@ async def heartbeat(request: web.Request) -> web.Response:
         await st.reliability.start_session(worker_id)
     await st.store.update_worker(worker_id, **fields)
     await st.reliability.update_online_pattern(worker_id, online=True)
+    es = body.get("engine_stats")
+    if isinstance(es, dict):
+        # speculation-efficiency counters ride the heartbeat (worker
+        # main._spec_engine_stats) → /metrics surfaces accept-rate and
+        # tokens-per-step per worker
+        st.metrics.record_spec_engine(worker_id, es)
     client_version = int(body.get("config_version") or 0)
     changed = await st.worker_config.config_changed_since(
         worker_id, client_version

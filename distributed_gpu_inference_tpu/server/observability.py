@@ -77,6 +77,9 @@ class Metrics:
                 "kv_cache_evictions", "worker_status", "hbm_used_bytes",
                 "hop_latency", "kv_migration_latency", "batch_size",
                 "queue_size", "spec_accept_rate", "spec_speedup",
+                "spec_accepted_tokens", "spec_drafted_tokens",
+                "spec_decode_steps", "spec_worker_accept_rate",
+                "spec_worker_tokens_per_step",
             ):
                 setattr(self, name, noop)
             return
@@ -120,6 +123,25 @@ class Metrics:
             "speculative_accept_rate", "Draft token accept rate", registry=r)
         self.spec_speedup = Gauge(
             "speculative_speedup", "Tokens per verify step", registry=r)
+        # per-worker speculation efficiency (engine-integrated decode mode):
+        # counters scrape-delta cleanly into fleet accept-rate / tokens-per-
+        # step panels; the gauges mirror the engine's own derived numbers
+        self.spec_accepted_tokens = Counter(
+            "speculative_accepted_tokens_total",
+            "Accepted draft tokens", ["worker"], registry=r)
+        self.spec_drafted_tokens = Counter(
+            "speculative_drafted_tokens_total",
+            "Drafted tokens offered to verification", ["worker"], registry=r)
+        self.spec_decode_steps = Counter(
+            "speculative_decode_steps_total",
+            "Per-slot speculative verify steps", ["worker"], registry=r)
+        self.spec_worker_accept_rate = Gauge(
+            "speculative_worker_accept_rate",
+            "Draft token accept rate per worker", ["worker"], registry=r)
+        self.spec_worker_tokens_per_step = Gauge(
+            "speculative_worker_tokens_per_step",
+            "Committed tokens per verify step per worker (weight-stream "
+            "amortization factor)", ["worker"], registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -133,6 +155,9 @@ class MetricsCollector:
     def __init__(self, metrics: Optional[Metrics] = None) -> None:
         self.metrics = metrics or Metrics()
         self._tok_window: list[tuple[float, int]] = []
+        # last-seen cumulative spec counters per worker: engines report
+        # monotonic totals, Prometheus counters advance by deltas
+        self._spec_prev: Dict[str, Dict[str, int]] = {}
 
     def record_request(self, job_type: str, status: str,
                        latency_s: Optional[float] = None) -> None:
@@ -177,6 +202,41 @@ class MetricsCollector:
                            tokens_per_step: float) -> None:
         self.metrics.spec_accept_rate.set(accept_rate)
         self.metrics.spec_speedup.set(tokens_per_step)
+
+    def record_spec_engine(self, worker: str,
+                           engine_stats: Dict[str, Any]) -> None:
+        """Ingest one worker engine's speculative counters
+        (``TPUEngine.get_stats()`` — spec_accepted / spec_drafted /
+        spec_slot_steps totals plus the derived rate/amortization gauges)
+        so ``/metrics`` surfaces speculation efficiency per worker. Safe to
+        call with stats from a non-speculative engine (no-op counters)."""
+        prev = self._spec_prev.setdefault(worker, {})
+        for key, metric in (
+            ("spec_accepted", self.metrics.spec_accepted_tokens),
+            ("spec_drafted", self.metrics.spec_drafted_tokens),
+            ("spec_slot_steps", self.metrics.spec_decode_steps),
+        ):
+            try:
+                cur = int(engine_stats.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                # worker-supplied payload: one malformed field must degrade
+                # to a skipped sample, never 500 the heartbeat (a failing
+                # heartbeat gets a LIVE worker swept offline)
+                continue
+            delta = cur - prev.get(key, 0)
+            if delta > 0:
+                metric.labels(worker).inc(delta)
+            # an engine restart resets totals — re-anchor instead of
+            # emitting a bogus negative/huge delta
+            prev[key] = cur
+        if "spec_accept_rate" in engine_stats:
+            try:
+                rate = float(engine_stats.get("spec_accept_rate") or 0.0)
+                tps = float(engine_stats.get("spec_tokens_per_step") or 0.0)
+            except (TypeError, ValueError):
+                return
+            self.metrics.spec_worker_accept_rate.labels(worker).set(rate)
+            self.metrics.spec_worker_tokens_per_step.labels(worker).set(tps)
 
     def render(self) -> bytes:
         return self.metrics.render()

@@ -95,8 +95,12 @@ class PDFlowService:
         for child_id in (f"{parent_id}-prefill", f"{parent_id}-decode"):
             child = await self.store.get_job(child_id)
             if child is not None and child["status"] == "queued":
-                await self.store.update_job(
-                    child_id, status="cancelled", completed_at=time.time(),
+                # conditional transition: a pinned worker may claim/finish
+                # the child between the read and this write, and a terminal
+                # status must never be clobbered back to CANCELLED
+                await self.store.try_transition_job(
+                    child_id, "queued", status="cancelled",
+                    completed_at=time.time(),
                 )
 
     async def on_job_permanently_failed(self, job: Dict[str, Any]) -> None:

@@ -127,6 +127,35 @@ class TPULLMEngine(LLMBaseEngine):
                 ttl_s=float(self.config.get("kv_remote_ttl_s", 3600.0)),
             ),
         )
+        # engine-INTEGRATED speculative decoding (EngineConfig.speculative):
+        # every decode round runs fused draft→verify→accept steps committing
+        # 1..K+1 tokens per slot — unlike engine=jax-speculative below,
+        # which routes a SUBSET of requests to a standalone tree decoder.
+        # Greedy outputs stay byte-identical; sampled requests ride the same
+        # graph at one token per step.
+        if self.config.get("speculative_decode"):
+            from ...runtime.speculative import SpecDecodeConfig
+
+            try:
+                eng_cfg.speculative = SpecDecodeConfig(
+                    num_draft_tokens=int(
+                        self.config.get("spec_num_draft_tokens", 4)
+                    ),
+                )
+                eng_cfg.speculative.validate(eng_cfg)
+            except (ValueError, TypeError) as exc:
+                raise EngineLoadError(
+                    f"speculative_decode config invalid: {exc}"
+                ) from exc
+            if self.config.get("engine") in ("jax-speculative",
+                                             "speculative"):
+                # config-only conflict: fail BEFORE weights load / the
+                # draft head distills, not after minutes of work
+                raise EngineLoadError(
+                    "speculative_decode (engine-integrated) and "
+                    "engine=jax-speculative (standalone tree decoder) are "
+                    "mutually exclusive — pick one"
+                )
         # first-class TP: tp_size > 1 builds a model-axis mesh over local
         # devices (the reference forwarded tensor_parallel_size to vLLM;
         # here the engine itself shards, llm_vllm.py:56 / SURVEY §2.2)
@@ -157,6 +186,19 @@ class TPULLMEngine(LLMBaseEngine):
             # invalid mesh/model combination must drop the task type, not
             # kill worker startup (load_engines catches EngineLoadError)
             raise EngineLoadError(str(exc)) from exc
+        if eng_cfg.speculative is not None and \
+                int(self.config.get("spec_distill_steps", 0)) > 0:
+            # optional on-load draft distillation against the engine's own
+            # target weights; a random head is still correct, just ~0
+            # acceptance, so failures here must not kill the task type
+            try:
+                self.engine.distill_draft(
+                    steps=int(self.config["spec_distill_steps"])
+                )
+            except Exception as exc:  # noqa: BLE001 — optax absent, OOM, ...
+                raise EngineLoadError(
+                    f"speculative draft distillation failed: {exc}"
+                ) from exc
         # engine=jax-speculative: short-prompt greedy requests route through
         # the EAGLE-style tree decoder (shares the TARGET weights with the
         # paged engine but owns its own KV pool — sized to exactly one
@@ -642,7 +684,13 @@ class TPULLMEngine(LLMBaseEngine):
                 if cancel is not None and cancel.is_set():
                     s.finish_reason = s.finish_reason or "abort"
                     break
-                self.engine.decode_step()
+                if self.engine.cfg.speculative is not None:
+                    # one draft→verify→accept round per flush: up to K+1
+                    # tokens reach the stream per device round instead of 1
+                    # (same emission contract incl. stop handling)
+                    self.engine.spec_decode_step()
+                else:
+                    self.engine.decode_step()
         finally:
             resp = self.engine.finish_slot(slot)
         yield {

@@ -291,8 +291,42 @@ class Worker:
 
     # -- heartbeat (reference main.py:263-311) -------------------------------
 
+    def _spec_engine_stats(self) -> Optional[Dict[str, Any]]:
+        """Speculation-efficiency counters of any engine running the
+        integrated speculative decode mode — ride the heartbeat so the
+        control plane's ``/metrics`` surfaces accept-rate and tokens-per-
+        step per worker. None when nothing speculates (no payload bloat)."""
+        out: Dict[str, Any] = {}
+        for eng in self.engines.values():
+            core = getattr(eng, "engine", None)
+            if core is None or \
+                    getattr(getattr(core, "cfg", None), "speculative",
+                            None) is None:
+                continue
+            s = core.get_stats()
+            for k in ("spec_accepted", "spec_drafted", "spec_slot_steps",
+                      "spec_emitted"):
+                out[k] = out.get(k, 0) + int(s.get(k, 0) or 0)
+        if not out:
+            return None
+        # rates derived from the SUMMED counters so the gauges always agree
+        # with the counter ratios when several engines speculate
+        out["spec_accept_rate"] = (
+            out["spec_accepted"] / out["spec_drafted"]
+            if out.get("spec_drafted") else 0.0
+        )
+        out["spec_tokens_per_step"] = (
+            out["spec_emitted"] / out["spec_slot_steps"]
+            if out.get("spec_slot_steps") else 0.0
+        )
+        return out
+
     def _heartbeat_once(self) -> None:
         try:
+            extra: Dict[str, Any] = {}
+            spec_stats = self._spec_engine_stats()
+            if spec_stats:
+                extra["engine_stats"] = spec_stats
             resp = self.api.heartbeat(
                 status=self.state.value,
                 config_version=self.config.config_version,
@@ -305,6 +339,7 @@ class Worker:
                     k: self.stats[k]
                     for k in ("jobs_completed", "jobs_failed")
                 },
+                **extra,
             )
             self.stats["heartbeats"] += 1
             if resp.get("stale_job") and self.current_job_id:

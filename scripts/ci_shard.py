@@ -20,6 +20,7 @@ HEAVY = [
     "tests/test_chaos_scenarios.py",     # 50-seed replays per scenario
     "tests/test_parallel_pipeline.py",
     "tests/test_parallel_ring_attention.py",
+    "tests/test_engine_spec_integrated.py",  # spec scan graphs x 2 engines
     "tests/test_model_moe.py",
     "tests/test_kv_handoff_stream.py",
     "tests/test_engine_tp.py",

@@ -1,0 +1,157 @@
+"""Speculative config validation + observability export (fast, no jit).
+
+- SpecDecodeConfig (engine-integrated chain mode) rejects draft depths
+  whose worst-case per-step block growth exceeds max_blocks_per_seq, with
+  the limiting field named.
+- The tree SpeculativeConfig gets the same screen per verify round.
+- MetricsCollector.record_spec_engine exports per-worker accept-rate and
+  tokens-per-step counters for /metrics.
+"""
+
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.engine import EngineConfig
+from distributed_gpu_inference_tpu.runtime.speculative import (
+    SpecDecodeConfig,
+    SpeculativeConfig,
+)
+
+
+def test_spec_decode_config_accepts_sane_depth():
+    cfg = EngineConfig(max_batch_size=2, max_seq_len=128, block_size=16)
+    SpecDecodeConfig(num_draft_tokens=4).validate(cfg)
+    SpecDecodeConfig(num_draft_tokens=7).validate(cfg)
+
+
+def test_spec_decode_config_rejects_zero_depth():
+    cfg = EngineConfig(max_batch_size=2, max_seq_len=128, block_size=16)
+    with pytest.raises(ValueError, match="num_draft_tokens"):
+        SpecDecodeConfig(num_draft_tokens=0).validate(cfg)
+
+
+def test_spec_decode_config_rejects_depth_beyond_small_q_path():
+    # K=8 would push the verify pass (q_len=9) off the Pallas small-q path
+    # onto the prefill-shaped gather on TPU — a silent perf cliff
+    cfg = EngineConfig(max_batch_size=2, max_seq_len=128, block_size=16)
+    with pytest.raises(ValueError, match="num_draft_tokens"):
+        SpecDecodeConfig(num_draft_tokens=8).validate(cfg)
+
+
+def test_spec_decode_config_rejects_block_growth_overflow():
+    # max_seq_len 8 / block 2 -> 4 blocks per sequence; a 7-token draft
+    # window could touch ceil(9/2)+1 = 6 blocks per step
+    cfg = EngineConfig(max_batch_size=2, max_seq_len=8, block_size=2)
+    with pytest.raises(ValueError) as ei:
+        SpecDecodeConfig(num_draft_tokens=7).validate(cfg)
+    msg = str(ei.value)
+    assert "num_draft_tokens" in msg          # the limiting field, by name
+    assert "max_blocks_per_seq" in msg
+
+
+def test_spec_decode_config_rejects_window_beyond_context():
+    cfg = EngineConfig(max_batch_size=2, max_seq_len=8, block_size=4)
+    with pytest.raises(ValueError, match="num_draft_tokens"):
+        SpecDecodeConfig(num_draft_tokens=7).validate(cfg)
+
+
+def test_engine_ctor_validates_spec_config():
+    from distributed_gpu_inference_tpu.runtime.engine import TPUEngine
+
+    with pytest.raises(ValueError, match="num_draft_tokens"):
+        TPUEngine(
+            "llama3-tiny",
+            EngineConfig(max_batch_size=1, max_seq_len=32, block_size=16,
+                         prefill_buckets=(16,),
+                         speculative=SpecDecodeConfig(num_draft_tokens=40)),
+        )
+
+
+def test_tree_config_rejects_block_growth_overflow():
+    spec = SpeculativeConfig(widths=(8, 8, 8), adaptive=False)
+    with pytest.raises(ValueError) as ei:
+        spec.validate_blocks(max_blocks_per_seq=2, block_size=16)
+    msg = str(ei.value)
+    assert "widths" in msg
+    assert "max_blocks_per_seq" in msg
+
+
+def test_tree_config_counts_adaptive_growth():
+    # widths fit as configured but adaptive depth growth overflows
+    spec = SpeculativeConfig(widths=(8, 8), adaptive=True, max_depth=4)
+    spec.validate_blocks(max_blocks_per_seq=32, block_size=16)
+    with pytest.raises(ValueError, match="max_depth"):
+        spec.validate_blocks(max_blocks_per_seq=5, block_size=16)
+
+
+def test_tree_config_rejects_zero_width():
+    with pytest.raises(ValueError, match="widths"):
+        SpeculativeConfig(widths=(4, 0)).validate_blocks(8, 16)
+
+
+def test_decoder_ctor_validates_widths():
+    from distributed_gpu_inference_tpu.runtime.speculative import (
+        SpeculativeDecoder,
+    )
+
+    with pytest.raises(ValueError, match="widths"):
+        SpeculativeDecoder(
+            "llama3-tiny",
+            spec_cfg=SpeculativeConfig(widths=(8, 8, 8), adaptive=False),
+            max_batch_size=1, max_seq_len=32, block_size=16,
+        )
+
+
+def test_record_spec_engine_exports_per_worker():
+    from distributed_gpu_inference_tpu.server.observability import (
+        HAVE_PROMETHEUS,
+        MetricsCollector,
+    )
+
+    mc = MetricsCollector()
+    stats = {
+        "spec_accepted": 30, "spec_drafted": 40, "spec_slot_steps": 10,
+        "spec_accept_rate": 0.75, "spec_tokens_per_step": 4.0,
+    }
+    mc.record_spec_engine("worker-a", stats)
+    # totals advance by deltas across scrapes, and a restart re-anchors
+    stats2 = dict(stats, spec_accepted=50, spec_drafted=70,
+                  spec_slot_steps=17)
+    mc.record_spec_engine("worker-a", stats2)
+    mc.record_spec_engine("worker-a", {"spec_accepted": 5, "spec_drafted": 6,
+                                       "spec_slot_steps": 2,
+                                       "spec_accept_rate": 0.8,
+                                       "spec_tokens_per_step": 3.5})
+    text = mc.render().decode()
+    if HAVE_PROMETHEUS:
+        assert 'speculative_accepted_tokens_total{worker="worker-a"} 50.0' \
+            in text
+        assert 'speculative_drafted_tokens_total{worker="worker-a"} 70.0' \
+            in text
+        assert 'speculative_worker_accept_rate{worker="worker-a"} 0.8' \
+            in text
+        assert 'speculative_worker_tokens_per_step{worker="worker-a"} 3.5' \
+            in text
+
+
+def test_worker_llm_engine_wires_spec_config():
+    from distributed_gpu_inference_tpu.worker.engines.base import (
+        EngineLoadError,
+    )
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+    eng = TPULLMEngine({
+        "model": "llama3-tiny", "max_batch_size": 2, "max_seq_len": 64,
+        "speculative_decode": True, "spec_num_draft_tokens": 3,
+    })
+    eng.load_model()
+    assert eng.engine.cfg.speculative is not None
+    assert eng.engine.cfg.speculative.num_draft_tokens == 3
+    assert "spec_accept_rate" in eng.engine.get_stats()
+    eng.unload()
+
+    bad = TPULLMEngine({
+        "model": "llama3-tiny", "max_batch_size": 2, "max_seq_len": 64,
+        "speculative_decode": True, "spec_num_draft_tokens": 0,
+    })
+    with pytest.raises(EngineLoadError, match="speculative_decode"):
+        bad.load_model()
