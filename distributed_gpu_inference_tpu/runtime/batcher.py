@@ -24,6 +24,7 @@ thread-safe); the asyncio side only schedules and resolves futures.
 from __future__ import annotations
 
 import asyncio
+import functools
 import heapq
 import itertools
 import time
@@ -33,8 +34,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from distributed_gpu_inference_tpu.runtime.engine import (
     ChunkedAdmission,
+    PreemptedSequence,
     TPUEngine,
 )
+from distributed_gpu_inference_tpu.runtime.kv_cache import OutOfBlocksError
 from distributed_gpu_inference_tpu.utils.data_structures import (
     InferenceRequest,
     InferenceResponse,
@@ -53,6 +56,14 @@ class BatcherConfig:
     target_step_latency_ms: float = 100.0  # per host round-trip
     queue_limit: int = 1024
     default_timeout_s: float = 300.0
+    # KV-pressure preemption policy: a request preempted more than this
+    # many times errors with a distinct ``preempted_too_often`` reason
+    # instead of thrashing the pool forever (pool genuinely too small for
+    # the working set). Victims are picked (lowest priority first, then
+    # most-recently-admitted — LIFO) and requeued at the FRONT of the heap
+    # with their full generated context, so resume restores spilled/cached
+    # pages instead of recomputing.
+    max_preemptions: int = 3
     # horizon when admission work is waiting: bounded so a queued request
     # never waits more than this many decode steps for a slot, while still
     # amortizing host round-trips (decode_step per token would pay one RTT
@@ -89,6 +100,13 @@ class _QueueItem:
     request: InferenceRequest = field(compare=False)
     future: "asyncio.Future[InferenceResponse]" = field(compare=False)
     enqueued_at: float = field(compare=False, default_factory=time.time)
+    # KV-pressure state: a preempted request waits in the heap carrying its
+    # frozen sequence; _admit resumes it instead of submitting fresh
+    preempted: Optional[PreemptedSequence] = field(compare=False, default=None)
+    preempt_count: int = field(compare=False, default=0)
+    # consecutive resume failures seen while the engine held NOTHING else:
+    # an idle pool that cannot re-admit the sequence never will
+    idle_resume_oob: int = field(compare=False, default=0)
 
 
 class ContinuousBatcher:
@@ -136,6 +154,15 @@ class ContinuousBatcher:
         )
         self._horizon = float(self._levels[self._level])
         self._slot_items: Dict[int, _QueueItem] = {}
+        # admission stamps for LIFO victim selection (slot indices recycle,
+        # so recency must be tracked per admission, not per slot number)
+        self._admit_stamp: Dict[int, int] = {}
+        self._stamp = itertools.count()
+        # after a preemption, resumes pause until one round runs
+        # unpressured: the FROZEN slots must reserve the freed blocks
+        # first, or the resume takes them straight back and the pressure
+        # recurs every round until the victim dies preempted_too_often
+        self._resume_hold = False
         # at most one chunk-interleaved long-prompt admission in flight;
         # its prefill advances one chunk per loop iteration, between decode
         # rounds (VERDICT r1 next-step #4)
@@ -146,6 +173,8 @@ class ContinuousBatcher:
             "step_latency_ema_ms": 0.0, "occupancy_sum": 0, "horizon": self._horizon,
             "chunked_admissions": 0, "batched_waves": 0,
             "spec_waves": 0, "spec_completed": 0, "spec_errors": 0,
+            "preemptions": 0, "resumes": 0, "preemption_block_pressure": 0,
+            "preempted_too_often": 0,
         }
 
     # ---------------------------------------------------- speculative routing
@@ -193,6 +222,11 @@ class ContinuousBatcher:
             return False
         items = [it for it in list(self._heap) if not it.future.cancelled()]
         if not items or not all(self._spec_eligible(it) for it in items):
+            return False
+        if any(it.preempted is not None for it in items):
+            # a preempted sequence must RESUME (restoring its generated
+            # context, TTFT origin, and warm pages) — a spec wave would
+            # silently regenerate it from token 0
             return False
         loop = asyncio.get_running_loop()
         self._heap.clear()
@@ -273,6 +307,18 @@ class ContinuousBatcher:
             return InferenceResponse(
                 request_id=request.request_id, error="queue full"
             )
+        if not self.engine.request_fits_pool(request):
+            # the PROMPT alone cannot fit even an idle pool: no amount of
+            # preemption could ever admit it — reject up front. (The check
+            # is deliberately not worst-case on max_new_tokens; generation
+            # that outgrows the pool is handled dynamically by preemption,
+            # bounded by max_preemptions and the idle-resume abort.)
+            self.stats["rejected"] += 1
+            return InferenceResponse(
+                request_id=request.request_id,
+                error="request exceeds KV pool capacity (worst case "
+                      "cannot fit even an idle pool)",
+            )
         loop = asyncio.get_running_loop()
         fut: "asyncio.Future[InferenceResponse]" = loop.create_future()
         item = _QueueItem(
@@ -321,9 +367,18 @@ class ContinuousBatcher:
     def _admission_order(self) -> List[_QueueItem]:
         """Prefix-grouped admission (reference :267-300): group queued
         requests by their first-block prefix hash; largest group first, then
-        priority/FIFO inside the group."""
+        priority/FIFO inside the group. Preempted sequences ALWAYS lead:
+        their pages are still warm in the prefix cache / spill tiers, and
+        head-of-line resume is what bounds a preempted request's extra
+        latency to one pressure episode."""
+        resumes = sorted(
+            (it for it in self._heap if it.preempted is not None),
+            key=lambda it: it.sort_key,
+        )
         groups: Dict[str, List[_QueueItem]] = {}
         for item in self._heap:
+            if item.preempted is not None:
+                continue
             ids = item.request.prompt_token_ids or []
             key = (
                 compute_prefix_hash(ids, KV_BLOCK_TOKENS)
@@ -339,7 +394,7 @@ class ContinuousBatcher:
             key=lambda kv: (-len(kv[1]), min(it.sort_key for it in kv[1])),
         ):
             ordered.extend(sorted(members, key=lambda it: it.sort_key))
-        return ordered
+        return resumes + ordered
 
     async def _admit(self) -> int:
         """Admit queued requests into free slots. Heap mutation and future
@@ -354,12 +409,44 @@ class ContinuousBatcher:
         instead (one at a time); their chunks run between decode rounds in
         ``_run``."""
         admitted = 0
+        if self._resume_hold:
+            # the round after a preemption belongs to the FROZEN slots:
+            # neither resumes nor fresh admissions may take the freed
+            # blocks before they re-reserve, or the pressure recurs every
+            # round (thrash) no matter who stole them
+            return 0
         free = self.engine.free_slots()
         if not free or not self._heap:
             return 0
         loop = asyncio.get_running_loop()
         max_bucket = self.engine.cfg.prefill_buckets[-1]
         wave: List[_QueueItem] = []
+        requeue: List[_QueueItem] = []
+
+        def _defer(item: "_QueueItem") -> bool:
+            """Requeue an item the pool could not hold RIGHT NOW — unless
+            its worst case statically can never fit the pool, in which
+            case it errors out (the one capacity error that legitimately
+            reaches a client). A PREEMPTED sequence is never statically
+            rejected: it was admitted once and carries generated tokens —
+            requeue it and let the preempted_too_often cap (which returns
+            the partial output) decide if the pool can't sustain it.
+            Returns True when the item was deferred."""
+            if item.preempted is None and \
+                    not self.engine.request_fits_pool(item.request):
+                if not item.future.done():
+                    item.future.set_result(InferenceResponse(
+                        request_id=item.request.request_id,
+                        error="request exceeds KV pool capacity (worst "
+                              "case cannot fit even an idle pool)",
+                    ))
+                    # same counter as the submit()-time static rejection:
+                    # one condition, one metric, wherever it is detected
+                    self.stats["rejected"] += 1
+                return False
+            requeue.append(item)
+            return True
+
         for item in self._admission_order():
             if not free:
                 break
@@ -371,6 +458,62 @@ class ContinuousBatcher:
                 continue  # already handled
             if item.future.cancelled():
                 continue
+            if item.preempted is not None:
+                # resume a preempted sequence: head-of-line, restores
+                # cached/spilled pages through the normal allocate+prefill
+                # path. Pool still too tight → stop admitting ANYTHING this
+                # pass (new work must not steal the blocks the resume
+                # needs) and retry next loop.
+                try:
+                    slot = await loop.run_in_executor(
+                        self._exec, self.engine.resume, item.preempted,
+                    )
+                except OutOfBlocksError:
+                    if self.engine.num_active == 0 and \
+                            self._chunked is None:
+                        # an IDLE pool that cannot re-admit the sequence
+                        # never will (nothing left to free): after a few
+                        # consecutive tries, deliver the partial output
+                        # instead of spinning until the client's timeout
+                        item.idle_resume_oob += 1
+                        if item.idle_resume_oob > 2:
+                            pre = item.preempted
+                            if not item.future.done():
+                                item.future.set_result(InferenceResponse(
+                                    request_id=item.request.request_id,
+                                    token_ids=list(pre.generated),
+                                    finish_reason="abort",
+                                    prompt_tokens=pre.prompt_len,
+                                    completion_tokens=len(pre.generated),
+                                    error="request exceeds KV pool "
+                                          "capacity: generated context "
+                                          f"({len(pre.generated)} tokens) "
+                                          "can no longer be resumed",
+                                ))
+                                self.stats["completed"] += 1
+                            continue
+                    else:
+                        item.idle_resume_oob = 0
+                    if _defer(item):
+                        break
+                    continue
+                except Exception as e:
+                    if not item.future.done():
+                        item.future.set_result(InferenceResponse(
+                            request_id=item.request.request_id,
+                            error=f"resume failed: {e}",
+                        ))
+                        self.stats["completed"] += 1
+                    continue
+                item.preempted = None
+                item.idle_resume_oob = 0
+                if slot in free:
+                    free.remove(slot)
+                self._slot_items[slot] = item
+                self._admit_stamp[slot] = next(self._stamp)
+                self.stats["resumes"] += 1
+                admitted += 1
+                continue
             n_prompt = len(item.request.prompt_token_ids or [])
             if n_prompt > max_bucket:
                 if self._chunked is not None:
@@ -379,12 +522,14 @@ class ContinuousBatcher:
                     # not starve short requests behind it)
                     heapq.heappush(self._heap, item)
                     continue
-                free.pop(0)
                 try:
                     adm = await loop.run_in_executor(
                         self._exec, self.engine.submit_chunked_start,
                         item.request,
                     )
+                except OutOfBlocksError:
+                    _defer(item)
+                    continue
                 except Exception as e:
                     if not item.future.done():
                         item.future.set_result(
@@ -394,6 +539,10 @@ class ContinuousBatcher:
                             )
                         )
                     continue
+                # consume the slot only on SUCCESS: a failed chunked start
+                # rolled the engine back, and burning a free slot for it
+                # would under-admit the rest of this pass (the slot leak)
+                free.pop(0)
                 self._chunked = (adm, item)
                 self.stats["chunked_admissions"] += 1
                 continue
@@ -403,17 +552,31 @@ class ContinuousBatcher:
         if wave:
             try:
                 slots = await loop.run_in_executor(
-                    self._exec, self.engine.submit_batch,
-                    [it.request for it in wave],
+                    self._exec,
+                    functools.partial(
+                        self.engine.submit_batch,
+                        [it.request for it in wave], partial=True,
+                    ),
                 )
+            except OutOfBlocksError:
+                # pool can't hold the wave right now: requeue silently —
+                # completions/preemptions free blocks and the requests
+                # retry; clients never see the pressure
+                for item in wave:
+                    _defer(item)
+                slots = None
             except Exception:
                 # the wave is all-or-nothing (engine rolls back); isolate the
                 # failing request(s) by falling back to per-request admission
+                slots = None
                 for item in wave:
                     try:
                         slot = await loop.run_in_executor(
                             self._exec, self.engine.submit, item.request
                         )
+                    except OutOfBlocksError:
+                        _defer(item)
+                        continue
                     except Exception as e:
                         if not item.future.done():
                             item.future.set_result(
@@ -424,13 +587,22 @@ class ContinuousBatcher:
                             )
                         continue
                     self._slot_items[slot] = item
+                    self._admit_stamp[slot] = next(self._stamp)
                     admitted += 1
-            else:
-                self.stats["batched_waves"] += 1
+            if slots is not None:
+                if slots:
+                    self.stats["batched_waves"] += 1
                 for item, slot in zip(wave, slots):
                     self._slot_items[slot] = item
-                admitted += len(wave)
+                    self._admit_stamp[slot] = next(self._stamp)
+                admitted += len(slots)
+                # pressure deferred the wave's tail (possibly the whole
+                # wave): requeue without error
+                for item in wave[len(slots):]:
+                    _defer(item)
 
+        for item in requeue:
+            heapq.heappush(self._heap, item)
         if self._heap:
             heapq.heapify(self._heap)
         self.stats["admitted"] += admitted
@@ -465,6 +637,97 @@ class ContinuousBatcher:
             self._slot_items[adm.slot] = item
             self._chunked = None
             self.stats["admitted"] += 1
+
+    async def _check_pressure(self, after_round: bool = False) -> None:
+        """Consume the engine's KV-pressure signal and apply the preemption
+        policy. Decode-sourced pressure (active slots frozen, progress
+        blocked) always preempts a victim; admission-sourced pressure
+        preempts only when the waiting work outranks the victim — otherwise
+        the deferred admissions simply wait for completions."""
+        p = self.engine.take_pressure()
+        if p is None:
+            if after_round:
+                # one full engine round ran unpressured: the frozen slots
+                # got their reservations, resumes may flow again
+                self._resume_hold = False
+            return
+        self.stats["preemption_block_pressure"] += 1
+        if p.source == "decode":
+            # skip if every frozen slot resolved meanwhile (finished this
+            # very round and its blocks are already back)
+            still_frozen = any(
+                (s := self.engine.slots[sl]) is not None
+                and s.finish_reason is None
+                for sl in p.slots
+            )
+            if still_frozen:
+                await self._preempt_victim(mandatory=True)
+        else:
+            await self._preempt_victim(mandatory=False)
+
+    async def _preempt_victim(self, mandatory: bool) -> None:
+        """Pick and preempt one victim: lowest priority first, ties broken
+        most-recently-admitted (LIFO — the youngest sequence has the least
+        compute invested and the warmest prefix to resume from). The frozen
+        sequence requeues at the FRONT of the heap; past
+        ``max_preemptions`` the request errors with ``preempted_too_often``."""
+        cands = []
+        for slot, item in self._slot_items.items():
+            s = self.engine.slots[slot]
+            if s is None or s.finish_reason is not None or s.prefilling:
+                continue
+            cands.append((item.request.priority,
+                          -self._admit_stamp.get(slot, -1), slot, item))
+        if not cands:
+            return
+        prio, _, slot, item = min(cands)
+        if not mandatory:
+            # admission pressure: only preempt for strictly higher-priority
+            # waiting work — FIFO fairness is not worth a spill round-trip
+            waiting = max(
+                (it.request.priority for it in self._heap
+                 if not it.future.done()),
+                default=None,
+            )
+            if waiting is None or waiting <= prio:
+                return
+        loop = asyncio.get_running_loop()
+        try:
+            pre = await loop.run_in_executor(
+                self._exec, self.engine.preempt_slot, slot
+            )
+        except Exception:
+            return      # slot finished/changed under us: nothing to preempt
+        self._slot_items.pop(slot, None)
+        self.stats["preemptions"] += 1
+        item.preempt_count += 1
+        pre.preempt_count = item.preempt_count
+        if item.preempt_count > self.cfg.max_preemptions:
+            self.stats["preempted_too_often"] += 1
+            if not item.future.done():
+                item.future.set_result(InferenceResponse(
+                    request_id=item.request.request_id,
+                    token_ids=list(pre.generated),
+                    finish_reason="abort",
+                    prompt_tokens=pre.prompt_len,
+                    completion_tokens=len(pre.generated),
+                    error=f"preempted_too_often: evicted "
+                          f"{item.preempt_count} times under KV pressure",
+                ))
+                self.stats["completed"] += 1
+            return
+        item.preempted = pre
+        # resort to the FRONT of the heap: resumes outrank every waiting
+        # admission (their pages are warm; head-of-line bounds added
+        # latency) — but pause resumes until one round runs unpressured,
+        # so the frozen slots reserve the freed blocks first
+        self._resume_hold = True
+        item.sort_key = (
+            -(1 << 20) - item.request.priority,
+            item.request.arrival_time,
+            next(self._seq),
+        )
+        heapq.heappush(self._heap, item)
 
     def _engine_round(self) -> float:
         """One blocking engine round on the worker thread. Returns latency ms."""
@@ -519,6 +782,9 @@ class ContinuousBatcher:
             # paged slots below and the two interleave round for round
             await self._maybe_start_spec_wave()
             await self._admit()
+            # admission-sourced KV pressure: deferred requests wait, or a
+            # higher-priority arrival preempts the lowest-priority victim
+            await self._check_pressure()
             # one prefill chunk of the in-flight long admission per loop
             # iteration — decode rounds below run between chunks, so active
             # slots stall at most one chunk per round
@@ -526,6 +792,13 @@ class ContinuousBatcher:
             # one bounded fused dispatch of the in-flight spec wave
             await self._step_spec_wave()
             if not self.engine.num_active:
+                # nothing active means nothing frozen is waiting on the
+                # freed blocks: resumes may flow immediately
+                self._resume_hold = False
+                if self._heap:
+                    # deferred (pressured) work with an idle engine: yield
+                    # briefly instead of hot-spinning the admission loop
+                    await asyncio.sleep(0.001)
                 continue
             try:
                 latency = await loop.run_in_executor(
@@ -543,6 +816,12 @@ class ContinuousBatcher:
                         if item and not item.future.done():
                             item.future.set_result(resp)
                             self.stats["completed"] += 1
+                # decode-sourced KV pressure: slots froze this round —
+                # preempt the policy victim so the next round progresses
+                # (completions above may already have freed blocks; the
+                # check skips if every frozen slot resolved). An
+                # unpressured round releases the resume hold.
+                await self._check_pressure(after_round=True)
             except asyncio.CancelledError:
                 raise
             except Exception as e:

@@ -23,9 +23,10 @@ bucket; decode compiles once per engine.
 from __future__ import annotations
 
 import functools
+import itertools
 import time
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -40,6 +41,7 @@ from distributed_gpu_inference_tpu.ops.sampling import (
 )
 from distributed_gpu_inference_tpu.runtime.kv_cache import (
     HostKVStore,
+    OutOfBlocksError,
     PagedKVCacheManager,
     PendingDeviceOps,
 )
@@ -208,6 +210,48 @@ class ChunkedAdmission:
     off: int
     mode: str
     done: bool = False
+
+
+@dataclass
+class KVPressure:
+    """KV-block exhaustion observed at a step boundary — a SCHEDULING event,
+    not an error. The engine leaves every sequence in a consistent frozen
+    state (nothing decoded for the pressured slots, nothing half-allocated)
+    and hands this signal to whoever drives it (``ContinuousBatcher``,
+    ``generate``) to pick a preemption victim / requeue admissions.
+
+    ``source``: "decode" means active slots could not reserve their next
+    step's blocks (progress REQUIRES freeing blocks — preempt someone);
+    "admission" means new work could not allocate (it can simply wait for
+    running sequences to finish unless it outranks them).
+    """
+
+    source: str
+    slots: List[int] = field(default_factory=list)   # slots that froze
+    requests: int = 0                                # admissions deferred
+
+
+@dataclass
+class PreemptedSequence:
+    """A running sequence frozen by :meth:`TPUEngine.preempt_slot`.
+
+    Carries everything needed for a byte-identical greedy (and seed-stable
+    sampled) continuation through :meth:`TPUEngine.resume`: the original
+    request, every token generated so far, and the slot's PRNG key
+    material. Device blocks are RELEASED at preempt time — full blocks park
+    in the prefix cache (and spill to the host tier under further
+    pressure), so resume restores them via the radix index / ``_probe_spill``
+    instead of recomputing the whole context.
+    """
+
+    request: InferenceRequest
+    prompt_len: int
+    generated: List[int]
+    slot_key: Tuple[int, int]             # threefry key words (hi, lo)
+    start_time: Optional[float]
+    first_token_time: Optional[float]
+    cached_tokens: int
+    preempt_count: int = 0                # maintained by the scheduler layer
 
 
 class TPUEngine:
@@ -384,9 +428,13 @@ class TPUEngine:
             )
 
         self._build_jit_fns()
+        # pending KV-pressure signal (set at step boundaries, consumed by
+        # the scheduler layer via take_pressure)
+        self._pressure: Optional[KVPressure] = None
         self.stats: Dict[str, Any] = {
             "requests": 0, "completed": 0, "generated_tokens": 0,
             "prefill_tokens": 0, "prefill_calls": 0, "decode_calls": 0,
+            "preemptions": 0, "resumes": 0, "kv_pressure_events": 0,
         }
         if self.cfg.speculative is not None:
             self.stats.update({
@@ -1133,6 +1181,145 @@ class TPUEngine:
     def num_active(self) -> int:
         return sum(1 for s in self.slots if s is not None)
 
+    # ------------------------------------------- KV pressure + preemption
+
+    def _signal_pressure(self, source: str, slots: Sequence[int] = (),
+                         requests: int = 0) -> None:
+        """Record a step-boundary KV-pressure event for the scheduler. One
+        ``KVPressure`` accumulates per engine round; "decode" outranks
+        "admission" (decode pressure blocks progress, admission can wait)."""
+        if self._pressure is None:
+            self._pressure = KVPressure(source=source)
+            self.stats["kv_pressure_events"] += 1
+        elif source == "decode":
+            self._pressure.source = "decode"
+        for sl in slots:
+            if sl not in self._pressure.slots:
+                self._pressure.slots.append(sl)
+        self._pressure.requests += requests
+
+    def request_fits_pool(self, request: InferenceRequest) -> bool:
+        """Static admissibility check: can the request's PROMPT (plus its
+        pending first token, and the speculative verify window when the
+        engine speculates) fit an idle pool? A request failing this can
+        never even be admitted — the one case a capacity error
+        legitimately reaches the client immediately.
+
+        Deliberately NOT a worst-case (prompt + max_new_tokens) test:
+        max_new_tokens is a cap, not a promise — most generations stop at
+        EOS far earlier, so pre-rejecting on the cap would break every
+        generous-cap/short-output workload that served fine. Growth beyond
+        the pool is a DYNAMIC condition the preemption machinery absorbs,
+        bounded by the scheduler's preemption/resume caps."""
+        tokens = len(request.prompt_token_ids or []) + 1
+        if self.cfg.speculative is not None:
+            tokens += self.cfg.speculative.num_draft_tokens + 1
+        need = -(-tokens // self.cfg.block_size)
+        return need <= self.num_blocks - 1   # block 0 is the reserved pad
+
+    def take_pressure(self) -> Optional[KVPressure]:
+        """Consume the pending pressure signal (None when the last round
+        ran unpressured). The scheduler calls this after every engine round
+        / admission attempt and reacts per its preemption policy."""
+        p, self._pressure = self._pressure, None
+        return p
+
+    def preempt_slot(self, slot: int) -> PreemptedSequence:
+        """Freeze a RUNNING sequence and release its device blocks — the
+        recovery half of KV-pressure handling. Full blocks are freed
+        through ``free_sequence(cache=True)``: they park in the prefix
+        cache and, when evicted under further pressure, spill to the
+        host/remote tiers — so :meth:`resume` restores them via the radix
+        index or ``_probe_spill`` instead of recomputing the whole context.
+
+        The sequence's pending token (sampled but its KV not yet written)
+        is dropped from the manager's token log first, so only fully
+        written blocks can be cached/spilled; it stays in ``generated`` and
+        is recomputed by the resume prefill."""
+        s = self.slots[slot]
+        if s is None:
+            raise ValueError(f"slot {slot} is empty")
+        if s.prefilling:
+            raise ValueError(
+                f"slot {slot} is mid-prefill (chunked admission) — abort it "
+                "with abort_chunked instead of preempting"
+            )
+        if s.finish_reason is not None:
+            raise ValueError(
+                f"slot {slot} already finished ({s.finish_reason}) — use "
+                "finish_slot"
+            )
+        seq = self.manager.seq_tokens[s.seq_id]
+        committed = int(self._kv_lens[slot])
+        while len(seq) > committed:
+            seq.pop()
+        # drop reserved tail blocks (spec windows, multi-step horizons) so
+        # the freed footprint is exactly the committed context
+        self.manager.trim_reserved(s.seq_id)
+        pre = PreemptedSequence(
+            request=s.request,
+            prompt_len=s.prompt_len,
+            generated=list(s.generated),
+            slot_key=(int(self._slot_keys[slot, 0]),
+                      int(self._slot_keys[slot, 1])),
+            start_time=s.start_time,
+            first_token_time=s.first_token_time,
+            cached_tokens=s.cached_tokens,
+        )
+        self.manager.free_sequence(s.seq_id, cache=True)
+        self.slots[slot] = None
+        self._kv_lens[slot] = 0
+        self._core_dirty = True
+        if self.cfg.speculative is not None:
+            self._spec_h_zero.add(slot)
+        self.stats["preemptions"] += 1
+        return pre
+
+    def resume(self, pre: PreemptedSequence,
+               slot: Optional[int] = None) -> int:
+        """Re-admit a preempted sequence through the normal allocation +
+        prefill path. The resume prompt is the original prompt plus every
+        generated token: ``allocate_sequence`` restores whatever prefix the
+        cache/spill tiers still hold and the prefill recomputes only the
+        uncached suffix. Greedy continuations are byte-identical to a
+        never-preempted run; sampled continuations are seed-stable because
+        the slot's PRNG key is restored verbatim and the sampler folds in
+        the absolute position.
+
+        Raises OutOfBlocksError (state untouched) when the pool still
+        cannot hold the sequence — the scheduler retries later."""
+        sp = pre.request.sampling
+        remaining = sp.max_new_tokens - len(pre.generated)
+        if remaining <= 0:
+            raise ValueError("preempted sequence has no remaining budget")
+        token_ids = list(pre.request.prompt_token_ids or []) + \
+            list(pre.generated)
+        # the preserved key words round-trip through SamplingParams.seed:
+        # _bind_slot unpacks PRNGKey-style [seed >> 32, seed & 0xffffffff]
+        seed = (pre.slot_key[0] << 32) | pre.slot_key[1]
+        derived = replace(
+            pre.request,
+            prompt_token_ids=token_ids,
+            session_id=None,
+            sampling=replace(sp, max_new_tokens=remaining, seed=seed),
+        )
+        slot = self.submit(derived, slot=slot)
+        s = self.slots[slot]
+        assert s is not None
+        # restore the client-visible identity: the ORIGINAL request (decode
+        # budgets are max_new_tokens minus the FULL generated list), prompt
+        # accounting, and the TTFT clock origin
+        s.request = pre.request
+        s.prompt_len = pre.prompt_len
+        s.generated = list(pre.generated) + s.generated
+        s.cached_tokens = pre.cached_tokens
+        s.start_time = pre.start_time
+        if pre.first_token_time is not None:
+            s.first_token_time = pre.first_token_time
+        self.stats["requests"] -= 1          # not a new client request
+        self.stats["resumes"] += 1
+        return slot
+
     def _validate_request(self, request: InferenceRequest) -> List[int]:
         token_ids = request.prompt_token_ids
         if not token_ids:
@@ -1156,22 +1343,40 @@ class TPUEngine:
             raise RuntimeError(f"slot {slot} busy")
         token_ids = self._validate_request(request)
         seq_id = request.session_id or uuid.uuid4().hex
-        blocks, cached = self.manager.allocate_sequence(seq_id, token_ids)
+        try:
+            blocks, cached = self.manager.allocate_sequence(seq_id, token_ids)
+        except OutOfBlocksError:
+            # allocate_sequence scrubbed its own staging: state is clean,
+            # the caller sees a pressure signal + typed error, never a
+            # half-admitted sequence
+            self._signal_pressure("admission", requests=1)
+            raise
         try:
             return self._submit_allocated(request, slot, seq_id, token_ids, cached)
-        except Exception:
+        except Exception as exc:
             self.slots[slot] = None
             self._kv_lens[slot] = 0
             self.manager.free_sequence(seq_id, cache=False)
+            if isinstance(exc, OutOfBlocksError):
+                self._signal_pressure("admission", requests=1)
             raise
 
-    def submit_batch(self, requests: Sequence[InferenceRequest]) -> List[int]:
+    def submit_batch(self, requests: Sequence[InferenceRequest],
+                     partial: bool = False) -> List[int]:
         """Admit several requests at once: same-bucket prefills run as ONE
         batched device call (full batch width, inactive rows masked with
         position -1). On a remote-tunnel TPU each device call costs a full
         control round-trip, so per-request prefill serializes admission —
         this path admits a whole wave for one RTT. Long prompts that need
-        chunking fall back to the per-request chunked path."""
+        chunking fall back to the per-request chunked path.
+
+        ``partial``: when KV blocks run out mid-wave, admit the prefix of
+        the wave that DID allocate and return only its slots (a pressure
+        signal marks the deferred tail) instead of rolling the whole wave
+        back — the batcher requeues the tail with no client-visible error.
+        With ``partial=False`` (default) exhaustion rolls back the whole
+        wave and raises ``OutOfBlocksError`` after signalling pressure;
+        state is clean either way."""
         if not requests:
             return []
         free = self.free_slots()
@@ -1225,7 +1430,18 @@ class TPUEngine:
             for request, slot in zip(requests, free):
                 token_ids = self._validate_request(request)
                 seq_id = request.session_id or uuid.uuid4().hex
-                _, cached = self.manager.allocate_sequence(seq_id, token_ids)
+                try:
+                    _, cached = self.manager.allocate_sequence(
+                        seq_id, token_ids
+                    )
+                except OutOfBlocksError:
+                    # step-boundary pressure: allocate_sequence scrubbed its
+                    # own staging, nothing of THIS request is admitted
+                    deferred = len(requests) - len(slots_out)
+                    self._signal_pressure("admission", requests=deferred)
+                    if not partial:
+                        raise
+                    break   # admit the prefix that allocated; tail deferred
                 admitted.append((slot, seq_id))
                 slots_out.append(slot)
                 n_fresh = len(token_ids) - cached
@@ -1327,7 +1543,7 @@ class TPUEngine:
                         self._record_token(
                             slot, int(first_np[slot]), device_synced=True
                         )
-        except Exception:
+        except Exception as exc:
             # a failed wave must not leak: every sequence this call admitted
             # (bound or not) is freed so a retry sees clean state
             self._invalidate_device_state()
@@ -1335,6 +1551,10 @@ class TPUEngine:
             # interleaved decode tokens that went to slots OUTSIDE this wave
             # really happened and survive the rollback
             self.stats["generated_tokens"] += interleaved_extra
+            if isinstance(exc, OutOfBlocksError):
+                self._signal_pressure(
+                    "admission", requests=len(requests)
+                )
             raise
         return slots_out
 
@@ -1555,7 +1775,11 @@ class TPUEngine:
             raise RuntimeError(f"slot {slot} busy")
         token_ids = self._validate_request(request)
         seq_id = request.session_id or uuid.uuid4().hex
-        _, cached = self.manager.allocate_sequence(seq_id, token_ids)
+        try:
+            _, cached = self.manager.allocate_sequence(seq_id, token_ids)
+        except OutOfBlocksError:
+            self._signal_pressure("admission", requests=1)
+            raise
         try:
             self._apply_pending()
             s = _Slot(request=request, seq_id=seq_id,
@@ -1583,8 +1807,25 @@ class TPUEngine:
         s = self.slots[adm.slot]
         if s is None or s.seq_id != adm.seq_id:
             raise RuntimeError("chunked admission slot was freed")
-        self._apply_pending()
         max_bucket = self.cfg.prefill_buckets[-1]
+        if len(adm.fresh) <= max_bucket:
+            # the upcoming chunk is the LAST one: it samples the first
+            # token, whose pending KV block must exist. Pre-reserve it NOW
+            # so exhaustion is a step-boundary retry (pressure signal,
+            # chunk not consumed, caller steps again once blocks free)
+            # instead of OutOfBlocksError aborting a fully-prefilled
+            # admission from inside _record_token.
+            try:
+                if self.manager.reserve_tokens(s.seq_id, 1):
+                    self._block_tables[adm.slot] = \
+                        self.manager.block_table_for(
+                            s.seq_id, self.cfg.max_blocks_per_seq
+                        )
+            except OutOfBlocksError:
+                self.manager.trim_reserved(s.seq_id)
+                self._signal_pressure("admission", requests=1)
+                return False
+        self._apply_pending()
         piece = adm.fresh[: max_bucket]
         adm.fresh = adm.fresh[max_bucket:]
         is_last = not adm.fresh
@@ -1682,6 +1923,42 @@ class TPUEngine:
         ]
         if not active:
             return {}
+        # pre-reserve the block this step's SAMPLED token will occupy (and
+        # CoW a shared tail) BEFORE the device call: exhaustion then freezes
+        # the slot at the step boundary — nothing decoded, pending token
+        # still pending, host/device state untouched — and signals the
+        # scheduler, instead of OutOfBlocksError unwinding mid-record with a
+        # sampled-but-unplaced token
+        kept: List[int] = []
+        pressured: List[int] = []
+        for i in active:
+            s = self.slots[i]
+            assert s is not None
+            if len(self.manager.seq_tokens[s.seq_id]) >= self.cfg.max_seq_len:
+                # context full: this step's sample triggers the length
+                # finish and is never appended — reserving past the table
+                # width would overflow it
+                kept.append(i)
+                continue
+            try:
+                added = self.manager.reserve_tokens(s.seq_id, 1)
+            except OutOfBlocksError:
+                self.manager.trim_reserved(s.seq_id)
+                self._block_tables[i] = self.manager.block_table_for(
+                    s.seq_id, self.cfg.max_blocks_per_seq
+                )
+                pressured.append(i)
+                continue
+            if added:
+                self._block_tables[i] = self.manager.block_table_for(
+                    s.seq_id, self.cfg.max_blocks_per_seq
+                )
+            kept.append(i)
+        if pressured:
+            self._signal_pressure("decode", slots=pressured)
+        if not kept:
+            return {}
+        active = kept
         self._apply_pending()
         active_mask = np.zeros(len(self.slots), dtype=bool)
         active_mask[active] = True
@@ -1750,6 +2027,7 @@ class TPUEngine:
         rounds = max(1, min(int(num_steps),
                             int(max(budgets[i] for i in active))))
         rounds = 1 << (rounds.bit_length() - 1)
+        pressured: List[int] = []
         for i in active:
             s = self.slots[i]
             # reserve the dispatch's worst case up front — the device
@@ -1761,14 +2039,29 @@ class TPUEngine:
             cur = len(self.manager.seq_tokens[s.seq_id])
             want = min(rounds * (k + 1), int(budgets[i])) + k + 1
             n_res = max(min(want, self.cfg.max_seq_len - cur), 0)
-            if n_res > 0 and self.manager.reserve_tokens(s.seq_id, n_res):
-                # table rebuild only when the reservation actually added
-                # blocks (or CoW'd a shared tail)
+            try:
+                if n_res > 0 and self.manager.reserve_tokens(s.seq_id, n_res):
+                    # table rebuild only when the reservation actually added
+                    # blocks (or CoW'd a shared tail)
+                    self._block_tables[i] = self.manager.block_table_for(
+                        s.seq_id, self.cfg.max_blocks_per_seq
+                    )
+            except OutOfBlocksError:
+                # pool can't hold this slot's verify window: freeze it for
+                # this dispatch (step-boundary pressure, scheduler decides
+                # who yields) rather than unwind half-reserved
+                self.manager.trim_reserved(s.seq_id)
                 self._block_tables[i] = self.manager.block_table_for(
                     s.seq_id, self.cfg.max_blocks_per_seq
                 )
+                pressured.append(i)
+                continue
             active_mask[i] = True
             caps[i] = cur + n_res
+        if pressured:
+            self._signal_pressure("decode", slots=pressured)
+        if not active_mask.any():
+            return {}
         self._apply_pending()
         core = self._sync_core()
         h_last = self._spec_h_device()
@@ -1888,15 +2181,35 @@ class TPUEngine:
         if not active_mask.any():
             return {}
         # pre-reserve KV blocks for each slot's actual horizon (no host
-        # alloc mid-scan)
+        # alloc mid-scan). A slot whose reservation exhausts the pool is
+        # FROZEN for this round (masked out, partial reservation trimmed
+        # back, pending token still pending) and reported as a pressure
+        # signal — the step boundary stays consistent instead of the round
+        # unwinding with half the batch reserved.
+        pressured: List[int] = []
         for i, s in enumerate(self.slots):
             if active_mask[i] and s is not None:
-                self.manager.reserve_tokens(
-                    s.seq_id, int(min(num_steps, budgets[i]))
-                )
+                # clamp the horizon to the context limit: the length-finish
+                # trigger token is never appended, so reserving past
+                # max_seq_len would only overflow the block-table width
+                cur = len(self.manager.seq_tokens[s.seq_id])
+                n_res = min(int(min(num_steps, budgets[i])),
+                            self.cfg.max_seq_len - cur)
+                if n_res <= 0:
+                    continue
+                try:
+                    self.manager.reserve_tokens(s.seq_id, n_res)
+                except OutOfBlocksError:
+                    self.manager.trim_reserved(s.seq_id)
+                    active_mask[i] = False
+                    pressured.append(i)
                 self._block_tables[i] = self.manager.block_table_for(
                     s.seq_id, self.cfg.max_blocks_per_seq
                 )
+        if pressured:
+            self._signal_pressure("decode", slots=pressured)
+        if not active_mask.any():
+            return {}
         self._apply_pending()
         core = self._sync_core()
         tables, act_d, bud_d = self._sched_arrays(
@@ -1963,19 +2276,135 @@ class TPUEngine:
         self,
         requests: Sequence[InferenceRequest],
         use_multi_step: bool = False,
+        max_preemptions: int = 8,
     ) -> List[InferenceResponse]:
-        """Batch-generate to completion (waves of ≤ max_batch_size)."""
-        pending = list(requests)
+        """Batch-generate to completion (waves of ≤ max_batch_size).
+
+        KV-pressure safe: admissions the pool cannot hold simply wait,
+        decode pressure preempts the most-recently-admitted sequence
+        (spill → resume, byte-identical continuation), and a request
+        preempted more than ``max_preemptions`` times finishes with a
+        ``preempted_too_often`` error instead of livelocking the wave.
+        Clients never see an OutOfBlocksError."""
+        pending = []
         responses: Dict[str, InferenceResponse] = {}
-        while pending or self.num_active:
-            n_free = len(self.free_slots())
-            if pending and n_free:
-                wave, pending = pending[:n_free], pending[n_free:]
-                self.submit_batch(wave)  # one prefill call per bucket
-            if use_multi_step:
-                self.decode_multi()
+        for r in requests:
+            if self.request_fits_pool(r):
+                pending.append(r)
             else:
-                self.decode_step()
+                # a prompt that cannot fit an idle pool would head-of-line
+                # block the whole wave forever — reject it immediately and
+                # keep serving the rest
+                responses[r.request_id] = InferenceResponse(
+                    request_id=r.request_id,
+                    error="request exceeds KV pool capacity (prompt cannot "
+                          "fit an idle pool)",
+                )
+        preempted: List[PreemptedSequence] = []
+        stamp = itertools.count()
+        admitted_at: Dict[int, int] = {}        # slot → admission stamp
+        preempt_counts: Dict[str, int] = {}     # request_id → preemptions
+        stalled = 0
+        # after a preemption, resumes pause for one unpressured round so
+        # the FROZEN slots reserve first — an immediate resume would take
+        # back exactly the blocks the preemption freed and the pressure
+        # would recur every round until the victim dies preempted_too_often
+        hold_resume = False
+        while pending or preempted or self.num_active:
+            progressed = False
+            n_free = len(self.free_slots())
+            # resumes outrank fresh admissions: preempted work re-enters
+            # at the head of the line
+            while preempted and n_free > 0 and not hold_resume:
+                try:
+                    slot = self.resume(preempted[0])
+                except OutOfBlocksError:
+                    break               # still pressured; decode frees blocks
+                preempted.pop(0)
+                admitted_at[slot] = next(stamp)
+                n_free -= 1
+                progressed = True
+            if pending and n_free > 0:
+                wave, pending = pending[:n_free], pending[n_free:]
+                try:
+                    slots = self.submit_batch(wave, partial=True)
+                except OutOfBlocksError:
+                    # exhaustion in the PREFILL phase (first sampled token's
+                    # block): the wave rolled back cleanly — defer it all
+                    slots = []
+                pending = wave[len(slots):] + pending   # deferred tail waits
+                for sl in slots:
+                    admitted_at[sl] = next(stamp)
+                progressed = progressed or bool(slots)
+            if self.num_active:
+                out = (
+                    self.decode_multi() if use_multi_step
+                    else self.decode_step()
+                )
+                progressed = progressed or bool(out)
+            pressure = self.take_pressure()
+            if pressure is None:
+                hold_resume = False     # unpressured round: resumes may flow
+            elif pressure.source == "decode":
+                victims = [
+                    i for i, s in enumerate(self.slots)
+                    if s is not None and s.finish_reason is None
+                    and not s.prefilling
+                ]
+                if victims:
+                    victim = max(
+                        victims, key=lambda sl: admitted_at.get(sl, -1)
+                    )
+                    pre = self.preempt_slot(victim)
+                    rid = pre.request.request_id
+                    count = preempt_counts.get(rid, 0) + 1
+                    preempt_counts[rid] = count
+                    pre.preempt_count = count
+                    if count > max_preemptions:
+                        responses[rid] = InferenceResponse(
+                            request_id=rid,
+                            token_ids=list(pre.generated),
+                            finish_reason="abort",
+                            prompt_tokens=pre.prompt_len,
+                            completion_tokens=len(pre.generated),
+                            error="preempted_too_often: KV pool cannot "
+                                  f"sustain this sequence ({count} "
+                                  "preemptions)",
+                        )
+                    else:
+                        preempted.append(pre)
+                        hold_resume = True
+                    progressed = True
+            if not progressed:
+                stalled += 1
+                if stalled > 8 and preempted and self.num_active == 0 \
+                        and not pending:
+                    # an IDLE engine repeatedly failing a resume means the
+                    # sequence's generated context alone no longer fits the
+                    # pool — nothing will ever free more blocks. Deliver
+                    # what it produced instead of wedging forever.
+                    pre = preempted.pop(0)
+                    rid = pre.request.request_id
+                    responses[rid] = InferenceResponse(
+                        request_id=rid,
+                        token_ids=list(pre.generated),
+                        finish_reason="abort",
+                        prompt_tokens=pre.prompt_len,
+                        completion_tokens=len(pre.generated),
+                        error="request exceeds KV pool capacity: generated "
+                              f"context ({len(pre.generated)} tokens) can "
+                              "no longer be resumed",
+                    )
+                    stalled = 0
+                elif stalled > 32:
+                    raise OutOfBlocksError(
+                        "generate wedged under KV pressure: "
+                        f"{len(pending)} pending, {len(preempted)} "
+                        f"preempted, {self.num_active} active — the pool "
+                        "cannot hold even one waiting sequence"
+                    )
+            else:
+                stalled = 0
             for i, s in enumerate(list(self.slots)):
                 if s is not None and s.finish_reason is not None:
                     resp = self.finish_slot(i)

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributed_gpu_inference_tpu.testing import faults as _faults
 from distributed_gpu_inference_tpu.utils.data_structures import (
     KV_BLOCK_TOKENS,
     KVBlockMeta,
@@ -378,6 +379,15 @@ class PagedKVCacheManager:
         return len(self.free_list) + len(self.cached_lru)
 
     def _pop_free_block(self) -> int:
+        # chaos seam: a fired ``pressure`` rule makes this allocation see a
+        # pool with zero free (and zero evictable) blocks — the same
+        # OutOfBlocksError a saturated pool raises, so seeded storms drive
+        # the engine/batcher preempt → spill → resume path end to end
+        if _faults.kv_pressure("kv.block.alloc", num_free=len(self.free_list)):
+            raise OutOfBlocksError(
+                f"KV pool exhausted (kv_pressure fault injected with "
+                f"{len(self.free_list)} actually free)"
+            )
         if self.free_list:
             bid = self.free_list.pop()
         else:
@@ -766,6 +776,24 @@ class PagedKVCacheManager:
                     meta.prefix_hash = compute_prefix_hash(tokens, full_tokens)
                 self._deactivate_block(bid)
 
+    def _scrub_pending_for(self, bid: int) -> None:
+        """Withdraw staged device ops that reference a block returning to
+        the free list: the id can be reallocated before the ops apply, and
+        a stale upload/copy would clobber the new owner's pages. Downloads
+        are never scrubbed — a spill-on-evict download is the evicted
+        page's only copy."""
+        p = self.pending
+        if p.copies:
+            # filter by DESTINATION only: a freed source's page bytes are
+            # still intact until the id is reallocated AND rewritten, and
+            # the CoW owner needs them — the dst, though, must never be
+            # written once it can belong to someone else
+            p.copies = [c for c in p.copies if c[1] != bid]
+        if p.uploads:
+            p.uploads = [u for u in p.uploads if u[0] != bid]
+        if p.scale_uploads:
+            p.scale_uploads = [u for u in p.scale_uploads if u[0] != bid]
+
     def _deactivate_block(self, bid: int) -> None:
         """A block whose refcount just hit 0: park it as reusable cache if the
         radix still indexes it (interior nodes CANNOT be freed — descendant
@@ -777,6 +805,7 @@ class PagedKVCacheManager:
             self.stats.cached_blocks += 1
         else:
             self.metas.pop(bid, None)
+            self._scrub_pending_for(bid)
             self.free_list.append(bid)
 
     def _release_block(self, bid: int) -> None:
@@ -789,6 +818,7 @@ class PagedKVCacheManager:
                     f"refusing to force-free interior radix block {bid}"
                 )
             self.radix.remove_block(bid)
+        self._scrub_pending_for(bid)
         self.free_list.append(bid)
 
     # -- engine handshake ---------------------------------------------------

@@ -30,17 +30,42 @@ DIRECT_CACHE_TTL_S = 60.0  # reference inference_client.py:284-306
 
 
 class InferenceClientError(Exception):
-    def __init__(self, status: int, detail: str = "") -> None:
+    def __init__(self, status: int, detail: str = "",
+                 retry_after_s: Optional[float] = None) -> None:
         super().__init__(f"HTTP {status}: {detail}")
         self.status = status
         self.detail = detail
+        # server-provided backpressure hint (429/503 Retry-After header or
+        # the machine-readable retry_after_s body field) — callers that
+        # schedule their own retries should wait at least this long
+        self.retry_after_s = retry_after_s
 
 
 class NoWorkersAvailable(InferenceClientError):
     """Every configured server answered 503 (no capacity)."""
 
-    def __init__(self, detail: str = "no workers available") -> None:
-        super().__init__(503, detail)
+    def __init__(self, detail: str = "no workers available",
+                 retry_after_s: Optional[float] = None) -> None:
+        super().__init__(503, detail, retry_after_s=retry_after_s)
+
+
+def _retry_after_of(resp: httpx.Response) -> Optional[float]:
+    """Parse the server's retry hint: machine-readable ``retry_after_s`` in
+    the JSON body (one contract for 429 backpressure AND 503 capacity
+    errors), falling back to the standard Retry-After header."""
+    try:
+        val = resp.json().get("retry_after_s")
+        if val is not None:
+            return max(0.0, float(val))
+    except (ValueError, AttributeError, TypeError):
+        pass
+    hdr = resp.headers.get("Retry-After")
+    if hdr:
+        try:
+            return max(0.0, float(hdr))
+        except ValueError:
+            pass
+    return None
 
 
 class InferenceClient:
@@ -84,10 +109,15 @@ class InferenceClient:
             h["X-API-Key"] = self.api_key
         return h
 
-    def _sleep_backoff(self, attempt: int) -> None:
+    def _sleep_backoff(self, attempt: int, floor_s: float = 0.0) -> None:
         """Full-jitter exponential backoff (``utils.backoff``); bounded by
-        the attempt count, de-synchronized across a fleet of clients."""
-        time.sleep(full_jitter_delay(self._backoff_s, attempt, self._rng))
+        the attempt count, de-synchronized across a fleet of clients.
+        ``floor_s``: a server-provided Retry-After hint — honored as a
+        minimum wait with the jitter ADDED on top, so a saturated server's
+        clients neither return early nor stampede back in lockstep."""
+        time.sleep(
+            floor_s + full_jitter_delay(self._backoff_s, attempt, self._rng)
+        )
 
     def _request(self, method: str, path: str,
                  payload: Optional[Dict[str, Any]] = None,
@@ -101,6 +131,8 @@ class InferenceClient:
         execute the job again."""
         last: Optional[Exception] = None
         saw_503 = False
+        err_429: Optional[InferenceClientError] = None
+        last_retry_after: Optional[float] = None
         for server in self.servers:
             for attempt in range(self._max_retries + 1):
                 try:
@@ -125,7 +157,26 @@ class InferenceClient:
                     continue
                 if resp.status_code == 503:
                     saw_503 = True
+                    last_retry_after = _retry_after_of(resp)
                     break  # capacity problem: next server, don't retry here
+                if resp.status_code == 429:
+                    # queue backpressure: the job was NOT created, so a
+                    # retry is safe even for non-idempotent POSTs. Honor
+                    # Retry-After as the backoff floor with full jitter on
+                    # top (no fleet-wide stampede when the hint expires);
+                    # once this server's retries are spent, fail over to
+                    # the next configured server exactly like the 503 path.
+                    retry_after = _retry_after_of(resp) or 1.0
+                    last_retry_after = retry_after
+                    if attempt < self._max_retries:
+                        self._sleep_backoff(attempt, floor_s=retry_after)
+                        continue
+                    err_429 = InferenceClientError(
+                        429, "server backpressure: queue saturated",
+                        retry_after_s=retry_after,
+                    )
+                    last = err_429
+                    break
                 if 400 <= resp.status_code < 500:
                     detail = ""
                     try:
@@ -143,10 +194,13 @@ class InferenceClient:
                         self._sleep_backoff(attempt)
                     continue
                 return resp
-            if not idempotent and not saw_503:
+            if not idempotent and not (saw_503 or err_429 is not None):
                 break  # no cross-server failover for effectful calls
+                #       (503/429 mean the job was never created — safe)
         if saw_503:
-            raise NoWorkersAvailable()
+            raise NoWorkersAvailable(retry_after_s=last_retry_after)
+        if err_429 is not None:
+            raise err_429  # every server backpressured: surface the hint
         raise InferenceClientError(599, f"all servers failed: {last}")
 
     # -- job lifecycle (reference :225-280) ----------------------------------

@@ -49,7 +49,8 @@ class ServerState:
                  api_key: Optional[str] = None,
                  admin_key: Optional[str] = None,
                  require_signing: bool = False,
-                 heartbeat_timeout_s: float = 90.0) -> None:
+                 heartbeat_timeout_s: float = 90.0,
+                 submit_queue_limit: int = 0) -> None:
         self.store = Store(db_path)
         self.security = SecurityService()
         self.reliability = ReliabilityService(self.store)
@@ -65,6 +66,12 @@ class ServerState:
         self.background = TaskGuaranteeBackgroundWorker(self.guarantee)
         self.geo = GeoService()
         self.worker_config = WorkerConfigService(self.store)
+        if submit_queue_limit:
+            # end-to-end backpressure: POST /jobs beyond this queue depth
+            # answers 429 + Retry-After instead of growing the queue
+            # silently (threshold lives on the fleet-default LoadControl —
+            # the same policy object the claim-side admission enforces)
+            self.worker_config.set_submit_queue_limit(submit_queue_limit)
         self.usage = UsageService(self.store)
         self.privacy = EnterprisePrivacyService(self.store)
         self.metrics = MetricsCollector()
@@ -78,15 +85,66 @@ class ServerState:
         # up holding the ORIGINAL's token hashes while the client keeps the
         # retry's tokens (instant lockout spiral)
         self.register_lock = asyncio.Lock()
+        # short-TTL queue-stats cache for the backpressure check: a 429
+        # FLOOD (the case backpressure exists for) must not pay two
+        # GROUP BY table scans per rejected request. Accepted submissions
+        # invalidate it, so admission decisions always see fresh depth.
+        self._bp_cache: Optional[tuple] = None   # (expires_at, stats)
         self.started_at = time.time()
+
+    def bp_cache_clear(self) -> None:
+        """Invalidate the backpressure queue-stats cache — called after any
+        accepted job creation so the next admission check reads the real
+        queue depth (rejections leave the depth unchanged, so the cache
+        stays valid through a rejection storm)."""
+        self._bp_cache = None
 
 
 def _state(request: web.Request) -> ServerState:
     return request.app["state"]
 
 
-def _json_error(status: int, detail: str) -> web.Response:
-    return web.json_response({"detail": detail}, status=status)
+def _json_error(status: int, detail: str,
+                retry_after_s: Optional[float] = None) -> web.Response:
+    """JSON error body; capacity-style rejections (429/503) carry a
+    machine-readable ``retry_after_s`` in the body AND the standard
+    ``Retry-After`` header, so the SDK has ONE retry contract for both."""
+    body: Dict[str, Any] = {"detail": detail}
+    headers = None
+    if retry_after_s is not None:
+        body["retry_after_s"] = round(float(retry_after_s), 3)
+        headers = {"Retry-After": str(max(1, int(-(-retry_after_s // 1))))}
+    return web.json_response(body, status=status, headers=headers)
+
+
+async def _submit_backpressure(st: ServerState) -> Optional[web.Response]:
+    """Queue-depth admission control for job submission: when the queue is
+    saturated (fleet-default ``LoadControl.max_queue_depth``), reject with
+    429 + Retry-After derived from current queue stats — real backpressure
+    instead of silent queue growth. Returns None when the job may enter."""
+    if st.worker_config.submit_queue_limit <= 0:
+        return None    # backpressure disabled: skip the queue-stats scans
+    now = time.time()
+    if st._bp_cache is not None and st._bp_cache[0] > now:
+        stats = st._bp_cache[1]
+    else:
+        stats = await st.store.queue_stats()
+        st._bp_cache = (now + 0.25, stats)
+    queued = int(stats.get("queued") or 0)
+    workers = stats.get("workers") or {}
+    active = int(workers.get("idle") or 0) + int(workers.get("busy") or 0)
+    ok, retry_after = st.worker_config.should_accept_submission(
+        queued, active
+    )
+    if ok:
+        return None
+    st.metrics.record_request("backpressure", "rejected")
+    return _json_error(
+        429,
+        f"queue saturated ({queued} jobs queued); retry after "
+        f"{retry_after:.1f}s",
+        retry_after_s=retry_after,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -279,6 +337,9 @@ async def heartbeat(request: web.Request) -> web.Response:
         # main._spec_engine_stats) → /metrics surfaces accept-rate and
         # tokens-per-step per worker
         st.metrics.record_spec_engine(worker_id, es)
+        # KV-pressure counters (preemptions / resumes / pressure events)
+        # ride the same payload → per-worker preemption panels in /metrics
+        st.metrics.record_pressure_engine(worker_id, es)
     client_version = int(body.get("config_version") or 0)
     changed = await st.worker_config.config_changed_since(
         worker_id, client_version
@@ -551,6 +612,8 @@ async def create_job(request: web.Request) -> web.Response:
     if (err := _check_api_key(request)) is not None:
         return err
     st = _state(request)
+    if (bp := await _submit_backpressure(st)) is not None:
+        return bp
     body = await request.json()
     row = await _make_job_row(request, body)
     if (row.get("params") or {}).get("pd_disaggregated"):
@@ -559,6 +622,7 @@ async def create_job(request: web.Request) -> web.Response:
         row["status"] = JobStatus.RUNNING.value
         row["started_at"] = time.time()
         job_id = await st.store.create_job(row)
+        st.bp_cache_clear()
         job = await st.store.get_job(job_id)
         try:
             await st.pd_flow.submit(job)
@@ -567,7 +631,10 @@ async def create_job(request: web.Request) -> web.Response:
                 job_id, status=JobStatus.FAILED.value, error=str(exc),
                 completed_at=time.time(),
             )
-            return _json_error(503, str(exc))
+            # machine-readable retry hint: PD placement failures are
+            # capacity problems (no prefill/decode pair free) — same retry
+            # contract as the 429 backpressure path
+            return _json_error(503, str(exc), retry_after_s=5.0)
         except Exception as exc:  # noqa: BLE001 — parent must not strand
             await st.store.update_job(
                 job_id, status=JobStatus.FAILED.value,
@@ -580,6 +647,7 @@ async def create_job(request: web.Request) -> web.Response:
             {"job_id": job_id, "status": "running", "pd": True}, status=201
         )
     job_id = await st.store.create_job(row)
+    st.bp_cache_clear()
     st.metrics.record_request(row["type"], "queued")
     return web.json_response({"job_id": job_id, "status": "queued"}, status=201)
 
@@ -590,13 +658,18 @@ async def create_job_sync(request: web.Request) -> web.Response:
     if (err := _check_api_key(request)) is not None:
         return err
     st = _state(request)
+    if (bp := await _submit_backpressure(st)) is not None:
+        return bp
     body = await request.json()
     stats = await st.scheduler.get_queue_stats()
     if stats["active_workers"] == 0:
-        return _json_error(503, "no workers available")
+        # a fleet with zero live workers drains nothing: tell clients to
+        # come back on the heartbeat-revival timescale, not instantly
+        return _json_error(503, "no workers available", retry_after_s=10.0)
     row = await _make_job_row(request, body)
     row["priority"] = row["priority"] + 10
     job_id = await st.store.create_job(row)
+    st.bp_cache_clear()
     timeout = min(float(body.get("timeout_seconds") or 120.0), 300.0)
     job = await st.guarantee.wait_for_job(job_id, timeout_s=timeout)
     if job is None:
@@ -1255,9 +1328,13 @@ def main() -> None:  # pragma: no cover - manual entry point
     ap.add_argument("--port", type=int, default=8000)
     ap.add_argument("--db", default="dgi_tpu.sqlite")
     ap.add_argument("--api-key", default=None)
+    ap.add_argument("--submit-queue-limit", type=int, default=0,
+                    help="reject job submissions with 429 + Retry-After "
+                         "past this queue depth (0 = unlimited)")
     args = ap.parse_args()
     web.run_app(
-        create_app(ServerState(db_path=args.db, api_key=args.api_key)),
+        create_app(ServerState(db_path=args.db, api_key=args.api_key,
+                               submit_queue_limit=args.submit_queue_limit)),
         host=args.host,
         port=args.port,
     )

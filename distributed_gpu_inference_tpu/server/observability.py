@@ -80,6 +80,7 @@ class Metrics:
                 "spec_accepted_tokens", "spec_drafted_tokens",
                 "spec_decode_steps", "spec_worker_accept_rate",
                 "spec_worker_tokens_per_step",
+                "kv_preemptions", "kv_resumes", "kv_pressure_events",
             ):
                 setattr(self, name, noop)
             return
@@ -142,6 +143,22 @@ class Metrics:
             "speculative_worker_tokens_per_step",
             "Committed tokens per verify step per worker (weight-stream "
             "amortization factor)", ["worker"], registry=r)
+        # KV-pressure recovery: preemption is a scheduling event, and these
+        # are its fleet health panel — a rising preemption rate means pools
+        # are running hot; preemptions without matching resumes mean
+        # requests are dying preempted_too_often
+        self.kv_preemptions = Counter(
+            "kv_preemptions_total",
+            "Sequences preempted under KV-block pressure", ["worker"],
+            registry=r)
+        self.kv_resumes = Counter(
+            "kv_resumes_total",
+            "Preempted sequences resumed (spill/cache restore)", ["worker"],
+            registry=r)
+        self.kv_pressure_events = Counter(
+            "kv_pressure_events_total",
+            "Step-boundary KV pressure signals (frozen slots / deferred "
+            "admissions)", ["worker"], registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -158,6 +175,7 @@ class MetricsCollector:
         # last-seen cumulative spec counters per worker: engines report
         # monotonic totals, Prometheus counters advance by deltas
         self._spec_prev: Dict[str, Dict[str, int]] = {}
+        self._pressure_prev: Dict[str, Dict[str, int]] = {}
 
     def record_request(self, job_type: str, status: str,
                        latency_s: Optional[float] = None) -> None:
@@ -237,6 +255,32 @@ class MetricsCollector:
                 return
             self.metrics.spec_worker_accept_rate.labels(worker).set(rate)
             self.metrics.spec_worker_tokens_per_step.labels(worker).set(tps)
+
+    def record_pressure_engine(self, worker: str,
+                               engine_stats: Dict[str, Any]) -> None:
+        """Ingest one worker engine's KV-pressure counters (heartbeat
+        ``engine_stats``: cumulative ``preemptions`` / ``resumes`` /
+        ``kv_pressure_events`` from ``TPUEngine.get_stats()`` or the
+        batcher) so ``/metrics`` surfaces per-worker preemption health.
+        Same delta-anchoring as the spec counters: totals re-anchor on
+        engine restart, malformed fields skip the sample, and a payload
+        with no pressure keys is a no-op."""
+        prev = self._pressure_prev.setdefault(worker, {})
+        for key, metric in (
+            ("preemptions", self.metrics.kv_preemptions),
+            ("resumes", self.metrics.kv_resumes),
+            ("kv_pressure_events", self.metrics.kv_pressure_events),
+        ):
+            if key not in engine_stats:
+                continue
+            try:
+                cur = int(engine_stats.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                continue
+            delta = cur - prev.get(key, 0)
+            if delta > 0:
+                metric.labels(worker).inc(delta)
+            prev[key] = cur
 
     def render(self) -> bytes:
         return self.metrics.render()

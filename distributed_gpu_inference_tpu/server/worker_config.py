@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import asdict, dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from .store import Store
 
@@ -35,6 +35,10 @@ class LoadControl:
     working_hours: Optional[list] = None  # [start_hour, end_hour] UTC or None
     task_type_weights: Dict[str, float] = field(default_factory=dict)
     cooldown_seconds: float = 0.0
+    # end-to-end backpressure: job submissions beyond this queue depth are
+    # rejected with 429 + Retry-After instead of growing the queue silently
+    # (the SDK's jittered backoff honors the hint). 0 = unlimited.
+    max_queue_depth: int = 0
 
 
 @dataclass
@@ -133,6 +137,34 @@ class WorkerConfigService:
         if w is None:
             return False
         return int(w.get("config_version") or 0) > version
+
+    # -- submission backpressure (same policy object should_accept_job
+    # enforces on the claim side; this is the client-facing half) ------------
+
+    @property
+    def submit_queue_limit(self) -> int:
+        """Fleet-default queue-depth ceiling for job submissions (0 =
+        backpressure disabled)."""
+        return int(self._defaults.load_control.max_queue_depth or 0)
+
+    def set_submit_queue_limit(self, limit: int) -> None:
+        self._defaults.load_control.max_queue_depth = int(limit)
+
+    def should_accept_submission(self, queued: int,
+                                 active_workers: int) -> Tuple[bool, float]:
+        """Queue-depth admission control for POST /jobs. Returns
+        ``(accept, retry_after_s)`` — when the fleet-default
+        ``LoadControl.max_queue_depth`` is exceeded the submission is
+        rejected and the hint estimates the drain time of the overflow
+        (queue beyond the limit, spread over live workers), clamped to
+        [1, 60] s so a burst never tells every client to come back at the
+        same instant far in the future."""
+        limit = self.submit_queue_limit
+        if limit <= 0 or queued < limit:
+            return True, 0.0
+        overflow = queued - limit + 1
+        retry_after = min(60.0, max(1.0, overflow / max(1, active_workers)))
+        return False, retry_after
 
     # -- server-side admission (reference worker_config.py:195) --------------
 

@@ -36,6 +36,10 @@ flap       unconditional drop for the next ``times`` hits — a
            server/link that is down for a window, then recovers.
 reorder    stream filter only: hold the message and deliver it
            right after the next delivered message (or last).
+pressure   KV allocator seam only: the hit sees a pool with zero
+           free blocks (``OutOfBlocksError`` at the call site) —
+           drives seeded preemption storms through the engine's
+           preempt → spill → resume recovery path.
 =========  =======================================================
 """
 
@@ -61,6 +65,7 @@ from typing import (
 
 _KINDS = {
     "drop", "delay", "error", "truncate", "duplicate", "flap", "reorder",
+    "pressure",
 }
 
 
@@ -311,6 +316,27 @@ def store_fault(site: str, **ctx: Any) -> bool:
 
         raise sqlite3.OperationalError(f"fault injected at {site}")
     raise ValueError(f"rule kind {rule.kind!r} unsupported at store seam")
+
+
+def kv_pressure(site: str, num_free: int, **ctx: Any) -> bool:
+    """KV block-allocator seam (``PagedKVCacheManager._pop_free_block``).
+    Returns True when THIS allocation must behave as pool-exhausted — the
+    caller raises ``OutOfBlocksError`` exactly as a genuinely full pool
+    would, exercising the engine's preempt → spill → resume recovery.
+    ``num_free`` rides in the trace context so a storm's firing points are
+    reproducible down to the observed pool state."""
+    plan = _ACTIVE
+    if plan is None:
+        return False
+    rule = plan.fire(site, num_free=num_free, **ctx)
+    if rule is None:
+        return False
+    if rule.kind == "pressure":
+        return True
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return False
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at kv seam")
 
 
 def mutate_bytes(site: str, data: bytes, **ctx: Any) -> bytes:

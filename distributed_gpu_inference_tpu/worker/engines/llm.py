@@ -538,9 +538,18 @@ class TPULLMEngine(LLMBaseEngine):
                 f"no adopted KV for key {key!r} — handoff never arrived"
             )
         eng = self.engine
-        while eng.slots[slot] is not None and \
-                eng.slots[slot].finish_reason is None:
-            eng.decode_multi()
+        try:
+            while eng.slots[slot] is not None and \
+                    eng.slots[slot].finish_reason is None:
+                eng.decode_multi()
+                self._raise_if_pressured(eng, slot)
+        except Exception:
+            # the job fails, so the adopted slot MUST be released — a
+            # leaked slot would hold its KV blocks forever and compound
+            # the very pressure that aborted it
+            if eng.slots[slot] is not None:
+                eng.finish_slot(slot, cache=False)
+            raise
         resp = eng.finish_slot(slot)
         text = self.tokenizer.decode(resp.token_ids) if self.tokenizer else ""
         return {
@@ -558,6 +567,24 @@ class TPULLMEngine(LLMBaseEngine):
                       "completion_tokens": resp.completion_tokens,
                       "total_tokens": resp.completion_tokens},
         }
+
+    @staticmethod
+    def _raise_if_pressured(eng: TPUEngine, slot: int) -> None:
+        """Single-sequence drivers (PD decode, token streaming) have no
+        scheduler above them to preempt a victim for: when the engine
+        freezes THIS slot at a pressure boundary, surface the pre-existing
+        OutOfBlocksError contract instead of spinning on empty rounds.
+        (The continuous batcher path recovers gracefully via
+        preempt → spill → resume; these paths report the job as failed
+        exactly as they did before pressure became a scheduling event.)"""
+        from ...runtime.kv_cache import OutOfBlocksError
+
+        p = eng.take_pressure()
+        if p is not None and slot in p.slots:
+            raise OutOfBlocksError(
+                f"KV pool exhausted while decoding slot {slot} and no "
+                "scheduler is attached to preempt for it"
+            )
 
     def kv_receiver(self, raw: bytes) -> Dict[str, Any]:
         """Data-plane ``/kv/transfer`` hook: adopt a pushed handoff into this
@@ -691,6 +718,7 @@ class TPULLMEngine(LLMBaseEngine):
                     self.engine.spec_decode_step()
                 else:
                     self.engine.decode_step()
+                self._raise_if_pressured(self.engine, slot)
         finally:
             resp = self.engine.finish_slot(slot)
         yield {

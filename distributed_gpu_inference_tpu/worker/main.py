@@ -321,12 +321,35 @@ class Worker:
         )
         return out
 
+    def _pressure_engine_stats(self) -> Optional[Dict[str, Any]]:
+        """KV-pressure recovery counters of every loaded paged engine
+        (cumulative preemptions / resumes / pressure events) — ride the
+        heartbeat so the control plane's ``/metrics`` shows which workers
+        run their pools hot. None when no loaded engine exposes the
+        counters (payload stays lean for non-LLM workers)."""
+        out: Dict[str, int] = {}
+        for eng in self.engines.values():
+            core = getattr(eng, "engine", None)
+            stats = getattr(core, "stats", None)
+            if not isinstance(stats, dict):
+                continue
+            for k in ("preemptions", "resumes", "kv_pressure_events"):
+                if k in stats:
+                    out[k] = out.get(k, 0) + int(stats.get(k, 0) or 0)
+        return out or None
+
     def _heartbeat_once(self) -> None:
         try:
             extra: Dict[str, Any] = {}
+            engine_stats: Dict[str, Any] = {}
             spec_stats = self._spec_engine_stats()
             if spec_stats:
-                extra["engine_stats"] = spec_stats
+                engine_stats.update(spec_stats)
+            pressure_stats = self._pressure_engine_stats()
+            if pressure_stats:
+                engine_stats.update(pressure_stats)
+            if engine_stats:
+                extra["engine_stats"] = engine_stats
             resp = self.api.heartbeat(
                 status=self.state.value,
                 config_version=self.config.config_version,

@@ -21,6 +21,8 @@ HEAVY = [
     "tests/test_parallel_pipeline.py",
     "tests/test_parallel_ring_attention.py",
     "tests/test_engine_spec_integrated.py",  # spec scan graphs x 2 engines
+    "tests/test_engine_preemption.py",   # preempt/resume byte-identity runs
+    "tests/test_kv_pressure_chaos.py",   # 25-seed kv_pressure storms
     "tests/test_model_moe.py",
     "tests/test_kv_handoff_stream.py",
     "tests/test_engine_tp.py",

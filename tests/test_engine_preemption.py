@@ -1,0 +1,381 @@
+"""KV-pressure-safe serving: sequence preemption, spill-and-resume, and
+step-boundary OutOfBlocks handling.
+
+The deterministic acceptance suite for the preemption layer:
+
+- mid-decode exhaustion is a *signal* (``KVPressure``), never an unwind
+  with partial engine state — slots / ``_kv_lens`` / block tables stay
+  consistent after the freeze;
+- with the pool sized to force preemptions, every request completes and
+  greedy outputs are BYTE-IDENTICAL to an unpressured run of the same
+  prompts (seeded sampled runs are seed-stable the same way);
+- the spill tier actually carries evicted pages across the preemption
+  (resume restores via prefix cache / ``_probe_spill``, not recompute).
+
+One module-scoped reference engine amortizes the jit compiles; the tiny
+pressured engines share its graphs via the jit cache.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.pressure
+
+from distributed_gpu_inference_tpu.runtime.batcher import (
+    BatcherConfig,
+    ContinuousBatcher,
+)
+from distributed_gpu_inference_tpu.runtime.engine import (
+    EngineConfig,
+    TPUEngine,
+)
+from distributed_gpu_inference_tpu.runtime.kv_cache import OutOfBlocksError
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+
+
+def _reqs(n=4, max_new=40, temp=0.0, seed=None, prio=0):
+    return [
+        InferenceRequest(
+            request_id=f"r{i}",
+            prompt_token_ids=list(range(10 + i * 3, 26 + i * 3)),
+            priority=prio,
+            sampling=SamplingParams(
+                max_new_tokens=max_new, temperature=temp,
+                seed=(seed + i) if seed is not None else None,
+            ),
+        )
+        for i in range(n)
+    ]
+
+
+def _small_cfg(**kw):
+    """Pool sized to exhaust mid-decode: 4 sequences x 56 tokens need 16
+    blocks of 16; the pool has 8 usable (+pad). Host spill tier on, so
+    preempted pages survive eviction."""
+    base = dict(
+        max_batch_size=4, max_seq_len=128, prefill_buckets=(16, 32),
+        multi_step=4, num_blocks=9, block_size=16, spill_host_blocks=64,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """Unpressured reference outputs (greedy + seeded sampled)."""
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=4, max_seq_len=128,
+                     prefill_buckets=(16, 32), multi_step=4),
+    )
+    greedy = {r.request_id: r.token_ids
+              for r in eng.generate(_reqs(), use_multi_step=True)}
+    sampled = {r.request_id: r.token_ids
+               for r in eng.generate(_reqs(temp=0.8, seed=77),
+                                     use_multi_step=True)}
+    return {"greedy": greedy, "sampled": sampled}
+
+
+def _assert_consistent(eng):
+    """No partial engine state: every live slot's host mirrors agree with
+    the manager's accounting."""
+    for i, s in enumerate(eng.slots):
+        if s is None:
+            assert eng._kv_lens[i] == 0
+            continue
+        blocks = eng.manager.seq_blocks[s.seq_id]
+        table = eng._block_tables[i]
+        assert list(table[: len(blocks)]) == blocks
+        committed = int(eng._kv_lens[i])
+        toks = eng.manager.seq_tokens[s.seq_id]
+        # committed tokens + at most one pending sample
+        assert committed <= len(toks) <= committed + 1
+        # every committed+pending position has a backing block
+        assert len(blocks) * eng.cfg.block_size >= len(toks)
+
+
+def test_mid_decode_exhaustion_is_a_signal_not_an_unwind():
+    eng = TPUEngine("llama3-tiny", _small_cfg())
+    slots = eng.submit_batch(_reqs(n=2, max_new=60), partial=True)
+    assert len(slots) == 2
+    # burn the pool down with decode rounds until pressure fires
+    pressure = None
+    for _ in range(64):
+        eng.decode_multi(4)
+        pressure = eng.take_pressure()
+        if pressure is not None:
+            break
+        if all(s is None or s.finish_reason is not None for s in eng.slots):
+            pytest.skip("pool never pressured — config drifted")
+    assert pressure is not None and pressure.source == "decode"
+    assert pressure.slots, "pressure must name the frozen slots"
+    # the freeze left NO partial state: mirrors consistent, frozen slots
+    # still resumable, nothing half-reserved
+    _assert_consistent(eng)
+    # and a frozen slot preempts + resumes cleanly
+    victim = pressure.slots[0]
+    before = list(eng.slots[victim].generated)
+    pre = eng.preempt_slot(victim)
+    assert pre.generated == before
+    _assert_consistent(eng)
+    # the victim's blocks went back to the pool (reclaimable)
+    assert eng.manager.num_reclaimable > 0
+
+
+def test_generate_under_pressure_byte_identical_greedy(reference):
+    eng = TPUEngine("llama3-tiny", _small_cfg())
+    out = eng.generate(_reqs(), use_multi_step=True)
+    assert eng.stats["preemptions"] >= 2, (
+        "pool must force >= 2 preemptions for this to test anything: "
+        f"{eng.stats}"
+    )
+    assert eng.stats["resumes"] == eng.stats["preemptions"]
+    for r in out:
+        assert r.error is None
+        assert r.token_ids == reference["greedy"][r.request_id]
+    # spill-and-resume actually engaged: restored pages came from the
+    # prefix cache or the host tier rather than full recompute
+    kv = eng.manager.get_stats()
+    assert kv["spills"] > 0
+    assert kv["l1_hits"] + kv["l2_hits"] > 0
+
+
+def test_generate_per_step_path_byte_identical(reference):
+    eng = TPUEngine("llama3-tiny", _small_cfg())
+    out = eng.generate(_reqs(), use_multi_step=False)
+    assert eng.stats["preemptions"] >= 1
+    for r in out:
+        assert r.error is None
+        assert r.token_ids == reference["greedy"][r.request_id]
+
+
+def test_seeded_sampled_continuation_is_seed_stable(reference):
+    eng = TPUEngine("llama3-tiny", _small_cfg())
+    out = eng.generate(_reqs(temp=0.8, seed=77), use_multi_step=True)
+    assert eng.stats["preemptions"] >= 1
+    for r in out:
+        assert r.error is None
+        assert r.token_ids == reference["sampled"][r.request_id]
+
+
+def test_resume_without_spill_tier_recomputes_identically(reference):
+    """No host store, prefix cache off: resume falls back to full
+    recompute and the greedy continuation is still byte-identical."""
+    eng = TPUEngine(
+        "llama3-tiny",
+        _small_cfg(spill_host_blocks=0, enable_prefix_cache=False),
+    )
+    out = eng.generate(_reqs(), use_multi_step=True)
+    assert eng.stats["preemptions"] >= 1
+    for r in out:
+        assert r.error is None
+        assert r.token_ids == reference["greedy"][r.request_id]
+
+
+def test_preempted_sequence_response_metadata_survives():
+    """prompt_tokens / completion_tokens / TTFT origin describe the
+    ORIGINAL request, not the resume prompt."""
+    eng = TPUEngine("llama3-tiny", _small_cfg())
+    out = eng.generate(_reqs(max_new=40), use_multi_step=True)
+    assert eng.stats["preemptions"] >= 1
+    for r in out:
+        assert r.prompt_tokens == 16
+        assert r.completion_tokens == 40
+        assert r.ttft_ms is not None and r.ttft_ms >= 0.0
+
+
+def test_preempt_slot_api_contract():
+    eng = TPUEngine("llama3-tiny", _small_cfg())
+    with pytest.raises(ValueError):
+        eng.preempt_slot(0)              # empty slot
+    [slot] = eng.submit_batch(_reqs(n=1, max_new=8), partial=True)
+    pre = eng.preempt_slot(slot)
+    assert eng.slots[slot] is None
+    assert pre.generated, "first sampled token rides the freeze"
+    # resume continues to completion
+    slot2 = eng.resume(pre)
+    while eng.slots[slot2] is not None and \
+            eng.slots[slot2].finish_reason is None:
+        eng.decode_multi(4)
+    resp = eng.finish_slot(slot2)
+    assert resp.completion_tokens == 8
+    # requests counted once despite the resume
+    assert eng.stats["requests"] == 1
+    assert eng.stats["resumes"] == 1
+
+
+def test_batcher_pressure_all_complete_byte_identical(reference):
+    """The serving-layer acceptance: queue depth > slots > pool, every
+    request completes with zero client-visible OutOfBlocksError and
+    greedy outputs match the unpressured reference."""
+    import asyncio
+
+    eng = TPUEngine("llama3-tiny", _small_cfg())
+
+    async def drive():
+        # max_preemptions raised: this test asserts the HAPPY recovery path
+        # (every request completes identically); the cap's error behavior
+        # has its own test below
+        b = ContinuousBatcher(
+            eng, BatcherConfig(max_wait_ms=5, max_preemptions=20)
+        )
+        # queue ALL requests before the loop starts: one wave of 4 admits
+        # together, so the pool MUST pressure (4 x 4 blocks vs 8 usable) —
+        # timing can't quietly serialize the admissions
+        tasks = [asyncio.ensure_future(b.submit(r)) for r in _reqs(n=4)]
+        await asyncio.sleep(0.01)
+        b.start()
+        outs = await asyncio.gather(*tasks)
+        stats = b.get_stats()
+        await b.stop()
+        return outs, stats
+
+    outs, stats = asyncio.run(drive())
+    for o in outs:
+        assert o.error is None, o.error
+        assert o.token_ids == reference["greedy"][o.request_id]
+    assert stats["preemptions"] >= 1
+    assert stats["resumes"] == stats["preemptions"]
+    assert stats["preemption_block_pressure"] >= 1
+    assert stats["preempted_too_often"] == 0
+    assert stats["completed"] == 4
+
+
+def test_batcher_victim_policy_lowest_priority_lifo():
+    """Victim choice: lowest priority first; LIFO between equals."""
+    import asyncio
+
+    eng = TPUEngine("llama3-tiny", _small_cfg())
+
+    async def drive():
+        b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=20))
+        b.start()
+        hi = [InferenceRequest(
+            request_id=f"hi{i}", priority=5,
+            prompt_token_ids=list(range(10 + i, 26 + i)),
+            sampling=SamplingParams(max_new_tokens=40)) for i in range(2)]
+        lo = [InferenceRequest(
+            request_id=f"lo{i}", priority=0,
+            prompt_token_ids=list(range(40 + i, 56 + i)),
+            sampling=SamplingParams(max_new_tokens=40)) for i in range(2)]
+        outs = await asyncio.gather(*[b.submit(r) for r in hi + lo])
+        # which requests got preempted is visible via preempt counters on
+        # the batcher stats; victims must all be low-priority
+        victims = drive.victims
+        stats = b.get_stats()
+        await b.stop()
+        return outs, stats, victims
+
+    # spy on preempt_slot to record victim priorities
+    drive.victims = []
+    orig = eng.preempt_slot
+
+    def spy(slot):
+        s = eng.slots[slot]
+        drive.victims.append(s.request.priority)
+        return orig(slot)
+
+    eng.preempt_slot = spy
+    outs, stats, victims = asyncio.run(drive())
+    for o in outs:
+        assert o.error is None
+    if victims:        # pressure timing-dependent, but when it fires...
+        assert all(p == 0 for p in victims), victims
+
+
+def test_preempted_too_often_errors_distinctly():
+    """A pool that cannot sustain the working set kills the thrashing
+    request with the distinct preempted_too_often reason, not a generic
+    engine error — and the others still complete."""
+    import asyncio
+
+    # 2 slots, pool worth ~6 usable blocks, both sequences need 4+ blocks
+    # at full length → endless mutual eviction without the cap
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=2, max_seq_len=128,
+                     prefill_buckets=(16, 32), multi_step=4,
+                     num_blocks=6, block_size=16, spill_host_blocks=64),
+    )
+
+    async def drive():
+        b = ContinuousBatcher(
+            eng, BatcherConfig(max_wait_ms=5, max_preemptions=2)
+        )
+        b.start()
+        outs = await asyncio.gather(
+            *[b.submit(r) for r in _reqs(n=2, max_new=60)]
+        )
+        stats = b.get_stats()
+        await b.stop()
+        return outs, stats
+
+    outs, stats = asyncio.run(drive())
+    errors = [o for o in outs if o.error]
+    assert stats["completed"] == 2
+    if errors:
+        assert all("preempted_too_often" in o.error for o in errors)
+        assert stats["preempted_too_often"] == len(errors)
+        # the killed request still reports the tokens it had generated
+        assert all(o.finish_reason == "abort" for o in errors)
+    ok = [o for o in outs if not o.error]
+    assert ok, "at least one sequence must complete"
+    assert all(len(o.token_ids) == 60 for o in ok)
+
+
+def test_oversized_request_errors_cleanly_not_livelock():
+    """Capacity limits degrade gracefully, never livelock to a timeout:
+    a prompt that cannot fit an idle pool is rejected up front; a request
+    whose GENERATION outgrows the pool terminates with a distinct
+    capacity/preemption error carrying the partial output. (max_new_tokens
+    alone never pre-rejects — it is a cap, not a promise.)"""
+    import asyncio
+
+    eng = TPUEngine(
+        "llama3-tiny",
+        EngineConfig(max_batch_size=2, max_seq_len=128,
+                     prefill_buckets=(16, 32), multi_step=4,
+                     num_blocks=3, block_size=16),
+    )
+
+    async def drive():
+        b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=1))
+        b.start()
+        # prompt needs 3 blocks (pool has 2 usable): immediate rejection
+        too_big = await b.submit(
+            InferenceRequest(
+                prompt_token_ids=list(range(40)),
+                sampling=SamplingParams(max_new_tokens=8),
+            ),
+            timeout_s=30.0,
+        )
+        # prompt fits, generation outgrows the pool: terminates with the
+        # partial output and a capacity/preemption error, well before the
+        # 30s client timeout
+        outgrows = await b.submit(
+            InferenceRequest(
+                prompt_token_ids=list(range(30)),
+                sampling=SamplingParams(max_new_tokens=60),
+            ),
+            timeout_s=30.0,
+        )
+        # a request that DOES fit still serves normally on the same batcher
+        ok = await b.submit(
+            InferenceRequest(
+                prompt_token_ids=list(range(16)),
+                sampling=SamplingParams(max_new_tokens=4),
+            ),
+            timeout_s=30.0,
+        )
+        await b.stop(drain=False)
+        return too_big, outgrows, ok
+
+    too_big, outgrows, ok = asyncio.run(drive())
+    assert too_big.error is not None and "KV pool capacity" in too_big.error
+    assert outgrows.error is not None and "timeout" not in outgrows.error
+    assert ("KV pool capacity" in outgrows.error
+            or "preempted_too_often" in outgrows.error)
+    assert ok.error is None and len(ok.token_ids) == 4

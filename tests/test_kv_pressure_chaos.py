@@ -1,0 +1,319 @@
+"""Seeded kv_pressure chaos: preempt → spill → resume storms driven through
+the ``kv.block.alloc`` fault site (testing/faults.py kind="pressure"), plus
+the control-plane half of the story — preemption counters riding worker
+heartbeats into ``/metrics`` and the 429/Retry-After backpressure contract —
+through the loopback harness (testing/harness.py).
+
+The storm scenarios are a function of a seed: the FaultPlan's RNG decides
+which block allocations see an exhausted pool; the engine must recover every
+one of them via preemption + spill-and-resume with ZERO client-visible
+OutOfBlocksError, and same seed ⇒ same fault trace (the determinism contract
+every chaos suite here asserts).
+
+One module-scoped engine amortizes jit compiles; cache/spill state is reset
+between seeds so each replay starts cold and traces reproduce exactly.
+"""
+
+import asyncio
+import time
+from typing import Any, Dict, List, Tuple
+
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.batcher import (
+    BatcherConfig,
+    ContinuousBatcher,
+)
+from distributed_gpu_inference_tpu.runtime.engine import (
+    EngineConfig,
+    TPUEngine,
+)
+from distributed_gpu_inference_tpu.sdk.client import (
+    InferenceClient,
+    InferenceClientError,
+)
+from distributed_gpu_inference_tpu.testing import faults
+from distributed_gpu_inference_tpu.testing.faults import FaultPlan, FaultRule
+from distributed_gpu_inference_tpu.testing.harness import LiveControlPlane
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    SamplingParams,
+)
+from distributed_gpu_inference_tpu.worker.api_client import APIClient
+
+pytestmark = [pytest.mark.chaos, pytest.mark.pressure]
+
+N_SEEDS = 25
+DET_SEED = 4321
+
+
+_ENGINE = None
+
+
+def _engine() -> TPUEngine:
+    global _ENGINE
+    if _ENGINE is None:
+        _ENGINE = TPUEngine(
+            "llama3-tiny",
+            EngineConfig(max_batch_size=4, max_seq_len=128,
+                         prefill_buckets=(16, 32), multi_step=4,
+                         num_blocks=24, block_size=16,
+                         spill_host_blocks=64),
+        )
+    return _ENGINE
+
+
+def _reset(eng: TPUEngine) -> None:
+    """Cold-start the cache/spill state so every seeded replay sees the
+    same pool and produces the same trace."""
+    assert eng.num_active == 0
+    eng._apply_pending()
+    eng.manager.clear_cached(spill=False)
+    if eng.manager.host_store is not None:
+        eng.manager.host_store._store.clear()
+
+
+def _reqs(seed: int, n: int = 6, max_new: int = 24) -> List[InferenceRequest]:
+    return [
+        InferenceRequest(
+            request_id=f"s{seed}-r{i}",
+            prompt_token_ids=[(seed * 7 + i * 13 + j) % 200 + 4
+                              for j in range(16)],
+            sampling=SamplingParams(max_new_tokens=max_new),
+        )
+        for i in range(n)
+    ]
+
+
+def _plan(seed: int) -> FaultPlan:
+    return FaultPlan(seed, [
+        # a bounded storm: after the first few allocations, ~20% of block
+        # allocs see an exhausted pool, for at most 8 firings — enough to
+        # force several preempt/resume cycles, finite so every request
+        # drains once the storm passes
+        FaultRule(site="kv.block.alloc", kind="pressure", prob=0.2,
+                  after=6, times=8),
+    ])
+
+
+def _trace(plan: FaultPlan) -> List[Tuple[str, str, str]]:
+    return list(plan.trace)
+
+
+def scenario_kv_pressure(seed: int) -> Dict[str, Any]:
+    """One seeded storm through the engine's own scheduler (generate):
+    injected exhaustion at the allocator → step-boundary freeze → preempt →
+    spill → resume → every request completes, zero client errors."""
+    eng = _engine()
+    _reset(eng)
+    plan = _plan(seed)
+    p0 = eng.stats["preemptions"]
+    r0 = eng.stats["resumes"]
+    with faults.active(plan):
+        outs = eng.generate(_reqs(seed), use_multi_step=True,
+                            max_preemptions=50)
+    for o in outs:
+        assert o.error is None, (seed, o.error)
+        assert len(o.token_ids) == 24, (seed, len(o.token_ids))
+    preempts = eng.stats["preemptions"] - p0
+    resumes = eng.stats["resumes"] - r0
+    assert resumes == preempts          # nothing stays frozen
+    return {
+        "fired": sum(r.fired for r in plan.rules),
+        "preemptions": preempts,
+        "trace": _trace(plan),
+    }
+
+
+def test_kv_pressure_storm_25_seeds():
+    outcomes = [scenario_kv_pressure(s) for s in range(N_SEEDS)]
+    # the storm actually bit: faults fired in most seeds and at least some
+    # seeds recovered via real preemptions
+    assert sum(1 for o in outcomes if o["fired"]) >= N_SEEDS // 2
+    assert any(o["preemptions"] > 0 for o in outcomes)
+
+
+def test_kv_pressure_same_seed_same_trace():
+    first = scenario_kv_pressure(DET_SEED)
+    second = scenario_kv_pressure(DET_SEED)
+    assert first == second
+
+
+def test_kv_pressure_batcher_end_to_end():
+    """The same storm through the full serving path (ContinuousBatcher):
+    async timing makes traces non-deterministic here, so this asserts the
+    OUTCOME contract only — every request completes, no client errors,
+    counters reconcile."""
+    eng = _engine()
+    for seed in range(4):
+        _reset(eng)
+        plan = _plan(seed)
+
+        async def drive():
+            b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=2,
+                                                     max_preemptions=50))
+            b.start()
+            with faults.active(plan):
+                outs = await asyncio.gather(
+                    *[b.submit(r, timeout_s=60.0) for r in _reqs(seed)]
+                )
+            stats = b.get_stats()
+            await b.stop()
+            return outs, stats
+
+        outs, stats = asyncio.run(drive())
+        for o in outs:
+            assert o.error is None, (seed, o.error)
+            assert len(o.token_ids) == 24
+        assert stats["completed"] == 6
+        assert stats["resumes"] == stats["preemptions"]
+
+
+# ---------------------------------------------------------------------------
+# control-plane half: counters → heartbeat → /metrics, and 429 backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_counters_reach_metrics():
+    """Worker heartbeats carry the engine's preemption counters; the
+    control plane's /metrics surfaces them per worker (delta-anchored, so a
+    second heartbeat with higher totals adds only the delta)."""
+    import httpx
+
+    with LiveControlPlane() as cp:
+        a = APIClient(cp.url, worker_id="w-kv", backoff_s=0.0)
+        a.register({"name": "wkv", "region": "us-west",
+                    "supported_types": ["llm"]})
+        a.heartbeat(status="idle", engine_stats={
+            "preemptions": 3, "resumes": 2, "kv_pressure_events": 5,
+        })
+        body = httpx.get(f"{cp.url}/metrics").text
+        assert 'kv_preemptions_total{worker="w-kv"} 3.0' in body
+        assert 'kv_resumes_total{worker="w-kv"} 2.0' in body
+        assert 'kv_pressure_events_total{worker="w-kv"} 5.0' in body
+        # cumulative totals re-report: only the delta lands
+        a.heartbeat(status="idle", engine_stats={
+            "preemptions": 7, "resumes": 7, "kv_pressure_events": 6,
+        })
+        body = httpx.get(f"{cp.url}/metrics").text
+        assert 'kv_preemptions_total{worker="w-kv"} 7.0' in body
+        assert 'kv_resumes_total{worker="w-kv"} 7.0' in body
+        a.close()
+
+
+def test_storm_counters_flow_to_metrics_end_to_end():
+    """The full loop: a real storm's engine counters ride a real heartbeat
+    through the loopback control plane into /metrics."""
+    import httpx
+
+    out = scenario_kv_pressure(DET_SEED)
+    eng = _engine()
+    with LiveControlPlane() as cp:
+        a = APIClient(cp.url, worker_id="w-storm", backoff_s=0.0)
+        a.register({"name": "ws", "region": "us-west",
+                    "supported_types": ["llm"]})
+        a.heartbeat(status="idle", engine_stats={
+            k: eng.stats[k]
+            for k in ("preemptions", "resumes", "kv_pressure_events")
+        })
+        body = httpx.get(f"{cp.url}/metrics").text
+        assert f'kv_preemptions_total{{worker="w-storm"}} '\
+               f'{float(eng.stats["preemptions"])}' in body
+        a.close()
+    assert out["preemptions"] >= 0
+
+
+def test_submit_backpressure_429_with_retry_after():
+    """Queue saturation answers 429 with BOTH the Retry-After header and a
+    machine-readable retry_after_s body — and clears once the queue
+    drains."""
+    import httpx
+
+    with LiveControlPlane(submit_queue_limit=3) as cp:
+        sdk = InferenceClient(cp.url, backoff_s=0.0, max_retries=0)
+        for _ in range(3):
+            sdk.create_job("llm", {"prompt": "x"})
+        # 4th submission: raw HTTP shows the full contract
+        r = httpx.post(f"{cp.url}/api/v1/jobs",
+                       json={"type": "llm", "params": {}})
+        assert r.status_code == 429
+        body = r.json()
+        assert body["retry_after_s"] >= 1.0
+        assert int(r.headers["Retry-After"]) >= 1
+        # the SDK surfaces it typed, with the hint attached
+        with pytest.raises(InferenceClientError) as ei:
+            sdk.create_job("llm", {"prompt": "y"})
+        assert ei.value.status == 429
+        assert ei.value.retry_after_s and ei.value.retry_after_s >= 1.0
+        # a worker drains one job → submissions flow again (the admission
+        # check caches queue stats for 250 ms to survive rejection floods,
+        # so wait past the TTL — well inside the >= 1 s Retry-After the
+        # contract already told clients to honor)
+        a = APIClient(cp.url, worker_id="w-a", backoff_s=0.0)
+        a.register({"name": "wa", "region": "us-west",
+                    "supported_types": ["llm"]})
+        job = a.fetch_next_job()
+        a.complete_job(job["id"], success=True, result={"text": "ok"})
+        time.sleep(0.3)
+        assert sdk.create_job("llm", {"prompt": "z"})
+        a.close()
+        sdk.close()
+
+
+def test_sdk_retries_429_honoring_retry_after():
+    """429 means the job was NOT created: the SDK may retry even the
+    non-idempotent POST /jobs, waiting at least the server's hint (full
+    jitter rides on top)."""
+    import random
+
+    import httpx
+
+    calls = {"n": 0}
+    slept: List[float] = []
+
+    def handler(request: httpx.Request) -> httpx.Response:
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return httpx.Response(
+                429, json={"detail": "queue saturated",
+                           "retry_after_s": 0.01},
+                headers={"Retry-After": "1"},
+            )
+        return httpx.Response(201, json={"job_id": "j1",
+                                         "status": "queued"})
+
+    sdk = InferenceClient(
+        "http://test", transport=httpx.MockTransport(handler),
+        backoff_s=0.0, max_retries=2, rng=random.Random(0),
+    )
+    orig_sleep = time.sleep
+    try:
+        time.sleep = lambda s: slept.append(s)
+        job_id = sdk.create_job("llm", {"prompt": "x"})
+    finally:
+        time.sleep = orig_sleep
+    assert job_id == "j1"
+    assert calls["n"] == 2
+    # waited at least the machine-readable hint (body wins over header)
+    assert slept and slept[0] >= 0.01
+    sdk.close()
+
+
+def test_503_carries_retry_after_contract():
+    """The pre-existing 503 capacity paths share the retry contract: the
+    body carries retry_after_s and NoWorkersAvailable exposes it."""
+    import httpx
+
+    from distributed_gpu_inference_tpu.sdk.client import NoWorkersAvailable
+
+    with LiveControlPlane() as cp:
+        r = httpx.post(f"{cp.url}/api/v1/jobs/sync",
+                       json={"type": "llm", "params": {}})
+        assert r.status_code == 503
+        assert r.json()["retry_after_s"] > 0
+        assert "Retry-After" in r.headers
+        sdk = InferenceClient(cp.url, backoff_s=0.0, max_retries=0)
+        with pytest.raises(NoWorkersAvailable) as ei:
+            sdk._run_job("llm", {"prompt": "x"}, sync=True, timeout_s=5.0)
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        sdk.close()

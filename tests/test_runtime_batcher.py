@@ -134,9 +134,9 @@ def test_priority_admission(engine):
     order = []
     orig_submit_batch = small.submit_batch
 
-    def tracking_submit_batch(requests):
+    def tracking_submit_batch(requests, partial=False):
         order.extend(r.priority for r in requests)
-        return orig_submit_batch(requests)
+        return orig_submit_batch(requests, partial=partial)
 
     small.submit_batch = tracking_submit_batch
 
