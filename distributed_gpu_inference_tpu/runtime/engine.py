@@ -231,9 +231,16 @@ class KVPressure:
     requests: int = 0                                # admissions deferred
 
 
+#: wire version of the portable checkpoint format. Bump when a field's
+#: meaning changes; ``from_wire`` refuses unknown versions so a newer
+#: worker's checkpoint can never be silently mis-resumed by an older one.
+CHECKPOINT_WIRE_VERSION = 1
+
+
 @dataclass
 class PreemptedSequence:
-    """A running sequence frozen by :meth:`TPUEngine.preempt_slot`.
+    """A running sequence frozen by :meth:`TPUEngine.preempt_slot` (or
+    snapshotted live by :meth:`TPUEngine.snapshot_slot`).
 
     Carries everything needed for a byte-identical greedy (and seed-stable
     sampled) continuation through :meth:`TPUEngine.resume`: the original
@@ -242,6 +249,12 @@ class PreemptedSequence:
     in the prefix cache (and spill to the host tier under further
     pressure), so resume restores them via the radix index / ``_probe_spill``
     instead of recomputing the whole context.
+
+    The state is also PORTABLE: :meth:`to_wire` / :meth:`from_wire` give a
+    versioned JSON-safe encoding workers piggyback on heartbeats to the
+    control plane, so a sequence can resume on a DIFFERENT engine after its
+    worker dies (KV restored through the prefix cache / spill tiers when
+    reachable, deterministic uncached-suffix recompute otherwise).
     """
 
     request: InferenceRequest
@@ -252,6 +265,61 @@ class PreemptedSequence:
     first_token_time: Optional[float]
     cached_tokens: int
     preempt_count: int = 0                # maintained by the scheduler layer
+
+    def to_wire(self) -> Dict[str, Any]:
+        """Versioned JSON-safe checkpoint (numbers, strings, lists only —
+        it crosses HTTP and lands in a TEXT column)."""
+        r = self.request
+        return {
+            "v": CHECKPOINT_WIRE_VERSION,
+            "request": {
+                "request_id": r.request_id,
+                "model": r.model,
+                "prompt_token_ids": list(r.prompt_token_ids or []),
+                "sampling": r.sampling.to_dict(),
+                "priority": r.priority,
+                "session_id": r.session_id,
+            },
+            "prompt_len": self.prompt_len,
+            "generated": list(self.generated),
+            "slot_key": [int(self.slot_key[0]), int(self.slot_key[1])],
+            "start_time": self.start_time,
+            "first_token_time": self.first_token_time,
+            "cached_tokens": self.cached_tokens,
+            "preempt_count": self.preempt_count,
+        }
+
+    @classmethod
+    def from_wire(cls, data: Dict[str, Any]) -> "PreemptedSequence":
+        if not isinstance(data, dict):
+            raise ValueError("checkpoint must be a dict")
+        ver = data.get("v")
+        if ver != CHECKPOINT_WIRE_VERSION:
+            raise ValueError(
+                f"unsupported checkpoint version {ver!r} (this build "
+                f"speaks v{CHECKPOINT_WIRE_VERSION})"
+            )
+        r = data["request"]
+        request = InferenceRequest(
+            request_id=r["request_id"],
+            model=r.get("model"),
+            prompt_token_ids=[int(t) for t in (r.get("prompt_token_ids")
+                                               or [])],
+            sampling=SamplingParams.from_dict(r["sampling"]),
+            priority=int(r.get("priority") or 0),
+            session_id=r.get("session_id"),
+        )
+        key = data.get("slot_key") or [0, 0]
+        return cls(
+            request=request,
+            prompt_len=int(data["prompt_len"]),
+            generated=[int(t) for t in (data.get("generated") or [])],
+            slot_key=(int(key[0]), int(key[1])),
+            start_time=data.get("start_time"),
+            first_token_time=data.get("first_token_time"),
+            cached_tokens=int(data.get("cached_tokens") or 0),
+            preempt_count=int(data.get("preempt_count") or 0),
+        )
 
 
 class TPUEngine:
@@ -1223,6 +1291,36 @@ class TPUEngine:
         / admission attempt and reacts per its preemption policy."""
         p, self._pressure = self._pressure, None
         return p
+
+    def snapshot_slot(self, slot: int) -> PreemptedSequence:
+        """Non-destructive checkpoint of a LIVE slot: the same portable state
+        :meth:`preempt_slot` captures, but the slot keeps decoding. This is
+        the worker-failover checkpoint source — the snapshot rides to the
+        control plane and, should this worker die, :meth:`resume` on a
+        replacement engine recomputes the uncached suffix and continues
+        byte-identically (greedy) / seed-stably (sampled).
+
+        ``generated`` may include the pending token (sampled, KV unwritten);
+        resume treats the whole list as prompt suffix and recomputes, so the
+        distinction never leaks. Mid-prefill and finished slots have nothing
+        useful to checkpoint and are rejected."""
+        s = self.slots[slot]
+        if s is None:
+            raise ValueError(f"slot {slot} is empty")
+        if s.prefilling:
+            raise ValueError(f"slot {slot} is mid-prefill")
+        if s.finish_reason is not None:
+            raise ValueError(f"slot {slot} already finished")
+        return PreemptedSequence(
+            request=s.request,
+            prompt_len=s.prompt_len,
+            generated=list(s.generated),
+            slot_key=(int(self._slot_keys[slot, 0]),
+                      int(self._slot_keys[slot, 1])),
+            start_time=s.start_time,
+            first_token_time=s.first_token_time,
+            cached_tokens=s.cached_tokens,
+        )
 
     def preempt_slot(self, slot: int) -> PreemptedSequence:
         """Freeze a RUNNING sequence and release its device blocks — the

@@ -772,10 +772,21 @@ class HandoffReceiver:
     # the documented ~4 MB/s tunnel rate even a 2 MB block lands well
     # inside this window), so only stalled/adversarial streams hit it.
     SESSION_MAX_NO_PROGRESS_S = 10 * 180.0
+    # adopt-session count cap, enforced at ``_begin``: a flood of begins
+    # (crashed donors that never send their abort, or a buggy peer
+    # re-opening sessions) must not pin unbounded KV blocks while each
+    # waits out its TTL — past the cap the stalest session is evicted to
+    # make room. Sized well above any sane concurrent-migration fan-in.
+    MAX_SESSIONS = 32
 
     def __init__(self, engine: "TPUEngine") -> None:
         self.engine = engine
         self._sessions: Dict[str, _AdoptSession] = {}
+        # sessions_purged: abandoned migrations reclaimed (TTL, no-progress
+        # backstop, or count-cap eviction) — exported via worker heartbeats
+        # as kv_handoff_sessions_purged_total so they are VISIBLE, not just
+        # silently garbage-collected
+        self.stats: Dict[str, int] = {"sessions_purged": 0}
 
     def handle(self, raw: bytes) -> Dict[str, Any]:
         # chaos seam: an installed FaultPlan can truncate or lose this
@@ -827,6 +838,16 @@ class HandoffReceiver:
         key = meta["key"]
         if key in self._sessions:
             raise ValueError(f"streamed handoff {key!r} already begun")
+        # purge on ADOPT-SESSION pressure too, not only on message arrival:
+        # age out stale sessions first, then — if a begin flood still has
+        # the table at the cap — evict the stalest session so abandoned
+        # migrations can never pin the pool against live ones
+        self._purge_stale()
+        while len(self._sessions) >= self.MAX_SESSIONS:
+            stalest = min(self._sessions,
+                          key=lambda k: self._sessions[k].last_activity)
+            self._drop(stalest)
+            self.stats["sessions_purged"] += 1
         r = meta["request"]
         request = InferenceRequest(
             request_id=r["request_id"],
@@ -994,6 +1015,7 @@ class HandoffReceiver:
                     if now - s.last_activity > self.SESSION_TTL_S
                     or now - s.last_progress > self.SESSION_MAX_NO_PROGRESS_S]:
             self._drop(key)
+            self.stats["sessions_purged"] += 1
 
 
 def deserialize_handoff(data: bytes) -> KVHandoff:

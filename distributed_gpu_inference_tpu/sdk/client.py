@@ -333,6 +333,7 @@ class InferenceClient:
         prompt: Optional[str] = None,
         model: Optional[str] = None,
         timeout_s: float = 300.0,
+        max_stream_resumes: int = 3,
         **gen_params: Any,
     ):
         """Token streaming via the nearest direct worker's SSE endpoint.
@@ -340,9 +341,22 @@ class InferenceClient:
         Yields ``{"text_delta", "token_ids"}`` chunks then a final
         ``{"done": True, ...}``. When no direct worker is available (or the
         stream fails before the first chunk), falls back to one queued
-        round trip yielded as a single chunk + done event.
-        """
+        round trip yielded as a single chunk + done event. The queued
+        fallback NEVER fires after a chunk was consumed — a re-run would
+        duplicate the delivered prefix AND execute the prompt twice.
+
+        Exactly-once resumable streams: offset-aware workers stamp every
+        event with a monotonic token ``offset``. When such a stream drops
+        mid-generation, the client reconnects — to the same worker or,
+        excluding the one that just died, a failover peer — with a
+        ``Last-Event-ID``-style ``resume {stream_id, offset}`` body. The
+        worker adopts the generation's control-plane checkpoint and splices
+        the continuation at the offset, so the consumer sees the exact
+        token sequence an undropped stream would have produced: no gap, no
+        duplicate. Streams from legacy (offset-less) workers keep the old
+        contract: a mid-generation drop raises."""
         import json as _json
+        import uuid as _uuid
 
         params: Dict[str, Any] = dict(gen_params)
         if messages is not None:
@@ -352,16 +366,67 @@ class InferenceClient:
         if model is not None:
             params["model"] = model
 
-        worker = self._get_nearest_worker()
-        if worker is not None:
+        stream_id = _uuid.uuid4().hex
+        offset = 0            # token offset of the last consumed event
+        text_len = 0          # characters consumed (holdback flushes can
+        #                       advance text without advancing the token
+        #                       offset — the resume must splice BOTH)
+        yielded = False       # any chunk reached the consumer
+        offset_aware = False  # the worker stamps offsets → resumable
+        resumes = 0
+        failed_workers: List[str] = []
+        last_err: Any = None
+
+        while True:
+            resuming = yielded
+            worker = self._get_nearest_worker(
+                exclude=failed_workers or None
+            )
+            if worker is None:
+                if resuming:
+                    raise InferenceClientError(
+                        599, "stream dropped mid-generation and no "
+                             f"failover worker available: {last_err}"
+                    )
+                break  # nothing consumed: queued fallback is safe
             url = f"{worker['direct_url'].rstrip('/')}/inference/stream"
-            yielded = False
+            body: Dict[str, Any] = {
+                "type": "llm", "params": params, "stream_id": stream_id,
+            }
+            if resuming:
+                body["resume"] = {"stream_id": stream_id, "offset": offset,
+                                  "text_offset": text_len}
+            dropped = False
+            # a worker that DIED on us is excluded from rediscovery; one
+            # that merely answered busy/5xx stays eligible (it frees up)
+            blacklist = False
+            retry_floor = 0.0
             try:
                 with self._client.stream(
-                    "POST", url, json={"type": "llm", "params": params},
+                    "POST", url, json=body,
                     headers=self._headers(), timeout=timeout_s,
                 ) as resp:
-                    if resp.status_code == 200:
+                    if resp.status_code != 200:
+                        self._direct_cache = None
+                        if not resuming:
+                            break  # busy/declined: queued fallback
+                        if resp.status_code == 409:
+                            # no checkpoint exists: the delivered prefix
+                            # cannot be disowned and a re-run would
+                            # double-generate it — surface the drop
+                            raise InferenceClientError(
+                                599, "stream dropped mid-generation: no "
+                                     "checkpoint to resume from"
+                            )
+                        dropped = True
+                        last_err = f"HTTP {resp.status_code}"
+                        try:
+                            retry_floor = float(
+                                resp.headers.get("Retry-After") or 0.5
+                            )
+                        except ValueError:
+                            retry_floor = 0.5
+                    else:
                         for line in resp.iter_lines():
                             if not line.startswith("data: "):
                                 continue
@@ -370,18 +435,72 @@ class InferenceClient:
                                 raise InferenceClientError(
                                     500, chunk["error"]
                                 )
+                            off = chunk.get("offset")
+                            if off is not None:
+                                offset_aware = True
+                                # belt-and-braces dedupe: the worker
+                                # splices, but a replayed event must never
+                                # re-deliver consumed tokens. Same-offset
+                                # chunks WITHOUT token ids are legitimate
+                                # (the final holdback flush emits text
+                                # only, at an unchanged token offset) and
+                                # must pass.
+                                if not chunk.get("done") and yielded and (
+                                    int(off) < offset
+                                    or (int(off) == offset
+                                        and chunk.get("token_ids"))
+                                ):
+                                    continue
+                                offset = max(offset, int(off))
+                            elif resuming:
+                                # a resume answered by an offset-less
+                                # (legacy or fresh-run) worker cannot be
+                                # spliced safely — refuse the duplicate
+                                raise InferenceClientError(
+                                    599, "stream dropped mid-generation: "
+                                         "resume target is not offset-"
+                                         "aware"
+                                )
                             yielded = True
+                            text_len += len(chunk.get("text_delta") or "")
                             yield chunk
-                        return
-                    self._direct_cache = None  # busy: rediscover later
+                            if chunk.get("done"):
+                                return
+                        # 200 stream ended with no done event: the
+                        # connection died mid-body (worker crash)
+                        dropped = True
+                        blacklist = True
+                        last_err = "stream ended before done event"
             except httpx.TransportError as exc:
                 self._direct_cache = None
-                if yielded:
-                    # chunks already reached the consumer: a queued re-run
-                    # would duplicate text AND execute the prompt twice
-                    raise InferenceClientError(
-                        599, f"stream dropped mid-generation: {exc}"
-                    ) from exc
+                dropped = True
+                blacklist = True
+                last_err = exc
+            if not dropped:
+                break  # non-200 first attempt fell through: queued path
+            if not yielded:
+                break  # nothing consumed: queued fallback is safe
+            if not offset_aware:
+                # legacy worker: no offsets, no safe splice
+                raise InferenceClientError(
+                    599, f"stream dropped mid-generation: {last_err}"
+                )
+            resumes += 1
+            if resumes > max_stream_resumes:
+                raise InferenceClientError(
+                    599, f"stream dropped mid-generation: resume budget "
+                         f"exhausted after {max_stream_resumes} attempts "
+                         f"({last_err})"
+                )
+            if blacklist:
+                wid = worker.get("worker_id")
+                if wid and wid not in failed_workers:
+                    failed_workers.append(wid)
+            self._direct_cache = None
+            # jittered backoff between resume attempts (Retry-After as the
+            # floor on a busy answer) — no zero-delay stampede at the very
+            # worker fleet the first failure just destabilized
+            self._sleep_backoff(resumes - 1, floor_s=retry_floor)
         # fallback: queued path, emitted as one chunk (stream contract kept)
         result = self._run_job("llm", params, sync=True, timeout_s=timeout_s)
         yield {"text_delta": result.get("text", ""), "token_ids": []}
@@ -391,13 +510,20 @@ class InferenceClient:
 
     # -- direct mode (reference :284-329) ------------------------------------
 
-    def _get_nearest_worker(self) -> Optional[Dict[str, Any]]:
+    def _get_nearest_worker(
+        self, exclude: Optional[Sequence[str]] = None
+    ) -> Optional[Dict[str, Any]]:
         now = time.time()
-        if self._direct_cache is not None and \
+        if not exclude and self._direct_cache is not None and \
                 now - self._direct_cache_at < DIRECT_CACHE_TTL_S:
             return self._direct_cache
         try:
-            resp = self._request("GET", "/api/v1/jobs/direct/nearest")
+            resp = self._request(
+                "GET", "/api/v1/jobs/direct/nearest",
+                # exclude: workers the caller just watched fail — a
+                # failover reconnect must not land on the corpse
+                params={"exclude": ",".join(exclude)} if exclude else None,
+            )
         except InferenceClientError:
             return None
         self._direct_cache = resp.json()

@@ -292,6 +292,55 @@ async def _register_worker_locked(st: ServerState,
     )
 
 
+async def _ingest_checkpoint(st: ServerState, worker_id: str,
+                             cp: Dict[str, Any]) -> None:
+    """Store one piggybacked generation checkpoint, fenced.
+
+    ``kind=job`` entries land on the job row only while the job is still
+    RUNNING on this worker at this assignment epoch — a zombie whose job
+    was requeued (epoch bumped on the next claim) or taken over cannot
+    poison the live assignment's resume state. ``kind=stream`` entries go
+    to the stream_checkpoints table with the same epoch fence (the adopt
+    path bumps it)."""
+    kind = cp.get("kind")
+    key = cp.get("key")
+    epoch = int(cp.get("epoch") or 0)
+    state = cp.get("state")
+    if not key:
+        st.metrics.record_checkpoint_rejected("malformed")
+        return
+    if kind == "job":
+        job = await st.store.get_job(str(key))
+        if job is None or job.get("worker_id") != worker_id:
+            st.metrics.record_checkpoint_rejected("not_owner")
+            return
+        if int(job.get("assignment_epoch") or 0) != epoch:
+            st.metrics.record_checkpoint_rejected("stale_epoch")
+            return
+        if job["status"] != JobStatus.RUNNING.value:
+            st.metrics.record_checkpoint_rejected("not_running")
+            return
+        if state is not None:
+            await st.store.update_job(str(key), checkpoint=state)
+            st.metrics.record_checkpoint(worker_id)
+        return
+    if kind == "stream":
+        if cp.get("done"):
+            await st.store.delete_stream_checkpoint(
+                str(key), worker_id, epoch
+            )
+            return
+        ok = await st.store.save_stream_checkpoint(
+            str(key), worker_id, epoch, state
+        )
+        if ok:
+            st.metrics.record_checkpoint(worker_id)
+        else:
+            st.metrics.record_checkpoint_rejected("stale_epoch")
+        return
+    st.metrics.record_checkpoint_rejected("malformed")
+
+
 async def heartbeat(request: web.Request) -> web.Response:
     worker_id = request.match_info["worker_id"]
     w, err = await _auth_worker(request, worker_id)
@@ -331,6 +380,20 @@ async def heartbeat(request: web.Request) -> web.Response:
         await st.reliability.start_session(worker_id)
     await st.store.update_worker(worker_id, **fields)
     await st.reliability.update_online_pattern(worker_id, online=True)
+    cps = body.get("checkpoints")
+    if isinstance(cps, list):
+        # crash-safe generation: workers piggyback portable generation
+        # checkpoints on heartbeats. Each entry is fenced (assignment
+        # epoch + ownership) and a malformed entry degrades to a skipped
+        # sample — a failing checkpoint must never 500 the heartbeat (that
+        # would get a LIVE worker swept offline).
+        for cp in cps[:32]:
+            if not isinstance(cp, dict):
+                continue
+            try:
+                await _ingest_checkpoint(st, worker_id, cp)
+            except Exception:  # noqa: BLE001
+                st.metrics.record_checkpoint_rejected("malformed")
     es = body.get("engine_stats")
     if isinstance(es, dict):
         # speculation-efficiency counters ride the heartbeat (worker
@@ -414,6 +477,28 @@ async def complete_job(request: web.Request) -> web.Response:
         return _json_error(404, "job not assigned to this worker")
     body = await request.json()
     success = bool(body.get("success", True))
+    claimed_epoch = body.get("assignment_epoch")
+    if claimed_epoch is not None and \
+            int(claimed_epoch) != int(job.get("assignment_epoch") or 0):
+        # zombie fence: the job was requeued/reclaimed since this worker's
+        # assignment (every claim bumps assignment_epoch — even a reclaim
+        # by the SAME worker, which the worker_id check above cannot see).
+        # The late result is discarded; release this worker's capacity
+        # claim so it doesn't sit phantom-BUSY.
+        w2 = await st.store.get_worker(worker_id)
+        if w2 is not None and w2.get("current_job_id") == job_id:
+            fields: Dict[str, Any] = {"current_job_id": None}
+            if w2.get("status") == WorkerState.BUSY.value:
+                # only BUSY→IDLE: a DRAINING worker must stay draining or
+                # the scheduler would hand fresh work to a process that is
+                # seconds from exiting
+                fields["status"] = WorkerState.IDLE.value
+            await st.store.update_worker(worker_id, **fields)
+        st.metrics.record_checkpoint_rejected("stale_epoch")
+        return _json_error(
+            409, f"stale assignment epoch {claimed_epoch} "
+                 f"(job is at {job.get('assignment_epoch') or 0})"
+        )
 
     async def _already_terminal(status: str) -> web.Response:
         # always release this worker's capacity claim on the job
@@ -477,6 +562,108 @@ async def complete_job(request: web.Request) -> web.Response:
         # decode done → merge results into the parent container job)
         await st.pd_flow.on_child_complete(job2)
     return web.json_response({"ok": True})
+
+
+async def checkpoint_job(request: web.Request) -> web.Response:
+    """Worker-pushed generation checkpoint for a RUNNING job — the
+    graceful-drain migration path (``migrate=true`` additionally requeues
+    the job WITHOUT burning a retry, so the next claimant resumes from the
+    checkpoint instead of regenerating). Fenced by assignment epoch like
+    every other checkpoint write."""
+    worker_id = request.match_info["worker_id"]
+    job_id = request.match_info["job_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err is not None:
+        return err
+    st = _state(request)
+    job = await st.store.get_job(job_id)
+    if job is None or job.get("worker_id") != worker_id:
+        return _json_error(404, "job not assigned to this worker")
+    body = await request.json()
+    epoch = int(body.get("assignment_epoch") or 0)
+    if epoch != int(job.get("assignment_epoch") or 0):
+        st.metrics.record_checkpoint_rejected("stale_epoch")
+        return _json_error(
+            409, f"stale assignment epoch {epoch} "
+                 f"(job is at {job.get('assignment_epoch') or 0})"
+        )
+    if job["status"] != JobStatus.RUNNING.value:
+        st.metrics.record_checkpoint_rejected("not_running")
+        return _json_error(409, f"job is {job['status']}, not running")
+    state = body.get("state")
+    if state is not None:
+        await st.store.update_job(job_id, checkpoint=state)
+        st.metrics.record_checkpoint(worker_id)
+    requeued = False
+    if body.get("migrate"):
+        # graceful migration: conditional RUNNING→QUEUED (a racing
+        # completion keeps its terminal status), retry_count untouched —
+        # a drain is not a failure. The checkpoint stays on the row; the
+        # next claim bumps the epoch and resumes from it.
+        requeued = await st.store.try_transition_job(
+            job_id, JobStatus.RUNNING.value, owned_by=worker_id,
+            status=JobStatus.QUEUED.value,
+            worker_id=None,
+            started_at=None,
+        )
+        w2 = await st.store.get_worker(worker_id)
+        if w2 is not None and w2.get("current_job_id") == job_id:
+            fields: Dict[str, Any] = {"current_job_id": None}
+            if w2.get("status") == WorkerState.BUSY.value:
+                fields["status"] = WorkerState.IDLE.value
+            await st.store.update_worker(worker_id, **fields)
+    return web.json_response({"ok": True, "requeued": requeued})
+
+
+async def checkpoint_stream(request: web.Request) -> web.Response:
+    """Worker-pushed checkpoint for a direct (queue-less) SSE stream —
+    the per-token/periodic cadence between heartbeats. ``done=true``
+    deletes the row when the stream finishes normally (fenced: a zombie's
+    late "done" cannot erase the state its replacement resumes from)."""
+    worker_id = request.match_info["worker_id"]
+    stream_id = request.match_info["stream_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err is not None:
+        return err
+    st = _state(request)
+    body = await request.json()
+    epoch = int(body.get("epoch") or 0)
+    if body.get("done"):
+        await st.store.delete_stream_checkpoint(stream_id, worker_id, epoch)
+        return web.json_response({"ok": True, "deleted": True})
+    ok = await st.store.save_stream_checkpoint(
+        stream_id, worker_id, epoch, body.get("state")
+    )
+    if not ok:
+        st.metrics.record_checkpoint_rejected("stale_epoch")
+        return _json_error(
+            409, f"stale stream epoch {epoch} for {stream_id}"
+        )
+    st.metrics.record_checkpoint(worker_id)
+    return web.json_response({"ok": True})
+
+
+async def adopt_stream(request: web.Request) -> web.Response:
+    """Failover worker adopts a dropped stream's checkpoint: atomically
+    bumps the epoch (fencing the previous owner's late writes out) and
+    returns the latest state so the adopter resumes via
+    ``TPUEngine.resume()`` and splices the continuation at the client's
+    offset."""
+    worker_id = request.match_info["worker_id"]
+    stream_id = request.match_info["stream_id"]
+    w, err = await _auth_worker(request, worker_id)
+    if err is not None:
+        return err
+    st = _state(request)
+    row = await st.store.adopt_stream_checkpoint(stream_id, worker_id)
+    if row is None:
+        return _json_error(404, f"no checkpoint for stream {stream_id}")
+    st.metrics.record_stream_failover()
+    return web.json_response({
+        "stream_id": stream_id,
+        "checkpoint": row["state"],
+        "epoch": row["epoch"],
+    })
 
 
 async def going_offline(request: web.Request) -> web.Response:
@@ -734,9 +921,18 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
     region = request.query.get("region") or await st.geo.detect_client_region(
         client_ip
     )
+    # ``exclude``: comma-separated worker ids the client just watched fail
+    # (dropped stream / refused connection) — a failover reconnect must not
+    # be handed straight back to the worker that died on it while the
+    # heartbeat sweep is still counting down
+    exclude = {
+        e for e in (request.query.get("exclude") or "").split(",") if e
+    }
     workers = await st.store.list_workers(status=[WorkerState.IDLE.value])
     cands = [
-        w for w in workers if w.get("supports_direct") and w.get("direct_url")
+        w for w in workers
+        if w.get("supports_direct") and w.get("direct_url")
+        and w["id"] not in exclude
     ]
     if not cands:
         return _json_error(404, "no direct workers available")
@@ -1239,6 +1435,18 @@ def create_app(state: Optional[ServerState] = None,
     )
     app.router.add_post(
         f"{API}/workers/{{worker_id}}/jobs/{{job_id}}/release", release_job
+    )
+    app.router.add_post(
+        f"{API}/workers/{{worker_id}}/jobs/{{job_id}}/checkpoint",
+        checkpoint_job,
+    )
+    app.router.add_post(
+        f"{API}/workers/{{worker_id}}/streams/{{stream_id}}/checkpoint",
+        checkpoint_stream,
+    )
+    app.router.add_post(
+        f"{API}/workers/{{worker_id}}/streams/{{stream_id}}/adopt",
+        adopt_stream,
     )
     app.router.add_post(f"{API}/workers/{{worker_id}}/going-offline", going_offline)
     app.router.add_post(f"{API}/workers/{{worker_id}}/offline", offline)

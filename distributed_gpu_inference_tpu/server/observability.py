@@ -81,6 +81,8 @@ class Metrics:
                 "spec_decode_steps", "spec_worker_accept_rate",
                 "spec_worker_tokens_per_step",
                 "kv_preemptions", "kv_resumes", "kv_pressure_events",
+                "job_checkpoints", "checkpoints_rejected",
+                "stream_failovers", "kv_handoff_purged",
             ):
                 setattr(self, name, noop)
             return
@@ -159,6 +161,27 @@ class Metrics:
             "kv_pressure_events_total",
             "Step-boundary KV pressure signals (frozen slots / deferred "
             "admissions)", ["worker"], registry=r)
+        # crash-safe generation: checkpoints accepted/fenced and streams
+        # adopted by failover workers. A rising checkpoints_rejected
+        # {reason=stale_epoch} means zombie workers are still reporting
+        # after their assignments were taken over — exactly what the epoch
+        # fence exists to absorb, but worth watching at fleet scale.
+        self.job_checkpoints = Counter(
+            "job_checkpoints_total",
+            "Generation checkpoints accepted by the control plane",
+            ["worker"], registry=r)
+        self.checkpoints_rejected = Counter(
+            "checkpoints_rejected_total",
+            "Checkpoints/completions rejected by epoch or ownership "
+            "fencing", ["reason"], registry=r)
+        self.stream_failovers = Counter(
+            "stream_failovers_total",
+            "Direct-stream checkpoints adopted by a failover worker",
+            registry=r)
+        self.kv_handoff_purged = Counter(
+            "kv_handoff_sessions_purged_total",
+            "Abandoned streamed-handoff sessions purged by receivers",
+            ["worker"], registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -270,6 +293,10 @@ class MetricsCollector:
             ("preemptions", self.metrics.kv_preemptions),
             ("resumes", self.metrics.kv_resumes),
             ("kv_pressure_events", self.metrics.kv_pressure_events),
+            # abandoned streamed-handoff sessions purged on the worker's
+            # HandoffReceiver (TTL, no-progress, or session-cap eviction)
+            # — rides the same heartbeat payload and delta anchoring
+            ("kv_handoff_sessions_purged", self.metrics.kv_handoff_purged),
         ):
             if key not in engine_stats:
                 continue
@@ -281,6 +308,15 @@ class MetricsCollector:
             if delta > 0:
                 metric.labels(worker).inc(delta)
             prev[key] = cur
+
+    def record_checkpoint(self, worker: str) -> None:
+        self.metrics.job_checkpoints.labels(worker).inc()
+
+    def record_checkpoint_rejected(self, reason: str) -> None:
+        self.metrics.checkpoints_rejected.labels(reason).inc()
+
+    def record_stream_failover(self) -> None:
+        self.metrics.stream_failovers.inc()
 
     def render(self) -> bytes:
         return self.metrics.render()

@@ -44,7 +44,7 @@ _WORKER_JSON = {
     "topology",
     "mesh_shape",
 }
-_JOB_JSON = {"params", "result"}
+_JOB_JSON = {"params", "result", "checkpoint"}
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS workers (
@@ -201,6 +201,21 @@ _MIGRATIONS = [
     (4, "ALTER TABLE workers ADD COLUMN machine_fingerprint TEXT"),
     (4, "CREATE INDEX IF NOT EXISTS idx_workers_fingerprint "
         "ON workers (machine_fingerprint)"),
+    # v5: crash-safe generation — every claim bumps the job's
+    # assignment_epoch (the fence a zombie worker's late complete_job or
+    # stale checkpoint is rejected against), and workers piggyback a
+    # portable PreemptedSequence checkpoint on heartbeats so a requeued
+    # job resumes instead of regenerating. Direct (queue-less) SSE streams
+    # checkpoint into their own table keyed by stream_id.
+    (5, "ALTER TABLE jobs ADD COLUMN assignment_epoch INTEGER "
+        "NOT NULL DEFAULT 0"),
+    (5, "ALTER TABLE jobs ADD COLUMN checkpoint TEXT"),
+    (5, "CREATE TABLE IF NOT EXISTS stream_checkpoints ("
+        " stream_id TEXT PRIMARY KEY,"
+        " worker_id TEXT,"
+        " epoch INTEGER NOT NULL DEFAULT 0,"
+        " state TEXT,"
+        " updated_at REAL)"),
 ]
 
 SCHEMA_VERSION = max(
@@ -550,9 +565,14 @@ class Store:
                     self._conn.execute("COMMIT")
                     return None
                 now = time.time()
+                # every claim is a fresh assignment epoch: a zombie still
+                # working the previous assignment fails the epoch fence on
+                # complete/checkpoint even if THIS worker reclaims the job
                 cur = self._conn.execute(
                     "UPDATE jobs SET status=?, worker_id=?, started_at=?, "
-                    "actual_region=? WHERE id=? AND status=?",
+                    "actual_region=?, "
+                    "assignment_epoch=assignment_epoch+1 "
+                    "WHERE id=? AND status=?",
                     (
                         JobStatus.RUNNING.value,
                         worker_id,
@@ -575,6 +595,123 @@ class Store:
 
         row = await self._run(txn)
         return _decode(_JOB_JSON, row) if row is not None else None
+
+    # -- stream checkpoints (direct-mode failover) -------------------------
+
+    async def save_stream_checkpoint(self, stream_id: str, worker_id: str,
+                                     epoch: int, state: Any) -> bool:
+        """Fenced upsert of a direct stream's generation checkpoint.
+
+        Accepts when the stream is unknown, when ``epoch`` advances past the
+        stored one, or when the SAME owner re-checkpoints at its current
+        epoch. A zombie worker (whose stream was adopted by a failover peer,
+        bumping the epoch) is rejected — its stale state must never clobber
+        the live continuation. Returns True when the write landed."""
+
+        def txn() -> bool:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                row = self._conn.execute(
+                    "SELECT epoch, worker_id FROM stream_checkpoints "
+                    "WHERE stream_id=?", (stream_id,),
+                ).fetchone()
+                if row is not None:
+                    stored = int(row["epoch"] or 0)
+                    if epoch < stored or (
+                        epoch == stored
+                        and row["worker_id"] not in (None, worker_id)
+                    ):
+                        self._conn.execute("COMMIT")
+                        return False
+                self._conn.execute(
+                    "INSERT INTO stream_checkpoints "
+                    "(stream_id, worker_id, epoch, state, updated_at) "
+                    "VALUES (?,?,?,?,?) ON CONFLICT(stream_id) DO UPDATE "
+                    "SET worker_id=excluded.worker_id, "
+                    "epoch=excluded.epoch, state=excluded.state, "
+                    "updated_at=excluded.updated_at",
+                    (stream_id, worker_id, int(epoch),
+                     json.dumps(state), time.time()),
+                )
+                self._conn.execute("COMMIT")
+                return True
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+
+        return await self._run(txn)
+
+    async def adopt_stream_checkpoint(
+        self, stream_id: str, worker_id: str
+    ) -> Optional[Dict[str, Any]]:
+        """Atomically hand a stream's latest checkpoint to a failover
+        worker: bumps the epoch (fencing out the previous owner's late
+        writes) and records the adopter as the new owner. Returns
+        ``{"state", "epoch"}`` or None when no checkpoint exists."""
+
+        def txn() -> Optional[Dict[str, Any]]:
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                row = self._conn.execute(
+                    "SELECT epoch, state FROM stream_checkpoints "
+                    "WHERE stream_id=?", (stream_id,),
+                ).fetchone()
+                if row is None or row["state"] is None:
+                    self._conn.execute("COMMIT")
+                    return None
+                new_epoch = int(row["epoch"] or 0) + 1
+                self._conn.execute(
+                    "UPDATE stream_checkpoints SET worker_id=?, epoch=?, "
+                    "updated_at=? WHERE stream_id=?",
+                    (worker_id, new_epoch, time.time(), stream_id),
+                )
+                self._conn.execute("COMMIT")
+                try:
+                    state = json.loads(row["state"])
+                except (ValueError, TypeError):
+                    state = None
+                if state is None:
+                    return None
+                return {"state": state, "epoch": new_epoch}
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+
+        return await self._run(txn)
+
+    async def delete_stream_checkpoint(self, stream_id: str, worker_id: str,
+                                       epoch: int) -> bool:
+        """Fenced cleanup when a stream finishes normally: only the current
+        owner at the current (or newer) epoch may delete — a zombie's late
+        "done" must not erase the checkpoint its replacement still needs."""
+
+        def txn() -> bool:
+            cur = self._conn.execute(
+                "DELETE FROM stream_checkpoints WHERE stream_id=? "
+                "AND (worker_id IS NULL OR worker_id=?) AND epoch<=?",
+                (stream_id, worker_id, int(epoch)),
+            )
+            return cur.rowcount == 1
+
+        return await self._run(txn)
+
+    async def get_stream_checkpoint(
+        self, stream_id: str
+    ) -> Optional[Dict[str, Any]]:
+        rows = await self._run(
+            self._query,
+            "SELECT * FROM stream_checkpoints WHERE stream_id=?",
+            (stream_id,),
+        )
+        if not rows:
+            return None
+        d = dict(rows[0])
+        if isinstance(d.get("state"), str):
+            try:
+                d["state"] = json.loads(d["state"])
+            except (ValueError, TypeError):
+                pass
+        return d
 
     # -- queue stats -------------------------------------------------------
 
@@ -635,6 +772,7 @@ _TABLE_JSON = {
     "usage_records": set(),
     "api_keys": set(),
     "audit_log": {"detail"},
+    "stream_checkpoints": {"state"},
 }
 
 

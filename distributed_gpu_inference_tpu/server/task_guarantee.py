@@ -28,6 +28,10 @@ HEARTBEAT_TIMEOUT_S = 90.0
 STALE_JOB_CAP_S = 30 * 60.0
 SWEEP_INTERVAL_S = 30.0
 SYNC_POLL_INTERVAL_S = 0.5
+# direct-stream checkpoints are retired by AGE, not by the worker: a worker
+# cannot know its final SSE bytes reached the client, so an eager "done"
+# delete could erase the state a tail-less client still needs to resume
+STREAM_CHECKPOINT_TTL_S = 30 * 60.0
 
 
 class TaskGuaranteeService:
@@ -110,16 +114,28 @@ class TaskGuaranteeService:
         retries = int(job.get("retry_count") or 0)
         max_retries = int(job.get("max_retries") or 3)
         if retries + 1 > max_retries:
+            fields: Dict[str, Any] = {
+                "status": JobStatus.FAILED.value,
+                "error": f"exceeded max_retries ({max_retries}): {reason}",
+                "completed_at": time.time(),
+            }
+            partial = self._partial_from_checkpoint(job)
+            if partial is not None and not job.get("result"):
+                # the job dies, but its last checkpoint's decoded tokens
+                # don't have to: surface them exactly like the engine's
+                # preempted_too_often partials, so a client can keep what
+                # the fleet DID produce across however many failovers
+                fields["result"] = partial
             won = await self._store.try_transition_job(
-                job["id"], job["status"], owned_by=wid,
-                status=JobStatus.FAILED.value,
-                error=f"exceeded max_retries ({max_retries}): {reason}",
-                completed_at=time.time(),
+                job["id"], job["status"], owned_by=wid, **fields
             )
             if not won:
                 return await _lost_race()
             await self._notify_failed(job["id"])
             return JobStatus.FAILED.value
+        # NOTE: the job's ``checkpoint`` column is deliberately untouched —
+        # a requeued job carries its latest generation checkpoint to the
+        # next claimant, which resumes instead of regenerating
         won = await self._store.try_transition_job(
             job["id"], job["status"], owned_by=wid,
             status=JobStatus.QUEUED.value,
@@ -130,6 +146,24 @@ class TaskGuaranteeService:
         if not won:
             return await _lost_race()
         return JobStatus.QUEUED.value
+
+    @staticmethod
+    def _partial_from_checkpoint(
+        job: Dict[str, Any]
+    ) -> Optional[Dict[str, Any]]:
+        """Partial-output payload recovered from a job's latest generation
+        checkpoint (None when there is nothing to preserve)."""
+        ckpt = job.get("checkpoint")
+        if not isinstance(ckpt, dict):
+            return None
+        gen = ckpt.get("generated")
+        if not gen:
+            return None
+        return {
+            "partial": True,
+            "partial_token_ids": [int(t) for t in gen],
+            "partial_tokens": len(gen),
+        }
 
     async def handle_worker_offline(self, worker_id: str,
                                     graceful: bool = False) -> List[str]:
@@ -249,6 +283,26 @@ class TaskGuaranteeService:
             failed.append(job["id"])
         return failed
 
+    async def sweep_stale_stream_checkpoints(
+        self, now: Optional[float] = None
+    ) -> List[str]:
+        """Age out direct-stream checkpoints nobody resumed: a client that
+        lost a stream tail reconnects within seconds, so anything older
+        than ``STREAM_CHECKPOINT_TTL_S`` is an abandoned stream whose
+        state would otherwise accumulate forever."""
+        now = time.time() if now is None else now
+        rows = await self._store.query(
+            "SELECT stream_id FROM stream_checkpoints WHERE updated_at < ?",
+            (now - STREAM_CHECKPOINT_TTL_S,),
+        )
+        purged = [r["stream_id"] for r in rows]
+        if purged:
+            await self._store.execute(
+                "DELETE FROM stream_checkpoints WHERE updated_at < ?",
+                (now - STREAM_CHECKPOINT_TTL_S,),
+            )
+        return purged
+
     async def sweep(self, now: Optional[float] = None) -> Dict[str, List[str]]:
         return {
             "dead_workers": await self.sweep_dead_workers(now=now),
@@ -257,6 +311,8 @@ class TaskGuaranteeService:
             # (2× heartbeat timeout) has elapsed, its freshly-OFFLINE state
             # and its children's orphaning land in the same sweep pass
             "orphaned_pins": await self.sweep_orphaned_pins(now=now),
+            "stale_stream_checkpoints":
+                await self.sweep_stale_stream_checkpoints(now=now),
         }
 
     # -- sync wait (reference :187-228) ---------------------------------------

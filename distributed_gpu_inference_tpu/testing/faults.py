@@ -339,6 +339,26 @@ def kv_pressure(site: str, num_free: int, **ctx: Any) -> bool:
     raise ValueError(f"rule kind {rule.kind!r} unsupported at kv seam")
 
 
+def stream_cut(site: str, **ctx: Any) -> bool:
+    """Server-push stream seam (worker SSE): returns True when the stream
+    must die ABRUPTLY at this event — the handler hard-closes the socket,
+    modelling a worker process crash mid-generation. ``after=N`` on the
+    rule lets exactly N events through first, so a seeded kill point is
+    reproducible to the event."""
+    plan = _ACTIVE
+    if plan is None:
+        return False
+    rule = plan.fire(site, **ctx)
+    if rule is None:
+        return False
+    if rule.kind in ("drop", "flap"):
+        return True
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return False
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at stream seam")
+
+
 def mutate_bytes(site: str, data: bytes, **ctx: Any) -> bytes:
     """Byte-message seam (KV handoff receiver): truncate or lose a message
     in transit. Drops raise :class:`FaultInjected`, which the transport

@@ -192,11 +192,60 @@ class APIClient:
 
     def complete_job(self, job_id: str, success: bool,
                      result: Optional[Dict[str, Any]] = None,
-                     error: Optional[str] = None) -> Dict[str, Any]:
+                     error: Optional[str] = None,
+                     assignment_epoch: Optional[int] = None
+                     ) -> Dict[str, Any]:
+        payload: Dict[str, Any] = {
+            "success": success, "result": result, "error": error,
+        }
+        if assignment_epoch is not None:
+            # zombie fence: the server rejects a completion whose epoch no
+            # longer matches the job's current assignment (requeued or
+            # reclaimed since) with a 409 instead of applying it
+            payload["assignment_epoch"] = int(assignment_epoch)
         resp = self._request(
             "POST",
             f"/api/v1/workers/{self.worker_id}/jobs/{job_id}/complete",
-            {"success": success, "result": result, "error": error},
+            payload,
+        )
+        return resp.json()
+
+    # -- crash-safe generation (checkpoints + stream failover) ---------------
+
+    def checkpoint_job(self, job_id: str, assignment_epoch: int,
+                       state: Optional[Dict[str, Any]],
+                       migrate: bool = False) -> Dict[str, Any]:
+        """Push a generation checkpoint for a RUNNING job; ``migrate=True``
+        additionally requeues it (graceful drain) without burning a retry."""
+        resp = self._request(
+            "POST",
+            f"/api/v1/workers/{self.worker_id}/jobs/{job_id}/checkpoint",
+            {"assignment_epoch": int(assignment_epoch), "state": state,
+             "migrate": bool(migrate)},
+        )
+        return resp.json()
+
+    def checkpoint_stream(self, stream_id: str, epoch: int,
+                          state: Optional[Dict[str, Any]],
+                          done: bool = False) -> Dict[str, Any]:
+        """Push (or, with ``done=True``, retire) a direct stream's
+        checkpoint — the per-token cadence between heartbeats."""
+        resp = self._request(
+            "POST",
+            f"/api/v1/workers/{self.worker_id}/streams/{stream_id}"
+            "/checkpoint",
+            {"epoch": int(epoch), "state": state, "done": bool(done)},
+            retries=0,
+        )
+        return resp.json()
+
+    def adopt_stream(self, stream_id: str) -> Dict[str, Any]:
+        """Adopt a dropped stream's checkpoint (epoch fences out the
+        previous owner); raises APIError(404) when none exists."""
+        resp = self._request(
+            "POST",
+            f"/api/v1/workers/{self.worker_id}/streams/{stream_id}/adopt",
+            {},
         )
         return resp.json()
 

@@ -17,9 +17,12 @@ import asyncio
 import contextlib
 import threading
 import time
+import uuid
 from typing import Any, Dict, Optional
 
 from aiohttp import web
+
+from ..testing import faults as _faults
 
 
 class DirectServer:
@@ -73,6 +76,14 @@ class DirectServer:
                 {"detail": f"engine for {task_type!r} does not stream"},
                 status=501,
             )
+        # reserved internal key: the failover context is MINTED by this
+        # server / the worker claim path, never accepted from a client —
+        # a forged checkpoint would otherwise drive the resume path with
+        # arbitrary state (bypassing request validation) and poison the
+        # stream's control-plane checkpoints
+        params = body.get("params")
+        if isinstance(params, dict):
+            params.pop("_failover_ctx", None)
         accept = getattr(self.worker, "should_accept_job", None)
         if accept is not None and not accept({"type": task_type}):
             self.stats["rejected"] += 1
@@ -117,7 +128,15 @@ class DirectServer:
                                 ) -> web.StreamResponse:
         """SSE token streaming (reference SGLang SSE path,
         llm_sglang.py:358-416): each chunk is one ``data:`` event; the final
-        event carries done/finish_reason/usage."""
+        event carries done/finish_reason/usage.
+
+        Crash-safe streams: every event is stamped with the engine's
+        monotonic token ``offset`` (mirrored into the SSE ``id:`` field —
+        the Last-Event-ID idiom), and a ``resume`` body
+        (``{"stream_id", "offset"}``) adopts the stream's control-plane
+        checkpoint — possibly left by a DIFFERENT, now-dead worker — and
+        splices the continuation at the client's offset: no token re-sent,
+        none skipped."""
         import json
 
         engine, body, err = await self._parse_and_admit(
@@ -126,6 +145,57 @@ class DirectServer:
         if err is not None:
             return err
         started = time.time()
+        params = dict(body.get("params") or {})
+        resume = body.get("resume") if isinstance(body.get("resume"),
+                                                  dict) else None
+        stream_id = str(
+            (resume or {}).get("stream_id") or body.get("stream_id")
+            or uuid.uuid4().hex
+        )
+        if getattr(engine, "supports_failover", False):
+            ctx: Dict[str, Any] = {"key": stream_id, "kind": "stream",
+                                   "epoch": 0}
+            if resume is not None:
+                adopt = getattr(self.worker, "adopt_stream_checkpoint", None)
+                adoption = None
+                adopt_failed = adopt is None
+                if adopt is not None:
+                    loop = asyncio.get_running_loop()
+                    try:
+                        adoption = await loop.run_in_executor(
+                            None, adopt, stream_id
+                        )
+                    except Exception:  # noqa: BLE001 — plane unreachable
+                        adopt_failed = True
+                if adoption is None:
+                    self._release(started)
+                    if adopt_failed:
+                        # transient: the control plane was unreachable,
+                        # NOT proof that no checkpoint exists — a 503
+                        # keeps the client's resume budget alive (409
+                        # would terminally fail a resumable stream)
+                        return web.json_response(
+                            {"detail": "checkpoint adoption failed "
+                                       "(control plane unreachable)"},
+                            status=503,
+                        )
+                    # no checkpoint to resume from: the client decides
+                    # (fresh queued run only if it consumed nothing yet)
+                    return web.json_response(
+                        {"detail": f"no checkpoint for stream {stream_id}"},
+                        status=409,
+                    )
+                ctx["checkpoint"] = adoption.get("checkpoint")
+                ctx["epoch"] = int(adoption.get("epoch") or 0)
+                ctx["offset"] = int(resume.get("offset") or 0)
+                ctx["text_offset"] = int(resume.get("text_offset") or 0)
+            params["_failover_ctx"] = ctx
+        elif resume is not None:
+            self._release(started)
+            return web.json_response(
+                {"detail": "engine does not support stream resume"},
+                status=409,
+            )
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -134,12 +204,22 @@ class DirectServer:
             }
         )
         await resp.prepare(request)
-        agen = engine.stream_inference(body.get("params") or {})
+        agen = engine.stream_inference(params)
         try:
             async for chunk in agen:
-                await resp.write(
-                    f"data: {json.dumps(chunk)}\n\n".encode()
-                )
+                if _faults.stream_cut("worker.direct.stream",
+                                      stream_id=stream_id):
+                    # chaos seam: the worker "dies" mid-stream — hard-close
+                    # the socket so the client sees an abrupt drop, exactly
+                    # like a crashed process
+                    with contextlib.suppress(Exception):
+                        request.transport.close()
+                    raise ConnectionResetError("fault injected: stream cut")
+                evt = b""
+                if chunk.get("offset") is not None:
+                    evt += f"id: {chunk['offset']}\n".encode()
+                evt += f"data: {json.dumps(chunk)}\n\n".encode()
+                await resp.write(evt)
         except ConnectionResetError:
             pass  # client went away mid-stream; aclose() below aborts the run
         finally:

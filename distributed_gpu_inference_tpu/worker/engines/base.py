@@ -21,6 +21,18 @@ class EngineLoadError(RuntimeError):
     """Model/deps unavailable — worker should drop this task type."""
 
 
+class JobMigrated(Exception):
+    """A generation was interrupted at a step boundary (graceful drain) and
+    frozen into a portable checkpoint instead of finishing. The worker
+    hands ``checkpoint`` to the control plane, which requeues the job so
+    the next claimant resumes it — no tokens lost, no retry burned."""
+
+    def __init__(self, checkpoint: Dict[str, Any], tokens: int = 0) -> None:
+        super().__init__(f"job migrated with {tokens} generated tokens")
+        self.checkpoint = checkpoint
+        self.tokens = tokens
+
+
 @dataclass
 class GenerationConfig:
     """Per-request generation knobs (reference ``__init__.py:24``)."""

@@ -23,12 +23,13 @@ from typing import Any, Dict, List, Optional
 
 import httpx
 
-from ...runtime.engine import EngineConfig, TPUEngine
+from ...runtime.engine import EngineConfig, PreemptedSequence, TPUEngine
 from ...utils.data_structures import InferenceRequest, SamplingParams
 from .base import (
     EngineLoadError,
     GenerationConfig,
     GenerationResult,
+    JobMigrated,
     LLMBaseEngine,
 )
 
@@ -74,6 +75,42 @@ def _load_hf_tokenizer(tokenizer_id: str):
         raise EngineLoadError(f"cannot load tokenizer {tokenizer_id!r}: {exc}")
 
 
+class _CheckpointPusher:
+    """Latest-wins background pusher for stream-cadence checkpoints.
+
+    The sink is a blocking control-plane HTTP call, which must never stall
+    the decode loop (a hung control plane would otherwise freeze every
+    live SSE stream for a full timeout per push). One pending entry per
+    key is kept — a newer checkpoint supersedes an unsent older one, so a
+    slow plane costs checkpoint STALENESS (bounded extra recompute on
+    failover), never tokens/sec."""
+
+    def __init__(self, sink) -> None:
+        self._sink = sink
+        self._latest: Dict[str, Dict[str, Any]] = {}
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ckpt-pusher"
+        )
+        self._thread.start()
+
+    def put(self, entry: Dict[str, Any]) -> None:
+        with self._cv:
+            self._latest[str(entry.get("key"))] = entry
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._latest:
+                    self._cv.wait()
+                _, entry = self._latest.popitem()
+            try:
+                self._sink(entry)
+            except Exception:  # noqa: BLE001 — best-effort by contract
+                pass
+
+
 class TPULLMEngine(LLMBaseEngine):
     """config keys: model (name in models/configs registry), tokenizer /
     tokenizer_id, max_batch_size, max_seq_len, multi_step,
@@ -82,6 +119,11 @@ class TPULLMEngine(LLMBaseEngine):
     """
 
     task_type = "llm"
+    # the worker injects a ``_failover_ctx`` (job id, assignment epoch,
+    # server-held checkpoint) only into engines that advertise this — the
+    # llm engine then checkpoints in-flight generations to the control
+    # plane and resumes a requeued job from its checkpoint
+    supports_failover = True
 
     def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
         super().__init__(config)
@@ -96,6 +138,25 @@ class TPULLMEngine(LLMBaseEngine):
         self._engine_lock = threading.Lock()
         # streamed-handoff session machine (created with the engine)
         self._handoff_rx = None
+        # crash-safe generation: key → live in-flight generation metadata
+        # (request_id to find the slot, kind job|stream, assignment epoch).
+        # The heartbeat thread snapshots these via checkpoint_live WITHOUT
+        # the engine lock — snapshots read host-side Python/numpy mirrors
+        # only, and a torn read degrades to a skipped checkpoint, never a
+        # stalled heartbeat behind a whole generation.
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._live_lock = threading.Lock()
+        # graceful drain: set by interrupt_live(); queued-job drivers freeze
+        # their sequence at the next step boundary and raise JobMigrated
+        self._interrupt = threading.Event()
+        # optional push cadence between heartbeats: the worker points this
+        # at its control-plane client; the stream path calls it once at
+        # admission and every checkpoint_interval_tokens afterwards
+        self.checkpoint_sink = None
+        self._ckpt_pusher: Optional[_CheckpointPusher] = None
+        self._ckpt_interval = int(
+            self.config.get("checkpoint_interval_tokens", 8) or 0
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -303,6 +364,12 @@ class TPULLMEngine(LLMBaseEngine):
         with self._engine_lock:
             if stage == "decode":
                 return self.pd_decode(params)
+            ctx = params.get("_failover_ctx")
+            if isinstance(ctx, dict):
+                # queued-job failover path: interruptible driver that
+                # registers for heartbeat checkpointing and resumes from a
+                # server-held checkpoint when the claim carries one
+                return self._job_inference(params, ctx)
             return super().inference(params)
 
     def pd_prefill(self, params: Dict[str, Any]) -> Dict[str, Any]:
@@ -607,6 +674,229 @@ class TPULLMEngine(LLMBaseEngine):
                 self._pd_slots[result["kv_cache_key"]] = result["slot"]
         return result
 
+    # -- crash-safe generation: live checkpoints + resumable drivers --------
+
+    @property
+    def handoff_sessions_purged(self) -> int:
+        """Cumulative abandoned streamed-handoff sessions purged by this
+        engine's receiver — rides the heartbeat into
+        ``kv_handoff_sessions_purged_total``."""
+        rx = self._handoff_rx
+        return int(rx.stats.get("sessions_purged", 0)) if rx is not None else 0
+
+    def _register_live(self, key: str, kind: str, epoch: int,
+                       request_id: str) -> None:
+        with self._live_lock:
+            self._live[key] = {
+                "kind": kind, "epoch": int(epoch), "request_id": request_id,
+            }
+
+    def _unregister_live(self, key: str) -> None:
+        with self._live_lock:
+            self._live.pop(key, None)
+
+    def interrupt_live(self) -> None:
+        """Graceful drain: queued-job drivers freeze at the next step
+        boundary and raise :class:`JobMigrated` with their checkpoint.
+        Direct streams keep running to completion (they checkpoint
+        continuously, so a client of a worker that then vanishes resumes
+        from the last checkpoint on a failover peer)."""
+        self._interrupt.set()
+
+    def _snapshot_live(self, key: str,
+                       info: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Portable checkpoint entry for one live generation, or None when
+        the slot is gone/finished/unreadable. Runs WITHOUT the engine lock
+        (heartbeat thread): a torn read mid-finish degrades to a skipped
+        sample — the next heartbeat retries."""
+        eng = self.engine
+        if eng is None:
+            return None
+        try:
+            for slot, s in enumerate(list(eng.slots)):
+                if s is None or s.request.request_id != info["request_id"]:
+                    continue
+                pre = eng.snapshot_slot(slot)
+                if pre.request.request_id != info["request_id"]:
+                    # the slot was freed and reused by ANOTHER request
+                    # between the scan and the snapshot (we read without
+                    # the engine lock): a foreign sequence must never be
+                    # checkpointed under this key — skip the sample
+                    return None
+                return {
+                    "kind": info["kind"], "key": key,
+                    "epoch": info["epoch"], "state": pre.to_wire(),
+                }
+        except Exception:  # noqa: BLE001 — checkpointing must never break serving
+            return None
+        return None
+
+    def checkpoint_live(self) -> List[Dict[str, Any]]:
+        """Checkpoint entries for every in-flight generation — the payload
+        the worker piggybacks on heartbeats (``checkpoints`` field)."""
+        with self._live_lock:
+            live = dict(self._live)
+        out = []
+        for key, info in live.items():
+            entry = self._snapshot_live(key, info)
+            if entry is not None:
+                out.append(entry)
+        return out
+
+    def _push_checkpoint(self, entry: Optional[Dict[str, Any]],
+                         sync: bool = False) -> None:
+        """Push one checkpoint through the configured sink (control-plane
+        client); sink failures are swallowed — a flaky control plane must
+        never abort the generation it is trying to protect.
+
+        ``sync=True`` blocks (the one-time ADMISSION checkpoint: a kill at
+        token 1 must already find a resumable record, and the pre-first-
+        token cost is noise next to prefill). Cadence pushes go through
+        the latest-wins background pusher so the decode loop never waits
+        on the control plane."""
+        if self.checkpoint_sink is None or entry is None:
+            return
+        if sync:
+            try:
+                self.checkpoint_sink(entry)
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        if self._ckpt_pusher is None:
+            self._ckpt_pusher = _CheckpointPusher(self._sink_now)
+        self._ckpt_pusher.put(entry)
+
+    def _sink_now(self, entry: Dict[str, Any]) -> None:
+        sink = self.checkpoint_sink          # resolved at drain time
+        if sink is not None:
+            sink(entry)
+
+    def _job_inference(self, params: Dict[str, Any],
+                       ctx: Dict[str, Any]) -> Dict[str, Any]:
+        """Queued-job driver with failover support: submits (or RESUMES from
+        the claim's server-held checkpoint), registers for heartbeat
+        checkpointing, decodes in bounded multi-step rounds so a drain
+        interrupt lands at a step boundary, and raises :class:`JobMigrated`
+        with the frozen state instead of finishing when interrupted.
+
+        Continuations are byte-identical greedy / seed-stable sampled: the
+        resume path restores the PRNG key words and recomputes only the
+        suffix the prefix cache / spill tiers don't still hold."""
+        cfg = GenerationConfig.from_params(params)
+        key = str(ctx.get("key") or "")
+        epoch = int(ctx.get("epoch") or 0)
+        ckpt = ctx.get("checkpoint")
+        eng = self.engine
+        if eng is None or not self.loaded:
+            raise EngineLoadError("engine not loaded")
+        if not isinstance(ckpt, dict) and self._spec is not None \
+                and cfg.temperature <= 0.0:
+            # standalone tree-speculative decoder (engine=jax-speculative):
+            # its fused tree rounds are neither interruptible nor
+            # checkpointable, but the multi-x decode speedup should not be
+            # lost on every queued job. Fresh spec-eligible jobs take the
+            # legacy fast path — a drain finishes them and a crash replays
+            # from scratch, exactly the pre-failover contract.
+            return super().inference(params)
+        t0 = time.perf_counter()
+        if isinstance(ckpt, dict):
+            pre = PreemptedSequence.from_wire(ckpt)
+            remaining = (pre.request.sampling.max_new_tokens
+                         - len(pre.generated))
+            if remaining <= 0:
+                # the checkpoint already holds the whole generation: the
+                # previous worker died between its last decode and its
+                # complete_job — deliver without touching the engine
+                return self._finish_payload(
+                    list(pre.generated), pre.prompt_len,
+                    pre.cached_tokens, "length", cfg, None,
+                    time.perf_counter() - t0,
+                )
+            slot = eng.resume(pre)
+            request_id = pre.request.request_id
+        else:
+            req = self._build_request(
+                params.get("messages") or params.get("prompt") or "", cfg
+            )
+            slot = eng.submit(req)
+            request_id = req.request_id
+        self._register_live(key, "job", epoch, request_id)
+        try:
+            while eng.slots[slot] is not None and \
+                    eng.slots[slot].finish_reason is None:
+                if self._interrupt.is_set():
+                    pre = eng.preempt_slot(slot)
+                    raise JobMigrated(pre.to_wire(),
+                                      tokens=len(pre.generated))
+                eng.decode_multi()
+                slot = self._ride_out_pressure(eng, slot)
+        except JobMigrated:
+            raise
+        except Exception:
+            if eng.slots[slot] is not None:
+                eng.finish_slot(slot, cache=False)
+            raise
+        finally:
+            self._unregister_live(key)
+        resp = eng.finish_slot(slot)
+        return self._finish_payload(
+            list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
+            resp.finish_reason or "stop", cfg, resp.ttft_ms,
+            time.perf_counter() - t0,
+        )
+
+    def _ride_out_pressure(self, eng: TPUEngine, slot: int) -> int:
+        """Queued-job KV-pressure recovery without a batcher above us:
+        when the engine freezes THIS slot at a pressure boundary, preempt
+        it (releasing reserved tails and parking its blocks in the
+        evictable prefix cache) and resume immediately — that recovers
+        every self-caused squeeze the batcher path would. No wait loop:
+        this runs UNDER the engine lock, and the paths that free
+        externally-pinned blocks (handoff adopt-sessions, retained PD
+        slots) need that same lock, so sleeping here could never observe
+        a free. If the pool still cannot hold the sequence the blocks are
+        genuinely pinned — fail the job honestly. A drain interrupt
+        converts the frozen state into :class:`JobMigrated` instead (the
+        checkpoint is already in hand)."""
+        from ...runtime.kv_cache import OutOfBlocksError
+
+        p = eng.take_pressure()
+        if p is None or slot not in p.slots:
+            return slot
+        pre = eng.preempt_slot(slot)
+        if self._interrupt.is_set():
+            raise JobMigrated(pre.to_wire(), tokens=len(pre.generated))
+        try:
+            return eng.resume(pre)
+        except OutOfBlocksError:
+            raise OutOfBlocksError(
+                "KV pool cannot hold the queued job's sequence even after "
+                "preempt/evict — blocks are pinned by concurrent sessions"
+            ) from None
+
+    def _finish_payload(self, token_ids: List[int], prompt_tokens: int,
+                        cached_tokens: int, finish_reason: str,
+                        cfg: GenerationConfig, ttft_ms: Optional[float],
+                        e2e_s: float) -> Dict[str, Any]:
+        """Result payload shared by the fresh and resumed queued paths —
+        same decode + stop-string truncation as ``_generate``."""
+        out_text = self.tokenizer.decode(token_ids) if self.tokenizer else ""
+        finish = finish_reason
+        for s in cfg.stop:
+            idx = out_text.find(s)
+            if idx >= 0:
+                out_text = out_text[:idx]
+                finish = "stop"
+                break
+        return GenerationResult(
+            text=out_text,
+            prompt_tokens=prompt_tokens,
+            completion_tokens=len(token_ids),
+            cached_tokens=cached_tokens,
+            finish_reason=finish,
+            ttft_ms=ttft_ms if ttft_ms is not None else e2e_s * 1000.0,
+        ).to_result_payload()
+
     def _generate(self, prompt_or_messages: Any,
                   cfg: GenerationConfig) -> GenerationResult:
         req = self._build_request(prompt_or_messages, cfg)
@@ -648,9 +938,9 @@ class TPULLMEngine(LLMBaseEngine):
     def stream(self, params: Dict[str, Any],
                cancel: Optional[Any] = None):
         """Sync generator of chunks:
-        ``{"text_delta", "token_ids"}...`` then a final
-        ``{"done": True, "finish_reason", "usage"}``. Drives the engine
-        per-step so tokens flush as they are sampled.
+        ``{"text_delta", "token_ids", "offset"}...`` then a final
+        ``{"done": True, "finish_reason", "usage", "offset"}``. Drives the
+        engine per-step so tokens flush as they are sampled.
 
         ``cancel``: a ``threading.Event``-like object; when set, generation
         stops at the next step boundary and the slot is released (client
@@ -660,23 +950,133 @@ class TPULLMEngine(LLMBaseEngine):
         ``len(longest_stop) - 1`` characters are held back until the stop
         scan clears them, so a stop sequence spanning chunk boundaries never
         leaks its prefix.
-        """
+
+        Crash-safe streams: when the caller supplies a ``_failover_ctx``
+        (direct server) the stream registers for heartbeat checkpointing,
+        pushes checkpoints through ``checkpoint_sink`` at admission and
+        every ``checkpoint_interval_tokens``, and stamps every event with a
+        monotonic token ``offset``. A resume context (checkpoint + the
+        client's consumed offset) restores the sequence via
+        ``TPUEngine.resume`` and SPLICES: tokens the client already holds
+        are regenerated (deterministically) but never re-emitted — no gap,
+        no duplicate."""
         cfg = GenerationConfig.from_params(params)
-        req = self._build_request(
-            params.get("messages") or params.get("prompt") or "", cfg
-        )
-        slot = self.engine.submit(req)
+        ctx = params.get("_failover_ctx")
+        ctx = ctx if isinstance(ctx, dict) else {}
+        key = str(ctx.get("key") or params.get("stream_id") or "") or None
+        epoch = int(ctx.get("epoch") or 0)
+        ckpt = ctx.get("checkpoint")
+        resume_from = int(ctx.get("offset") or 0)
+        # characters the client already consumed: holdback flushes advance
+        # text WITHOUT advancing the token offset, so the token splice
+        # alone could re-deliver (or withhold) the flushed tail
+        resume_text = int(ctx.get("text_offset") or 0)
+        eng = self.engine
+
+        def stamp(chunk: Dict[str, Any], offset: int) -> Dict[str, Any]:
+            if key is not None:
+                chunk["stream_id"] = key
+                chunk["offset"] = offset
+            return chunk
+
         holdback = max((len(s) for s in cfg.stop), default=0)
         holdback = max(holdback - 1, 0)
+        if isinstance(ckpt, dict):
+            pre = PreemptedSequence.from_wire(ckpt)
+            remaining = (pre.request.sampling.max_new_tokens
+                         - len(pre.generated))
+            if remaining <= 0:
+                # the checkpoint already holds the full generation (the
+                # donor died between its last decode and the final SSE
+                # flush): serve the un-consumed tail straight from it,
+                # through the SAME stop-string/holdback machinery the live
+                # loop uses — the client must receive exactly the text an
+                # undropped run would have (incl. the held-back chars and
+                # the stop-truncated finish)
+                gen = list(pre.generated)
+                m = min(resume_from, len(gen))
+                full = self.tokenizer.decode(gen)
+                stop_idx = -1
+                for st_ in cfg.stop:
+                    idx = full.find(st_)
+                    if idx >= 0 and (stop_idx < 0 or idx < stop_idx):
+                        stop_idx = idx
+                finish = "length"
+                target = full
+                if stop_idx >= 0:
+                    target = full[:stop_idx]
+                    finish = "stop"
+                raw_prev = self.tokenizer.decode(gen[:m])
+                prev = raw_prev
+                if holdback:
+                    prev = prev[:max(len(prev) - holdback, 0)]
+                if resume_text > len(prev):
+                    # the client already received part of the held-back
+                    # tail (a flush crossed before the drop) — never
+                    # re-deliver those characters
+                    prev = target[:resume_text]
+                delta = target[len(prev):] if len(prev) < len(target) else ""
+                tail = [] if stop_idx >= 0 else gen[m:]
+                if delta or tail:
+                    yield stamp(
+                        {"text_delta": delta, "token_ids": tail}, len(gen)
+                    )
+                yield stamp({
+                    "done": True, "finish_reason": finish,
+                    "usage": {
+                        "prompt_tokens": pre.prompt_len,
+                        "completion_tokens": len(gen),
+                        "total_tokens": pre.prompt_len + len(gen),
+                        "cached_tokens": pre.cached_tokens,
+                    },
+                }, len(gen))
+                return
+            slot = eng.resume(pre)
+            request_id = pre.request.request_id
+        else:
+            req = self._build_request(
+                params.get("messages") or params.get("prompt") or "", cfg
+            )
+            slot = eng.submit(req)
+            request_id = req.request_id
+        live_info = {"kind": "stream", "epoch": epoch,
+                     "request_id": request_id}
+        last_ckpt = len(eng.slots[slot].generated)
+        if key is not None:
+            self._register_live(key, "stream", epoch, request_id)
+            # admission checkpoint (synchronous): even a worker killed
+            # before its first heartbeat leaves a resumable record (the
+            # replacement regenerates from the prompt and splices)
+            self._push_checkpoint(self._snapshot_live(key, live_info),
+                                  sync=True)
         sent_tokens = 0
         sent_text = ""
+        # splice point of a resumed stream: the client already consumed
+        # tokens [0, resume_from) — regenerate silently up to it, then
+        # re-derive the exact text the ORIGINAL stream had delivered at
+        # that offset (same holdback formula, same deterministic tokens)
+        splice: Optional[int] = resume_from if resume_from > 0 else None
         finish_override: Optional[str] = None
         try:
             while True:
-                s = self.engine.slots[slot]
+                s = eng.slots[slot]
                 gen = list(s.generated)
                 finished = s.finish_reason is not None
-                if len(gen) > sent_tokens or finished:
+                if splice is not None and (len(gen) >= splice or finished):
+                    sent_tokens = min(splice, len(gen))
+                    raw = self.tokenizer.decode(gen[:sent_tokens])
+                    sent_text = raw
+                    if holdback:
+                        sent_text = sent_text[
+                            : max(len(sent_text) - holdback, 0)
+                        ]
+                    if resume_text > len(sent_text):
+                        # a holdback flush reached the client before the
+                        # drop: its characters are consumed even though
+                        # the token offset didn't advance
+                        sent_text = raw[:resume_text]
+                    splice = None
+                if splice is None and (len(gen) > sent_tokens or finished):
                     # decode the WHOLE sequence: multi-byte characters and
                     # cross-chunk stop strings stay correct
                     full = self.tokenizer.decode(gen)
@@ -694,15 +1094,20 @@ class TPULLMEngine(LLMBaseEngine):
                         target = full[: max(len(full) - holdback,
                                             len(sent_text))]
                     delta = target[len(sent_text):]
-                    if delta:
-                        yield {
-                            "text_delta": delta,
-                            # token ids past a stop cut are not emitted
-                            "token_ids": [] if stop_idx >= 0
-                            else gen[sent_tokens:],
-                        }
+                    new_ids = [] if stop_idx >= 0 else gen[sent_tokens:]
                     sent_text = target
                     sent_tokens = len(gen)
+                    # emit on new token ids even when the text delta is
+                    # empty (id outside the tokenizer's decodable range,
+                    # or held back): exactly-once delivery means every
+                    # sampled id reaches the client in some chunk —
+                    # silently skipped ids would desync the offset splice
+                    if delta or new_ids:
+                        yield stamp({
+                            "text_delta": delta,
+                            # token ids past a stop cut are not emitted
+                            "token_ids": new_ids,
+                        }, sent_tokens)
                     if stop_idx >= 0:
                         s.finish_reason = "stop"
                         finished = True
@@ -711,26 +1116,43 @@ class TPULLMEngine(LLMBaseEngine):
                 if cancel is not None and cancel.is_set():
                     s.finish_reason = s.finish_reason or "abort"
                     break
-                if self.engine.cfg.speculative is not None:
+                if eng.cfg.speculative is not None:
                     # one draft→verify→accept round per flush: up to K+1
                     # tokens reach the stream per device round instead of 1
                     # (same emission contract incl. stop handling)
-                    self.engine.spec_decode_step()
+                    eng.spec_decode_step()
                 else:
-                    self.engine.decode_step()
-                self._raise_if_pressured(self.engine, slot)
+                    eng.decode_step()
+                self._raise_if_pressured(eng, slot)
+                if key is not None and self._ckpt_interval > 0:
+                    s2 = eng.slots[slot]
+                    n = len(s2.generated) if s2 is not None else last_ckpt
+                    if n - last_ckpt >= self._ckpt_interval:
+                        self._push_checkpoint(
+                            self._snapshot_live(key, live_info)
+                        )
+                        last_ckpt = n
         finally:
+            if key is not None:
+                self._unregister_live(key)
             resp = self.engine.finish_slot(slot)
-        yield {
+        finish = finish_override or resp.finish_reason
+        yield stamp({
             "done": True,
-            "finish_reason": finish_override or resp.finish_reason,
+            "finish_reason": finish,
             "usage": {
                 "prompt_tokens": resp.prompt_tokens,
                 "completion_tokens": resp.completion_tokens,
                 "total_tokens": resp.prompt_tokens + resp.completion_tokens,
                 "cached_tokens": resp.cached_tokens,
             },
-        }
+        }, sent_tokens)
+        # NOTE: the server-held checkpoint is deliberately NOT retired on
+        # completion. The worker cannot know the final SSE bytes reached
+        # the client (TCP buffers): a client that lost the tail must still
+        # be able to resume, with the last checkpoint regenerating (stop)
+        # or serving (length) the missing suffix. The control plane ages
+        # stream checkpoints out instead (sweep_stale_stream_checkpoints).
 
     async def stream_inference(self, params: Dict[str, Any]):
         """Async wrapper: the sync per-step generator runs in a worker

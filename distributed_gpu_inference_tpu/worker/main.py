@@ -37,6 +37,7 @@ from ..utils.config import WorkerConfig
 from ..utils.data_structures import TpuTopology, WorkerState
 from .api_client import APIClient, APIError
 from .engines import EngineLoadError, create_engine
+from .engines.base import JobMigrated
 from .machine_id import MachineFingerprint
 
 log = logging.getLogger("tpu_worker")
@@ -214,6 +215,7 @@ class Worker:
         self._rng = random.Random(0xC0FFEE)
         self.stats: Dict[str, Any] = {
             "jobs_completed": 0, "jobs_failed": 0, "jobs_rejected": 0,
+            "jobs_migrated": 0,
             "heartbeats": 0, "config_refetches": 0,
         }
 
@@ -331,12 +333,35 @@ class Worker:
         for eng in self.engines.values():
             core = getattr(eng, "engine", None)
             stats = getattr(core, "stats", None)
-            if not isinstance(stats, dict):
-                continue
-            for k in ("preemptions", "resumes", "kv_pressure_events"):
-                if k in stats:
-                    out[k] = out.get(k, 0) + int(stats.get(k, 0) or 0)
+            if isinstance(stats, dict):
+                for k in ("preemptions", "resumes", "kv_pressure_events"):
+                    if k in stats:
+                        out[k] = out.get(k, 0) + int(stats.get(k, 0) or 0)
+            # abandoned streamed-handoff sessions purged by the engine's
+            # HandoffReceiver → kv_handoff_sessions_purged_total
+            purged = getattr(eng, "handoff_sessions_purged", None)
+            if purged:
+                out["kv_handoff_sessions_purged"] = (
+                    out.get("kv_handoff_sessions_purged", 0) + int(purged)
+                )
         return out or None
+
+    def _collect_checkpoints(self) -> List[Dict[str, Any]]:
+        """Portable checkpoints of every in-flight generation across loaded
+        engines — piggybacked on heartbeats so a sequence survives this
+        worker's death: the control plane attaches the latest checkpoint to
+        the requeued job / adoptable stream and the replacement worker
+        resumes instead of regenerating."""
+        out: List[Dict[str, Any]] = []
+        for eng in self.engines.values():
+            fn = getattr(eng, "checkpoint_live", None)
+            if fn is None:
+                continue
+            try:
+                out.extend(fn() or [])
+            except Exception:  # noqa: BLE001 — never break the heartbeat
+                log.debug("checkpoint collection failed", exc_info=True)
+        return out
 
     def _heartbeat_once(self) -> None:
         try:
@@ -350,6 +375,9 @@ class Worker:
                 engine_stats.update(pressure_stats)
             if engine_stats:
                 extra["engine_stats"] = engine_stats
+            checkpoints = self._collect_checkpoints()
+            if checkpoints:
+                extra["checkpoints"] = checkpoints
             resp = self.api.heartbeat(
                 status=self.state.value,
                 config_version=self.config.config_version,
@@ -471,22 +499,60 @@ class Worker:
 
     def process_job(self, job: Dict[str, Any]) -> None:
         """Run one claimed job. Caller must hold the BUSY state
-        (``try_begin_job``)."""
+        (``try_begin_job``).
+
+        Failover-capable engines get a ``_failover_ctx`` (job id, assignment
+        epoch, and the claim's server-held checkpoint, if any): they resume
+        a requeued generation instead of regenerating, register it for
+        heartbeat checkpointing, and — on graceful drain — freeze it and
+        raise :class:`JobMigrated`, which hands the checkpoint back to the
+        control plane WITHOUT burning a retry. Completions carry the
+        assignment epoch so a zombie's late result is fenced with a 409."""
         job_id = job["id"]
         task_type = job.get("type", "llm")
         engine = self.engines.get(task_type)
         self.current_job_id = job_id
         started = time.time()
+        epoch = int(job.get("assignment_epoch") or 0)
+        fenced = "assignment_epoch" in job
+        complete_kw: Dict[str, Any] = (
+            {"assignment_epoch": epoch} if fenced else {}
+        )
         try:
             if engine is None:
                 raise RuntimeError(f"no engine loaded for type {task_type!r}")
-            result = engine.inference(job.get("params") or {})
-            self.api.complete_job(job_id, success=True, result=result)
+            params = dict(job.get("params") or {})
+            # reserved key: never accept a client-submitted failover
+            # context from job params — the worker mints it below
+            params.pop("_failover_ctx", None)
+            if getattr(engine, "supports_failover", False):
+                params["_failover_ctx"] = {
+                    "key": job_id, "kind": "job", "epoch": epoch,
+                    "checkpoint": job.get("checkpoint"),
+                }
+            result = engine.inference(params)
+            self.api.complete_job(
+                job_id, success=True, result=result, **complete_kw
+            )
             self.stats["jobs_completed"] += 1
+        except JobMigrated as mig:
+            log.info("job %s migrated on drain (%d tokens checkpointed)",
+                     job_id, mig.tokens)
+            try:
+                self.api.checkpoint_job(
+                    job_id, epoch, mig.checkpoint, migrate=True
+                )
+            except APIError:
+                # the server's offline requeue still reruns the job from
+                # the last heartbeat-piggybacked checkpoint
+                log.error("could not push drain checkpoint for %s", job_id)
+            self.stats["jobs_migrated"] += 1
         except Exception as exc:  # noqa: BLE001 - job failure is a result
             log.exception("job %s failed", job_id)
             try:
-                self.api.complete_job(job_id, success=False, error=str(exc))
+                self.api.complete_job(
+                    job_id, success=False, error=str(exc), **complete_kw
+                )
             except APIError:
                 log.error("could not report failure for job %s", job_id)
             self.stats["jobs_failed"] += 1
@@ -537,6 +603,11 @@ class Worker:
               block: bool = True) -> None:
         self.register()
         self.load_engines()
+        for eng in self.engines.values():
+            # stream-checkpoint cadence between heartbeats (llm engine):
+            # admission + every checkpoint_interval_tokens
+            if hasattr(eng, "checkpoint_sink"):
+                eng.checkpoint_sink = self.push_stream_checkpoint
         if self.config.direct.enabled:
             from .direct_server import DirectServer
 
@@ -580,17 +651,53 @@ class Worker:
         self.request_shutdown()
 
     def request_shutdown(self) -> None:
-        """Graceful drain (reference main.py:444-463): stop accepting, let the
-        in-flight job finish, notify the server."""
+        """Graceful drain (reference main.py:444-463): stop accepting,
+        MIGRATE the in-flight generation instead of finishing it (failover-
+        capable engines freeze at the next step boundary and the checkpoint
+        requeues the job — seconds instead of a full generation's tail),
+        then notify the server."""
         if self._shutdown.is_set():
             return
         with self._state_lock:
             self.state = WorkerState.DRAINING
+        for eng in self.engines.values():
+            interrupt = getattr(eng, "interrupt_live", None)
+            if interrupt is not None:
+                try:
+                    interrupt()
+                except Exception:  # noqa: BLE001
+                    pass
         try:
             self.api.going_offline()
         except APIError:
             pass
         self._shutdown.set()
+
+    # -- stream failover (direct server drives these) ------------------------
+
+    def adopt_stream_checkpoint(self, stream_id: str
+                                ) -> Optional[Dict[str, Any]]:
+        """Fetch-and-fence a dropped stream's checkpoint from the control
+        plane (epoch bumps to this worker). None when no checkpoint exists
+        — the direct server then answers the resume with a 409."""
+        try:
+            return self.api.adopt_stream(stream_id)
+        except APIError as exc:
+            if exc.status == 404:
+                return None
+            raise
+
+    def push_stream_checkpoint(self, entry: Dict[str, Any]) -> None:
+        """Checkpoint sink for the llm engine's stream cadence: push one
+        stream checkpoint (or its ``done`` retirement) to the control
+        plane. Job-kind entries only ride heartbeats — pushing them here
+        would double-report."""
+        if entry.get("kind") != "stream":
+            return
+        self.api.checkpoint_stream(
+            entry["key"], int(entry.get("epoch") or 0),
+            entry.get("state"), done=bool(entry.get("done")),
+        )
 
     def _finalize_shutdown(self) -> None:
         try:

@@ -18,6 +18,7 @@ import pathlib
 # ordered heaviest-first; files absent from the checkout are skipped
 HEAVY = [
     "tests/test_chaos_scenarios.py",     # 50-seed replays per scenario
+    "tests/test_worker_failover_chaos.py",  # 25-seed kill-mid-stream e2e
     "tests/test_parallel_pipeline.py",
     "tests/test_parallel_ring_attention.py",
     "tests/test_engine_spec_integrated.py",  # spec scan graphs x 2 engines

@@ -99,6 +99,7 @@ def _fake_receiver():
     rx = HandoffReceiver.__new__(HandoffReceiver)
     rx.engine = engine
     rx._sessions = {}
+    rx.stats = {"sessions_purged": 0}
     return rx
 
 

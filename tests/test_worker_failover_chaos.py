@@ -1,0 +1,1069 @@
+"""Crash-safe generation: cross-worker sequence failover chaos suite.
+
+The acceptance scenario: a client streams tokens from worker A over the
+direct SSE path; A checkpoints the generation to the control plane
+(admission + per-token cadence + heartbeat piggyback); a seeded fault kills
+A's socket mid-stream (after ≥1 delivered token, before EOS); the SDK
+reconnects with its ``Last-Event-ID``-style offset, worker B adopts the
+checkpoint (epoch fence bumps, zombifying A), resumes via
+``TPUEngine.resume`` and splices the continuation — and the client ends up
+with the BYTE-IDENTICAL greedy token sequence an unkilled run produces.
+No gap, no duplicate, across 25 seeds.
+
+Also covered here: assignment-epoch fencing of a zombie's late
+complete_job / stale checkpoints, drain migration without retry burn,
+partial-output preservation on permanent failure, the
+``TaskGuaranteeService.wait_for_job`` timeout and ``_lost_race`` paths
+(previously untested), the HandoffReceiver adopt-session cap, and the
+SDK's consumed-prefix fallback guard.
+"""
+
+import asyncio
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import httpx
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.engine import PreemptedSequence
+from distributed_gpu_inference_tpu.sdk.client import (
+    InferenceClient,
+    InferenceClientError,
+)
+from distributed_gpu_inference_tpu.server.store import Store
+from distributed_gpu_inference_tpu.server.task_guarantee import (
+    TaskGuaranteeService,
+)
+from distributed_gpu_inference_tpu.testing import faults
+from distributed_gpu_inference_tpu.testing.faults import FaultPlan, FaultRule
+from distributed_gpu_inference_tpu.testing.harness import LiveControlPlane
+from distributed_gpu_inference_tpu.utils.data_structures import (
+    InferenceRequest,
+    JobStatus,
+    SamplingParams,
+    WorkerState,
+)
+from distributed_gpu_inference_tpu.worker.api_client import APIClient, APIError
+
+pytestmark = [pytest.mark.chaos, pytest.mark.failover]
+
+N_SEEDS = 25
+
+
+def _wire(prompt: List[int], generated: List[int], max_new: int = 16,
+          request_id: str = "r1") -> Dict[str, Any]:
+    """A valid v1 checkpoint for control-plane-level tests."""
+    return PreemptedSequence(
+        request=InferenceRequest(
+            request_id=request_id,
+            prompt_token_ids=list(prompt),
+            sampling=SamplingParams(max_new_tokens=max_new),
+        ),
+        prompt_len=len(prompt),
+        generated=list(generated),
+        slot_key=(3, 4),
+        start_time=1.0,
+        first_token_time=2.0,
+        cached_tokens=0,
+    ).to_wire()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: kill worker A mid-stream, client splices B's continuation
+# ---------------------------------------------------------------------------
+
+
+class _DirectWorker:
+    """Minimal worker shim around a real TPULLMEngine + DirectServer: the
+    claim state machine, the checkpoint sink (stream cadence → control
+    plane), and stream adoption — the exact surfaces ``Worker`` wires."""
+
+    def __init__(self, eng: Any, api: APIClient) -> None:
+        self.engines = {"llm": eng}
+        self.api = api
+        self.state = WorkerState.IDLE
+        self.adoptions = 0
+        eng.checkpoint_sink = self.push_stream_checkpoint
+
+    def try_begin_job(self) -> bool:
+        if self.state != WorkerState.IDLE:
+            return False
+        self.state = WorkerState.BUSY
+        return True
+
+    def end_job(self) -> None:
+        if self.state == WorkerState.BUSY:
+            self.state = WorkerState.IDLE
+
+    def should_accept_job(self, job: Dict[str, Any]) -> bool:
+        return True
+
+    def note_job_done(self, started: float) -> None:
+        pass
+
+    def get_status(self) -> Dict[str, Any]:
+        return {"state": self.state.value}
+
+    def adopt_stream_checkpoint(self, stream_id: str
+                                ) -> Optional[Dict[str, Any]]:
+        try:
+            out = self.api.adopt_stream(stream_id)
+        except APIError as exc:
+            if exc.status == 404:
+                return None
+            raise
+        self.adoptions += 1
+        return out
+
+    def push_stream_checkpoint(self, entry: Dict[str, Any]) -> None:
+        if entry.get("kind") != "stream":
+            return
+        self.api.checkpoint_stream(
+            entry["key"], int(entry.get("epoch") or 0),
+            entry.get("state"), done=bool(entry.get("done")),
+        )
+
+
+class _Fleet:
+    """One live control plane + two direct workers (A first in discovery
+    order) sharing tiny real engines; built once per module — jit compiles
+    amortize across the 25 seeds."""
+
+    def __init__(self) -> None:
+        from distributed_gpu_inference_tpu.worker.direct_server import (
+            DirectServer,
+        )
+        from distributed_gpu_inference_tpu.worker.engines.llm import (
+            TPULLMEngine,
+        )
+
+        self.plane = LiveControlPlane()
+        self.plane.__enter__()
+        self.workers: List[_DirectWorker] = []
+        self.servers = []
+        for name in ("wka", "wkb"):
+            eng = TPULLMEngine({
+                "model": "llama3-tiny", "max_batch_size": 2,
+                "max_seq_len": 128, "multi_step": 4,
+                # per-token cadence: the kill point is seeded per event, so
+                # a checkpoint must exist before every possible cut
+                "checkpoint_interval_tokens": 1,
+            })
+            eng.load_model()
+            api = APIClient(self.plane.url, backoff_s=0.0)
+            w = _DirectWorker(eng, api)
+            ds = DirectServer(w, host="127.0.0.1", port=0)
+            ds.start()
+            port = ds._runner.addresses[0][1]
+            api.register({
+                "name": name, "region": "us-west",
+                "supported_types": ["llm"],
+                "supports_direct": True,
+                "direct_url": f"http://127.0.0.1:{port}",
+            })
+            self.workers.append(w)
+            self.servers.append(ds)
+
+    def close(self) -> None:
+        for ds in self.servers:
+            ds.stop()
+        for w in self.workers:
+            w.api.close()
+        self.plane.__exit__(None, None, None)
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    f = _Fleet()
+    yield f
+    f.close()
+
+
+def _collect(chunks: List[Dict[str, Any]]) -> Dict[str, Any]:
+    toks: List[int] = []
+    text = ""
+    for c in chunks:
+        if c.get("done"):
+            return {"tokens": toks, "text": text,
+                    "finish": c.get("finish_reason"),
+                    "usage": c.get("usage", {})}
+        toks.extend(c.get("token_ids") or [])
+        text += c.get("text_delta") or ""
+    raise AssertionError("stream ended without a done event")
+
+
+def _scenario_prompt(seed: int) -> str:
+    return "".join(chr(97 + (seed * 7 + i * 3) % 26) for i in range(12))
+
+
+def scenario_kill_mid_stream(fleet: _Fleet, seed: int) -> None:
+    a, b = fleet.workers
+    max_new = 10 + seed % 5
+    prompt = _scenario_prompt(seed)
+    params = {"prompt": prompt, "max_new_tokens": max_new}
+    # reference: the same greedy generation, unkilled, straight off worker
+    # B's engine (identically-seeded weights; its prefix cache then also
+    # exercises the KV-restore-on-resume path in the kill run)
+    ref = _collect(list(b.engines["llm"].stream(dict(params))))
+    n = len(ref["tokens"])
+    if n < 2:
+        # degenerate seed (EOS at the first token): nothing to kill
+        # mid-generation — lengthen the prompt deterministically
+        params["prompt"] = prompt + "qz"
+        ref = _collect(list(b.engines["llm"].stream(dict(params))))
+        n = len(ref["tokens"])
+    assert n >= 2, f"seed {seed}: reference produced {n} tokens"
+    # kill after k delivered events, 1 ≤ k ≤ n-1: ≥1 token reached the
+    # client, and the cut lands strictly before the last token event (so
+    # before EOS/done)
+    kill_after = 1 + (seed % (n - 1))
+    plan = FaultPlan(seed, [
+        FaultRule(site="worker.direct.stream", kind="drop",
+                  after=kill_after, times=1),
+    ])
+    adoptions_before = b.adoptions
+    client = InferenceClient(fleet.plane.url, backoff_s=0.0)
+    try:
+        with faults.active(plan):
+            out = _collect(list(client.stream_chat(timeout_s=60.0, **params)))
+    finally:
+        client.close()
+    # the kill fired exactly once, and the failover worker adopted
+    assert [t[1] for t in plan.trace] == ["drop"], (seed, plan.trace)
+    assert b.adoptions == adoptions_before + 1, seed
+    # exactly-once: byte-identical token sequence — no gap, no duplicate
+    assert out["tokens"] == ref["tokens"], (
+        seed, kill_after, out["tokens"], ref["tokens"]
+    )
+    assert out["text"] == ref["text"], (seed, kill_after)
+    assert out["finish"] == ref["finish"], (seed, kill_after)
+    # both engines quiet (no leaked slots on either side of the failover);
+    # the server-side release runs in the handler's finally, which races
+    # the client's read of the final event — give it a moment to land
+    deadline = time.time() + 5.0
+    while time.time() < deadline and not (
+        a.state == WorkerState.IDLE and b.state == WorkerState.IDLE
+        and a.engines["llm"].engine.num_active == 0
+        and b.engines["llm"].engine.num_active == 0
+    ):
+        time.sleep(0.01)
+    assert a.engines["llm"].engine.num_active == 0
+    assert b.engines["llm"].engine.num_active == 0
+    assert a.state == WorkerState.IDLE and b.state == WorkerState.IDLE
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_kill_mid_stream_byte_identical_continuation(fleet, seed):
+    scenario_kill_mid_stream(fleet, seed)
+
+
+def test_completed_stream_checkpoint_stays_until_sweep(fleet):
+    """A worker cannot know its final SSE bytes reached the client, so a
+    completed stream's checkpoint stays adoptable (a tail-less client can
+    still resume); the control-plane sweep ages it out instead."""
+    b = fleet.workers[1]
+    client = InferenceClient(fleet.plane.url, backoff_s=0.0)
+    try:
+        chunks = list(client.stream_chat(prompt="hello world",
+                                         max_new_tokens=6, timeout_s=60.0))
+    finally:
+        client.close()
+    out = _collect(chunks)
+    assert out["finish"] in ("stop", "length")
+    sid = next(c["stream_id"] for c in chunks if c.get("stream_id"))
+    adopted = b.api.adopt_stream(sid)
+    assert adopted["checkpoint"]["v"] == 1
+    # ...and the age sweep retires abandoned rows
+    cp = fleet.plane
+    purged = cp.call(cp.state.guarantee.sweep_stale_stream_checkpoints(
+        now=time.time() + 31 * 60.0
+    ))
+    assert sid in purged
+    with pytest.raises(APIError) as ei:
+        b.api.adopt_stream(sid)
+    assert ei.value.status == 404
+
+
+# ---------------------------------------------------------------------------
+# control-plane fencing: epochs, zombies, drain migration
+# ---------------------------------------------------------------------------
+
+
+def _register(cp: LiveControlPlane, name: str) -> APIClient:
+    api = APIClient(cp.url, backoff_s=0.0)
+    api.register({"name": name, "region": "us-west",
+                  "supported_types": ["llm"]})
+    return api
+
+
+def _create_job(cp: LiveControlPlane,
+                params: Optional[Dict[str, Any]] = None) -> str:
+    return cp.call(cp.state.store.create_job({
+        "type": "llm", "params": params or {"prompt": "x"},
+    }))
+
+
+def test_job_checkpoint_rides_heartbeat_and_is_epoch_fenced():
+    with LiveControlPlane() as cp:
+        api_a = _register(cp, "a")
+        job_id = _create_job(cp)
+        job = api_a.fetch_next_job()
+        assert job["id"] == job_id
+        assert int(job["assignment_epoch"]) == 1      # claim bumped it
+        assert job.get("checkpoint") is None
+
+        ck1 = _wire([1, 2, 3], [10, 11])
+        api_a.heartbeat(status="busy", current_job_id=job_id, checkpoints=[
+            {"kind": "job", "key": job_id, "epoch": 1, "state": ck1},
+        ])
+        row = cp.job(job_id)
+        assert row["checkpoint"]["generated"] == [10, 11]
+
+        # stale-epoch checkpoint is fenced out (heartbeat still succeeds)
+        ck_stale = _wire([1, 2, 3], [99])
+        api_a.heartbeat(status="busy", current_job_id=job_id, checkpoints=[
+            {"kind": "job", "key": job_id, "epoch": 0, "state": ck_stale},
+        ])
+        assert cp.job(job_id)["checkpoint"]["generated"] == [10, 11]
+
+        # worker dies: requeue PRESERVES the checkpoint, burns one retry
+        cp.call(cp.state.guarantee.handle_worker_offline(api_a.worker_id))
+        row = cp.job(job_id)
+        assert row["status"] == JobStatus.QUEUED.value
+        assert row["checkpoint"]["generated"] == [10, 11]
+        assert row["retry_count"] == 1
+
+        # replacement worker's claim carries the checkpoint + a NEW epoch
+        api_b = _register(cp, "b")
+        job_b = api_b.fetch_next_job()
+        assert job_b["id"] == job_id
+        assert int(job_b["assignment_epoch"]) == 2
+        assert job_b["checkpoint"]["generated"] == [10, 11]
+
+        # cross-worker zombie: A's late completion bounces on the
+        # ownership check (the pre-existing fence)
+        with pytest.raises(APIError) as ei:
+            api_a.complete_job(job_id, success=True,
+                               result={"text": "zombie"},
+                               assignment_epoch=1)
+        assert ei.value.status == 404
+        assert cp.job(job_id)["worker_id"] == api_b.worker_id
+
+        # SAME-worker zombie — the hole only the epoch closes: B's job is
+        # requeued, B revives and RECLAIMS it (epoch 3); B's previous
+        # incarnation then reports under epoch 2 — worker_id matches,
+        # status is RUNNING, but the fence rejects it with 409
+        cp.call(cp.state.guarantee.handle_worker_offline(api_b.worker_id))
+        api_b.heartbeat(status="idle")           # revive
+        job_b2 = api_b.fetch_next_job()
+        assert job_b2["id"] == job_id
+        assert int(job_b2["assignment_epoch"]) == 3
+        with pytest.raises(APIError) as ei:
+            api_b.complete_job(job_id, success=True,
+                               result={"text": "zombie"},
+                               assignment_epoch=2)
+        assert ei.value.status == 409
+        row = cp.job(job_id)
+        assert row["status"] == JobStatus.RUNNING.value
+        assert row.get("result") is None
+
+        # the live incarnation's completion (current epoch) applies
+        api_b.complete_job(job_id, success=True, result={"text": "ok"},
+                           assignment_epoch=3)
+        row = cp.job(job_id)
+        assert row["status"] == JobStatus.COMPLETED.value
+        assert row["result"]["text"] == "ok"
+        api_a.close()
+        api_b.close()
+
+
+def test_drain_migration_requeues_with_checkpoint_no_retry_burn():
+    with LiveControlPlane() as cp:
+        api = _register(cp, "a")
+        job_id = _create_job(cp)
+        job = api.fetch_next_job()
+        out = api.checkpoint_job(job_id, int(job["assignment_epoch"]),
+                                 _wire([1, 2], [7, 8, 9]), migrate=True)
+        assert out["requeued"] is True
+        row = cp.job(job_id)
+        assert row["status"] == JobStatus.QUEUED.value
+        assert row["retry_count"] == 0           # a drain is not a failure
+        assert row["checkpoint"]["generated"] == [7, 8, 9]
+        assert row["worker_id"] is None
+        w = cp.worker(api.worker_id)
+        assert w["current_job_id"] is None
+
+        # a second (now stale-epoch) migrate attempt is fenced
+        with pytest.raises(APIError) as ei:
+            api.checkpoint_job(job_id, int(job["assignment_epoch"]),
+                               _wire([1, 2], [7]), migrate=True)
+        assert ei.value.status in (404, 409)
+        api.close()
+
+
+def test_stream_checkpoint_adopt_bumps_epoch_and_fences_zombie():
+    with LiveControlPlane() as cp:
+        api_a = _register(cp, "a")
+        api_b = _register(cp, "b")
+        sid = "stream-1"
+        ck = _wire([1, 2, 3], [5])
+        assert api_a.checkpoint_stream(sid, 0, ck)["ok"] is True
+
+        adopted = api_b.adopt_stream(sid)
+        assert adopted["epoch"] == 1
+        assert adopted["checkpoint"]["generated"] == [5]
+
+        # zombie A: stale checkpoint rejected, stale "done" cannot erase
+        with pytest.raises(APIError) as ei:
+            api_a.checkpoint_stream(sid, 0, _wire([1, 2, 3], [5, 6]))
+        assert ei.value.status == 409
+        api_a.checkpoint_stream(sid, 0, None, done=True)
+        assert cp.call(cp.state.store.get_stream_checkpoint(sid)) is not None
+
+        # the adopter keeps checkpointing at its epoch, then retires it
+        assert api_b.checkpoint_stream(
+            sid, 1, _wire([1, 2, 3], [5, 6, 7])
+        )["ok"] is True
+        api_b.checkpoint_stream(sid, 1, None, done=True)
+        assert cp.call(cp.state.store.get_stream_checkpoint(sid)) is None
+        with pytest.raises(APIError) as ei:
+            api_b.adopt_stream(sid)
+        assert ei.value.status == 404
+        api_a.close()
+        api_b.close()
+
+
+def test_nearest_direct_worker_exclude_filters_the_corpse():
+    with LiveControlPlane() as cp:
+        for name in ("a", "b"):
+            api = APIClient(cp.url, backoff_s=0.0)
+            api.register({
+                "name": name, "region": "us-west",
+                "supported_types": ["llm"], "supports_direct": True,
+                "direct_url": f"http://{name}.example:8471",
+            })
+            if name == "a":
+                wid_a = api.worker_id
+            api.close()
+        r = httpx.get(f"{cp.url}/api/v1/jobs/direct/nearest")
+        assert r.json()["worker_id"] == wid_a
+        r = httpx.get(f"{cp.url}/api/v1/jobs/direct/nearest",
+                      params={"exclude": wid_a})
+        assert r.json()["worker_id"] != wid_a
+
+
+# ---------------------------------------------------------------------------
+# task-guarantee satellites: partial preservation, wait_for_job, lost races
+# ---------------------------------------------------------------------------
+
+
+def test_permanent_failure_preserves_checkpoint_partial_output():
+    async def body():
+        store = Store()
+        svc = TaskGuaranteeService(store)
+        job_id = await store.create_job({
+            "type": "llm", "params": {}, "status": JobStatus.RUNNING.value,
+            "worker_id": "w1", "started_at": time.time(),
+            "retry_count": 3, "max_retries": 3,
+            "checkpoint": _wire([1, 2], [21, 22, 23]),
+        })
+        job = await store.get_job(job_id)
+        status = await svc.requeue_job(job, reason="worker_offline")
+        assert status == JobStatus.FAILED.value
+        row = await store.get_job(job_id)
+        assert row["result"]["partial"] is True
+        assert row["result"]["partial_token_ids"] == [21, 22, 23]
+        assert row["result"]["partial_tokens"] == 3
+        assert "max_retries" in row["error"]
+        store.close()
+
+    asyncio.run(body())
+
+
+def test_requeue_without_checkpoint_keeps_no_partial():
+    async def body():
+        store = Store()
+        svc = TaskGuaranteeService(store)
+        job_id = await store.create_job({
+            "type": "llm", "params": {}, "status": JobStatus.RUNNING.value,
+            "worker_id": "w1", "started_at": time.time(),
+            "retry_count": 3, "max_retries": 3,
+        })
+        job = await store.get_job(job_id)
+        assert await svc.requeue_job(job) == JobStatus.FAILED.value
+        assert (await store.get_job(job_id)).get("result") is None
+        store.close()
+
+    asyncio.run(body())
+
+
+def test_wait_for_job_times_out_returns_last_row():
+    async def body():
+        store = Store()
+        svc = TaskGuaranteeService(store)
+        job_id = await store.create_job({"type": "llm", "params": {}})
+        t0 = time.monotonic()
+        row = await svc.wait_for_job(job_id, timeout_s=0.2, poll_s=0.02)
+        assert time.monotonic() - t0 >= 0.2
+        # non-terminal at the deadline: the CURRENT row comes back, so the
+        # caller can report the live status instead of a generic timeout
+        assert row is not None and row["status"] == JobStatus.QUEUED.value
+        store.close()
+
+    asyncio.run(body())
+
+
+def test_wait_for_job_missing_job_returns_none_and_terminal_returns():
+    async def body():
+        store = Store()
+        svc = TaskGuaranteeService(store)
+        assert await svc.wait_for_job("nope", timeout_s=0.1,
+                                      poll_s=0.02) is None
+        job_id = await store.create_job({"type": "llm", "params": {}})
+
+        async def complete_soon():
+            await asyncio.sleep(0.05)
+            await store.update_job(job_id, status=JobStatus.COMPLETED.value)
+
+        task = asyncio.ensure_future(complete_soon())
+        row = await svc.wait_for_job(job_id, timeout_s=5.0, poll_s=0.02)
+        await task
+        assert row["status"] == JobStatus.COMPLETED.value
+        store.close()
+
+    asyncio.run(body())
+
+
+def test_requeue_lost_race_returns_live_status():
+    async def body():
+        store = Store()
+        svc = TaskGuaranteeService(store)
+        job_id = await store.create_job({
+            "type": "llm", "params": {}, "status": JobStatus.RUNNING.value,
+            "worker_id": "w1", "started_at": time.time(),
+        })
+        snapshot = await store.get_job(job_id)
+        # a slow-but-alive worker completes JUST before the sweep's write:
+        # the conditional transition loses and the terminal status wins
+        await store.update_job(job_id, status=JobStatus.COMPLETED.value)
+        status = await svc.requeue_job(snapshot, reason="job_timeout")
+        assert status == JobStatus.COMPLETED.value
+        assert (await store.get_job(job_id))["status"] == \
+            JobStatus.COMPLETED.value
+
+        # and a job deleted out from under the sweep reports FAILED
+        job2 = await store.create_job({
+            "type": "llm", "params": {}, "status": JobStatus.RUNNING.value,
+            "worker_id": "w1", "started_at": time.time(),
+        })
+        snap2 = await store.get_job(job2)
+        await store.execute("DELETE FROM jobs WHERE id=?", (job2,))
+        assert await svc.requeue_job(snap2) == JobStatus.FAILED.value
+        store.close()
+
+    asyncio.run(body())
+
+
+def test_requeue_lost_write_takes_lost_race_path():
+    """Chaos seam: the conditional transition's write is DROPPED (wedged
+    store) — requeue_job must report the row's live status, not pretend
+    the requeue happened."""
+    async def body():
+        store = Store()
+        svc = TaskGuaranteeService(store)
+        job_id = await store.create_job({
+            "type": "llm", "params": {}, "status": JobStatus.RUNNING.value,
+            "worker_id": "w1", "started_at": time.time(),
+        })
+        job = await store.get_job(job_id)
+        plan = FaultPlan(0, [
+            FaultRule(site="server.store.execute", kind="drop",
+                      match={"sql": "*transition*"}),
+        ])
+        with faults.active(plan):
+            status = await svc.requeue_job(job)
+        assert status == JobStatus.RUNNING.value   # nothing moved
+        assert plan.trace
+        store.close()
+
+    asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# worker-side: process_job context, drain migration, heartbeat piggyback
+# ---------------------------------------------------------------------------
+
+
+class _FakeFailoverEngine:
+    supports_failover = True
+
+    def __init__(self) -> None:
+        self.seen_ctx: List[Dict[str, Any]] = []
+        self.migrate_on_next: Optional[Dict[str, Any]] = None
+        self.live_entries: List[Dict[str, Any]] = []
+        self.interrupted = False
+
+    def inference(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        from distributed_gpu_inference_tpu.worker.engines.base import (
+            JobMigrated,
+        )
+
+        self.seen_ctx.append(params.get("_failover_ctx"))
+        if self.migrate_on_next is not None:
+            ck, self.migrate_on_next = self.migrate_on_next, None
+            raise JobMigrated(ck, tokens=len(ck.get("generated") or []))
+        return {"text": "done"}
+
+    def checkpoint_live(self) -> List[Dict[str, Any]]:
+        return list(self.live_entries)
+
+    def interrupt_live(self) -> None:
+        self.interrupted = True
+
+
+class _FakeCkptAPI:
+    def __init__(self) -> None:
+        self.worker_id = "w-1"
+        self.completed: List[Dict[str, Any]] = []
+        self.checkpointed: List[Dict[str, Any]] = []
+        self.heartbeats: List[Dict[str, Any]] = []
+
+    def heartbeat(self, **kw):
+        self.heartbeats.append(kw)
+        return {}
+
+    def complete_job(self, job_id, success, result=None, error=None,
+                     **kw):
+        self.completed.append({"job_id": job_id, "success": success,
+                               "result": result, "error": error, **kw})
+        return {"ok": True}
+
+    def checkpoint_job(self, job_id, assignment_epoch, state,
+                       migrate=False):
+        self.checkpointed.append({
+            "job_id": job_id, "assignment_epoch": assignment_epoch,
+            "state": state, "migrate": migrate,
+        })
+        return {"ok": True, "requeued": True}
+
+    def going_offline(self):
+        pass
+
+
+def _worker_with(engine, api):
+    from distributed_gpu_inference_tpu.utils.config import WorkerConfig
+    from distributed_gpu_inference_tpu.worker.main import Worker
+
+    w = Worker(WorkerConfig(), api=api)
+    w.engines = {"llm": engine}
+    w.state = WorkerState.IDLE
+    return w
+
+
+def test_process_job_threads_failover_ctx_and_epoch():
+    eng, api = _FakeFailoverEngine(), _FakeCkptAPI()
+    w = _worker_with(eng, api)
+    assert w.try_begin_job()
+    ck = _wire([1], [2])
+    w.process_job({"id": "j1", "type": "llm", "params": {"prompt": "x"},
+                   "assignment_epoch": 3, "checkpoint": ck})
+    ctx = eng.seen_ctx[0]
+    assert ctx == {"key": "j1", "kind": "job", "epoch": 3, "checkpoint": ck}
+    assert api.completed[0]["assignment_epoch"] == 3
+    assert w.state == WorkerState.IDLE
+
+
+def test_process_job_without_epoch_keeps_legacy_complete():
+    eng, api = _FakeFailoverEngine(), _FakeCkptAPI()
+    w = _worker_with(eng, api)
+    assert w.try_begin_job()
+    w.process_job({"id": "j1", "type": "llm", "params": {}})
+    assert "assignment_epoch" not in api.completed[0]
+
+
+def test_job_migrated_checkpoints_instead_of_completing():
+    eng, api = _FakeFailoverEngine(), _FakeCkptAPI()
+    ck = _wire([1], [2, 3])
+    eng.migrate_on_next = ck
+    w = _worker_with(eng, api)
+    assert w.try_begin_job()
+    w.process_job({"id": "j1", "type": "llm", "params": {},
+                   "assignment_epoch": 2})
+    assert api.completed == []
+    assert api.checkpointed == [{
+        "job_id": "j1", "assignment_epoch": 2, "state": ck, "migrate": True,
+    }]
+    assert w.stats["jobs_migrated"] == 1
+    assert w.stats["jobs_failed"] == 0
+
+
+def test_heartbeat_piggybacks_live_checkpoints_and_drain_interrupts():
+    eng, api = _FakeFailoverEngine(), _FakeCkptAPI()
+    entry = {"kind": "job", "key": "j1", "epoch": 1, "state": _wire([1], [2])}
+    eng.live_entries = [entry]
+    w = _worker_with(eng, api)
+    w._heartbeat_once()
+    assert api.heartbeats[0]["checkpoints"] == [entry]
+    eng.live_entries = []
+    w._heartbeat_once()
+    assert "checkpoints" not in api.heartbeats[1]
+    w.request_shutdown()
+    assert eng.interrupted
+
+
+# ---------------------------------------------------------------------------
+# llm-engine unit: queued resume from a checkpoint is byte-identical
+# ---------------------------------------------------------------------------
+
+
+def test_job_inference_resumes_from_checkpoint_byte_identical(fleet):
+    from distributed_gpu_inference_tpu.worker.engines.base import (
+        GenerationConfig,
+    )
+
+    llm = fleet.workers[1].engines["llm"]
+    params = {"prompt": "resume me please", "max_new_tokens": 9}
+    ref = _collect(list(llm.stream(dict(params))))
+    assert len(ref["tokens"]) >= 4, ref
+    # rebuild the request EXACTLY as the engine did (eos merged into stops)
+    req = llm._build_request(params["prompt"],
+                             GenerationConfig.from_params(params))
+    # pretend the first worker died after 3 tokens: checkpoint carries them
+    pre = PreemptedSequence(
+        request=req, prompt_len=len(req.prompt_token_ids),
+        generated=ref["tokens"][:3],
+        slot_key=(0, 0), start_time=time.time(), first_token_time=None,
+        cached_tokens=0,
+    )
+    resumed = llm.inference({**params,
+                             "_failover_ctx": {"key": "jf2", "epoch": 2,
+                                               "checkpoint": pre.to_wire()}})
+    assert resumed["text"] == ref["text"]
+    assert resumed["usage"]["completion_tokens"] == \
+        ref["usage"]["completion_tokens"]
+    assert llm.engine.num_active == 0
+
+
+def test_interrupt_freezes_queued_job_into_checkpoint(fleet):
+    from distributed_gpu_inference_tpu.worker.engines.base import JobMigrated
+
+    llm = fleet.workers[0].engines["llm"]
+    llm._interrupt.set()
+    try:
+        with pytest.raises(JobMigrated) as ei:
+            llm.inference({"prompt": "drain mid-generation",
+                           "max_new_tokens": 32,
+                           "_failover_ctx": {"key": "jd", "epoch": 1,
+                                             "checkpoint": None}})
+    finally:
+        llm._interrupt.clear()
+    ck = ei.value.checkpoint
+    assert ck["v"] == 1
+    assert isinstance(ck["generated"], list)
+    assert llm.engine.num_active == 0
+    # the frozen state resumes cleanly elsewhere (worker B's engine)
+    other = fleet.workers[1].engines["llm"]
+    resumed = other.inference({"prompt": "drain mid-generation",
+                               "max_new_tokens": 32,
+                               "_failover_ctx": {"key": "jd2", "epoch": 2,
+                                                 "checkpoint": ck}})
+    reference = other.inference({"prompt": "drain mid-generation",
+                                 "max_new_tokens": 32})
+    assert resumed["text"] == reference["text"]
+
+
+# ---------------------------------------------------------------------------
+# HandoffReceiver: adopt-session cap purge (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_handoff_begin_purges_on_session_cap():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        HandoffReceiver,
+    )
+    from distributed_gpu_inference_tpu.testing.fakes import (
+        FakeKVEngine,
+        make_stream_messages,
+    )
+
+    eng = FakeKVEngine(num_blocks=64)
+    rx = HandoffReceiver(eng)
+    rx.MAX_SESSIONS = 2
+    rx.handle(make_stream_messages("k1", list(range(8)))[0])
+    rx.handle(make_stream_messages("k2", list(range(8, 16)))[0])
+    free_before = len(eng.manager.free_blocks)
+    # third begin: the table is at the cap — the stalest session (k1) is
+    # evicted, its blocks freed, and the purge is COUNTED
+    rx.handle(make_stream_messages("k3", list(range(16, 24)))[0])
+    assert "k1" not in rx._sessions
+    assert {"k2", "k3"} <= set(rx._sessions)
+    assert rx.stats["sessions_purged"] == 1
+    assert len(eng.manager.free_blocks) >= free_before - 2
+    with pytest.raises(ValueError, match="no streamed handoff session"):
+        rx.handle(make_stream_messages("k1", list(range(8)))[1])
+
+
+def test_handoff_ttl_purge_is_counted():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        HandoffReceiver,
+    )
+    from distributed_gpu_inference_tpu.testing.fakes import (
+        FakeKVEngine,
+        make_stream_messages,
+    )
+
+    eng = FakeKVEngine(num_blocks=32)
+    rx = HandoffReceiver(eng)
+    rx.handle(make_stream_messages("k1", list(range(8)))[0])
+    rx._sessions["k1"].last_activity -= rx.SESSION_TTL_S + 1.0
+    rx._purge_stale()
+    assert rx.stats["sessions_purged"] == 1
+
+
+# ---------------------------------------------------------------------------
+# SDK: fallback guard + resume protocol (satellite + tentpole client half)
+# ---------------------------------------------------------------------------
+
+
+class _IterStream(httpx.SyncByteStream):
+    def __init__(self, it):
+        self._it = it
+
+    def __iter__(self):
+        return self._it
+
+
+def _sse(chunk: Dict[str, Any]) -> bytes:
+    return f"data: {json.dumps(chunk)}\n\n".encode()
+
+
+def test_sdk_resumes_dropped_stream_and_splices():
+    calls: List[Dict[str, Any]] = []
+
+    class T(httpx.BaseTransport):
+        def handle_request(self, req):
+            if req.url.path == "/api/v1/jobs/direct/nearest":
+                excl = dict(req.url.params).get("exclude", "")
+                wid = "wb" if "wa" in excl else "wa"
+                return httpx.Response(200, json={
+                    "worker_id": wid, "region": "us-west",
+                    "direct_url": f"http://{wid}:8471",
+                })
+            assert req.url.path == "/inference/stream"
+            body = json.loads(req.read())
+            calls.append(body)
+            if "resume" not in body:
+                def gen():
+                    yield _sse({"text_delta": "He", "token_ids": [1],
+                                "offset": 1, "stream_id": "s"})
+                    yield _sse({"text_delta": "ll", "token_ids": [2],
+                                "offset": 2, "stream_id": "s"})
+                    raise httpx.ReadError("worker died")
+
+                return httpx.Response(
+                    200, headers={"Content-Type": "text/event-stream"},
+                    stream=_IterStream(gen()),
+                )
+            assert body["resume"] == {"stream_id": body["stream_id"],
+                                      "offset": 2, "text_offset": 4}
+            sse = (_sse({"text_delta": "o", "token_ids": [3], "offset": 3,
+                         "stream_id": "s"})
+                   + _sse({"done": True, "finish_reason": "stop",
+                           "usage": {"completion_tokens": 3}, "offset": 3}))
+            return httpx.Response(
+                200, content=sse,
+                headers={"Content-Type": "text/event-stream"},
+            )
+
+    c = InferenceClient("http://s1", transport=T(), backoff_s=0.0)
+    chunks = list(c.stream_chat(prompt="x"))
+    assert "".join(ch.get("text_delta", "") for ch in chunks[:-1]) == "Hello"
+    assert [t for ch in chunks[:-1] for t in ch["token_ids"]] == [1, 2, 3]
+    assert chunks[-1]["done"] is True
+    # the reconnect excluded the dead worker and went to the failover peer
+    assert len(calls) == 2 and "resume" in calls[1]
+
+
+def test_sdk_no_checkpoint_after_consumption_raises_never_requeues():
+    """Satellite guard: once a chunk was consumed, a dropped stream must
+    NEVER fall back to a fresh queued job (double generation) — with no
+    checkpoint to resume from, it raises."""
+    queued_calls = []
+
+    class T(httpx.BaseTransport):
+        def handle_request(self, req):
+            if req.url.path == "/api/v1/jobs/direct/nearest":
+                return httpx.Response(200, json={
+                    "worker_id": "wa", "region": "us-west",
+                    "direct_url": "http://wa:8471",
+                })
+            if req.url.path in ("/api/v1/jobs/sync", "/api/v1/jobs"):
+                queued_calls.append(req.url.path)
+                return httpx.Response(200, json={
+                    "job_id": "j", "status": "completed",
+                    "result": {"text": "dup"},
+                })
+            assert req.url.path == "/inference/stream"
+            body = json.loads(req.read())
+            if "resume" in body:
+                return httpx.Response(409, json={
+                    "detail": "no checkpoint for stream",
+                })
+
+            def gen():
+                yield _sse({"text_delta": "He", "token_ids": [1],
+                            "offset": 1})
+                raise httpx.ReadError("worker died")
+
+            return httpx.Response(
+                200, headers={"Content-Type": "text/event-stream"},
+                stream=_IterStream(gen()),
+            )
+
+    c = InferenceClient("http://s1", transport=T(), backoff_s=0.0)
+    out = []
+    with pytest.raises(InferenceClientError,
+                       match="no checkpoint to resume"):
+        for ch in c.stream_chat(prompt="x"):
+            out.append(ch)
+    assert out and out[0]["text_delta"] == "He"
+    assert queued_calls == []           # the prompt never re-ran
+
+
+def test_sdk_drop_before_first_chunk_still_falls_back_to_queue():
+    class T(httpx.BaseTransport):
+        def handle_request(self, req):
+            if req.url.path == "/api/v1/jobs/direct/nearest":
+                return httpx.Response(200, json={
+                    "worker_id": "wa", "region": "us-west",
+                    "direct_url": "http://wa:8471",
+                })
+            if req.url.path == "/inference/stream":
+                raise httpx.ConnectError("refused")
+            assert req.url.path == "/api/v1/jobs/sync"
+            return httpx.Response(200, json={
+                "job_id": "j", "status": "completed",
+                "result": {"text": "fallback", "finish_reason": "stop",
+                           "usage": {"completion_tokens": 1}},
+            })
+
+    c = InferenceClient("http://s1", transport=T(), backoff_s=0.0)
+    chunks = list(c.stream_chat(prompt="x"))
+    assert chunks[0]["text_delta"] == "fallback"
+    assert chunks[-1]["done"] is True
+
+
+def test_sdk_passes_same_offset_holdback_flush_chunk():
+    """An EOS finish flushes held-back stop-string characters as a
+    text-only chunk at an UNCHANGED token offset — the dedupe must let it
+    through (only same-offset chunks carrying token ids are replays)."""
+    class T(httpx.BaseTransport):
+        def handle_request(self, req):
+            if req.url.path == "/api/v1/jobs/direct/nearest":
+                return httpx.Response(200, json={
+                    "worker_id": "wa", "region": "us-west",
+                    "direct_url": "http://wa:8471",
+                })
+            assert req.url.path == "/inference/stream"
+            sse = (_sse({"text_delta": "Hel", "token_ids": [1, 2],
+                         "offset": 2})
+                   + _sse({"text_delta": "lo", "token_ids": [],
+                           "offset": 2})          # holdback flush
+                   + _sse({"done": True, "finish_reason": "stop",
+                           "usage": {"completion_tokens": 2}, "offset": 2}))
+            return httpx.Response(
+                200, content=sse,
+                headers={"Content-Type": "text/event-stream"},
+            )
+
+    c = InferenceClient("http://s1", transport=T(), backoff_s=0.0)
+    chunks = list(c.stream_chat(prompt="x", stop=["###"]))
+    assert "".join(ch.get("text_delta", "") for ch in chunks[:-1]) == "Hello"
+    assert chunks[-1]["done"] is True
+
+
+def test_sdk_resume_sends_text_offset_and_worker_splices_flush(fleet):
+    """Resume after a holdback flush: the client's consumed TEXT is ahead
+    of what the token offset implies; the resume body carries text_offset
+    and the worker's splice never re-delivers the flushed characters."""
+    llm = fleet.workers[1].engines["llm"]
+    params = {"prompt": "stop string splice", "max_new_tokens": 8,
+              "stop": ["ÿÿÿ"]}      # never matches: holdback 2
+    ref = _collect(list(llm.stream(dict(params))))
+    assert ref["tokens"], ref
+    # simulate: client consumed everything (tokens AND flushed text), then
+    # the done event was lost — it resumes with full offsets
+    from distributed_gpu_inference_tpu.worker.engines.base import (
+        GenerationConfig,
+    )
+
+    req = llm._build_request(params["prompt"],
+                             GenerationConfig.from_params(params))
+    pre = PreemptedSequence(
+        request=req, prompt_len=len(req.prompt_token_ids),
+        generated=ref["tokens"], slot_key=(0, 0),
+        start_time=time.time(), first_token_time=None, cached_tokens=0,
+    )
+    out = list(llm.stream({**params, "_failover_ctx": {
+        "key": "sf", "epoch": 2, "checkpoint": pre.to_wire(),
+        "offset": len(ref["tokens"]), "text_offset": len(ref["text"]),
+    }}))
+    resumed = _collect(out)
+    # everything was already consumed — NOTHING may be re-delivered (the
+    # flushed holdback characters in particular), and the stream closes
+    # with the same finish
+    assert resumed["text"] == ""
+    assert resumed["tokens"] == []
+    assert resumed["finish"] == ref["finish"]
+
+
+def test_sdk_resume_budget_exhaustion_raises():
+    class T(httpx.BaseTransport):
+        def handle_request(self, req):
+            if req.url.path == "/api/v1/jobs/direct/nearest":
+                return httpx.Response(200, json={
+                    "worker_id": "wa", "region": "us-west",
+                    "direct_url": "http://wa:8471",
+                })
+            assert req.url.path == "/inference/stream"
+
+            def gen():
+                yield _sse({"text_delta": "x", "token_ids": [1],
+                            "offset": 1})
+                raise httpx.ReadError("flaky")
+
+            return httpx.Response(
+                200, headers={"Content-Type": "text/event-stream"},
+                stream=_IterStream(gen()),
+            )
+
+    c = InferenceClient("http://s1", transport=T(), backoff_s=0.0)
+    with pytest.raises(InferenceClientError, match="resume budget"):
+        # every reconnect re-yields nothing new (offset 1 deduped) then
+        # drops again — the budget bounds the loop
+        list(c.stream_chat(prompt="x", max_stream_resumes=2))
+
+
+# ---------------------------------------------------------------------------
+# wire format: versioning
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_wire_rejects_unknown_version():
+    ck = _wire([1, 2], [3])
+    ck["v"] = 99
+    with pytest.raises(ValueError, match="unsupported checkpoint version"):
+        PreemptedSequence.from_wire(ck)
+    with pytest.raises(ValueError):
+        PreemptedSequence.from_wire("not-a-dict")
+
+
+def test_checkpoint_wire_roundtrips_through_json():
+    ck = json.loads(json.dumps(_wire([1, 2, 3], [9, 8])))
+    pre = PreemptedSequence.from_wire(ck)
+    assert pre.generated == [9, 8]
+    assert pre.slot_key == (3, 4)
+    assert pre.request.prompt_token_ids == [1, 2, 3]
+    assert pre.request.sampling.max_new_tokens == 16
