@@ -27,10 +27,13 @@ import asyncio
 import functools
 import heapq
 import itertools
+import logging
+import threading
 import time
+from concurrent.futures import Future as _Future
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from distributed_gpu_inference_tpu.runtime.engine import (
     ChunkedAdmission,
@@ -44,6 +47,44 @@ from distributed_gpu_inference_tpu.utils.data_structures import (
     compute_prefix_hash,
 )
 from distributed_gpu_inference_tpu.utils.data_structures import KV_BLOCK_TOKENS
+
+log = logging.getLogger(__name__)
+
+
+class RequestMigrated(Exception):
+    """A submitted request was frozen at a step boundary by its *interrupt*
+    event (graceful drain): the generation did not fail — it carries a
+    portable :class:`PreemptedSequence` the caller hands to the control
+    plane so another worker resumes it. The serving layer translates this
+    into the worker-level ``JobMigrated``."""
+
+    def __init__(self, pre: PreemptedSequence) -> None:
+        super().__init__(
+            f"request migrated with {len(pre.generated)} generated tokens"
+        )
+        self.pre = pre
+
+
+def synthesize_checkpoint(request: InferenceRequest) -> PreemptedSequence:
+    """A zero-token checkpoint for a request the engine never admitted
+    (interrupted while still queued, or the admission-time stream record).
+    The slot key mirrors ``TPUEngine._bind_slot``'s derivation for seeded
+    requests so a resume elsewhere stays seed-stable; unseeded sampling was
+    never deterministic, so the (0, 0) fallback loses nothing."""
+    seed = request.sampling.seed
+    key = (
+        ((int(seed) >> 32) & 0xFFFFFFFF, int(seed) & 0xFFFFFFFF)
+        if seed is not None else (0, 0)
+    )
+    return PreemptedSequence(
+        request=request,
+        prompt_len=len(request.prompt_token_ids or []),
+        generated=[],
+        slot_key=key,
+        start_time=request.arrival_time,
+        first_token_time=None,
+        cached_tokens=0,
+    )
 
 
 @dataclass
@@ -107,6 +148,16 @@ class _QueueItem:
     # consecutive resume failures seen while the engine held NOTHING else:
     # an idle pool that cannot re-admit the sequence never will
     idle_resume_oob: int = field(compare=False, default=0)
+    # serving hooks (all optional): ``observer(tokens)`` is called on the
+    # event-loop thread after every decode round the sequence survived with
+    # the monotonic generated-token list (SSE streaming reads deltas off
+    # it); ``cancel`` aborts at the next step boundary (client gone);
+    # ``interrupt`` freezes into a checkpoint and fails the future with
+    # :class:`RequestMigrated` (graceful drain)
+    observer: Optional[Callable[[List[int]], None]] = \
+        field(compare=False, default=None)
+    cancel: Optional[Any] = field(compare=False, default=None)
+    interrupt: Optional[Any] = field(compare=False, default=None)
 
 
 class ContinuousBatcher:
@@ -140,19 +191,10 @@ class ContinuousBatcher:
         self._stopping = False
         self._run_task: Optional[asyncio.Task] = None
         self._exec = ThreadPoolExecutor(max_workers=1, thread_name_prefix="engine")
-        if self.cfg.adaptive:
-            self._levels = self.cfg.horizon_levels
-        else:
-            # a fixed horizon compiles exactly one graph — honor it verbatim
-            self._levels = (max(self.cfg.min_multi_step,
-                                min(self.cfg.multi_step,
-                                    self.cfg.max_multi_step)),)
-        # start at the level closest to the configured multi_step
-        self._level = min(
-            range(len(self._levels)),
-            key=lambda i: abs(self._levels[i] - self.cfg.multi_step),
-        )
-        self._horizon = float(self._levels[self._level])
+        self._levels: Tuple[int, ...] = ()
+        self._level = 0
+        self._horizon = 0.0
+        self._rebuild_levels(float(self.cfg.multi_step))
         self._slot_items: Dict[int, _QueueItem] = {}
         # admission stamps for LIFO victim selection (slot indices recycle,
         # so recency must be tracked per admission, not per slot number)
@@ -175,7 +217,29 @@ class ContinuousBatcher:
             "spec_waves": 0, "spec_completed": 0, "spec_errors": 0,
             "preemptions": 0, "resumes": 0, "preemption_block_pressure": 0,
             "preempted_too_often": 0,
+            "cancelled": 0, "migrated": 0, "adopted": 0,
         }
+
+    def _rebuild_levels(self, anchor: float) -> None:
+        """THE quantized-horizon level-set derivation (init + live
+        reconfigure): adaptive mode exposes the power-of-4 levels, fixed
+        mode honors the clamped ``multi_step`` verbatim; the current level
+        snaps to the one nearest ``anchor`` so a retune never requests an
+        uncompiled scan length mid-flight."""
+        if self.cfg.adaptive:
+            levels = self.cfg.horizon_levels
+        else:
+            # a fixed horizon compiles exactly one graph — honor it verbatim
+            levels = (max(self.cfg.min_multi_step,
+                          min(self.cfg.multi_step,
+                              self.cfg.max_multi_step)),)
+        self._levels = levels
+        self._level = min(
+            range(len(levels)), key=lambda i: abs(levels[i] - anchor)
+        )
+        self._horizon = float(levels[self._level])
+        if hasattr(self, "stats"):
+            self.stats["horizon"] = self._horizon
 
     # ---------------------------------------------------- speculative routing
 
@@ -190,8 +254,22 @@ class ContinuousBatcher:
             return False
         if r.params.get("speculative") is False:
             return False
+        if item.observer is not None or item.cancel is not None \
+                or item.interrupt is not None:
+            # serving hooks need round-granular slot access (streaming
+            # deltas, step-boundary abort/migrate) — a whole-wave spec
+            # dispatch offers none of that
+            return False
         s = self.spec
-        if len(ids) > s.prefill_buckets[-1]:
+        max_bucket = s.prefill_buckets[-1]
+        eng_buckets = getattr(self.engine.cfg, "prefill_buckets", None)
+        if eng_buckets:
+            # prompts beyond the PAGED engine's largest bucket take the
+            # chunk-interleaved admission; spec routing honors the same
+            # boundary so the long-prompt path is one contract across
+            # serving modes (the worker's legacy driver gated on it too)
+            max_bucket = min(max_bucket, eng_buckets[-1])
+        if len(ids) > max_bucket:
             return False
         # headroom must cover the WORST verify tree (incl. adaptive depth
         # growth): the spec fits-freeze ends a row early at
@@ -295,11 +373,25 @@ class ContinuousBatcher:
     # ---------------------------------------------------------------- API
 
     async def submit(
-        self, request: InferenceRequest, timeout_s: Optional[float] = None
+        self, request: InferenceRequest, timeout_s: Optional[float] = None,
+        *,
+        observer: Optional[Callable[[List[int]], None]] = None,
+        cancel: Optional[Any] = None,
+        interrupt: Optional[Any] = None,
+        resume_from: Optional[PreemptedSequence] = None,
     ) -> InferenceResponse:
         """Enqueue and await completion (reference submit:130 semantics:
         future resolves with the response; queue-full and timeout surface as
-        errors in the response)."""
+        errors in the response).
+
+        Serving hooks: ``observer`` receives the monotonic generated-token
+        list after every decode round (SSE streaming); ``cancel`` (an
+        Event) aborts at the next step boundary; ``interrupt`` (an Event)
+        freezes the sequence into a checkpoint and raises
+        :class:`RequestMigrated` here instead of resolving (graceful
+        drain). ``resume_from`` re-admits a server-held checkpoint instead
+        of prefilling from scratch — head-of-line, through the same
+        cache/spill-restoring resume path KV-pressure preemptions use."""
         if self._stopping:
             raise RuntimeError("batcher is stopping")
         if len(self._heap) >= self.cfg.queue_limit:
@@ -307,12 +399,14 @@ class ContinuousBatcher:
             return InferenceResponse(
                 request_id=request.request_id, error="queue full"
             )
-        if not self.engine.request_fits_pool(request):
+        if resume_from is None and not self.engine.request_fits_pool(request):
             # the PROMPT alone cannot fit even an idle pool: no amount of
             # preemption could ever admit it — reject up front. (The check
             # is deliberately not worst-case on max_new_tokens; generation
             # that outgrows the pool is handled dynamically by preemption,
-            # bounded by max_preemptions and the idle-resume abort.)
+            # bounded by max_preemptions and the idle-resume abort.
+            # Checkpoint resumes skip it: they were admitted once and the
+            # preempted_too_often cap owns their capacity endgame.)
             self.stats["rejected"] += 1
             return InferenceResponse(
                 request_id=request.request_id,
@@ -325,6 +419,10 @@ class ContinuousBatcher:
             sort_key=(-request.priority, request.arrival_time, next(self._seq)),
             request=request,
             future=fut,
+            observer=observer,
+            cancel=cancel,
+            interrupt=interrupt,
+            preempted=resume_from,
         )
         heapq.heappush(self._heap, item)
         self.stats["submitted"] += 1
@@ -339,6 +437,44 @@ class ContinuousBatcher:
                 request_id=request.request_id, error=f"timeout after {timeout_s}s"
             )
 
+    async def adopt_slot(self, slot: int,
+                         request: Optional[InferenceRequest] = None
+                         ) -> InferenceResponse:
+        """Drive an ALREADY-ADMITTED engine slot (PD decode stage: the
+        sequence arrived through a KV handoff, not through submit) inside
+        the shared decode rounds, and await its completion. The slot joins
+        the batch exactly like a submitted request — it can be preempted,
+        resumed, and counted — so PD decode no longer monopolizes the
+        engine for its whole generation."""
+        if self._stopping:
+            # same race submit() guards: a stop() between the caller's
+            # serving.active check and this coroutine running would leave
+            # the item in _slot_items with no run task to ever resolve it
+            raise RuntimeError("batcher is stopping")
+        s = self.engine.slots[slot]
+        if s is None:
+            raise ValueError(f"slot {slot} is empty")
+        self.stats["adopted"] += 1
+        if s.finish_reason is not None:
+            # the sequence already finished (it decoded alongside earlier
+            # batcher rounds while awaiting adoption): resolve immediately
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                self._exec, self.engine.finish_slot, slot
+            )
+        req = request or s.request
+        loop = asyncio.get_running_loop()
+        fut: "asyncio.Future[InferenceResponse]" = loop.create_future()
+        item = _QueueItem(
+            sort_key=(-req.priority, req.arrival_time, next(self._seq)),
+            request=req,
+            future=fut,
+        )
+        self._slot_items[slot] = item
+        self._admit_stamp[slot] = next(self._stamp)
+        self._wake.set()
+        return await fut
+
     def start(self) -> None:
         if self._run_task is None:
             self._stopping = False
@@ -346,11 +482,15 @@ class ContinuousBatcher:
 
     async def stop(self, drain: bool = True) -> None:
         """Graceful shutdown: optionally finish queued + active work first
-        (reference worker drain semantics, main.py:444)."""
+        (reference worker drain semantics, main.py:444). Without drain,
+        every still-pending future resolves with an error response so no
+        caller is left waiting out its timeout against a dead loop."""
         self._stopping = True
         self._wake.set()
         if drain:
-            while self._heap or self.engine.num_active \
+            # drain batcher-OWNED work only: a foreign engine slot (e.g. a
+            # PD sequence retained between stages) is not ours to wait on
+            while self._heap or self._slot_items or self._chunked is not None \
                     or self._spec_wave is not None or self._spec_starting:
                 await asyncio.sleep(0.01)
         if self._run_task:
@@ -360,7 +500,66 @@ class ContinuousBatcher:
             except asyncio.CancelledError:
                 pass
             self._run_task = None
+        pending = list(self._slot_items.values()) + list(self._heap)
+        self._slot_items.clear()
+        self._heap.clear()
+        loop = asyncio.get_running_loop()
+        if self._chunked is not None:
+            # a request mid chunk-interleaved prefill is in NEITHER
+            # collection above — abort its engine state and resolve it,
+            # or its submit() would wait on a dead loop forever
+            adm, chunk_item = self._chunked
+            self._chunked = None
+            try:
+                await loop.run_in_executor(
+                    self._exec, self.engine.abort_chunked, adm
+                )
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                pass
+            pending.append(chunk_item)
+        if self._spec_wave is not None:
+            wave, items = self._spec_wave
+            self._spec_wave = None
+            try:
+                await loop.run_in_executor(
+                    self._exec, self.spec.abort_wave, wave
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            pending.extend(items)
+        for item in pending:
+            if item.future.done():
+                continue
+            item.future.set_result(InferenceResponse(
+                request_id=item.request.request_id,
+                error="batcher stopped",
+            ))
+            self.stats["completed"] += 1
         self._exec.shutdown(wait=False)
+
+    def reconfigure(self, **updates: Any) -> None:
+        """Apply server-pushed SLO knobs to a LIVE batcher between rounds:
+        any :class:`BatcherConfig` field by name (None values are ignored).
+        Horizon-shaping fields (``max_multi_step``, ``min_multi_step``,
+        ``multi_step``, ``adaptive``) rebuild the quantized level set; the
+        current level snaps to the nearest surviving horizon so retuning
+        never requests an uncompiled scan length mid-flight."""
+        coerced: Dict[str, Any] = {}
+        for key, val in updates.items():
+            if val is None or not hasattr(self.cfg, key):
+                continue
+            cur = getattr(self.cfg, key)
+            if isinstance(cur, bool) and isinstance(val, str):
+                # remote pushes arrive through an untyped dict and env/YAML
+                # tooling stringifies scalars — bool("false") is True, so
+                # coerce by content, not constructor
+                val = val.strip().lower() in ("1", "true", "yes", "on")
+            coerced[key] = type(cur)(val) if cur is not None else val
+        # all-or-nothing: coercion above raised before any cfg mutation,
+        # so one bad value can't leave a half-applied retune
+        for key, val in coerced.items():
+            setattr(self.cfg, key, val)
+        self._rebuild_levels(self._horizon)
 
     # ------------------------------------------------------------- internals
 
@@ -729,6 +928,128 @@ class ContinuousBatcher:
         )
         heapq.heappush(self._heap, item)
 
+    def _abort_slot(self, slot: int) -> Optional[InferenceResponse]:
+        """Runs on the engine executor: mark a live slot aborted and finish
+        it (partial tokens included). None when the slot vanished."""
+        s = self.engine.slots[slot]
+        if s is None:
+            return None
+        s.finish_reason = s.finish_reason or "abort"
+        return self.engine.finish_slot(slot)
+
+    async def _scan_signals(self) -> None:
+        """Honor per-request cancel/interrupt events at the loop boundary —
+        the only place slot state is quiescent. Cancels resolve with the
+        partial output (finish_reason="abort"); interrupts freeze into a
+        checkpoint and fail the future with :class:`RequestMigrated` so the
+        serving layer migrates the job without burning a retry."""
+        loop = asyncio.get_running_loop()
+        changed = False
+        for item in list(self._heap):
+            if item.future.done():
+                continue
+            if item.cancel is not None and item.cancel.is_set():
+                self._heap.remove(item)
+                changed = True
+                pre = item.preempted
+                item.future.set_result(InferenceResponse(
+                    request_id=item.request.request_id,
+                    token_ids=list(pre.generated) if pre else [],
+                    finish_reason="abort",
+                    prompt_tokens=pre.prompt_len if pre
+                    else len(item.request.prompt_token_ids or []),
+                    completion_tokens=len(pre.generated) if pre else 0,
+                ))
+                self.stats["completed"] += 1
+                self.stats["cancelled"] += 1
+            elif item.interrupt is not None and item.interrupt.is_set():
+                self._heap.remove(item)
+                changed = True
+                pre = item.preempted or synthesize_checkpoint(item.request)
+                pre.preempt_count = item.preempt_count
+                item.future.set_exception(RequestMigrated(pre))
+                self.stats["migrated"] += 1
+        if changed:
+            heapq.heapify(self._heap)
+        if self._chunked is not None:
+            adm, item = self._chunked
+            cancelled = item.cancel is not None and item.cancel.is_set()
+            interrupted = item.interrupt is not None \
+                and item.interrupt.is_set()
+            if cancelled or interrupted:
+                # a request mid chunk-interleaved prefill holds no
+                # resumable engine state yet: abort the admission (frees
+                # its slot + staged blocks) and either resolve with an
+                # empty abort or migrate with a synthesized zero-token
+                # checkpoint — burning the remaining prefill rounds on an
+                # abandoned/draining request would stall everyone else
+                self._chunked = None
+                try:
+                    await loop.run_in_executor(
+                        self._exec, self.engine.abort_chunked, adm
+                    )
+                except Exception:  # noqa: BLE001 — abort is best-effort
+                    pass
+                if not item.future.done():
+                    if cancelled:
+                        item.future.set_result(InferenceResponse(
+                            request_id=item.request.request_id,
+                            finish_reason="abort",
+                            prompt_tokens=len(
+                                item.request.prompt_token_ids or []),
+                        ))
+                        self.stats["completed"] += 1
+                        self.stats["cancelled"] += 1
+                    else:
+                        pre = synthesize_checkpoint(item.request)
+                        pre.preempt_count = item.preempt_count
+                        item.future.set_exception(RequestMigrated(pre))
+                        self.stats["migrated"] += 1
+        for slot, item in list(self._slot_items.items()):
+            s = self.engine.slots[slot]
+            if s is None or s.finish_reason is not None:
+                continue  # the round loop resolves finished slots
+            if item.cancel is not None and item.cancel.is_set():
+                try:
+                    resp = await loop.run_in_executor(
+                        self._exec, self._abort_slot, slot
+                    )
+                except Exception:
+                    continue
+                self._slot_items.pop(slot, None)
+                if resp is not None and not item.future.done():
+                    item.future.set_result(resp)
+                    self.stats["completed"] += 1
+                    self.stats["cancelled"] += 1
+            elif item.interrupt is not None and item.interrupt.is_set() \
+                    and not s.prefilling:
+                try:
+                    pre = await loop.run_in_executor(
+                        self._exec, self.engine.preempt_slot, slot
+                    )
+                except Exception:
+                    continue  # finished/changed under us — next pass
+                self._slot_items.pop(slot, None)
+                pre.preempt_count = item.preempt_count
+                if not item.future.done():
+                    item.future.set_exception(RequestMigrated(pre))
+                    self.stats["migrated"] += 1
+
+    def _notify_observers(self) -> None:
+        """Push per-round progress to streaming observers (loop thread;
+        observers must only enqueue). Finished slots are excluded — their
+        full token list rides the resolving response."""
+        for slot, item in list(self._slot_items.items()):
+            if item.observer is None:
+                continue
+            s = self.engine.slots[slot]
+            if s is None or s.finish_reason is not None:
+                continue
+            try:
+                item.observer(list(s.generated))
+            except Exception:  # noqa: BLE001 — an observer must never wedge serving
+                pass
+
     def _engine_round(self) -> float:
         """One blocking engine round on the worker thread. Returns latency ms."""
         t0 = time.perf_counter()
@@ -765,8 +1086,13 @@ class ContinuousBatcher:
         loop = asyncio.get_running_loop()
         latch_until = 0.0
         while True:
-            if not self._heap and not self.engine.num_active \
-                    and self._spec_wave is None:
+            # idle = no batcher-OWNED work. Deliberately not engine.num_active:
+            # a foreign slot (PD sequence retained/adopted between stages,
+            # awaiting its decode job) must neither keep this loop spinning
+            # nor be decoded/finished behind its owner's back — it joins the
+            # batch only through adopt_slot().
+            if not self._heap and not self._slot_items \
+                    and self._chunked is None and self._spec_wave is None:
                 self._wake.clear()
                 if self._stopping:
                     return
@@ -777,6 +1103,10 @@ class ContinuousBatcher:
             while time.time() < latch_until and \
                     len(self._heap) < len(self.engine.slots):
                 await asyncio.sleep(0.001)
+            # cancel/interrupt events land at this quiescent boundary:
+            # aborted requests release their slots BEFORE admission so the
+            # freed capacity admits waiting work this very pass
+            await self._scan_signals()
             # low-depth all-greedy load routes through the spec tree BEFORE
             # paged admission claims it; requests arriving mid-wave admit to
             # paged slots below and the two interleave round for round
@@ -791,9 +1121,10 @@ class ContinuousBatcher:
             await self._step_chunked()
             # one bounded fused dispatch of the in-flight spec wave
             await self._step_spec_wave()
-            if not self.engine.num_active:
-                # nothing active means nothing frozen is waiting on the
-                # freed blocks: resumes may flow immediately
+            if not self._slot_items and self._chunked is None:
+                # no batcher-owned slot decodes: no frozen slot of OURS is
+                # waiting on freed blocks, so resumes may flow immediately
+                # (foreign slots are left untouched for their owner)
                 self._resume_hold = False
                 if self._heap:
                     # deferred (pressured) work with an idle engine: yield
@@ -808,7 +1139,12 @@ class ContinuousBatcher:
                 self.stats["occupancy_sum"] += self.engine.num_active
                 self._retune(latency)
                 for i, s in enumerate(list(self.engine.slots)):
-                    if s is not None and s.finish_reason is not None:
+                    if s is not None and s.finish_reason is not None \
+                            and i in self._slot_items:
+                        # OWNED slots only: a foreign sequence that finished
+                        # while sharing our rounds (PD retained/awaiting
+                        # adoption) keeps its slot until its owner collects
+                        # it — finishing it here would discard the response
                         resp = await loop.run_in_executor(
                             self._exec, self.engine.finish_slot, i
                         )
@@ -816,6 +1152,9 @@ class ContinuousBatcher:
                         if item and not item.future.done():
                             item.future.set_result(resp)
                             self.stats["completed"] += 1
+                # streaming observers see each surviving slot's monotonic
+                # token list once per round (finished slots resolved above)
+                self._notify_observers()
                 # decode-sourced KV pressure: slots froze this round —
                 # preempt the policy victim so the next round progresses
                 # (completions above may already have freed blocks; the
@@ -848,16 +1187,18 @@ class ContinuousBatcher:
                             )
                         )
                         self.stats["completed"] += 1
-                for i, s in enumerate(list(self.engine.slots)):
-                    if s is None:
-                        continue
-                    try:
-                        await loop.run_in_executor(
-                            self._exec,
-                            lambda i=i: self.engine.finish_slot(i, cache=False),
-                        )
-                    except Exception:
-                        pass
+                for i in list(self._slot_items):
+                    # fail OWNED slots only — a foreign slot's owner handles
+                    # its own engine-error cleanup (PD decode already does)
+                    if self.engine.slots[i] is not None:
+                        try:
+                            await loop.run_in_executor(
+                                self._exec,
+                                lambda i=i: self.engine.finish_slot(
+                                    i, cache=False),
+                            )
+                        except Exception:
+                            pass
                     item = self._slot_items.pop(i, None)
                     if item and not item.future.done():
                         item.future.set_result(
@@ -891,3 +1232,164 @@ class ContinuousBatcher:
         if out["decode_rounds"]:
             out["avg_occupancy"] = out["occupancy_sum"] / out["decode_rounds"]
         return out
+
+
+class BatcherServing:
+    """Thread-hosted serving front-end over a :class:`ContinuousBatcher`.
+
+    The batcher is asyncio-native; the worker's callers are plain threads
+    (the poll loop, the direct server's handlers, PD stages, tests). This
+    wrapper owns a dedicated event loop thread running ONE batcher and
+    exposes a thread-safe surface:
+
+    - :meth:`submit` — blocking submit from any thread (the batcher's
+      serving hooks — observer / cancel / interrupt / resume_from — pass
+      through), raising :class:`RequestMigrated` on drain.
+    - :meth:`adopt_slot` — drive an externally-admitted engine slot (PD
+      decode) inside the shared decode rounds.
+    - :meth:`run_exclusive` — run an engine-touching callable on the
+      batcher's single engine-executor thread, serialized with decode
+      rounds (PD prefill / KV-handoff adoption compose with live serving
+      without a second lock hierarchy).
+    - :meth:`reconfigure` — apply server-pushed SLO knobs between rounds.
+    """
+
+    def __init__(self, engine: TPUEngine,
+                 cfg: Optional[BatcherConfig] = None,
+                 spec: Optional[Any] = None) -> None:
+        self.engine = engine
+        self._cfg = cfg
+        self._spec = spec
+        self.batcher: Optional[ContinuousBatcher] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._ready = threading.Event()
+        self._stopped = False
+        self._boot_error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="batcher-serving", daemon=True
+        )
+        self._thread.start()
+        if not self._ready.wait(timeout=10.0):
+            raise RuntimeError("batcher serving loop failed to start")
+        if self._boot_error is not None:
+            raise RuntimeError(
+                f"batcher serving loop failed: {self._boot_error}"
+            )
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def boot() -> None:
+            try:
+                self.batcher = ContinuousBatcher(
+                    self.engine, self._cfg, spec=self._spec
+                )
+                self.batcher.start()
+            except BaseException as exc:  # noqa: BLE001 — surfaced to ctor
+                self._boot_error = exc
+            finally:
+                self._ready.set()
+
+        loop.run_until_complete(boot())
+        if self._boot_error is None:
+            loop.run_forever()
+        loop.close()
+
+    # -- thread-safe surface -------------------------------------------------
+
+    def submit_async(self, request: InferenceRequest,
+                     timeout_s: Optional[float] = None,
+                     **hooks: Any) -> "_Future[InferenceResponse]":
+        assert self.batcher is not None and self._loop is not None
+        if self._stopped or not self._thread.is_alive():
+            # a coroutine scheduled on a dead loop never runs and its
+            # future never resolves — fail fast instead of hanging callers.
+            # NOT loop.is_running(): that is False in the window between
+            # boot() completing and run_forever() starting, and a coroutine
+            # scheduled in that window runs fine once the loop spins up.
+            raise RuntimeError("batcher serving is stopped")
+        return asyncio.run_coroutine_threadsafe(
+            self.batcher.submit(request, timeout_s, **hooks), self._loop
+        )
+
+    def submit(self, request: InferenceRequest,
+               timeout_s: Optional[float] = None,
+               **hooks: Any) -> InferenceResponse:
+        return self.submit_async(request, timeout_s, **hooks).result()
+
+    def adopt_slot(self, slot: int,
+                   request: Optional[InferenceRequest] = None
+                   ) -> InferenceResponse:
+        assert self.batcher is not None and self._loop is not None
+        return asyncio.run_coroutine_threadsafe(
+            self.batcher.adopt_slot(slot, request), self._loop
+        ).result()
+
+    def run_exclusive(self, fn: Callable[..., Any], *args: Any,
+                      **kw: Any) -> Any:
+        """Run ``fn`` on the batcher's engine-executor thread. Every engine
+        call the batcher makes runs on that SAME single thread, so this is
+        the serialization point for out-of-band engine work (PD prefill,
+        handoff adoption): no lock ordering, no mid-round interleaving —
+        the work simply runs between rounds."""
+        assert self.batcher is not None
+        return self.batcher._exec.submit(fn, *args, **kw).result()
+
+    def reconfigure(self, **updates: Any) -> None:
+        """Thread-safe config push: applied on the loop thread between
+        iterations (the batcher reads its cfg only at loop boundaries)."""
+        if self._loop is None or self.batcher is None:
+            return
+
+        def _apply() -> None:
+            try:
+                self.batcher.reconfigure(**updates)
+            except Exception:  # noqa: BLE001 — an operator push must not
+                # die in the event loop's default handler unseen
+                log.exception("serving config push rejected: %r", updates)
+
+        self._loop.call_soon_threadsafe(_apply)
+
+    def get_stats(self) -> Dict[str, Any]:
+        return self.batcher.get_stats() if self.batcher is not None else {}
+
+    @property
+    def active(self) -> bool:
+        # explicit lifecycle flag, NOT loop.is_running(): the latter is
+        # False between boot() and run_forever(), and a request arriving
+        # in that window would silently fall through to the legacy
+        # engine-lock path while the batcher thread comes up — two
+        # drivers on one engine
+        return (
+            self.batcher is not None
+            and self._loop is not None
+            and not self._stopped
+            and self._thread.is_alive()
+        )
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        if self._loop is None or self.batcher is None or self._stopped:
+            return
+        self._stopped = True   # reject new submits before draining old ones
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.batcher.stop(drain=drain), self._loop
+            ).result(timeout=timeout_s)
+        except Exception:  # noqa: BLE001 — drain stuck/timed out
+            # the loop must NOT die with futures still pending (every
+            # thread blocked in submit().result() would hang forever):
+            # force a non-drain stop, which resolves all outstanding
+            # futures with "batcher stopped" before the loop goes down
+            try:
+                asyncio.run_coroutine_threadsafe(
+                    self.batcher.stop(drain=False), self._loop
+                ).result(timeout=5.0)
+            except Exception:  # noqa: BLE001 — loop wedged: give up
+                pass
+        try:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        except RuntimeError:   # loop already closed (boot failed earlier)
+            pass
+        self._thread.join(timeout=5.0)

@@ -290,8 +290,12 @@ class InferenceClient:
         sync: bool = True,
         use_direct: bool = False,
         timeout_s: float = 120.0,
+        priority: int = 0,
         **gen_params: Any,
     ) -> Dict[str, Any]:
+        """``priority``: scheduling priority — orders the control-plane
+        queue AND the worker batcher's admission heap (higher admits
+        first; KV-pressure victims are picked lowest-priority-first)."""
         params: Dict[str, Any] = dict(gen_params)
         if messages is not None:
             params["messages"] = messages
@@ -299,11 +303,15 @@ class InferenceClient:
             params["prompt"] = prompt
         if model is not None:
             params["model"] = model
+        if priority:
+            params["priority"] = int(priority)
         if use_direct:
             result = self._try_direct("llm", params)
             if result is not None:
                 return result
-        return self._run_job("llm", params, sync=sync, timeout_s=timeout_s)
+        return self._run_job("llm", params, sync=sync, timeout_s=timeout_s,
+                             **({"priority": int(priority)} if priority
+                                else {}))
 
     def generate_image(self, prompt: str, sync: bool = True,
                        timeout_s: float = 300.0,
@@ -334,6 +342,7 @@ class InferenceClient:
         model: Optional[str] = None,
         timeout_s: float = 300.0,
         max_stream_resumes: int = 3,
+        priority: int = 0,
         **gen_params: Any,
     ):
         """Token streaming via the nearest direct worker's SSE endpoint.
@@ -365,6 +374,10 @@ class InferenceClient:
             params["prompt"] = prompt
         if model is not None:
             params["model"] = model
+        if priority:
+            # reaches the worker batcher's admission heap: a high-priority
+            # stream admits ahead of waiting work on a saturated worker
+            params["priority"] = int(priority)
 
         stream_id = _uuid.uuid4().hex
         offset = 0            # token offset of the last consumed event

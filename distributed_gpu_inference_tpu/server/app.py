@@ -372,6 +372,19 @@ async def heartbeat(request: web.Request) -> web.Response:
             fields["current_job_id"] = None
             if fields.get("status") == WorkerState.BUSY.value:
                 fields["status"] = WorkerState.IDLE.value
+    stale_jobs: list = []
+    extra_claims = body.get("active_job_ids")
+    if isinstance(extra_claims, list):
+        # a batcher-backed worker runs several jobs concurrently;
+        # current_job_id carries only one of them — fence the REST of its
+        # claims too, so a requeued/taken-over concurrent job is flagged
+        # back instead of silently finishing as undetected zombie work
+        jids = [jid for jid in extra_claims[:32]
+                if isinstance(jid, str) and jid != claimed]
+        jobs = await asyncio.gather(*(st.store.get_job(j) for j in jids))
+        for jid, job in zip(jids, jobs):
+            if job is None or job.get("worker_id") != worker_id:
+                stale_jobs.append(jid)
     if w.get("status") == WorkerState.OFFLINE.value:
         # swept offline but evidently alive: revive (a heartbeat IS proof of
         # life) and open a fresh reliability session so online-time
@@ -403,13 +416,19 @@ async def heartbeat(request: web.Request) -> web.Response:
         # KV-pressure counters (preemptions / resumes / pressure events)
         # ride the same payload → per-worker preemption panels in /metrics
         st.metrics.record_pressure_engine(worker_id, es)
+        # batcher serving stats (occupancy, queue depth, chunked
+        # admissions, drain migrations) → per-worker batch-health panels
+        batcher = es.get("batcher")
+        if isinstance(batcher, dict):
+            st.metrics.record_batcher_engine(worker_id, batcher)
     client_version = int(body.get("config_version") or 0)
     changed = await st.worker_config.config_changed_since(
         worker_id, client_version
     )
-    return web.json_response(
-        {"ok": True, "config_changed": changed, "stale_job": stale_job}
-    )
+    return web.json_response({
+        "ok": True, "config_changed": changed, "stale_job": stale_job,
+        **({"stale_jobs": stale_jobs} if stale_jobs else {}),
+    })
 
 
 async def next_job(request: web.Request) -> web.Response:

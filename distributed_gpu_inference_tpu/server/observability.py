@@ -83,6 +83,11 @@ class Metrics:
                 "kv_preemptions", "kv_resumes", "kv_pressure_events",
                 "job_checkpoints", "checkpoints_rejected",
                 "stream_failovers", "kv_handoff_purged",
+                "batcher_queue_depth", "batcher_active_slots",
+                "batcher_occupancy", "batcher_horizon",
+                "batcher_decode_rounds", "batcher_completed",
+                "batcher_chunked_admissions", "batcher_preemptions",
+                "batcher_migrated",
             ):
                 setattr(self, name, noop)
             return
@@ -182,6 +187,45 @@ class Metrics:
             "kv_handoff_sessions_purged_total",
             "Abandoned streamed-handoff sessions purged by receivers",
             ["worker"], registry=r)
+        # batcher-backed serving (the production worker path since round
+        # 6): per-worker batch health — queue depth growing while
+        # occupancy sits at the slot count means the worker is saturated;
+        # chunked admissions trending up means long prompts dominate.
+        self.batcher_queue_depth = Gauge(
+            "batcher_queue_depth",
+            "Requests waiting in the worker's continuous-batching "
+            "admission queue", ["worker"], registry=r)
+        self.batcher_active_slots = Gauge(
+            "batcher_active_slots",
+            "Engine slots decoding right now", ["worker"], registry=r)
+        self.batcher_occupancy = Gauge(
+            "batcher_avg_occupancy",
+            "Average decoding slots per engine round", ["worker"],
+            registry=r)
+        self.batcher_horizon = Gauge(
+            "batcher_horizon",
+            "Current adaptive decode horizon (device steps per host "
+            "round-trip)", ["worker"], registry=r)
+        self.batcher_decode_rounds = Counter(
+            "batcher_decode_rounds_total",
+            "Engine decode rounds driven by the batcher", ["worker"],
+            registry=r)
+        self.batcher_completed = Counter(
+            "batcher_requests_completed_total",
+            "Requests completed through the batcher serving path",
+            ["worker"], registry=r)
+        self.batcher_chunked_admissions = Counter(
+            "batcher_chunked_admissions_total",
+            "Long prompts admitted chunk-interleaved", ["worker"],
+            registry=r)
+        self.batcher_preemptions = Counter(
+            "batcher_preemptions_total",
+            "KV-pressure preemptions applied by the batcher's victim "
+            "policy", ["worker"], registry=r)
+        self.batcher_migrated = Counter(
+            "batcher_requests_migrated_total",
+            "In-flight requests frozen into checkpoints on graceful "
+            "drain", ["worker"], registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -199,6 +243,7 @@ class MetricsCollector:
         # monotonic totals, Prometheus counters advance by deltas
         self._spec_prev: Dict[str, Dict[str, int]] = {}
         self._pressure_prev: Dict[str, Dict[str, int]] = {}
+        self._batcher_prev: Dict[str, Dict[str, int]] = {}
 
     def record_request(self, job_type: str, status: str,
                        latency_s: Optional[float] = None) -> None:
@@ -302,6 +347,44 @@ class MetricsCollector:
                 continue
             try:
                 cur = int(engine_stats.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                continue
+            delta = cur - prev.get(key, 0)
+            if delta > 0:
+                metric.labels(worker).inc(delta)
+            prev[key] = cur
+
+    def record_batcher_engine(self, worker: str,
+                              stats: Dict[str, Any]) -> None:
+        """Ingest one worker's batcher serving stats (heartbeat
+        ``engine_stats["batcher"]`` — ``Worker._batcher_stats``): gauges
+        set directly, counters delta-anchored like the spec/pressure
+        payloads (totals re-anchor on engine restart, malformed fields
+        skip the sample)."""
+        for key, gauge in (
+            ("queue_depth", self.metrics.batcher_queue_depth),
+            ("active_slots", self.metrics.batcher_active_slots),
+            ("avg_occupancy", self.metrics.batcher_occupancy),
+            ("horizon", self.metrics.batcher_horizon),
+        ):
+            if key not in stats:
+                continue
+            try:
+                gauge.labels(worker).set(float(stats.get(key) or 0.0))
+            except (TypeError, ValueError):
+                continue
+        prev = self._batcher_prev.setdefault(worker, {})
+        for key, metric in (
+            ("decode_rounds", self.metrics.batcher_decode_rounds),
+            ("completed", self.metrics.batcher_completed),
+            ("chunked_admissions", self.metrics.batcher_chunked_admissions),
+            ("preemptions", self.metrics.batcher_preemptions),
+            ("migrated", self.metrics.batcher_migrated),
+        ):
+            if key not in stats:
+                continue
+            try:
+                cur = int(stats.get(key, 0) or 0)
             except (TypeError, ValueError):
                 continue
             delta = cur - prev.get(key, 0)

@@ -29,7 +29,14 @@ from .store import Store
 @dataclass
 class LoadControl:
     acceptance_rate: float = 1.0          # probability of accepting any job
-    max_concurrent_jobs: int = 1
+    # since round 6 this is also the worker's SHARED serving-claim cap
+    # (batcher-backed engines batch this many concurrent jobs/streams):
+    # the fleet default matches the worker-local default
+    # (utils.config.LoadControlConfig) — a server pushing 1 would silently
+    # disable continuous batching on every worker it manages. Workers
+    # whose engines have no batcher still serialize via the exclusive
+    # claim regardless of this value.
+    max_concurrent_jobs: int = 4
     max_jobs_per_hour: int = 0            # 0 = unlimited
     max_hbm_utilization: float = 0.9      # fraction of per-chip HBM usable
     working_hours: Optional[list] = None  # [start_hour, end_hour] UTC or None
@@ -63,6 +70,15 @@ class WorkerRemoteConfig:
     load_control: LoadControl = field(default_factory=LoadControl)
     security: SecurityPolicy = field(default_factory=SecurityPolicy)
     model_configs: Dict[str, ModelConfig] = field(default_factory=dict)
+    # batcher-serving SLO knobs pushed to live workers (the keys of
+    # utils.config.ServingConfig that retune a RUNNING batcher between
+    # decode rounds: target_step_ms, max_horizon, min_horizon, multi_step,
+    # adaptive, max_wait_ms, queue_limit, default_timeout_s,
+    # max_preemptions, spec_max_batch, spec_max_active). Compile-affecting
+    # admission knobs (subwave/interleave) and `mode` are load-time-only
+    # worker YAML and silently ignored by the worker if pushed. Empty dict
+    # = no override (the worker keeps its local config).
+    serving: Dict[str, Any] = field(default_factory=dict)
     updated_at: float = field(default_factory=time.time)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -80,6 +96,7 @@ class WorkerRemoteConfig:
             load_control=lc,
             security=sec,
             model_configs=mcs,
+            serving=dict(d.get("serving") or {}),
             updated_at=float(d.get("updated_at") or time.time()),
         )
 
@@ -111,8 +128,9 @@ class WorkerConfigService:
         cfg = await self.get_config(worker_id)
         d = cfg.to_dict()
         for key, val in updates.items():
-            if key in ("load_control", "security") and isinstance(val, dict):
-                d[key] = {**d.get(key, {}), **val}
+            if key in ("load_control", "security", "serving") \
+                    and isinstance(val, dict):
+                d[key] = {**(d.get(key) or {}), **val}
             elif key == "model_configs" and isinstance(val, dict):
                 merged = dict(d.get("model_configs") or {})
                 for task, mc in val.items():

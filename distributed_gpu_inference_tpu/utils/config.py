@@ -73,6 +73,32 @@ class LoadControlConfig(BaseModel):
     cooldown_seconds: float = 0.0
 
 
+class ServingConfig(BaseModel):
+    """Batcher-backed serving front-end (``engines.<type>.serving.*``) —
+    the SLO knobs the round-5 frontier measured, now first-class worker
+    YAML keys (``worker/engines/llm.py`` SERVING_DEFAULTS mirrors these).
+
+    ``target_step_ms`` / ``max_horizon`` / ``queue_limit`` / ``max_wait_ms``
+    are also remote-pushable (server ``WorkerRemoteConfig.serving``) and
+    retune a LIVE batcher; ``subwave`` / ``interleave`` / ``mode`` are
+    compile-affecting and apply at engine load only."""
+
+    mode: str = "batcher"               # batcher | direct (legacy driving)
+    target_step_ms: float = 100.0       # adaptive round-latency target
+    max_horizon: int = 64               # decode-scan cap (longest stall)
+    min_horizon: int = 1
+    multi_step: int = 8                 # initial decode horizon
+    adaptive: bool = True
+    max_wait_ms: float = 5.0            # admission latch
+    queue_limit: int = 1024
+    default_timeout_s: float = 300.0
+    max_preemptions: int = 3
+    subwave: int = 0                    # admission sub-wave width (load-time)
+    interleave: int = 0                 # decode steps between sub-waves (load-time)
+    spec_max_batch: int = 2
+    spec_max_active: int = 2
+
+
 class EngineModelConfig(BaseModel):
     """Per-task-type engine/model selection (reference :173-188)."""
 
@@ -80,6 +106,7 @@ class EngineModelConfig(BaseModel):
     model: str = "llama3-tiny"
     dtype: str = "bfloat16"
     quantization: Optional[str] = None  # int8 | fp8 | None
+    serving: Optional[ServingConfig] = None   # None → engine defaults
     extra: Dict[str, Any] = Field(default_factory=dict)
 
 

@@ -51,28 +51,35 @@ class DirectServer:
                                require_stream: bool = False):
         """ONE admission pipeline for both inference endpoints (load-control
         caps must hold no matter which path the job takes): returns
-        ``(engine, body, None)`` with the worker CLAIMED, or
-        ``(None, None, error_response)``. On success the caller owns the
-        claim and must call ``_release(started)``."""
+        ``(engine, body, release, None)`` with the worker CLAIMED, or
+        ``(None, None, None, error_response)``. On success the caller owns
+        the claim and must call ``release(started)``.
+
+        Claim kinds: an engine serving through a batcher front-end takes a
+        SHARED serving claim (concurrent requests join the batch, capped by
+        ``load_control.max_concurrent_jobs``); everything else keeps the
+        exclusive IDLE→BUSY claim (engines without a batcher are never
+        driven concurrently). Workers without the shared-claim surface
+        (older shims, tests) always get the exclusive claim."""
         try:
             body = await request.json()
         except ValueError:
-            return None, None, web.json_response(
+            return None, None, None, web.json_response(
                 {"detail": "invalid JSON"}, status=400
             )
         if not isinstance(body, dict):
-            return None, None, web.json_response(
+            return None, None, None, web.json_response(
                 {"detail": "body must be a JSON object"}, status=400
             )
         task_type = body.get("type", "llm")
         engine = self.worker.engines.get(task_type)
         if engine is None:
-            return None, None, web.json_response(
+            return None, None, None, web.json_response(
                 {"detail": f"task type {task_type!r} not loaded"}, status=404
             )
         if require_stream and \
                 getattr(engine, "stream_inference", None) is None:
-            return None, None, web.json_response(
+            return None, None, None, web.json_response(
                 {"detail": f"engine for {task_type!r} does not stream"},
                 status=501,
             )
@@ -87,29 +94,49 @@ class DirectServer:
         accept = getattr(self.worker, "should_accept_job", None)
         if accept is not None and not accept({"type": task_type}):
             self.stats["rejected"] += 1
-            return None, None, web.json_response(
+            return None, None, None, web.json_response(
                 {"detail": "declined by load control"}, status=503
             )
-        # atomically claim the worker (IDLE→BUSY): a second direct request,
-        # or the queue poll loop, sees BUSY and backs off — engines are never
-        # driven concurrently. 503 → client falls back to the control-plane
-        # queue (reference direct_server.py:79-85).
-        if not self.worker.try_begin_job():
-            self.stats["rejected"] += 1
-            return None, None, web.json_response(
-                {"detail": f"worker {self.worker.state.value}"}, status=503
-            )
+        serving = getattr(engine, "serving", None)
+        begin_shared = getattr(self.worker, "try_begin_serving", None)
+        is_pd = isinstance(params, dict) and params.get("pd_stage")
+        if serving is not None and getattr(serving, "active", False) \
+                and begin_shared is not None and not is_pd:
+            # batcher-backed engine: shared claim — concurrent direct
+            # requests land in the SAME continuous batch and share decode
+            # rounds (PD stages keep the exclusive claim: they manage
+            # engine slots out-of-band)
+            if not begin_shared():
+                self.stats["rejected"] += 1
+                return None, None, None, web.json_response(
+                    {"detail": f"worker {self.worker.state.value}"},
+                    status=503,
+                )
+            end = self.worker.end_serving
+        else:
+            # atomically claim the worker (IDLE→BUSY): a second direct
+            # request, or the queue poll loop, sees BUSY and backs off.
+            # 503 → client falls back to the control-plane queue
+            # (reference direct_server.py:79-85).
+            if not self.worker.try_begin_job():
+                self.stats["rejected"] += 1
+                return None, None, None, web.json_response(
+                    {"detail": f"worker {self.worker.state.value}"},
+                    status=503,
+                )
+            end = self.worker.end_job
         self.stats["requests"] += 1
-        return engine, body, None
 
-    def _release(self, started: float) -> None:
-        note = getattr(self.worker, "note_job_done", None)
-        if note is not None:
-            note(started)
-        self.worker.end_job()
+        def release(started: float) -> None:
+            note = getattr(self.worker, "note_job_done", None)
+            if note is not None:
+                note(started)
+            end()
+
+        return engine, body, release, None
 
     async def _inference(self, request: web.Request) -> web.Response:
-        engine, body, err = await self._parse_and_admit(request)
+        engine, body, release, err = await self._parse_and_admit(request)
         if err is not None:
             return err
         started = time.time()
@@ -121,7 +148,7 @@ class DirectServer:
         except Exception as exc:  # noqa: BLE001 - surface as a job error
             return web.json_response({"detail": str(exc)}, status=500)
         finally:
-            self._release(started)
+            release(started)
         return web.json_response({"result": result})
 
     async def _inference_stream(self, request: web.Request
@@ -139,7 +166,7 @@ class DirectServer:
         none skipped."""
         import json
 
-        engine, body, err = await self._parse_and_admit(
+        engine, body, release, err = await self._parse_and_admit(
             request, require_stream=True
         )
         if err is not None:
@@ -168,7 +195,7 @@ class DirectServer:
                     except Exception:  # noqa: BLE001 — plane unreachable
                         adopt_failed = True
                 if adoption is None:
-                    self._release(started)
+                    release(started)
                     if adopt_failed:
                         # transient: the control plane was unreachable,
                         # NOT proof that no checkpoint exists — a 503
@@ -191,7 +218,7 @@ class DirectServer:
                 ctx["text_offset"] = int(resume.get("text_offset") or 0)
             params["_failover_ctx"] = ctx
         elif resume is not None:
-            self._release(started)
+            release(started)
             return web.json_response(
                 {"detail": "engine does not support stream resume"},
                 status=409,
@@ -227,7 +254,7 @@ class DirectServer:
             # WAITS for it — the engine is quiet before the claim releases,
             # so the next request can never drive the engine concurrently
             await agen.aclose()
-            self._release(started)
+            release(started)
         with contextlib.suppress(ConnectionResetError):
             await resp.write_eof()
         return resp

@@ -17,13 +17,21 @@ boxes — no network fetch, mirroring the reference's offline-test strategy).
 from __future__ import annotations
 
 import asyncio
+import queue as _queue_mod
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
 import httpx
 
+from ...runtime.batcher import (
+    BatcherConfig,
+    BatcherServing,
+    RequestMigrated,
+    synthesize_checkpoint,
+)
 from ...runtime.engine import EngineConfig, PreemptedSequence, TPUEngine
+from ...utils.config import ServingConfig
 from ...utils.data_structures import InferenceRequest, SamplingParams
 from .base import (
     EngineLoadError,
@@ -32,6 +40,30 @@ from .base import (
     JobMigrated,
     LLMBaseEngine,
 )
+
+# Worker-YAML / remote-config serving knobs (``engines.llm.serving.*``) —
+# THE SLO configuration surface measured by the round-5 frontier. The
+# single source of truth for keys AND defaults is the pydantic-validated
+# YAML surface, ``utils.config.ServingConfig``; this dict is derived from
+# it so plain-dict engine construction (benchmarks, tests) can never
+# drift from YAML-configured workers.
+SERVING_DEFAULTS: Dict[str, Any] = ServingConfig().model_dump()
+
+# remote-config ``serving`` keys that may retune a LIVE batcher (pushed via
+# WorkerRemoteConfig; the compile-affecting admission knobs are excluded)
+SERVING_REMOTE_KEYS: Dict[str, str] = {
+    "target_step_ms": "target_step_latency_ms",
+    "max_horizon": "max_multi_step",
+    "min_horizon": "min_multi_step",
+    "multi_step": "multi_step",
+    "adaptive": "adaptive",
+    "max_wait_ms": "max_wait_ms",
+    "queue_limit": "queue_limit",
+    "default_timeout_s": "default_timeout_s",
+    "max_preemptions": "max_preemptions",
+    "spec_max_batch": "spec_max_batch",
+    "spec_max_active": "spec_max_active",
+}
 
 
 class ByteTokenizer:
@@ -73,6 +105,85 @@ def _load_hf_tokenizer(tokenizer_id: str):
         return AutoTokenizer.from_pretrained(tokenizer_id)
     except Exception as exc:  # noqa: BLE001 — offline box, bad id, ...
         raise EngineLoadError(f"cannot load tokenizer {tokenizer_id!r}: {exc}")
+
+
+class _StreamSplicer:
+    """Per-snapshot token→chunk derivation shared by BOTH stream drivers
+    (batcher-backed ``_stream_serving`` and legacy ``_stream_direct``).
+
+    This is the one block the exactly-once streaming contract requires to
+    stay byte-identical across serving modes: resume-splice re-derivation,
+    whole-sequence re-decode (multi-byte chars and cross-chunk stop
+    strings stay correct), the stop scan, holdback, and delta/new-ids
+    emission. The chaos suites assert the two drivers emit identical
+    event streams — one implementation, not two copies.
+
+    ``advance(gen, finished)`` consumes a monotonic generated-token
+    prefix and returns ``(chunk | None, stop_cut)``; the caller stamps
+    and yields the chunk (offset = ``sent_tokens``) and handles the
+    driver-specific abort when ``stop_cut`` is True."""
+
+    def __init__(self, tokenizer, cfg, holdback: int,
+                 resume_from: int, resume_text: int) -> None:
+        self.tokenizer = tokenizer
+        self.cfg = cfg
+        self.holdback = holdback
+        self.resume_text = resume_text
+        self.sent_tokens = 0
+        self.sent_text = ""
+        # splice point of a resumed stream: the client already consumed
+        # tokens [0, resume_from) — regenerate silently up to it, then
+        # re-derive the exact text the ORIGINAL stream had delivered at
+        # that offset (same holdback formula, same deterministic tokens)
+        self.splice: Optional[int] = resume_from if resume_from > 0 else None
+        self.finish_override: Optional[str] = None
+
+    def advance(self, gen: List[int], finished: bool):
+        if self.splice is not None and (len(gen) >= self.splice or finished):
+            self.sent_tokens = min(self.splice, len(gen))
+            raw = self.tokenizer.decode(gen[: self.sent_tokens])
+            self.sent_text = raw
+            if self.holdback:
+                self.sent_text = self.sent_text[
+                    : max(len(self.sent_text) - self.holdback, 0)
+                ]
+            if self.resume_text > len(self.sent_text):
+                # a holdback flush reached the client before the drop:
+                # its characters are consumed even though the token
+                # offset didn't advance
+                self.sent_text = raw[: self.resume_text]
+            self.splice = None
+        if self.splice is not None or \
+                (len(gen) <= self.sent_tokens and not finished):
+            return None, False
+        # decode the WHOLE sequence: multi-byte characters and
+        # cross-chunk stop strings stay correct
+        full = self.tokenizer.decode(gen)
+        stop_idx = -1
+        for st in self.cfg.stop:
+            idx = full.find(st)
+            if idx >= 0 and (stop_idx < 0 or idx < stop_idx):
+                stop_idx = idx
+        if stop_idx >= 0:
+            target = full[:stop_idx]
+            self.finish_override = "stop"
+        elif finished:
+            target = full
+        else:
+            target = full[: max(len(full) - self.holdback,
+                                len(self.sent_text))]
+        delta = target[len(self.sent_text):]
+        # token ids past a stop cut are not emitted
+        new_ids = [] if stop_idx >= 0 else list(gen[self.sent_tokens:])
+        self.sent_text = target
+        self.sent_tokens = len(gen)
+        # emit on new token ids even when the text delta is empty (id
+        # outside the tokenizer's decodable range, or held back):
+        # exactly-once delivery means every sampled id reaches the client
+        # in some chunk — silently skipped ids would desync the splice
+        chunk = ({"text_delta": delta, "token_ids": new_ids}
+                 if (delta or new_ids) else None)
+        return chunk, stop_idx >= 0
 
 
 class _CheckpointPusher:
@@ -128,6 +239,11 @@ class TPULLMEngine(LLMBaseEngine):
     def __init__(self, config: Optional[Dict[str, Any]] = None) -> None:
         super().__init__(config)
         self.engine: Optional[TPUEngine] = None
+        # batcher-backed serving front-end (the DEFAULT worker path since
+        # round 6): all queued jobs and direct/SSE requests share decode
+        # rounds through one ContinuousBatcher; ``serving.mode: direct``
+        # restores the legacy per-request engine driving
+        self.serving: Optional[BatcherServing] = None
         self._spec = None            # EAGLE-style decoder (engine=jax-speculative)
         self.tokenizer = self.config.get("tokenizer")
         # PD disaggregation: kv_cache_key → engine slot holding an adopted
@@ -174,6 +290,7 @@ class TPULLMEngine(LLMBaseEngine):
             remote_store_from_url,
         )
 
+        sv = self._serving_config()
         eng_cfg = EngineConfig(
             max_batch_size=int(self.config.get("max_batch_size", 8)),
             max_seq_len=int(self.config.get("max_seq_len", 2048)),
@@ -187,6 +304,9 @@ class TPULLMEngine(LLMBaseEngine):
                 self.config.get("kv_remote_url"),
                 ttl_s=float(self.config.get("kv_remote_ttl_s", 3600.0)),
             ),
+            # SLO admission shaping (compile-affecting: load-time only)
+            admission_subwave=int(sv["subwave"]),
+            admission_interleave_steps=int(sv["interleave"]),
         )
         # engine-INTEGRATED speculative decoding (EngineConfig.speculative):
         # every decode round runs fused draft→verify→accept steps committing
@@ -294,9 +414,79 @@ class TPULLMEngine(LLMBaseEngine):
                 raise EngineLoadError(
                     f"speculative engine config invalid: {exc}"
                 ) from exc
+        if str(sv["mode"]) == "batcher":
+            try:
+                self.serving = BatcherServing(
+                    self.engine, self._batcher_config(sv), spec=self._spec
+                )
+            except (ValueError, RuntimeError) as exc:
+                raise EngineLoadError(
+                    f"batcher serving config invalid: {exc}"
+                ) from exc
         self.loaded = True
 
+    def _serving_config(self) -> Dict[str, Any]:
+        """Merged serving knobs: defaults < ``config['serving']`` (worker
+        YAML ``engines.llm.serving.*``) < ``extra['serving']``."""
+        out = dict(SERVING_DEFAULTS)
+        for src in (self.config.get("serving"),
+                    (self.config.get("extra") or {}).get("serving")):
+            if isinstance(src, dict):
+                out.update({k: v for k, v in src.items() if v is not None})
+        return out
+
+    @staticmethod
+    def _batcher_config(sv: Dict[str, Any]) -> BatcherConfig:
+        return BatcherConfig(
+            max_wait_ms=float(sv["max_wait_ms"]),
+            multi_step=int(sv["multi_step"]),
+            min_multi_step=int(sv["min_horizon"]),
+            max_multi_step=int(sv["max_horizon"]),
+            adaptive=bool(sv["adaptive"]),
+            target_step_latency_ms=float(sv["target_step_ms"]),
+            queue_limit=int(sv["queue_limit"]),
+            default_timeout_s=float(sv["default_timeout_s"]),
+            max_preemptions=int(sv["max_preemptions"]),
+            spec_max_batch=int(sv["spec_max_batch"]),
+            spec_max_active=int(sv["spec_max_active"]),
+        )
+
+    def apply_serving_config(self, updates: Optional[Dict[str, Any]]) -> None:
+        """Server-pushed SLO retune (remote config ``serving`` section):
+        applied to the LIVE batcher between rounds. Compile-affecting
+        admission knobs (``subwave``/``interleave``) and ``mode`` are
+        load-time only and ignored here."""
+        if self.serving is None or not updates:
+            return
+        kw = {
+            SERVING_REMOTE_KEYS[k]: v
+            for k, v in updates.items()
+            if k in SERVING_REMOTE_KEYS and v is not None
+        }
+        if kw:
+            self.serving.reconfigure(**kw)
+
+    def serving_stats(self) -> Optional[Dict[str, Any]]:
+        """Live batcher stats (occupancy, queue depth, chunked admissions,
+        preemption counters, horizon) — ride the worker heartbeat into the
+        control plane's ``/metrics``. None when serving mode is direct."""
+        if self.serving is None or not self.serving.active:
+            return None
+        return self.serving.get_stats()
+
+    def _exclusive(self, fn: Any) -> Any:
+        """Serialize out-of-band engine work (PD stages, handoff adoption)
+        with the batcher's decode rounds: the callable runs on the
+        batcher's single engine-executor thread. Without a batcher the
+        caller's ``_engine_lock`` is the only serialization needed."""
+        if self.serving is not None and self.serving.active:
+            return self.serving.run_exclusive(fn)
+        return fn()
+
     def unload(self) -> None:
+        if self.serving is not None:
+            self.serving.stop(drain=False)
+            self.serving = None
         self.engine = None
         self._spec = None
         super().unload()
@@ -361,6 +551,17 @@ class TPULLMEngine(LLMBaseEngine):
         stage = params.get("pd_stage")
         if stage == "prefill":
             return self.pd_prefill(params)
+        if self.serving is not None and self.serving.active:
+            # batcher-backed serving: the batcher owns engine serialization
+            # (every engine call runs on its one executor thread), so
+            # concurrent jobs/streams need no engine lock — they share
+            # decode rounds instead of queueing on it
+            if stage == "decode":
+                return self.pd_decode(params)
+            ctx = params.get("_failover_ctx")
+            if isinstance(ctx, dict):
+                return self._job_inference(params, ctx)
+            return self._serving_inference(params)
         with self._engine_lock:
             if stage == "decode":
                 return self.pd_decode(params)
@@ -371,6 +572,29 @@ class TPULLMEngine(LLMBaseEngine):
                 # server-held checkpoint when the claim carries one
                 return self._job_inference(params, ctx)
             return super().inference(params)
+
+    def _serving_inference(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Blocking request through the batcher front-end (direct server /
+        plain jobs): same tokenization, stop handling, and result payload
+        as the legacy ``_generate`` path, but concurrent callers share
+        decode rounds via slot-level continuous batching."""
+        cfg = GenerationConfig.from_params(params)
+        req = self._build_request(
+            params.get("messages") or params.get("prompt") or "", cfg
+        )
+        if params.get("priority") is not None:
+            req.priority = int(params.get("priority") or 0)
+        if params.get("speculative") is False:
+            req.params["speculative"] = False
+        t0 = time.perf_counter()
+        resp = self.serving.submit(req)
+        if resp.error is not None:
+            raise RuntimeError(resp.error)
+        return self._finish_payload(
+            list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
+            resp.finish_reason or "stop", cfg, resp.ttft_ms,
+            time.perf_counter() - t0,
+        )
 
     def pd_prefill(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Prefill stage: run the prompt, sample the first token (TTFT),
@@ -426,7 +650,10 @@ class TPULLMEngine(LLMBaseEngine):
                     or self.config.get("pd_stream_piece_blocks", 4)
                 ),
             )
-        with self._engine_lock:
+        def _prefill_and_export():
+            # engine-touching block: under a batcher it runs on the engine
+            # executor thread (serialized with live decode rounds) — the
+            # admitted slot composes with concurrently-decoding slots
             slot = self.engine.submit_batch([req])[0]
             s = self.engine.slots[slot]
             first_token = int(self.engine._last_tokens[slot])
@@ -438,25 +665,32 @@ class TPULLMEngine(LLMBaseEngine):
             if local:
                 # KV affinity: this worker decodes too — retain the slot
                 self._pd_slots[key] = slot
-                return {
-                    "pd_stage": "prefill", "kv_cache_key": key,
-                    "first_token": first_token, "ttft_ms": ttft_ms,
-                    "migration_bytes": 0, "migration_ms": 0.0,
-                    "decode_slot": slot, "local": True,
-                    # prefill compute billed on this child; the decode child
-                    # bills the completion (usage shape = units_from_result)
-                    "usage": {"prompt_tokens": prompt_tokens,
-                              "completion_tokens": 0,
-                              "total_tokens": prompt_tokens},
-                }
+                return slot, first_token, ttft_ms, prompt_tokens, None
             try:
                 handoff = export_slot_kv(self.engine, slot)
-                raw = serialize_handoff(handoff)
+                return slot, first_token, ttft_ms, prompt_tokens, \
+                    serialize_handoff(handoff)
             finally:
                 # donor side is done with the sequence once the bytes are
                 # serialized: free the slot before the network hop so a
                 # failed or slow push cannot leak it
                 self.engine.finish_slot(slot)
+
+        with self._engine_lock:
+            slot, first_token, ttft_ms, prompt_tokens, raw = \
+                self._exclusive(_prefill_and_export)
+        if local:
+            return {
+                "pd_stage": "prefill", "kv_cache_key": key,
+                "first_token": first_token, "ttft_ms": ttft_ms,
+                "migration_bytes": 0, "migration_ms": 0.0,
+                "decode_slot": slot, "local": True,
+                # prefill compute billed on this child; the decode child
+                # bills the completion (usage shape = units_from_result)
+                "usage": {"prompt_tokens": prompt_tokens,
+                          "completion_tokens": 0,
+                          "total_tokens": prompt_tokens},
+            }
         # network push OUTSIDE the engine lock: a peer pushing to US can
         # adopt concurrently (kv_receiver takes the lock the engine work
         # above released) — no crossed-push deadlock
@@ -538,24 +772,29 @@ class TPULLMEngine(LLMBaseEngine):
                 pass
 
         gen = exp.messages()
+
+        def _drive_export() -> Optional[float]:
+            # the generator's cleanup (abort_chunked/finish_slot)
+            # mutates the engine, so it must run INSIDE the serialized
+            # region — close explicitly rather than leaving it to GC
+            # (it would race the kv_receiver thread / a decode round)
+            t_end = None
+            try:
+                for msg in gen:
+                    if state["exc"] is not None:
+                        # fail fast: the push is already doomed — stop
+                        # prefilling/gathering and release the engine
+                        raise state["exc"]
+                    if t_end is None and exp.first_token is not None:
+                        t_end = time.perf_counter()
+                    q.put(msg)
+            finally:
+                gen.close()
+            return t_end
+
         try:
             with self._engine_lock:
-                # the generator's cleanup (abort_chunked/finish_slot)
-                # mutates the engine, so it must run INSIDE the lock —
-                # close explicitly rather than leaving it to GC after the
-                # lock is released (it would race the kv_receiver thread)
-                try:
-                    for msg in gen:
-                        if state["exc"] is not None:
-                            # fail fast: the push is already doomed — stop
-                            # prefilling/gathering and release the engine
-                            raise state["exc"]
-                        if t_prefill_end is None and \
-                                exp.first_token is not None:
-                            t_prefill_end = time.perf_counter()
-                        q.put(msg)
-                finally:
-                    gen.close()
+                t_prefill_end = self._exclusive(_drive_export)
         except Exception:
             q.put(None)
             sender.join(timeout=60.0)
@@ -605,19 +844,33 @@ class TPULLMEngine(LLMBaseEngine):
                 f"no adopted KV for key {key!r} — handoff never arrived"
             )
         eng = self.engine
-        try:
-            while eng.slots[slot] is not None and \
-                    eng.slots[slot].finish_reason is None:
-                eng.decode_multi()
-                self._raise_if_pressured(eng, slot)
-        except Exception:
-            # the job fails, so the adopted slot MUST be released — a
-            # leaked slot would hold its KV blocks forever and compound
-            # the very pressure that aborted it
-            if eng.slots[slot] is not None:
-                eng.finish_slot(slot, cache=False)
-            raise
-        resp = eng.finish_slot(slot)
+        if self.serving is not None and self.serving.active:
+            # batcher-backed: the adopted slot joins the shared decode
+            # rounds instead of monopolizing the engine for its whole
+            # generation (it preempts/resumes like any other sequence)
+            seq = eng.slots[slot]
+            try:
+                resp = self.serving.adopt_slot(slot)
+            except Exception:
+                self._release_adopted_slot(eng, slot, seq)
+                raise
+            if resp.error is not None:
+                self._release_adopted_slot(eng, slot, seq)
+                raise RuntimeError(resp.error)
+        else:
+            try:
+                while eng.slots[slot] is not None and \
+                        eng.slots[slot].finish_reason is None:
+                    eng.decode_multi()
+                    self._raise_if_pressured(eng, slot)
+            except Exception:
+                # the job fails, so the adopted slot MUST be released — a
+                # leaked slot would hold its KV blocks forever and compound
+                # the very pressure that aborted it
+                if eng.slots[slot] is not None:
+                    eng.finish_slot(slot, cache=False)
+                raise
+            resp = eng.finish_slot(slot)
         text = self.tokenizer.decode(resp.token_ids) if self.tokenizer else ""
         return {
             "pd_stage": "decode", "kv_cache_key": key,
@@ -634,6 +887,29 @@ class TPULLMEngine(LLMBaseEngine):
                       "completion_tokens": resp.completion_tokens,
                       "total_tokens": resp.completion_tokens},
         }
+
+    def _release_adopted_slot(self, eng: TPUEngine, slot: int,
+                              seq: Any) -> None:
+        """Legacy-path parity: a failed PD decode MUST free its adopted
+        slot — leaked KV blocks would hold their pages for the life of the
+        engine. Identity-guarded: batcher error paths that already released
+        the slot (preemption cap, engine-error abort) may have recycled the
+        index for another sequence, which is not ours to finish."""
+        def _free() -> None:
+            if eng.slots[slot] is seq:
+                eng.finish_slot(slot, cache=False)
+
+        try:
+            if self.serving is not None and self.serving.active:
+                # serialize with live decode rounds
+                self.serving.run_exclusive(_free)
+                return
+        except Exception:  # noqa: BLE001 — loop stopping: free directly
+            pass
+        try:
+            _free()
+        except Exception:  # noqa: BLE001 — release is best-effort
+            pass
 
     @staticmethod
     def _raise_if_pressured(eng: TPUEngine, slot: int) -> None:
@@ -669,7 +945,10 @@ class TPULLMEngine(LLMBaseEngine):
             if self._handoff_rx is None or \
                     self._handoff_rx.engine is not self.engine:
                 self._handoff_rx = HandoffReceiver(self.engine)
-            result = self._handoff_rx.handle(raw)
+            # adoption mutates the engine (block allocation + slot bind):
+            # under a batcher it runs on the engine executor thread,
+            # serialized with live decode rounds
+            result = self._exclusive(lambda: self._handoff_rx.handle(raw))
             if result.get("slot") is not None:
                 self._pd_slots[result["kv_cache_key"]] = result["slot"]
         return result
@@ -789,6 +1068,8 @@ class TPULLMEngine(LLMBaseEngine):
         eng = self.engine
         if eng is None or not self.loaded:
             raise EngineLoadError("engine not loaded")
+        if self.serving is not None and self.serving.active:
+            return self._job_inference_serving(params, cfg, key, epoch, ckpt)
         if not isinstance(ckpt, dict) and self._spec is not None \
                 and cfg.temperature <= 0.0:
             # standalone tree-speculative decoder (engine=jax-speculative):
@@ -839,6 +1120,67 @@ class TPULLMEngine(LLMBaseEngine):
         finally:
             self._unregister_live(key)
         resp = eng.finish_slot(slot)
+        return self._finish_payload(
+            list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
+            resp.finish_reason or "stop", cfg, resp.ttft_ms,
+            time.perf_counter() - t0,
+        )
+
+    def _job_inference_serving(self, params: Dict[str, Any],
+                               cfg: GenerationConfig, key: str, epoch: int,
+                               ckpt: Any) -> Dict[str, Any]:
+        """Queued-job driver through the batcher front-end: resumes from
+        the claim's server-held checkpoint, shares decode rounds with every
+        other in-flight request, registers for heartbeat checkpointing, and
+        converts a drain interrupt (``interrupt_live``) into
+        :class:`JobMigrated` — the batcher freezes the sequence at the next
+        step boundary and hands back the portable checkpoint."""
+        t0 = time.perf_counter()
+        pre: Optional[PreemptedSequence] = None
+        if isinstance(ckpt, dict):
+            pre = PreemptedSequence.from_wire(ckpt)
+            remaining = (pre.request.sampling.max_new_tokens
+                         - len(pre.generated))
+            if remaining <= 0:
+                # the checkpoint already holds the whole generation: the
+                # previous worker died between its last decode and its
+                # complete_job — deliver without touching the engine
+                return self._finish_payload(
+                    list(pre.generated), pre.prompt_len,
+                    pre.cached_tokens, "length", cfg, None,
+                    time.perf_counter() - t0,
+                )
+            req = pre.request
+        else:
+            req = self._build_request(
+                params.get("messages") or params.get("prompt") or "", cfg
+            )
+            if params.get("priority") is not None:
+                req.priority = int(params.get("priority") or 0)
+        # parity with the legacy driver: a FRESH spec-eligible greedy job
+        # keeps the standalone tree decoder's multi-x speedup by waiving
+        # failover hooks (the wave is neither interruptible nor
+        # checkpointable — a drain finishes it, a crash replays it)
+        spec_fast = (
+            pre is None and self._spec is not None
+            and cfg.temperature <= 0.0
+            and params.get("speculative") is not False
+        )
+        interrupt = None if spec_fast else self._interrupt
+        if not spec_fast:
+            self._register_live(key, "job", epoch, req.request_id)
+        try:
+            resp = self.serving.submit(
+                req, resume_from=pre, interrupt=interrupt
+            )
+        except RequestMigrated as mig:
+            raise JobMigrated(mig.pre.to_wire(),
+                              tokens=len(mig.pre.generated)) from None
+        finally:
+            if not spec_fast:
+                self._unregister_live(key)
+        if resp.error is not None:
+            raise RuntimeError(resp.error)
         return self._finish_payload(
             list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
             resp.finish_reason or "stop", cfg, resp.ttft_ms,
@@ -937,7 +1279,211 @@ class TPULLMEngine(LLMBaseEngine):
 
     def stream(self, params: Dict[str, Any],
                cancel: Optional[Any] = None):
-        """Sync generator of chunks:
+        """Sync generator of SSE chunks — dispatches to the batcher-backed
+        serving stream (default: the sequence SHARES decode rounds with
+        every other in-flight request) or the legacy per-step engine driver
+        (``serving.mode: direct``). Both emit the same chunk contract:
+        ``{"text_delta", "token_ids", "offset"}...`` then a final
+        ``{"done": True, "finish_reason", "usage", "offset"}``."""
+        if self.serving is not None and self.serving.active:
+            return self._stream_serving(params, cancel=cancel)
+        return self._stream_direct(params, cancel=cancel)
+
+    def _stream_checkpoint_tail(self, pre: PreemptedSequence,
+                                cfg: GenerationConfig, stamp: Any,
+                                holdback: int, resume_from: int,
+                                resume_text: int):
+        """Serve the un-consumed tail of a COMPLETE checkpoint (the donor
+        died between its last decode and the final SSE flush) straight from
+        it, through the SAME stop-string/holdback machinery the live loop
+        uses — the client must receive exactly the text an undropped run
+        would have (incl. the held-back chars and the stop-truncated
+        finish)."""
+        gen = list(pre.generated)
+        m = min(resume_from, len(gen))
+        full = self.tokenizer.decode(gen)
+        stop_idx = -1
+        for st_ in cfg.stop:
+            idx = full.find(st_)
+            if idx >= 0 and (stop_idx < 0 or idx < stop_idx):
+                stop_idx = idx
+        finish = "length"
+        target = full
+        if stop_idx >= 0:
+            target = full[:stop_idx]
+            finish = "stop"
+        raw_prev = self.tokenizer.decode(gen[:m])
+        prev = raw_prev
+        if holdback:
+            prev = prev[:max(len(prev) - holdback, 0)]
+        if resume_text > len(prev):
+            # the client already received part of the held-back tail (a
+            # flush crossed before the drop) — never re-deliver those
+            # characters
+            prev = target[:resume_text]
+        delta = target[len(prev):] if len(prev) < len(target) else ""
+        tail = [] if stop_idx >= 0 else gen[m:]
+        if delta or tail:
+            yield stamp({"text_delta": delta, "token_ids": tail}, len(gen))
+        yield stamp({
+            "done": True, "finish_reason": finish,
+            "usage": {
+                "prompt_tokens": pre.prompt_len,
+                "completion_tokens": len(gen),
+                "total_tokens": pre.prompt_len + len(gen),
+                "cached_tokens": pre.cached_tokens,
+            },
+        }, len(gen))
+
+    def _stream_serving(self, params: Dict[str, Any],
+                        cancel: Optional[Any] = None):
+        """Batcher-backed token streaming: the request is submitted to the
+        serving front-end with a per-round observer; deltas are derived
+        from the observer's monotonic token snapshots with the exact
+        stop-string/holdback/splice machinery of the legacy per-step
+        driver, so exactly-once token offsets and checkpoint/resume hold
+        while the sequence shares decode rounds with other slots."""
+        cfg = GenerationConfig.from_params(params)
+        ctx = params.get("_failover_ctx")
+        ctx = ctx if isinstance(ctx, dict) else {}
+        key = str(ctx.get("key") or params.get("stream_id") or "") or None
+        epoch = int(ctx.get("epoch") or 0)
+        ckpt = ctx.get("checkpoint")
+        resume_from = int(ctx.get("offset") or 0)
+        resume_text = int(ctx.get("text_offset") or 0)
+
+        def stamp(chunk: Dict[str, Any], offset: int) -> Dict[str, Any]:
+            if key is not None:
+                chunk["stream_id"] = key
+                chunk["offset"] = offset
+            return chunk
+
+        holdback = max((len(s) for s in cfg.stop), default=0)
+        holdback = max(holdback - 1, 0)
+        pre: Optional[PreemptedSequence] = None
+        if isinstance(ckpt, dict):
+            pre = PreemptedSequence.from_wire(ckpt)
+            remaining = (pre.request.sampling.max_new_tokens
+                         - len(pre.generated))
+            if remaining <= 0:
+                yield from self._stream_checkpoint_tail(
+                    pre, cfg, stamp, holdback, resume_from, resume_text
+                )
+                return
+            req = pre.request
+        else:
+            req = self._build_request(
+                params.get("messages") or params.get("prompt") or "", cfg
+            )
+            if params.get("priority") is not None:
+                req.priority = int(params.get("priority") or 0)
+        # spec waves buffer whole generations — a stream needs per-round
+        # progress, so it always decodes through the paged slots
+        req.params["speculative"] = False
+        request_id = req.request_id
+        live_info = {"kind": "stream", "epoch": epoch,
+                     "request_id": request_id}
+
+        snaps: "_queue_mod.Queue" = _queue_mod.Queue()
+        _DONE = object()
+        stop_evt = threading.Event()   # batcher-side abort (cancel / stop cut)
+        fut = self.serving.submit_async(
+            req, observer=lambda toks: snaps.put(toks),
+            cancel=stop_evt, resume_from=pre,
+        )
+        fut.add_done_callback(lambda f: snaps.put(_DONE))
+
+        last_ckpt = len(pre.generated) if pre is not None else 0
+        if key is not None:
+            self._register_live(key, "stream", epoch, request_id)
+            # admission checkpoint (synchronous): even a worker killed
+            # before its first heartbeat leaves a resumable record. The
+            # request may still be QUEUED, so the record is synthesized
+            # engine-free (the resumed prefix when resuming, zero tokens
+            # when fresh) — cadence pushes below carry live slot state.
+            self._push_checkpoint({
+                "kind": "stream", "key": key, "epoch": epoch,
+                "state": (pre or synthesize_checkpoint(req)).to_wire(),
+            }, sync=True)
+        sp = _StreamSplicer(self.tokenizer, cfg, holdback,
+                            resume_from, resume_text)
+        stopping = False               # stop string matched: drain silently
+        final = None
+        try:
+            while True:
+                try:
+                    item = snaps.get(timeout=0.05) if cancel is not None \
+                        else snaps.get()
+                except _queue_mod.Empty:
+                    # cancel-poll timeout: honor a client disconnect even
+                    # while the request is still queued (no snapshots yet)
+                    if cancel is not None and cancel.is_set():
+                        stop_evt.set()
+                    continue
+                if item is _DONE:
+                    final = fut.result()   # raises on engine/submit failure
+                    if final.error is not None:
+                        raise RuntimeError(final.error)
+                    gen = list(final.token_ids)
+                    finished = True
+                else:
+                    gen = list(item)
+                    finished = False
+                # a round snapshot may carry SEVERAL new tokens — process
+                # them one at a time so the SSE cadence (one event per
+                # token, each stamped with its offset) is identical to the
+                # legacy per-step driver: clients, resume splices, and the
+                # chaos kill points all count events
+                ks = list(range(sp.sent_tokens + 1, len(gen) + 1))
+                if not ks and finished:
+                    ks = [len(gen)]       # flush held-back chars at EOS
+                for k in ks:
+                    if stopping:
+                        break
+                    fin_k = finished and k == len(gen)
+                    chunk, stop_cut = sp.advance(gen[:k], fin_k)
+                    if chunk is not None:
+                        yield stamp(chunk, sp.sent_tokens)
+                    if stop_cut and not fin_k:
+                        # release the slot; the final (abort) response
+                        # still carries the full usage accounting
+                        stopping = True
+                        stop_evt.set()
+                if finished:
+                    break
+                if cancel is not None and cancel.is_set():
+                    stop_evt.set()
+                if key is not None and self._ckpt_interval > 0 \
+                        and len(gen) - last_ckpt >= self._ckpt_interval:
+                    self._push_checkpoint(
+                        self._snapshot_live(key, live_info)
+                    )
+                    last_ckpt = len(gen)
+        finally:
+            stop_evt.set()     # no-op when already resolved; aborts a run
+            #                    abandoned by a closed generator
+            if key is not None:
+                self._unregister_live(key)
+        finish = sp.finish_override or final.finish_reason
+        yield stamp({
+            "done": True,
+            "finish_reason": finish,
+            "usage": {
+                "prompt_tokens": final.prompt_tokens,
+                "completion_tokens": final.completion_tokens,
+                "total_tokens": final.prompt_tokens
+                + final.completion_tokens,
+                "cached_tokens": final.cached_tokens,
+            },
+        }, sp.sent_tokens)
+        # NOTE: as in the legacy driver, the server-held checkpoint is NOT
+        # retired on completion — the worker cannot know the final SSE
+        # bytes reached the client; the control plane ages streams out.
+
+    def _stream_direct(self, params: Dict[str, Any],
+                       cancel: Optional[Any] = None):
+        """Legacy per-step engine driver (``serving.mode: direct``).
+        Sync generator of chunks:
         ``{"text_delta", "token_ids", "offset"}...`` then a final
         ``{"done": True, "finish_reason", "usage", "offset"}``. Drives the
         engine per-step so tokens flush as they are sampled.
@@ -988,48 +1534,10 @@ class TPULLMEngine(LLMBaseEngine):
             if remaining <= 0:
                 # the checkpoint already holds the full generation (the
                 # donor died between its last decode and the final SSE
-                # flush): serve the un-consumed tail straight from it,
-                # through the SAME stop-string/holdback machinery the live
-                # loop uses — the client must receive exactly the text an
-                # undropped run would have (incl. the held-back chars and
-                # the stop-truncated finish)
-                gen = list(pre.generated)
-                m = min(resume_from, len(gen))
-                full = self.tokenizer.decode(gen)
-                stop_idx = -1
-                for st_ in cfg.stop:
-                    idx = full.find(st_)
-                    if idx >= 0 and (stop_idx < 0 or idx < stop_idx):
-                        stop_idx = idx
-                finish = "length"
-                target = full
-                if stop_idx >= 0:
-                    target = full[:stop_idx]
-                    finish = "stop"
-                raw_prev = self.tokenizer.decode(gen[:m])
-                prev = raw_prev
-                if holdback:
-                    prev = prev[:max(len(prev) - holdback, 0)]
-                if resume_text > len(prev):
-                    # the client already received part of the held-back
-                    # tail (a flush crossed before the drop) — never
-                    # re-deliver those characters
-                    prev = target[:resume_text]
-                delta = target[len(prev):] if len(prev) < len(target) else ""
-                tail = [] if stop_idx >= 0 else gen[m:]
-                if delta or tail:
-                    yield stamp(
-                        {"text_delta": delta, "token_ids": tail}, len(gen)
-                    )
-                yield stamp({
-                    "done": True, "finish_reason": finish,
-                    "usage": {
-                        "prompt_tokens": pre.prompt_len,
-                        "completion_tokens": len(gen),
-                        "total_tokens": pre.prompt_len + len(gen),
-                        "cached_tokens": pre.cached_tokens,
-                    },
-                }, len(gen))
+                # flush): serve the un-consumed tail straight from it
+                yield from self._stream_checkpoint_tail(
+                    pre, cfg, stamp, holdback, resume_from, resume_text
+                )
                 return
             slot = eng.resume(pre)
             request_id = pre.request.request_id
@@ -1049,68 +1557,19 @@ class TPULLMEngine(LLMBaseEngine):
             # replacement regenerates from the prompt and splices)
             self._push_checkpoint(self._snapshot_live(key, live_info),
                                   sync=True)
-        sent_tokens = 0
-        sent_text = ""
-        # splice point of a resumed stream: the client already consumed
-        # tokens [0, resume_from) — regenerate silently up to it, then
-        # re-derive the exact text the ORIGINAL stream had delivered at
-        # that offset (same holdback formula, same deterministic tokens)
-        splice: Optional[int] = resume_from if resume_from > 0 else None
-        finish_override: Optional[str] = None
+        sp = _StreamSplicer(self.tokenizer, cfg, holdback,
+                            resume_from, resume_text)
         try:
             while True:
                 s = eng.slots[slot]
                 gen = list(s.generated)
                 finished = s.finish_reason is not None
-                if splice is not None and (len(gen) >= splice or finished):
-                    sent_tokens = min(splice, len(gen))
-                    raw = self.tokenizer.decode(gen[:sent_tokens])
-                    sent_text = raw
-                    if holdback:
-                        sent_text = sent_text[
-                            : max(len(sent_text) - holdback, 0)
-                        ]
-                    if resume_text > len(sent_text):
-                        # a holdback flush reached the client before the
-                        # drop: its characters are consumed even though
-                        # the token offset didn't advance
-                        sent_text = raw[:resume_text]
-                    splice = None
-                if splice is None and (len(gen) > sent_tokens or finished):
-                    # decode the WHOLE sequence: multi-byte characters and
-                    # cross-chunk stop strings stay correct
-                    full = self.tokenizer.decode(gen)
-                    stop_idx = -1
-                    for st in cfg.stop:
-                        idx = full.find(st)
-                        if idx >= 0 and (stop_idx < 0 or idx < stop_idx):
-                            stop_idx = idx
-                    if stop_idx >= 0:
-                        target = full[:stop_idx]
-                        finish_override = "stop"
-                    elif finished:
-                        target = full
-                    else:
-                        target = full[: max(len(full) - holdback,
-                                            len(sent_text))]
-                    delta = target[len(sent_text):]
-                    new_ids = [] if stop_idx >= 0 else gen[sent_tokens:]
-                    sent_text = target
-                    sent_tokens = len(gen)
-                    # emit on new token ids even when the text delta is
-                    # empty (id outside the tokenizer's decodable range,
-                    # or held back): exactly-once delivery means every
-                    # sampled id reaches the client in some chunk —
-                    # silently skipped ids would desync the offset splice
-                    if delta or new_ids:
-                        yield stamp({
-                            "text_delta": delta,
-                            # token ids past a stop cut are not emitted
-                            "token_ids": new_ids,
-                        }, sent_tokens)
-                    if stop_idx >= 0:
-                        s.finish_reason = "stop"
-                        finished = True
+                chunk, stop_cut = sp.advance(gen, finished)
+                if chunk is not None:
+                    yield stamp(chunk, sp.sent_tokens)
+                if stop_cut:
+                    s.finish_reason = "stop"
+                    finished = True
                 if finished:
                     break
                 if cancel is not None and cancel.is_set():
@@ -1136,7 +1595,7 @@ class TPULLMEngine(LLMBaseEngine):
             if key is not None:
                 self._unregister_live(key)
             resp = self.engine.finish_slot(slot)
-        finish = finish_override or resp.finish_reason
+        finish = sp.finish_override or resp.finish_reason
         yield stamp({
             "done": True,
             "finish_reason": finish,
@@ -1146,7 +1605,7 @@ class TPULLMEngine(LLMBaseEngine):
                 "total_tokens": resp.prompt_tokens + resp.completion_tokens,
                 "cached_tokens": resp.cached_tokens,
             },
-        }, sent_tokens)
+        }, sp.sent_tokens)
         # NOTE: the server-held checkpoint is deliberately NOT retired on
         # completion. The worker cannot know the final SSE bytes reached
         # the client (TCP buffers): a client that lost the tail must still
@@ -1238,4 +1697,7 @@ class TPULLMEngine(LLMBaseEngine):
         h = super().health()
         if self.engine is not None:
             h["engine_stats"] = self.engine.get_stats()
+        stats = self.serving_stats()
+        if stats is not None:
+            h["serving_stats"] = stats
         return h

@@ -208,6 +208,20 @@ class Worker:
         # guards IDLE→BUSY transitions so the poll loop and the direct server
         # can never run engine.inference concurrently on the same engines
         self._state_lock = threading.Lock()
+        # shared serving claims (batcher-backed engines): count of direct
+        # requests / queued jobs currently sharing decode rounds — they
+        # coexist with each other up to load_control.max_concurrent_jobs
+        # but never with an exclusive claim (PD stages, legacy engines)
+        self._serving_jobs = 0
+        self._job_pool: Optional[Any] = None
+        self._job_pool_width = 16
+        self._pool_inflight = 0
+        self._active_jobs: set = set()
+        # exclusive-needing work (PD stage / non-llm) was fetched while
+        # other shared claims were live: back off from polling until this
+        # deadline (or until the shared load drains) instead of
+        # claim/fetch/releasing the same head-of-queue job every interval
+        self._exclusive_defer_until = 0.0
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._hour_window: List[float] = []       # job-start times, rolling hour
         self._last_job_done_at = 0.0
@@ -273,6 +287,20 @@ class Worker:
             self.config.load_control.job_type_weights = dict(
                 lc["job_type_weights"]
             )
+        serving = remote.get("serving")
+        if isinstance(serving, dict) and serving:
+            # server-pushed SLO retune: batcher knobs (target_step_ms,
+            # max_horizon, queue limits) apply to LIVE batchers between
+            # rounds — no engine reload, no dropped requests
+            for eng in self.engines.values():
+                apply = getattr(eng, "apply_serving_config", None)
+                if apply is None:
+                    continue
+                try:
+                    apply(dict(serving))
+                except Exception:  # noqa: BLE001 — a bad push must not kill the worker
+                    log.warning("serving config push rejected",
+                                exc_info=True)
 
     # -- engines (reference main.py:234-261) ---------------------------------
 
@@ -346,6 +374,38 @@ class Worker:
                 )
         return out or None
 
+    def _batcher_stats(self) -> Optional[Dict[str, Any]]:
+        """Live batcher serving stats of every batcher-backed engine
+        (occupancy, queue depth, chunked admissions, preemption counters)
+        — nested under heartbeat ``engine_stats["batcher"]`` so the control
+        plane's ``/metrics`` shows how hot each worker's batch runs. None
+        when no engine serves through a batcher (payload stays lean)."""
+        out: Dict[str, Any] = {}
+        for eng in self.engines.values():
+            fn = getattr(eng, "serving_stats", None)
+            if fn is None:
+                continue
+            try:
+                s = fn()
+            except Exception:  # noqa: BLE001 — never break the heartbeat
+                continue
+            if not s:
+                continue
+            for k in ("submitted", "completed", "rejected", "admitted",
+                      "decode_rounds", "chunked_admissions",
+                      "batched_waves", "preemptions", "resumes",
+                      "preempted_too_often", "cancelled", "migrated"):
+                out[k] = out.get(k, 0) + int(s.get(k, 0) or 0)
+            for k in ("queue_depth", "active_slots"):
+                out[k] = out.get(k, 0) + int(s.get(k, 0) or 0)
+            if s.get("avg_occupancy") is not None:
+                out["avg_occupancy"] = round(
+                    float(s.get("avg_occupancy") or 0.0), 3
+                )
+            if s.get("horizon") is not None:
+                out["horizon"] = float(s["horizon"])
+        return out or None
+
     def _collect_checkpoints(self) -> List[Dict[str, Any]]:
         """Portable checkpoints of every in-flight generation across loaded
         engines — piggybacked on heartbeats so a sequence survives this
@@ -373,15 +433,26 @@ class Worker:
             pressure_stats = self._pressure_engine_stats()
             if pressure_stats:
                 engine_stats.update(pressure_stats)
+            batcher_stats = self._batcher_stats()
+            if batcher_stats:
+                engine_stats["batcher"] = batcher_stats
             if engine_stats:
                 extra["engine_stats"] = engine_stats
             checkpoints = self._collect_checkpoints()
             if checkpoints:
                 extra["checkpoints"] = checkpoints
+            with self._state_lock:
+                active = list(self._active_jobs)
+                current_job_id = self.current_job_id
+            if len(active) > 1:
+                # concurrent shared jobs: current_job_id can only carry one
+                # claim — report the full set so the server's stale-claim
+                # guard covers every in-flight job, not an arbitrary one
+                extra["active_job_ids"] = active
             resp = self.api.heartbeat(
                 status=self.state.value,
                 config_version=self.config.config_version,
-                current_job_id=self.current_job_id,
+                current_job_id=current_job_id,
                 loaded_models=[
                     getattr(e, "model_name", None) or str(type(e).__name__)
                     for e in self.engines.values()
@@ -402,6 +473,14 @@ class Worker:
                     "server reports job %s is no longer ours (requeued "
                     "after a heartbeat gap); finishing as zombie work",
                     self.current_job_id,
+                )
+                self.stats["stale_claims"] = \
+                    self.stats.get("stale_claims", 0) + 1
+            for jid in resp.get("stale_jobs") or []:
+                log.warning(
+                    "server reports job %s is no longer ours (requeued "
+                    "after a heartbeat gap); finishing as zombie work",
+                    jid,
                 )
                 self.stats["stale_claims"] = \
                     self.stats.get("stale_claims", 0) + 1
@@ -450,9 +529,15 @@ class Worker:
                 now - self._last_job_done_at < lc.cooldown_seconds:
             return False
         if lc.max_jobs_per_hour > 0:
-            self._hour_window = [t for t in self._hour_window if now - t < 3600]
-            if len(self._hour_window) >= lc.max_jobs_per_hour:
-                return False
+            with self._state_lock:
+                # prune + read under the lock: pool/direct threads append
+                # concurrently via note_job_done, and a rebind would drop
+                # their append on the floor
+                self._hour_window = [
+                    t for t in self._hour_window if now - t < 3600
+                ]
+                if len(self._hour_window) >= lc.max_jobs_per_hour:
+                    return False
         if lc.acceptance_rate < 1.0 and self._rng.random() > lc.acceptance_rate:
             return False
         return True
@@ -475,15 +560,20 @@ class Worker:
         return True
 
     def note_job_done(self, started: float) -> None:
-        """Load-control bookkeeping shared by queued AND direct jobs."""
-        self._last_job_done_at = time.time()
-        self._hour_window.append(started)
+        """Load-control bookkeeping shared by queued AND direct jobs —
+        called from pool/direct threads concurrently."""
+        with self._state_lock:
+            self._last_job_done_at = time.time()
+            self._hour_window.append(started)
 
     # -- busy-state acquisition (poll loop vs direct server) -----------------
 
     def try_begin_job(self) -> bool:
-        """Atomically claim the worker for one inference (IDLE→BUSY).
-        Returns False when busy/draining — the caller must back off."""
+        """Atomically claim the worker for one EXCLUSIVE inference
+        (IDLE→BUSY). Returns False when busy/draining — the caller must
+        back off. Exclusive claims never coexist with shared serving
+        claims (``try_begin_serving``), so engines without a batcher are
+        never driven concurrently."""
         with self._state_lock:
             if self.state != WorkerState.IDLE:
                 return False
@@ -495,11 +585,54 @@ class Worker:
             if self.state == WorkerState.BUSY:
                 self.state = WorkerState.IDLE
 
+    def serving_capacity(self) -> int:
+        """Concurrent shared-claim ceiling — server-pushed
+        ``load_control.max_concurrent_jobs`` (the batcher's queue_limit
+        guards depth beyond it)."""
+        return max(1, int(self.config.load_control.max_concurrent_jobs or 1))
+
+    def try_begin_serving(self) -> bool:
+        """Claim ONE shared serving slot (batcher-backed engines): the
+        request joins the engine's continuous batch instead of waiting for
+        an idle worker. Shared claims coexist with each other up to
+        :meth:`serving_capacity` but never with an exclusive claim, and a
+        draining worker accepts nothing."""
+        with self._state_lock:
+            if self.state == WorkerState.IDLE:
+                self.state = WorkerState.BUSY
+                self._serving_jobs = 1
+                return True
+            if self.state == WorkerState.BUSY and self._serving_jobs > 0 \
+                    and self._serving_jobs < self.serving_capacity():
+                self._serving_jobs += 1
+                return True
+            return False
+
+    def end_serving(self) -> None:
+        with self._state_lock:
+            if self._serving_jobs > 0:
+                self._serving_jobs -= 1
+                if self._serving_jobs == 0 and \
+                        self.state == WorkerState.BUSY:
+                    self.state = WorkerState.IDLE
+
+    def _upgrade_serving_to_exclusive(self) -> bool:
+        """Convert OUR shared claim into the exclusive claim — only
+        possible when no other shared work is in flight (the poll loop
+        uses this when a fetched job turns out to need exclusivity)."""
+        with self._state_lock:
+            if self.state == WorkerState.BUSY and self._serving_jobs == 1:
+                self._serving_jobs = 0
+                return True
+            return False
+
     # -- job processing (reference main.py:335-402) --------------------------
 
-    def process_job(self, job: Dict[str, Any]) -> None:
-        """Run one claimed job. Caller must hold the BUSY state
-        (``try_begin_job``).
+    def process_job(self, job: Dict[str, Any],
+                    release: Optional[Callable[[], None]] = None) -> None:
+        """Run one claimed job. Caller must hold a claim: the exclusive
+        BUSY state (``try_begin_job``, the default release) or a shared
+        serving slot (``try_begin_serving`` — pass ``release=end_serving``).
 
         Failover-capable engines get a ``_failover_ctx`` (job id, assignment
         epoch, and the claim's server-held checkpoint, if any): they resume
@@ -511,7 +644,9 @@ class Worker:
         job_id = job["id"]
         task_type = job.get("type", "llm")
         engine = self.engines.get(task_type)
-        self.current_job_id = job_id
+        with self._state_lock:
+            self._active_jobs.add(job_id)
+            self.current_job_id = job_id
         started = time.time()
         epoch = int(job.get("assignment_epoch") or 0)
         fenced = "assignment_epoch" in job
@@ -525,6 +660,11 @@ class Worker:
             # reserved key: never accept a client-submitted failover
             # context from job params — the worker mints it below
             params.pop("_failover_ctx", None)
+            if job.get("priority") is not None:
+                # control-plane priority reaches the batcher's admission
+                # heap (higher-priority jobs admit first, and KV-pressure
+                # victims are picked lowest-priority-first)
+                params.setdefault("priority", job.get("priority"))
             if getattr(engine, "supports_failover", False):
                 params["_failover_ctx"] = {
                     "key": job_id, "kind": "job", "epoch": epoch,
@@ -534,7 +674,8 @@ class Worker:
             self.api.complete_job(
                 job_id, success=True, result=result, **complete_kw
             )
-            self.stats["jobs_completed"] += 1
+            with self._state_lock:
+                self.stats["jobs_completed"] += 1
         except JobMigrated as mig:
             log.info("job %s migrated on drain (%d tokens checkpointed)",
                      job_id, mig.tokens)
@@ -546,7 +687,8 @@ class Worker:
                 # the server's offline requeue still reruns the job from
                 # the last heartbeat-piggybacked checkpoint
                 log.error("could not push drain checkpoint for %s", job_id)
-            self.stats["jobs_migrated"] += 1
+            with self._state_lock:
+                self.stats["jobs_migrated"] += 1
         except Exception as exc:  # noqa: BLE001 - job failure is a result
             log.exception("job %s failed", job_id)
             try:
@@ -555,25 +697,92 @@ class Worker:
                 )
             except APIError:
                 log.error("could not report failure for job %s", job_id)
-            self.stats["jobs_failed"] += 1
+            with self._state_lock:
+                self.stats["jobs_failed"] += 1
         finally:
             self.note_job_done(started)
-            self.current_job_id = None
-            self.end_job()
+            with self._state_lock:
+                self._active_jobs.discard(job_id)
+                self.current_job_id = next(iter(self._active_jobs), None)
+            (release or self.end_job)()
+
+    def _llm_serving_active(self) -> bool:
+        """True when the llm engine serves through a live batcher — queued
+        llm jobs then run under SHARED claims and concurrent jobs share
+        decode rounds."""
+        serving = getattr(self.engines.get("llm"), "serving", None)
+        return serving is not None and getattr(serving, "active", False)
+
+    def _job_runs_shared(self, job: Dict[str, Any]) -> bool:
+        """A fetched job may join the continuous batch iff it targets the
+        batcher-backed llm engine and is not a PD stage (PD stages manage
+        engine slots out-of-band and keep the exclusive claim)."""
+        if job.get("type", "llm") != "llm":
+            return False
+        if (job.get("params") or {}).get("pd_stage"):
+            return False
+        return self._llm_serving_active()
+
+    def _dispatch_shared(self, job: Dict[str, Any]) -> None:
+        """Run a shared-claim job on the job pool: the poll loop returns to
+        polling immediately, so several queued jobs decode concurrently in
+        one batch (the claim was taken by the caller; process_job's finally
+        releases it)."""
+        if self._job_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._job_pool = ThreadPoolExecutor(
+                max_workers=self._job_pool_width, thread_name_prefix="job"
+            )
+        with self._state_lock:
+            self._pool_inflight += 1
+
+        def run() -> None:
+            try:
+                self.process_job(job, release=self.end_serving)
+            except Exception:  # noqa: BLE001 — pool thread must not die silently
+                log.exception("shared job %s crashed", job.get("id"))
+            finally:
+                with self._state_lock:
+                    self._pool_inflight -= 1
+
+        self._job_pool.submit(run)
 
     def _poll_once(self) -> bool:
-        """One poll iteration; returns True if a job was processed."""
+        """One poll iteration; returns True if a job was processed (or
+        dispatched to the shared pool)."""
         if not self.gates_open():  # gated: don't even claim work
             return False
-        if not self.try_begin_job():  # direct inference in flight / draining
-            return False
+        shared_mode = self._llm_serving_active()
+        if shared_mode:
+            if self._pool_inflight >= self._job_pool_width:
+                # every pool thread is busy: a further claim would start
+                # its server-side clock while sitting unstarted in the
+                # pool queue (stale-sweep requeue → duplicate compute)
+                return False
+            with self._state_lock:
+                other_shared = self._serving_jobs > 0
+            if other_shared and time.time() < self._exclusive_defer_until:
+                # head-of-queue work needs exclusivity we cannot grant
+                # while shared claims run: stop the claim/release churn
+                # and give other workers (or our own drain) a window
+                return False
+            # claim a shared slot up front: queued jobs keep flowing while
+            # direct streams (other shared claims) are in flight
+            if not self.try_begin_serving():
+                return False
+            release = self.end_serving
+        else:
+            if not self.try_begin_job():  # direct inference in flight / draining
+                return False
+            release = self.end_job
         job = None
         try:
             job = self.api.fetch_next_job()
         except APIError as exc:
             log.warning("poll failed: %s", exc)
         if job is None:
-            self.end_job()
+            release()
             return False
         if not self.should_accept_job(job):
             self.stats["jobs_rejected"] += 1
@@ -584,11 +793,32 @@ class Worker:
                 self.api.release_job(job["id"])
             except APIError:
                 pass
-            self.end_job()
+            release()
             return False
         self._released_once.discard(job["id"])
-        self.process_job(job)
-        return True
+        if not shared_mode:
+            self.process_job(job)
+            return True
+        if self._job_runs_shared(job):
+            self._dispatch_shared(job)   # claim travels with the job
+            return True
+        # the fetched job needs exclusivity (PD stage / non-llm engine):
+        # upgrade — only possible when we hold the sole shared claim
+        if self._upgrade_serving_to_exclusive():
+            self.process_job(job)
+            return True
+        # other shared work in flight: hand the job back for another
+        # worker rather than stalling the batch, and back off from
+        # polling briefly (it would come straight back each interval)
+        try:
+            self.api.release_job(job["id"])
+        except APIError:
+            pass
+        self.end_serving()
+        self._exclusive_defer_until = time.time() + max(
+            5.0, 5 * self.config.poll_interval_s
+        )
+        return False
 
     def _main_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -700,6 +930,11 @@ class Worker:
         )
 
     def _finalize_shutdown(self) -> None:
+        if self._job_pool is not None:
+            # shared queued jobs: interrupt_live (request_shutdown) already
+            # told them to freeze at the next step boundary — wait for the
+            # JobMigrated checkpoints to land before reporting offline
+            self._job_pool.shutdown(wait=True)
         try:
             requeued = self.api.offline()
             if requeued:

@@ -19,6 +19,8 @@ import pathlib
 HEAVY = [
     "tests/test_chaos_scenarios.py",     # 50-seed replays per scenario
     "tests/test_worker_failover_chaos.py",  # 25-seed kill-mid-stream e2e
+    "tests/test_worker_serving_batcher.py",  # batcher-backed serving e2e
+    #   (real engines + direct servers + stream_cut chaos replays)
     "tests/test_parallel_pipeline.py",
     "tests/test_parallel_ring_attention.py",
     "tests/test_engine_spec_integrated.py",  # spec scan graphs x 2 engines

@@ -39,6 +39,26 @@ def test_single_worker_bench(capsys):
     assert res["ttft_ms"]["p50"] is not None
 
 
+def test_worker_serving_bench(capsys):
+    """The deployed-path harness: open-loop arrivals over HTTP against a
+    real DirectServer + batcher-backed TPULLMEngine, with the bench-only
+    comparison leg."""
+    from benchmarks.worker_serving import main
+
+    res = _run(main, [
+        "worker_serving", "--model", "llama3-tiny", "--requests", "4",
+        "--concurrency", "2", "--prompt-len", "16", "--max-tokens", "8",
+        "--shared-prefix", "8", "--arrival-rate", "20", "--compare",
+    ], capsys)
+    assert res["benchmark"] == "worker_serving"
+    assert res["mode"] == "open_loop"
+    assert res["deployed"]["ok"] == 4
+    assert res["deployed"]["ttft_ms"]["p50"] is not None
+    assert res["bench_only"]["ok"] == 4
+    assert res["tokens_per_s_ratio"] > 0
+    assert res["batcher"]["decode_rounds"] > 0
+
+
 def test_speculative_bench(capsys):
     from benchmarks.speculative import main
 

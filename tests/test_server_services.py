@@ -164,8 +164,9 @@ def test_remote_config_versioning_and_merge():
         )
         assert new.version == v0 + 1
         assert new.load_control.acceptance_rate == 0.5
-        # untouched fields survive the merge
-        assert new.load_control.max_concurrent_jobs == 1
+        # untouched fields survive the merge (fleet default: the shared
+        # serving-claim cap for batcher-backed workers)
+        assert new.load_control.max_concurrent_jobs == 4
         assert await svc.config_changed_since("w1", v0)
         assert not await svc.config_changed_since("w1", new.version)
         s.close()
