@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Micro-benchmark: Pallas paged-attention decode kernel vs the XLA gather
+"""Micro-benchmark: Pallas paged-attention kernels vs the XLA gather
 fallback, on-device (chained fori_loop + value readback — through a TPU
 tunnel, ``block_until_ready`` alone does not wait for device completion and
 single-call timing only measures the control RTT).
@@ -26,12 +26,18 @@ table: 2050-2237 µs vs 482-1065 µs at batch 32) while winning 3.4x at
 batch 8 mixed — the per-row page re-staging overhead scales with rows.
 SERVING never sees this: the model's decode path calls the fused kernel
 through ``ops/attention.py resolve_impl`` (label emitted as
-``serving_impl`` below). For the micro-bench itself, ``micro_read_impl``
-encodes the measured crossover: both variants still run (this IS the
-comparison harness), but the emitted ``micro_auto_impl`` labels the
-winner for the batch size and the derived ``live_kv_gb_s`` is computed
-from the auto-selected variant's timing, so no regime's headline number
-comes from the losing kernel.
+``serving_impl`` below). Since round 6 the crossover itself lives in
+``resolve_impl`` (``fused=False`` + ``rows``; ``MICRO_READ_XLA_MIN_BATCH``
+is an env OVERRIDE only) — this bench calls it instead of duplicating the
+threshold, and the emitted ``micro_auto_impl`` labels the auto-selected
+variant whose timing feeds the derived ``live_kv_gb_s``.
+
+``--impl ragged`` measures the round-6 ragged kernel — one invocation over
+a flattened row batch whose rows carry their own query spans. With
+``--q-span 1`` it is an apples-to-apples decode read against the other two
+variants; wider spans measure the mixed prefill+decode round shape serving
+actually dispatches (``--mixed-spans`` builds the decode-heavy + one-chunk
+row mix of a ragged admission round).
 """
 
 from __future__ import annotations
@@ -45,21 +51,6 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-# Measured crossover of the NON-FUSED micro-bench read kernel vs XLA
-# gather (r5 wedge table, v5e): pallas wins at batch <= 8 (3.4x mixed),
-# loses 2-4x by batch 32. Between the measured points the conservative
-# boundary is 16 rows — at/above it the micro-bench's auto dispatch
-# reads through XLA gather.
-MICRO_READ_XLA_MIN_BATCH = 16
-
-
-def micro_read_impl(batch: int) -> str:
-    """The variant the micro-bench's ``auto`` dispatch measures for a
-    given batch size — the batch-axis crossover the serving-path
-    ``resolve_impl`` (context-length axis) deliberately does not model,
-    because serving reads through the FUSED in-model kernel instead."""
-    return "xla" if batch >= MICRO_READ_XLA_MIN_BATCH else "pallas"
-
 
 def main() -> None:
     ap = argparse.ArgumentParser()
@@ -72,6 +63,19 @@ def main() -> None:
     ap.add_argument("--mixed", action="store_true",
                     help="heterogeneous lens 50..ctx (continuous batching)")
     ap.add_argument("--iters", type=int, default=500)
+    ap.add_argument("--impl", choices=["all", "xla", "pallas", "ragged"],
+                    default="all",
+                    help="which read variant(s) to measure: the XLA "
+                         "gather, the non-fused decode kernel, the ragged "
+                         "prefill+decode kernel, or all of them")
+    ap.add_argument("--q-span", type=int, default=1,
+                    help="query span per row for the ragged variant "
+                         "(1 = decode-shaped rows; >1 = uniform "
+                         "verify/chunk rows)")
+    ap.add_argument("--mixed-spans", action="store_true",
+                    help="ragged variant only: decode rows (span 1) plus "
+                         "ONE prefill chunk row of --q-span queries — the "
+                         "row mix of a ragged admission round")
     ap.add_argument("--skip-xla", action="store_true",
                     help="skip the XLA-gather variant (its full-table "
                          "gather materializes [B, M*Bk, Hkv, D] context — "
@@ -85,19 +89,34 @@ def main() -> None:
                     help="also measure the int8-KV (per-token scales) "
                          "kernel path")
     args = ap.parse_args()
-    if args.skip_xla and args.skip_pallas:
-        ap.error("--skip-xla and --skip-pallas leave nothing to measure")
-    if args.int8 and args.skip_pallas:
-        ap.error("--int8 measures the Pallas int8 kernel; it cannot be "
-                 "combined with --skip-pallas")
+    want = {
+        "all": {"xla", "pallas", "ragged"},
+        "xla": {"xla"}, "pallas": {"pallas"}, "ragged": {"ragged"},
+    }[args.impl]
+    if args.skip_xla:
+        want.discard("xla")
+    if args.skip_pallas:
+        want -= {"pallas", "ragged"}
+    if not want:
+        ap.error("the --impl/--skip flags leave nothing to measure")
+    if args.int8 and "pallas" not in want:
+        ap.error("--int8 measures the Pallas int8 kernel; it needs the "
+                 "pallas variant selected")
+    if args.mixed_spans and "ragged" not in want:
+        ap.error("--mixed-spans shapes the ragged variant's rows; it needs "
+                 "the ragged variant selected")
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from distributed_gpu_inference_tpu.ops.attention import paged_attention_xla
+    from distributed_gpu_inference_tpu.ops.attention import (
+        paged_attention_xla,
+        resolve_impl,
+    )
     from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
         paged_attention_pallas,
+        ragged_paged_attention,
     )
 
     b, hkv, nh, d = args.batch, args.kv_heads, args.q_heads, args.head_dim
@@ -133,20 +152,43 @@ def main() -> None:
     pos = (lens - 1)[:, None]
     q = jax.random.normal(ks[3], (b, 1, nh, d), jnp.bfloat16)
 
-    auto_impl = micro_read_impl(b)
+    # ragged-variant operands: [B, S] spans. Default S = --q-span for every
+    # row; --mixed-spans keeps decode rows at span 1 and gives ONE row the
+    # full chunk (the ragged admission round's shape).
+    s_rag = max(1, args.q_span)
+    pos_rag = np.full((b, s_rag), -1, np.int32)
+    lens_np = np.asarray(lens)
+    for i in range(b):
+        span = 1 if (args.mixed_spans and i != 0) else s_rag
+        span = min(span, int(lens_np[i]))
+        pos_rag[i, :span] = np.arange(
+            lens_np[i] - span, lens_np[i], dtype=np.int32
+        )
+    q_rag = jax.random.normal(ks[2], (b, s_rag, nh, d), jnp.bfloat16)
+    pos_rag = jnp.asarray(pos_rag)
+
+    # the crossover label comes from the ONE dispatch authority (bare read:
+    # fused=False + row count), not a bench-local constant
+    auto_impl = resolve_impl(
+        q_seq=1, head_dim=d, padded_ctx=m * block, rows=b, fused=False,
+    )
     variants = []
-    if not args.skip_pallas:
+    if "xla" in want:
+        variants.append(
+            ("xla", partial(paged_attention_xla, block_size=block),
+             (kp, vp), (), (q, pos)),
+        )
+    if "pallas" in want:
         variants.append(
             ("pallas", partial(paged_attention_pallas, block_size=block),
-             (kp, vp), ())
+             (kp, vp), (), (q, pos))
         )
-    if not args.skip_xla:
-        variants.insert(
-            0,
-            ("xla", partial(paged_attention_xla, block_size=block),
-             (kp, vp), ()),
+    if "ragged" in want:
+        variants.append(
+            ("ragged", partial(ragged_paged_attention, block_size=block),
+             (kp, vp), (), (q_rag, pos_rag))
         )
-    if args.int8 and not args.skip_pallas:
+    if args.int8:
         # int8 pools + per-(page, token) scales (VERDICT r3 #4): HBM sees
         # ~62% of the bf16 bytes per token; the kernel dequantizes in-page
         from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
@@ -158,11 +200,11 @@ def main() -> None:
         variants.append((
             "pallas_int8",
             partial(paged_attention_pallas, block_size=block),
-            (kp8, vp8), (kss, vss),
+            (kp8, vp8), (kss, vss), (q, pos),
         ))
 
     results = {}
-    for name, att, pools, scales in variants:
+    for name, att, pools, scales, qp in variants:
         # pools/scales/tables/lens are jit ARGUMENTS, never closure
         # captures: a captured device array is baked into the computation
         # as a literal, and through the remote-compile tunnel those
@@ -182,24 +224,25 @@ def main() -> None:
                           kpool, vpool, tables, pos, lens, **kw)
             return jax.lax.fori_loop(0, iters, body, q)
 
-        dt = (timed(many, q, pools[0], pools[1], tables, pos, lens, scales)
-              - rtt) / iters
+        dt = (timed(many, qp[0], pools[0], pools[1], tables, qp[1], lens,
+                    scales) - rtt) / iters
         results[name] = dt * 1e6
 
     live = int(np.sum(np.asarray(lens)))
     out = {"metric": "paged_attention_decode_us"}
-    if "pallas" in results:
-        out["pallas_us"] = round(results["pallas"], 1)
-    if "xla" in results:
-        out["xla_us"] = round(results["xla"], 1)
-        if "pallas" in results:
-            out["speedup"] = round(results["xla"] / results["pallas"], 2)
-    # crossover labelling (VERDICT r5 weak #6): which variant this
-    # micro-bench's batch-size dispatch selects, what it measured, and —
+    for name in ("pallas", "xla", "ragged"):
+        if name in results:
+            out[f"{name}_us"] = round(results[name], 1)
+    if "xla" in results and "pallas" in results:
+        out["speedup"] = round(results["xla"] / results["pallas"], 2)
+    if "xla" in results and "ragged" in results:
+        out["ragged_speedup_vs_xla"] = round(
+            results["xla"] / results["ragged"], 2
+        )
+    # crossover labelling (VERDICT r5 weak #6): which variant the bare-read
+    # dispatch selects for this row count, what it measured, and —
     # separately — the FUSED path serving actually reads through (the
     # model-level resolve_impl on the same static shape facts)
-    from distributed_gpu_inference_tpu.ops.attention import resolve_impl
-
     out["micro_auto_impl"] = auto_impl
     if auto_impl in results:
         out["micro_auto_us"] = round(results[auto_impl], 1)
@@ -207,12 +250,17 @@ def main() -> None:
         q_seq=1, head_dim=d, padded_ctx=m * block,
     )
     out["serving_uses_fused_kernel"] = out["serving_impl"] != "xla"
-    best = results.get(auto_impl, results.get("pallas", results.get("xla")))
+    best = results.get(auto_impl,
+                       results.get("pallas",
+                                   results.get("ragged",
+                                               results.get("xla"))))
     out.update(**{
         "live_kv_gb_s": round(
             (live * hkv * d * 2 * 2) / (best / 1e6) / 1e9, 1
         ),
         "config": {"batch": b, "ctx": ctx, "mixed": args.mixed,
+                   "impl": args.impl, "q_span": s_rag,
+                   "mixed_spans": args.mixed_spans,
                    "block_size": block, "backend": jax.default_backend()},
     })
     if "pallas_int8" in results:

@@ -18,13 +18,27 @@ the frontier was published from — and emits the deployed/bench ratios, so
 hardware: p50 TTFT within 15% and decode tok/s within 10% are the
 acceptance bars.
 
-Usage (SLO row / throughput row):
+``--compare-legacy`` (round 6) A/Bs the RAGGED serving path (the default:
+admission appends prefill-chunk rows to the shared decode round — one
+dispatch, no admission stall to shape) against the knob-tuned legacy
+wave/chunk-interleaved path on the SAME live engine: the primary leg runs
+ragged with the subwave/interleave/max-horizon knobs at their (ignored)
+defaults, then ``serving.ragged=false`` is pushed to the live batcher
+(the remote-config A/B path a fleet would use) and the identical workload
+replays through the legacy machinery shaped by the CLI knob values.
+Emits ragged/legacy TTFT p50/p95 and tok/s ratios — "the kernel beats
+the hand-tuning it deletes" is checkable on any hardware.
+
+Usage (SLO row / throughput row / ragged-vs-knob-tuned):
     python -m benchmarks.worker_serving --arrival-rate 1.5 --requests 64 \
         --prompt-len 512 --max-tokens 128 --concurrency 16 \
         --target-step-ms 400 --subwave 2 --interleave 2 --max-horizon 4 \
         --compare
     python -m benchmarks.worker_serving --requests 64 --concurrency 32 \
         --prompt-len 128 --max-tokens 64 --compare
+    python -m benchmarks.worker_serving --arrival-rate 2 --requests 64 \
+        --prompt-len 512 --max-tokens 128 --concurrency 16 \
+        --subwave 2 --interleave 1 --max-horizon 4 --compare-legacy
 """
 
 from __future__ import annotations
@@ -130,6 +144,21 @@ def _warm(llm: Any, prompt_len: int, levels: Tuple[int, ...],
                     eng.slots[slot].finish_reason is None:
                 eng.decode_multi(T)
             eng.finish_slot(slot, cache=False)
+        if getattr(eng, "supports_ragged", False):
+            # ragged rounds compile one graph per chunk bucket width:
+            # admit a prompt at every width an admission chunk row can
+            # bucket to and run it through ragged_round, so the ragged
+            # leg (the serving default) never bills a compile to TTFT
+            cap = min(max(int(eng.cfg.ragged_chunk), 1),
+                      eng.cfg.prefill_buckets[-1], prompt_len)
+            for width in sorted({min(b, cap)
+                                 for b in eng.cfg.prefill_buckets}):
+                adm = eng.submit_chunked_start(
+                    make_request(warm_prompt[:width], 2)
+                )
+                while not adm.done:
+                    eng.ragged_round([adm])
+                _drain()
 
     llm.serving.run_exclusive(_run)
     eng.manager.stats.prefix_queries = 0
@@ -271,6 +300,11 @@ def main() -> None:
                     help="also run the SAME workload through the "
                     "in-process batcher (the bench-only configuration) "
                     "and emit deployed/bench ratios")
+    ap.add_argument("--compare-legacy", action="store_true",
+                    help="A/B the ragged serving path (default, knobs "
+                    "ignored) against the knob-tuned legacy admission "
+                    "path on the same live engine (serving.ragged=false "
+                    "pushed between legs) and emit ragged/legacy ratios")
     add_platform_arg(ap)
     args = ap.parse_args()
 
@@ -339,8 +373,39 @@ def main() -> None:
                 k: stats.get(k)
                 for k in ("decode_rounds", "avg_occupancy", "horizon",
                           "chunked_admissions", "batched_waves",
-                          "queue_peak")
+                          "queue_peak", "ragged_mode", "ragged_rounds",
+                          "ragged_admissions")
             }
+            if args.compare_legacy:
+                # flip the LIVE batcher to the legacy wave/chunk-
+                # interleaved admission path (the remote-config A/B a
+                # fleet would push), replay the identical workload, and
+                # flip back. The CLI knob values shape the legacy leg;
+                # the ragged leg above ignored them by construction.
+                llm.engine.manager.clear_cached()
+                llm.apply_serving_config({"ragged": False})
+                legacy = _summarize(*asyncio.run(_drive_http(
+                    url, prompts, args.max_tokens, rate, args.concurrency,
+                    args.seed,
+                )))
+                # back to ragged for any following sweep rate (True ≡ the
+                # auto default on this engine; reconfigure ignores None)
+                llm.apply_serving_config({"ragged": True})
+                out["legacy_knob_tuned"] = legacy
+                ratios = {}
+                for pct in ("p50", "p95"):
+                    r_t = (deployed["ttft_ms"] or {}).get(pct)
+                    l_t = (legacy["ttft_ms"] or {}).get(pct)
+                    if r_t and l_t:
+                        ratios[f"ttft_{pct}_ragged_over_legacy"] = round(
+                            r_t / l_t, 3
+                        )
+                if legacy["decode_tokens_per_s"]:
+                    ratios["tokens_per_s_ragged_over_legacy"] = round(
+                        deployed["decode_tokens_per_s"]
+                        / legacy["decode_tokens_per_s"], 3
+                    )
+                out["ragged_vs_legacy"] = ratios
             if args.compare:
                 llm.engine.manager.clear_cached()
                 bench = _summarize(*asyncio.run(_drive_inproc(

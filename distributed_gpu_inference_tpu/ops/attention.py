@@ -45,11 +45,28 @@ def _use_pallas() -> bool:
 # 1400+. The threshold is on the STATIC padded table width, so dispatch is
 # trace-time and costs nothing.
 _PALLAS_MIN_PADDED_CTX = 512
-# small-q ceiling for the multi-query decode path (speculative verify:
-# q_len = K+1 per slot). Each query row becomes one decode-kernel row, so
-# pages re-stage once per query — past ~8 queries the re-staged HBM traffic
-# beats one gather and the prefill-shaped XLA path wins anyway.
-_PALLAS_MAX_MULTIQUERY = 8
+# Measured row-count crossover of the BARE (non-fused) decode read kernel
+# vs the XLA gather (r5 wedge table, v5e): the kernel wins 3.4x at batch 8
+# mixed lengths, loses 2-4x by batch 32 — per-row page staging scales with
+# rows while one gather amortizes. 16 is the conservative boundary between
+# the measured points. Serving's decode path never sees this (it reads
+# through the FUSED write+attention kernel, whose staging the write pass
+# already pays); only bare paged_attention() reads — micro-benches, adopted
+# pools — cross over. Since round 6 the crossover lives HERE (resolve_impl
+# applies it automatically from the static row count) instead of as a
+# duplicated constant in benchmarks/paged_attention_micro.py.
+_MICRO_READ_XLA_MIN_BATCH = 16
+
+
+def micro_read_xla_min_batch() -> int:
+    """The bare-read row-count crossover — the measured default, with the
+    ``MICRO_READ_XLA_MIN_BATCH`` env var kept as an OVERRIDE only (re-tuning
+    on new chip generations without a code change)."""
+    raw = os.environ.get("MICRO_READ_XLA_MIN_BATCH", "")
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return _MICRO_READ_XLA_MIN_BATCH
 
 
 def resolve_impl(
@@ -57,15 +74,28 @@ def resolve_impl(
     head_dim: int,
     padded_ctx: int,
     backend_is_tpu: Optional[bool] = None,
+    rows: Optional[int] = None,
+    fused: bool = True,
 ) -> str:
     """The implementation ``impl="auto"`` will select, from static shape
-    facts alone: q_seq (chunk length), head_dim, and the padded context
-    capacity ``block_tables.shape[1] * block_size``. Exposed so callers
-    (bench.py, engines) can ASSERT the Pallas kernel is in the measured
-    path instead of discovering a silent fallback after the fact
-    (VERDICT r1 weak #1). q_seq in 2..8 resolves to ``pallas_mq`` — the
-    small-q multi-query decode path serving speculative verify windows
-    (q_len = K+1 per slot rather than 1)."""
+    facts alone: q_seq (chunk length), head_dim, the padded context
+    capacity ``block_tables.shape[1] * block_size``, and the batch row
+    count. Exposed so callers (bench.py, engines) can ASSERT the Pallas
+    kernel is in the measured path instead of discovering a silent
+    fallback after the fact (VERDICT r1 weak #1).
+
+    q_seq > 1 resolves to ``ragged`` — the ragged paged-attention kernel
+    serving mixed prefill-chunk / spec-verify / decode rows in ONE
+    invocation (it replaced the q_len <= 8 ``pallas_mq`` path in round 6;
+    per-row bounds select each row's path inside the kernel, so there is
+    no small-q cap anymore).
+
+    ``fused``: the caller reads through the fused write+attention decode
+    kernel (the serving path) — row count never flips it. ``fused=False``
+    is the bare read (micro-benches, externally-written pools): there the
+    measured row-count crossover applies and ``rows`` at or above
+    :func:`micro_read_xla_min_batch` falls back to the one-gather XLA path.
+    """
     if backend_is_tpu is None:
         backend_is_tpu = _use_pallas()
     if (
@@ -74,9 +104,14 @@ def resolve_impl(
         and padded_ctx >= _PALLAS_MIN_PADDED_CTX
     ):
         if q_seq == 1:
+            if (
+                not fused
+                and rows is not None
+                and rows >= micro_read_xla_min_batch()
+            ):
+                return "xla"
             return "pallas"
-        if 1 < q_seq <= _PALLAS_MAX_MULTIQUERY:
-            return "pallas_mq"
+        return "ragged"
     return "xla"
 
 
@@ -95,7 +130,9 @@ def paged_attention(
 ) -> jax.Array:
     """Attention of a chunk of queries against paged context. → [B, S, Nh, D].
 
-    ``impl``: "auto" (pallas on TPU for decode, else xla), "xla", "pallas".
+    ``impl``: "auto" (pallas on TPU for decode, ragged for multi-token
+    spans, else xla), "xla", "pallas", "ragged" ("pallas_mq" accepted as a
+    legacy alias of "ragged").
     ``window``: query at position p sees context positions (p-window, p].
     ``k_scale``/``v_scale``: int8 pools' per-(page, token) scales — both
     impls dequantize context-sized (Pallas in VMEM, XLA at the gather).
@@ -107,11 +144,15 @@ def paged_attention(
         # production geometries (Llama-3 8B/70B, Qwen-7B, Mistral, Gemma)
         # have D ∈ {128, 256}; CI-scale minis fall back to XLA. Small padded
         # tables also stay on XLA (see resolve_impl / the measured
-        # crossover note above).
+        # crossover note above). This is the BARE read path (the fused
+        # write+attention kernel dispatches inside models/llama.py), so the
+        # row-count crossover applies.
         impl = resolve_impl(
             q_seq=q.shape[1],
             head_dim=q.shape[3],
             padded_ctx=block_tables.shape[1] * block_size,
+            rows=q.shape[0],
+            fused=False,
         )
     if impl == "pallas":
         from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
@@ -122,12 +163,15 @@ def paged_attention(
             q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
             window=window, k_scale=k_scale, v_scale=v_scale,
         )
-    if impl == "pallas_mq":
+    if impl in ("ragged", "pallas_mq"):
+        # "pallas_mq" is the pre-round-6 name of the small-q path, kept as
+        # an alias: the ragged kernel serves those shapes (and every other
+        # mixed-span batch) without the old q_len <= 8 cap
         from distributed_gpu_inference_tpu.ops.paged_attention_pallas import (
-            paged_attention_pallas_multiquery,
+            ragged_paged_attention,
         )
 
-        return paged_attention_pallas_multiquery(
+        return ragged_paged_attention(
             q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
             window=window, k_scale=k_scale, v_scale=v_scale,
         )

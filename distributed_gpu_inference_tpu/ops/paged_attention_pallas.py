@@ -55,6 +55,15 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Pallas-TPU API drift shims: older releases name the off-chip memory space
+# ANY (HBM arrived later) and the compiler-params dataclass TPUCompilerParams.
+# Semantics are identical for our usage (full-array HBM-resident operands the
+# kernels DMA page-wise), so alias rather than pin a jax version.
+_HBM = getattr(pltpu, "HBM", pltpu.ANY)
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+
 _NEG_INF = -1e30
 # VMEM budget for the four KV staging buffers (2 pools x 2 slots); the rest
 # of VMEM stays free for q/out blocks and compute temporaries.
@@ -523,8 +532,8 @@ def _call_decode_kernel(
         pl.BlockSpec(memory_space=pltpu.VMEM),   # new_v
         # pools must STAY in HBM (ANY lets the compiler pull the whole
         # pool into VMEM, where the padded lane dim breaks page slices)
-        pl.BlockSpec(memory_space=pltpu.HBM),
-        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=_HBM),
+        pl.BlockSpec(memory_space=_HBM),
     ]
     scratch = [
         pltpu.VMEM((2, gp, hkv, block_size, d), k_pool.dtype),
@@ -536,17 +545,17 @@ def _call_decode_kernel(
             lambda i, j, *_refs: (i, 0, 0, 0),
             memory_space=pltpu.VMEM,
         ),
-        pl.BlockSpec(memory_space=pltpu.HBM),
-        pl.BlockSpec(memory_space=pltpu.HBM),
+        pl.BlockSpec(memory_space=_HBM),
+        pl.BlockSpec(memory_space=_HBM),
     ]
     if quantized:
         in_specs += [
-            pl.BlockSpec(memory_space=pltpu.HBM),   # k_scale
-            pl.BlockSpec(memory_space=pltpu.HBM),   # v_scale
+            pl.BlockSpec(memory_space=_HBM),   # k_scale
+            pl.BlockSpec(memory_space=_HBM),   # v_scale
         ]
         out_specs += [
-            pl.BlockSpec(memory_space=pltpu.HBM),   # k_scale (aliased)
-            pl.BlockSpec(memory_space=pltpu.HBM),   # v_scale (aliased)
+            pl.BlockSpec(memory_space=_HBM),   # k_scale (aliased)
+            pl.BlockSpec(memory_space=_HBM),   # v_scale (aliased)
         ]
         scratch += [
             pltpu.VMEM((2, gp, block_size, d), jnp.bfloat16),    # ksbuf
@@ -622,7 +631,7 @@ def _call_decode_kernel(
         out_shape=out_shape,
         grid_spec=grid_spec,
         input_output_aliases=aliases,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -678,12 +687,393 @@ def quantize_kv_pool(pool: jax.Array) -> Tuple[jax.Array, jax.Array]:
     )
 
 
+# --------------------------------------------------------------------------
+# Ragged paged attention: one kernel invocation over a flattened row batch
+# where decode rows (q_len = 1), speculative verify rows (q_len = 2..K+1)
+# and prefill chunk rows (q_len up to the chunk width) coexist — the
+# serving-side unification that lets admission append rows to a decode
+# round instead of scheduling a competing prefill dispatch (Ragged Paged
+# Attention, PAPERS.md).
+# --------------------------------------------------------------------------
+
+# ceiling on (GQA queries per KV head) x (query tile) per grid cell: bounds
+# the f32 score tile [Hkv, qpk*T, group] and the accumulator scratch so a
+# wide prefill chunk never blows VMEM. Rows longer than the tile split into
+# independent q-tiles (softmax state is per query, so tiles never talk);
+# pages re-stage once per TILE, not once per query — the fix for the old
+# multi-query path's per-query re-staging that capped it at q_len <= 8.
+_RAGGED_QPK_TILE = 256
+
+
+def _ragged_q_tile(s: int, qpk: int) -> int:
+    t = max(1, min(s, _RAGGED_QPK_TILE // max(qpk, 1)))
+    return 1 << (t.bit_length() - 1)     # power of two so buckets divide
+
+
+def _ragged_kernel(
+    # scalar prefetch (SMEM; bidx/init persist across the sequential grid)
+    bt_ref,        # [R, M] int32 per-row block tables
+    lens_ref,      # [R] int32 effective kv length per row
+    qmax_ref,      # [R] int32 max valid query position (-1 = inactive row)
+    qmin_ref,      # [R] int32 min valid query position (0 when inactive)
+    bidx_ref,      # [1] int32 current double-buffer slot
+    init_ref,      # [1] int32 1 until the first live chunk issues its DMA
+    # blocked operands
+    q_ref,         # [1, Hkv, qpk*T, D] — this row's query tile, GQA-grouped
+    pos_ref,       # [1, T] int32 per-query positions (-1 = pad)
+    k_hbm,         # [N, Hkv, Bk, D] single-layer pool (ANY/HBM)
+    v_hbm,
+    *rest,         # [ks_hbm, vs_hbm,] out_ref, kbuf, vbuf, [ksbuf, vsbuf,]
+                   # sems, [ssems,] m_scr, l_scr, acc_scr
+    rows: int,
+    q_tile: int,
+    block_size: int,
+    pages_per_group: int,
+    max_pages: int,
+    window: Optional[int],
+    scale: float,
+    quantized: bool,
+):
+    if quantized:
+        (_ks_in, _vs_in, out_ref, kbuf, vbuf, ksbuf, vsbuf,
+         sems, ssems, m_scr, l_scr, acc_scr) = rest
+        ks_hbm, vs_hbm = _ks_in, _vs_in
+    else:
+        (out_ref, kbuf, vbuf, sems, m_scr, l_scr, acc_scr) = rest
+        ks_hbm = vs_hbm = ksbuf = vsbuf = ssems = None
+    r = pl.program_id(0)
+    i = pl.program_id(1)
+    gp = pages_per_group
+    gsz = gp * block_size
+    hkv = k_hbm.shape[1]
+    d = q_ref.shape[3]
+    qpk = q_ref.shape[2] // q_tile
+    max_groups = pl.num_programs(1)
+
+    def num_groups(s_):
+        s_ = jnp.clip(s_, 0, rows - 1)
+        # a padded/inactive q-tile (qmax < 0) has zero live groups and its
+        # grid cells skip in a few cycles — dead tiles of a short row in a
+        # wide ragged batch cost nothing but the grid step
+        needed = jnp.minimum(qmax_ref[s_] + 1, lens_ref[s_])
+        return jnp.minimum(pl.cdiv(needed, gsz), max_groups)
+
+    def start_group(s_):
+        if window is None:
+            return jnp.int32(0)
+        s_ = jnp.clip(s_, 0, rows - 1)
+        return jnp.maximum(qmin_ref[s_] - window + 1, 0) // gsz
+
+    ng_r = num_groups(r)
+    start_r = start_group(r)
+    live = (i >= start_r) & (i < ng_r)
+
+    def start_dma(s_, j, slot):
+        for p in range(gp):  # static unroll: G paired page DMAs
+            idx = jnp.minimum(j * gp + p, max_pages - 1)
+            page = bt_ref[jnp.clip(s_, 0, rows - 1), idx]
+            pltpu.make_async_copy(
+                k_hbm.at[page], kbuf.at[slot, p], sems.at[0, slot, p]
+            ).start()
+            pltpu.make_async_copy(
+                v_hbm.at[page], vbuf.at[slot, p], sems.at[1, slot, p]
+            ).start()
+            if quantized:
+                pltpu.make_async_copy(
+                    ks_hbm.at[page], ksbuf.at[slot, p], ssems.at[0, slot, p]
+                ).start()
+                pltpu.make_async_copy(
+                    vs_hbm.at[page], vsbuf.at[slot, p], ssems.at[1, slot, p]
+                ).start()
+
+    def wait_dma(s_, j, slot):
+        for p in range(gp):
+            idx = jnp.minimum(j * gp + p, max_pages - 1)
+            page = bt_ref[jnp.clip(s_, 0, rows - 1), idx]
+            pltpu.make_async_copy(
+                k_hbm.at[page], kbuf.at[slot, p], sems.at[0, slot, p]
+            ).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[page], vbuf.at[slot, p], sems.at[1, slot, p]
+            ).wait()
+            if quantized:
+                pltpu.make_async_copy(
+                    ks_hbm.at[page], ksbuf.at[slot, p], ssems.at[0, slot, p]
+                ).wait()
+                pltpu.make_async_copy(
+                    vs_hbm.at[page], vsbuf.at[slot, p], ssems.at[1, slot, p]
+                ).wait()
+
+    def next_chunk(s_, j):
+        """Grid-order successor of live chunk (s_, j) — same walk as the
+        decode kernel, over ragged rows instead of sequences."""
+
+        def advance_row():
+            def step(_, ss):
+                return jnp.where(
+                    (ss < rows) & (num_groups(ss) == 0), ss + 1, ss
+                )
+
+            ns = lax.fori_loop(0, rows, step, s_ + 1)
+            return ns, jnp.where(ns < rows, start_group(ns), 0)
+
+        return lax.cond(
+            j + 1 < num_groups(s_), lambda: (s_, j + 1), advance_row
+        )
+
+    # inactive row (fully padded q-tile): its output block still writes once
+    @pl.when((ng_r == 0) & (i == 0))
+    def _():
+        out_ref[0] = jnp.zeros((hkv, qpk * q_tile, d), out_ref.dtype)
+
+    @pl.when(live)
+    def _():
+        slot = bidx_ref[0]
+
+        @pl.when(init_ref[0] == 1)
+        def _():
+            start_dma(r, i, slot)
+
+        init_ref[0] = 0
+
+        nr, ni = next_chunk(r, i)
+
+        @pl.when(nr < rows)
+        def _():
+            start_dma(nr, ni, 1 - slot)
+
+        bidx_ref[0] = 1 - slot
+
+        wait_dma(r, i, slot)
+
+        @pl.when(i == start_r)
+        def _():
+            m_scr[...] = jnp.full((hkv, qpk * q_tile), _NEG_INF, jnp.float32)
+            l_scr[...] = jnp.zeros((hkv, qpk * q_tile), jnp.float32)
+            acc_scr[...] = jnp.zeros((hkv, qpk * q_tile, d), jnp.float32)
+
+        kv_len = lens_ref[r]
+        # the dot runs in the pool dtype (bf16 in, f32 accumulation) — the
+        # same MXU contract as the decode kernel; int8 pages dequantize in
+        # page layout during the upcast
+        cdt = jnp.bfloat16 if kbuf.dtype.itemsize == 1 else kbuf.dtype
+        qf = q_ref[0].astype(cdt)                         # [Hkv, qpk*T, D]
+        if quantized:
+            kq = kbuf[slot].astype(cdt) * ksbuf[slot][:, None, :, :]
+            vq = vbuf[slot].astype(cdt) * vsbuf[slot][:, None, :, :]
+            k = kq.transpose(1, 0, 2, 3).reshape(hkv, gsz, d)
+            v = vq.transpose(1, 0, 2, 3).reshape(hkv, gsz, d)
+        else:
+            k = kbuf[slot].transpose(1, 0, 2, 3).reshape(hkv, gsz, d).astype(cdt)
+            v = vbuf[slot].transpose(1, 0, 2, 3).reshape(hkv, gsz, d).astype(cdt)
+        scores = lax.dot_general(
+            qf, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # [Hkv, qpk*T, gsz]
+        # per-query causal/in-length mask: split the flattened (qpk, T) row
+        # axis (minor dim untouched — layout-free reshape), broadcast the
+        # tile's position vector along it. THE per-row-group path selection:
+        # a decode row (q_len = 1) and a prefill chunk row differ only in
+        # this mask and in how many groups the walk gave them.
+        scores4 = scores.reshape(hkv, qpk, q_tile, gsz)
+        col = i * gsz + lax.broadcasted_iota(
+            jnp.int32, (hkv, qpk, q_tile, gsz), 3
+        )
+        pos_b = pos_ref[0][None, None, :, None]     # [1, 1, T, 1]
+        valid = (col < kv_len) & (col <= pos_b)
+        if window is not None:
+            valid &= col > pos_b - window
+        scores4 = jnp.where(valid, scores4, _NEG_INF)
+
+        m_prev, l_prev = m_scr[...], l_scr[...]
+        m_new = jnp.maximum(
+            m_prev, jnp.max(scores4, axis=-1).reshape(hkv, qpk * q_tile)
+        )
+        alpha = jnp.exp(m_prev - m_new)
+        probs4 = jnp.exp(
+            scores4 - m_new.reshape(hkv, qpk, q_tile)[..., None]
+        )
+        probs4 = jnp.where(valid, probs4, 0.0)
+        l_new = l_prev * alpha + jnp.sum(probs4, axis=-1).reshape(
+            hkv, qpk * q_tile
+        )
+        probs = probs4.reshape(hkv, qpk * q_tile, gsz)
+        acc_new = acc_scr[...] * alpha[..., None] + lax.dot_general(
+            probs.astype(cdt), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                           # [Hkv, qpk*T, D]
+        m_scr[...], l_scr[...], acc_scr[...] = m_new, l_new, acc_new
+
+        @pl.when(i == ng_r - 1)
+        def _():
+            safe_l = jnp.where(l_new > 0, l_new, 1.0)[..., None]
+            out = jnp.where(safe_l > 0, acc_new / safe_l, 0.0)
+            # fully-masked queries (padding inside a live tile) → exact 0,
+            # the XLA-path contract
+            out = jnp.where(l_new[..., None] > 0, out, 0.0)
+            out_ref[0] = out.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_size", "window", "interpret"),
+)
+def ragged_paged_attention(
+    q: jax.Array,             # [B, S, Nh, D] — per-row spans padded to S
+    k_pool: jax.Array,        # [N, Hkv, Bk, D] (head-major pages, 1 layer)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [B, M] int32
+    positions: jax.Array,     # [B, S] int32 (-1 = pad)
+    kv_lens: jax.Array,       # [B] int32 effective context per row
+    block_size: int = 16,
+    window: Optional[int] = None,
+    interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,   # [N, Bk, D] bf16 lane-replicated
+    v_scale: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Ragged paged attention: ONE kernel invocation over a flattened token
+    batch in which each row carries its own (block table, query-span
+    length, effective KV length). Decode rows (one valid query), spec
+    verify rows (2..K+1) and prefill chunk rows (up to S) coexist in one
+    grid; per-row bounds select each row's path inside the kernel — group
+    walk length from ``min(max_pos + 1, kv_len)``, window start from the
+    row's min position, causal masking per query. Masking semantics
+    (causal, in-length, window, padded queries → exact zeros) are
+    identical to ``paged_attention_xla`` over the same batch.
+
+    Rows are split host-side into independent query tiles (softmax state
+    is per query) sized so the f32 score tile stays inside VMEM; pages
+    re-stage once per TILE — this replaces the old multi-query path, which
+    re-staged pages once per QUERY and therefore capped q_len at 8."""
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError(
+            "int8-KV pools need BOTH k_scale and v_scale (or neither)"
+        )
+    quantized = k_scale is not None
+    b, s, nh, d = q.shape
+    n, hkv, bk, _ = k_pool.shape
+    if bk != block_size:
+        raise ValueError(f"pool block dim {bk} != block_size {block_size}")
+    if d % 128 != 0 and not interpret:
+        raise ValueError(
+            f"ragged paged attention needs head_dim % 128 == 0, got {d}"
+        )
+    qpk = nh // hkv
+    m = block_tables.shape[1]
+    t = _ragged_q_tile(s, qpk)
+    s_pad = -(-s // t) * t
+    if s_pad != s:
+        q = jnp.pad(q, ((0, 0), (0, s_pad - s), (0, 0), (0, 0)))
+        positions = jnp.pad(
+            positions, ((0, 0), (0, s_pad - s)), constant_values=-1
+        )
+    qt = s_pad // t
+    rows = b * qt
+    # [B, S, Nh, D] → [R, Hkv, qpk*T, D] with the query index t fastest
+    # inside each (kv-head, GQA-slot) group — the layout the kernel's one
+    # batched MXU contraction per page group wants
+    q_r = q.reshape(b, qt, t, hkv, qpk, d).transpose(0, 1, 3, 4, 2, 5) \
+        .reshape(rows, hkv, qpk * t, d)
+    pos_r = positions.reshape(rows, t).astype(jnp.int32)
+    tables_r = jnp.repeat(block_tables.astype(jnp.int32), qt, axis=0)
+    lens_r = jnp.repeat(kv_lens.astype(jnp.int32), qt, axis=0)
+    qmax_r = jnp.max(pos_r, axis=1)
+    qmin_r = jnp.min(jnp.where(pos_r >= 0, pos_r, jnp.int32(2**30)), axis=1)
+    qmin_r = jnp.where(qmax_r >= 0, qmin_r, 0)
+
+    scale_page_bytes = block_size * d * 2 if quantized else 0
+    gp = _pages_per_group(
+        block_size, hkv, d, k_pool.dtype.itemsize, m,
+        scale_page_bytes=scale_page_bytes,
+    )
+    max_groups = -(-m // gp)
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, hkv, qpk * t, d),
+            lambda i, j, *_refs: (i, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (1, t), lambda i, j, *_refs: (i, 0), memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(memory_space=_HBM),   # k_pool
+        pl.BlockSpec(memory_space=_HBM),   # v_pool
+    ]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec(memory_space=_HBM),   # k_scale
+            pl.BlockSpec(memory_space=_HBM),   # v_scale
+        ]
+    out_specs = pl.BlockSpec(
+        (1, hkv, qpk * t, d),
+        lambda i, j, *_refs: (i, 0, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    scratch = [
+        pltpu.VMEM((2, gp, hkv, block_size, d), k_pool.dtype),
+        pltpu.VMEM((2, gp, hkv, block_size, d), v_pool.dtype),
+    ]
+    if quantized:
+        scratch += [
+            pltpu.VMEM((2, gp, block_size, d), jnp.bfloat16),    # ksbuf
+            pltpu.VMEM((2, gp, block_size, d), jnp.bfloat16),    # vsbuf
+        ]
+    scratch += [pltpu.SemaphoreType.DMA((2, 2, gp))]             # sems
+    if quantized:
+        scratch += [pltpu.SemaphoreType.DMA((2, 2, gp))]         # ssems
+    scratch += [
+        pltpu.VMEM((hkv, qpk * t), jnp.float32),                 # m
+        pltpu.VMEM((hkv, qpk * t), jnp.float32),                 # l
+        pltpu.VMEM((hkv, qpk * t, d), jnp.float32),              # acc
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(rows, max_groups),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _ragged_kernel,
+        rows=rows,
+        q_tile=t,
+        block_size=block_size,
+        pages_per_group=gp,
+        max_pages=m,
+        window=window,
+        scale=d**-0.5,
+        quantized=quantized,
+    )
+    operands = [
+        tables_r, lens_r, qmax_r, qmin_r,
+        jnp.zeros((1,), jnp.int32),   # buffer_index
+        jnp.ones((1,), jnp.int32),    # init_flag
+        q_r, pos_r, k_pool, v_pool,
+    ]
+    if quantized:
+        operands += [k_scale.astype(jnp.bfloat16),
+                     v_scale.astype(jnp.bfloat16)]
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, hkv, qpk * t, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(*operands)
+    out = out.reshape(b, qt, hkv, qpk, t, d).transpose(0, 1, 4, 2, 3, 5) \
+        .reshape(b, s_pad, nh, d)
+    return out[:, :s]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("block_size", "window", "interpret"),
 )
 def paged_attention_pallas_multiquery(
-    q: jax.Array,             # [B, S, Nh, D], small S (2..8)
+    q: jax.Array,             # [B, S, Nh, D], small S (spec verify windows)
     k_pool: jax.Array,        # [N, Hkv, Bk, D] (head-major pages, 1 layer)
     v_pool: jax.Array,
     block_tables: jax.Array,  # [B, M] int32
@@ -695,38 +1085,17 @@ def paged_attention_pallas_multiquery(
     k_scale: Optional[jax.Array] = None,   # [N, Bk, D] bf16 lane-replicated
     v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Small-q paged attention — the speculative verify pass's multi-query
-    path (q_len = K+1 per slot rather than 1).
-
-    Each of the S queries becomes one decode-kernel row with its own
-    effective context length ``min(position + 1, kv_len)``: causal masking
-    within the chunk falls out of the kernel's existing in-length mask
-    (the chunk's KV rows are already scattered into the pool before
-    attention runs, and chain positions are sequential). Pages re-stage
-    once per query row, which is why dispatch caps S at
-    ``ops.attention._PALLAS_MAX_MULTIQUERY``; masking semantics (causal,
-    in-length, window, padded queries → exact zeros) are identical to
-    ``paged_attention_xla`` over the same chunk."""
-    b, s, nh, d = q.shape
-    hkv = k_pool.shape[1]
-    qf = q.reshape(b * s, 1, nh, d)
-    pos_f = positions.reshape(b * s)
-    tables_f = jnp.repeat(block_tables, s, axis=0)
-    lens_f = jnp.minimum(pos_f + 1, jnp.repeat(kv_lens, s, axis=0))
-    zeros = jnp.zeros((b * s, hkv, d), jnp.bfloat16)
-    out = _call_decode_kernel(
-        qf, zeros, zeros, k_pool[None], v_pool[None], jnp.int32(0),
-        tables_f, pos_f,
-        jnp.full((b * s,), -1, jnp.int32),   # no writes
-        lens_f, block_size, window,
-        fused_write=False, interpret=interpret,
-        k_scale=None if k_scale is None else k_scale[None],
-        v_scale=None if v_scale is None else v_scale[None],
-    )[0]
-    # padded queries must be exact zeros (the XLA contract); inactive
-    # kernel rows may carry stale buffer contents
-    out = jnp.where((pos_f >= 0)[:, None, None, None], out, 0.0)
-    return out.reshape(b, s, nh, d)
+    """Small-q paged attention (speculative verify windows) — since round 6
+    a thin alias of :func:`ragged_paged_attention` with uniform spans. The
+    old implementation flattened every query into its own decode-kernel
+    row, re-staging pages once per query, which capped q_len at 8; the
+    ragged kernel stages pages once per query TILE, so the cap (and the
+    separate dispatch path) is gone."""
+    return ragged_paged_attention(
+        q, k_pool, v_pool, block_tables, positions, kv_lens, block_size,
+        window=window, interpret=interpret,
+        k_scale=k_scale, v_scale=v_scale,
+    )
 
 
 @functools.partial(

@@ -123,6 +123,16 @@ class BatcherConfig:
     # steady low rates (the first paged request kept the engine active
     # when each next one arrived, so no wave ever started again).
     spec_max_active: int = 2
+    # RAGGED rounds (round 6, the default): admission appends prefill-chunk
+    # rows to the next engine round instead of scheduling competing prefill
+    # dispatches — the round loop collapses to build-ragged-batch →
+    # dispatch → commit, and the subwave/interleave admission-stall knobs
+    # are obsolete. None = auto (ragged whenever the engine supports it:
+    # plain paged engines; spec-integrated and seq-sharded engines keep
+    # the split paths). False forces the legacy wave/chunk-interleaved
+    # admission — kept for A/B benchmarking (worker_serving --compare-
+    # legacy), not production.
+    ragged: Optional[bool] = None
 
     @property
     def horizon_levels(self) -> Tuple[int, ...]:
@@ -180,6 +190,7 @@ class ContinuousBatcher:
                 "(EngineConfig.speculative); attaching a standalone "
                 "SpeculativeDecoder would draft twice — pick one"
             )
+        self._check_ragged_supported(self.cfg.ragged)
         # (wave, items) while a speculative wave is in flight
         self._spec_wave: Optional[Tuple[Any, List["_QueueItem"]]] = None
         # True while start_wave runs on the executor: the requests are off
@@ -205,20 +216,53 @@ class ContinuousBatcher:
         # first, or the resume takes them straight back and the pressure
         # recurs every round until the victim dies preempted_too_often
         self._resume_hold = False
-        # at most one chunk-interleaved long-prompt admission in flight;
-        # its prefill advances one chunk per loop iteration, between decode
-        # rounds (VERDICT r1 next-step #4)
+        # legacy path only: at most one chunk-interleaved long-prompt
+        # admission in flight; its prefill advances one chunk per loop
+        # iteration, between decode rounds (VERDICT r1 next-step #4)
         self._chunked: Optional[Tuple[ChunkedAdmission, _QueueItem]] = None
+        # ragged mode (the default): EVERY admission — short or long — is a
+        # bound-but-unprefilled engine slot whose chunk rows ride the next
+        # ragged round(s) co-dispatched with the active decodes. Several
+        # may be in flight at once; an admission leaves this list for
+        # _slot_items when its final chunk samples the first token.
+        self._ragged: List[Tuple[ChunkedAdmission, _QueueItem]] = []
         self.stats: Dict[str, Any] = {
             "submitted": 0, "completed": 0, "rejected": 0, "timeouts": 0,
             "decode_rounds": 0, "admitted": 0, "queue_peak": 0,
             "step_latency_ema_ms": 0.0, "occupancy_sum": 0, "horizon": self._horizon,
             "chunked_admissions": 0, "batched_waves": 0,
+            "ragged_admissions": 0, "ragged_rounds": 0,
             "spec_waves": 0, "spec_completed": 0, "spec_errors": 0,
             "preemptions": 0, "resumes": 0, "preemption_block_pressure": 0,
             "preempted_too_often": 0,
             "cancelled": 0, "migrated": 0, "adopted": 0,
         }
+
+    @property
+    def use_ragged(self) -> bool:
+        """Ragged rounds are the DEFAULT serving path: admission appends
+        rows to the next round instead of dispatching competing prefills.
+        ``cfg.ragged=False`` forces the legacy path (A/B benches);
+        ``cfg.ragged=True`` REQUIRES it (init/reconfigure reject engines
+        that cannot serve it — a silent legacy fallback would make every
+        A/B ratio downstream a lie); ``None`` = auto: engines without
+        ragged support (spec-integrated, seq-sharded, fakes) fall back
+        automatically."""
+        if self.cfg.ragged is False:
+            return False
+        return bool(getattr(self.engine, "supports_ragged", False))
+
+    def _check_ragged_supported(self, requested: Any) -> None:
+        """``ragged=True`` is REQUIRE, not prefer — reject it loudly on an
+        engine that keeps the split admission paths."""
+        if requested is True and \
+                not getattr(self.engine, "supports_ragged", False):
+            raise ValueError(
+                "serving.ragged=true requires an engine with ragged-round "
+                "support (plain paged engines); spec-integrated and "
+                "seq-sharded engines keep the split admission paths — "
+                "use ragged=null (auto) to fall back silently"
+            )
 
     def _rebuild_levels(self, anchor: float) -> None:
         """THE quantized-horizon level-set derivation (init + live
@@ -293,6 +337,7 @@ class ContinuousBatcher:
             or spec_cap <= 0
             or self._spec_wave is not None
             or self._chunked is not None
+            or self._ragged
             or not self._heap
             or len(self._heap) > spec_cap
             or self.engine.num_active > self.cfg.spec_max_active
@@ -491,6 +536,7 @@ class ContinuousBatcher:
             # drain batcher-OWNED work only: a foreign engine slot (e.g. a
             # PD sequence retained between stages) is not ours to wait on
             while self._heap or self._slot_items or self._chunked is not None \
+                    or self._ragged \
                     or self._spec_wave is not None or self._spec_starting:
                 await asyncio.sleep(0.01)
         if self._run_task:
@@ -517,6 +563,17 @@ class ContinuousBatcher:
             except Exception:  # noqa: BLE001 — shutdown is best-effort
                 pass
             pending.append(chunk_item)
+        for adm, rag_item in self._ragged:
+            # mid-prefill ragged admissions are in NEITHER collection above
+            # either — abort their engine state and resolve them too
+            try:
+                await loop.run_in_executor(
+                    self._exec, self.engine.abort_chunked, adm
+                )
+            except Exception:  # noqa: BLE001 — shutdown is best-effort
+                pass
+            pending.append(rag_item)
+        self._ragged = []
         if self._spec_wave is not None:
             wave, items = self._spec_wave
             self._spec_wave = None
@@ -549,11 +606,17 @@ class ContinuousBatcher:
             if val is None or not hasattr(self.cfg, key):
                 continue
             cur = getattr(self.cfg, key)
-            if isinstance(cur, bool) and isinstance(val, str):
+            if (isinstance(cur, bool) or key == "ragged") \
+                    and isinstance(val, str):
                 # remote pushes arrive through an untyped dict and env/YAML
                 # tooling stringifies scalars — bool("false") is True, so
-                # coerce by content, not constructor
+                # coerce by content, not constructor ("ragged" is tri-state
+                # Optional[bool], so its current value may be None)
                 val = val.strip().lower() in ("1", "true", "yes", "on")
+            if key == "ragged":
+                self._check_ragged_supported(bool(val))
+                coerced[key] = bool(val)
+                continue
             coerced[key] = type(cur)(val) if cur is not None else val
         # all-or-nothing: coercion above raised before any cfg mutation,
         # so one bad value can't leave a half-applied retune
@@ -601,12 +664,17 @@ class ContinuousBatcher:
         the heap are not thread-safe); only the engine call itself runs on the
         engine executor thread.
 
-        Short prompts are collected into a WAVE and admitted through
-        ``engine.submit_batch`` — one batched prefill device call per bucket
-        instead of one per request (VERDICT r1 next-step #3). Prompts longer
-        than the largest prefill bucket start a chunk-interleaved admission
-        instead (one at a time); their chunks run between decode rounds in
-        ``_run``."""
+        Ragged mode (the default): every fresh admission binds its slot NOW
+        (``engine.submit_chunked_start``) and its prompt rides the next
+        ragged round(s) as chunk rows co-dispatched with the active decodes
+        — admission IS "append rows to the next round"; several may be in
+        flight at once. Legacy mode (``cfg.ragged=False`` or an engine
+        without ragged support): short prompts are collected into a WAVE
+        and admitted through ``engine.submit_batch`` — one batched prefill
+        device call per bucket instead of one per request (VERDICT r1
+        next-step #3) — while prompts longer than the largest prefill
+        bucket start a chunk-interleaved admission instead (one at a time);
+        their chunks run between decode rounds in ``_run``."""
         admitted = 0
         if self._resume_hold:
             # the round after a preemption belongs to the FROZEN slots:
@@ -712,6 +780,32 @@ class ContinuousBatcher:
                 self._admit_stamp[slot] = next(self._stamp)
                 self.stats["resumes"] += 1
                 admitted += 1
+                continue
+            if self.use_ragged:
+                # ragged admission (the default): bind the slot NOW, run no
+                # prefill — the prompt's chunk rows ride the next ragged
+                # round(s) co-dispatched with the active decodes, so there
+                # is no competing prefill dispatch and no short/long split
+                try:
+                    adm = await loop.run_in_executor(
+                        self._exec, self.engine.submit_chunked_start,
+                        item.request,
+                    )
+                except OutOfBlocksError:
+                    _defer(item)
+                    continue
+                except Exception as e:
+                    if not item.future.done():
+                        item.future.set_result(
+                            InferenceResponse(
+                                request_id=item.request.request_id,
+                                error=str(e),
+                            )
+                        )
+                    continue
+                free.pop(0)
+                self._ragged.append((adm, item))
+                self.stats["ragged_admissions"] += 1
                 continue
             n_prompt = len(item.request.prompt_token_ids or [])
             if n_prompt > max_bucket:
@@ -1005,6 +1099,39 @@ class ContinuousBatcher:
                         pre.preempt_count = item.preempt_count
                         item.future.set_exception(RequestMigrated(pre))
                         self.stats["migrated"] += 1
+        for adm, item in list(self._ragged):
+            cancelled = item.cancel is not None and item.cancel.is_set()
+            interrupted = item.interrupt is not None \
+                and item.interrupt.is_set()
+            if not (cancelled or interrupted or item.future.done()):
+                continue
+            # same contract as the legacy chunk-interleaved admission: a
+            # request mid ragged prefill holds no resumable engine state
+            # yet — abort (frees the slot + staged blocks) and resolve /
+            # migrate with a synthesized zero-token checkpoint; a done
+            # future (caller timeout) just releases the engine side
+            self._ragged.remove((adm, item))
+            try:
+                await loop.run_in_executor(
+                    self._exec, self.engine.abort_chunked, adm
+                )
+            except Exception:  # noqa: BLE001 — abort is best-effort
+                pass
+            if item.future.done():
+                continue
+            if cancelled:
+                item.future.set_result(InferenceResponse(
+                    request_id=item.request.request_id,
+                    finish_reason="abort",
+                    prompt_tokens=len(item.request.prompt_token_ids or []),
+                ))
+                self.stats["completed"] += 1
+                self.stats["cancelled"] += 1
+            else:
+                pre = synthesize_checkpoint(item.request)
+                pre.preempt_count = item.preempt_count
+                item.future.set_exception(RequestMigrated(pre))
+                self.stats["migrated"] += 1
         for slot, item in list(self._slot_items.items()):
             s = self.engine.slots[slot]
             if s is None or s.finish_reason is not None:
@@ -1051,8 +1178,21 @@ class ContinuousBatcher:
                 pass
 
     def _engine_round(self) -> float:
-        """One blocking engine round on the worker thread. Returns latency ms."""
+        """One blocking engine round on the worker thread. Returns latency ms.
+
+        Ragged mode with admissions in flight dispatches ONE
+        ``engine.ragged_round``: every active decode slot advances one
+        token and every admission advances one prefill chunk in the same
+        invocation — build-ragged-batch → dispatch → commit, no competing
+        prefill dispatch, no subwave/interleave stall shaping. With no
+        admission in flight a ragged round degenerates to pure decode, so
+        the multi-step scan (horizon amortization of the host RTT) is the
+        better dispatch for the identical math and runs instead."""
         t0 = time.perf_counter()
+        if self._ragged:
+            self.engine.ragged_round([adm for adm, _ in self._ragged])
+            self.stats["ragged_rounds"] += 1
+            return (time.perf_counter() - t0) * 1000.0
         steps = self._levels[self._level]
         if self._heap or self._chunked is not None:
             # work is waiting (queued requests or a mid-prefill chunked
@@ -1092,7 +1232,8 @@ class ContinuousBatcher:
             # nor be decoded/finished behind its owner's back — it joins the
             # batch only through adopt_slot().
             if not self._heap and not self._slot_items \
-                    and self._chunked is None and self._spec_wave is None:
+                    and self._chunked is None and not self._ragged \
+                    and self._spec_wave is None:
                 self._wake.clear()
                 if self._stopping:
                     return
@@ -1121,7 +1262,8 @@ class ContinuousBatcher:
             await self._step_chunked()
             # one bounded fused dispatch of the in-flight spec wave
             await self._step_spec_wave()
-            if not self._slot_items and self._chunked is None:
+            if not self._slot_items and self._chunked is None \
+                    and not self._ragged:
                 # no batcher-owned slot decodes: no frozen slot of OURS is
                 # waiting on freed blocks, so resumes may flow immediately
                 # (foreign slots are left untouched for their owner)
@@ -1138,6 +1280,14 @@ class ContinuousBatcher:
                 self.stats["decode_rounds"] += 1
                 self.stats["occupancy_sum"] += self.engine.num_active
                 self._retune(latency)
+                # ragged admissions whose final chunk sampled its first
+                # token this round join the batch (the finished-slot sweep
+                # below then resolves any that immediately hit stop/length)
+                for adm, item in [p for p in self._ragged if p[0].done]:
+                    self._ragged.remove((adm, item))
+                    self._slot_items[adm.slot] = item
+                    self._admit_stamp[adm.slot] = next(self._stamp)
+                    self.stats["admitted"] += 1
                 for i, s in enumerate(list(self.engine.slots)):
                     if s is not None and s.finish_reason is not None \
                             and i in self._slot_items:
@@ -1187,6 +1337,24 @@ class ContinuousBatcher:
                             )
                         )
                         self.stats["completed"] += 1
+                # mid-prefill ragged admissions likewise aren't in
+                # _slot_items yet — release their slots and resolve
+                for adm, rag_item in list(self._ragged):
+                    try:
+                        await loop.run_in_executor(
+                            self._exec, self.engine.abort_chunked, adm
+                        )
+                    except Exception:
+                        pass
+                    if not rag_item.future.done():
+                        rag_item.future.set_result(
+                            InferenceResponse(
+                                request_id=rag_item.request.request_id,
+                                error=f"engine error: {e}",
+                            )
+                        )
+                        self.stats["completed"] += 1
+                self._ragged.clear()
                 for i in list(self._slot_items):
                     # fail OWNED slots only — a foreign slot's owner handles
                     # its own engine-error cleanup (PD decode already does)
@@ -1213,6 +1381,8 @@ class ContinuousBatcher:
         out = dict(self.stats)
         out["queue_depth"] = len(self._heap)
         out["active_slots"] = self.engine.num_active
+        out["ragged_mode"] = self.use_ragged
+        out["ragged_in_flight"] = len(self._ragged)
         out["spec_wave_active"] = self._spec_wave is not None
         if self.spec is not None:
             out["spec"] = self.spec.get_stats()

@@ -156,6 +156,14 @@ class EngineConfig:
     # the target's own argmax); sampled slots ride the same graph at one
     # token per step. Single-chip only (no mesh).
     speculative: Optional[SpecDecodeConfig] = None
+    # RAGGED rounds (round 6): max prefill-chunk width co-dispatched with
+    # decode rows in one ragged_round() invocation. Bounds the dense
+    # (non-attention) compute padding of the [B, S] round graph — decode
+    # rows carry 1 live token out of S, so a wider chunk trades fewer
+    # admission rounds against more masked matmul work per round. Clamped
+    # to the largest prefill bucket; widths bucket through prefill_buckets
+    # so the compiled round-graph count stays logarithmic.
+    ragged_chunk: int = 256
 
     @property
     def max_blocks_per_seq(self) -> int:
@@ -503,6 +511,7 @@ class TPUEngine:
             "requests": 0, "completed": 0, "generated_tokens": 0,
             "prefill_tokens": 0, "prefill_calls": 0, "decode_calls": 0,
             "preemptions": 0, "resumes": 0, "kv_pressure_events": 0,
+            "ragged_rounds": 0,
         }
         if self.cfg.speculative is not None:
             self.stats.update({
@@ -930,6 +939,45 @@ class TPUEngine:
         self._decode_multi_fn = jax.jit(
             decode_multi, static_argnames=("num_steps", "mode"),
             donate_argnums=(1, 2),
+        )
+
+        # --- RAGGED round (round 6): ONE dispatch in which decode rows
+        # (1 live token each, at position lens) and admission prefill-chunk
+        # rows (up to S live tokens) coexist — per-row positions/-1 padding
+        # select each row's path, and on TPU the attention inside
+        # forward_chunk dispatches to the ragged paged-attention kernel
+        # (ops.attention.resolve_impl → "ragged" for S > 1). Admission
+        # stops being a competing dispatch: appending a chunk row to the
+        # next round IS the admission. Per-row token math is identical to
+        # the split paths (decode rows ≡ decode_multi's step, chunk rows ≡
+        # _prefill_chunk_fn), so greedy outputs are byte-identical and
+        # seeded sampling is stable (the sampler folds the absolute
+        # position, which is per-row here exactly as there).
+        def ragged_round(params, kv, toks_pos, tables, lens_after, core,
+                         sample_flag, mode):
+            out = llama.forward_chunk(
+                cfg, params, toks_pos[0], toks_pos[1], kv, tables,
+                lens_after, block_size=bs, last_only=True,
+                attn_override=chunk_attn_override,
+                # the fused write+attention kernel is S=1-shaped; ragged
+                # rounds always carry at least one multi-token-capable row
+                allow_fused=False,
+            )
+            toks = sample_mode(
+                out.logits[:, 0, :], core["keys"], lens_after,
+                core["temps"], core["top_ks"], core["top_ps"], mode,
+            )
+            # rows that sampled (decode rows + FINAL admission chunks)
+            # advance the device core exactly as decode_multi / the
+            # batched prefill would; intermediate chunk rows only write KV
+            sampled = sample_flag > 0
+            core = dict(core)
+            core["last"] = jnp.where(sampled, toks, core["last"])
+            core["lens"] = jnp.where(sampled, lens_after, core["lens"])
+            return out.kv, core, toks
+
+        self._ragged_round_fn = jax.jit(
+            ragged_round, static_argnames=("mode",), donate_argnums=(1, 5),
         )
 
         # --- integrated speculative decoding: R fused draft→verify→accept
@@ -1952,6 +2000,163 @@ class TPUEngine:
         self._kv_lens[adm.slot] = 0
         self.manager.free_sequence(adm.seq_id, cache=False)
         self._core_dirty = True
+
+    # ------------------------------------------------------- ragged rounds
+
+    @property
+    def supports_ragged(self) -> bool:
+        """Ragged rounds serve plain paged engines (single-chip or TP
+        mesh). Spec-integrated engines decode through the fused
+        draft→verify→accept scan (their rounds commit 1..K+1 tokens per
+        slot — a different round shape), and seq-sharded pools read decode
+        rows through a dedicated shard_map op; both keep the split
+        admission paths."""
+        return self.cfg.speculative is None and not self.cfg.kv_seq_sharded
+
+    def ragged_round(
+        self, admissions: Sequence[ChunkedAdmission] = (),
+    ) -> Dict[int, List[int]]:
+        """ONE device dispatch serving a ragged row batch: every active
+        decode slot advances one token AND every in-flight admission
+        advances one prefill chunk — the round-6 unification that replaced
+        scheduling competing prefill/decode dispatches (subwave/interleave)
+        with "append rows to the next round".
+
+        Per-row semantics are exactly the split paths': decode rows feed
+        their pending token at position ``_kv_lens`` (block pre-reserved,
+        pressure freezes the row at the step boundary — ``decode_step``'s
+        contract), admission rows run their next chunk with the final
+        chunk sampling the first token in-graph (``submit_chunked_step``'s
+        contract, including the pending-block pre-reservation; a pressured
+        final chunk is NOT consumed and retries next round). Returns
+        {slot: [token]} for every row that sampled. Admissions are mutated
+        in place; ``adm.done`` flips when the first token lands."""
+        admissions = [a for a in admissions if not a.done]
+        for adm in admissions:
+            s = self.slots[adm.slot]
+            if s is None or s.seq_id != adm.seq_id:
+                raise RuntimeError("ragged admission slot was freed")
+        b = len(self.slots)
+        max_bucket = self.cfg.prefill_buckets[-1]
+        chunk_cap = min(max(int(self.cfg.ragged_chunk), 1), max_bucket)
+
+        # --- decode rows: pre-reserve each pending token's block exactly
+        # as decode_step does; exhaustion freezes the row (nothing decoded,
+        # pending still pending) and signals step-boundary pressure
+        kept: List[int] = []
+        pressured: List[int] = []
+        for i, s in enumerate(self.slots):
+            if s is None or s.finish_reason is not None or s.prefilling:
+                continue
+            if len(self.manager.seq_tokens[s.seq_id]) >= self.cfg.max_seq_len:
+                kept.append(i)      # length-finish triggers in _record_token
+                continue
+            try:
+                added = self.manager.reserve_tokens(s.seq_id, 1)
+            except OutOfBlocksError:
+                self.manager.trim_reserved(s.seq_id)
+                self._block_tables[i] = self.manager.block_table_for(
+                    s.seq_id, self.cfg.max_blocks_per_seq
+                )
+                pressured.append(i)
+                continue
+            if added:
+                self._block_tables[i] = self.manager.block_table_for(
+                    s.seq_id, self.cfg.max_blocks_per_seq
+                )
+            kept.append(i)
+        if pressured:
+            self._signal_pressure("decode", slots=pressured)
+
+        # --- admission chunk rows: final chunks pre-reserve the sampled
+        # first token's block (submit_chunked_step's step-boundary rule);
+        # a pressured final chunk skips THIS round and retries
+        ready: List[Tuple[ChunkedAdmission, List[int], bool]] = []
+        width = 1
+        for adm in admissions:
+            s = self.slots[adm.slot]
+            assert s is not None
+            piece = adm.fresh[:chunk_cap]
+            is_last = len(adm.fresh) <= chunk_cap
+            if is_last:
+                try:
+                    if self.manager.reserve_tokens(s.seq_id, 1):
+                        self._block_tables[adm.slot] = \
+                            self.manager.block_table_for(
+                                s.seq_id, self.cfg.max_blocks_per_seq
+                            )
+                except OutOfBlocksError:
+                    self.manager.trim_reserved(s.seq_id)
+                    self._signal_pressure("admission", requests=1)
+                    continue
+            ready.append((adm, piece, is_last))
+            width = max(width, len(piece))
+        if not kept and not ready:
+            return {}
+
+        self._apply_pending()
+        s_w = self._bucket_len(width)
+        toks_pos = np.zeros((2, b, s_w), np.int32)
+        toks_pos[1] = -1
+        lens_after = np.zeros((b,), np.int32)
+        row_mask = np.zeros((b,), dtype=bool)
+        sample_flag = np.zeros((b,), np.int32)
+        mode = "greedy"
+        for i in kept:
+            toks_pos[0, i, 0] = self._last_tokens[i]
+            toks_pos[1, i, 0] = self._kv_lens[i]
+            lens_after[i] = self._kv_lens[i] + 1
+            row_mask[i] = True
+            sample_flag[i] = 1
+            if self._temps[i] > 0:
+                mode = "mixed"
+        for adm, piece, is_last in ready:
+            sl, n = adm.slot, len(piece)
+            toks_pos[0, sl, :n] = piece
+            toks_pos[1, sl, :n] = np.arange(adm.off, adm.off + n)
+            lens_after[sl] = adm.off + n
+            row_mask[sl] = True
+            sample_flag[sl] = 1 if is_last else 0
+            if adm.mode != "greedy":
+                mode = "mixed"
+        core = self._sync_core()
+        tables, _act, flag_d = self._sched_arrays(row_mask, sample_flag)
+        try:
+            self.kv, self._dev_core, toks = self._ragged_round_fn(
+                self.params, self.kv, toks_pos, tables,
+                jnp.asarray(lens_after), core, flag_d, mode,
+            )
+        except Exception:
+            self._invalidate_device_state()
+            raise
+        toks = np.asarray(toks)
+        self.stats["ragged_rounds"] += 1
+        if kept:
+            self.stats["decode_calls"] += 1
+        if ready:
+            # ONE device dispatch served every admission row — the counter
+            # means device calls everywhere else (wave admission asserts
+            # one per bucket), so it must not scale with the row count
+            self.stats["prefill_calls"] += 1
+        out: Dict[int, List[int]] = {}
+        for i in kept:
+            self._kv_lens[i] += 1   # the fed token's KV is now committed
+            tok = int(toks[i])
+            out[i] = [tok]
+            self._record_token(i, tok, device_synced=True)
+        for adm, piece, is_last in ready:
+            s = self.slots[adm.slot]
+            assert s is not None
+            adm.fresh = adm.fresh[len(piece):]
+            adm.off += len(piece)
+            self.stats["prefill_tokens"] += len(piece)
+            if is_last:
+                s.prefilling = False
+                tok = int(toks[adm.slot])
+                out[adm.slot] = [tok]
+                self._record_token(adm.slot, tok, device_synced=True)
+                adm.done = True
+        return out
 
     def _record_token(self, slot: int, tok: int, already_committed: bool = False,
                       device_synced: bool = False) -> None:

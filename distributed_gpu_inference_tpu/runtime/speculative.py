@@ -126,8 +126,11 @@ class SpecDecodeConfig:
     """
 
     # K drafted tokens per slot per step; the verify pass scores K+1
-    # queries. Keep K+1 <= 8 on TPU so dispatch stays on the small-q Pallas
-    # path (ops.attention.resolve_impl) instead of the prefill gather.
+    # queries. Since round 6 the verify pass dispatches through the ragged
+    # paged-attention kernel (ops.attention.resolve_impl → "ragged"), which
+    # stages pages once per query TILE — the old small-q path's q_len <= 8
+    # cap (pages re-staged per query) is gone, so K is bounded only by the
+    # block-growth checks below.
     num_draft_tokens: int = 4
     # EAGLE-style head weights (init_draft_params layout). None = random
     # init from ``draft_seed`` — near-zero acceptance but still CORRECT
@@ -149,22 +152,6 @@ class SpecDecodeConfig:
                 f"SpecDecodeConfig.num_draft_tokens={k}: need at least 1 "
                 "drafted token (0 would be vanilla decode — disable "
                 "speculative instead)"
-            )
-        from distributed_gpu_inference_tpu.ops.attention import (
-            _PALLAS_MAX_MULTIQUERY,
-        )
-
-        if k + 1 > _PALLAS_MAX_MULTIQUERY:
-            # a silent fall-through to the prefill-shaped gather would
-            # erase the speedup the mode exists for — the same no-silent-
-            # fallback stance as resolve_impl's exposure to bench.py
-            raise ValueError(
-                f"SpecDecodeConfig.num_draft_tokens={k}: the verify pass "
-                f"(q_len = K+1 = {k + 1}) would leave the small-q Pallas "
-                f"path (max {_PALLAS_MAX_MULTIQUERY} queries/slot, "
-                "ops.attention.resolve_impl) and decode through the "
-                "prefill-shaped gather on TPU; num_draft_tokens is the "
-                f"limiting field — keep it <= {_PALLAS_MAX_MULTIQUERY - 1}"
             )
         bs = engine_cfg.block_size
         m = engine_cfg.max_blocks_per_seq

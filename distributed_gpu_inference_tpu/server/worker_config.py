@@ -74,10 +74,14 @@ class WorkerRemoteConfig:
     # utils.config.ServingConfig that retune a RUNNING batcher between
     # decode rounds: target_step_ms, max_horizon, min_horizon, multi_step,
     # adaptive, max_wait_ms, queue_limit, default_timeout_s,
-    # max_preemptions, spec_max_batch, spec_max_active). Compile-affecting
-    # admission knobs (subwave/interleave) and `mode` are load-time-only
-    # worker YAML and silently ignored by the worker if pushed. Empty dict
-    # = no override (the worker keeps its local config).
+    # max_preemptions, spec_max_batch, spec_max_active, ragged).
+    # Compile-affecting admission knobs (subwave/interleave) and `mode`
+    # are load-time-only worker YAML and silently ignored by the worker if
+    # pushed. The round-6 ragged serving path made subwave/interleave/
+    # max_horizon degenerate: still accepted (saved SLO configs keep
+    # deploying) but deprecation-warned once on ingest — see
+    # utils.config.DEPRECATED_SERVING_KEYS. Empty dict = no override (the
+    # worker keeps its local config).
     serving: Dict[str, Any] = field(default_factory=dict)
     updated_at: float = field(default_factory=time.time)
 
@@ -86,11 +90,18 @@ class WorkerRemoteConfig:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "WorkerRemoteConfig":
+        from distributed_gpu_inference_tpu.utils.config import (
+            warn_deprecated_serving_key,
+        )
+
         lc = LoadControl(**(d.get("load_control") or {}))
         sec = SecurityPolicy(**(d.get("security") or {}))
         mcs = {
             k: ModelConfig(**v) for k, v in (d.get("model_configs") or {}).items()
         }
+        for key, val in (d.get("serving") or {}).items():
+            if val is not None:
+                warn_deprecated_serving_key(key, "remote config push")
         return cls(
             version=int(d.get("version") or 1),
             load_control=lc,

@@ -14,16 +14,60 @@ vLLM/SGLang backends.
 
 from __future__ import annotations
 
+import logging
 import os
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import yaml
-from pydantic import BaseModel, Field
+from pydantic import BaseModel, Field, model_validator
 
 from distributed_gpu_inference_tpu.utils.data_structures import KV_BLOCK_TOKENS
 
+log = logging.getLogger(__name__)
+
 ENV_PREFIX = "TPU_WORKER_"
+
+# Serving knobs obsoleted by the round-6 ragged serving path (one kernel
+# invocation carrying prefill-chunk AND decode rows — admission appends
+# rows to the next round instead of scheduling competing dispatches, so
+# the admission-stall shaping these knobs tuned no longer exists). They
+# stay ACCEPTED in worker YAML and remote pushes (rolling fleets, saved
+# SLO configs) but are warned once per process; only the legacy path
+# (``serving.ragged: false``) still reads them.
+DEPRECATED_SERVING_KEYS: Dict[str, str] = {
+    "subwave": (
+        "the ragged serving path admits by appending chunk rows to the "
+        "next decode round — there are no admission sub-waves to shape; "
+        "only the legacy path (serving.ragged: false) reads this"
+    ),
+    "interleave": (
+        "prefill chunks co-dispatch WITH decode rows in a ragged round — "
+        "there are no separate dispatches left to interleave; only the "
+        "legacy path (serving.ragged: false) reads this"
+    ),
+    "max_horizon": (
+        "still caps the pure-decode scan horizon, but it is no longer the "
+        "TTFT-shaping knob: admission latency is bounded by the ragged "
+        "round itself, not by capping decode-scan depth"
+    ),
+}
+_deprecated_serving_warned: Set[str] = set()
+
+
+def warn_deprecated_serving_key(key: str, source: str) -> None:
+    """One-time (per process, per key) deprecation warning for obsoleted
+    serving knobs — the keys keep working so existing YAML and saved
+    remote configs deploy unchanged, but operators learn the knob is
+    degenerate under ragged serving."""
+    if key not in DEPRECATED_SERVING_KEYS \
+            or key in _deprecated_serving_warned:
+        return
+    _deprecated_serving_warned.add(key)
+    log.warning(
+        "serving.%s (%s) is deprecated since the ragged serving round: %s",
+        key, source, DEPRECATED_SERVING_KEYS[key],
+    )
 
 
 class ServerConfig(BaseModel):
@@ -75,17 +119,23 @@ class LoadControlConfig(BaseModel):
 
 class ServingConfig(BaseModel):
     """Batcher-backed serving front-end (``engines.<type>.serving.*``) —
-    the SLO knobs the round-5 frontier measured, now first-class worker
-    YAML keys (``worker/engines/llm.py`` SERVING_DEFAULTS mirrors these).
+    the SLO knobs, now first-class worker YAML keys
+    (``worker/engines/llm.py`` SERVING_DEFAULTS mirrors these).
 
-    ``target_step_ms`` / ``max_horizon`` / ``queue_limit`` / ``max_wait_ms``
-    are also remote-pushable (server ``WorkerRemoteConfig.serving``) and
-    retune a LIVE batcher; ``subwave`` / ``interleave`` / ``mode`` are
-    compile-affecting and apply at engine load only."""
+    Since round 6 the default serving path runs RAGGED rounds (prefill
+    chunk rows and decode rows in one kernel dispatch), which obsoletes
+    the admission-stall shaping knobs: ``subwave`` / ``interleave`` /
+    ``max_horizon`` are still accepted (and ``max_horizon`` still caps the
+    pure-decode scan) but log a one-time deprecation warning when set —
+    see ``DEPRECATED_SERVING_KEYS``. ``target_step_ms`` / ``queue_limit``
+    / ``max_wait_ms`` / ``ragged`` are remote-pushable (server
+    ``WorkerRemoteConfig.serving``) and retune a LIVE batcher;
+    ``subwave`` / ``interleave`` / ``mode`` are compile-affecting and
+    apply at engine load only."""
 
     mode: str = "batcher"               # batcher | direct (legacy driving)
     target_step_ms: float = 100.0       # adaptive round-latency target
-    max_horizon: int = 64               # decode-scan cap (longest stall)
+    max_horizon: int = 64               # decode-scan cap (DEPRECATED knob)
     min_horizon: int = 1
     multi_step: int = 8                 # initial decode horizon
     adaptive: bool = True
@@ -93,10 +143,20 @@ class ServingConfig(BaseModel):
     queue_limit: int = 1024
     default_timeout_s: float = 300.0
     max_preemptions: int = 3
-    subwave: int = 0                    # admission sub-wave width (load-time)
-    interleave: int = 0                 # decode steps between sub-waves (load-time)
+    subwave: int = 0                    # DEPRECATED (legacy path only)
+    interleave: int = 0                 # DEPRECATED (legacy path only)
     spec_max_batch: int = 2
     spec_max_active: int = 2
+    # ragged rounds: None = auto (ragged whenever the engine supports it —
+    # THE default serving path), False = force the legacy wave/chunk-
+    # interleaved admission (A/B benchmarking), True = require ragged
+    ragged: Optional[bool] = None
+
+    @model_validator(mode="after")
+    def _warn_deprecated(self) -> "ServingConfig":
+        for key in self.model_fields_set & DEPRECATED_SERVING_KEYS.keys():
+            warn_deprecated_serving_key(key, "worker YAML")
+        return self
 
 
 class EngineModelConfig(BaseModel):

@@ -63,6 +63,9 @@ SERVING_REMOTE_KEYS: Dict[str, str] = {
     "max_preemptions": "max_preemptions",
     "spec_max_batch": "spec_max_batch",
     "spec_max_active": "spec_max_active",
+    # ragged rounds (round 6): remote-flippable so a fleet can A/B the
+    # ragged vs legacy admission path live (None = auto, the default)
+    "ragged": "ragged",
 }
 
 
@@ -428,10 +431,22 @@ class TPULLMEngine(LLMBaseEngine):
     def _serving_config(self) -> Dict[str, Any]:
         """Merged serving knobs: defaults < ``config['serving']`` (worker
         YAML ``engines.llm.serving.*``) < ``extra['serving']``."""
+        from ...utils.config import warn_deprecated_serving_key
+
         out = dict(SERVING_DEFAULTS)
         for src in (self.config.get("serving"),
                     (self.config.get("extra") or {}).get("serving")):
             if isinstance(src, dict):
+                # plain-dict construction (benchmarks, tests) bypasses the
+                # pydantic surface, so the obsoleted-knob deprecation
+                # warning fires here too — but only for values that differ
+                # from the defaults (CLI surfaces pass their whole arg
+                # namespace through; a knob nobody touched must not warn)
+                for k, v in src.items():
+                    if v is not None and v != SERVING_DEFAULTS.get(k):
+                        warn_deprecated_serving_key(
+                            k, "engine serving config"
+                        )
                 out.update({k: v for k, v in src.items() if v is not None})
         return out
 
@@ -449,6 +464,8 @@ class TPULLMEngine(LLMBaseEngine):
             max_preemptions=int(sv["max_preemptions"]),
             spec_max_batch=int(sv["spec_max_batch"]),
             spec_max_active=int(sv["spec_max_active"]),
+            ragged=(None if sv.get("ragged") is None
+                    else bool(sv["ragged"])),
         )
 
     def apply_serving_config(self, updates: Optional[Dict[str, Any]]) -> None:

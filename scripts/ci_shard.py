@@ -21,6 +21,8 @@ HEAVY = [
     "tests/test_worker_failover_chaos.py",  # 25-seed kill-mid-stream e2e
     "tests/test_worker_serving_batcher.py",  # batcher-backed serving e2e
     #   (real engines + direct servers + stream_cut chaos replays)
+    "tests/test_ragged_attention.py",    # interpret-mode ragged kernel +
+    #   ragged-vs-split byte-identity serving runs (multiple engines)
     "tests/test_parallel_pipeline.py",
     "tests/test_parallel_ring_attention.py",
     "tests/test_engine_spec_integrated.py",  # spec scan graphs x 2 engines

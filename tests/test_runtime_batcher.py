@@ -141,7 +141,12 @@ def test_priority_admission(engine):
     small.submit_batch = tracking_submit_batch
 
     async def go():
-        b = ContinuousBatcher(small, BatcherConfig(max_wait_ms=30))
+        # ragged=False: this test spies on engine.submit_batch, the LEGACY
+        # wave-admission entry point (ragged admissions bind through
+        # submit_chunked_start instead; priority order under ragged is
+        # covered in tests/test_ragged_attention.py)
+        b = ContinuousBatcher(small, BatcherConfig(max_wait_ms=30,
+                                                   ragged=False))
         lo = asyncio.ensure_future(
             b.submit(_req(list(range(16)), max_new=3, priority=0))
         )
@@ -232,8 +237,11 @@ def test_wave_admission_one_prefill_call_per_bucket():
     )
 
     async def drive():
+        # ragged=False pins the LEGACY wave path this test is about
+        # (ragged-mode admission never calls submit_batch)
         b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=20.0,
-                                                 multi_step=4))
+                                                 multi_step=4,
+                                                 ragged=False))
         b.start()
         before = eng.stats["prefill_calls"]
         reqs = [
@@ -274,8 +282,12 @@ def test_chunked_admission_interleaves_decode():
     eng.submit_chunked_step = spy_step
 
     async def drive():
+        # ragged=False pins the LEGACY chunk-interleaved admission this
+        # test spies on (ragged mode co-dispatches chunk rows WITH decode
+        # rows instead of interleaving separate dispatches)
         b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=1.0,
-                                                 multi_step=2))
+                                                 multi_step=2,
+                                                 ragged=False))
         b.start()
         # short request keeps decoding while the long one admits
         short = b.submit(InferenceRequest(
@@ -315,8 +327,12 @@ def test_second_long_prompt_does_not_starve_shorts():
     )
 
     async def drive():
+        # ragged=False: the one-chunked-admission-at-a-time bottleneck this
+        # test guards only exists on the legacy path (ragged admissions
+        # all ride the same round, so there is nothing to starve)
         b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=1.0,
-                                                 multi_step=2))
+                                                 multi_step=2,
+                                                 ragged=False))
         b.start()
         long_a = b.submit(InferenceRequest(
             prompt_token_ids=[(i * 5) % 500 for i in range(120)],

@@ -303,31 +303,50 @@ def test_synthesize_checkpoint_seed_roundtrip():
     assert unseeded.slot_key == (0, 0)
 
 
-def test_micro_read_impl_crossover_and_serving_label():
-    from benchmarks.paged_attention_micro import (
-        MICRO_READ_XLA_MIN_BATCH,
-        micro_read_impl,
+def test_micro_read_impl_crossover_and_serving_label(monkeypatch):
+    # since round 6 the micro-bench read crossover lives in resolve_impl
+    # itself (fused=False + rows); MICRO_READ_XLA_MIN_BATCH survives as an
+    # env OVERRIDE only, and benchmarks/paged_attention_micro.py no longer
+    # duplicates the resolution logic
+    from distributed_gpu_inference_tpu.ops.attention import (
+        micro_read_xla_min_batch,
+        resolve_impl,
     )
-    from distributed_gpu_inference_tpu.ops.attention import resolve_impl
+
+    monkeypatch.delenv("MICRO_READ_XLA_MIN_BATCH", raising=False)
+    thresh = micro_read_xla_min_batch()
+    assert thresh == 16                       # the measured r5 boundary
+
+    def bare(rows):
+        return resolve_impl(q_seq=1, head_dim=128, padded_ctx=8192,
+                            backend_is_tpu=True, rows=rows, fused=False)
 
     # the measured r5 points: batch 8 pallas-wins, batch 32 xla-wins
-    assert micro_read_impl(8) == "pallas"
-    assert micro_read_impl(32) == "xla"
-    assert micro_read_impl(MICRO_READ_XLA_MIN_BATCH) == "xla"
-    assert micro_read_impl(MICRO_READ_XLA_MIN_BATCH - 1) == "pallas"
+    assert bare(8) == "pallas"
+    assert bare(32) == "xla"
+    assert bare(thresh) == "xla"
+    assert bare(thresh - 1) == "pallas"
+    # env var is an override, not the source of the default
+    monkeypatch.setenv("MICRO_READ_XLA_MIN_BATCH", "4")
+    assert micro_read_xla_min_batch() == 4
+    assert bare(4) == "xla"
+    monkeypatch.delenv("MICRO_READ_XLA_MIN_BATCH")
     # serving's label comes from the model-level dispatch, and on TPU
     # shapes it selects the FUSED kernel (the micro crossover is about
-    # the non-fused bench variant only)
+    # the non-fused bench variant only — row count never flips serving)
     assert resolve_impl(q_seq=1, head_dim=128, padded_ctx=8192,
-                        backend_is_tpu=True) == "pallas"
+                        backend_is_tpu=True, rows=64) == "pallas"
     assert resolve_impl(q_seq=1, head_dim=128, padded_ctx=8192,
                         backend_is_tpu=False) == "xla"
 
 
-def test_cancel_aborts_chunked_admission():
-    """A cancel landing while a long prompt is mid chunk-interleaved
-    prefill must abort the admission (freeing its slot and staged
-    blocks), not burn the remaining chunks for an abandoned client."""
+@pytest.mark.parametrize("ragged", [True, False])
+def test_cancel_aborts_chunked_admission(ragged):
+    """A cancel landing while a long prompt is mid prefill must abort the
+    admission (freeing its slot and staged blocks), not burn the remaining
+    chunks for an abandoned client — on BOTH the ragged path (chunk rows
+    riding shared rounds, the default) and the legacy chunk-interleaved
+    path."""
     import asyncio
 
     from distributed_gpu_inference_tpu.runtime.batcher import (
@@ -347,7 +366,8 @@ def test_cancel_aborts_chunked_admission():
     )
 
     async def go():
-        b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=1.0))
+        b = ContinuousBatcher(eng, BatcherConfig(max_wait_ms=1.0,
+                                                 ragged=ragged))
         b.start()
         cancel = threading.Event()
         fut = asyncio.ensure_future(b.submit(
@@ -358,9 +378,11 @@ def test_cancel_aborts_chunked_admission():
             cancel=cancel,
         ))
         deadline = time.time() + 20.0
-        while b._chunked is None and time.time() < deadline:
+        while b._chunked is None and not b._ragged \
+                and time.time() < deadline:
             await asyncio.sleep(0.005)
-        assert b._chunked is not None, "chunked admission never started"
+        assert b._chunked is not None or b._ragged, \
+            "admission never started"
         cancel.set()
         resp = await fut
         stats = dict(b.stats)
