@@ -277,6 +277,345 @@ def _summarize(results: List[Dict[str, Any]], elapsed: float,
     }
 
 
+# ---------------------------------------------------------------------------
+# multi-worker fleet mode (round 7): cache-aware routing A/B over ≥2 live
+# engines behind the REAL control plane — requests discover their worker
+# through /jobs/direct/nearest (prefix-fingerprinted), workers advertise
+# radix summaries over authenticated heartbeats, and the routing flag is
+# flipped LIVE via the admin remote-config endpoint between legs.
+# ---------------------------------------------------------------------------
+
+
+class FleetMember:
+    """One live engine + direct server, registered with the control plane
+    and heartbeating radix summaries like a production worker."""
+
+    def __init__(self, llm: Any, region: str = "us-west") -> None:
+        from distributed_gpu_inference_tpu.worker.direct_server import (
+            DirectServer,
+        )
+
+        self.llm = llm
+        self.region = region
+        self.server = DirectServer(BenchWorker(llm), host="127.0.0.1",
+                                   port=0)
+        self.server.start()
+        port = self.server._runner.addresses[0][1]
+        self.url = f"http://127.0.0.1:{port}"
+        self.worker_id: Optional[str] = None
+        self.token: Optional[str] = None
+
+    def register(self, client: Any, plane_url: str) -> None:
+        r = client.post(f"{plane_url}/api/v1/workers/register", json={
+            "name": f"bench-{self.url.rsplit(':', 1)[-1]}",
+            "region": self.region,
+            "supported_types": ["llm"],
+            "supports_direct": True,
+            "direct_url": self.url,
+        })
+        r.raise_for_status()
+        data = r.json()
+        self.worker_id = data["worker_id"]
+        self.token = data["auth_token"]
+
+    def heartbeat(self, client: Any, plane_url: str) -> None:
+        es: Dict[str, Any] = {}
+        stats = self.llm.serving_stats() or {}
+        es["batcher"] = {
+            "active_slots": stats.get("active_slots", 0),
+            "queue_depth": stats.get("queue_depth", 0),
+            "avg_occupancy": stats.get("avg_occupancy", 0.0),
+            "capacity": int(self.llm.engine.cfg.max_batch_size),
+        }
+        summary = self.llm.prefix_summary_wire()
+        if summary is not None:
+            es["prefix_summary"] = summary
+        if self.llm.prefix_hot is not None:
+            es["prefix_summary_live"] = True
+        try:
+            r = client.post(
+                f"{plane_url}/api/v1/workers/{self.worker_id}/heartbeat",
+                json={"status": "idle", "engine_stats": es},
+                headers={"Authorization": f"Bearer {self.token}"},
+            )
+            if summary is not None:
+                # mirror worker/main.py: ack ONLY on an explicit
+                # "applied" answer — an absent key means the server never
+                # processed the payload (acking would commit a phantom
+                # base and route on stale summaries)
+                if r.status_code == 200 and \
+                        r.json().get("prefix_summary_resync") is False:
+                    self.llm.prefix_summary_ack()
+                else:
+                    self.llm.prefix_summary_resync()
+        except Exception:  # noqa: BLE001 — bench heartbeat loss is fine
+            if summary is not None:
+                self.llm.prefix_summary_resync()
+
+    def reset_cache(self) -> None:
+        """Cold-cache boundary between A/B legs: every leg starts with an
+        empty prefix cache, an empty ADVERTISED summary (the first
+        heartbeat round of the next leg ships the deletions, so no leg
+        routes on the previous leg's summaries), and zeroed counters."""
+        eng = self.llm.engine
+        self.llm.serving.run_exclusive(
+            lambda: eng.manager.clear_cached()
+        )
+        if self.llm.prefix_hot is not None:
+            self.llm.prefix_hot.clear()
+        # the wipe above may count as evictions; re-anchor so the next
+        # wire() doesn't ALSO drop freshly-noted entries
+        self.llm._prefix_evictions_seen = int(eng.manager.stats.evictions
+                                              or 0)
+        st = eng.manager.stats
+        st.prefix_queries = 0
+        st.prefix_hit_tokens = 0
+        st.prefix_total_tokens = 0
+
+    def cache_stats(self) -> Dict[str, Any]:
+        s = self.llm.engine.manager.stats
+        return {
+            "prefix_queries": s.prefix_queries,
+            "prefix_hit_tokens": s.prefix_hit_tokens,
+            "prefix_total_tokens": s.prefix_total_tokens,
+        }
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.llm.unload()
+
+
+async def _drive_fleet(plane_url: str, members: List["FleetMember"],
+                       workload: Any, hb_interval_s: float,
+                       ) -> Tuple[List[Dict[str, Any]], float]:
+    """Replay one workload leg against the fleet: every request discovers
+    its worker through the control plane (prefix-fingerprinted), honoring
+    open-loop arrivals AND conversation turn dependencies."""
+    import httpx
+
+    from distributed_gpu_inference_tpu.utils.prefixes import (
+        prefix_fingerprints,
+    )
+
+    done_events: Dict[str, asyncio.Event] = {
+        r.id: asyncio.Event() for r in workload.requests
+    }
+    done_at: Dict[str, float] = {}
+    t0 = time.perf_counter()
+    async with httpx.AsyncClient(timeout=600.0) as client:
+        stop_hb = asyncio.Event()
+
+        async def hb_loop() -> None:
+            # authenticated worker heartbeats on a thread (sync httpx via
+            # to_thread keeps engine-side summary locks off the loop)
+            sync_client = httpx.Client(timeout=30.0)
+            try:
+                while not stop_hb.is_set():
+                    for m in members:
+                        await asyncio.to_thread(
+                            m.heartbeat, sync_client, plane_url
+                        )
+                    try:
+                        await asyncio.wait_for(
+                            stop_hb.wait(), hb_interval_s
+                        )
+                    except asyncio.TimeoutError:
+                        pass
+            finally:
+                sync_client.close()
+
+        async def one(req: Any) -> Dict[str, Any]:
+            now = time.perf_counter() - t0
+            if req.arrival_s > now:
+                await asyncio.sleep(req.arrival_s - now)
+            if req.depends_on is not None:
+                await done_events[req.depends_on].wait()
+                wait_until = done_at[req.depends_on] + req.think_s
+                now = time.perf_counter() - t0
+                if wait_until > now:
+                    await asyncio.sleep(wait_until - now)
+            fps = prefix_fingerprints(req.prompt)
+            out: Dict[str, Any] = {"id": req.id, "tenant": req.tenant,
+                                   "conversation": req.conversation}
+            try:
+                # one retry on transport errors: think-time gaps idle the
+                # keep-alive connections, and the server closing one races
+                # the client reusing it (greedy outputs are deterministic,
+                # so a replayed inference is byte-identical)
+                for attempt in (0, 1):
+                    try:
+                        t_req = time.perf_counter()
+                        d = await client.get(
+                            f"{plane_url}/api/v1/jobs/direct/nearest",
+                            params={"prefix_fps": ",".join(fps)}
+                            if fps else None,
+                        )
+                        if d.status_code != 200:
+                            out["status"] = d.status_code
+                            return out
+                        disc = d.json()
+                        r = await client.post(
+                            disc["direct_url"] + "/inference", json={
+                                "type": "llm",
+                                "params": {"prompt": req.prompt,
+                                           "max_new_tokens": req.max_tokens,
+                                           "priority": req.priority},
+                            })
+                        break
+                    except httpx.TransportError:
+                        if attempt:
+                            out["status"] = 599
+                            return out
+                out["status"] = r.status_code
+                out["e2e_ms"] = (time.perf_counter() - t_req) * 1000.0
+                out["worker_id"] = disc["worker_id"]
+                if r.status_code == 200:
+                    res = r.json().get("result") or {}
+                    out["ttft_ms"] = res.get("ttft_ms")
+                    out["text"] = res.get("text")
+                    out["completion_tokens"] = (
+                        (res.get("usage") or {}).get("completion_tokens")
+                        or 0
+                    )
+            finally:
+                done_at[req.id] = time.perf_counter() - t0
+                done_events[req.id].set()
+            return out
+
+        # one COMPLETED heartbeat round before the first discovery, so
+        # leg ON starts with this leg's summaries registered instead of
+        # routing on whatever the previous leg left behind
+        first_hb = httpx.Client(timeout=30.0)
+        try:
+            for m in members:
+                await asyncio.to_thread(m.heartbeat, first_hb, plane_url)
+        finally:
+            first_hb.close()
+        hb = asyncio.create_task(hb_loop())
+        results = list(await asyncio.gather(
+            *(one(r) for r in workload.requests)
+        ))
+        stop_hb.set()
+        await hb
+    return results, time.perf_counter() - t0
+
+
+def _fleet_leg_summary(results: List[Dict[str, Any]], elapsed: float,
+                       members: List["FleetMember"]) -> Dict[str, Any]:
+    base = _summarize(results, elapsed, 0.0)
+    ok = [r for r in results if r.get("status") == 200]
+    ttfts = [r["ttft_ms"] for r in ok if r.get("ttft_ms") is not None]
+    if ttfts:
+        base["ttft_ms"]["mean"] = round(sum(ttfts) / len(ttfts), 2)
+    hit = sum(m.cache_stats()["prefix_hit_tokens"] for m in members)
+    total = sum(m.cache_stats()["prefix_total_tokens"] for m in members)
+    by_worker: Dict[str, int] = {}
+    for r in results:
+        if r.get("worker_id"):
+            by_worker[r["worker_id"]] = by_worker.get(r["worker_id"], 0) + 1
+    base.update({
+        "prefix_hit_rate": round(hit / total, 4) if total else 0.0,
+        "re_prefill_tokens_saved": int(hit),
+        "requests_by_worker": by_worker,
+    })
+    return base
+
+
+def run_fleet(args: Any, backend: str, model: str) -> None:
+    from distributed_gpu_inference_tpu.testing.harness import (
+        LiveControlPlane,
+    )
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+    import httpx
+
+    from benchmarks.workloads import generate
+
+    wl = generate(args.scenario, args.seed, requests=args.requests,
+                  max_tokens=args.max_tokens, rate=float(args.arrival_rate)
+                  if args.arrival_rate else 2.0)
+    max_prompt = max(len(r.prompt) for r in wl.requests)
+    members: List[FleetMember] = []
+    with LiveControlPlane() as plane:
+        client = httpx.Client(timeout=60.0)
+        try:
+            for _ in range(args.workers):
+                llm = TPULLMEngine({
+                    "model": model,
+                    "max_batch_size": args.concurrency,
+                    "max_seq_len": max_prompt + args.max_tokens + 16,
+                    "quantization": args.quantization,
+                    "serving": {
+                        "queue_limit": max(4096, args.requests * 2),
+                        "default_timeout_s": 600.0,
+                    },
+                })
+                llm.load_model()
+                m = FleetMember(llm)
+                m.register(client, plane.url)
+                members.append(m)
+
+            def leg(label: str) -> Dict[str, Any]:
+                for m in members:
+                    m.reset_cache()
+                results, elapsed = asyncio.run(_drive_fleet(
+                    plane.url, members, wl,
+                    hb_interval_s=args.fleet_heartbeat_s,
+                ))
+                out = _fleet_leg_summary(results, elapsed, members)
+                out["outputs"] = {
+                    r["id"]: r.get("text") for r in results
+                    if r.get("status") == 200
+                }
+                return out
+
+            # warmup replay: compile every graph both legs will use, so
+            # neither leg bills XLA compiles to TTFT
+            leg("warmup")
+            routed = leg("routing_on")
+            # the A/B flip a fleet operator would do: flip the LIVE
+            # control plane's routing term via the admin endpoint —
+            # workers untouched, summaries keep flowing
+            client.put(f"{plane.url}/api/v1/admin/routing",
+                       json={"enabled": False}).raise_for_status()
+            blind = leg("routing_off")
+            client.put(f"{plane.url}/api/v1/admin/routing",
+                       json={"enabled": True}).raise_for_status()
+
+            identical = routed.pop("outputs") == blind.pop("outputs")
+            out = {
+                "benchmark": "worker_serving_fleet",
+                "path": "control_plane+direct_nearest+batcher_engines",
+                "scenario": args.scenario, "seed": args.seed,
+                "workers": args.workers, "model": model,
+                "backend": backend, "requests": len(wl.requests),
+                "concurrency": args.concurrency,
+                "max_tokens": args.max_tokens,
+                "routing_on": routed, "routing_off": blind,
+                "outputs_identical": identical,
+            }
+            ratios: Dict[str, Any] = {}
+            for pct in ("mean", "p50", "p95"):
+                r_t = (routed["ttft_ms"] or {}).get(pct)
+                b_t = (blind["ttft_ms"] or {}).get(pct)
+                if r_t and b_t:
+                    ratios[f"ttft_{pct}_routed_over_blind"] = round(
+                        r_t / b_t, 3
+                    )
+            ratios["hit_rate_routed"] = routed["prefix_hit_rate"]
+            ratios["hit_rate_blind"] = blind["prefix_hit_rate"]
+            ratios["re_prefill_tokens_saved_delta"] = (
+                routed["re_prefill_tokens_saved"]
+                - blind["re_prefill_tokens_saved"]
+            )
+            out["routing_vs_blind"] = ratios
+            emit(out)
+        finally:
+            client.close()
+            for m in members:
+                m.stop()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None)
@@ -305,10 +644,27 @@ def main() -> None:
                     "ignored) against the knob-tuned legacy admission "
                     "path on the same live engine (serving.ragged=false "
                     "pushed between legs) and emit ragged/legacy ratios")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="≥2 stands up a FLEET behind a live control "
+                    "plane and A/Bs cache-aware routing (admin flag "
+                    "flipped live) on a seeded multi-tenant workload")
+    ap.add_argument("--scenario", default="chat",
+                    choices=["chat", "rag", "bursty", "priority"],
+                    help="fleet-mode workload (benchmarks/workloads.py)")
+    ap.add_argument("--fleet-heartbeat-s", type=float, default=0.5,
+                    help="fleet-mode worker heartbeat cadence (summaries "
+                    "ride heartbeats; production uses 30s)")
     add_platform_arg(ap)
     args = ap.parse_args()
 
     backend, model = resolve_backend_model(args)
+
+    if args.workers >= 2:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--workers fleet mode takes a single --arrival-rate "
+                     "(rate sweeps are a single-engine mode feature)")
+        run_fleet(args, backend, model)
+        return
 
     from distributed_gpu_inference_tpu.worker.direct_server import (
         DirectServer,

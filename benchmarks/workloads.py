@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Seeded multi-tenant workload generator — the traces production serving
+actually sees, for measuring cache-aware routing (and any future cluster
+bench) honestly.
+
+Every scenario produces a deterministic, seed-stable open-loop trace: the
+same ``(scenario, seed, knobs)`` always generates byte-identical requests
+and arrival times, so two benchmark legs (routing ON vs OFF, ragged vs
+legacy, one replica vs four) replay the EXACT same offered load.
+
+Scenarios:
+
+- ``chat``     multi-turn conversations with growing shared prefixes: each
+               tenant has a system prompt shared by all its conversations;
+               each turn's prompt is the previous turn's prompt plus an
+               assistant stub and a fresh user message — the prefix a
+               radix cache (and a locality router) can reuse grows every
+               turn. Turn k+1 depends on turn k (``depends_on`` + think
+               time): an open-loop driver must not fire a turn before its
+               predecessor's reply exists.
+- ``rag``      single-shot requests with long, heterogeneous prompts: a
+               document context drawn from a small shared corpus (the
+               cacheable part) plus a unique query; prompt lengths are
+               lognormal — the long tail is the point.
+- ``bursty``   the chat mix, but tenant arrivals modulate through on/off
+               bursts (a tenant's whole fleet goes quiet, then floods) —
+               the schedule a locality router must not melt under.
+- ``priority`` the rag mix across two tenant tiers: interactive (high
+               priority, low rate) over batch (priority 0, high rate) —
+               exercises the admission heap + affinity together.
+
+Usage (CLI emits JSONL for external drivers; ``generate()`` is the
+library surface ``benchmarks/worker_serving.py --workers`` drives):
+
+    python -m benchmarks.workloads --scenario chat --seed 0
+    python -m benchmarks.workloads --scenario rag --seed 3 --requests 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+_LETTERS = "abcdefghijklmnopqrstuvwxyz"
+
+
+def _text(rng: np.random.Generator, n: int) -> str:
+    """Deterministic ASCII filler (ByteTokenizer: one token per char)."""
+    return "".join(_LETTERS[i] for i in rng.integers(0, 26, int(n)))
+
+
+@dataclass
+class WorkloadRequest:
+    """One trace entry. ``arrival_s`` is the open-loop offset from trace
+    start; when ``depends_on`` is set the driver must additionally wait
+    for that request's completion plus ``think_s`` (multi-turn chat —
+    a turn cannot be typed before the previous reply renders)."""
+
+    id: str
+    arrival_s: float
+    tenant: str
+    prompt: str
+    max_tokens: int
+    priority: int = 0
+    conversation: Optional[str] = None
+    turn: int = 0
+    depends_on: Optional[str] = None
+    think_s: float = 0.0
+
+
+@dataclass
+class Workload:
+    scenario: str
+    seed: int
+    requests: List[WorkloadRequest]
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        return max((r.arrival_s for r in self.requests), default=0.0)
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(asdict(r)) for r in self.requests)
+
+
+def _chat(rng: np.random.Generator, *, requests: int, tenants: int,
+          turns: int, rate: float, system_len: int, turn_len: int,
+          max_tokens: int, think_s: float,
+          priority_for: Optional[Dict[str, int]] = None) -> List[WorkloadRequest]:
+    n_convs = max(1, requests // max(1, turns))
+    out: List[WorkloadRequest] = []
+    sys_prompts = {
+        f"t{t}": _text(rng, system_len) for t in range(tenants)
+    }
+    conv_starts = np.cumsum(rng.exponential(1.0 / rate, n_convs))
+    for c in range(n_convs):
+        tenant = f"t{int(rng.integers(0, tenants))}"
+        conv = f"c{c}"
+        history = sys_prompts[tenant]
+        prev_id: Optional[str] = None
+        # turns arrive dependency-chained; arrival_s spaces conversations
+        at = float(conv_starts[c])
+        for k in range(turns):
+            if len(out) >= requests:
+                return out
+            user = _text(rng, turn_len)
+            prompt = history + user
+            rid = f"{conv}.{k}"
+            out.append(WorkloadRequest(
+                id=rid, arrival_s=round(at, 4), tenant=tenant,
+                prompt=prompt, max_tokens=max_tokens,
+                priority=(priority_for or {}).get(tenant, 0),
+                conversation=conv, turn=k, depends_on=prev_id,
+                think_s=round(float(rng.uniform(0.5, 1.5) * think_s), 4)
+                if prev_id is not None else 0.0,
+            ))
+            # the assistant stub stands in for the reply the client would
+            # echo back — deterministic, so the grown prefix is stable
+            history = prompt + "|" + _text(rng, max_tokens // 2) + "|"
+            prev_id = rid
+    return out
+
+
+def _rag(rng: np.random.Generator, *, requests: int, tenants: int,
+         rate: float, corpus_docs: int, doc_len: int, query_len: int,
+         max_tokens: int,
+         priority_for: Optional[Dict[str, int]] = None) -> List[WorkloadRequest]:
+    corpus = [_text(rng, max(32, int(rng.lognormal(np.log(doc_len), 0.5))))
+              for _ in range(corpus_docs)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, requests))
+    out: List[WorkloadRequest] = []
+    for i in range(requests):
+        tenant = f"t{int(rng.integers(0, tenants))}"
+        # zipf-ish doc popularity: a few hot docs dominate — the shareable
+        # prefix mass a locality router exists for
+        doc = corpus[min(corpus_docs - 1,
+                         int(rng.zipf(1.5)) - 1)]
+        out.append(WorkloadRequest(
+            id=f"r{i}", arrival_s=round(float(arrivals[i]), 4),
+            tenant=tenant, prompt=doc + _text(rng, query_len),
+            max_tokens=max_tokens,
+            priority=(priority_for or {}).get(tenant, 0),
+        ))
+    return out
+
+
+def generate(scenario: str, seed: int = 0, *, requests: int = 32,
+             tenants: int = 4, turns: int = 4, rate: float = 2.0,
+             system_len: int = 256, turn_len: int = 64,
+             doc_len: int = 512, query_len: int = 64,
+             corpus_docs: int = 6, max_tokens: int = 32,
+             think_s: float = 0.2) -> Workload:
+    """Build one seed-stable trace. All randomness flows from ONE
+    ``np.random.default_rng(seed)`` consumed in a fixed order — adding a
+    scenario must never reorder draws inside an existing one."""
+    rng = np.random.default_rng(seed)
+    kw: Dict[str, Any] = {}
+    if scenario == "chat":
+        reqs = _chat(rng, requests=requests, tenants=tenants, turns=turns,
+                     rate=rate, system_len=system_len, turn_len=turn_len,
+                     max_tokens=max_tokens, think_s=think_s)
+    elif scenario == "rag":
+        reqs = _rag(rng, requests=requests, tenants=tenants, rate=rate,
+                    corpus_docs=corpus_docs, doc_len=doc_len,
+                    query_len=query_len, max_tokens=max_tokens)
+    elif scenario == "bursty":
+        # chat arrivals pushed through per-tenant on/off bursts: each
+        # conversation's start is delayed to its tenant's next ON window
+        reqs = _chat(rng, requests=requests, tenants=tenants, turns=turns,
+                     rate=rate * 2.0, system_len=system_len,
+                     turn_len=turn_len, max_tokens=max_tokens,
+                     think_s=think_s)
+        period = {f"t{t}": float(rng.uniform(2.0, 6.0))
+                  for t in range(tenants)}
+        duty = {f"t{t}": float(rng.uniform(0.3, 0.7))
+                for t in range(tenants)}
+        for r in reqs:
+            p, d = period[r.tenant], duty[r.tenant]
+            phase = r.arrival_s % p
+            if phase > p * d:   # OFF window: shift to the next ON edge
+                r.arrival_s = round(r.arrival_s + (p - phase), 4)
+        kw["burst_period_s"] = period
+    elif scenario == "priority":
+        tiers = {f"t{t}": (10 if t < max(1, tenants // 4) else 0)
+                 for t in range(tenants)}
+        reqs = _rag(rng, requests=requests, tenants=tenants, rate=rate,
+                    corpus_docs=corpus_docs, doc_len=doc_len,
+                    query_len=query_len, max_tokens=max_tokens,
+                    priority_for=tiers)
+        kw["priority_tiers"] = tiers
+    else:
+        raise ValueError(
+            f"unknown scenario {scenario!r} "
+            "(chat | rag | bursty | priority)"
+        )
+    return Workload(
+        scenario=scenario, seed=seed, requests=reqs,
+        meta={"requests": len(reqs), "tenants": tenants, "rate": rate,
+              "max_tokens": max_tokens, **kw},
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scenario", default="chat",
+                    choices=["chat", "rag", "bursty", "priority"])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--tenants", type=int, default=4)
+    ap.add_argument("--turns", type=int, default=4)
+    ap.add_argument("--rate", type=float, default=2.0,
+                    help="open-loop arrival rate (req/s or conv/s)")
+    ap.add_argument("--system-len", type=int, default=256)
+    ap.add_argument("--turn-len", type=int, default=64)
+    ap.add_argument("--doc-len", type=int, default=512)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--summary", action="store_true",
+                    help="print meta only, not the JSONL trace")
+    args = ap.parse_args()
+    wl = generate(args.scenario, args.seed, requests=args.requests,
+                  tenants=args.tenants, turns=args.turns, rate=args.rate,
+                  system_len=args.system_len, turn_len=args.turn_len,
+                  doc_len=args.doc_len, max_tokens=args.max_tokens)
+    if args.summary:
+        print(json.dumps({"scenario": wl.scenario, "seed": wl.seed,
+                          "duration_s": round(wl.duration_s, 3),
+                          **wl.meta}))
+    else:
+        print(wl.to_jsonl())
+
+
+if __name__ == "__main__":
+    main()

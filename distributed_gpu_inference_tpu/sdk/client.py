@@ -25,8 +25,19 @@ import httpx
 
 from ..testing import faults as _faults
 from ..utils.backoff import full_jitter_delay
+from ..utils.prefixes import (
+    canonical_prompt_text,
+    fingerprints_for_params,
+    prefix_fingerprints,
+)
 
 DIRECT_CACHE_TTL_S = 60.0  # reference inference_client.py:284-306
+# sticky session→worker routing cache: kept SHORT (same staleness budget
+# as the generic direct cache) because a sticky hit skips the server's
+# load-spillover ranking — the pin must expire before a saturated worker
+# can accumulate conversations the fleet should absorb
+SESSION_CACHE_TTL_S = 60.0
+_SESSION_CACHE_MAX = 1024
 
 
 class InferenceClientError(Exception):
@@ -91,6 +102,11 @@ class InferenceClient:
         self._client = httpx.Client(timeout=timeout_s, transport=transport)
         self._direct_cache: Optional[Dict[str, Any]] = None
         self._direct_cache_at = 0.0
+        # cache-aware routing: session → (worker, ts) sticky cache. A
+        # conversation keeps landing on the worker already holding its
+        # KV prefix without re-asking the control plane every turn; any
+        # failure drops the entry and rediscovers (affinity, never a pin).
+        self._session_workers: Dict[str, tuple] = {}
 
     def close(self) -> None:
         self._client.close()
@@ -282,6 +298,31 @@ class InferenceClient:
 
     # -- task helpers (reference :104-221) -----------------------------------
 
+    @staticmethod
+    def _routing_fps(params: Dict[str, Any],
+                     prefix_hint: Optional[str]) -> List[str]:
+        """Boundary fingerprints for cache-aware routing: the explicit
+        ``prefix_hint`` (e.g. a shared system prompt) when given, else the
+        request's own prompt/messages — same canonicalization and hash as
+        the control plane and the workers (``utils/prefixes.py``)."""
+        if prefix_hint is not None:
+            if not prefix_hint:
+                return []
+            if params.get("messages"):
+                # workers fingerprint the CANONICAL message text
+                # ("role\x1fcontent\x1e..."), so a raw-text hint would
+                # never match — wrap it as the leading system message it
+                # names, whose canonical form IS a prefix of the
+                # request's canonical text
+                return prefix_fingerprints(canonical_prompt_text(
+                    [{"role": "system", "content": prefix_hint}]
+                ))
+            return prefix_fingerprints(canonical_prompt_text(prefix_hint))
+        # no hint: same messages-over-prompt precedence as the server's
+        # fallback computation — ONE implementation, so client- and
+        # server-side fingerprints of a request can never drift
+        return fingerprints_for_params(params)
+
     def chat(
         self,
         messages: Optional[List[Dict[str, str]]] = None,
@@ -291,11 +332,22 @@ class InferenceClient:
         use_direct: bool = False,
         timeout_s: float = 120.0,
         priority: int = 0,
+        session: Optional[str] = None,
+        prefix_hint: Optional[str] = None,
         **gen_params: Any,
     ) -> Dict[str, Any]:
         """``priority``: scheduling priority — orders the control-plane
         queue AND the worker batcher's admission heap (higher admits
-        first; KV-pressure victims are picked lowest-priority-first)."""
+        first; KV-pressure victims are picked lowest-priority-first).
+
+        Cache-aware routing: ``session`` makes direct mode sticky — every
+        call with the same session id prefers the worker that served the
+        last one (whose radix cache holds the conversation's KV), falling
+        back to rediscovery on any failure. ``prefix_hint`` names the
+        shared prefix (a system prompt, a RAG document header) to
+        fingerprint for affinity routing; without it the prompt/messages
+        fingerprint themselves. Both are advisory — results are identical
+        wherever the request lands."""
         params: Dict[str, Any] = dict(gen_params)
         if messages is not None:
             params["messages"] = messages
@@ -305,13 +357,16 @@ class InferenceClient:
             params["model"] = model
         if priority:
             params["priority"] = int(priority)
+        fps = self._routing_fps(params, prefix_hint)
         if use_direct:
-            result = self._try_direct("llm", params)
+            result = self._try_direct("llm", params, prefix_fps=fps,
+                                      session=session)
             if result is not None:
                 return result
         return self._run_job("llm", params, sync=sync, timeout_s=timeout_s,
                              **({"priority": int(priority)} if priority
-                                else {}))
+                                else {}),
+                             **({"prefix_fps": fps} if fps else {}))
 
     def generate_image(self, prompt: str, sync: bool = True,
                        timeout_s: float = 300.0,
@@ -343,6 +398,8 @@ class InferenceClient:
         timeout_s: float = 300.0,
         max_stream_resumes: int = 3,
         priority: int = 0,
+        session: Optional[str] = None,
+        prefix_hint: Optional[str] = None,
         **gen_params: Any,
     ):
         """Token streaming via the nearest direct worker's SSE endpoint.
@@ -390,10 +447,12 @@ class InferenceClient:
         failed_workers: List[str] = []
         last_err: Any = None
 
+        fps = self._routing_fps(params, prefix_hint)
         while True:
             resuming = yielded
             worker = self._get_nearest_worker(
-                exclude=failed_workers or None
+                exclude=failed_workers or None,
+                prefix_fps=fps, session=session,
             )
             if worker is None:
                 if resuming:
@@ -510,6 +569,7 @@ class InferenceClient:
                 if wid and wid not in failed_workers:
                     failed_workers.append(wid)
             self._direct_cache = None
+            self._drop_session_worker(session)
             # jittered backoff between resume attempts (Retry-After as the
             # floor on a busy answer) — no zero-delay stampede at the very
             # worker fleet the first failure just destabilized
@@ -524,30 +584,74 @@ class InferenceClient:
     # -- direct mode (reference :284-329) ------------------------------------
 
     def _get_nearest_worker(
-        self, exclude: Optional[Sequence[str]] = None
+        self, exclude: Optional[Sequence[str]] = None,
+        prefix_fps: Optional[Sequence[str]] = None,
+        session: Optional[str] = None,
     ) -> Optional[Dict[str, Any]]:
         now = time.time()
-        if not exclude and self._direct_cache is not None and \
-                now - self._direct_cache_at < DIRECT_CACHE_TTL_S:
+        if session and not exclude:
+            cached = self._session_workers.get(session)
+            if cached is not None and now - cached[1] < SESSION_CACHE_TTL_S:
+                return cached[0]
+        if not exclude and not prefix_fps and self._direct_cache is not None \
+                and now - self._direct_cache_at < DIRECT_CACHE_TTL_S:
             return self._direct_cache
+        query: Dict[str, str] = {}
+        if exclude:
+            # exclude: workers the caller just watched fail — a failover
+            # reconnect must not land on the corpse
+            query["exclude"] = ",".join(exclude)
+        if prefix_fps:
+            # cache-aware routing: the control plane ranks direct workers
+            # by advertised prefix affinity (load-spillover-scaled)
+            query["prefix_fps"] = ",".join(prefix_fps)
         try:
             resp = self._request(
                 "GET", "/api/v1/jobs/direct/nearest",
-                # exclude: workers the caller just watched fail — a
-                # failover reconnect must not land on the corpse
-                params={"exclude": ",".join(exclude)} if exclude else None,
+                params=query or None,
             )
         except InferenceClientError:
             return None
-        self._direct_cache = resp.json()
-        self._direct_cache_at = now
-        return self._direct_cache
+        worker = resp.json()
+        if session:
+            if len(self._session_workers) >= _SESSION_CACHE_MAX:
+                # evict expired entries first, oldest-inserted as fallback
+                cutoff = now - SESSION_CACHE_TTL_S
+                for k in [k for k, (_, ts) in self._session_workers.items()
+                          if ts < cutoff]:
+                    del self._session_workers[k]
+                while len(self._session_workers) >= _SESSION_CACHE_MAX:
+                    del self._session_workers[
+                        next(iter(self._session_workers))
+                    ]
+            # pop-then-insert: a refresh must move the session to the
+            # recent end, or capacity eviction would drop the most ACTIVE
+            # session just because it was inserted first
+            self._session_workers.pop(session, None)
+            self._session_workers[session] = (worker, now)
+        if not prefix_fps or "prefix_affinity" not in worker:
+            # the generic cache stays affinity-free: a fingerprinted pick
+            # for one conversation must not leak to unrelated requests.
+            # An answer WITHOUT a prefix_affinity field was not affinity-
+            # ranked (routing disabled server-side, or no summaries) — it
+            # is safe to cache, restoring the one-discovery-per-60s
+            # behavior when the operator turns routing off.
+            self._direct_cache = worker
+            self._direct_cache_at = now
+        return worker
 
-    def _try_direct(self, job_type: str,
-                    params: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    def _drop_session_worker(self, session: Optional[str]) -> None:
+        if session:
+            self._session_workers.pop(session, None)
+
+    def _try_direct(self, job_type: str, params: Dict[str, Any],
+                    prefix_fps: Optional[Sequence[str]] = None,
+                    session: Optional[str] = None
+                    ) -> Optional[Dict[str, Any]]:
         """POST straight to the nearest worker; any failure returns None so
         the caller falls back to the queued path (reference :308-329)."""
-        worker = self._get_nearest_worker()
+        worker = self._get_nearest_worker(prefix_fps=prefix_fps,
+                                          session=session)
         if worker is None:
             return None
         try:
@@ -558,9 +662,11 @@ class InferenceClient:
             )
         except httpx.TransportError:
             self._direct_cache = None
+            self._drop_session_worker(session)
             return None
         if resp.status_code != 200:
             self._direct_cache = None  # busy/draining: rediscover next time
+            self._drop_session_worker(session)
             return None
         return resp.json()["result"]
 

@@ -20,6 +20,7 @@ HMAC request signing; ``X-API-Key`` for the jobs/admin surface.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -27,10 +28,20 @@ from typing import Any, Dict, Optional
 from aiohttp import web
 
 from ..utils.data_structures import JobStatus, WorkerState
+from ..utils.prefixes import fingerprints_for_params, sanitize_fingerprints
 from .geo import GeoService
 from .observability import MetricsCollector, StructuredLogger, TracingManager
+from .prefix_routing import PrefixRegistry, RoutingConfig
 from .reliability import ReliabilityService
-from .scheduler import REGIONS, SmartScheduler, estimate_job_duration_s, region_distance
+from .scheduler import (
+    _MAX_DISTANCE,
+    REGIONS,
+    WEIGHTS,
+    SmartScheduler,
+    estimate_job_duration_s,
+    graded_load_score,
+    region_distance,
+)
 from .security import LockoutState, SecurityService
 from .store import Store
 from .pd_flow import PDFlowError, PDFlowService
@@ -40,6 +51,11 @@ from .privacy import EnterprisePrivacyService
 from .worker_config import WorkerConfigService
 
 API = "/api/v1"
+
+# serialized heartbeat ``engine_stats`` beyond this is dropped (counted:
+# heartbeat_payload_rejected_total{reason="engine_stats_oversize"}) — one
+# misbehaving worker must not bloat the heartbeat path for the fleet
+_ENGINE_STATS_MAX_BYTES = 128 * 1024
 
 
 class ServerState:
@@ -54,7 +70,16 @@ class ServerState:
         self.store = Store(db_path)
         self.security = SecurityService()
         self.reliability = ReliabilityService(self.store)
-        self.scheduler = SmartScheduler(self.store, self.reliability)
+        self.metrics = MetricsCollector()
+        # cache-aware routing: per-worker radix summaries (heartbeat
+        # engine_stats channel) + the live-pushable routing knobs the
+        # scheduler/direct-discovery affinity terms read
+        self.routing = RoutingConfig()
+        self.prefix_registry = PrefixRegistry(self.routing)
+        self.scheduler = SmartScheduler(
+            self.store, self.reliability,
+            prefix_registry=self.prefix_registry, metrics=self.metrics,
+        )
         self.pd_flow = PDFlowService(self.store)
         self.guarantee = TaskGuaranteeService(
             self.store, self.reliability, heartbeat_timeout_s,
@@ -74,7 +99,6 @@ class ServerState:
             self.worker_config.set_submit_queue_limit(submit_queue_limit)
         self.usage = UsageService(self.store)
         self.privacy = EnterprisePrivacyService(self.store)
-        self.metrics = MetricsCollector()
         self.tracing = TracingManager()
         self.log = StructuredLogger("dgi-tpu.server")
         self.api_key = api_key
@@ -391,6 +415,36 @@ async def heartbeat(request: web.Request) -> web.Response:
         # accounting resumes
         fields.setdefault("status", WorkerState.IDLE.value)
         await st.reliability.start_session(worker_id)
+    es = body.get("engine_stats")
+    if isinstance(es, dict):
+        # payload hygiene: the engine_stats side channel is worker-supplied
+        # and unauthenticated in shape — cap its serialized size so one
+        # misbehaving worker cannot bloat the heartbeat path (the summary
+        # channel has its own per-entry cap on top of this)
+        try:
+            oversized = len(json.dumps(es)) > _ENGINE_STATS_MAX_BYTES
+        except (TypeError, ValueError):
+            oversized = True
+        if oversized:
+            st.metrics.record_heartbeat_payload_rejected(
+                "engine_stats_oversize"
+            )
+            es = None
+    else:
+        es = None
+    if es is not None:
+        batcher = es.get("batcher")
+        if isinstance(batcher, dict) and batcher.get("capacity"):
+            # graded load for the scheduler: a batcher-backed worker runs
+            # many jobs concurrently, so the binary BUSY signal lies —
+            # persist the occupancy snapshot the scoring path grades from
+            fields["load_stats"] = {
+                "active_slots": batcher.get("active_slots"),
+                "queue_depth": batcher.get("queue_depth"),
+                "capacity": batcher.get("capacity"),
+                "avg_occupancy": batcher.get("avg_occupancy"),
+                "ts": time.time(),
+            }
     await st.store.update_worker(worker_id, **fields)
     await st.reliability.update_online_pattern(worker_id, online=True)
     cps = body.get("checkpoints")
@@ -407,8 +461,9 @@ async def heartbeat(request: web.Request) -> web.Response:
                 await _ingest_checkpoint(st, worker_id, cp)
             except Exception:  # noqa: BLE001
                 st.metrics.record_checkpoint_rejected("malformed")
-    es = body.get("engine_stats")
-    if isinstance(es, dict):
+    summary_resync = None
+    summary_rejected = False
+    if es is not None:
         # speculation-efficiency counters ride the heartbeat (worker
         # main._spec_engine_stats) → /metrics surfaces accept-rate and
         # tokens-per-step per worker
@@ -421,6 +476,39 @@ async def heartbeat(request: web.Request) -> web.Response:
         batcher = es.get("batcher")
         if isinstance(batcher, dict):
             st.metrics.record_batcher_engine(worker_id, batcher)
+        ps = es.get("prefix_summary")
+        if ps is not None:
+            # cache-aware routing: the worker's advertised radix summary
+            # (full snapshot or delta — runtime/prefix_summary.py wire
+            # format). Validation/caps live in the registry; rejections
+            # are counted and answered, never 500d.
+            await st.prefix_registry.ensure_loaded(st.store)
+            res = st.prefix_registry.ingest(worker_id, ps)
+            summary_resync = res.resync
+            # statically un-ingestable (wire version / fingerprint basis
+            # skew): tell the worker explicitly, so it stops shipping
+            # payloads this plane can never apply instead of ping-ponging
+            # full snapshots forever
+            summary_rejected = (not res.applied and not res.resync)
+            if res.reason and res.reason != "summary_resync":
+                # "summary_resync" is the PROTOCOL-NORMAL recovery path
+                # (plane restart, lost heartbeat) — counting it here would
+                # make the misbehaving-worker counter fire on every
+                # restart; real rejections/truncations only
+                st.metrics.record_heartbeat_payload_rejected(res.reason)
+            if res.applied:
+                try:
+                    await st.prefix_registry.persist(worker_id, st.store)
+                except Exception:  # noqa: BLE001 — persistence is warm-
+                    pass           # start comfort, never heartbeat-fatal
+    if es is not None and es.get("prefix_summary_live"):
+        # the worker declares its summary channel alive this beat (wire()
+        # returns None while in sync, so no payload ≠ no summary): keep
+        # its advertised state fresh — staleness means "stopped
+        # heartbeating / restarted / channel disabled", not "stopped
+        # serving new prefixes". A restarted worker that no longer ships
+        # summaries omits the marker and ages out within one TTL.
+        st.prefix_registry.touch(worker_id)
     client_version = int(body.get("config_version") or 0)
     changed = await st.worker_config.config_changed_since(
         worker_id, client_version
@@ -428,6 +516,9 @@ async def heartbeat(request: web.Request) -> web.Response:
     return web.json_response({
         "ok": True, "config_changed": changed, "stale_job": stale_job,
         **({"stale_jobs": stale_jobs} if stale_jobs else {}),
+        **({"prefix_summary_resync": summary_resync}
+           if summary_resync is not None else {}),
+        **({"prefix_summary_applied": False} if summary_rejected else {}),
     })
 
 
@@ -801,9 +892,24 @@ async def _make_job_row(request: web.Request, body: Dict[str, Any]
     client_ip = request.headers.get("X-Forwarded-For", request.remote or "")
     client_ip = client_ip.split(",")[0].strip()
     client_region = await st.geo.detect_client_region(client_ip)
+    # cache-aware routing: the job row carries the request's prefix
+    # boundary fingerprints — client-supplied (SDK prefix_hint / auto)
+    # wins, server-side computation from the prompt/messages is the
+    # fallback. Advisory: an empty list just means locality-blind.
+    fps: list = []
+    if st.routing.enabled and (body.get("type") or "llm") == "llm":
+        fps = sanitize_fingerprints(
+            body.get("prefix_fps"), st.routing.max_fps_per_request
+        )
+        if not fps:
+            fps = fingerprints_for_params(
+                body.get("params"), st.routing.block_chars,
+                st.routing.max_fps_per_request,
+            )
     return {
         "type": body.get("type") or "llm",
         "params": body.get("params") or {},
+        **({"prefix_fps": fps} if fps else {}),
         "priority": int(body.get("priority") or 0),
         "preferred_region": body.get("preferred_region") or client_region,
         "allow_cross_region": bool(body.get("allow_cross_region", True)),
@@ -947,22 +1053,79 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
     exclude = {
         e for e in (request.query.get("exclude") or "").split(",") if e
     }
-    workers = await st.store.list_workers(status=[WorkerState.IDLE.value])
+    # batcher-backed workers serve many requests concurrently and report
+    # BUSY while doing so — they stay discoverable as long as their graded
+    # load shows headroom (legacy workers keep the IDLE-only contract)
+    workers = await st.store.list_workers(
+        status=[WorkerState.IDLE.value, WorkerState.BUSY.value]
+    )
+    now = time.time()
+    # grade each worker's load ONCE — the filter, the score loop, and the
+    # sort key all reuse it (graded_load_score json-decodes load_stats)
+    headroom = {w["id"]: graded_load_score(w, now=now) for w in workers}
     cands = [
         w for w in workers
         if w.get("supports_direct") and w.get("direct_url")
         and w["id"] not in exclude
+        and (w.get("status") == WorkerState.IDLE.value
+             or headroom[w["id"]] > 0.0)
     ]
     if not cands:
         return _json_error(404, "no direct workers available")
-    cands.sort(key=lambda w: region_distance(region, w.get("region")))
+    # cache-aware routing: ``prefix_fps`` (comma-separated boundary
+    # fingerprints, SDK-computed) ranks workers by advertised prefix
+    # affinity — load-headroom-scaled so a hot cached replica spills over —
+    # with region distance as the tiebreak. Advisory: no fingerprints (or
+    # routing disabled) keeps the pure region sort.
+    fps = sanitize_fingerprints(
+        [s for s in (request.query.get("prefix_fps") or "").split(",") if s],
+        st.routing.max_fps_per_request,
+    )
+    affinity = {}
+    score = {}
+    if fps and st.routing.enabled:
+        await st.prefix_registry.ensure_loaded(st.store)
+        cfg = st.routing
+        floor = max(0.0, min(1.0, cfg.min_headroom_factor))
+        for w in cands:
+            raw = st.prefix_registry.affinity(w["id"], fps, now=now)
+            head = headroom[w["id"]]
+            affinity[w["id"]] = raw * (floor + (1.0 - floor) * head)
+            # same term balance as SmartScheduler.score_worker (bonus vs
+            # load vs region): the floored bonus of a SATURATED cached
+            # worker stays below an idle cold worker's load term
+            # (spillover is strict), and keeping the region WEIGHT in the
+            # score means a zero-affinity request never crosses regions
+            # over a mere load-headroom delta
+            region_score = 1.0 - region_distance(
+                region, w.get("region")) / _MAX_DISTANCE
+            score[w["id"]] = (
+                cfg.affinity_weight * affinity[w["id"]]
+                + WEIGHTS["load"] * head
+                + WEIGHTS["region"] * region_score
+            )
+    cands.sort(key=lambda w: (
+        -score.get(w["id"], 0.0),
+        region_distance(region, w.get("region")),
+        -headroom[w["id"]],
+    ))
     best = cands[0]
+    if fps and st.routing.enabled:
+        chosen_raw = st.prefix_registry.affinity(best["id"], fps, now=now)
+        best_raw = st.prefix_registry.best_affinity_among(
+            [w["id"] for w in cands], fps, now=now,
+        )
+        st.metrics.record_prefix_route(
+            "direct", hit=chosen_raw > 0.0, spillover=best_raw > chosen_raw,
+        )
     return web.json_response(
         {
             "worker_id": best["id"],
             "direct_url": best["direct_url"],
             "region": best["region"],
             "client_region": region,
+            **({"prefix_affinity": round(affinity.get(best["id"], 0.0), 4)}
+               if affinity else {}),
         }
     )
 
@@ -1084,6 +1247,34 @@ async def admin_push_config(request: web.Request) -> web.Response:
     return web.json_response(cfg.to_dict())
 
 
+async def admin_get_routing(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    return web.json_response(st.routing.to_dict())
+
+
+async def admin_put_routing(request: web.Request) -> web.Response:
+    """Live routing A/B switch: flips/retunes the cache-aware routing
+    knobs on the RUNNING control plane (no restart, no worker involvement
+    — summaries keep flowing either way, only the scoring term reads the
+    flag). ``block_chars`` is intentionally NOT pushable: changing the
+    fingerprint basis requires a coordinated fleet restart."""
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    body = await request.json()
+    if not isinstance(body, dict):
+        return _json_error(400, "body must be a JSON object")
+    try:
+        st.routing.update(body)
+    except (TypeError, ValueError) as exc:
+        return _json_error(400, f"bad routing config: {exc}")
+    await st.store.audit("admin_update_routing", actor="admin",
+                         detail=st.routing.to_dict())
+    return web.json_response(st.routing.to_dict())
+
+
 async def admin_realtime(request: web.Request) -> web.Response:
     """Realtime fleet stats (reference admin.py:74-141): worker states by
     region, queue depths, jobs completed/failed in the last hour."""
@@ -1176,6 +1367,8 @@ async def admin_worker_delete(request: web.Request) -> web.Response:
         return _json_error(404, "worker not found")
     await st.guarantee.handle_worker_offline(wid, graceful=False)
     await st.store.delete_worker(wid)
+    st.prefix_registry.drop_worker(wid)
+    await st.store.delete_prefix_summary(wid)
     await st.store.audit("admin_delete_worker", actor="admin",
                          detail={"worker_id": wid})
     return web.json_response({"status": "deleted"})
@@ -1429,6 +1622,11 @@ async def regions(request: web.Request) -> web.Response:
 
 async def metrics_endpoint(request: web.Request) -> web.Response:
     st = _state(request)
+    # refresh summary gauges at SCRAPE time: age must keep climbing for a
+    # worker that stopped advertising — the ingest-time value is ~0 by
+    # construction and would hide exactly the staleness the gauge exposes
+    for wid, n, age in st.prefix_registry.stats_for_metrics():
+        st.metrics.record_prefix_summary(wid, n, age)
     return web.Response(
         body=st.metrics.render(),
         content_type="text/plain",
@@ -1485,6 +1683,8 @@ def create_app(state: Optional[ServerState] = None,
 
     app.router.add_get(f"{API}/admin/stats/dashboard", admin_dashboard)
     app.router.add_get(f"{API}/admin/stats/realtime", admin_realtime)
+    app.router.add_get(f"{API}/admin/routing", admin_get_routing)
+    app.router.add_put(f"{API}/admin/routing", admin_put_routing)
     app.router.add_get(f"{API}/admin/workers", admin_list_workers)
     app.router.add_get(f"{API}/admin/workers/{{worker_id}}",
                        admin_worker_detail)

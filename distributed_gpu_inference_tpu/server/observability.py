@@ -88,6 +88,9 @@ class Metrics:
                 "batcher_decode_rounds", "batcher_completed",
                 "batcher_chunked_admissions", "batcher_preemptions",
                 "batcher_migrated",
+                "prefix_route_hits", "prefix_route_spillover",
+                "prefix_summary_entries", "prefix_summary_age",
+                "heartbeat_payload_rejected",
             ):
                 setattr(self, name, noop)
             return
@@ -226,6 +229,34 @@ class Metrics:
             "batcher_requests_migrated_total",
             "In-flight requests frozen into checkpoints on graceful "
             "drain", ["worker"], registry=r)
+        # cache-aware routing (round 7): hits = placements that landed on
+        # a worker advertising the request's prefix; spillover = requests
+        # whose warmest worker was passed over (load headroom scaling or
+        # claim ordering) — a high spillover rate with low hit rate means
+        # the fleet is too hot for locality to matter.
+        self.prefix_route_hits = Counter(
+            "prefix_route_hits_total",
+            "Requests routed to a worker advertising their prefix",
+            ["path"], registry=r)
+        self.prefix_route_spillover = Counter(
+            "prefix_route_spillover_total",
+            "Requests whose warmest worker was passed over (load "
+            "spillover)", ["path"], registry=r)
+        self.prefix_summary_entries = Gauge(
+            "prefix_summary_entries",
+            "Advertised radix-summary entries per worker", ["worker"],
+            registry=r)
+        self.prefix_summary_age = Gauge(
+            "prefix_summary_age_seconds",
+            "Age of the last accepted radix summary per worker",
+            ["worker"], registry=r)
+        # heartbeat payload hygiene: oversized engine_stats, bad summary
+        # versions, mismatched fingerprint bases — counted, never 500d
+        # (a failing heartbeat gets a LIVE worker swept offline)
+        self.heartbeat_payload_rejected = Counter(
+            "heartbeat_payload_rejected_total",
+            "Heartbeat side-channel payloads rejected or truncated",
+            ["reason"], registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -391,6 +422,25 @@ class MetricsCollector:
             if delta > 0:
                 metric.labels(worker).inc(delta)
             prev[key] = cur
+
+    def record_prefix_route(self, path: str, hit: bool,
+                            spillover: bool = False) -> None:
+        """One routing decision on ``path`` (``direct`` discovery or the
+        ``queued`` claim): hit when the chosen worker advertised the
+        request's prefix, spillover when a warmer worker existed but was
+        passed over."""
+        if hit:
+            self.metrics.prefix_route_hits.labels(path).inc()
+        if spillover:
+            self.metrics.prefix_route_spillover.labels(path).inc()
+
+    def record_prefix_summary(self, worker: str, entries: int,
+                              age_s: float) -> None:
+        self.metrics.prefix_summary_entries.labels(worker).set(entries)
+        self.metrics.prefix_summary_age.labels(worker).set(age_s)
+
+    def record_heartbeat_payload_rejected(self, reason: str) -> None:
+        self.metrics.heartbeat_payload_rejected.labels(reason).inc()
 
     def record_checkpoint(self, worker: str) -> None:
         self.metrics.job_checkpoints.labels(worker).inc()

@@ -17,6 +17,7 @@ than GPU heuristics.
 
 from __future__ import annotations
 
+import json
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -77,15 +78,94 @@ def estimate_job_duration_s(job_type: str, params: Optional[Dict[str, Any]],
     return 10.0
 
 
+# a batcher load snapshot older than this (vs last_heartbeat cadence) is
+# ignored and the binary BUSY signal takes over — a worker that stopped
+# serving through a batcher must not keep its stale headroom forever
+_LOAD_STATS_TTL_S = 120.0
+
+
+def graded_load_score(worker: Dict[str, Any],
+                      now: Optional[float] = None) -> float:
+    """Load headroom in [0, 1]. Batcher-backed workers run MANY jobs
+    concurrently, so the binary current_job_id/BUSY signal reads "full" the
+    moment one request is in flight — grade from the heartbeat batcher
+    snapshot (active slots + queue depth vs the shared-claim capacity)
+    instead, falling back to the binary signal for legacy workers."""
+    ls = worker.get("load_stats")
+    if isinstance(ls, str):
+        try:
+            ls = json.loads(ls)
+        except ValueError:
+            ls = None
+    now = time.time() if now is None else now
+    if isinstance(ls, dict) and ls.get("capacity"):
+        ts = float(ls.get("ts") or 0.0)
+        if now - ts <= _LOAD_STATS_TTL_S:
+            try:
+                active = max(0, int(ls.get("active_slots") or 0))
+                queue = max(0, int(ls.get("queue_depth") or 0))
+                cap = max(1, int(ls.get("capacity") or 1))
+            except (TypeError, ValueError):
+                return _binary_load(worker)
+            # queued work counts double: it is latency ALREADY being paid
+            return max(0.0, 1.0 - (active + 2.0 * queue) / cap)
+    return _binary_load(worker)
+
+
+def _binary_load(worker: Dict[str, Any]) -> float:
+    load = 0.0 if worker.get("current_job_id") else 1.0
+    if worker.get("status") == WorkerState.BUSY.value:
+        load = 0.0
+    return load
+
+
 class SmartScheduler:
     """Scores candidate workers and drives atomic job claims."""
 
     def __init__(self, store: Store,
-                 reliability: Optional[ReliabilityService] = None) -> None:
+                 reliability: Optional[ReliabilityService] = None,
+                 prefix_registry: Optional[Any] = None,
+                 metrics: Optional[Any] = None) -> None:
         self._store = store
         self._reliability = reliability or ReliabilityService(store)
+        # cache-aware routing (server/prefix_routing.py): advisory prefix
+        # affinity — a bounded score bonus and a bounded claim reordering,
+        # never a placement gate
+        self._prefix_registry = prefix_registry
+        self._metrics = metrics
 
     # -- scoring (reference scheduler.py:111-164) ---------------------------
+
+    def _job_fps(self, job: Dict[str, Any]) -> List[str]:
+        fps = job.get("prefix_fps")
+        if isinstance(fps, str):
+            try:
+                fps = json.loads(fps)
+            except ValueError:
+                return []
+        if not isinstance(fps, list):
+            return []
+        return [fp for fp in fps if isinstance(fp, str)]
+
+    def prefix_affinity(self, worker: Dict[str, Any], job: Dict[str, Any],
+                        now: Optional[float] = None) -> float:
+        """Bounded routing bonus: (affinity fraction of the request's
+        prefix this worker advertises) × affinity_weight, scaled DOWN by
+        the worker's load so a hot replica spills over to the fleet
+        instead of starving it. 0 when routing is disabled/unknown."""
+        reg = self._prefix_registry
+        if reg is None or not reg.enabled:
+            return 0.0
+        fps = self._job_fps(job)
+        if not fps:
+            return 0.0
+        aff = reg.affinity(worker["id"], fps, now=now)
+        if aff <= 0.0:
+            return 0.0
+        cfg = reg.config
+        headroom = graded_load_score(worker, now=now)
+        floor = max(0.0, min(1.0, cfg.min_headroom_factor))
+        return cfg.affinity_weight * aff * (floor + (1.0 - floor) * headroom)
 
     def score_worker(self, worker: Dict[str, Any], job: Dict[str, Any],
                      now: Optional[float] = None) -> float:
@@ -103,9 +183,7 @@ class SmartScheduler:
         chips = max(1, int(worker.get("num_chips") or 1))
         perf = min(1.0, perf * (1.0 + 0.05 * (chips - 1)))
 
-        load = 0.0 if worker.get("current_job_id") else 1.0
-        if worker.get("status") == WorkerState.BUSY.value:
-            load = 0.0
+        load = graded_load_score(worker, now=now)
 
         return (
             WEIGHTS["reliability"] * reliability
@@ -113,11 +191,14 @@ class SmartScheduler:
             + WEIGHTS["predicted_online"] * online
             + WEIGHTS["performance"] * perf
             + WEIGHTS["load"] * load
+            + self.prefix_affinity(worker, job, now=now)
         )
 
     async def rank_workers(self, job: Dict[str, Any],
                            now: Optional[float] = None) -> List[Dict[str, Any]]:
         """Eligible workers sorted by descending score."""
+        if self._prefix_registry is not None:
+            await self._prefix_registry.ensure_loaded(self._store)
         cands = await self._store.list_workers(
             status=[WorkerState.IDLE.value, WorkerState.BUSY.value],
             supports_type=job.get("type"),
@@ -138,15 +219,50 @@ class SmartScheduler:
             WorkerState.DRAINING.value,
         ):
             return None
+        prefer = None
+        reg = self._prefix_registry
+        if reg is not None and reg.enabled:
+            # cache-aware claim: within the head priority band (bounded
+            # window — see claim_next_job), prefer the queued job whose
+            # prefix THIS worker advertises. Pure in-memory lookup, safe
+            # inside the claim transaction.
+            await reg.ensure_loaded(self._store)
+
+            def prefer(row: Dict[str, Any]) -> float:  # noqa: F811
+                return reg.affinity(worker_id, self._job_fps(row))
+
         job = await self._store.claim_next_job(
             worker_id,
             supported_types=list(w.get("supported_types") or []),
             region=w.get("region"),
+            prefer=prefer,
         )
         if job is not None:
             await self._store.update_worker(
                 worker_id, current_job_id=job["id"], status=WorkerState.BUSY.value
             )
+            if prefer is not None and self._metrics is not None:
+                fps = self._job_fps(job)
+                if fps:
+                    aff = reg.affinity(worker_id, fps)
+                    # spillover reference: warmest worker ELIGIBLE for
+                    # this job (same scoping as the direct path) — a
+                    # draining/offline/wrong-type worker advertising a
+                    # warm summary is not "passed over". One indexed
+                    # SELECT per claimed fingerprinted job buys an
+                    # operator signal that means what the docs say.
+                    cands = await self._store.list_workers(
+                        status=[WorkerState.IDLE.value,
+                                WorkerState.BUSY.value],
+                        supports_type=job.get("type"),
+                    )
+                    best = reg.best_affinity_among(
+                        [c["id"] for c in cands], fps,
+                    )
+                    self._metrics.record_prefix_route(
+                        "queued", hit=aff > 0.0,
+                        spillover=best > aff,
+                    )
         return job
 
     # -- queue stats (reference scheduler.py:236-280) ------------------------

@@ -43,8 +43,9 @@ _WORKER_JSON = {
     "config_override",
     "topology",
     "mesh_shape",
+    "load_stats",
 }
-_JOB_JSON = {"params", "result", "checkpoint"}
+_JOB_JSON = {"params", "result", "checkpoint", "prefix_fps"}
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS workers (
@@ -215,6 +216,22 @@ _MIGRATIONS = [
         " worker_id TEXT,"
         " epoch INTEGER NOT NULL DEFAULT 0,"
         " state TEXT,"
+        " updated_at REAL)"),
+    # v6: cache-aware routing — jobs carry the request's prefix boundary
+    # fingerprints (computed client- or server-side, utils/prefixes.py) so
+    # claim/scoring can prefer the worker already holding the prefix;
+    # workers persist their advertised radix summary (a control-plane
+    # restart warm-starts routing instead of going blind) and a graded
+    # load snapshot from the batcher heartbeat stats (the binary
+    # current_job_id load signal lies for batcher-backed workers running
+    # many jobs concurrently).
+    (6, "ALTER TABLE jobs ADD COLUMN prefix_fps TEXT"),
+    (6, "ALTER TABLE workers ADD COLUMN load_stats TEXT"),
+    (6, "CREATE TABLE IF NOT EXISTS worker_prefix_summaries ("
+        " worker_id TEXT PRIMARY KEY,"
+        " seq INTEGER NOT NULL DEFAULT 0,"
+        " block_chars INTEGER NOT NULL DEFAULT 64,"
+        " entries TEXT,"
         " updated_at REAL)"),
 ]
 
@@ -512,12 +529,23 @@ class Store:
         worker_id: str,
         supported_types: Sequence[str],
         region: Optional[str] = None,
+        prefer: Optional[Any] = None,
+        prefer_window: int = 32,
     ) -> Optional[Dict[str, Any]]:
         """Atomically claim the best queued job for this worker.
 
         Equivalent of the reference's ``SELECT … FOR UPDATE SKIP LOCKED``
         claim (``scheduler.py:194-234``): priority DESC then FIFO, filtered to
         the worker's supported types, region-preferring jobs honored.
+
+        ``prefer``: optional sync callable ``row_dict -> float`` (cache-aware
+        routing affinity, ``server/prefix_routing.py``). Within the HEAD
+        priority band only — and at most ``prefer_window`` eligible rows —
+        the highest-preference job wins, FIFO breaking ties. Priority
+        ordering is never violated and a job can be deferred by at most
+        ``prefer_window - 1`` positions, so affinity is a bounded
+        reordering, not a starvation risk. The callable runs inside the
+        claim transaction: it must be pure and in-memory (no store access).
         """
 
         def txn() -> Optional[sqlite3.Row]:
@@ -534,6 +562,7 @@ class Store:
                     [JobStatus.QUEUED.value, *supported_types],
                 ).fetchall()
                 pick = None
+                cands: List[sqlite3.Row] = []
                 for r in rows:
                     pref = r["preferred_region"]
                     if (
@@ -559,8 +588,24 @@ class Store:
                             target = None
                         if target and target != worker_id:
                             continue
-                    pick = r
-                    break
+                    if prefer is None:
+                        pick = r
+                        break
+                    if cands and r["priority"] != cands[0]["priority"]:
+                        break   # never cross a priority band for affinity
+                    cands.append(r)
+                    if len(cands) >= max(1, prefer_window):
+                        break
+                if prefer is not None and cands:
+                    best, best_score = cands[0], None
+                    for r in cands:
+                        try:
+                            s = float(prefer(dict(r)))
+                        except Exception:  # noqa: BLE001 — advisory only
+                            s = 0.0
+                        if best_score is None or s > best_score:
+                            best, best_score = r, s
+                    pick = best
                 if pick is None:
                     self._conn.execute("COMMIT")
                     return None
@@ -595,6 +640,31 @@ class Store:
 
         row = await self._run(txn)
         return _decode(_JOB_JSON, row) if row is not None else None
+
+    # -- prefix summaries (cache-aware routing) ----------------------------
+
+    async def save_prefix_summary(self, worker_id: str, seq: int,
+                                  block_chars: int, entries_json: str,
+                                  updated_at: float) -> None:
+        """Write-through persistence of a worker's advertised radix
+        summary (``server/prefix_routing.py`` keeps the hot in-memory
+        copy; this row exists so a restarted control plane warm-starts
+        routing instead of going locality-blind)."""
+        await self.execute(
+            "INSERT INTO worker_prefix_summaries "
+            "(worker_id, seq, block_chars, entries, updated_at) "
+            "VALUES (?,?,?,?,?) ON CONFLICT(worker_id) DO UPDATE SET "
+            "seq=excluded.seq, block_chars=excluded.block_chars, "
+            "entries=excluded.entries, updated_at=excluded.updated_at",
+            (worker_id, int(seq), int(block_chars), entries_json,
+             float(updated_at)),
+        )
+
+    async def delete_prefix_summary(self, worker_id: str) -> None:
+        await self.execute(
+            "DELETE FROM worker_prefix_summaries WHERE worker_id=?",
+            (worker_id,),
+        )
 
     # -- stream checkpoints (direct-mode failover) -------------------------
 

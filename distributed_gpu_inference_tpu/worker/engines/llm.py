@@ -31,6 +31,7 @@ from ...runtime.batcher import (
     synthesize_checkpoint,
 )
 from ...runtime.engine import EngineConfig, PreemptedSequence, TPUEngine
+from ...runtime.prefix_summary import TIER_HOST, PrefixHotSet
 from ...utils.config import ServingConfig
 from ...utils.data_structures import InferenceRequest, SamplingParams
 from .base import (
@@ -276,6 +277,16 @@ class TPULLMEngine(LLMBaseEngine):
         self._ckpt_interval = int(
             self.config.get("checkpoint_interval_tokens", 8) or 0
         )
+        # cache-aware routing: bounded hot-set of prefix boundary
+        # fingerprints (runtime/prefix_summary.py) — rides heartbeats as
+        # this worker's radix summary so the control plane can route
+        # prefix-sharing requests back here. prefix_summary_top_n=0
+        # disables the channel.
+        top_n = int(self.config.get("prefix_summary_top_n", 128) or 0)
+        self.prefix_hot: Optional[PrefixHotSet] = (
+            PrefixHotSet(top_n) if top_n > 0 else None
+        )
+        self._prefix_evictions_seen = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -491,6 +502,50 @@ class TPULLMEngine(LLMBaseEngine):
             return None
         return self.serving.get_stats()
 
+    def prefix_summary_wire(self) -> Optional[Dict[str, Any]]:
+        """Next heartbeat radix-summary payload (full snapshot or delta —
+        ``runtime/prefix_summary.py`` wire format), or None when the
+        control plane is up to date. Before encoding, cold entries are
+        tier-demoted in proportion to pool evictions since the last wire
+        — an advertised ``dev`` entry whose block was evicted would
+        otherwise overpromise until the staleness TTL."""
+        hot = self.prefix_hot   # snapshot vs concurrent disable()
+        if hot is None:
+            return None
+        eng = self.engine
+        if eng is not None and getattr(eng, "manager", None) is not None:
+            ev = int(eng.manager.stats.evictions or 0)
+            delta = ev - self._prefix_evictions_seen
+            if delta > 0 and len(hot):
+                frac = min(1.0, delta / len(hot))
+                if eng.manager.spill_on_evict:
+                    # evicted blocks landed in the spill tier: restorable,
+                    # but pricier than device-resident — demote the weight
+                    hot.demote(frac, tier=TIER_HOST)
+                else:
+                    # no spill tier: evicted KV is GONE — advertising it
+                    # at any weight would over-promise for a full TTL
+                    hot.drop(frac)
+            self._prefix_evictions_seen = ev
+        return hot.wire()
+
+    def prefix_summary_ack(self) -> None:
+        hot = self.prefix_hot
+        if hot is not None:
+            hot.ack()
+
+    def prefix_summary_resync(self) -> None:
+        hot = self.prefix_hot
+        if hot is not None:
+            hot.resync()
+
+    def prefix_summary_disable(self) -> None:
+        """The control plane statically rejected our summaries (wire
+        version / fingerprint-basis skew): stop shipping them — a
+        payload the server can never apply would otherwise ping-pong
+        full snapshots on every heartbeat until redeploy."""
+        self.prefix_hot = None
+
     def _exclusive(self, fn: Any) -> Any:
         """Serialize out-of-band engine work (PD stages, handoff adoption)
         with the batcher's decode rounds: the callable runs on the
@@ -538,6 +593,13 @@ class TPULLMEngine(LLMBaseEngine):
         two must never diverge on tokenization/truncation/sampling."""
         if not self.loaded or self.engine is None:
             raise EngineLoadError("engine not loaded")
+        hot = self.prefix_hot   # snapshot: the heartbeat thread may
+        if hot is not None and \
+                self.engine.cfg.enable_prefix_cache:  # disable() to None
+            # every built request's prefix will be radix-cached on
+            # completion — record its boundary fingerprints for the
+            # heartbeat summary (advisory; one O(prefix) hash pass)
+            hot.note(prompt_or_messages)
         text = self._to_prompt(prompt_or_messages)
         token_ids = list(self.tokenizer.encode(text))
         max_prompt = self.engine.cfg.max_seq_len - cfg.max_new_tokens - 1

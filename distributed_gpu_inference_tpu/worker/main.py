@@ -404,7 +404,28 @@ class Worker:
                 )
             if s.get("horizon") is not None:
                 out["horizon"] = float(s["horizon"])
+        if out:
+            # shared-claim ceiling: lets the scheduler GRADE this worker's
+            # load (active + queued vs capacity) instead of reading the
+            # binary BUSY flag that lies for concurrent batcher serving
+            out["capacity"] = self.serving_capacity()
         return out or None
+
+    def _prefix_summary_payload(self) -> Optional[tuple]:
+        """(engine, wire payload) of the first engine advertising a radix
+        summary this beat — None when every engine is already in sync
+        with the control plane (no payload bloat)."""
+        for eng in self.engines.values():
+            fn = getattr(eng, "prefix_summary_wire", None)
+            if fn is None:
+                continue
+            try:
+                payload = fn()
+            except Exception:  # noqa: BLE001 — never break the heartbeat
+                continue
+            if payload:
+                return eng, payload
+        return None
 
     def _collect_checkpoints(self) -> List[Dict[str, Any]]:
         """Portable checkpoints of every in-flight generation across loaded
@@ -424,6 +445,7 @@ class Worker:
         return out
 
     def _heartbeat_once(self) -> None:
+        summary_eng = None
         try:
             extra: Dict[str, Any] = {}
             engine_stats: Dict[str, Any] = {}
@@ -436,6 +458,19 @@ class Worker:
             batcher_stats = self._batcher_stats()
             if batcher_stats:
                 engine_stats["batcher"] = batcher_stats
+            summary = self._prefix_summary_payload()
+            if summary is not None:
+                # radix summary (full or delta) for cache-aware routing;
+                # committed as server-known only after the round-trip
+                # succeeds (deltas are diffed against an ACKed base)
+                summary_eng, engine_stats["prefix_summary"] = summary
+            if any(getattr(eng, "prefix_hot", None) is not None
+                   for eng in self.engines.values()):
+                # channel-alive marker: lets the server keep our advertised
+                # summary fresh on payload-less beats (in sync) without
+                # immortalizing summaries of workers that restarted with
+                # the channel off
+                engine_stats["prefix_summary_live"] = True
             if engine_stats:
                 extra["engine_stats"] = engine_stats
             checkpoints = self._collect_checkpoints()
@@ -464,6 +499,21 @@ class Worker:
                 **extra,
             )
             self.stats["heartbeats"] += 1
+            if summary_eng is not None:
+                if resp.get("prefix_summary_applied") is False:
+                    # statically un-ingestable (version/basis skew): stop
+                    # shipping summaries this plane can never apply
+                    summary_eng.prefix_summary_disable()
+                elif resp.get("prefix_summary_resync") is False:
+                    # explicit "applied": commit the pending snapshot
+                    summary_eng.prefix_summary_ack()
+                else:
+                    # asked to resync, OR the server never answered for
+                    # the payload (engine_stats dropped oversize, legacy
+                    # plane): acking would commit a base the server does
+                    # not hold — fall back to a full snapshot
+                    summary_eng.prefix_summary_resync()
+                summary_eng = None
             if resp.get("stale_job") and self.current_job_id:
                 # the server requeued our claim (we looked dead): the
                 # in-flight inference cannot be cancelled mid-graph, but
@@ -487,6 +537,14 @@ class Worker:
             if resp.get("config_changed"):
                 self._fetch_remote_config()
         except APIError as exc:
+            if summary_eng is not None:
+                # the beat carrying our summary delta was lost: the server
+                # never applied it, so the next delta's base would be wrong
+                # — fall back to a full snapshot
+                try:
+                    summary_eng.prefix_summary_resync()
+                except Exception:  # noqa: BLE001
+                    pass
             if exc.status == 401:
                 try:
                     self.api.refresh_credentials()

@@ -23,6 +23,8 @@ HEAVY = [
     #   (real engines + direct servers + stream_cut chaos replays)
     "tests/test_ragged_attention.py",    # interpret-mode ragged kernel +
     #   ragged-vs-split byte-identity serving runs (multiple engines)
+    "tests/test_prefix_routing.py",      # two-engine e2e routing runs
+    #   behind a live control plane (byte-identity ON/OFF)
     "tests/test_parallel_pipeline.py",
     "tests/test_parallel_ring_attention.py",
     "tests/test_engine_spec_integrated.py",  # spec scan graphs x 2 engines

@@ -1,0 +1,834 @@
+"""Cache-aware routing subsystem (round 7).
+
+Layers under test, bottom-up:
+
+- fingerprint currency (``utils/prefixes.py``): boundary hashes, shared-
+  prefix equality, canonicalization growth property, hostile input
+- worker hot-set + delta wire protocol (``runtime/prefix_summary.py``)
+- registry ingest/staleness/caps + affinity (``server/prefix_routing.py``)
+- graded load + scheduler affinity-vs-spillover (``server/scheduler.py``)
+- claim-path preference (store ``prefer`` hook, priority-band bounded)
+- heartbeat channel over HTTP (ingest, resync, oversize cap, version)
+- e2e: TWO live engines behind a real control plane — routed turns stick
+  to the cache-holding worker, outputs are byte-identical with the
+  routing flag flipped LIVE via the admin endpoint.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.prefix_summary import (
+    PrefixHotSet,
+    TIER_HOST,
+)
+from distributed_gpu_inference_tpu.server.observability import (
+    MetricsCollector,
+)
+from distributed_gpu_inference_tpu.server.prefix_routing import (
+    PrefixRegistry,
+    RoutingConfig,
+)
+from distributed_gpu_inference_tpu.server.scheduler import (
+    SmartScheduler,
+    graded_load_score,
+)
+from distributed_gpu_inference_tpu.server.store import Store
+from distributed_gpu_inference_tpu.utils.prefixes import (
+    PREFIX_BLOCK_CHARS,
+    canonical_prompt_text,
+    deepest_match,
+    fingerprints_for_params,
+    prefix_fingerprints,
+    sanitize_fingerprints,
+)
+
+pytestmark = [pytest.mark.routing]
+
+B = PREFIX_BLOCK_CHARS
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+
+def test_boundary_fingerprints_shared_prefix():
+    shared = "s" * (2 * B)
+    a = prefix_fingerprints(shared + "a" * B)
+    b = prefix_fingerprints(shared + "b" * B)
+    assert len(a) == len(b) == 3
+    assert a[:2] == b[:2] and a[2] != b[2]
+    # partial tail blocks never fingerprint
+    assert prefix_fingerprints("x" * (B - 1)) == []
+    assert len(prefix_fingerprints("x" * (B + 1))) == 1
+
+
+def test_fingerprints_stable_and_bounded():
+    t = "q" * (100 * B)
+    fps = prefix_fingerprints(t)
+    assert len(fps) == 32  # MAX_PREFIX_BLOCKS cap
+    assert fps == prefix_fingerprints(t)
+    assert all(len(fp) == 16 for fp in fps)
+
+
+def test_canonical_messages_growth_property():
+    msgs = [{"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"}]
+    t1 = canonical_prompt_text(msgs)
+    t2 = canonical_prompt_text(
+        msgs + [{"role": "user", "content": "more"}]
+    )
+    assert t2.startswith(t1)
+    assert canonical_prompt_text("plain") == "plain"
+    assert canonical_prompt_text(None) == ""
+
+
+def test_fingerprints_for_params_precedence_and_sanitize():
+    prompt = "p" * (2 * B)
+    assert fingerprints_for_params({"prompt": prompt}) == \
+        prefix_fingerprints(prompt)
+    msgs = [{"role": "user", "content": "m" * (2 * B)}]
+    assert fingerprints_for_params({"messages": msgs, "prompt": prompt}) \
+        == prefix_fingerprints(canonical_prompt_text(msgs))
+    assert fingerprints_for_params(None) == []
+    good = prefix_fingerprints(prompt)
+    assert sanitize_fingerprints(good) == good
+    assert sanitize_fingerprints(good + ["NOT-HEX!"]) == []
+    assert sanitize_fingerprints("abc") == []
+    assert sanitize_fingerprints([x for x in good] * 50) == good[:1] * 0 \
+        or len(sanitize_fingerprints(good * 50)) <= 32
+
+
+def test_deepest_match():
+    fps = ["aa", "bb", "cc"]
+    assert deepest_match(fps, {"aa": 1, "cc": 1}) == 3
+    assert deepest_match(fps, {"aa": 1, "bb": 1}) == 2
+    assert deepest_match(fps, {"zz": 1}) == 0
+    assert deepest_match([], {"aa": 1}) == 0
+
+
+# ---------------------------------------------------------------------------
+# worker hot-set + wire protocol
+# ---------------------------------------------------------------------------
+
+
+def test_hotset_note_bound_and_lru():
+    hot = PrefixHotSet(top_n=4)
+    hot.note("a" * (3 * B))   # 3 entries
+    hot.note("b" * (2 * B))   # +2 → evicts the coldest 'a' boundary
+    assert len(hot) == 4
+    assert hot.stats["evicted"] == 1
+
+
+def test_wire_full_then_delta_then_ack():
+    hot = PrefixHotSet(top_n=16)
+    reg = PrefixRegistry(RoutingConfig())
+    hot.note("a" * (2 * B))
+    w = hot.wire()
+    assert "full" in w and w["v"] == 1
+    assert reg.ingest("w1", w).applied
+    hot.ack()
+    assert hot.wire() is None            # in sync: no payload bloat
+    hot.note("c" * B)
+    d = hot.wire()
+    assert "add" in d and d["base_seq"] == w["seq"]
+    assert reg.ingest("w1", d).applied
+    hot.ack()
+    fps = prefix_fingerprints("a" * (2 * B))
+    assert reg.affinity("w1", fps) == 1.0
+    # recency-only churn (same prompts re-served): NO empty-delta spam —
+    # steady state ships nothing per heartbeat
+    hot.note("a" * (2 * B))
+    hot.note("c" * B)
+    assert hot.wire() is None
+
+
+def test_wire_delta_desync_asks_resync_then_full_heals():
+    hot = PrefixHotSet()
+    reg = PrefixRegistry(RoutingConfig())
+    hot.note("a" * B)
+    hot.wire()
+    hot.ack()                       # worker thinks server knows state A
+    hot.note("b" * B)
+    delta = hot.wire()
+    res = reg.ingest("w1", delta)   # server never saw A: must resync
+    assert res.resync and not res.applied
+    hot.resync()
+    full = hot.wire()
+    assert "full" in full
+    assert reg.ingest("w1", full).applied
+
+
+def test_lost_heartbeat_resync_recovers():
+    hot = PrefixHotSet()
+    reg = PrefixRegistry(RoutingConfig())
+    hot.note("a" * B)
+    assert reg.ingest("w1", hot.wire()).applied
+    hot.ack()
+    hot.note("b" * B)
+    hot.wire()          # this delta is LOST in transit
+    hot.resync()        # worker's heartbeat error path
+    full = hot.wire()
+    assert "full" in full and reg.ingest("w1", full).applied
+    assert reg.affinity("w1", prefix_fingerprints("b" * B)) == 1.0
+
+
+def test_demote_lowers_tier_weight():
+    hot = PrefixHotSet()
+    hot.note("a" * B)
+    hot.demote(1.0, tier=TIER_HOST)
+    reg = PrefixRegistry(RoutingConfig())
+    assert reg.ingest("w1", hot.wire()).applied
+    fps = prefix_fingerprints("a" * B)
+    assert reg.affinity("w1", fps) == pytest.approx(0.7)
+
+
+def test_drop_forgets_evicted_entries_entirely():
+    # eviction WITHOUT a spill tier: the KV is gone, so the entries must
+    # vanish from the advertised summary (any nonzero weight would keep
+    # attracting conversations the worker must fully re-prefill)
+    hot = PrefixHotSet()
+    hot.note("a" * (2 * B))
+    reg = PrefixRegistry(RoutingConfig())
+    assert reg.ingest("w1", hot.wire()).applied
+    hot.ack()
+    assert hot.drop(1.0) == 2 and len(hot) == 0
+    delta = hot.wire()
+    assert set(delta["del"]) == set(prefix_fingerprints("a" * (2 * B)))
+    assert reg.ingest("w1", delta).applied
+    assert reg.affinity("w1", prefix_fingerprints("a" * (2 * B))) == 0.0
+
+
+def test_best_affinity_among_scopes_to_eligible_workers():
+    reg = PrefixRegistry(RoutingConfig())
+    hot = PrefixHotSet()
+    hot.note("a" * B)
+    assert reg.ingest("dead", hot.wire()).applied
+    fps = prefix_fingerprints("a" * B)
+    # fleet-wide best sees the (possibly dead/excluded) worker...
+    assert reg.best_affinity(fps)[1] == 1.0
+    # ...the eligible-scoped variant does not
+    assert reg.best_affinity_among(["cold1", "cold2"], fps) == 0.0
+    assert reg.best_affinity_among(["dead", "cold1"], fps) == 1.0
+
+
+# ---------------------------------------------------------------------------
+# registry: validation, caps, staleness
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_rejects_bad_version_and_block_mismatch():
+    reg = PrefixRegistry(RoutingConfig())
+    bad_v = {"v": 99, "seq": 1, "block_chars": B, "full": []}
+    res = reg.ingest("w", bad_v)
+    assert not res.applied and res.reason == "summary_bad_version"
+    bad_b = {"v": 1, "seq": 1, "block_chars": B * 2, "full": []}
+    res = reg.ingest("w", bad_b)
+    assert not res.applied and res.reason == "summary_block_mismatch"
+    res = reg.ingest("w", "garbage")
+    assert not res.applied and res.reason == "summary_malformed"
+
+
+def test_ingest_truncates_oversized_summary_with_reason():
+    reg = PrefixRegistry(RoutingConfig(summary_max_entries=4))
+    entries = [[f"{i:016x}", 1, "dev"] for i in range(10)]
+    res = reg.ingest("w", {"v": 1, "seq": 1, "block_chars": B,
+                           "full": entries})
+    assert res.applied and res.truncated == 6
+    assert res.reason == "summary_truncated"
+
+
+def test_staleness_ttl_zeroes_affinity():
+    reg = PrefixRegistry(RoutingConfig(staleness_ttl_s=10.0))
+    hot = PrefixHotSet()
+    hot.note("a" * B)
+    assert reg.ingest("w1", hot.wire(), now=1000.0).applied
+    fps = prefix_fingerprints("a" * B)
+    assert reg.affinity("w1", fps, now=1005.0) == 1.0
+    assert reg.affinity("w1", fps, now=1011.0) == 0.0
+    # a heartbeat WITHOUT a payload (worker in sync) must keep the
+    # summary fresh: staleness means "stopped heartbeating", not
+    # "stopped serving new prefixes"
+    reg.touch("w1", now=1011.0)
+    assert reg.affinity("w1", fps, now=1020.0) == 1.0
+    reg.touch("unknown", now=1011.0)   # no-op, never creates entries
+
+
+def test_routing_config_update_validates_before_applying():
+    cfg = RoutingConfig()
+    # string booleans coerce by MEANING, not truthiness
+    cfg.update({"enabled": "false"})
+    assert cfg.enabled is False
+    cfg.update({"enabled": "true"})
+    assert cfg.enabled is True
+    with pytest.raises(ValueError):
+        cfg.update({"enabled": "maybe"})
+    # an invalid value anywhere leaves the WHOLE config untouched
+    with pytest.raises(ValueError):
+        cfg.update({"enabled": False, "staleness_ttl_s": "abc"})
+    assert cfg.enabled is True
+    with pytest.raises(ValueError):
+        cfg.update({"min_headroom_factor": 1.5})
+    with pytest.raises(ValueError):
+        cfg.update({"summary_max_entries": 0})
+    # the spillover invariant is enforced ACROSS knobs: a floored bonus
+    # at or above the scheduler load weight would turn affinity into a pin
+    with pytest.raises(ValueError, match="starves"):
+        cfg.update({"affinity_weight": 1.0})
+    with pytest.raises(ValueError, match="starves"):
+        cfg.update({"min_headroom_factor": 0.9})
+    cfg.update({"affinity_weight": 0.2, "min_headroom_factor": 0.2})
+
+
+def test_sdk_prefix_hint_matches_worker_canonical_messages():
+    from distributed_gpu_inference_tpu.sdk.client import InferenceClient
+
+    hint = "h" * (2 * B)
+    msgs = [{"role": "system", "content": hint},
+            {"role": "user", "content": "question"}]
+    # the worker notes the request's MESSAGES (canonical form) — the
+    # SDK's hint fingerprints must land inside that advertised set
+    hot = PrefixHotSet()
+    hot.note(msgs)
+    reg = PrefixRegistry(RoutingConfig())
+    assert reg.ingest("w1", hot.wire()).applied
+    fps = InferenceClient._routing_fps({"messages": msgs}, hint)
+    assert fps, "hint must fingerprint"
+    assert reg.affinity("w1", fps) > 0.0
+    # prompt-style requests keep the raw-prefix semantics
+    fps_p = InferenceClient._routing_fps({"prompt": hint + "tail"}, hint)
+    assert fps_p == prefix_fingerprints(hint)
+
+
+def test_registry_persistence_roundtrip():
+    async def body():
+        st = Store(":memory:")
+        reg = PrefixRegistry(RoutingConfig())
+        hot = PrefixHotSet()
+        hot.note("a" * (2 * B))
+        assert reg.ingest("w1", hot.wire()).applied
+        await reg.persist("w1", st)
+        # a fresh registry (control-plane restart) warm-starts from disk
+        reg2 = PrefixRegistry(RoutingConfig())
+        await reg2.ensure_loaded(st)
+        fps = prefix_fingerprints("a" * (2 * B))
+        assert reg2.affinity("w1", fps) == 1.0
+        st.close()
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# graded load + scheduler scoring
+# ---------------------------------------------------------------------------
+
+
+def _w(wid="w", **kw):
+    return {"id": wid, "region": "us-west", "reliability_score": 0.5,
+            "status": "idle", **kw}
+
+
+def test_graded_load_prefers_batcher_stats_over_binary():
+    now = time.time()
+    # binary signal says FULL (current_job_id set) but the batcher shows
+    # 1 of 8 slots busy: the graded score must show headroom
+    w = _w(current_job_id="j1", status="busy",
+           load_stats={"active_slots": 1, "queue_depth": 0,
+                       "capacity": 8, "ts": now})
+    assert graded_load_score(w, now=now) == pytest.approx(1 - 1 / 8)
+    # queued work counts double
+    w2 = _w(load_stats={"active_slots": 4, "queue_depth": 2,
+                        "capacity": 8, "ts": now})
+    assert graded_load_score(w2, now=now) == pytest.approx(0.0)
+    # stale snapshot → binary fallback
+    w3 = _w(current_job_id="j1", status="busy",
+            load_stats={"active_slots": 0, "queue_depth": 0,
+                        "capacity": 8, "ts": now - 1000})
+    assert graded_load_score(w3, now=now) == 0.0
+    assert graded_load_score(_w(), now=now) == 1.0
+
+
+def test_scheduler_affinity_bonus_and_spillover():
+    async def body():
+        st = Store(":memory:")
+        reg = PrefixRegistry(RoutingConfig())
+        hot = PrefixHotSet()
+        prompt = "s" * (4 * B)
+        hot.note(prompt)
+        assert reg.ingest("warm", hot.wire()).applied
+        sched = SmartScheduler(st, prefix_registry=reg)
+        now = time.time()
+        job = {"type": "llm", "prefix_fps": prefix_fingerprints(prompt)}
+        idle = {"active_slots": 0, "queue_depth": 0, "capacity": 8,
+                "ts": now}
+        full = {"active_slots": 8, "queue_depth": 4, "capacity": 8,
+                "ts": now}
+        warm_idle = _w("warm", load_stats=idle)
+        cold_idle = _w("cold", load_stats=idle)
+        # idle + cached beats idle + cold by the full affinity weight
+        d = sched.score_worker(warm_idle, job, now=now) - \
+            sched.score_worker(cold_idle, job, now=now)
+        assert d == pytest.approx(reg.config.affinity_weight)
+        # SPILLOVER: the warm worker saturated keeps only the headroom
+        # floor of its bonus — the idle cold worker now outranks it
+        warm_full = _w("warm", load_stats=full)
+        assert sched.score_worker(cold_idle, job, now=now) > \
+            sched.score_worker(warm_full, job, now=now)
+        # ...but against an EQUALLY saturated cold worker, warmth still wins
+        cold_full = _w("cold", load_stats=full)
+        assert sched.score_worker(warm_full, job, now=now) > \
+            sched.score_worker(cold_full, job, now=now)
+        # routing disabled: no bonus at all
+        reg.config.enabled = False
+        assert sched.score_worker(warm_idle, job, now=now) == \
+            pytest.approx(sched.score_worker(cold_idle, job, now=now))
+        st.close()
+    run(body())
+
+
+def test_claim_prefers_affinity_within_priority_band():
+    async def body():
+        st = Store(":memory:")
+        reg = PrefixRegistry(RoutingConfig())
+        metrics = MetricsCollector()
+        hot = PrefixHotSet()
+        prompt = "s" * (3 * B)
+        hot.note(prompt)
+        await st.upsert_worker({"id": "warm", "supported_types": ["llm"],
+                                "status": "idle"})
+        assert reg.ingest("warm", hot.wire()).applied
+        sched = SmartScheduler(st, prefix_registry=reg, metrics=metrics)
+        # FIFO order: cold job first, warm job second, SAME priority
+        j_cold = await st.create_job({"type": "llm", "params": {}})
+        j_warm = await st.create_job({
+            "type": "llm", "params": {},
+            "prefix_fps": prefix_fingerprints(prompt),
+        })
+        got = await sched.atomic_assign_job("warm")
+        assert got["id"] == j_warm, "affinity should win within the band"
+        # priority is NEVER crossed: a higher-priority cold job wins even
+        # against a perfect prefix match
+        await st.update_job(j_cold, status="queued", worker_id=None)
+        j_hot = await st.create_job({"type": "llm", "params": {},
+                                     "priority": 10})
+        await st.update_worker("warm", current_job_id=None, status="idle")
+        got = await sched.atomic_assign_job("warm")
+        assert got["id"] == j_hot
+        st.close()
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface: heartbeat channel, job fps, admin flag, discovery
+# ---------------------------------------------------------------------------
+
+
+from aiohttp.test_utils import TestClient, TestServer  # noqa: E402
+
+from distributed_gpu_inference_tpu.server.app import (  # noqa: E402
+    ServerState,
+    create_app,
+)
+
+
+async def make_client(**state_kw) -> TestClient:
+    state = ServerState(**state_kw)
+    app = create_app(state, start_background=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+async def register(client, **body):
+    payload = {"name": "tw", "region": "us-west",
+               "supported_types": ["llm"], **body}
+    resp = await client.post("/api/v1/workers/register", json=payload)
+    return await resp.json()
+
+
+def auth(reg):
+    return {"Authorization": f"Bearer {reg['auth_token']}"}
+
+
+def test_heartbeat_summary_ingest_resync_and_load_stats():
+    async def body():
+        client = await make_client()
+        st = client.server.app["state"]
+        reg = await register(client)
+        wid = reg["worker_id"]
+        hot = PrefixHotSet()
+        prompt = "s" * (2 * B)
+        hot.note(prompt)
+        # a DELTA against a base the server never saw → resync answer
+        hot._acked, hot._acked_seq = {}, 0   # fake a stale ack
+        delta = hot.wire()
+        assert "add" in delta
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/heartbeat", headers=auth(reg),
+            json={"engine_stats": {"prefix_summary": delta}},
+        )
+        data = await resp.json()
+        assert data["prefix_summary_resync"] is True
+        hot.resync()
+        full = hot.wire()
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/heartbeat", headers=auth(reg),
+            json={"engine_stats": {
+                "prefix_summary": full,
+                "batcher": {"active_slots": 1, "queue_depth": 0,
+                            "capacity": 8, "avg_occupancy": 1.0},
+            }},
+        )
+        data = await resp.json()
+        assert data.get("prefix_summary_resync") is False
+        assert "prefix_summary_applied" not in data
+        fps = prefix_fingerprints(prompt)
+        assert st.prefix_registry.affinity(wid, fps) == 1.0
+        # statically un-ingestable payload → explicit applied:false so
+        # the worker can stop shipping (never a resync ping-pong)
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/heartbeat", headers=auth(reg),
+            json={"engine_stats": {"prefix_summary": {
+                "v": 99, "seq": 1, "block_chars": B, "full": []}}},
+        )
+        data = await resp.json()
+        assert data["prefix_summary_applied"] is False
+        assert data.get("prefix_summary_resync") is False
+        # graded load snapshot landed on the worker row
+        w = await st.store.get_worker(wid)
+        assert w["load_stats"]["capacity"] == 8
+        assert graded_load_score(w) == pytest.approx(1 - 1 / 8)
+        # summary persisted → a fresh registry warm-starts it
+        reg2 = PrefixRegistry(st.routing)
+        await reg2.ensure_loaded(st.store)
+        assert reg2.affinity(wid, fps) == 1.0
+        await client.close()
+    run(body())
+
+
+def test_heartbeat_engine_stats_oversize_dropped():
+    async def body():
+        client = await make_client()
+        st = client.server.app["state"]
+        reg = await register(client)
+        wid = reg["worker_id"]
+        hot = PrefixHotSet()
+        hot.note("s" * B)
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/heartbeat", headers=auth(reg),
+            json={"engine_stats": {
+                "prefix_summary": hot.wire(),
+                "blob": "x" * (256 * 1024),      # > 128 KiB cap
+            }},
+        )
+        assert resp.status == 200               # heartbeat NEVER fails
+        data = await resp.json()
+        assert "prefix_summary_resync" not in data   # payload was dropped
+        assert st.prefix_registry.affinity(
+            wid, prefix_fingerprints("s" * B)
+        ) == 0.0
+        await client.close()
+    run(body())
+
+
+def test_job_rows_carry_fingerprints_server_side():
+    async def body():
+        client = await make_client()
+        st = client.server.app["state"]
+        prompt = "p" * (2 * B)
+        resp = await client.post("/api/v1/jobs", json={
+            "type": "llm", "params": {"prompt": prompt},
+        })
+        jid = (await resp.json())["job_id"]
+        job = await st.store.get_job(jid)
+        assert job["prefix_fps"] == prefix_fingerprints(prompt)
+        # client-supplied fingerprints win over server-side computation
+        resp = await client.post("/api/v1/jobs", json={
+            "type": "llm", "params": {"prompt": prompt},
+            "prefix_fps": prefix_fingerprints("z" * B),
+        })
+        jid = (await resp.json())["job_id"]
+        job = await st.store.get_job(jid)
+        assert job["prefix_fps"] == prefix_fingerprints("z" * B)
+        # routing off → no fingerprints stored
+        st.routing.enabled = False
+        resp = await client.post("/api/v1/jobs", json={
+            "type": "llm", "params": {"prompt": prompt},
+        })
+        jid = (await resp.json())["job_id"]
+        assert (await st.store.get_job(jid)).get("prefix_fps") is None
+        await client.close()
+    run(body())
+
+
+def test_admin_routing_flag_live_flip():
+    async def body():
+        client = await make_client()
+        st = client.server.app["state"]
+        resp = await client.get("/api/v1/admin/routing")
+        cfg = await resp.json()
+        assert cfg["enabled"] is True
+        resp = await client.put("/api/v1/admin/routing",
+                                json={"enabled": False,
+                                      "affinity_weight": 0.1})
+        cfg = await resp.json()
+        assert cfg["enabled"] is False and cfg["affinity_weight"] == 0.1
+        # a push that would break the no-starvation bound is a 400 and
+        # leaves the live config untouched
+        resp = await client.put("/api/v1/admin/routing",
+                                json={"affinity_weight": 1.0})
+        assert resp.status == 400
+        assert st.routing.affinity_weight == 0.1
+        assert st.routing.enabled is False
+        resp = await client.put("/api/v1/admin/routing",
+                                json={"enabled": True})
+        assert (await resp.json())["enabled"] is True
+        await client.close()
+    run(body())
+
+
+def test_nearest_direct_ranks_by_affinity_with_spillover():
+    async def body():
+        client = await make_client()
+        st = client.server.app["state"]
+        prompt = "s" * (3 * B)
+        fps = prefix_fingerprints(prompt)
+        now = time.time()
+        regs = {}
+        for name in ("warm", "cold"):
+            r = await register(client, name=name, supports_direct=True,
+                               direct_url=f"http://{name}:1")
+            regs[name] = r["worker_id"]
+        hot = PrefixHotSet()
+        hot.note(prompt)
+        assert st.prefix_registry.ingest(regs["warm"], hot.wire()).applied
+        idle = {"active_slots": 0, "queue_depth": 0, "capacity": 8,
+                "ts": now}
+        for name in ("warm", "cold"):
+            await st.store.update_worker(regs[name], load_stats=idle)
+        resp = await client.get("/api/v1/jobs/direct/nearest",
+                                params={"prefix_fps": ",".join(fps)})
+        data = await resp.json()
+        assert data["worker_id"] == regs["warm"]
+        assert data["prefix_affinity"] > 0
+        # saturate the warm worker → spillover to the cold one
+        await st.store.update_worker(regs["warm"], load_stats={
+            "active_slots": 8, "queue_depth": 8, "capacity": 8, "ts": now,
+        })
+        resp = await client.get("/api/v1/jobs/direct/nearest",
+                                params={"prefix_fps": ",".join(fps)})
+        data = await resp.json()
+        assert data["worker_id"] == regs["cold"]
+        # no fingerprints → plain region/nearest behavior still works
+        resp = await client.get("/api/v1/jobs/direct/nearest")
+        assert resp.status == 200
+        await client.close()
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# SDK
+# ---------------------------------------------------------------------------
+
+
+def test_sdk_routing_fps_and_session_cache(monkeypatch):
+    from distributed_gpu_inference_tpu.sdk.client import InferenceClient
+
+    c = InferenceClient("http://x")
+    prompt = "s" * (2 * B)
+    fps = c._routing_fps({"prompt": prompt}, None)
+    assert fps == prefix_fingerprints(prompt)
+    assert c._routing_fps({"prompt": prompt}, "h" * B) == \
+        prefix_fingerprints("h" * B)
+    assert c._routing_fps({}, None) == []
+
+    calls = []
+
+    class _Resp:
+        def json(self):
+            # prefix_affinity marks an affinity-RANKED answer — those
+            # must never land in the generic direct cache (an answer
+            # without the field is cacheable: routing was off)
+            return {"worker_id": "w1", "direct_url": "http://w1",
+                    "region": "us-west", "prefix_affinity": 0.5}
+
+    def fake_request(method, path, payload=None, params=None, **kw):
+        calls.append(params)
+        return _Resp()
+
+    monkeypatch.setattr(c, "_request", fake_request)
+    w = c._get_nearest_worker(prefix_fps=fps, session="conv-1")
+    assert w["worker_id"] == "w1"
+    assert calls[-1]["prefix_fps"] == ",".join(fps)
+    # session stickiness: second lookup answers from the session cache
+    w2 = c._get_nearest_worker(prefix_fps=fps, session="conv-1")
+    assert w2 is w and len(calls) == 1
+    # failure drops the sticky entry
+    c._drop_session_worker("conv-1")
+    c._get_nearest_worker(prefix_fps=fps, session="conv-1")
+    assert len(calls) == 2
+    # fingerprinted discovery must not poison the generic direct cache
+    assert c._direct_cache is None
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# e2e: two live engines behind a real control plane
+# ---------------------------------------------------------------------------
+
+
+def test_two_engine_routing_sticks_and_outputs_identical():
+    import httpx
+
+    from distributed_gpu_inference_tpu.testing.harness import (
+        LiveControlPlane,
+    )
+    from distributed_gpu_inference_tpu.worker.direct_server import (
+        DirectServer,
+    )
+    from distributed_gpu_inference_tpu.worker.engines.llm import (
+        TPULLMEngine,
+    )
+
+    class _Shim:
+        """Minimal claim surface for DirectServer (shared claims only)."""
+
+        def __init__(self, llm):
+            self.engines = {"llm": llm}
+            self.state = type("S", (), {"value": "idle"})()
+
+        def try_begin_serving(self):
+            return True
+
+        def end_serving(self):
+            pass
+
+        def try_begin_job(self):
+            return True
+
+        def end_job(self):
+            pass
+
+        def get_status(self):
+            return {"state": "idle"}
+
+    members = []
+    with LiveControlPlane() as plane:
+        client = httpx.Client(timeout=60.0)
+        try:
+            for i in range(2):
+                llm = TPULLMEngine({
+                    "model": "llama3-tiny", "max_batch_size": 4,
+                    "max_seq_len": 256, "multi_step": 4,
+                    "serving": {"max_wait_ms": 2.0},
+                })
+                llm.load_model()
+                ds = DirectServer(_Shim(llm), host="127.0.0.1", port=0)
+                ds.start()
+                port = ds._runner.addresses[0][1]
+                r = client.post(
+                    f"{plane.url}/api/v1/workers/register",
+                    json={"name": f"e{i}", "region": "us-west",
+                          "supported_types": ["llm"],
+                          "supports_direct": True,
+                          "direct_url": f"http://127.0.0.1:{port}"},
+                )
+                r.raise_for_status()
+                members.append({"llm": llm, "ds": ds, **r.json()})
+
+            def heartbeat(m):
+                es = {"batcher": {
+                    "active_slots": 0, "queue_depth": 0, "capacity": 4,
+                }}
+                w = m["llm"].prefix_summary_wire()
+                if w is not None:
+                    es["prefix_summary"] = w
+                r = client.post(
+                    f"{plane.url}/api/v1/workers/{m['worker_id']}"
+                    "/heartbeat",
+                    json={"status": "idle", "engine_stats": es},
+                    headers={
+                        "Authorization": f"Bearer {m['auth_token']}"
+                    },
+                )
+                assert r.status_code == 200
+                if w is not None:
+                    if r.json().get("prefix_summary_resync") is False:
+                        m["llm"].prefix_summary_ack()
+                    else:
+                        m["llm"].prefix_summary_resync()
+
+            def one(prompt):
+                fps = prefix_fingerprints(prompt)
+                d = client.get(
+                    f"{plane.url}/api/v1/jobs/direct/nearest",
+                    params={"prefix_fps": ",".join(fps)} if fps else None,
+                )
+                d.raise_for_status()
+                disc = d.json()
+                r = client.post(disc["direct_url"] + "/inference", json={
+                    "type": "llm",
+                    "params": {"prompt": prompt, "max_new_tokens": 8},
+                })
+                r.raise_for_status()
+                for m in members:
+                    heartbeat(m)
+                return disc["worker_id"], r.json()["result"]["text"]
+
+            # two "conversations" with distinct 2-block shared prefixes,
+            # three growing turns each, interleaved
+            convs = {
+                "A": "a" * (2 * B),
+                "B": "b" * (2 * B),
+            }
+            for m in members:
+                heartbeat(m)
+
+            def drive():
+                placements: dict = {"A": [], "B": []}
+                outputs: dict = {}
+                for turn in range(3):
+                    for name, prefix in convs.items():
+                        prompt = prefix + f"turn{turn}" * 8
+                        wid, text = one(prompt)
+                        placements[name].append(wid)
+                        outputs[f"{name}.{turn}"] = text
+                return placements, outputs
+
+            placements, routed_out = drive()
+            # turns 2+ of each conversation stick to the turn-1 worker
+            for name in convs:
+                assert len(set(placements[name][1:])) == 1
+                assert placements[name][1] == placements[name][0] or \
+                    placements[name][1] in {m["worker_id"]
+                                            for m in members}
+            hits = sum(
+                m["llm"].engine.manager.stats.prefix_hit_tokens
+                for m in members
+            )
+            assert hits > 0, "routed turns must reuse cached prefixes"
+
+            # LIVE A/B flip via the admin endpoint: outputs byte-identical
+            r = client.put(f"{plane.url}/api/v1/admin/routing",
+                           json={"enabled": False})
+            assert r.status_code == 200
+            for m in members:
+                eng = m["llm"].engine
+                m["llm"].serving.run_exclusive(
+                    lambda e=eng: e.manager.clear_cached()
+                )
+            _, blind_out = drive()
+            assert routed_out == blind_out
+        finally:
+            client.close()
+            for m in members:
+                m["ds"].stop()
+                m["llm"].unload()

@@ -1,0 +1,112 @@
+"""Workload generator (benchmarks/workloads.py): seed stability, scenario
+shape invariants, and the CLI surface future cluster benches reuse."""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from benchmarks.workloads import Workload, generate
+
+pytestmark = [pytest.mark.routing]
+
+
+@pytest.mark.parametrize("scenario", ["chat", "rag", "bursty", "priority"])
+def test_same_seed_same_trace(scenario):
+    a = generate(scenario, seed=11, requests=48)
+    b = generate(scenario, seed=11, requests=48)
+    assert a.to_jsonl() == b.to_jsonl()
+    c = generate(scenario, seed=12, requests=48)
+    assert a.to_jsonl() != c.to_jsonl()
+
+
+def test_chat_prefixes_grow_and_turns_chain():
+    wl = generate("chat", seed=3, requests=32, turns=4)
+    convs = {}
+    for r in wl.requests:
+        convs.setdefault(r.conversation, []).append(r)
+    multi = [c for c in convs.values() if len(c) > 1]
+    assert multi, "chat must produce multi-turn conversations"
+    for turns in multi:
+        for prev, cur in zip(turns, turns[1:]):
+            # the radix-shareable property: turn k+1 strictly extends turn k
+            assert cur.prompt.startswith(prev.prompt)
+            assert len(cur.prompt) > len(prev.prompt)
+            assert cur.depends_on == prev.id
+            assert cur.think_s > 0.0
+        assert turns[0].depends_on is None
+
+
+def test_chat_tenants_share_system_prompts():
+    wl = generate("chat", seed=5, requests=64, tenants=2, turns=2)
+    first_turns = [r for r in wl.requests if r.turn == 0]
+    by_tenant = {}
+    for r in first_turns:
+        by_tenant.setdefault(r.tenant, []).append(r.prompt)
+    shared = False
+    for prompts in by_tenant.values():
+        if len(prompts) > 1:
+            # all conversations of one tenant open with ITS system prompt
+            p0 = prompts[0][:256]
+            assert all(p.startswith(p0) for p in prompts)
+            shared = True
+    assert shared
+
+
+def test_rag_prompts_are_heterogeneous_and_share_docs():
+    wl = generate("rag", seed=7, requests=48, corpus_docs=4)
+    lens = {len(r.prompt) for r in wl.requests}
+    assert len(lens) >= 3, "rag prompt lengths must vary"
+    assert max(lens) > 2 * min(lens), "rag needs a long tail"
+    # zipf doc popularity → at least two requests share a doc prefix
+    heads = [r.prompt[:64] for r in wl.requests]
+    assert len(set(heads)) < len(heads)
+
+
+def test_bursty_delays_land_in_on_windows():
+    base = generate("chat", seed=9, requests=32)
+    burst = generate("bursty", seed=9, requests=32)
+    assert "burst_period_s" in burst.meta
+    assert all(r.arrival_s >= 0 for r in burst.requests)
+    # bursts reshape the schedule, they don't change the request set size
+    assert len(burst.requests) == len(base.requests)
+
+
+def test_priority_has_two_tiers():
+    wl = generate("priority", seed=1, requests=40, tenants=4)
+    prios = {r.priority for r in wl.requests}
+    assert prios == {0, 10}
+    tiers = wl.meta["priority_tiers"]
+    assert any(v == 10 for v in tiers.values())
+    assert any(v == 0 for v in tiers.values())
+
+
+def test_unknown_scenario_raises():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        generate("nope", seed=0)
+
+
+def test_workload_duration_and_jsonl_roundtrip():
+    wl = generate("rag", seed=2, requests=8)
+    assert isinstance(wl, Workload)
+    assert wl.duration_s == max(r.arrival_s for r in wl.requests)
+    lines = wl.to_jsonl().splitlines()
+    assert len(lines) == 8
+    rec = json.loads(lines[0])
+    assert {"id", "arrival_s", "tenant", "prompt", "max_tokens"} <= set(rec)
+
+
+def test_cli_emits_seed_stable_jsonl():
+    cmd = [sys.executable, "-m", "benchmarks.workloads",
+           "--scenario", "chat", "--seed", "0", "--requests", "8"]
+    a = subprocess.run(cmd, capture_output=True, text=True, check=True)
+    b = subprocess.run(cmd, capture_output=True, text=True, check=True)
+    assert a.stdout == b.stdout
+    assert len(a.stdout.strip().splitlines()) == 8
+    s = subprocess.run(cmd + ["--summary"], capture_output=True, text=True,
+                       check=True)
+    meta = json.loads(s.stdout)
+    assert meta["scenario"] == "chat" and meta["requests"] == 8
