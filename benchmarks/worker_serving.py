@@ -29,6 +29,13 @@ replays through the legacy machinery shaped by the CLI knob values.
 Emits ragged/legacy TTFT p50/p95 and tok/s ratios — "the kernel beats
 the hand-tuning it deletes" is checkable on any hardware.
 
+``--spec`` (round 8) A/Bs speculative decoding ON (oracle draft: forced
+acceptance at configurable rates — every cost real, only the decision
+forced) against OFF through the same deployed path, publishing the
+tok/s-vs-acceptance curve and the crossover rate where spec ON beats
+spec OFF at equal p50 TTFT — the ROADMAP item 1 exit bar, measurable
+without trained draft weights.
+
 Usage (SLO row / throughput row / ragged-vs-knob-tuned):
     python -m benchmarks.worker_serving --arrival-rate 1.5 --requests 64 \
         --prompt-len 512 --max-tokens 128 --concurrency 16 \
@@ -117,6 +124,7 @@ def _warm(llm: Any, prompt_len: int, levels: Tuple[int, ...],
     from benchmarks.common import make_request
 
     eng = llm.engine
+    spec = getattr(eng.cfg, "speculative", None) is not None
     warm_ids = [((i * 13) % 26) + ord("a") for i in range(prompt_len)]
     warm_prompt = [llm.tokenizer.encode(chr(c))[0] for c in warm_ids]
 
@@ -139,7 +147,15 @@ def _warm(llm: Any, prompt_len: int, levels: Tuple[int, ...],
                 break
             w *= 2
         for T in levels:
-            slot = eng.submit(make_request(warm_prompt, 2))
+            # a spec engine's decode dispatch is a rounds=min(T, budget)
+            # scan: warm with a budget that reaches T (clamped to the
+            # pool geometry) or the serving measurement pays the
+            # full-depth compile on its first round
+            budget = 2
+            if spec:
+                budget = max(2, min(T, eng.cfg.max_seq_len
+                                    - len(warm_prompt) - 8))
+            slot = eng.submit(make_request(warm_prompt, budget))
             while eng.slots[slot] is not None and \
                     eng.slots[slot].finish_reason is None:
                 eng.decode_multi(T)
@@ -148,17 +164,31 @@ def _warm(llm: Any, prompt_len: int, levels: Tuple[int, ...],
             # ragged rounds compile one graph per chunk bucket width:
             # admit a prompt at every width an admission chunk row can
             # bucket to and run it through ragged_round, so the ragged
-            # leg (the serving default) never bills a compile to TTFT
+            # leg (the serving default) never bills a compile to TTFT.
+            # Spec engines compile TWO graphs per width — admission-only
+            # rounds delegate to the plain graph (no draft chain), and
+            # rounds with a live decode slot run the spec verify graph —
+            # plus the dedicated K+1 pure-verify width (short final
+            # chunks), so warm admits each width twice: once alone, once
+            # alongside a decoding slot.
             cap = min(max(int(eng.cfg.ragged_chunk), 1),
                       eng.cfg.prefill_buckets[-1], prompt_len)
-            for width in sorted({min(b, cap)
-                                 for b in eng.cfg.prefill_buckets}):
-                adm = eng.submit_chunked_start(
-                    make_request(warm_prompt[:width], 2)
-                )
-                while not adm.done:
-                    eng.ragged_round([adm])
-                _drain()
+            widths = {min(b, cap) for b in eng.cfg.prefill_buckets}
+            if spec:
+                widths.add(2)
+            spec_legs = (False, True) if spec and len(eng.slots) > 1 \
+                else (False,)
+            bg_budget = max(2, min(32, eng.cfg.max_seq_len - 8))
+            for width in sorted(widths):
+                for with_live_decode in spec_legs:
+                    if with_live_decode:
+                        eng.submit(make_request(warm_prompt[:4], bg_budget))
+                    adm = eng.submit_chunked_start(
+                        make_request(warm_prompt[:width], 2)
+                    )
+                    while not adm.done:
+                        eng.ragged_round([adm])
+                    _drain()
 
     llm.serving.run_exclusive(_run)
     eng.manager.stats.prefix_queries = 0
@@ -201,7 +231,8 @@ async def _drive(one, prompts: List[str], rate: Optional[float],
 
 async def _drive_http(url: str, prompts: List[str], max_tokens: int,
                       rate: Optional[float], concurrency: int,
-                      seed: int) -> Tuple[List[Dict[str, Any]], float, float]:
+                      seed: int, extra_params: Optional[Dict[str, Any]] = None,
+                      ) -> Tuple[List[Dict[str, Any]], float, float]:
     """Drive the REAL direct server over HTTP."""
     import httpx
 
@@ -213,7 +244,8 @@ async def _drive_http(url: str, prompts: List[str], max_tokens: int,
             t0 = time.perf_counter()
             r = await client.post(url + "/inference", json={
                 "type": "llm",
-                "params": {"prompt": p, "max_new_tokens": max_tokens},
+                "params": {"prompt": p, "max_new_tokens": max_tokens,
+                           **(extra_params or {})},
             })
             e2e_ms = (time.perf_counter() - t0) * 1000.0
             out = {"status": r.status_code, "e2e_ms": e2e_ms}
@@ -616,6 +648,168 @@ def run_fleet(args: Any, backend: str, model: str) -> None:
                 m.stop()
 
 
+# ---------------------------------------------------------------------------
+# --spec (round 8): spec ON vs OFF on the SLO frontier with an ORACLE draft.
+# Real 8B trained draft heads are environment-blocked (VERDICT r5 #3), but
+# the win condition is testable without them: the oracle forces the
+# acceptance rate while every cost stays real (draft chain, K+1-query
+# verify, KV writes ahead of verification, commit + trim_reserved
+# rollback). Sweeping the forced rate traces the tok/s-vs-acceptance curve
+# through the DEPLOYED path — DirectServer + batcher + spec ragged rounds
+# — and the crossover is the acceptance a trained draft must clear for
+# spec ON to beat spec OFF at equal p50 TTFT.
+# ---------------------------------------------------------------------------
+
+
+def _build_serving_llm(args: Any, model: str, spec_k: int = 0,
+                       adaptive: bool = False) -> Any:
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+    cfg: Dict[str, Any] = {
+        "model": model,
+        "max_batch_size": args.concurrency,
+        # identical pool geometry both legs: the spec verify window rides
+        # inside the same max_seq_len margin
+        "max_seq_len": args.prompt_len + args.max_tokens + 16
+        + max(args.spec_k, 1) + 2,
+        "quantization": args.quantization,
+        "serving": {
+            "target_step_ms": args.target_step_ms,
+            "queue_limit": max(4096, args.requests * 2),
+            "default_timeout_s": 600.0,
+        },
+    }
+    if args.kv_cache_dtype:
+        cfg["kv_cache_dtype"] = args.kv_cache_dtype
+    if spec_k > 0:
+        cfg.update({
+            "speculative_decode": True,
+            "spec_num_draft_tokens": spec_k,
+            "spec_adaptive": adaptive,
+            # any valid rate — legs flip it live via set_spec_oracle
+            "spec_oracle_accept": 1.0,
+        })
+    llm = TPULLMEngine(cfg)
+    llm.load_model()
+    return llm
+
+
+def run_spec_ab(args: Any, backend: str, model: str) -> None:
+    from distributed_gpu_inference_tpu.worker.direct_server import (
+        DirectServer,
+    )
+
+    rate = float(args.arrival_rate) if args.arrival_rate else None
+    prompts = synth_prompt_strings(args.requests, args.prompt_len,
+                                   args.shared_prefix)
+    # ignore_eos: the oracle commits (garbage) draft tokens, and both legs
+    # must generate IDENTICAL token counts for tok/s to be comparable
+    extra = {"ignore_eos": True}
+
+    def leg(llm: Any) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        worker = BenchWorker(llm)
+        ds = DirectServer(worker, host="127.0.0.1", port=0)
+        ds.start()
+        url = f"http://127.0.0.1:{ds._runner.addresses[0][1]}"
+        try:
+            # on the engine-executor thread: a client-side timeout in the
+            # previous sweep point can leave the batcher mid-round, and an
+            # unsynchronized cache wipe would race its manager mutations
+            llm.serving.run_exclusive(llm.engine.manager.clear_cached)
+            summary = _summarize(*asyncio.run(_drive_http(
+                url, prompts, args.max_tokens, rate, args.concurrency,
+                args.seed, extra_params=extra,
+            )))
+            stats = llm.serving.get_stats()
+            return summary, stats
+        finally:
+            ds.stop()
+
+    out: Dict[str, Any] = {
+        "benchmark": "worker_serving_spec",
+        "path": "direct_server+batcher_engine+spec_ragged_rounds",
+        "mode": "open_loop" if rate else "closed_loop",
+        "model": model, "backend": backend,
+        "requests": args.requests, "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len, "max_tokens": args.max_tokens,
+        "arrival_rate_rps": rate, "seed": args.seed,
+        "spec_k": args.spec_k, "spec_adaptive": bool(args.spec_adaptive),
+        "kv_cache_dtype": args.kv_cache_dtype,
+        "oracle": "forced-acceptance draft (real cost, forced decision)",
+    }
+
+    # ---- spec OFF baseline (identical engine minus the draft mode)
+    llm_off = _build_serving_llm(args, model)
+    try:
+        _warm(llm_off, args.prompt_len, llm_off.serving.batcher._levels,
+              args.concurrency)
+        off_summary, off_stats = leg(llm_off)
+    finally:
+        llm_off.unload()
+    out["spec_off"] = off_summary
+    out["spec_off_batcher"] = {
+        k: off_stats.get(k) for k in ("decode_rounds", "ragged_rounds",
+                                      "ragged_admissions", "avg_occupancy")
+    }
+    off_tps = off_summary["decode_tokens_per_s"]
+    off_p50 = (off_summary["ttft_ms"] or {}).get("p50")
+
+    # ---- spec ON sweep over forced acceptance rates (live oracle flips —
+    # the compiled graphs are identical across rates)
+    rates = [float(r) for r in str(args.spec_accept).split(",") if r.strip()]
+    llm_on = _build_serving_llm(args, model, spec_k=args.spec_k,
+                                adaptive=bool(args.spec_adaptive))
+    curve: List[Dict[str, Any]] = []
+    try:
+        _warm(llm_on, args.prompt_len, llm_on.serving.batcher._levels,
+              args.concurrency)
+        for r in rates:
+            llm_on.serving.run_exclusive(llm_on.engine.set_spec_oracle, r)
+            # per-LEG spec efficiency: the engine counters are cumulative
+            # (warm + earlier sweep points), so rate/tokens-per-step must
+            # come from this leg's deltas
+            pre = {k: llm_on.engine.stats.get(k, 0)
+                   for k in ("spec_accepted", "spec_drafted",
+                             "spec_emitted", "spec_slot_steps")}
+            on_summary, on_stats = leg(llm_on)
+            post = llm_on.engine.stats
+            d_drafted = post.get("spec_drafted", 0) - pre["spec_drafted"]
+            d_steps = post.get("spec_slot_steps", 0) - pre["spec_slot_steps"]
+            point = {
+                "forced_accept_rate": r,
+                "summary": on_summary,
+                "measured_accept_rate": round(
+                    (post.get("spec_accepted", 0) - pre["spec_accepted"])
+                    / d_drafted, 4) if d_drafted else None,
+                "tokens_per_step": round(
+                    (post.get("spec_emitted", 0) - pre["spec_emitted"])
+                    / d_steps, 3) if d_steps else None,
+                "tokens_per_s_on_over_off": round(
+                    on_summary["decode_tokens_per_s"] / off_tps, 3
+                ) if off_tps else None,
+            }
+            p50 = (on_summary["ttft_ms"] or {}).get("p50")
+            if p50 and off_p50:
+                point["ttft_p50_on_over_off"] = round(p50 / off_p50, 3)
+            curve.append(point)
+    finally:
+        llm_on.unload()
+    out["spec_on_curve"] = curve
+
+    # ---- crossover: smallest forced rate where spec ON beats OFF on
+    # tok/s at equal p50 TTFT (<= 5% TTFT regression tolerated)
+    crossover = None
+    for point in sorted(curve, key=lambda p: p["forced_accept_rate"]):
+        ratio = point.get("tokens_per_s_on_over_off") or 0.0
+        t_ratio = point.get("ttft_p50_on_over_off")
+        if ratio > 1.0 and (t_ratio is None or t_ratio <= 1.05):
+            crossover = point["forced_accept_rate"]
+            break
+    out["crossover_accept_rate"] = crossover
+    out["ttft_parity_tolerance"] = 1.05
+    emit(out)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None)
@@ -644,6 +838,22 @@ def main() -> None:
                     "ignored) against the knob-tuned legacy admission "
                     "path on the same live engine (serving.ragged=false "
                     "pushed between legs) and emit ragged/legacy ratios")
+    ap.add_argument("--spec", action="store_true",
+                    help="A/B spec ON (oracle draft, forced acceptance "
+                    "sweep) vs spec OFF through the deployed serving "
+                    "path; emits the tok/s-vs-acceptance curve and the "
+                    "crossover at equal p50 TTFT")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft depth K for the --spec ON legs")
+    ap.add_argument("--spec-accept", default="0.0,0.25,0.5,0.75,1.0",
+                    help="comma-separated forced acceptance rates "
+                    "(fraction of the K drafts accepted per round)")
+    ap.add_argument("--spec-adaptive", action="store_true",
+                    help="enable acceptance-adaptive draft depth in the "
+                    "--spec ON legs")
+    ap.add_argument("--kv-cache-dtype", default=None,
+                    help="KV pool storage dtype for both --spec legs "
+                    "(int8 composes with spec verify since round 8)")
     ap.add_argument("--workers", type=int, default=0,
                     help="≥2 stands up a FLEET behind a live control "
                     "plane and A/Bs cache-aware routing (admin flag "
@@ -664,6 +874,13 @@ def main() -> None:
             ap.error("--workers fleet mode takes a single --arrival-rate "
                      "(rate sweeps are a single-engine mode feature)")
         run_fleet(args, backend, model)
+        return
+
+    if args.spec:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--spec takes a single --arrival-rate (the sweep "
+                     "axis is the forced acceptance rate)")
+        run_spec_ab(args, backend, model)
         return
 
     from distributed_gpu_inference_tpu.worker.direct_server import (

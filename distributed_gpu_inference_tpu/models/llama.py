@@ -630,34 +630,32 @@ def forward_tree_chunk(
     engine compacts the winning path's pages (see
     ``runtime/speculative.py``). Reference analogue:
     ``worker/engines/speculative.py:419-453`` _verify_candidates.
+
+    Composes with sliding-window models (the tree-attention mask windows
+    prefix AND within-chunk keys by semantic node position — round 8
+    deleted the depth-vs-window guard) and with int8 KV pools: node KV
+    quantizes through the shared per-token contract on write and the
+    verify read dequantizes context-sized via ``ops.attention
+    .dequantize_kv`` — the same arithmetic every other int8 reader uses,
+    so tree verification over int8 pools is bit-identical to a
+    dequantized oracle.
     """
     from distributed_gpu_inference_tpu.ops.attention import paged_tree_attention
 
-    if cfg.sliding_window is not None and token_ids.shape[1] >= cfg.sliding_window:
-        # within-chunk tree attention skips window masking on the assumption
-        # that node depth << window; N nodes bounds depth, so enforce it
-        raise ValueError(
-            f"speculative tree of {token_ids.shape[1]} nodes on a model with "
-            f"sliding_window={cfg.sliding_window}: tree depth may reach the "
-            "window, which the tree-attention window mask does not cover"
-        )
-    if "k_scale" in kv:
-        raise NotImplementedError(
-            "tree verification over int8 KV pools is not wired (the "
-            "speculative decoder owns bf16 pools)"
-        )
     hidden = embed_tokens(params, token_ids, cfg)
     cos, sin = _rope_angles(
         jnp.maximum(rope_positions, 0), cfg.head_dim, cfg.rope_theta
     )
 
-    def attn_fn(q, layer_k, layer_v):
+    def attn_fn(q, layer_k, layer_v, layer_ks=None, layer_vs=None):
         return paged_tree_attention(
             q, layer_k, layer_v, block_tables, prefix_lens, tree_mask,
             block_size, node_positions=rope_positions,
             window=cfg.sliding_window,
+            k_scale=layer_ks, v_scale=layer_vs,
         )
 
+    quant_kv = "k_scale" in kv
     scanned, stacked = split_stacked_quant(params["layers"])
     step = functools.partial(
         _layer_step,
@@ -671,16 +669,23 @@ def forward_tree_chunk(
         stacked=stacked,
         emit_hidden=collect_layers is not None,
     )
-    (hidden, k_pool, v_pool, _), layer_hs = lax.scan(
-        lambda c, lp: step(c, lp), (hidden, kv["k"], kv["v"], jnp.int32(0)),
+    k0 = (kv["k"], kv["k_scale"]) if quant_kv else kv["k"]
+    v0 = (kv["v"], kv["v_scale"]) if quant_kv else kv["v"]
+    (hidden, k_out, v_out, _), layer_hs = lax.scan(
+        lambda c, lp: step(c, lp), (hidden, k0, v0, jnp.int32(0)),
         scanned,
+    )
+    new_kv = (
+        {"k": k_out[0], "v": v_out[0],
+         "k_scale": k_out[1], "v_scale": v_out[1]}
+        if quant_kv else {"k": k_out, "v": v_out}
     )
     features = (
         jnp.concatenate([layer_hs[i] for i in collect_layers], axis=-1)
         if collect_layers is not None else None
     )
     logits = project_logits(cfg, params, hidden)
-    return ChunkOutput(hidden=hidden, kv={"k": k_pool, "v": v_pool},
+    return ChunkOutput(hidden=hidden, kv=new_kv,
                        logits=logits, features=features)
 
 

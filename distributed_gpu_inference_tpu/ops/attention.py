@@ -307,12 +307,22 @@ def paged_tree_attention(
         axis=2,
     )                                                                # [B, N, J]
     if window is not None and node_positions is not None:
-        # prefix keys beyond the node's window drop out; within-chunk nodes
-        # are at most tree-depth apart (<< window), so only the prefix needs
-        # masking
+        # prefix keys beyond the node's window drop out by ABSOLUTE
+        # position; within-chunk keys window by SEMANTIC node position
+        # (prefix + depth — cache slots are node-indexed, so the raw
+        # key_pos of a chunk key says nothing about its distance). Deep
+        # trees on tiny windows (Mistral-class SWA, VERDICT r5 #5) thus
+        # mask exactly like the sequential engine would: an ancestor more
+        # than ``window`` semantic steps up is invisible.
         is_prefix &= (
             key_pos[:, None, :] > node_positions[:, :, None] - window
         )
+        key_node_pos = jnp.take_along_axis(
+            jnp.broadcast_to(node_positions[:, None, :], (b, n, n)),
+            jnp.broadcast_to(safe_idx, (b, n, j)).astype(jnp.int32),
+            axis=2,
+        )                                                            # [B, N, J]
+        tm &= key_node_pos > node_positions[:, :, None] - window
     mask = is_prefix | (in_chunk & tm)
     scores = jnp.where(mask[:, None, None, :, :], scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)

@@ -693,7 +693,12 @@ def quantize_kv_pool(pool: jax.Array) -> Tuple[jax.Array, jax.Array]:
 # and prefill chunk rows (q_len up to the chunk width) coexist — the
 # serving-side unification that lets admission append rows to a decode
 # round instead of scheduling a competing prefill dispatch (Ragged Paged
-# Attention, PAPERS.md).
+# Attention, PAPERS.md). Since round 8 the verify-row shape is a SERVING
+# path, not just a tested one: a spec-integrated engine's ragged_round
+# dispatches its draft chains here as q_len = K+1 rows (contiguous
+# positions lens..lens+K, per-row in-length bound lens+K+1), mixed with
+# chunk rows — int8 pools dequant in-kernel on the same read, which is
+# what lifted the models/llama.py int8 verify fence.
 # --------------------------------------------------------------------------
 
 # ceiling on (GQA queries per KV head) x (query tile) per grid cell: bounds

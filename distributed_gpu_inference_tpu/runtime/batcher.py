@@ -128,10 +128,11 @@ class BatcherConfig:
     # dispatches — the round loop collapses to build-ragged-batch →
     # dispatch → commit, and the subwave/interleave admission-stall knobs
     # are obsolete. None = auto (ragged whenever the engine supports it:
-    # plain paged engines; spec-integrated and seq-sharded engines keep
-    # the split paths). False forces the legacy wave/chunk-interleaved
-    # admission — kept for A/B benchmarking (worker_serving --compare-
-    # legacy), not production.
+    # every paged engine including spec-integrated since round 8 — their
+    # rounds carry verify rows; only seq-sharded pools keep the split
+    # paths). False forces the legacy wave/chunk-interleaved admission —
+    # kept for A/B benchmarking (worker_serving --compare-legacy), not
+    # production.
     ragged: Optional[bool] = None
 
     @property
@@ -246,22 +247,28 @@ class ContinuousBatcher:
         ``cfg.ragged=True`` REQUIRES it (init/reconfigure reject engines
         that cannot serve it — a silent legacy fallback would make every
         A/B ratio downstream a lie); ``None`` = auto: engines without
-        ragged support (spec-integrated, seq-sharded, fakes) fall back
-        automatically."""
+        ragged support (seq-sharded, fakes) fall back automatically.
+        Spec-integrated engines serve ragged since round 8 — a round with
+        admissions in flight dispatches verify rows + chunk rows in one
+        invocation."""
         if self.cfg.ragged is False:
             return False
         return bool(getattr(self.engine, "supports_ragged", False))
 
     def _check_ragged_supported(self, requested: Any) -> None:
         """``ragged=True`` is REQUIRE, not prefer — reject it loudly on an
-        engine that keeps the split admission paths."""
+        engine that keeps the split admission paths. Spec-integrated
+        engines are an explicit ACCEPT since round 8 (their ragged rounds
+        carry verify rows); only seq-sharded pools remain fenced."""
         if requested is True and \
                 not getattr(self.engine, "supports_ragged", False):
             raise ValueError(
                 "serving.ragged=true requires an engine with ragged-round "
-                "support (plain paged engines); spec-integrated and "
-                "seq-sharded engines keep the split admission paths — "
-                "use ragged=null (auto) to fall back silently"
+                "support (paged engines, spec-integrated included since "
+                "round 8); kv_seq_sharded engines keep the split "
+                "admission paths — their decode rows read through a "
+                "dedicated shard_map op with no ragged variant. Use "
+                "ragged=null (auto) to fall back silently"
             )
 
     def _rebuild_levels(self, anchor: float) -> None:

@@ -502,6 +502,18 @@ class TPUEngine:
                     dtype=self.dtype,
                 )
             )
+            # acceptance-adaptive draft depth: per-slot EMA of the
+            # ACCEPTED length (host-side — deterministic float arithmetic
+            # over integer accept counts, so same seed → same K
+            # schedule). Fresh slots start optimistic at K and converge.
+            self._spec_k_ema = np.full((b,), float(sp.num_draft_tokens))
+            # oracle-draft fractional-rate accumulator (per slot): a rate
+            # whose K-scaled target is fractional dithers deterministically
+            # (e.g. 2.4 → 2,3,2,3,2 accepted per round)
+            self._spec_oracle_acc = np.zeros((b,))
+            # test hook: set to a list and every dispatch appends its
+            # [(slot, selected_k), ...] — None (default) records nothing
+            self.spec_k_trace: Optional[List[Any]] = None
 
         self._build_jit_fns()
         # pending KV-pressure signal (set at step boundaries, consumed by
@@ -995,57 +1007,82 @@ class TPUEngine:
         # accept counts, active mask) return to the host, which replays
         # stop/budget bookkeeping EXACTLY as the per-step path would.
         self._spec_rounds_fn = None
+        self._spec_ragged_round_fn = None
         if self.cfg.speculative is not None:
             spec_k = self.cfg.speculative.num_draft_tokens
 
+            def draft_chain(params, dp, pending, h):
+                # K-token greedy draft chain — shared by the fused scan
+                # and the spec ragged round. Draft logits go through
+                # project_logits (final norm + head) — the readout
+                # distillation trains against (the round-3 tied-embedding
+                # finding, runtime/speculative.py). Depth is always the
+                # STATIC spec_k; per-slot adaptive depths mask the tail
+                # (positions/acceptance), never re-trace.
+                toks = [pending]
+                hh = h
+                for _ in range(spec_k):
+                    hh = draft_apply(
+                        cfg, dp, hh, llama.embed_tokens(params, toks[-1],
+                                                        cfg)
+                    )
+                    dl = llama.project_logits(cfg, params, hh[:, None, :])
+                    toks.append(
+                        jnp.argmax(dl[:, 0, :], axis=-1).astype(jnp.int32)
+                    )
+                return jnp.stack(toks, axis=1)                   # [B, K+1]
+
+            def accept_chain(chunk, target_pred, ks, forced, lens, caps,
+                             offs):
+                # longest matching prefix (greedy match) bounded by the
+                # slot's selected depth; the oracle (forced >= 0)
+                # overrides the match — cost stays real, only the
+                # decision is forced. Clamped so committed + pending
+                # stays inside block coverage.
+                match = (chunk[:, 1:] == target_pred[:, :-1]).astype(
+                    jnp.int32
+                ) * (offs[:, 1:] <= ks[:, None]).astype(jnp.int32)
+                n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                n_acc = jnp.where(
+                    forced >= 0, jnp.minimum(forced, ks), n_acc
+                )
+                return jnp.minimum(n_acc, jnp.maximum(caps - lens - 2, 0))
+
             def spec_rounds(params, dp, kv, core, h_last, tables, active,
-                            caps, budgets, rounds, mode):
+                            caps, budgets, ks, forced_rounds, rounds, mode):
                 # caps[b] = token positions the slot's reserved blocks
                 # cover for the WHOLE dispatch; writes beyond drop to the
                 # pad block, acceptance is clamped, and a row freezes when
                 # its next window no longer fits (host re-reserves next
                 # dispatch). budgets[b] = remaining max_new_tokens.
+                # ks[b] = the slot's selected draft depth (= spec_k unless
+                # adaptive); forced_rounds[r, b] = oracle accepted length
+                # per round (-1 = real acceptance).
                 keys, temps = core["keys"], core["temps"]
                 top_ks, top_ps, stops = (
                     core["top_ks"], core["top_ps"], core["stops"]
                 )
                 offs = jnp.arange(spec_k + 1, dtype=jnp.int32)[None, :]
 
-                def emb(ids):
-                    return llama.embed_tokens(params, ids, cfg)
-
-                def body(carry, _):
+                def body(carry, forced):
                     kv, lens, pending, h, done, n_emit = carry
                     act = ~done
                     b = lens.shape[0]
-                    # ---- draft phase: K-token greedy chain. Draft logits
-                    # go through project_logits (final norm + head) — the
-                    # readout distillation trains against (the round-3
-                    # tied-embedding finding, runtime/speculative.py).
-                    toks = [pending]
-                    hh = h
-                    for _ in range(spec_k):
-                        hh = draft_apply(cfg, dp, hh, emb(toks[-1]))
-                        dl = llama.project_logits(
-                            cfg, params, hh[:, None, :]
-                        )
-                        toks.append(
-                            jnp.argmax(dl[:, 0, :], axis=-1).astype(
-                                jnp.int32
-                            )
-                        )
-                    chunk = jnp.stack(toks, axis=1)              # [B, K+1]
+                    # ---- draft phase
+                    chunk = draft_chain(params, dp, pending, h)  # [B, K+1]
 
                     # ---- verify phase: one target pass over the chain.
                     # t0 (the pending token) commits its KV exactly as a
                     # vanilla step would; drafts write ahead of
-                    # verification into reserved blocks.
+                    # verification into reserved blocks (only up to the
+                    # slot's selected depth — deeper columns are masked).
                     pos = lens[:, None] + offs
                     pos = jnp.where(
-                        act[:, None] & (pos < caps[:, None]), pos, -1
+                        act[:, None] & (offs <= ks[:, None])
+                        & (pos < caps[:, None]), pos, -1
                     )
                     kv_lens_after = jnp.where(
-                        act, lens + spec_k + 1, 0
+                        act, lens + ks + 1, 0
                     ).astype(jnp.int32)
                     out = llama.forward_chunk(
                         cfg, params, chunk, pos, kv, tables, kv_lens_after,
@@ -1055,15 +1092,9 @@ class TPUEngine:
                         jnp.int32
                     )                                            # [B, K+1]
 
-                    # ---- acceptance: longest matching prefix (greedy
-                    # match), clamped so committed + pending stays inside
-                    # block coverage
-                    match = (chunk[:, 1:] == target_pred[:, :-1]).astype(
-                        jnp.int32
-                    )
-                    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
-                    n_acc = jnp.minimum(
-                        n_acc, jnp.maximum(caps - lens - 2, 0)
+                    # ---- acceptance
+                    n_acc = accept_chain(
+                        chunk, target_pred, ks, forced, lens, caps, offs
                     )
                     bonus = jnp.take_along_axis(
                         target_pred, n_acc[:, None], axis=1
@@ -1133,7 +1164,7 @@ class TPUEngine:
                     body,
                     (kv, core["lens"], core["last"], h_last, ~active,
                      jnp.zeros_like(core["lens"])),
-                    None, length=rounds,
+                    forced_rounds, length=rounds,
                 )
                 core = dict(core)
                 core["lens"], core["last"] = lens, pending
@@ -1143,6 +1174,138 @@ class TPUEngine:
                 spec_rounds, static_argnames=("rounds", "mode"),
                 donate_argnums=(2, 3, 4),
             )
+
+            # --- spec RAGGED round (round 8): ONE dispatch whose row batch
+            # mixes VERIFY rows (the draft chain + pending token,
+            # q_len = 2..K+1, one per active decode slot) with admission
+            # prefill-chunk rows — the spec engine's analogue of
+            # ragged_round, so admission stops being a competing dispatch
+            # for speculating engines too. One round per dispatch (the
+            # host replays stop/budget bookkeeping from the emission
+            # record, exactly like the fused scan's per-round replay);
+            # pure-decode moments keep the deeper _spec_rounds_fn scan.
+            # The LM head reads a GATHERED [B, K+1] hidden slice (chain
+            # offsets for verify rows, the last valid chunk index for
+            # admission rows) — never the full [B, S, V] chunk width.
+            def spec_ragged_round(params, dp, kv, toks_pos, tables,
+                                  lens_after, core, h_last, spec_row,
+                                  sample_flag, ks, caps, forced, mode):
+                keys, temps = core["keys"], core["temps"]
+                top_ks, top_ps = core["top_ks"], core["top_ps"]
+                offs = jnp.arange(spec_k + 1, dtype=jnp.int32)[None, :]
+                lens = core["lens"]
+                b, s_w = toks_pos[0].shape
+
+                # ---- draft + row merge: verify rows overwrite their
+                # chunk columns with the chain; chunk rows keep toks_pos
+                chunk = draft_chain(params, dp, core["last"], h_last)
+                pos_spec = lens[:, None] + offs
+                pos_spec = jnp.where(
+                    spec_row[:, None] & (offs <= ks[:, None])
+                    & (pos_spec < caps[:, None]), pos_spec, -1
+                )
+                pad = ((0, 0), (0, s_w - (spec_k + 1)))
+                chain_w = jnp.pad(chunk, pad)
+                pos_spec_w = jnp.pad(pos_spec, pad, constant_values=-1)
+                token_ids = jnp.where(
+                    spec_row[:, None], chain_w, toks_pos[0]
+                )
+                positions = jnp.where(
+                    spec_row[:, None], pos_spec_w, toks_pos[1]
+                )
+                kv_lens_row = jnp.where(
+                    spec_row, lens + ks + 1, lens_after
+                ).astype(jnp.int32)
+                out = llama.forward_chunk(
+                    cfg, params, token_ids, positions, kv, tables,
+                    kv_lens_row, block_size=bs, last_only=False,
+                    with_logits=False, allow_fused=False,
+                )
+
+                # ---- gathered logits: chain offsets for verify rows, the
+                # last valid index (forward_chunk's last_only rule) for
+                # chunk rows — identical arithmetic to the split paths,
+                # so greedy chunk rows stay byte-identical to
+                # _plain_ragged_round's in-graph sample
+                n_valid = jnp.sum((positions >= 0).astype(jnp.int32),
+                                  axis=1)
+                last_idx = jnp.maximum(n_valid - 1, 0)
+                gidx = jnp.where(
+                    spec_row[:, None],
+                    jnp.minimum(offs, s_w - 1),
+                    last_idx[:, None],
+                )                                              # [B, K+1]
+                hsel = jnp.take_along_axis(
+                    out.hidden, gidx[:, :, None].astype(jnp.int32), axis=1
+                )                                              # [B, K+1, H]
+                logits = llama.project_logits(cfg, params, hsel)
+                target_pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+                # ---- acceptance (verify rows) + sample (chunk-final and
+                # sampled rows). Sample positions: lens + 1 for verify
+                # rows, lens_after for chunk rows — the split paths' key
+                # folds exactly.
+                n_acc = accept_chain(
+                    chunk, target_pred, ks, forced, lens, caps, offs
+                )
+                bonus = jnp.take_along_axis(
+                    target_pred, n_acc[:, None], axis=1
+                )[:, 0]
+                samp_pos = jnp.where(spec_row, lens + 1, lens_after)
+                tok0 = sample_mode(
+                    logits[:, 0, :], keys, samp_pos, temps, top_ks,
+                    top_ps, mode,
+                )
+                if mode == "mixed":
+                    # sampled slots ride the round at one token: sample
+                    # from the pending token's logits, never accept drafts
+                    is_sampled = temps > 0.0
+                    n_acc = jnp.where(is_sampled & spec_row, 0, n_acc)
+                    bonus = jnp.where(is_sampled, tok0, bonus)
+
+                # ---- ordered emission record [B, K+1] for the host
+                # replay: accepted drafts then the bonus; -1 pads
+                acc_pad = jnp.concatenate(
+                    [chunk[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1
+                )
+                emitted = jnp.where(
+                    offs < n_acc[:, None], acc_pad,
+                    jnp.where(offs == n_acc[:, None], bonus[:, None], -1),
+                )
+                emitted = jnp.where(spec_row[:, None], emitted, -1)
+
+                # ---- advance device state: verify rows commit n_acc + 1
+                # and carry the bonus pending; chunk-final rows commit
+                # their sampled first token; intermediate chunks only
+                # wrote KV
+                new_h = jnp.take_along_axis(
+                    hsel, n_acc[:, None, None].astype(jnp.int32), axis=1
+                )[:, 0, :]
+                sampled = sample_flag > 0
+                core = dict(core)
+                core["lens"] = jnp.where(
+                    spec_row, lens + n_acc + 1,
+                    jnp.where(sampled, lens_after, lens),
+                )
+                core["last"] = jnp.where(
+                    spec_row, bonus,
+                    jnp.where(sampled, tok0, core["last"]),
+                )
+                h2 = jnp.where(spec_row[:, None], new_h, h_last)
+                return out.kv, core, h2, tok0, emitted, n_acc
+
+            self._spec_ragged_round_fn = jax.jit(
+                spec_ragged_round, static_argnames=("mode",),
+                donate_argnums=(2, 6, 7),
+            )
+
+            def unpack_spec_sched(si):
+                # one packed upload per spec ragged round: tables,
+                # spec_row, sample_flag, ks, caps, forced
+                return (si[:, :m], si[:, m] > 0, si[:, m + 1],
+                        si[:, m + 2], si[:, m + 3], si[:, m + 4])
+
+            self._unpack_spec_sched_fn = jax.jit(unpack_spec_sched)
 
         def apply_ops(kv, srcs, dsts):
             # page copies (CoW): dst = -1 entries are dropped. Scale pools
@@ -1772,9 +1935,11 @@ class TPUEngine:
         self._top_ks[slot] = sp.top_k
         self._top_ps[slot] = sp.top_p
         self._stop_ids[slot] = -1
-        stop = list(sp.stop_token_ids)[:MAX_STOP_IDS]
+        # ignore_eos (bench/oracle workloads): no stop ids at all — the
+        # generation runs to its max_new_tokens budget
+        stop = [] if sp.ignore_eos else list(sp.stop_token_ids)[:MAX_STOP_IDS]
         if self.eos_token_id is not None and self.eos_token_id not in stop \
-                and len(stop) < MAX_STOP_IDS:
+                and len(stop) < MAX_STOP_IDS and not sp.ignore_eos:
             stop.append(self.eos_token_id)
         self._stop_ids[slot, : len(stop)] = stop
         # host-side key material (no device round-trip on the admission hot
@@ -1792,8 +1957,13 @@ class TPUEngine:
         if self.cfg.speculative is not None:
             # fresh occupant: its draft feature starts at zeros (stale
             # hidden would only cost acceptance, never correctness — but
-            # deterministic stats want a clean start)
+            # deterministic stats want a clean start), its adaptive-depth
+            # EMA restarts optimistic at K, and its oracle dither resets
             self._spec_h_zero.add(slot)
+            self._spec_k_ema[slot] = float(
+                self.cfg.speculative.num_draft_tokens
+            )
+            self._spec_oracle_acc[slot] = 0.0
         self.stats["requests"] += 1
 
     def _submit_allocated(self, request: InferenceRequest, slot: int,
@@ -2005,15 +2175,94 @@ class TPUEngine:
 
     @property
     def supports_ragged(self) -> bool:
-        """Ragged rounds serve plain paged engines (single-chip or TP
-        mesh). Spec-integrated engines decode through the fused
-        draft→verify→accept scan (their rounds commit 1..K+1 tokens per
-        slot — a different round shape), and seq-sharded pools read decode
-        rows through a dedicated shard_map op; both keep the split
-        admission paths."""
-        return self.cfg.speculative is None and not self.cfg.kv_seq_sharded
+        """Ragged rounds serve every paged engine except seq-sharded
+        pools (whose decode rows read through a dedicated shard_map op —
+        the one remaining split path). Spec-integrated engines serve
+        ragged since round 8: their rounds carry VERIFY rows
+        (q_len = 2..K+1 — the draft chain plus the pending token) in
+        place of plain decode rows, co-dispatched with admission
+        prefill-chunk rows in the same invocation, committing 1..K+1
+        accepted tokens per slot at the same step boundary."""
+        return not self.cfg.kv_seq_sharded
 
     def ragged_round(
+        self, admissions: Sequence[ChunkedAdmission] = (),
+    ) -> Dict[int, List[int]]:
+        if self.cfg.speculative is not None:
+            return self._spec_ragged_round(admissions)
+        return self._plain_ragged_round(admissions)
+
+    def _ragged_admission_rows(
+        self, admissions: Sequence[ChunkedAdmission], chunk_cap: int,
+    ) -> Tuple[List[Tuple[ChunkedAdmission, List[int], bool]], int]:
+        """Slice each in-flight admission's next chunk row for a ragged
+        round, pre-reserving the sampled first token's block for FINAL
+        chunks (``submit_chunked_step``'s step-boundary rule); a
+        pressured final chunk skips this round and retries. Shared by
+        the plain and spec ragged rounds so the retry contract cannot
+        drift. Returns (ready rows, max chunk width)."""
+        ready: List[Tuple[ChunkedAdmission, List[int], bool]] = []
+        width = 1
+        for adm in admissions:
+            s = self.slots[adm.slot]
+            assert s is not None
+            piece = adm.fresh[:chunk_cap]
+            is_last = len(adm.fresh) <= chunk_cap
+            if is_last:
+                try:
+                    if self.manager.reserve_tokens(s.seq_id, 1):
+                        self._block_tables[adm.slot] = \
+                            self.manager.block_table_for(
+                                s.seq_id, self.cfg.max_blocks_per_seq
+                            )
+                except OutOfBlocksError:
+                    self.manager.trim_reserved(s.seq_id)
+                    self._signal_pressure("admission", requests=1)
+                    continue
+            ready.append((adm, piece, is_last))
+            width = max(width, len(piece))
+        return ready, width
+
+    def _fill_ragged_admission_rows(
+        self, ready, toks_pos: np.ndarray, lens_after: np.ndarray,
+        sample_flag: np.ndarray, row_mask: Optional[np.ndarray] = None,
+    ) -> bool:
+        """Write the admission chunk rows into a ragged round's host
+        batch arrays; True when any admission samples non-greedily."""
+        mixed = False
+        for adm, piece, is_last in ready:
+            sl, n = adm.slot, len(piece)
+            toks_pos[0, sl, :n] = piece
+            toks_pos[1, sl, :n] = np.arange(adm.off, adm.off + n)
+            lens_after[sl] = adm.off + n
+            sample_flag[sl] = 1 if is_last else 0
+            if row_mask is not None:
+                row_mask[sl] = True
+            if adm.mode != "greedy":
+                mixed = True
+        return mixed
+
+    def _commit_ragged_admissions(
+        self, ready, toks: np.ndarray, out: Dict[int, List[int]],
+    ) -> None:
+        """Post-dispatch admission bookkeeping shared by the plain and
+        spec ragged rounds: advance chunk offsets, account prefill
+        tokens, and record each completed admission's in-graph-sampled
+        first token (flipping ``adm.done``)."""
+        for adm, piece, is_last in ready:
+            s = self.slots[adm.slot]
+            assert s is not None
+            adm.fresh = adm.fresh[len(piece):]
+            adm.off += len(piece)
+            self.stats["prefill_tokens"] += len(piece)
+            if is_last:
+                s.prefilling = False
+                tok = int(toks[adm.slot])
+                out[adm.slot] = [tok]
+                self._record_token(adm.slot, tok, device_synced=True)
+                adm.done = True
+
+    def _plain_ragged_round(
         self, admissions: Sequence[ChunkedAdmission] = (),
     ) -> Dict[int, List[int]]:
         """ONE device dispatch serving a ragged row batch: every active
@@ -2068,29 +2317,9 @@ class TPUEngine:
         if pressured:
             self._signal_pressure("decode", slots=pressured)
 
-        # --- admission chunk rows: final chunks pre-reserve the sampled
-        # first token's block (submit_chunked_step's step-boundary rule);
-        # a pressured final chunk skips THIS round and retries
-        ready: List[Tuple[ChunkedAdmission, List[int], bool]] = []
-        width = 1
-        for adm in admissions:
-            s = self.slots[adm.slot]
-            assert s is not None
-            piece = adm.fresh[:chunk_cap]
-            is_last = len(adm.fresh) <= chunk_cap
-            if is_last:
-                try:
-                    if self.manager.reserve_tokens(s.seq_id, 1):
-                        self._block_tables[adm.slot] = \
-                            self.manager.block_table_for(
-                                s.seq_id, self.cfg.max_blocks_per_seq
-                            )
-                except OutOfBlocksError:
-                    self.manager.trim_reserved(s.seq_id)
-                    self._signal_pressure("admission", requests=1)
-                    continue
-            ready.append((adm, piece, is_last))
-            width = max(width, len(piece))
+        # --- admission chunk rows: shared slicing + final-chunk
+        # pending-block pre-reservation (``_ragged_admission_rows``)
+        ready, width = self._ragged_admission_rows(admissions, chunk_cap)
         if not kept and not ready:
             return {}
 
@@ -2110,15 +2339,9 @@ class TPUEngine:
             sample_flag[i] = 1
             if self._temps[i] > 0:
                 mode = "mixed"
-        for adm, piece, is_last in ready:
-            sl, n = adm.slot, len(piece)
-            toks_pos[0, sl, :n] = piece
-            toks_pos[1, sl, :n] = np.arange(adm.off, adm.off + n)
-            lens_after[sl] = adm.off + n
-            row_mask[sl] = True
-            sample_flag[sl] = 1 if is_last else 0
-            if adm.mode != "greedy":
-                mode = "mixed"
+        if self._fill_ragged_admission_rows(ready, toks_pos, lens_after,
+                                            sample_flag, row_mask):
+            mode = "mixed"
         core = self._sync_core()
         tables, _act, flag_d = self._sched_arrays(row_mask, sample_flag)
         try:
@@ -2144,18 +2367,180 @@ class TPUEngine:
             tok = int(toks[i])
             out[i] = [tok]
             self._record_token(i, tok, device_synced=True)
-        for adm, piece, is_last in ready:
+        self._commit_ragged_admissions(ready, toks, out)
+        return out
+
+    def _spec_ragged_round(
+        self, admissions: Sequence[ChunkedAdmission] = (),
+    ) -> Dict[int, List[int]]:
+        """Spec-integrated ragged round: ONE dispatch serving VERIFY rows
+        (per active decode slot: the draft chain + pending token,
+        q_len = 2..K+1) alongside admission prefill-chunk rows — the
+        round-8 unification that gives a speculating engine PR 6's
+        one-dispatch prefill+decode path. Per-row contracts match the
+        split paths exactly: verify rows pre-reserve their worst-case
+        window and commit 1..K+1 accepted tokens with precise
+        ``trim_reserved`` rollback at this same step boundary
+        (``_spec_decode_rounds``'s per-round contract — greedy outputs
+        stay byte-identical spec on/off and ragged on/off); admission
+        rows run their next chunk with the final chunk sampling in-graph
+        (``submit_chunked_step``'s contract, pending-block pre-reservation
+        included; a pressured final chunk retries next round). Returns
+        {slot: [tokens]}; admissions mutate in place."""
+        spec = self.cfg.speculative
+        assert spec is not None and self._spec_ragged_round_fn is not None
+        k = spec.num_draft_tokens
+        admissions = [a for a in admissions if not a.done]
+        for adm in admissions:
             s = self.slots[adm.slot]
+            if s is None or s.seq_id != adm.seq_id:
+                raise RuntimeError("ragged admission slot was freed")
+        b = len(self.slots)
+        max_bucket = self.cfg.prefill_buckets[-1]
+        chunk_cap = min(max(int(self.cfg.ragged_chunk), 1), max_bucket)
+
+        # --- verify rows: per-slot depth selection + worst-case
+        # reservation (one round: up to K+1 fed tokens plus the
+        # post-round pending token), exactly _spec_decode_rounds at
+        # rounds=1; exhaustion freezes the row at the step boundary
+        budgets = np.zeros((b,), np.int32)
+        cand: List[int] = []
+        for i, s in enumerate(self.slots):
+            if s is None or s.finish_reason is not None or s.prefilling:
+                continue
+            rem = s.request.sampling.max_new_tokens - len(s.generated)
+            if rem <= 0:
+                continue
+            budgets[i] = rem
+            cand.append(i)
+        ks_sel = self._select_spec_ks(cand)
+        caps = np.zeros((b,), np.int32)
+        spec_rows = np.zeros((b,), bool)
+        pressured: List[int] = []
+        for i in cand:
+            s = self.slots[i]
             assert s is not None
-            adm.fresh = adm.fresh[len(piece):]
-            adm.off += len(piece)
-            self.stats["prefill_tokens"] += len(piece)
-            if is_last:
-                s.prefilling = False
-                tok = int(toks[adm.slot])
-                out[adm.slot] = [tok]
-                self._record_token(adm.slot, tok, device_synced=True)
-                adm.done = True
+            cur = len(self.manager.seq_tokens[s.seq_id])
+            ki = int(ks_sel[i])
+            want = min(ki + 1, int(budgets[i])) + ki + 1
+            n_res = max(min(want, self.cfg.max_seq_len - cur), 0)
+            try:
+                if n_res > 0 and self.manager.reserve_tokens(s.seq_id,
+                                                             n_res):
+                    self._block_tables[i] = self.manager.block_table_for(
+                        s.seq_id, self.cfg.max_blocks_per_seq
+                    )
+            except OutOfBlocksError:
+                self.manager.trim_reserved(s.seq_id)
+                self._block_tables[i] = self.manager.block_table_for(
+                    s.seq_id, self.cfg.max_blocks_per_seq
+                )
+                pressured.append(i)
+                continue
+            spec_rows[i] = True
+            caps[i] = cur + n_res
+        if pressured:
+            self._signal_pressure("decode", slots=pressured)
+        if not spec_rows.any():
+            # no verify row this round — admission-only (cold-start
+            # ramp-up) or every candidate pressured out of its verify
+            # window. The PLAIN ragged graph serves chunk rows with
+            # byte-identical arithmetic and skips the draft chain + the
+            # [B, K+1, V] head projections entirely; pressured slots it
+            # can re-admit advance one VANILLA token (a 1-token
+            # reservation can fit where K+2 did not — graceful
+            # degradation, still target-greedy so outputs are unchanged;
+            # only the stale draft hidden costs next-round acceptance).
+            return self._plain_ragged_round(admissions)
+
+        # --- admission chunk rows: identical contract to the plain path
+        # (shared helper — the retry/reservation rules cannot drift)
+        ready, width = self._ragged_admission_rows(admissions, chunk_cap)
+
+        self._apply_pending()
+        # row width: a dedicated K+1 shape serves pure-verify rounds (the
+        # steady state) without padding up to the smallest prefill
+        # bucket; wider chunk rows bucket as usual — the compiled width
+        # set stays {K+1} ∪ buckets
+        s_w = k + 1 if width <= k + 1 else self._bucket_len(width)
+        toks_pos = np.zeros((2, b, s_w), np.int32)
+        toks_pos[1] = -1
+        lens_after = np.zeros((b,), np.int32)
+        sample_flag = np.zeros((b,), np.int32)
+        mode = "greedy"
+        for i in np.nonzero(spec_rows)[0]:
+            if self._temps[i] > 0:
+                mode = "mixed"
+        if self._fill_ragged_admission_rows(ready, toks_pos, lens_after,
+                                            sample_flag):
+            mode = "mixed"
+        forced = self._spec_forced(
+            [int(i) for i in np.nonzero(spec_rows)[0]], 1, ks_sel
+        )[0]
+        core = self._sync_core()
+        h_last = self._spec_h_device()
+        mm = self.cfg.max_blocks_per_seq
+        si = np.zeros((b, mm + 5), np.int32)
+        si[:, :mm] = self._block_tables
+        si[:, mm] = spec_rows
+        si[:, mm + 1] = sample_flag
+        si[:, mm + 2] = ks_sel
+        si[:, mm + 3] = caps
+        si[:, mm + 4] = forced
+        (tables, spec_d, flag_d, ks_d, caps_d,
+         forced_d) = self._unpack_spec_sched_fn(si)
+        try:
+            (self.kv, self._dev_core, self._dev_spec_h, tok0, emitted,
+             n_acc) = self._spec_ragged_round_fn(
+                self.params, self._draft_params, self.kv, toks_pos,
+                tables, jnp.asarray(lens_after), core, h_last, spec_d,
+                flag_d, ks_d, caps_d, forced_d, mode,
+            )
+        except Exception:
+            self._invalidate_device_state()
+            raise
+        tok0 = np.asarray(tok0)
+        emitted = np.asarray(emitted)
+        n_acc = np.asarray(n_acc)
+        self.stats["ragged_rounds"] += 1
+        if spec_rows.any():
+            self.stats["decode_calls"] += 1
+            self.stats["spec_steps"] += 1
+        if ready:
+            self.stats["prefill_calls"] += 1
+        out: Dict[int, List[int]] = {}
+        for i in np.nonzero(spec_rows)[0]:
+            i = int(i)
+            if spec.adaptive:
+                self._spec_ema_update(i, int(n_acc[i]))
+            s = self.slots[i]
+            assert s is not None
+            a = int(n_acc[i])
+            # the device committed t0..t_a (fed in the verify pass)
+            self._kv_lens[i] += a + 1
+            if self._temps[i] <= 0.0:
+                self.stats["spec_slot_steps"] += 1
+                self.stats["spec_drafted"] += int(ks_sel[i])
+                self.stats["spec_accepted"] += a
+                self.stats["spec_emitted"] += a + 1
+            commit: List[int] = []
+            for t in emitted[i]:
+                if t < 0 or s.finish_reason is not None:
+                    break
+                out.setdefault(i, []).append(int(t))
+                self._record_token(i, int(t), already_committed=True,
+                                   device_synced=True)
+                if s.finish_reason is None:
+                    commit.append(int(t))
+            self.manager.commit_tokens(s.seq_id, commit)
+            # precise rollback of the rejected window at the same step
+            # boundary (footprint matches a never-speculated engine)
+            if self.manager.trim_reserved(s.seq_id):
+                self._block_tables[i] = self.manager.block_table_for(
+                    s.seq_id, self.cfg.max_blocks_per_seq
+                )
+            self._maybe_release_window(i)
+        self._commit_ragged_admissions(ready, tok0, out)
         return out
 
     def _record_token(self, slot: int, tok: int, already_committed: bool = False,
@@ -2288,6 +2673,75 @@ class TPUEngine:
             self._record_token(i, tok, device_synced=True)
         return out
 
+    # ------------------------------------------- spec depth / oracle helpers
+
+    def _select_spec_ks(self, active: Sequence[int]) -> np.ndarray:
+        """Per-slot draft depth for the next dispatch. Non-adaptive: the
+        configured K everywhere. Adaptive: the smallest choice from the
+        static ``k_choices`` set strictly above the slot's accepted-length
+        EMA (always draft a little deeper than the recent accept), capped
+        at the largest choice. Depths select masks inside ONE compiled
+        graph — never a new trace."""
+        sp = self.cfg.speculative
+        assert sp is not None
+        ks = np.full((len(self.slots),), sp.num_draft_tokens, np.int32)
+        if sp.adaptive:
+            choices = sp.k_choices()
+            for i in active:
+                ema = float(self._spec_k_ema[i])
+                sel = choices[-1]
+                for c in choices:
+                    if ema < c:
+                        sel = c
+                        break
+                ks[i] = sel
+        if self.spec_k_trace is not None:
+            self.spec_k_trace.append([(int(i), int(ks[i])) for i in active])
+        return ks
+
+    def _spec_ema_update(self, slot: int, accepted: int) -> None:
+        sp = self.cfg.speculative
+        assert sp is not None
+        a = float(sp.adaptive_ema)
+        self._spec_k_ema[slot] = (
+            a * float(self._spec_k_ema[slot]) + (1.0 - a) * float(accepted)
+        )
+
+    def _spec_forced(self, active: Sequence[int], rounds: int,
+                     ks: np.ndarray) -> np.ndarray:
+        """Oracle-draft forced accepted lengths, [rounds, B] int32; -1 =
+        real acceptance (the production value — also every inactive row).
+        Fractional per-round targets (rate × K) dither through a per-slot
+        accumulator, so the mean over rounds hits the rate exactly and the
+        schedule is deterministic."""
+        sp = self.cfg.speculative
+        assert sp is not None
+        out = np.full((rounds, len(self.slots)), -1, np.int32)
+        rate = sp.oracle_accept_rate
+        if rate is None:
+            return out
+        for i in active:
+            target = float(rate) * float(ks[i])
+            for r in range(rounds):
+                self._spec_oracle_acc[i] += target
+                f = int(np.floor(self._spec_oracle_acc[i] + 1e-9))
+                f = max(0, min(f, int(ks[i])))
+                self._spec_oracle_acc[i] -= f
+                out[r, i] = f
+        return out
+
+    def set_spec_oracle(self, rate: Optional[float]) -> None:
+        """Flip the oracle draft's forced acceptance rate on a LIVE engine
+        (the bench A/B lever — the oracle is a traced input, so no
+        recompile). ``None`` restores real acceptance."""
+        sp = self.cfg.speculative
+        if sp is None:
+            raise ValueError("engine has no speculative config")
+        if rate is not None and not (0.0 <= float(rate) <= 1.0):
+            raise ValueError(f"oracle rate {rate} must be in [0, 1]")
+        sp.oracle_accept_rate = None if rate is None else float(rate)
+        self._spec_oracle_acc[:] = 0.0
+
     def spec_decode_step(self) -> Dict[int, List[int]]:
         """One speculative round for all active slots: draft K tokens per
         slot, verify the chain in one multi-query target pass, commit each
@@ -2305,7 +2759,6 @@ class TPUEngine:
         commits and emission bookkeeping exactly match the per-step path."""
         spec = self.cfg.speculative
         assert spec is not None and self._spec_rounds_fn is not None
-        k = spec.num_draft_tokens
         active = [
             i for i, s in enumerate(self.slots)
             if s is not None and s.finish_reason is None and not s.prefilling
@@ -2330,17 +2783,21 @@ class TPUEngine:
         rounds = max(1, min(int(num_steps),
                             int(max(budgets[i] for i in active))))
         rounds = 1 << (rounds.bit_length() - 1)
+        ks_sel = self._select_spec_ks(active)
         pressured: List[int] = []
         for i in active:
             s = self.slots[i]
             # reserve the dispatch's worst case up front — the device
             # cannot allocate mid-scan: commits are bounded by
             # min(rounds*(K+1), budget), plus K+1 so the final round's full
-            # window and the post-dispatch pending token stay covered.
-            # Near max_seq_len the window shrinks and the in-graph clamp +
-            # freeze honor the smaller cap.
+            # window and the post-dispatch pending token stay covered
+            # (K = the slot's SELECTED depth — adaptive shallow slots
+            # pre-book proportionally less). Near max_seq_len the window
+            # shrinks and the in-graph clamp + freeze honor the smaller
+            # cap.
             cur = len(self.manager.seq_tokens[s.seq_id])
-            want = min(rounds * (k + 1), int(budgets[i])) + k + 1
+            ki = int(ks_sel[i])
+            want = min(rounds * (ki + 1), int(budgets[i])) + ki + 1
             n_res = max(min(want, self.cfg.max_seq_len - cur), 0)
             try:
                 if n_res > 0 and self.manager.reserve_tokens(s.seq_id, n_res):
@@ -2366,6 +2823,9 @@ class TPUEngine:
         if not active_mask.any():
             return {}
         self._apply_pending()
+        forced = self._spec_forced(
+            [i for i in active if active_mask[i]], rounds, ks_sel
+        )
         core = self._sync_core()
         h_last = self._spec_h_device()
         tables, act_d, caps_d = self._sched_arrays(active_mask, caps)
@@ -2374,13 +2834,15 @@ class TPUEngine:
             (self.kv, self._dev_core, self._dev_spec_h,
              recs) = self._spec_rounds_fn(
                 self.params, self._draft_params, self.kv, core, h_last,
-                tables, act_d, caps_d, jnp.asarray(budgets), rounds, mode,
+                tables, act_d, caps_d, jnp.asarray(budgets),
+                jnp.asarray(ks_sel), jnp.asarray(forced), rounds, mode,
             )
         except Exception:
             self._invalidate_device_state()
             raise
         rec_emit, rec_nacc, rec_act = (np.asarray(r) for r in recs)
         self.stats["decode_calls"] += rounds
+        adaptive = spec.adaptive
         out: Dict[int, List[int]] = {}
         for r in range(rounds):
             act = rec_act[r]
@@ -2390,6 +2852,11 @@ class TPUEngine:
             for i in active:
                 if not act[i]:
                     continue
+                if adaptive:
+                    # EMA sees every round the row was live (sampled rows
+                    # contribute their structural zeros and converge to
+                    # the shallowest depth — less dead verify weight)
+                    self._spec_ema_update(i, int(rec_nacc[r, i]))
                 s = self.slots[i]
                 if s is None or s.finish_reason is not None:
                     continue
@@ -2402,7 +2869,7 @@ class TPUEngine:
                     # counting their forced zeros would dilute the exported
                     # accept-rate/tokens-per-step gauges under mixed traffic
                     self.stats["spec_slot_steps"] += 1
-                    self.stats["spec_drafted"] += k
+                    self.stats["spec_drafted"] += int(ks_sel[i])
                     self.stats["spec_accepted"] += a
                     self.stats["spec_emitted"] += a + 1
                 commit: List[int] = []

@@ -138,6 +138,48 @@ class SpecDecodeConfig:
     # distill with ``TPUEngine.distill_draft`` / distill_draft_params.
     draft_params: Optional[Dict[str, jax.Array]] = None
     draft_seed: int = 1
+    # acceptance-adaptive draft depth (round 8): a per-slot EMA of the
+    # ACCEPTED length selects each slot's draft depth from
+    # ``k_choices()`` — a small static set, so every depth runs through
+    # the SAME compiled graph (``num_draft_tokens`` stays the drafted
+    # width; per-slot depths beyond a slot's selected K are masked, never
+    # re-traced). Slots that accept little draft shallow (less wasted
+    # verify KV + reservation pressure — sampled slots, which never
+    # accept, converge to depth ``adaptive_min_k``); slots on a roll
+    # draft deep. The selection is host-side float arithmetic over
+    # integer accept counts: same seed → same K schedule, bit-for-bit.
+    adaptive: bool = False
+    adaptive_min_k: int = 1
+    adaptive_ema: float = 0.8            # EMA weight on the PREVIOUS value
+    adaptive_k_choices: Optional[Tuple[int, ...]] = None  # None = powers
+    #   of two from adaptive_min_k up, plus num_draft_tokens itself
+    # ORACLE draft (round 8, VERDICT r5 #3): force the per-round accepted
+    # length to ``rate * K`` (fractional rates dither deterministically)
+    # instead of matching against the target. Draft cost, verify cost, KV
+    # writes, commits, and rollback are all REAL — only the acceptance
+    # decision is forced — so the serving bench can measure the
+    # tok/s-vs-acceptance curve without trained draft weights
+    # (``benchmarks/worker_serving.py --spec``). Committed tokens are the
+    # (garbage) drafts: outputs are meaningless, pair with ignore_eos
+    # requests. None = real acceptance (the only production value).
+    oracle_accept_rate: Optional[float] = None
+
+    def k_choices(self) -> Tuple[int, ...]:
+        """The static set adaptive depth selects from (ascending, ending
+        at ``num_draft_tokens`` — ``validate`` rejects custom sets whose
+        top choice is below K, since the chain always DRAFTS K tokens and
+        a lower ceiling would make part of every round structurally
+        unacceptable; cap ``num_draft_tokens`` instead)."""
+        if self.adaptive_k_choices is not None:
+            return tuple(sorted(set(int(c) for c in self.adaptive_k_choices)))
+        lo = max(1, int(self.adaptive_min_k))
+        out = []
+        c = lo
+        while c < self.num_draft_tokens:
+            out.append(c)
+            c *= 2
+        out.append(self.num_draft_tokens)
+        return tuple(out)
 
     def validate(self, engine_cfg: Any) -> None:
         """Reject configs whose worst-case per-step block growth cannot fit
@@ -173,6 +215,53 @@ class SpecDecodeConfig:
                 f"{engine_cfg.max_seq_len}; num_draft_tokens is the "
                 "limiting field"
             )
+        if getattr(engine_cfg, "kv_seq_sharded", False):
+            # name the fence instead of silently falling back to split
+            # paths: seq-sharded pools read decode rows through a
+            # dedicated shard_map partial-softmax op with no multi-token
+            # verify-window variant, and the in-graph draft chain has no
+            # sharded-pool read path either
+            raise ValueError(
+                "speculative + kv_seq_sharded is fenced: the seq-sharded "
+                "pool decode read (shard_map partial-softmax op) has no "
+                "multi-query verify-window variant, so draft/verify "
+                "rounds cannot read sharded pools — drop kv_seq_sharded "
+                "or EngineConfig.speculative"
+            )
+        if self.oracle_accept_rate is not None and not (
+            0.0 <= float(self.oracle_accept_rate) <= 1.0
+        ):
+            raise ValueError(
+                f"SpecDecodeConfig.oracle_accept_rate="
+                f"{self.oracle_accept_rate}: must be in [0, 1] (fraction "
+                "of drafted tokens force-accepted per round)"
+            )
+        if self.adaptive:
+            if not (0.0 <= float(self.adaptive_ema) < 1.0):
+                raise ValueError(
+                    f"SpecDecodeConfig.adaptive_ema={self.adaptive_ema}: "
+                    "must be in [0, 1)"
+                )
+            if not (1 <= int(self.adaptive_min_k) <= k):
+                # k_choices() would silently collapse to (K,) — pinning
+                # every slot at full depth while the config promises a
+                # floor — so reject instead
+                raise ValueError(
+                    f"SpecDecodeConfig.adaptive_min_k="
+                    f"{self.adaptive_min_k}: must be in "
+                    f"[1, num_draft_tokens={k}]"
+                )
+            choices = self.k_choices()
+            if choices[0] < 1 or choices[-1] != k:
+                # a top choice above K is unreachable; one BELOW K would
+                # silently waste draft/verify work every round (the chain
+                # always drafts K tokens) — lower num_draft_tokens instead
+                raise ValueError(
+                    f"SpecDecodeConfig adaptive depth choices {choices} "
+                    f"must lie in [1, num_draft_tokens={k}] and end at "
+                    f"num_draft_tokens; adaptive_min_k/adaptive_k_choices "
+                    "are the limiting fields"
+                )
 
 
 @dataclass
@@ -569,23 +658,26 @@ class SpeculativeDecoder:
         seed: int = 0,
         eos_token_id: Optional[int] = None,
         prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128, 256, 512, 1024),
+        kv_cache_dtype: Optional[str] = None,
     ) -> None:
+        """``kv_cache_dtype``: ``"int8"`` stores the decoder's pools
+        quantized (per-(page, token) scale pools ride alongside; the tree
+        verify pass dequantizes context-sized through the shared
+        ``ops.attention.dequantize_kv`` arithmetic, and path compaction
+        moves code + scale rows as an atomic pair). Sliding-window models
+        speculate at any tree depth since round 8 — the tree-attention
+        mask windows within-chunk node visibility by semantic position
+        (``ops.attention.paged_tree_attention``)."""
         self.model_cfg = (
             get_model_config(model_cfg) if isinstance(model_cfg, str) else model_cfg
         )
         self.spec_cfg = spec_cfg or SpeculativeConfig()
-        sw = self.model_cfg.sliding_window
-        if sw is not None:
-            # tree verify skips window masking within the chunk on the
-            # assumption depth << window; surface the conflict at
-            # construction, not mid-request in the first traced step
-            n_nodes = TreeTopology(tuple(self.spec_cfg.widths)).num_nodes
-            if n_nodes >= sw:
-                raise ValueError(
-                    f"speculative tree of {n_nodes} nodes >= "
-                    f"sliding_window={sw} of {self.model_cfg.name}: shrink "
-                    "spec_cfg.widths or use a non-windowed model"
-                )
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"SpeculativeDecoder kv_cache_dtype={kv_cache_dtype!r}: "
+                "only int8 (or None = model dtype) is wired"
+            )
+        self.kv_dtype = jnp.int8 if kv_cache_dtype == "int8" else None
         self.block_size = block_size
         self.max_batch_size = max_batch_size
         self.max_seq_len = max_seq_len
@@ -612,7 +704,9 @@ class SpeculativeDecoder:
                 ),
             )
         )
-        self.kv = llama.init_kv_pools(self.model_cfg, self.num_blocks, block_size)
+        self.kv = llama.init_kv_pools(
+            self.model_cfg, self.num_blocks, block_size, dtype=self.kv_dtype
+        )
         self.manager = PagedKVCacheManager(self.num_blocks, block_size)
         self.eos_token_id = eos_token_id
         self.prefill_buckets = tuple(sorted(prefill_buckets))
@@ -754,11 +848,18 @@ class SpeculativeDecoder:
             src_pos = jnp.where(live, prefix_lens[:, None] + path, -1)
             dst_pos = prefix_lens[:, None] + 1 + jnp.arange(dmax)[None, :]
             dst_pos = jnp.where(live, dst_pos, -1)
-            kv2 = {
+            moved = {
                 "k": _move_rows(kv2["k"], block_tables, src_pos, dst_pos, bs),
                 "v": _move_rows(kv2["v"], block_tables, src_pos, dst_pos, bs),
             }
-            return kv2, accepted_tokens, n_accept, bonus, new_h
+            # int8 pools: a code row without its scale is garbage — the
+            # compaction moves them as an atomic pair
+            for sk in ("k_scale", "v_scale"):
+                if sk in kv2:
+                    moved[sk] = _move_scale_rows(
+                        kv2[sk], block_tables, src_pos, dst_pos, bs
+                    )
+            return moved, accepted_tokens, n_accept, bonus, new_h
 
         return step
 
@@ -1201,3 +1302,37 @@ def _move_rows(
     # [T, L, Hkv, D]
     flat = rows.reshape(b * p, pool.shape[0], pool.shape[2], pool.shape[4])
     return pool.at[:, wphys, :, wslot].set(flat, mode="drop")
+
+
+def _move_scale_rows(
+    pool: jax.Array,          # [L, N, Bk, D] bf16 scale pool (int8 KV)
+    block_tables: jax.Array,  # [B, M]
+    src_pos: jax.Array,       # [B, P] token positions (-1 invalid)
+    dst_pos: jax.Array,       # [B, P]
+    block_size: int,
+) -> jax.Array:
+    """Scale-pool twin of :func:`_move_rows` (no head axis): int8 path
+    compaction must move each code row's per-(page, token) scale with it
+    or the copied page dequantizes with a stale scale."""
+    num_blocks = pool.shape[1]
+    b, p = src_pos.shape
+
+    def phys_slot(pos):
+        valid = pos >= 0
+        safe = jnp.maximum(pos, 0)
+        logical = safe // block_size
+        slot = safe % block_size
+        phys = jnp.take_along_axis(block_tables, logical, axis=1)
+        return jnp.where(valid, phys, num_blocks), slot, valid
+
+    sphys, sslot, svalid = phys_slot(src_pos)
+    dphys, dslot, dvalid = phys_slot(dst_pos)
+    # advanced indices on dims 1 (page) and 2 (slot) are adjacent here, so
+    # the indexed dims stay IN PLACE: rows [L, B, P, D]
+    rows = pool[
+        :, jnp.where(svalid, sphys, 0), jnp.where(svalid, sslot, 0)
+    ]
+    wphys = jnp.where(svalid & dvalid, dphys, num_blocks).reshape(-1)
+    wslot = dslot.reshape(-1)
+    flat = rows.reshape(pool.shape[0], b * p, pool.shape[3])
+    return pool.at[:, wphys, wslot].set(flat, mode="drop")

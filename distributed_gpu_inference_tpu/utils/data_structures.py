@@ -360,6 +360,10 @@ class SamplingParams:
     top_p: float = 1.0            # 1.0 → disabled
     stop_token_ids: Tuple[int, ...] = ()
     seed: Optional[int] = None
+    # run to the max_new_tokens budget, honoring NO stop ids (engine eos
+    # included) — benchmark/oracle workloads where both A/B legs must
+    # generate identical token counts (vLLM's ignore_eos parity knob)
+    ignore_eos: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -369,12 +373,14 @@ class SamplingParams:
             "top_p": self.top_p,
             "stop_token_ids": list(self.stop_token_ids),
             "seed": self.seed,
+            "ignore_eos": self.ignore_eos,
         }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "SamplingParams":
         d = dict(d)
         d["stop_token_ids"] = tuple(d.get("stop_token_ids", ()))
+        d["ignore_eos"] = bool(d.get("ignore_eos", False))
         return cls(**d)
 
 

@@ -46,6 +46,9 @@ class GenerationConfig:
     # the reference forwards it to vLLM as stop_token_ids)
     stop_token_ids: List[int] = field(default_factory=list)
     seed: Optional[int] = None
+    # run to the max_new_tokens budget, honoring no stops (benchmark
+    # workloads where A/B legs must generate identical token counts)
+    ignore_eos: bool = False
 
     @classmethod
     def from_params(cls, params: Dict[str, Any]) -> "GenerationConfig":
@@ -60,6 +63,7 @@ class GenerationConfig:
             stop_token_ids=[int(t) for t in
                             (params.get("stop_token_ids") or [])],
             seed=params.get("seed"),
+            ignore_eos=bool(params.get("ignore_eos") or False),
         )
 
 

@@ -313,6 +313,12 @@ class TPULLMEngine(LLMBaseEngine):
                 self.config.get("enable_prefix_cache", True)
             ),
             quantization=self.config.get("quantization"),
+            # KV-pool storage dtype (int8 | fp8 | None = activation dtype)
+            # — previously engine-API-only; spec verify reads int8 pools
+            # through the ragged kernel's in-kernel dequant since round 8,
+            # so the worker config can finally compose quantized KV with
+            # speculative serving
+            kv_cache_dtype=self.config.get("kv_cache_dtype"),
             spill_host_blocks=int(self.config.get("kv_spill_host_blocks", 0)),
             spill_remote_store=remote_store_from_url(
                 self.config.get("kv_remote_url"),
@@ -332,9 +338,22 @@ class TPULLMEngine(LLMBaseEngine):
             from ...runtime.speculative import SpecDecodeConfig
 
             try:
+                oracle = self.config.get("spec_oracle_accept")
                 eng_cfg.speculative = SpecDecodeConfig(
                     num_draft_tokens=int(
                         self.config.get("spec_num_draft_tokens", 4)
+                    ),
+                    # acceptance-adaptive draft depth (per-slot EMA
+                    # selects K from a static set — one compiled graph)
+                    adaptive=bool(self.config.get("spec_adaptive", False)),
+                    adaptive_min_k=int(
+                        self.config.get("spec_adaptive_min_k", 1)
+                    ),
+                    # bench-only oracle draft: force the acceptance rate
+                    # (fraction of drafted tokens) — real cost, forced
+                    # decision; outputs are garbage, pair with ignore_eos
+                    oracle_accept_rate=(
+                        None if oracle is None else float(oracle)
                     ),
                 )
                 eng_cfg.speculative.validate(eng_cfg)
@@ -587,6 +606,21 @@ class TPULLMEngine(LLMBaseEngine):
             ids.append(int(eos))
         return tuple(ids[:4])
 
+    def _sampling_from(self, cfg: GenerationConfig) -> SamplingParams:
+        """THE GenerationConfig → SamplingParams mapping — every request
+        construction path (interactive, batch, PD prefill) goes through
+        here so per-request knobs like ``ignore_eos`` cannot be honored on
+        one path and dropped on another."""
+        return SamplingParams(
+            max_new_tokens=cfg.max_new_tokens,
+            temperature=cfg.temperature,
+            top_k=cfg.top_k,
+            top_p=cfg.top_p,
+            stop_token_ids=(() if cfg.ignore_eos else self._stop_ids(cfg)),
+            seed=cfg.seed,
+            ignore_eos=cfg.ignore_eos,
+        )
+
     def _build_request(self, prompt_or_messages: Any,
                        cfg: GenerationConfig) -> InferenceRequest:
         """One request builder for the blocking AND streaming paths — the
@@ -607,14 +641,7 @@ class TPULLMEngine(LLMBaseEngine):
             token_ids = token_ids[-max_prompt:]  # keep the tail (recency)
         return InferenceRequest(
             prompt_token_ids=token_ids,
-            sampling=SamplingParams(
-                max_new_tokens=cfg.max_new_tokens,
-                temperature=cfg.temperature,
-                top_k=cfg.top_k,
-                top_p=cfg.top_p,
-                stop_token_ids=self._stop_ids(cfg),
-                seed=cfg.seed,
-            ),
+            sampling=self._sampling_from(cfg),
         )
 
     # -- PD disaggregation stages (server/pd_flow.py drives these) ----------
@@ -694,12 +721,7 @@ class TPULLMEngine(LLMBaseEngine):
         if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
             req = InferenceRequest(
                 prompt_token_ids=[int(t) for t in prompt],
-                sampling=SamplingParams(
-                    max_new_tokens=cfg.max_new_tokens,
-                    temperature=cfg.temperature,
-                    top_k=cfg.top_k, top_p=cfg.top_p,
-                    stop_token_ids=self._stop_ids(cfg), seed=cfg.seed,
-                ),
+                sampling=self._sampling_from(cfg),
             )
         else:
             req = self._build_request(prompt, cfg)
@@ -1741,14 +1763,7 @@ class TPULLMEngine(LLMBaseEngine):
             reqs.append(
                 InferenceRequest(
                     prompt_token_ids=list(self.tokenizer.encode(text)),
-                    sampling=SamplingParams(
-                        max_new_tokens=cfg.max_new_tokens,
-                        temperature=cfg.temperature,
-                        top_k=cfg.top_k,
-                        top_p=cfg.top_p,
-                        stop_token_ids=self._stop_ids(cfg),
-                        seed=cfg.seed,
-                    ),
+                    sampling=self._sampling_from(cfg),
                 )
             )
         resps = self.engine.generate(reqs, use_multi_step=True)

@@ -27,6 +27,8 @@ HEAVY = [
     #   behind a live control plane (byte-identity ON/OFF)
     "tests/test_parallel_pipeline.py",
     "tests/test_parallel_ring_attention.py",
+    "tests/test_spec_serving.py",        # spec x ragged x int8 identity
+    #   matrix (many engine builds) + spec ragged serving e2e
     "tests/test_engine_spec_integrated.py",  # spec scan graphs x 2 engines
     "tests/test_engine_preemption.py",   # preempt/resume byte-identity runs
     "tests/test_kv_pressure_chaos.py",   # 25-seed kv_pressure storms

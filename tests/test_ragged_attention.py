@@ -438,7 +438,8 @@ def test_use_ragged_resolution():
     assert ContinuousBatcher(_RaggedEng(), BatcherConfig()).use_ragged
     assert not ContinuousBatcher(
         _RaggedEng(), BatcherConfig(ragged=False)).use_ragged
-    # fakes / spec-integrated / seq-sharded engines: no supports_ragged
+    # fakes / seq-sharded engines: no supports_ragged (spec-integrated
+    # engines serve ragged since round 8 — tests/test_spec_serving.py)
     assert not ContinuousBatcher(_Eng(), BatcherConfig()).use_ragged
     # ragged=True is REQUIRE, not prefer: a silent legacy fallback would
     # make every downstream A/B ratio a lie — rejected at init and at
@@ -460,9 +461,10 @@ def test_supports_ragged_engine_facts(params):
 
     eng = TPUEngine(CFG, _ecfg(), params=params)
     assert eng.supports_ragged
-    # seq-sharded pools and spec-integrated engines keep the split paths
-    # (different round shapes); flip the config facts on the live object —
-    # constructing either engine needs a mesh / draft params
+    # seq-sharded pools keep the split paths (their decode rows read
+    # through a dedicated shard_map op); spec-integrated engines serve
+    # ragged since round 8. Flip the config fact on the live object —
+    # constructing a seq-sharded engine needs a mesh
     orig = eng.cfg
     try:
         eng.cfg = dataclasses.replace(orig, kv_seq_sharded=True)
