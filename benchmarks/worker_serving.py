@@ -649,6 +649,252 @@ def run_fleet(args: Any, backend: str, model: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --chaos (round 9): the CLUSTER frontier and the brownout curve. Fleet mode
+# gains chaos: LiveFleet (testing/harness.py — N REAL workers behind the
+# live control plane) serves the same open-loop Poisson workload at 1/2/4
+# replicas for the aggregate frontier, then a seeded kill/restart executes
+# MID-WORKLOAD and the brownout leg publishes what the outage actually
+# costs: SLO percentiles inside the kill window, goodput (token throughput
+# during the window vs calm), and time-to-recover (restart → first request
+# served by the rejoined replica). Greedy outputs chaos-on vs chaos-off are
+# byte-identical — the failover machinery never changes WHAT is generated,
+# only when and where.
+# ---------------------------------------------------------------------------
+
+
+async def _drive_fleet_direct(plane_url: str, prompts: List[str],
+                              arrivals: List[float], max_tokens: int,
+                              ) -> Tuple[List[Dict[str, Any]], float]:
+    """Open-loop direct-path driver that SURVIVES chaos: each request
+    discovers its worker per attempt, excludes workers it just watched
+    die, and retries until it lands — the client behavior a production
+    SDK implements, so brownout numbers measure the fleet, not a fragile
+    driver. Records client e2e, engine TTFT, serving worker, and
+    completion wall offset for window bucketing."""
+    import httpx
+
+    t0 = time.perf_counter()
+    async with httpx.AsyncClient(timeout=600.0) as client:
+
+        async def one(i: int, prompt: str, at: float) -> Dict[str, Any]:
+            now = time.perf_counter() - t0
+            if at > now:
+                await asyncio.sleep(at - now)
+            rec: Dict[str, Any] = {"i": i, "arrival_s": at, "status": 0}
+            t_req = time.perf_counter()
+            exclude: List[str] = []
+            # deadline-based retry: an open-loop client under brownout (or
+            # plain oversubscription) keeps retrying — the SLO cost shows
+            # up as e2e latency, not as failed requests
+            while time.perf_counter() - t_req < 180.0:
+                wid = None
+                try:
+                    d = await client.get(
+                        f"{plane_url}/api/v1/jobs/direct/nearest",
+                        params={"exclude": ",".join(exclude)}
+                        if exclude else None,
+                    )
+                    if d.status_code != 200:
+                        # fleet momentarily dark (sweep lag): back off
+                        exclude = []
+                        await asyncio.sleep(0.15)
+                        continue
+                    disc = d.json()
+                    wid = disc["worker_id"]
+                    r = await client.post(
+                        disc["direct_url"] + "/inference", json={
+                            "type": "llm",
+                            "params": {"prompt": prompt,
+                                       "max_new_tokens": max_tokens},
+                        })
+                    if r.status_code == 200:
+                        res = r.json().get("result") or {}
+                        rec.update({
+                            "status": 200,
+                            "e2e_ms": (time.perf_counter() - t_req) * 1e3,
+                            "done_s": time.perf_counter() - t0,
+                            "ttft_ms": res.get("ttft_ms"),
+                            "worker_id": wid,
+                            "text": res.get("text"),
+                            "completion_tokens": (res.get("usage") or {})
+                            .get("completion_tokens") or 0,
+                        })
+                        return rec
+                    if r.status_code == 503:
+                        await asyncio.sleep(0.1)   # busy: same worker frees up
+                        continue
+                    if wid and wid not in exclude:
+                        exclude.append(wid)
+                except httpx.TransportError:
+                    # the worker died on us mid-request: exclude the corpse
+                    if wid and wid not in exclude:
+                        exclude.append(wid)
+                    await asyncio.sleep(0.05)
+            rec["status"] = 599
+            return rec
+
+        results = list(await asyncio.gather(
+            *(one(i, p, a) for i, (p, a) in
+              enumerate(zip(prompts, arrivals)))
+        ))
+    return results, time.perf_counter() - t0
+
+
+def _fleet_leg(fleet: Any, prompts: List[str], arrivals: List[float],
+               max_tokens: int) -> Tuple[List[Dict[str, Any]], float]:
+    return asyncio.run(_drive_fleet_direct(
+        fleet.url, prompts, arrivals, max_tokens
+    ))
+
+
+def _aggregate_summary(results: List[Dict[str, Any]],
+                       elapsed: float) -> Dict[str, Any]:
+    ok = [r for r in results if r["status"] == 200]
+    toks = sum(r.get("completion_tokens") or 0 for r in ok)
+    return {
+        "ok": len(ok), "failed": len(results) - len(ok),
+        "elapsed_s": round(elapsed, 3),
+        "aggregate_tokens_per_s": round(toks / elapsed, 2) if elapsed
+        else 0.0,
+        "ttft_ms": percentiles(
+            [r["ttft_ms"] for r in ok if r.get("ttft_ms") is not None]
+        ),
+        "e2e_ms": percentiles([r["e2e_ms"] for r in ok]),
+        "requests_by_worker": {
+            w: sum(1 for r in ok if r.get("worker_id") == w)
+            for w in {r.get("worker_id") for r in ok if r.get("worker_id")}
+        },
+    }
+
+
+def run_chaos_fleet(args: Any, backend: str, model: str) -> None:
+    import numpy as _np
+
+    from distributed_gpu_inference_tpu.testing.faults import (
+        FleetEvent,
+        FleetFaultPlan,
+    )
+    from distributed_gpu_inference_tpu.testing.harness import LiveFleet
+
+    engine_config = {
+        "model": model,
+        "max_batch_size": args.concurrency,
+        "max_seq_len": args.prompt_len + args.max_tokens + 16,
+        "quantization": args.quantization,
+        "serving": {
+            "queue_limit": max(4096, args.requests * 2),
+            "default_timeout_s": 600.0,
+        },
+    }
+    prompts = synth_prompt_strings(args.requests, args.prompt_len,
+                                   args.shared_prefix, seed=args.seed)
+    rate = float(args.arrival_rate) if args.arrival_rate else 4.0
+    gaps = _np.random.default_rng(args.seed).exponential(
+        1.0 / rate, len(prompts)
+    )
+    arrivals = [float(a) for a in _np.cumsum(gaps)]
+    span = arrivals[-1]
+
+    out: Dict[str, Any] = {
+        "benchmark": "worker_serving_fleet_chaos",
+        "path": "control_plane+direct_nearest+live_fleet",
+        "model": model, "backend": backend, "seed": args.seed,
+        "requests": args.requests, "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len, "max_tokens": args.max_tokens,
+        "arrival_rate_rps": rate,
+    }
+
+    # ---- cluster frontier: the same offered load at 1/2/4 replicas
+    frontier = []
+    for n in [int(x) for x in str(args.replicas).split(",") if x.strip()]:
+        with LiveFleet(n=n, engine_config=engine_config) as fleet:
+            _fleet_leg(fleet, prompts, arrivals, args.max_tokens)  # warm
+            results, elapsed = _fleet_leg(fleet, prompts, arrivals,
+                                          args.max_tokens)
+            entry = {"replicas": n, **_aggregate_summary(results, elapsed)}
+            frontier.append(entry)
+    out["cluster_frontier"] = frontier
+
+    # ---- brownout: seeded kill mid-workload at the chaos replica count
+    n = int(args.chaos_replicas)
+    t_kill = round(0.30 * span, 3)
+    t_restart = round(0.60 * span, 3)
+    with LiveFleet(n=n, engine_config=engine_config) as fleet:
+        _fleet_leg(fleet, prompts, arrivals, args.max_tokens)      # warm
+        calm_results, calm_elapsed = _fleet_leg(
+            fleet, prompts, arrivals, args.max_tokens
+        )
+        calm = _aggregate_summary(calm_results, calm_elapsed)
+
+        plan = FleetFaultPlan(args.seed, n_workers=n, duration_s=span)
+        plan.events = [FleetEvent(t_kill, "kill", 0),
+                       FleetEvent(t_restart, "restart", 0)]
+        fleet.run_chaos(plan)
+        try:
+            chaos_results, chaos_elapsed = _fleet_leg(
+                fleet, prompts, arrivals, args.max_tokens
+            )
+        finally:
+            fleet.wait_chaos()
+        chaos = _aggregate_summary(chaos_results, chaos_elapsed)
+
+        # schedule offsets as EXECUTED (the trace is wall-clock-stamped)
+        kill_at = next(t for t, k, _ in plan.trace if k == "kill")
+        restart_at = next(t for t, k, _ in plan.trace if k == "restart")
+        killed_wid = fleet.members[0].worker_id
+
+        ok = [r for r in chaos_results if r["status"] == 200]
+        in_window = [r for r in ok
+                     if kill_at <= r["arrival_s"] < restart_at]
+        # goodput: token throughput the degraded fleet sustained during
+        # the kill window, as a fraction of the calm leg's aggregate
+        window_tokens = sum(
+            r.get("completion_tokens") or 0 for r in ok
+            if kill_at <= r.get("done_s", 0.0) < restart_at
+        )
+        window_s = max(1e-6, restart_at - kill_at)
+        calm_tps = calm["aggregate_tokens_per_s"] or 1e-6
+        # time-to-recover: restart → the rejoined replica serves again
+        recovered = [r["done_s"] for r in ok
+                     if r.get("worker_id") == killed_wid
+                     and r.get("done_s", 0.0) >= restart_at]
+        brownout = {
+            "replicas": n,
+            "kill_at_s": round(kill_at, 3),
+            "restart_at_s": round(restart_at, 3),
+            "killed_worker": killed_wid,
+            "calm": calm,
+            "chaos": chaos,
+            "kill_window": {
+                "offered": len([r for r in chaos_results
+                                if kill_at <= r["arrival_s"] < restart_at]),
+                "completed_ok": len(in_window),
+                "ttft_ms": percentiles(
+                    [r["ttft_ms"] for r in in_window
+                     if r.get("ttft_ms") is not None]
+                ),
+                "e2e_ms": percentiles([r["e2e_ms"] for r in in_window]),
+                "goodput_vs_calm": round(
+                    (window_tokens / window_s) / calm_tps, 3
+                ),
+            },
+            "time_to_recover_s": round(min(recovered) - restart_at, 3)
+            if recovered else None,
+        }
+        chaos_texts = {r["i"]: r.get("text") for r in chaos_results
+                       if r["status"] == 200}
+        calm_texts = {r["i"]: r.get("text") for r in calm_results
+                      if r["status"] == 200}
+        brownout["outputs_identical"] = (
+            len(chaos_texts) == len(calm_texts) == len(prompts)
+            and chaos_texts == calm_texts
+        )
+        out["brownout"] = brownout
+        out["chaos_trace"] = [list(t) for t in plan.trace]
+    emit(out)
+
+
+# ---------------------------------------------------------------------------
 # --spec (round 8): spec ON vs OFF on the SLO frontier with an ORACLE draft.
 # Real 8B trained draft heads are environment-blocked (VERDICT r5 #3), but
 # the win condition is testable without them: the oracle forces the
@@ -858,6 +1104,19 @@ def main() -> None:
                     help="≥2 stands up a FLEET behind a live control "
                     "plane and A/Bs cache-aware routing (admin flag "
                     "flipped live) on a seeded multi-tenant workload")
+    ap.add_argument("--chaos", action="store_true",
+                    help="cluster frontier + brownout mode: drive the "
+                    "same open-loop workload through a LiveFleet at "
+                    "--replicas counts, then replay it with a seeded "
+                    "kill/restart mid-workload and publish SLO-in-window, "
+                    "goodput, time-to-recover, and chaos-on/off "
+                    "byte-identity")
+    ap.add_argument("--replicas", default="1,2,4",
+                    help="comma-separated replica counts for the --chaos "
+                    "cluster frontier sweep")
+    ap.add_argument("--chaos-replicas", type=int, default=2,
+                    help="fleet size for the --chaos brownout leg "
+                    "(one replica is killed and restarted)")
     ap.add_argument("--scenario", default="chat",
                     choices=["chat", "rag", "bursty", "priority"],
                     help="fleet-mode workload (benchmarks/workloads.py)")
@@ -868,6 +1127,13 @@ def main() -> None:
     args = ap.parse_args()
 
     backend, model = resolve_backend_model(args)
+
+    if args.chaos:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--chaos takes a single --arrival-rate (the sweep "
+                     "axis is the replica count)")
+        run_chaos_fleet(args, backend, model)
+        return
 
     if args.workers >= 2:
         if args.arrival_rate and "," in str(args.arrival_rate):

@@ -745,12 +745,20 @@ class ContinuousBatcher:
                 except OutOfBlocksError:
                     if self.engine.num_active == 0 and \
                             self._chunked is None:
-                        # an IDLE pool that cannot re-admit the sequence
-                        # never will (nothing left to free): after a few
-                        # consecutive tries, deliver the partial output
-                        # instead of spinning until the client's timeout
+                        # an IDLE pool that STATICALLY cannot hold the
+                        # sequence never will (nothing left to free):
+                        # after a few consecutive tries, deliver the
+                        # partial output instead of spinning until the
+                        # client's timeout. A statically-fitting resume
+                        # keeps retrying — an idle-pool allocation failure
+                        # is then transient by construction (cache
+                        # eviction in flight, injected chaos pressure),
+                        # and aborting would turn a 2-second storm into a
+                        # permanently failed request (fleet chaos suite).
                         item.idle_resume_oob += 1
-                        if item.idle_resume_oob > 2:
+                        if item.idle_resume_oob > 2 and not \
+                                self.engine.resume_fits_pool(
+                                    item.preempted):
                             pre = item.preempted
                             if not item.future.done():
                                 item.future.set_result(InferenceResponse(

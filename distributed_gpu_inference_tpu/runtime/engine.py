@@ -1490,11 +1490,29 @@ class TPUEngine:
         generous-cap/short-output workload that served fine. Growth beyond
         the pool is a DYNAMIC condition the preemption machinery absorbs,
         bounded by the scheduler's preemption/resume caps."""
-        tokens = len(request.prompt_token_ids or []) + 1
+        return self._fits_empty_pool(len(request.prompt_token_ids or []) + 1)
+
+    def _fits_empty_pool(self, tokens: int) -> bool:
+        """One fit rule for admission AND resume: ``tokens`` context (+
+        the speculative verify window) against the whole pool minus the
+        reserved pad block — the two callers must never disagree about
+        what fits."""
         if self.cfg.speculative is not None:
             tokens += self.cfg.speculative.num_draft_tokens + 1
         need = -(-tokens // self.cfg.block_size)
         return need <= self.num_blocks - 1   # block 0 is the reserved pad
+
+    def resume_fits_pool(self, pre: "PreemptedSequence") -> bool:
+        """Static admissibility of a RESUME: the preempted sequence's
+        prompt + already-generated context + pending token (+ the spec
+        verify window) against an EMPTY pool. Only a sequence failing
+        this can never be re-admitted — an allocation failure on a
+        statically-fitting resume is a dynamic condition (cache eviction
+        in flight, a transient allocator fault injected by chaos, another
+        admission racing) and must be retried, not aborted: the fleet
+        chaos suite showed a 2-second injected pressure storm permanently
+        killing requests the pool could trivially hold a moment later."""
+        return self._fits_empty_pool(pre.prompt_len + len(pre.generated) + 1)
 
     def take_pressure(self) -> Optional[KVPressure]:
         """Consume the pending pressure signal (None when the last round

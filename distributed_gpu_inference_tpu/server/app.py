@@ -87,6 +87,11 @@ class ServerState:
             # container promptly (and cancel orphaned siblings) instead of
             # stranding the parent until its own timeout
             on_permanent_failure=self.pd_flow.on_job_permanently_failed,
+            # partition staleness: the moment a worker is marked offline
+            # (self-reported, admin, or heartbeat sweep) its advertised
+            # prefix summary is zeroed — affinity must never keep routing
+            # at a dead warm worker while its staleness TTL runs down
+            on_worker_offline=self._invalidate_prefix_summary,
         )
         self.background = TaskGuaranteeBackgroundWorker(self.guarantee)
         self.geo = GeoService()
@@ -115,6 +120,19 @@ class ServerState:
         # invalidate it, so admission decisions always see fresh depth.
         self._bp_cache: Optional[tuple] = None   # (expires_at, stats)
         self.started_at = time.time()
+
+    async def _invalidate_prefix_summary(self, worker_id: str,
+                                         reason: str) -> None:
+        """Offline-worker hook: drop the in-memory summary (counted) and
+        its persisted warm-start row, so neither live scoring nor a
+        control-plane restart resurrects a dead worker's affinity."""
+        if self.prefix_registry.invalidate_worker(
+            worker_id, reason=reason, metrics=self.metrics
+        ):
+            try:
+                await self.store.delete_prefix_summary(worker_id)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
 
     def bp_cache_clear(self) -> None:
         """Invalidate the backpressure queue-stats cache — called after any
@@ -272,6 +290,27 @@ async def _register_worker_locked(st: ServerState,
             fingerprint, str(uuid.uuid4())
         )
     worker_id = worker_id or str(uuid.uuid4())
+    # restart-with-reregistration: landing on a row that already completed
+    # a registration (it holds issued credentials) AND looks dead (swept
+    # offline, or heartbeat-silent past the timeout) means the previous
+    # incarnation of this machine is gone — whatever it was RUNNING will
+    # never complete. Requeue those jobs NOW (epoch bumps on the next
+    # claim, fencing any zombie remnant) instead of stranding them until
+    # the stale-job sweep's per-job timeout, and count the rejoin. A row
+    # with a RECENT heartbeat is NOT treated as dead: a live worker
+    # re-registers to recover from a credential blip (401 + failed
+    # refresh), and destructively requeueing the work it is actively
+    # generating would turn that blip into duplicate compute — its jobs
+    # stay put, and the sweep covers the case where it really is dying.
+    prior = await st.store.get_worker(worker_id)
+    rejoined = False
+    if prior is not None and prior.get("auth_token_hash") is not None:
+        hb = prior.get("last_heartbeat")
+        rejoined = (
+            prior.get("status") == WorkerState.OFFLINE.value
+            or hb is None
+            or time.time() - float(hb) > st.guarantee._heartbeat_timeout_s
+        )
     bundle, stored = st.security.tokens.issue()
     row: Dict[str, Any] = {
         "id": worker_id,
@@ -303,6 +342,17 @@ async def _register_worker_locked(st: ServerState,
         **stored,
     }
     await st.store.upsert_worker(row)
+    if rejoined:
+        st.metrics.record_worker_rejoin(worker_id)
+        for job in await st.store.list_jobs(
+            status=[JobStatus.RUNNING.value], worker_id=worker_id
+        ):
+            # conditional requeue via the guarantee layer: a completion
+            # racing this re-registration keeps its terminal status
+            await st.guarantee.requeue_job(job, reason="worker_reregistered")
+        # the fresh process starts with a COLD cache: its pre-restart
+        # summary must not keep earning affinity until the TTL expires
+        await st._invalidate_prefix_summary(worker_id, "worker_reregistered")
     await st.reliability.start_session(worker_id)
     cfg = await st.worker_config.get_config(worker_id)
     st.security.audit.log("worker_registered", actor=worker_id)
@@ -412,9 +462,11 @@ async def heartbeat(request: web.Request) -> web.Response:
     if w.get("status") == WorkerState.OFFLINE.value:
         # swept offline but evidently alive: revive (a heartbeat IS proof of
         # life) and open a fresh reliability session so online-time
-        # accounting resumes
+        # accounting resumes. Counted as a fleet rejoin — the degradation
+        # panel reads recovery from this counter.
         fields.setdefault("status", WorkerState.IDLE.value)
         await st.reliability.start_session(worker_id)
+        st.metrics.record_worker_rejoin(worker_id)
     es = body.get("engine_stats")
     if isinstance(es, dict):
         # payload hygiene: the engine_stats side channel is worker-supplied
@@ -539,8 +591,14 @@ async def next_job(request: web.Request) -> web.Response:
         worker_id, job["type"], rand=_random.random(),
         ignore_job_id=job["id"],
     ):
-        await st.store.update_job(
-            job["id"], status=JobStatus.QUEUED.value, worker_id=None,
+        # conditional release: between our claim and this decline a sweep
+        # (or admin cancel) may have moved the job — an unconditional
+        # overwrite would clobber another worker's fresh claim or revert a
+        # terminal status back to QUEUED (stale-claim race under
+        # concurrent failover)
+        await st.store.try_transition_job(
+            job["id"], JobStatus.RUNNING.value, owned_by=worker_id,
+            status=JobStatus.QUEUED.value, worker_id=None,
             started_at=None,
         )
         await st.store.update_worker(
@@ -565,8 +623,13 @@ async def release_job(request: web.Request) -> web.Response:
     if job is None or job.get("worker_id") != worker_id:
         return _json_error(404, "job not assigned to this worker")
     if job["status"] == JobStatus.RUNNING.value:
-        await st.store.update_job(
-            job_id, status=JobStatus.QUEUED.value, worker_id=None,
+        # conditional: a sweep requeue + another worker's re-claim can land
+        # between our read and this write — releasing unconditionally
+        # would yank the job out from under the NEW owner (stale-claim
+        # race the fleet chaos suite drives via requeue storms)
+        await st.store.try_transition_job(
+            job_id, JobStatus.RUNNING.value, owned_by=worker_id,
+            status=JobStatus.QUEUED.value, worker_id=None,
             started_at=None,
         )
     await st.store.update_worker(
@@ -1365,10 +1428,10 @@ async def admin_worker_delete(request: web.Request) -> web.Response:
     wid = request.match_info["worker_id"]
     if await st.store.get_worker(wid) is None:
         return _json_error(404, "worker not found")
+    # handle_worker_offline's on_worker_offline hook already invalidates
+    # the registry entry and deletes the persisted summary row (counted)
     await st.guarantee.handle_worker_offline(wid, graceful=False)
     await st.store.delete_worker(wid)
-    st.prefix_registry.drop_worker(wid)
-    await st.store.delete_prefix_summary(wid)
     await st.store.audit("admin_delete_worker", actor="admin",
                          detail={"worker_id": wid})
     return web.json_response({"status": "deleted"})
@@ -1627,6 +1690,20 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
     # construction and would hide exactly the staleness the gauge exposes
     for wid, n, age in st.prefix_registry.stats_for_metrics():
         st.metrics.record_prefix_summary(wid, n, age)
+    # fleet strength at scrape time too: serving (idle/busy/draining still
+    # count — a draining replica finishes its work) over every registered
+    # replica. The ratio is what a brownout panel alerts on.
+    stats = await st.store.queue_stats()
+    w = stats.get("workers") or {}
+    serving = sum(
+        int(w.get(s) or 0)
+        for s in (WorkerState.IDLE.value, WorkerState.BUSY.value,
+                  WorkerState.DRAINING.value)
+    )
+    st.metrics.record_fleet_strength(serving, sum(
+        int(n or 0) for n in w.values()
+    ))
+    st.metrics.record_worker_counts(w)
     return web.Response(
         body=st.metrics.render(),
         content_type="text/plain",

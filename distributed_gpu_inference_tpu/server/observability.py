@@ -91,6 +91,9 @@ class Metrics:
                 "prefix_route_hits", "prefix_route_spillover",
                 "prefix_summary_entries", "prefix_summary_age",
                 "heartbeat_payload_rejected",
+                "prefix_summaries_invalidated", "worker_rejoin",
+                "fleet_degraded", "chaos_kills", "chaos_partitions",
+                "chaos_events",
             ):
                 setattr(self, name, noop)
             return
@@ -257,6 +260,38 @@ class Metrics:
             "heartbeat_payload_rejected_total",
             "Heartbeat side-channel payloads rejected or truncated",
             ["reason"], registry=r)
+        # fleet-under-fire panel (round 9): a dead/partitioned worker's
+        # advertised prefix summary is zeroed the MOMENT it is marked
+        # offline (not after staleness_ttl_s), so affinity can never route
+        # at a dead warm worker; rejoins and the serving/registered ratio
+        # show the fleet absorbing and recovering from churn; chaos
+        # counters are emitted by the harness-facing seams so a chaos
+        # run's injected events and the plane's observed reactions land
+        # in ONE scrape.
+        self.prefix_summaries_invalidated = Counter(
+            "prefix_summaries_invalidated_total",
+            "Worker prefix summaries zeroed before their staleness TTL",
+            ["reason"], registry=r)
+        self.worker_rejoin = Counter(
+            "worker_rejoin_total",
+            "Workers that rejoined the fleet (heartbeat revival of a "
+            "swept-offline worker, or re-registration on an existing "
+            "machine fingerprint)", ["worker"], registry=r)
+        self.fleet_degraded = Gauge(
+            "fleet_degraded",
+            "Replicas serving / replicas registered (1.0 = full strength)",
+            registry=r)
+        self.chaos_kills = Counter(
+            "chaos_kills_total",
+            "Hard worker kills injected by the chaos harness", registry=r)
+        self.chaos_partitions = Counter(
+            "chaos_partitions_total",
+            "Network partitions/blackouts injected by the chaos harness",
+            registry=r)
+        self.chaos_events = Counter(
+            "chaos_events_total",
+            "All chaos events injected by the fleet harness", ["kind"],
+            registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -441,6 +476,30 @@ class MetricsCollector:
 
     def record_heartbeat_payload_rejected(self, reason: str) -> None:
         self.metrics.heartbeat_payload_rejected.labels(reason).inc()
+
+    def record_prefix_summary_invalidated(self, reason: str) -> None:
+        """One worker's advertised summary zeroed ahead of its staleness
+        TTL (marked offline, swept for a stale heartbeat, partitioned)."""
+        self.metrics.prefix_summaries_invalidated.labels(reason).inc()
+
+    def record_worker_rejoin(self, worker: str) -> None:
+        self.metrics.worker_rejoin.labels(worker).inc()
+
+    def record_fleet_strength(self, serving: int, registered: int) -> None:
+        """Refresh the ``fleet_degraded`` gauge: replicas currently able
+        to take work over replicas the plane knows about."""
+        ratio = (serving / registered) if registered else 1.0
+        self.metrics.fleet_degraded.set(max(0.0, min(1.0, ratio)))
+
+    def record_chaos_event(self, kind: str) -> None:
+        """Harness-facing seam: the fleet chaos driver reports each event
+        it executes, so injected faults and the plane's observed reactions
+        (requeues, rejoins, invalidations) share one scrape timeline."""
+        self.metrics.chaos_events.labels(kind).inc()
+        if kind in ("kill",):
+            self.metrics.chaos_kills.inc()
+        elif kind in ("partition", "blackout"):
+            self.metrics.chaos_partitions.inc()
 
     def record_checkpoint(self, worker: str) -> None:
         self.metrics.job_checkpoints.labels(worker).inc()

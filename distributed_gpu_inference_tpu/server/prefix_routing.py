@@ -207,6 +207,32 @@ class PrefixRegistry:
     def drop_worker(self, worker_id: str) -> None:
         self._workers.pop(worker_id, None)
 
+    def invalidate_worker(self, worker_id: str, reason: str = "offline",
+                          metrics: Optional[Any] = None) -> bool:
+        """Zero a worker's advertised summary the MOMENT the plane decides
+        it is gone (marked offline, heartbeat swept stale, partitioned) —
+        not after ``staleness_ttl_s``. Affinity scoring must never prefer a
+        dead warm worker over a live cold one: between the sweep and the
+        TTL the dead worker's KV is as good as gone (it will restart cold,
+        or never), while the bonus would keep steering spillover math and
+        the claim path at its corpse.
+
+        The whole record is dropped (not just emptied): a revived worker's
+        next delta then base-mismatches → resync → full snapshot, so both
+        sides converge in one round-trip instead of the worker diffing
+        against entries the plane no longer holds. Returns True when a
+        summary actually existed (callers use it to gate persistence
+        cleanup and the counted metric)."""
+        ws = self._workers.pop(worker_id, None)
+        if ws is None:
+            return False
+        if metrics is not None:
+            try:
+                metrics.record_prefix_summary_invalidated(reason)
+            except Exception:  # noqa: BLE001 — metrics are best-effort
+                pass
+        return True
+
     def touch(self, worker_id: str, now: Optional[float] = None) -> None:
         """A heartbeat arrived from this worker: its summary is still
         live even when no payload rode along (``wire()`` returns None

@@ -22,14 +22,30 @@ from typing import Any, Dict, List, Optional
 
 from .store import Store
 
-try:  # gated: cryptography present in this image, but keep import soft
-    from cryptography.fernet import Fernet
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.kdf.pbkdf2 import PBKDF2HMAC
+def _load_crypto():
+    """Lazy ``cryptography`` import: the module (and every privacy feature
+    that doesn't encrypt) must work on images without the optional dep —
+    only constructing a :class:`FieldEncryptor` requires it, and the error
+    then names the missing capability instead of an ImportError at import
+    time (which used to take the whole server module down with it)."""
+    try:
+        from cryptography.fernet import Fernet
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.kdf.pbkdf2 import PBKDF2HMAC
+    except Exception as exc:  # pragma: no cover - present in full images
+        raise RuntimeError(
+            "field encryption requires the optional 'cryptography' package "
+            f"(pip install cryptography): {exc}"
+        ) from exc
+    return Fernet, hashes, PBKDF2HMAC
 
-    _HAVE_CRYPTO = True
-except Exception:  # pragma: no cover - absent in minimal envs
-    _HAVE_CRYPTO = False
+
+def crypto_available() -> bool:
+    try:
+        _load_crypto()
+        return True
+    except RuntimeError:
+        return False
 
 _EMAIL_RE = re.compile(r"[\w.+-]+@[\w-]+\.[\w.-]+")
 _PHONE_RE = re.compile(r"\+?\d[\d\s().-]{7,}\d")
@@ -84,8 +100,7 @@ class FieldEncryptor:
     """Fernet encryption of individual fields, key derived via PBKDF2."""
 
     def __init__(self, passphrase: str, salt: bytes = b"dgi-tpu-privacy") -> None:
-        if not _HAVE_CRYPTO:
-            raise RuntimeError("cryptography not available")
+        Fernet, hashes, PBKDF2HMAC = _load_crypto()
         kdf = PBKDF2HMAC(
             algorithm=hashes.SHA256(), length=32, salt=salt, iterations=100_000
         )
@@ -172,7 +187,8 @@ class EnterprisePrivacyService:
         self.anonymizer = Anonymizer(pseudonym_salt)
         self.retention = RetentionPolicy(store)
         self._encryptor = (
-            FieldEncryptor(passphrase) if (passphrase and _HAVE_CRYPTO) else None
+            FieldEncryptor(passphrase)
+            if (passphrase and crypto_available()) else None
         )
 
     async def _settings(self, enterprise_id: Optional[str]) -> Dict[str, Any]:
@@ -234,5 +250,5 @@ class EnterprisePrivacyService:
             "logging_disabled": sum(1 for e in ents if not e.get("allow_logging", 1)),
             "stored_jobs": int(jobs[0]["n"]),
             "stored_usage_records": int(usage[0]["n"]),
-            "encryption_available": _HAVE_CRYPTO,
+            "encryption_available": crypto_available(),
         }

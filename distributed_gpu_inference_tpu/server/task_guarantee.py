@@ -40,6 +40,9 @@ class TaskGuaranteeService:
                  heartbeat_timeout_s: float = HEARTBEAT_TIMEOUT_S,
                  on_permanent_failure: Optional[
                      Callable[[Dict[str, Any]], Awaitable[None]]
+                 ] = None,
+                 on_worker_offline: Optional[
+                     Callable[[str, str], Awaitable[None]]
                  ] = None) -> None:
         self._store = store
         self._reliability = reliability or ReliabilityService(store)
@@ -48,6 +51,11 @@ class TaskGuaranteeService:
         # good (retries exhausted, container timeout, pinned worker gone);
         # the PD flow uses it to fail containers promptly (server/app.py)
         self.on_permanent_failure = on_permanent_failure
+        # called with (worker_id, reason) whenever a worker is marked
+        # offline — ServerState uses it to zero the worker's advertised
+        # prefix summary immediately (routing must not keep preferring a
+        # dead warm worker for the rest of its staleness TTL)
+        self.on_worker_offline = on_worker_offline
 
     async def _notify_failed(self, job_id: str) -> None:
         if self.on_permanent_failure is None:
@@ -166,14 +174,16 @@ class TaskGuaranteeService:
         }
 
     async def handle_worker_offline(self, worker_id: str,
-                                    graceful: bool = False) -> List[str]:
+                                    graceful: bool = False,
+                                    reason: str = "worker_offline"
+                                    ) -> List[str]:
         """Mark worker offline and requeue its running jobs (:60-96)."""
         running = await self._store.list_jobs(
             status=[JobStatus.RUNNING.value], worker_id=worker_id
         )
         requeued = []
         for job in running:
-            await self.requeue_job(job, reason="worker_offline")
+            await self.requeue_job(job, reason=reason)
             requeued.append(job["id"])
         await self._store.update_worker(
             worker_id,
@@ -181,6 +191,11 @@ class TaskGuaranteeService:
             current_job_id=None,
         )
         await self._reliability.end_session(worker_id, graceful=graceful)
+        if self.on_worker_offline is not None:
+            try:
+                await self.on_worker_offline(worker_id, reason)
+            except Exception:  # noqa: BLE001 — advisory hook, never fatal
+                log.exception("worker-offline hook failed for %s", worker_id)
         return requeued
 
     # -- sweeps ---------------------------------------------------------------
@@ -218,7 +233,9 @@ class TaskGuaranteeService:
             if hb is None or now - float(hb) > self._heartbeat_timeout_s:
                 # handle_worker_offline → end_session(graceful=False) already
                 # applies the unexpected_offline penalty exactly once
-                await self.handle_worker_offline(w["id"], graceful=False)
+                await self.handle_worker_offline(
+                    w["id"], graceful=False, reason="heartbeat_stale"
+                )
                 dead.append(w["id"])
         return dead
 

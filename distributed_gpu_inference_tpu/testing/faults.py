@@ -149,6 +149,26 @@ class FaultPlan:
                 return r
         return None
 
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        """Append a rule to a LIVE plan (counters zeroed, private copy).
+        The fleet chaos driver uses this to arm pressure-storm / latency
+        rules at their scheduled instant while the plan is installed —
+        rule matching holds the same lock as :meth:`fire`, so arming
+        mid-traffic is safe."""
+        r = replace(rule, hits=0, fired=0)
+        with self._lock:
+            self.rules.append(r)
+        return r
+
+    def remove_rule(self, rule: FaultRule) -> None:
+        """Disarm a rule previously returned by :meth:`add_rule` — the end
+        of a scheduled chaos window (partition heals, storm passes)."""
+        with self._lock:
+            try:
+                self.rules.remove(rule)
+            except ValueError:
+                pass
+
     # -- stream filtering (transport-level loss/reorder/dup) ----------------
 
     def filter_stream(
@@ -359,6 +379,145 @@ def stream_cut(site: str, **ctx: Any) -> bool:
     raise ValueError(f"rule kind {rule.kind!r} unsupported at stream seam")
 
 
+# ---------------------------------------------------------------------------
+# fleet-level chaos: seeded schedules of whole-replica events
+# ---------------------------------------------------------------------------
+
+# kinds a generated schedule draws from. ``restart`` never appears here —
+# every ``kill`` emits its own paired restart event, so a schedule can
+# never leave a replica dead forever by construction.
+FLEET_EVENT_KINDS = ("kill", "blackout", "partition", "pressure", "slow")
+
+# the canonical suite/CLI geometry: ``--replay`` must reconstruct the EXACT
+# schedule a failing suite seed ran, so both sides share these defaults
+FLEET_CHAOS_WORKERS = 2
+FLEET_CHAOS_DURATION_S = 6.0
+
+
+@dataclass(frozen=True)
+class FleetEvent:
+    """One scheduled fleet-level event.
+
+    =========  ==========================================================
+    kind       effect in :class:`~..testing.harness.LiveFleet`
+    =========  ==========================================================
+    kill       hard-stop a replica's servers/threads mid-traffic (no
+               drain, no offline call) — a crashed process
+    restart    rebuild the replica cold and re-register it on the SAME
+               machine fingerprint (restart-with-reregistration)
+    blackout   heartbeats stop for ``duration_s`` while the replica keeps
+               serving — the one-directional partition that gets a LIVE
+               worker swept offline
+    partition  bidirectional: heartbeats stop AND the replica's direct
+               endpoint refuses traffic for ``duration_s``
+    pressure   fleet-wide KV pressure storm: ``kv.block.alloc`` fires
+               pool-exhausted for ``duration_s`` at ``prob``
+    slow       latency injection: every direct request/stream event of
+               the replica sleeps ``delay_s`` for ``duration_s``
+    =========  ==========================================================
+    """
+
+    at_s: float            # offset from chaos start
+    kind: str
+    worker: int            # fleet member index; -1 = fleet-wide
+    duration_s: float = 0.0
+    prob: float = 1.0      # pressure: per-allocation firing probability
+    delay_s: float = 0.0   # slow: injected per-hit latency
+
+
+class FleetFaultPlan:
+    """Seeded, deterministic schedule of fleet-level events.
+
+    Pure function of ``(seed, n_workers, duration_s, kinds)``: the same
+    arguments always produce the identical event list — the suite asserts
+    this, and ``python -m distributed_gpu_inference_tpu.testing.faults
+    --replay <seed>`` prints the exact schedule a failing seed ran.
+
+    Generated disruption windows are SEQUENTIAL (next window starts after
+    the previous ends), so with ≥ 2 replicas at least one replica can take
+    work at every instant — the suite's liveness assertions rely on it.
+    ``trace`` records what the executor actually ran, wall-clock-stamped.
+    """
+
+    def __init__(self, seed: int,
+                 n_workers: int = FLEET_CHAOS_WORKERS,
+                 duration_s: float = FLEET_CHAOS_DURATION_S,
+                 kinds: Sequence[str] = FLEET_EVENT_KINDS,
+                 max_disruptions: int = 2) -> None:
+        for k in kinds:
+            if k not in FLEET_EVENT_KINDS:
+                raise ValueError(
+                    f"unknown fleet event kind {k!r} "
+                    f"(one of {FLEET_EVENT_KINDS})"
+                )
+        self.seed = seed
+        self.n_workers = n_workers
+        self.duration_s = duration_s
+        self.kinds = tuple(kinds)
+        self.max_disruptions = max_disruptions
+        self.events: List[FleetEvent] = self._generate()
+        self.trace: List[Tuple[float, str, int]] = []
+
+    def _generate(self) -> List[FleetEvent]:
+        rng = random.Random(0xF1EE7 * (self.seed + 1) + self.n_workers)
+        n = 1
+        if self.max_disruptions > 1 and rng.random() < 0.5:
+            n = 2
+        events: List[FleetEvent] = []
+        cursor = self.duration_s * (0.10 + 0.15 * rng.random())
+        for _ in range(n):
+            kind = self.kinds[rng.randrange(len(self.kinds))]
+            worker = rng.randrange(self.n_workers)
+            dur = self.duration_s * (0.20 + 0.25 * rng.random())
+            if kind == "kill":
+                events.append(FleetEvent(round(cursor, 3), "kill", worker))
+                events.append(
+                    FleetEvent(round(cursor + dur, 3), "restart", worker)
+                )
+            elif kind == "pressure":
+                events.append(FleetEvent(
+                    round(cursor, 3), "pressure", -1,
+                    duration_s=round(dur, 3),
+                    prob=0.25 + 0.5 * rng.random(),
+                ))
+            elif kind == "slow":
+                events.append(FleetEvent(
+                    round(cursor, 3), "slow", worker,
+                    duration_s=round(dur, 3),
+                    delay_s=round(0.02 + 0.08 * rng.random(), 3),
+                ))
+            else:  # blackout / partition
+                events.append(FleetEvent(
+                    round(cursor, 3), kind, worker,
+                    duration_s=round(dur, 3),
+                ))
+            # sequential windows + breathing room: disruptions never
+            # overlap, so a 2-replica fleet always has a live replica
+            cursor += dur + self.duration_s * 0.10 * (1.0 + rng.random())
+        return sorted(events, key=lambda e: e.at_s)
+
+    def record(self, offset_s: float, kind: str, worker: int) -> None:
+        """Executor hook: stamp one executed event into the trace."""
+        self.trace.append((round(offset_s, 3), kind, worker))
+
+    def describe(self) -> List[str]:
+        out = [
+            f"FleetFaultPlan(seed={self.seed}, workers={self.n_workers}, "
+            f"duration={self.duration_s}s, kinds={','.join(self.kinds)})"
+        ]
+        for e in self.events:
+            tgt = "fleet" if e.worker < 0 else f"worker[{e.worker}]"
+            extra = ""
+            if e.duration_s:
+                extra += f" for {e.duration_s}s"
+            if e.kind == "pressure":
+                extra += f" prob={e.prob:.2f}"
+            if e.kind == "slow":
+                extra += f" delay={e.delay_s}s"
+            out.append(f"  t+{e.at_s:6.2f}s  {e.kind:<9} {tgt}{extra}")
+        return out
+
+
 def mutate_bytes(site: str, data: bytes, **ctx: Any) -> bytes:
     """Byte-message seam (KV handoff receiver): truncate or lose a message
     in transit. Drops raise :class:`FaultInjected`, which the transport
@@ -377,3 +536,44 @@ def mutate_bytes(site: str, data: bytes, **ctx: Any) -> bytes:
         time.sleep(rule.delay_s)
         return data
     raise ValueError(f"rule kind {rule.kind!r} unsupported at byte seam")
+
+
+# ---------------------------------------------------------------------------
+# seeded-replay CLI: reconstruct a failing fleet-chaos seed's exact schedule
+# ---------------------------------------------------------------------------
+
+
+def _replay_main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m distributed_gpu_inference_tpu.testing.faults --replay N``
+
+    Prints the exact fleet FaultPlan a chaos-suite seed runs (same
+    generator, same defaults as ``tests/test_fleet_chaos.py``), so a chaos
+    flake reproduces one-shot: read the CI failure's seed, replay it, and
+    the printed schedule IS what the failing run injected."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m distributed_gpu_inference_tpu.testing.faults",
+        description="Replay a seeded fleet FaultPlan schedule.",
+    )
+    ap.add_argument("--replay", type=int, required=True, metavar="SEED",
+                    help="the failing suite seed to reconstruct")
+    ap.add_argument("--workers", type=int, default=FLEET_CHAOS_WORKERS,
+                    help="fleet size the suite ran (default: suite default)")
+    ap.add_argument("--duration", type=float,
+                    default=FLEET_CHAOS_DURATION_S,
+                    help="chaos window seconds (default: suite default)")
+    ap.add_argument("--kinds", default=",".join(FLEET_EVENT_KINDS),
+                    help="comma-separated event kinds the suite allowed")
+    args = ap.parse_args(argv)
+    plan = FleetFaultPlan(
+        args.replay, n_workers=args.workers, duration_s=args.duration,
+        kinds=tuple(k for k in args.kinds.split(",") if k),
+    )
+    for line in plan.describe():
+        print(line)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - manual entry point
+    raise SystemExit(_replay_main())

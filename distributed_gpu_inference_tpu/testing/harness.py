@@ -8,6 +8,17 @@ loopback port; the test thread then talks real HTTP through the real
 clients (retry ladders, signing, fault seams and all), and can reach into
 the server's services (sweeps with a simulated clock, store queries) via
 :meth:`call`.
+
+:class:`LiveFleet` (round 9) scales the harness to a CLUSTER: N real
+``worker.main.Worker`` instances — batcher-backed engines, direct servers,
+heartbeat and poll threads, the production claim machinery — registered
+behind one live control plane, plus a chaos driver that executes a seeded
+:class:`~..testing.faults.FleetFaultPlan` (hard kills,
+restart-with-reregistration, heartbeat blackouts, bidirectional
+partitions, pressure storms, slow-replica latency) against wall-clock
+offsets WHILE open-loop traffic runs. Every injected event is reported to
+the plane's metrics (``chaos_*_total``) so a chaos run and the plane's
+observed reactions share one scrape timeline.
 """
 
 from __future__ import annotations
@@ -15,11 +26,15 @@ from __future__ import annotations
 import asyncio
 import socket
 import threading
+import time
+import uuid
 from typing import Any, Coroutine, Dict, List, Optional
 
 from aiohttp import web
 
 from ..server.app import ServerState, create_app
+from . import faults as _faults
+from .faults import FaultPlan, FaultRule, FleetFaultPlan
 
 
 class LiveControlPlane:
@@ -97,3 +112,398 @@ class LiveControlPlane:
 
     def worker(self, worker_id: str) -> Optional[Dict[str, Any]]:
         return self.call(self.state.store.get_worker(worker_id))
+
+
+# ---------------------------------------------------------------------------
+# fleet-scale harness (round 9): N real workers + seeded chaos under load
+# ---------------------------------------------------------------------------
+
+# engine geometry every fleet member shares unless overridden: tiny model,
+# per-token checkpoint cadence (a seeded kill point must always have a
+# checkpoint to resume from), a deep queue so backpressure is the PLANE's
+# decision (submit_queue_limit), not the batcher's
+DEFAULT_FLEET_ENGINE = {
+    "model": "llama3-tiny",
+    "max_batch_size": 4,
+    "max_seq_len": 160,
+    "multi_step": 4,
+    "checkpoint_interval_tokens": 1,
+    "serving": {"queue_limit": 4096, "default_timeout_s": 120.0},
+}
+
+
+class FleetWorker:
+    """One fleet replica: a REAL ``worker.main.Worker`` wired exactly like
+    production — batcher-backed ``TPULLMEngine``, ``DirectServer``, stream
+    checkpoint sink, heartbeat + poll threads — except registration uses a
+    STABLE synthetic machine fingerprint (process-global fingerprints would
+    collapse an in-process fleet onto one worker row), and the heartbeat
+    loop is gateable so blackout/partition events can silence it without
+    touching worker code. ``kill()`` is a hard crash (no drain, no
+    offline call); ``start()`` after a kill is a cold
+    restart-with-reregistration that lands on the same worker row."""
+
+    def __init__(self, index: int, plane_url: str,
+                 engine_config: Optional[Dict[str, Any]] = None,
+                 hb_interval_s: float = 0.2,
+                 poll_interval_s: float = 0.05,
+                 role: Optional[str] = None,
+                 region: str = "us-west") -> None:
+        self.index = index
+        self.plane_url = plane_url
+        self.engine_config = dict(engine_config or DEFAULT_FLEET_ENGINE)
+        self.hb_interval_s = hb_interval_s
+        self.poll_interval_s = poll_interval_s
+        self.role = role
+        self.region = region
+        self.tag = f"fw{index}"
+        # stable across restarts of THIS member: re-registration must land
+        # on the same worker row (rejoin accounting, job requeue)
+        self.fingerprint = f"fleet-{index}-{uuid.uuid4().hex[:8]}"
+        self.alive = False
+        self.worker: Optional[Any] = None
+        self.llm: Optional[Any] = None
+        self.server: Optional[Any] = None
+        self.api: Optional[Any] = None
+        self.worker_id: Optional[str] = None
+        self._hb_blocked = threading.Event()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Cold start (or cold RESTART): fresh engine, fresh server, fresh
+        credentials — registered on the stable fingerprint."""
+        from ..utils.config import WorkerConfig
+        from ..utils.data_structures import TpuTopology, WorkerState
+        from ..worker.api_client import APIClient
+        from ..worker.direct_server import DirectServer
+        from ..worker.main import Worker
+
+        from ..worker.engines.llm import TPULLMEngine
+
+        llm = TPULLMEngine(dict(self.engine_config))
+        llm.load_model()
+        api = APIClient(self.plane_url, backoff_s=0.0)
+        api.fault_tag = self.tag
+        cfg = WorkerConfig(
+            name=self.tag, region=self.region,
+            heartbeat_interval_s=self.hb_interval_s,
+            poll_interval_s=self.poll_interval_s,
+        )
+        cfg.task_types = ["llm"]
+        w = Worker(
+            cfg, api=api,
+            topology=TpuTopology(chip_type="cpu", num_chips=1,
+                                 hbm_gb_per_chip=4.0),
+        )
+        w.engines = {"llm": llm}
+        w.fault_tag = self.tag
+        llm.checkpoint_sink = w.push_stream_checkpoint
+        ds = DirectServer(w, host="127.0.0.1", port=0)
+        ds.start()
+        port = ds._runner.addresses[0][1]
+        info: Dict[str, Any] = {
+            "name": self.tag, "region": self.region,
+            "machine_fingerprint": self.fingerprint,
+            "supported_types": ["llm"], "supports_direct": True,
+            "direct_url": f"http://127.0.0.1:{port}",
+        }
+        if self.role:
+            info["role"] = self.role
+        api.register(info)
+        self.worker_id = api.worker_id
+        w.state = WorkerState.IDLE
+        self.worker, self.llm, self.server, self.api = w, llm, ds, api
+        self._hb_blocked.clear()
+        self._stop.clear()
+        w._heartbeat_once()   # first beat lands before traffic arrives
+        self._threads = [
+            threading.Thread(target=self._hb_loop,
+                             name=f"{self.tag}-hb", daemon=True),
+            threading.Thread(target=w._main_loop,
+                             name=f"{self.tag}-poll", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+        self.alive = True
+
+    def _hb_loop(self) -> None:
+        w = self.worker
+        while not self._stop.wait(self.hb_interval_s):
+            if self._hb_blocked.is_set():
+                continue   # blackout/partition window: beats are "lost"
+            try:
+                w._heartbeat_once()
+            except Exception:  # noqa: BLE001 — outage: next tick retries
+                pass
+
+    def kill(self) -> None:
+        """Hard crash: servers and threads stop mid-flight — no drain, no
+        graceful offline, no checkpoint push. The plane finds out the way
+        it would in production: heartbeats stop arriving."""
+        if not self.alive:
+            return
+        self.alive = False
+        self._stop.set()
+        if self.worker is not None:
+            self.worker._shutdown.set()   # stops the poll loop
+        if self.server is not None:
+            self.server.stop()            # in-flight sockets die abruptly
+        if self.llm is not None:
+            # resolves outstanding batcher futures with errors and stops
+            # the engine — concurrent requests see a crashed process
+            try:
+                self.llm.unload()
+            except Exception:  # noqa: BLE001 — a crash is not graceful
+                pass
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads = []
+        if self.api is not None:
+            self.api.close()
+        self.worker = self.llm = self.server = self.api = None
+
+    def stop(self) -> None:
+        """Teardown at harness exit (not a chaos event)."""
+        self.kill()
+
+    # -- chaos windows -------------------------------------------------------
+
+    def blackout(self, on: bool) -> None:
+        """Heartbeats stop/resume while the replica keeps serving — the
+        one-directional partition that gets a LIVE worker swept offline."""
+        if on:
+            self._hb_blocked.set()
+        else:
+            self._hb_blocked.clear()
+
+    def partition_rules(self) -> List[FaultRule]:
+        """Rules a bidirectional partition arms on the installed plan: the
+        replica's direct endpoint hard-drops every request/stream event,
+        and its OWN control-plane calls (completions, checkpoints, polls)
+        fail like a cut wire. Heartbeats are gated separately
+        (:meth:`blackout`)."""
+        return [
+            FaultRule(site="worker.direct.request", kind="flap",
+                      times=None, match={"worker": self.tag}),
+            FaultRule(site="worker.direct.stream", kind="flap",
+                      times=None, match={"worker": self.tag}),
+            FaultRule(site="worker.api.request", kind="flap",
+                      times=None, match={"worker": self.tag}),
+        ]
+
+    def slow_rules(self, delay_s: float) -> List[FaultRule]:
+        """Latency-injection rules: every direct request admission and
+        stream event of THIS replica pays ``delay_s``."""
+        return [
+            FaultRule(site="worker.direct.request", kind="delay",
+                      delay_s=delay_s, times=None,
+                      match={"worker": self.tag}),
+            FaultRule(site="worker.direct.stream", kind="delay",
+                      delay_s=delay_s, times=None,
+                      match={"worker": self.tag}),
+        ]
+
+    # -- introspection -------------------------------------------------------
+
+    def engine_quiet(self) -> bool:
+        return self.llm is None or self.llm.engine is None \
+            or self.llm.engine.num_active == 0
+
+
+class LiveFleet:
+    """Context manager: a live control plane + N real workers + a seeded
+    chaos driver. The production composition in one object:
+
+    - every member is a real ``Worker`` (shared serving claims, stream
+      checkpoints, drain/zombie fencing) serving through the batcher;
+    - a sweeper thread runs the guarantee sweeps on a fast cadence, like
+      the production background worker;
+    - :meth:`run_chaos` executes a :class:`FleetFaultPlan` against
+      wall-clock offsets while the caller drives traffic, reporting every
+      event to the plane's ``chaos_*`` metrics and the plan's trace.
+    """
+
+    def __init__(self, n: int = 2,
+                 engine_config: Optional[Dict[str, Any]] = None,
+                 heartbeat_timeout_s: float = 0.9,
+                 hb_interval_s: float = 0.2,
+                 poll_interval_s: float = 0.05,
+                 sweep_interval_s: float = 0.25,
+                 submit_queue_limit: int = 0,
+                 roles: Optional[List[Optional[str]]] = None) -> None:
+        self.n = n
+        self.engine_config = dict(engine_config or DEFAULT_FLEET_ENGINE)
+        self.hb_interval_s = hb_interval_s
+        self.poll_interval_s = poll_interval_s
+        self.sweep_interval_s = sweep_interval_s
+        self.roles = list(roles) if roles is not None else [None] * n
+        if len(self.roles) != n:
+            raise ValueError("roles must have one entry per member")
+        self.plane = LiveControlPlane(
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            submit_queue_limit=submit_queue_limit,
+        )
+        self.members: List[FleetWorker] = []
+        self._sweep_stop = threading.Event()
+        self._sweeper: Optional[threading.Thread] = None
+        self._chaos_thread: Optional[threading.Thread] = None
+        self._chaos_failure: Optional[BaseException] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self) -> "LiveFleet":
+        self.plane.__enter__()
+        try:
+            for i in range(self.n):
+                m = FleetWorker(
+                    i, self.plane.url, self.engine_config,
+                    hb_interval_s=self.hb_interval_s,
+                    poll_interval_s=self.poll_interval_s,
+                    role=self.roles[i],
+                )
+                m.start()
+                self.members.append(m)
+            self._sweep_stop.clear()
+            self._sweeper = threading.Thread(
+                target=self._sweep_loop, name="fleet-sweeper", daemon=True
+            )
+            self._sweeper.start()
+        except BaseException:
+            self.__exit__(None, None, None)
+            raise
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        try:
+            self.wait_chaos(timeout_s=30.0)
+        finally:
+            self._sweep_stop.set()
+            if self._sweeper is not None:
+                self._sweeper.join(timeout=5.0)
+            for m in self.members:
+                try:
+                    m.stop()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+            self.plane.__exit__(None, None, None)
+
+    def _sweep_loop(self) -> None:
+        while not self._sweep_stop.wait(self.sweep_interval_s):
+            try:
+                self.plane.sweep()
+            except Exception:  # noqa: BLE001 — next tick retries
+                pass
+
+    @property
+    def url(self) -> str:
+        return self.plane.url
+
+    def alive_members(self) -> List[FleetWorker]:
+        return [m for m in self.members if m.alive]
+
+    # -- chaos driver --------------------------------------------------------
+
+    def run_chaos(self, plan: FleetFaultPlan,
+                  block: bool = False) -> threading.Thread:
+        """Execute ``plan`` on a background thread (or inline with
+        ``block=True``): each event fires at its wall-clock offset from
+        now, windowed events (blackout/partition/pressure/slow) arm their
+        effect and disarm it ``duration_s`` later. A :class:`FaultPlan`
+        seeded from the fleet plan is installed for the whole run — the
+        rule container the windowed events arm into — so callers must not
+        hold their own installed plan concurrently."""
+        if self._chaos_thread is not None and \
+                self._chaos_thread.is_alive():
+            raise RuntimeError("a chaos run is already in flight")
+        self._chaos_failure = None
+
+        def drive() -> None:
+            try:
+                self._drive_chaos(plan)
+            except BaseException as exc:  # noqa: BLE001 — surfaced on wait
+                self._chaos_failure = exc
+
+        t = threading.Thread(target=drive, name="fleet-chaos", daemon=True)
+        self._chaos_thread = t
+        t.start()
+        if block:
+            self.wait_chaos()
+        return t
+
+    def wait_chaos(self, timeout_s: float = 120.0) -> None:
+        """Join the in-flight chaos run; re-raises a driver failure."""
+        t = self._chaos_thread
+        if t is not None:
+            t.join(timeout=timeout_s)
+            self._chaos_thread = None
+        if self._chaos_failure is not None:
+            failure, self._chaos_failure = self._chaos_failure, None
+            raise failure
+
+    def _emit(self, kind: str) -> None:
+        try:
+            self.plane.state.metrics.record_chaos_event(kind)
+        except Exception:  # noqa: BLE001 — metrics are best-effort
+            pass
+
+    def _drive_chaos(self, plan: FleetFaultPlan) -> None:
+        t0 = time.monotonic()
+        pending = sorted(plan.events, key=lambda e: e.at_s)
+        undo: List[tuple] = []   # (due_at_offset, fn)
+        with _faults.active(FaultPlan(plan.seed)) as fp:
+            while pending or undo:
+                now = time.monotonic() - t0
+                for due, fn in [u for u in undo if u[0] <= now]:
+                    undo.remove((due, fn))
+                    fn()
+                while pending and pending[0].at_s <= now:
+                    ev = pending.pop(0)
+                    plan.record(now, ev.kind, ev.worker)
+                    self._emit(ev.kind)
+                    end = self._execute(ev, fp)
+                    if end is not None:
+                        # window duration runs from the ACTUAL arm time:
+                        # a preceding kill/restart can block the driver
+                        # past at_s, and anchoring the disarm to the
+                        # scheduled offset would silently collapse the
+                        # window to nothing on a slow box
+                        undo.append((
+                            (time.monotonic() - t0) + ev.duration_s, end
+                        ))
+                time.sleep(0.02)
+
+    def _execute(self, ev: Any, fp: FaultPlan) -> Optional[Any]:
+        """Apply one fleet event; returns the disarm callback for windowed
+        kinds (None for kill/restart)."""
+        member = self.members[ev.worker] if ev.worker >= 0 else None
+        if ev.kind == "kill":
+            member.kill()
+            return None
+        if ev.kind == "restart":
+            member.start()
+            return None
+        if ev.kind == "blackout":
+            member.blackout(True)
+            return lambda: member.blackout(False)
+        if ev.kind == "partition":
+            member.blackout(True)
+            rules = [fp.add_rule(r) for r in member.partition_rules()]
+
+            def heal() -> None:
+                for r in rules:
+                    fp.remove_rule(r)
+                member.blackout(False)
+
+            return heal
+        if ev.kind == "slow":
+            rules = [fp.add_rule(r) for r in member.slow_rules(ev.delay_s)]
+            return lambda: [fp.remove_rule(r) for r in rules]
+        if ev.kind == "pressure":
+            rule = fp.add_rule(FaultRule(
+                site="kv.block.alloc", kind="pressure", prob=ev.prob,
+            ))
+            return lambda: fp.remove_rule(rule)
+        raise ValueError(f"unknown fleet event kind {ev.kind!r}")

@@ -105,6 +105,10 @@ class APIClient:
                         headers=self._headers(method, path, body),
                     ),
                     method=method, path=path,
+                    # per-replica chaos targeting (fleet harness sets
+                    # fault_tag): a bidirectional partition must cut ONE
+                    # worker's control-plane traffic, not the process's
+                    worker=str(getattr(self, "fault_tag", "") or ""),
                 )
             except httpx.TransportError as exc:
                 last_exc = exc

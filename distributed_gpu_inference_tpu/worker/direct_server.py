@@ -135,7 +135,21 @@ class DirectServer:
 
         return engine, body, release, None
 
+    def _fault_tag(self) -> str:
+        """Per-worker context for the chaos seams: rules can target ONE
+        replica of a fleet (``match={"worker": "w1"}``) instead of every
+        engine in the process. Workers/shims opt in by setting
+        ``fault_tag``; untagged workers match the empty string."""
+        return str(getattr(self.worker, "fault_tag", "") or "")
+
     async def _inference(self, request: web.Request) -> web.Response:
+        if _faults.stream_cut("worker.direct.request",
+                              worker=self._fault_tag()):
+            # chaos seam: the worker "dies" on this request — hard-close
+            # so the client sees a crashed process, not a clean error
+            with contextlib.suppress(Exception):
+                request.transport.close()
+            raise ConnectionResetError("fault injected: request cut")
         engine, body, release, err = await self._parse_and_admit(request)
         if err is not None:
             return err
@@ -235,7 +249,8 @@ class DirectServer:
         try:
             async for chunk in agen:
                 if _faults.stream_cut("worker.direct.stream",
-                                      stream_id=stream_id):
+                                      stream_id=stream_id,
+                                      worker=self._fault_tag()):
                     # chaos seam: the worker "dies" mid-stream — hard-close
                     # the socket so the client sees an abrupt drop, exactly
                     # like a crashed process

