@@ -895,6 +895,275 @@ def run_chaos_fleet(args: Any, backend: str, model: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --pd-split (round 11): the PD frontier. A LiveFleet split into a prefill
+# fleet and a decode fleet (role-tagged registrations, every member running
+# a real /kv/transfer data plane) serves pd-disaggregated jobs through the
+# control plane — placement over roles, pinned stage children, streamed KV
+# handoff, adopt_slot decode — against a DATA-PARALLEL baseline at EQUAL
+# worker count (same engines, no roles, plain jobs). Then the handoff-
+# brownout leg: a handoff partition window plus a seeded kill/restart of
+# the prefill side mid-workload, publishing SLO-in-window, the re-prefill
+# count, and time-to-recover. Greedy outputs are asserted byte-identical
+# PD vs data-parallel and brownout vs calm — disaggregation and its
+# recovery machinery never change WHAT is generated.
+# ---------------------------------------------------------------------------
+
+
+async def _drive_queued_jobs(plane_url: str, prompts: List[str],
+                             arrivals: List[float], max_tokens: int,
+                             pd: bool,
+                             ) -> Tuple[List[Dict[str, Any]], float]:
+    """Open-loop queued-job driver (the PD path runs through /jobs, not
+    the direct servers): submit at the arrival instant — riding out
+    placement-capacity 503s/429s with the server's retry hint — then
+    poll to completion. Records client e2e, engine ttft, completion wall
+    offset, and the serving workers."""
+    import httpx
+
+    t0 = time.perf_counter()
+    async with httpx.AsyncClient(timeout=600.0) as client:
+
+        async def one(i: int, prompt: str, at: float) -> Dict[str, Any]:
+            now = time.perf_counter() - t0
+            if at > now:
+                await asyncio.sleep(at - now)
+            rec: Dict[str, Any] = {"i": i, "arrival_s": at, "status": 0}
+            t_req = time.perf_counter()
+            params: Dict[str, Any] = {
+                "prompt": prompt, "max_tokens": max_tokens,
+                "temperature": 0,
+            }
+            if pd:
+                params["pd_disaggregated"] = True
+            job_id = None
+            while time.perf_counter() - t_req < 180.0:
+                try:
+                    r = await client.post(
+                        f"{plane_url}/api/v1/jobs",
+                        json={"type": "llm", "params": params},
+                    )
+                except httpx.TransportError:
+                    await asyncio.sleep(0.1)
+                    continue
+                if r.status_code == 201:
+                    job_id = r.json()["job_id"]
+                    break
+                if r.status_code in (429, 503):
+                    hint = 0.2
+                    try:
+                        hint = float(r.json().get("retry_after_s") or 0.2)
+                    except (ValueError, KeyError):
+                        pass
+                    await asyncio.sleep(min(hint, 1.0))
+                    continue
+                rec["status"] = r.status_code
+                return rec
+            if job_id is None:
+                rec["status"] = 599
+                return rec
+            while time.perf_counter() - t_req < 180.0:
+                try:
+                    j = (await client.get(
+                        f"{plane_url}/api/v1/jobs/{job_id}"
+                    )).json()
+                except (httpx.TransportError, ValueError):
+                    await asyncio.sleep(0.1)
+                    continue
+                if j.get("status") in ("completed", "failed", "cancelled"):
+                    res = j.get("result") or {}
+                    rec.update({
+                        "status": 200 if j["status"] == "completed"
+                        else 500,
+                        "e2e_ms": (time.perf_counter() - t_req) * 1e3,
+                        "done_s": time.perf_counter() - t0,
+                        "ttft_ms": res.get("ttft_ms"),
+                        "text": res.get("text"),
+                        "prefill_worker": res.get("prefill_worker"),
+                        "decode_worker": res.get("decode_worker"),
+                        "migration_bytes": res.get("migration_bytes"),
+                        "completion_tokens": (res.get("usage") or {})
+                        .get("completion_tokens")
+                        or res.get("completion_tokens") or 0,
+                    })
+                    return rec
+                await asyncio.sleep(0.05)
+            rec["status"] = 599
+            return rec
+
+        results = list(await asyncio.gather(
+            *(one(i, p, a) for i, (p, a) in
+              enumerate(zip(prompts, arrivals)))
+        ))
+    return results, time.perf_counter() - t0
+
+
+def run_pd_split(args: Any, backend: str, model: str) -> None:
+    import numpy as _np
+
+    from distributed_gpu_inference_tpu.testing.faults import (
+        FleetEvent,
+        FleetFaultPlan,
+    )
+    from distributed_gpu_inference_tpu.testing.harness import LiveFleet
+
+    try:
+        n_prefill, n_decode = (int(x) for x in args.pd_split.split(":"))
+    except ValueError:
+        raise SystemExit("--pd-split takes P:D, e.g. 1:2")
+    n = n_prefill + n_decode
+    roles = ["prefill"] * n_prefill + ["decode"] * n_decode
+    engine_config = {
+        "model": model,
+        "max_batch_size": args.concurrency,
+        "max_seq_len": args.prompt_len + args.max_tokens + 16,
+        "quantization": args.quantization,
+        "pd_slot_ttl_s": 10.0,
+        "serving": {
+            "queue_limit": max(4096, args.requests * 2),
+            "default_timeout_s": 600.0,
+        },
+    }
+    prompts = synth_prompt_strings(args.requests, args.prompt_len,
+                                   args.shared_prefix, seed=args.seed)
+    rate = float(args.arrival_rate) if args.arrival_rate else 3.0
+    gaps = _np.random.default_rng(args.seed).exponential(
+        1.0 / rate, len(prompts)
+    )
+    arrivals = [float(a) for a in _np.cumsum(gaps)]
+    span = arrivals[-1]
+
+    def leg(fleet: Any, pd: bool) -> Tuple[List[Dict[str, Any]], float]:
+        return asyncio.run(_drive_queued_jobs(
+            fleet.url, prompts, arrivals, args.max_tokens, pd
+        ))
+
+    out: Dict[str, Any] = {
+        "benchmark": "worker_serving_pd_split",
+        "path": "control_plane+pd_flow+streamed_handoff+adopt_slot",
+        "model": model, "backend": backend, "seed": args.seed,
+        "requests": args.requests, "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len, "max_tokens": args.max_tokens,
+        "arrival_rate_rps": rate,
+        "pd_split": f"{n_prefill}:{n_decode}", "workers": n,
+    }
+
+    # ---- PD leg + brownout on ONE fleet (warm once, reuse engines)
+    with LiveFleet(n=n, roles=roles, pd_data_plane=True,
+                   engine_config=engine_config) as fleet:
+        sched = fleet.plane.state.pd_flow.scheduler
+        leg(fleet, pd=True)                               # warm compiles
+        # scheduler counters are cumulative across legs on the shared
+        # fleet: every published stat is a per-leg DELTA
+        affinity_before = sched.stats["affinity_hits"]
+        pd_results, pd_elapsed = leg(fleet, pd=True)
+        pd_summary = _aggregate_summary(pd_results, pd_elapsed)
+        pd_summary["handoff_bytes"] = sum(
+            r.get("migration_bytes") or 0 for r in pd_results
+        )
+        pd_summary["affinity_hits"] = (
+            sched.stats["affinity_hits"] - affinity_before
+        )
+        out["pd"] = pd_summary
+
+        # ---- handoff brownout: partition the prefill side's pushes,
+        # then kill/restart the prefill worker mid-workload
+        flow = fleet.plane.state.pd_flow
+        reprefills_before = flow.stats["reprefills"]
+        rebalanced_before = sched.stats["role_rebalanced_prefill"]
+        t_part = round(0.10 * span, 3)
+        t_kill = round(0.35 * span, 3)
+        t_restart = round(0.60 * span, 3)
+        plan = FleetFaultPlan(args.seed, n_workers=n, duration_s=span,
+                              kinds=("kill", "handoff_partition"))
+        plan.events = [
+            FleetEvent(t_part, "handoff_partition", 0,
+                       duration_s=round(0.12 * span, 3)),
+            FleetEvent(t_kill, "kill", 0),
+            FleetEvent(t_restart, "restart", 0),
+        ]
+        fleet.run_chaos(plan)
+        try:
+            b_results, b_elapsed = leg(fleet, pd=True)
+        finally:
+            fleet.wait_chaos()
+        for m in fleet.members:
+            if not m.alive:
+                m.start()
+        brown = _aggregate_summary(b_results, b_elapsed)
+        kill_at = next(t for t, k, _ in plan.trace if k == "kill")
+        restart_at = next(t for t, k, _ in plan.trace if k == "restart")
+        ok = [r for r in b_results if r["status"] == 200]
+        in_window = [r for r in ok
+                     if t_part <= r["arrival_s"] < restart_at]
+        killed_wid = fleet.members[0].worker_id
+        recovered = [r["done_s"] for r in ok
+                     if r.get("prefill_worker") == killed_wid
+                     and r.get("done_s", 0.0) >= restart_at]
+        out["handoff_brownout"] = {
+            "partition_at_s": t_part,
+            "kill_at_s": round(kill_at, 3),
+            "restart_at_s": round(restart_at, 3),
+            "killed_prefill_worker": killed_wid,
+            "summary": brown,
+            "window": {
+                "offered": len([r for r in b_results
+                                if t_part <= r["arrival_s"] < restart_at]),
+                "completed_ok": len(in_window),
+                "ttft_ms": percentiles(
+                    [r["ttft_ms"] for r in in_window
+                     if r.get("ttft_ms") is not None]
+                ),
+                "e2e_ms": percentiles([r["e2e_ms"] for r in in_window]),
+            },
+            "reprefills": flow.stats["reprefills"] - reprefills_before,
+            "role_rebalanced_prefill":
+                sched.stats["role_rebalanced_prefill"] - rebalanced_before,
+            "time_to_recover_s": round(min(recovered) - restart_at, 3)
+            if recovered else None,
+            "outputs_identical_vs_calm_pd": (
+                {r["i"]: r.get("text") for r in ok}
+                == {r["i"]: r.get("text") for r in pd_results
+                    if r["status"] == 200}
+                and len(ok) == len(prompts)
+            ),
+        }
+        out["chaos_trace"] = [list(t) for t in plan.trace]
+
+    # ---- data-parallel baseline at EQUAL worker count
+    with LiveFleet(n=n, engine_config=engine_config) as fleet:
+        leg(fleet, pd=False)                              # warm compiles
+        dp_results, dp_elapsed = leg(fleet, pd=False)
+    out["data_parallel"] = _aggregate_summary(dp_results, dp_elapsed)
+    pd_texts = {r["i"]: r.get("text") for r in pd_results
+                if r["status"] == 200}
+    dp_texts = {r["i"]: r.get("text") for r in dp_results
+                if r["status"] == 200}
+    # completeness guard: equal PARTIAL dicts (both legs failing the same
+    # requests) must not report a vacuous identity
+    out["outputs_identical_pd_vs_dp"] = (
+        pd_texts == dp_texts
+        and len(pd_texts) == len(dp_texts) == len(prompts)
+    )
+    ratios: Dict[str, Any] = {}
+    for pct in ("p50", "p95"):
+        a = (pd_summary["ttft_ms"] or {}).get(pct)
+        b = (out["data_parallel"]["ttft_ms"] or {}).get(pct)
+        if a and b:
+            ratios[f"ttft_{pct}_pd_over_dp"] = round(a / b, 3)
+        a = (pd_summary["e2e_ms"] or {}).get(pct)
+        b = (out["data_parallel"]["e2e_ms"] or {}).get(pct)
+        if a and b:
+            ratios[f"e2e_{pct}_pd_over_dp"] = round(a / b, 3)
+    if out["data_parallel"]["aggregate_tokens_per_s"]:
+        ratios["tokens_per_s_pd_over_dp"] = round(
+            pd_summary["aggregate_tokens_per_s"]
+            / out["data_parallel"]["aggregate_tokens_per_s"], 3
+        )
+    out["pd_vs_dp"] = ratios
+    emit(out)
+
+
+# ---------------------------------------------------------------------------
 # --spec (round 8): spec ON vs OFF on the SLO frontier with an ORACLE draft.
 # Real 8B trained draft heads are environment-blocked (VERDICT r5 #3), but
 # the win condition is testable without them: the oracle forces the
@@ -1104,6 +1373,13 @@ def main() -> None:
                     help="≥2 stands up a FLEET behind a live control "
                     "plane and A/Bs cache-aware routing (admin flag "
                     "flipped live) on a seeded multi-tenant workload")
+    ap.add_argument("--pd-split", default=None, metavar="P:D",
+                    help="stand up a role-split LiveFleet (P prefill + D "
+                    "decode workers, real /kv/transfer data planes) and "
+                    "publish the PD frontier vs a data-parallel fleet at "
+                    "equal worker count, plus a handoff-brownout leg "
+                    "(handoff partition + prefill-side kill/restart: "
+                    "SLO-in-window, re-prefill count, time-to-recover)")
     ap.add_argument("--chaos", action="store_true",
                     help="cluster frontier + brownout mode: drive the "
                     "same open-loop workload through a LiveFleet at "
@@ -1127,6 +1403,13 @@ def main() -> None:
     args = ap.parse_args()
 
     backend, model = resolve_backend_model(args)
+
+    if args.pd_split:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--pd-split takes a single --arrival-rate (the "
+                     "comparison axis is PD vs data-parallel)")
+        run_pd_split(args, backend, model)
+        return
 
     if args.chaos:
         if args.arrival_rate and "," in str(args.arrival_rate):

@@ -520,6 +520,17 @@ def is_stream_message(data: bytes) -> bool:
     return data[:4] == _STREAM_MAGIC
 
 
+def message_kind(data: bytes) -> str:
+    """Human name of a handoff wire message's kind — fault-rule ``match``
+    context for the sender's push seam (rules can target, say, only
+    ``commit`` frames) and log labelling. One-shot blobs are ``blob``."""
+    if len(data) < 6 or not is_stream_message(data):
+        return "blob"
+    return {_KIND_BEGIN: "begin", _KIND_PIECE: "piece",
+            _KIND_COMMIT: "commit", _KIND_ABORT: "abort"}.get(
+                data[5], "unknown")
+
+
 def _pack_stream(kind: int, meta: Dict[str, Any],
                  payload: bytes = b"") -> bytes:
     mb = _pack_header(meta)
@@ -779,14 +790,35 @@ class HandoffReceiver:
     # make room. Sized well above any sane concurrent-migration fan-in.
     MAX_SESSIONS = 32
 
+    # commit-replay memo size: a retried commit whose first delivery's ACK
+    # was lost must answer idempotently (the slot is already bound — a
+    # "no session" error would fail a handoff that actually LANDED), so
+    # recent commits are remembered by key
+    MAX_COMMIT_MEMO = 32
+
     def __init__(self, engine: "TPUEngine") -> None:
         self.engine = engine
         self._sessions: Dict[str, _AdoptSession] = {}
+        # recently committed keys → the result dict their commit returned
+        # (insertion-ordered; oldest evicted past MAX_COMMIT_MEMO)
+        self._recent_commits: Dict[str, Dict[str, Any]] = {}
         # sessions_purged: abandoned migrations reclaimed (TTL, no-progress
         # backstop, or count-cap eviction) — exported via worker heartbeats
         # as kv_handoff_sessions_purged_total so they are VISIBLE, not just
-        # silently garbage-collected
-        self.stats: Dict[str, int] = {"sessions_purged": 0}
+        # silently garbage-collected. The per-reason counters break the
+        # total down (chaos suites assert each recovery path is COUNTED,
+        # not silently absorbed); "rx_aborts" counts sender-requested
+        # aborts, "commits" successful bindings.
+        self.stats: Dict[str, int] = {
+            "sessions_purged": 0,
+            "purged_ttl": 0,
+            "purged_no_progress": 0,
+            "purged_cap": 0,
+            "rx_aborts": 0,
+            "commits": 0,
+            "begin_duplicates": 0,
+            "commit_replays": 0,
+        }
 
     def handle(self, raw: bytes) -> Dict[str, Any]:
         # chaos seam: an installed FaultPlan can truncate or lose this
@@ -836,8 +868,23 @@ class HandoffReceiver:
                 "(and vice versa)"
             )
         key = meta["key"]
-        if key in self._sessions:
-            raise ValueError(f"streamed handoff {key!r} already begun")
+        existing = self._sessions.get(key)
+        if existing is not None:
+            rid = (meta.get("request") or {}).get("request_id")
+            if existing.request.request_id == rid:
+                # duplicate delivery (sender retried a begin whose ACK was
+                # lost): the session is already open for the SAME request —
+                # answer idempotently so the retry ladder composes with the
+                # streamed protocol instead of poisoning it
+                self.stats["begin_duplicates"] = (
+                    self.stats.get("begin_duplicates", 0) + 1
+                )
+                return {"kv_cache_key": key, "state": "begun",
+                        "cached_tokens": existing.cached_tokens,
+                        "duplicate": True}
+            raise ValueError(
+                f"streamed handoff {key!r} already begun by another request"
+            )
         # purge on ADOPT-SESSION pressure too, not only on message arrival:
         # age out stale sessions first, then — if a begin flood still has
         # the table at the cap — evict the stalest session so abandoned
@@ -847,7 +894,10 @@ class HandoffReceiver:
             stalest = min(self._sessions,
                           key=lambda k: self._sessions[k].last_activity)
             self._drop(stalest)
-            self.stats["sessions_purged"] += 1
+            self.stats["sessions_purged"] = (
+                self.stats.get("sessions_purged", 0) + 1
+            )
+            self.stats["purged_cap"] = self.stats.get("purged_cap", 0) + 1
         r = meta["request"]
         request = InferenceRequest(
             request_id=r["request_id"],
@@ -917,6 +967,14 @@ class HandoffReceiver:
 
     def _commit(self, meta: Dict[str, Any]) -> Dict[str, Any]:
         key = meta["key"]
+        if key not in self._sessions and key in self._recent_commits:
+            # retried commit after a lost ACK: the slot is already bound —
+            # answer the original result instead of failing a handoff that
+            # landed (the sender's retry ladder depends on this)
+            self.stats["commit_replays"] = (
+                self.stats.get("commit_replays", 0) + 1
+            )
+            return {**self._recent_commits[key], "replay": True}
         sess = self._require(key)
         eng = self.engine
         req = sess.request
@@ -975,10 +1033,17 @@ class HandoffReceiver:
             self._drop(key)
             raise
         del self._sessions[key]
-        return {"slot": slot, "kv_cache_key": key, "state": "committed",
-                "streamed": True}
+        self.stats["commits"] = self.stats.get("commits", 0) + 1
+        result = {"slot": slot, "kv_cache_key": key, "state": "committed",
+                  "streamed": True}
+        self._recent_commits[key] = result
+        while len(self._recent_commits) > self.MAX_COMMIT_MEMO:
+            self._recent_commits.pop(next(iter(self._recent_commits)))
+        return result
 
     def _abort(self, meta: Dict[str, Any]) -> Dict[str, Any]:
+        if str(meta.get("key", "")) in self._sessions:
+            self.stats["rx_aborts"] = self.stats.get("rx_aborts", 0) + 1
         self._drop(meta.get("key", ""))
         return {"kv_cache_key": meta.get("key"), "state": "aborted"}
 
@@ -1011,11 +1076,18 @@ class HandoffReceiver:
 
     def _purge_stale(self) -> None:
         now = time.monotonic()
-        for key in [k for k, s in self._sessions.items()
-                    if now - s.last_activity > self.SESSION_TTL_S
-                    or now - s.last_progress > self.SESSION_MAX_NO_PROGRESS_S]:
+        for key, sess in list(self._sessions.items()):
+            if now - sess.last_activity > self.SESSION_TTL_S:
+                reason = "purged_ttl"
+            elif now - sess.last_progress > self.SESSION_MAX_NO_PROGRESS_S:
+                reason = "purged_no_progress"
+            else:
+                continue
             self._drop(key)
-            self.stats["sessions_purged"] += 1
+            self.stats["sessions_purged"] = (
+                self.stats.get("sessions_purged", 0) + 1
+            )
+            self.stats[reason] = self.stats.get(reason, 0) + 1
 
 
 def deserialize_handoff(data: bytes) -> KVHandoff:

@@ -80,7 +80,7 @@ class ServerState:
             self.store, self.reliability,
             prefix_registry=self.prefix_registry, metrics=self.metrics,
         )
-        self.pd_flow = PDFlowService(self.store)
+        self.pd_flow = PDFlowService(self.store, metrics=self.metrics)
         self.guarantee = TaskGuaranteeService(
             self.store, self.reliability, heartbeat_timeout_s,
             # sweeps that permanently fail a PD stage child must fail its
@@ -303,6 +303,7 @@ async def _register_worker_locked(st: ServerState,
     # generating would turn that blip into duplicate compute — its jobs
     # stay put, and the sweep covers the case where it really is dying.
     prior = await st.store.get_worker(worker_id)
+    boot_id = body.get("boot_id")
     rejoined = False
     if prior is not None and prior.get("auth_token_hash") is not None:
         hb = prior.get("last_heartbeat")
@@ -310,6 +311,13 @@ async def _register_worker_locked(st: ServerState,
             prior.get("status") == WorkerState.OFFLINE.value
             or hb is None
             or time.time() - float(hb) > st.guarantee._heartbeat_timeout_s
+            # fast-restart fence: a NEW process (different boot_id) on the
+            # same fingerprint proves the old incarnation is dead even when
+            # the restart beat the heartbeat timeout — without this, its
+            # RUNNING jobs strand until the job timeout (the fresh process
+            # heartbeats happily, so no sweep ever fires)
+            or (bool(boot_id) and bool(prior.get("boot_id"))
+                and boot_id != prior.get("boot_id"))
         )
     bundle, stored = st.security.tokens.issue()
     row: Dict[str, Any] = {
@@ -339,6 +347,7 @@ async def _register_worker_locked(st: ServerState,
         "direct_url": body.get("direct_url"),
         "data_plane_url": body.get("data_plane_url"),
         "machine_fingerprint": fingerprint,
+        "boot_id": boot_id,
         **stored,
     }
     await st.store.upsert_worker(row)
@@ -528,6 +537,12 @@ async def heartbeat(request: web.Request) -> web.Response:
         batcher = es.get("batcher")
         if isinstance(batcher, dict):
             st.metrics.record_batcher_engine(worker_id, batcher)
+        # PD handoff lifecycle counters (sender outcomes, piece retries,
+        # receiver abort/purge reasons) → pd_handoffs_total{outcome} /
+        # pd_handoff_bytes_total per worker
+        pd = es.get("pd")
+        if isinstance(pd, dict):
+            st.metrics.record_pd_engine(worker_id, pd)
         ps = es.get("prefix_summary")
         if ps is not None:
             # cache-aware routing: the worker's advertised radix summary

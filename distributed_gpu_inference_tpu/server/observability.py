@@ -94,6 +94,8 @@ class Metrics:
                 "prefix_summaries_invalidated", "worker_rejoin",
                 "fleet_degraded", "chaos_kills", "chaos_partitions",
                 "chaos_events",
+                "pd_handoffs", "pd_handoff_bytes", "pd_reprefill",
+                "pd_fleet_balance",
             ):
                 setattr(self, name, noop)
             return
@@ -292,6 +294,30 @@ class Metrics:
             "chaos_events_total",
             "All chaos events injected by the fleet harness", ["kind"],
             registry=r)
+        # disaggregated prefill/decode under fire (round 11): handoff
+        # lifecycle by outcome (sender commits/failures/aborts + receiver
+        # abort/purge reasons — a rising failed:committed ratio means the
+        # handoff link is sick), bytes actually moved, re-prefill
+        # fallbacks by reason (the flow recovering a lost handoff/KV by
+        # redoing the prompt), and the per-role free-capacity balance
+        # (one side at 0 while the other has headroom = the brownout the
+        # role-rebalance fallback absorbs).
+        self.pd_handoffs = Counter(
+            "pd_handoffs_total",
+            "Prefill→decode KV handoff lifecycle events by outcome",
+            ["worker", "outcome"], registry=r)
+        self.pd_handoff_bytes = Counter(
+            "pd_handoff_bytes_total",
+            "Serialized KV handoff bytes pushed by prefill workers",
+            ["worker"], registry=r)
+        self.pd_reprefill = Counter(
+            "pd_reprefill_total",
+            "PD flows re-prefilled after a stage failure, by reason",
+            ["reason"], registry=r)
+        self.pd_fleet_balance = Gauge(
+            "pd_fleet_balance",
+            "Free PD serving capacity by role (prefill/decode slots "
+            "available across the registered pool)", ["role"], registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -310,6 +336,7 @@ class MetricsCollector:
         self._spec_prev: Dict[str, Dict[str, int]] = {}
         self._pressure_prev: Dict[str, Dict[str, int]] = {}
         self._batcher_prev: Dict[str, Dict[str, int]] = {}
+        self._pd_prev: Dict[str, Dict[str, int]] = {}
 
     def record_request(self, job_type: str, status: str,
                        latency_s: Optional[float] = None) -> None:
@@ -458,6 +485,64 @@ class MetricsCollector:
                 metric.labels(worker).inc(delta)
             prev[key] = cur
 
+    # heartbeat ``engine_stats["pd"]`` key → pd_handoffs_total outcome label
+    _PD_OUTCOMES = (
+        ("handoffs_committed", "committed"),
+        ("handoffs_failed", "failed"),
+        ("handoffs_aborted", "aborted"),
+        ("handoffs_local", "local"),
+        ("piece_retries", "piece_retry"),
+        ("adopted_expired", "adopted_expired"),
+        ("rx_aborts", "rx_abort"),
+        ("rx_purged_ttl", "rx_purged_ttl"),
+        ("rx_purged_no_progress", "rx_purged_no_progress"),
+        ("rx_purged_cap", "rx_purged_cap"),
+    )
+
+    def record_pd_engine(self, worker: str,
+                         pd_stats: Dict[str, Any]) -> None:
+        """Ingest one worker's PD handoff lifecycle counters (heartbeat
+        ``engine_stats["pd"]`` — ``TPULLMEngine.pd_wire_stats()``): sender
+        outcomes + receiver abort/purge reasons into
+        ``pd_handoffs_total{outcome}``, bytes into
+        ``pd_handoff_bytes_total``. Same delta anchoring as the
+        spec/pressure payloads: totals re-anchor on engine restart,
+        malformed fields skip the sample."""
+        prev = self._pd_prev.setdefault(worker, {})
+        for key, outcome in self._PD_OUTCOMES:
+            if key not in pd_stats:
+                continue
+            try:
+                cur = int(pd_stats.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                continue
+            delta = cur - prev.get(key, 0)
+            if delta > 0:
+                self.metrics.pd_handoffs.labels(worker, outcome).inc(delta)
+            prev[key] = cur
+        if "handoff_bytes" in pd_stats:
+            try:
+                cur = int(pd_stats.get("handoff_bytes", 0) or 0)
+            except (TypeError, ValueError):
+                return
+            delta = cur - prev.get("handoff_bytes", 0)
+            if delta > 0:
+                self.metrics.pd_handoff_bytes.labels(worker).inc(delta)
+            prev["handoff_bytes"] = cur
+
+    def record_pd_reprefill(self, reason: str) -> None:
+        """One PD flow fell back to re-prefill (stage failure, lost
+        handoff, dead kv_holder) — plane-side, counted by reason."""
+        self.metrics.pd_reprefill.labels(reason).inc()
+
+    def record_pd_fleet_balance(self, capacity: Dict[str, int]) -> None:
+        """Refresh the per-role free-capacity gauge from the PD
+        scheduler's registered pool (``capacity_by_role()``)."""
+        for role in ("prefill", "decode"):
+            self.metrics.pd_fleet_balance.labels(role).set(
+                float(capacity.get(role, 0) or 0)
+            )
+
     def record_prefix_route(self, path: str, hit: bool,
                             spillover: bool = False) -> None:
         """One routing decision on ``path`` (``direct`` discovery or the
@@ -498,7 +583,7 @@ class MetricsCollector:
         self.metrics.chaos_events.labels(kind).inc()
         if kind in ("kill",):
             self.metrics.chaos_kills.inc()
-        elif kind in ("partition", "blackout"):
+        elif kind in ("partition", "blackout", "handoff_partition"):
             self.metrics.chaos_partitions.inc()
 
     def record_checkpoint(self, worker: str) -> None:

@@ -29,6 +29,8 @@ Parent jobs are created RUNNING (never claimable); children carry
 
 from __future__ import annotations
 
+import asyncio
+import random
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -43,15 +45,42 @@ class PDFlowError(RuntimeError):
 
 
 class PDFlowService:
-    """Drives pd-disaggregated jobs through prefill → handoff → decode."""
+    """Drives pd-disaggregated jobs through prefill → handoff → decode,
+    with a re-prefill fallback: a failed stage (prefill worker died
+    mid-transfer, decode worker died after adoption, handoff lost or
+    corrupted) re-places the WHOLE flow — prompt prefilled again on a
+    surviving worker, failed workers excluded — up to ``max_reprefills``
+    times, WITHOUT burning the parent job's own retry budget (stage
+    children carry their own ``retry_count``; the flow's attempt counter
+    is independent of both)."""
+
+    # re-prefill budget per flow: attempts 0..max_reprefills (the prompt
+    # is recomputed from scratch each time, so this bounds wasted FLOPs,
+    # not correctness — greedy outputs are identical on any attempt)
+    MAX_REPREFILLS = 3
+    # jittered exponential backoff BETWEEN attempts
+    # (``U(0.5, 1.5)·base·2^(attempt-1)``): a handoff-partition window
+    # lasting a couple of seconds must not eat the whole budget in its
+    # first 200 ms — attempts spread past the outage instead. 0 disables
+    # (immediate, synchronous re-placement — deterministic tests).
+    REPREFILL_BACKOFF_S = 0.5
 
     def __init__(self, store: Store,
-                 scheduler: Optional[PrefillDecodeScheduler] = None) -> None:
+                 scheduler: Optional[PrefillDecodeScheduler] = None,
+                 metrics: Optional[Any] = None,
+                 max_reprefills: int = MAX_REPREFILLS,
+                 reprefill_backoff_s: float = REPREFILL_BACKOFF_S) -> None:
         self.store = store
         self.scheduler = scheduler or PrefillDecodeScheduler()
+        self.metrics = metrics
+        self.max_reprefills = max_reprefills
+        self.reprefill_backoff_s = reprefill_backoff_s
         # request_id → PDRequest (placement state released on completion)
         self._live: Dict[str, PDRequest] = {}
-        self.stats = {"submitted": 0, "completed": 0, "failed": 0}
+        # in-flight delayed re-placement tasks (strong refs)
+        self._bg: set = set()
+        self.stats = {"submitted": 0, "completed": 0, "failed": 0,
+                      "reprefills": 0, "stale_stage_results": 0}
 
     # ---------------------------------------------------------------- sync
 
@@ -79,6 +108,13 @@ class PDFlowService:
                     self.scheduler._workers.values()]:
             if wid not in seen:
                 self.scheduler.remove_worker(wid)
+        if self.metrics is not None:
+            # pd_fleet_balance{role}: free capacity per side, refreshed on
+            # every placement pass — a side pinned at 0 while the other
+            # has headroom is the brownout rebalance absorbs
+            self.metrics.record_pd_fleet_balance(
+                self.scheduler.capacity_by_role()
+            )
 
     # -------------------------------------------------------------- submit
 
@@ -91,25 +127,39 @@ class PDFlowService:
         self._finish(parent_id, ok=False)
         await self._cancel_queued_children(parent_id)
 
+    @staticmethod
+    def _child_id(parent_id: str, stage: str, attempt: int) -> str:
+        """Deterministic stage-child id per re-prefill attempt — attempt 0
+        keeps the legacy un-suffixed id (restart compatibility), retries
+        append ``-rN`` so a stale attempt's children never collide with
+        the live attempt's."""
+        base = f"{parent_id}-{stage}"
+        return base if attempt <= 0 else f"{base}-r{attempt}"
+
     async def _cancel_queued_children(self, parent_id: str) -> None:
-        for child_id in (f"{parent_id}-prefill", f"{parent_id}-decode"):
-            child = await self.store.get_job(child_id)
-            if child is not None and child["status"] == "queued":
-                # conditional transition: a pinned worker may claim/finish
-                # the child between the read and this write, and a terminal
-                # status must never be clobbered back to CANCELLED
-                await self.store.try_transition_job(
-                    child_id, "queued", status="cancelled",
-                    completed_at=time.time(),
-                )
+        for stage in ("prefill", "decode"):
+            for attempt in range(self.max_reprefills + 1):
+                child_id = self._child_id(parent_id, stage, attempt)
+                child = await self.store.get_job(child_id)
+                if child is not None and child["status"] == "queued":
+                    # conditional transition: a pinned worker may claim/
+                    # finish the child between the read and this write,
+                    # and a terminal status must never be clobbered back
+                    # to CANCELLED
+                    await self.store.try_transition_job(
+                        child_id, "queued", status="cancelled",
+                        completed_at=time.time(),
+                    )
 
     async def on_job_permanently_failed(self, job: Dict[str, Any]) -> None:
         """TaskGuarantee hook: the sweeps failed ``job`` for good (retries
         exhausted, container timeout, pinned worker gone). PD containers
         release placement and cancel orphaned children; PD stage children
-        fail their container NOW instead of stranding it RUNNING until its
-        own timeout — a stranded parent holds a scheduler placement and
-        keeps its sync waiters hanging the full window."""
+        enter the RE-PREFILL fallback (the flow re-places the whole
+        generation on surviving workers) and only fail their container
+        when the re-prefill budget is spent — a stranded parent holds a
+        scheduler placement and keeps its sync waiters hanging the full
+        window."""
         params = job.get("params") or {}
         # child check FIRST: stage children inherit the container's params
         # (pd_disaggregated included) and would otherwise match the
@@ -120,10 +170,7 @@ class PDFlowService:
             if parent is not None and parent["status"] not in (
                 "completed", "failed", "cancelled"
             ):
-                await self._fail(
-                    parent_id, params["pd_stage"],
-                    job.get("error") or "stage failed permanently",
-                )
+                await self._stage_failed(parent_id, params["pd_stage"], job)
             return
         if params.get("pd_disaggregated"):
             await self.on_parent_terminal(job["id"])
@@ -156,6 +203,16 @@ class PDFlowService:
             max_new_tokens=int(params.get("max_tokens") or 256),
             model_name=params.get("model") or "llama3-8b",
         )
+        await self._place_and_enqueue(parent, req)
+        self._live[parent["id"]] = req
+        self.stats["submitted"] += 1
+
+    async def _place_and_enqueue(self, parent: Dict[str, Any],
+                                 req: PDRequest) -> None:
+        """Place ``req`` on a prefill + decode pair and enqueue this
+        attempt's pinned prefill child. Raises :class:`PDFlowError` (with
+        placement fully released) when no capable pair exists."""
+        params = parent.get("params") or {}
         pw = self.scheduler.place_prefill(req)
         if pw is None:
             raise PDFlowError("no prefill-capable worker available")
@@ -165,29 +222,33 @@ class PDFlowService:
         dw = self.scheduler.place_decode(req)
         if dw is None:
             self.scheduler.release(req)
+            req.prefill_worker = None
             raise PDFlowError("no decode-capable worker available")
         decode_row = await self.store.get_worker(dw)
         decode_url = (decode_row or {}).get("data_plane_url")
         if dw != pw and not decode_url:
             self.scheduler.release(req)
+            req.prefill_worker = req.decode_worker = None
             raise PDFlowError(
                 f"decode worker {dw} advertises no data_plane_url for the "
                 "KV handoff"
             )
+        # fresh key per attempt: a stale attempt's adopted KV (if its push
+        # landed after all) can never be claimed by the live attempt's
+        # decode stage — it ages out via the worker's pd-slot TTL
         req.kv_cache_key = f"pd-{parent['id']}-{uuid.uuid4().hex[:8]}"
-        self._live[parent["id"]] = req
-        self.stats["submitted"] += 1
         child_params = {
             **params,
             "pd_stage": "prefill",
             "pd_parent": parent["id"],
+            "pd_attempt": req.attempt,
             "target_worker": pw,
             "decode_worker": dw,
             "decode_url": decode_url,
             "kv_cache_key": req.kv_cache_key,
         }
         await self.store.create_job({
-            "id": f"{parent['id']}-prefill",
+            "id": self._child_id(parent["id"], "prefill", req.attempt),
             "type": parent["type"],
             "params": child_params,
             "priority": int(parent.get("priority") or 0) + 5,
@@ -213,9 +274,18 @@ class PDFlowService:
             # placement state, never overwrite the terminal status
             self._finish(parent_id, ok=False)
             return
+        req = self._live.get(parent_id)
+        if req is not None and \
+                int(params.get("pd_attempt") or 0) != req.attempt:
+            # a STALE attempt's child finished late (its worker revived
+            # after the flow re-prefilled elsewhere): the live attempt
+            # owns the flow — ignore. KV the stale prefill pushed ages
+            # out via the decode worker's pd-slot TTL (fresh key per
+            # attempt, so the live decode stage can never claim it).
+            self.stats["stale_stage_results"] += 1
+            return
         if child["status"] != "completed":
-            await self._fail(parent_id, stage,
-                             child.get("error") or f"{stage} stage failed")
+            await self._stage_failed(parent_id, stage, child)
             return
         result = child.get("result") or {}
         if stage == "prefill":
@@ -242,7 +312,9 @@ class PDFlowService:
                 },
             })
             await self.store.create_job({
-                "id": f"{parent_id}-decode",
+                "id": self._child_id(
+                    parent_id, "decode", int(params.get("pd_attempt") or 0)
+                ),
                 "type": parent["type"],
                 "params": decode_params,
                 "priority": int(parent.get("priority") or 0) + 5,
@@ -272,6 +344,116 @@ class PDFlowService:
             ),
         )
         self._finish(parent_id, ok=won)
+
+    @staticmethod
+    def _failure_reason(stage: str, error: str) -> str:
+        """Counted re-prefill reason (``pd_reprefill_total{reason}``)."""
+        low = (error or "").lower()
+        if "no adopted kv" in low or "reclaimed" in low:
+            return "kv_holder_lost"
+        if "push" in low or "handoff" in low or "kv/transfer" in low:
+            return "handoff_failed"
+        return f"{stage}_failed"
+
+    async def _stage_failed(self, parent_id: str, stage: str,
+                            child: Dict[str, Any]) -> None:
+        """A stage child went terminal without completing (worker died
+        mid-transfer, handoff lost/corrupted, adopted KV gone, pinned
+        worker swept): RE-PREFILL — release the placement, exclude the
+        failed workers, and re-run the whole flow on survivors. The
+        parent's own retry budget is untouched; the flow's attempt
+        counter bounds the fallback. Out of budget (or flow state lost to
+        a plane restart) → fail the parent as before."""
+        params = child.get("params") or {}
+        error = child.get("error") or f"{stage} stage failed"
+        req = self._live.get(parent_id)
+        if req is not None and \
+                int(params.get("pd_attempt") or 0) != req.attempt:
+            # stale attempt failing late: the live attempt owns the flow
+            self.stats["stale_stage_results"] += 1
+            return
+        if req is None or req.attempt >= self.max_reprefills:
+            await self._fail(parent_id, stage, error)
+            return
+        parent = await self.store.get_job(parent_id)
+        if parent is None or parent["status"] in (
+            "completed", "failed", "cancelled"
+        ):
+            self._finish(parent_id, ok=False)
+            return
+        # release the failed placement; exclude the stage's pinned worker
+        # (and, for a prefill/handoff failure, the push target — a dead
+        # RECEIVER fails the sender's child). Exclusions are advisory:
+        # the scheduler retries over everyone before giving up.
+        self.scheduler.release(req)
+        excluded = {params.get("target_worker")}
+        if stage == "prefill":
+            excluded.add(params.get("decode_worker"))
+        req.excluded_workers |= {w for w in excluded if w}
+        req.prefill_worker = req.decode_worker = None
+        req.kv_holder = None
+        req.needs_migration = False
+        req.attempt += 1
+        self.stats["reprefills"] += 1
+        if self.metrics is not None:
+            self.metrics.record_pd_reprefill(
+                self._failure_reason(stage, error)
+            )
+        # a still-queued sibling of the failed attempt (e.g. its decode
+        # child) must not run against KV that no longer exists
+        await self._cancel_queued_children(parent_id)
+        if self.reprefill_backoff_s <= 0 or req.attempt == 1:
+            # FIRST fallback places immediately: a one-off failure (worker
+            # died, KV lost) recovers with no added latency, and a flow
+            # whose re-placement cannot succeed at all (fleet dark) fails
+            # promptly in the same pass — the round-10 contract
+            await self._replace_now(parent_id, req, stage, error)
+            return
+        # repeat failures back off with jitter before the next attempt: a
+        # handoff outage lasting a couple of seconds must not consume the
+        # whole budget before it heals
+        delay = (self.reprefill_backoff_s * (2 ** (req.attempt - 2))
+                 * (0.5 + random.random()))
+        task = asyncio.ensure_future(
+            self._replace_later(parent_id, req, req.attempt, stage,
+                                error, delay)
+        )
+        self._bg.add(task)
+        task.add_done_callback(self._bg.discard)
+
+    async def _replace_now(self, parent_id: str, req: PDRequest,
+                           stage: str, error: str) -> None:
+        await self._sync_workers()
+        parent = await self.store.get_job(parent_id)
+        if parent is None or parent["status"] in (
+            "completed", "failed", "cancelled"
+        ):
+            self._finish(parent_id, ok=False)
+            return
+        try:
+            await self._place_and_enqueue(parent, req)
+        except PDFlowError as exc:
+            await self._fail(
+                parent_id, stage,
+                f"{error}; re-prefill placement failed: {exc}",
+            )
+
+    async def _replace_later(self, parent_id: str, req: PDRequest,
+                             attempt: int, stage: str, error: str,
+                             delay: float) -> None:
+        try:
+            await asyncio.sleep(delay)
+            # the flow may have gone terminal (cancel, timeout) or been
+            # superseded while we slept — only the still-live attempt we
+            # scheduled for may place
+            if self._live.get(parent_id) is not req or \
+                    req.attempt != attempt:
+                return
+            await self._replace_now(parent_id, req, stage, error)
+        except Exception:  # noqa: BLE001 — a failed re-place must not
+            # leak an unobserved task exception; the parent either fails
+            # via _replace_now or the sweeps time it out
+            pass
 
     async def _fail(self, parent_id: str, stage: str, error: str) -> None:
         # conditional: a cancel or completion racing this failure between

@@ -124,6 +124,10 @@ class PDRequest:
     needs_migration: bool = False
     excluded_workers: set = field(default_factory=set)  # failed migration dsts
     migration_attempts: int = 0
+    # re-prefill fallback counter (pd_flow): a failed stage re-places the
+    # WHOLE flow — prefill again from the prompt — up to the flow's budget,
+    # without burning the job's own retry_count
+    attempt: int = 0
     # model geometry for KV size estimates
     num_layers: int = 32
     num_kv_heads: int = 8
@@ -141,7 +145,8 @@ class PrefillDecodeScheduler:
     """Routes requests through disaggregated prefill and decode pools."""
 
     def __init__(self, migrator: Optional["KVCacheMigrator"] = None,
-                 max_migration_attempts: int = 3) -> None:
+                 max_migration_attempts: int = 3,
+                 allow_role_rebalance: bool = True) -> None:
         self._workers: Dict[str, _PoolWorker] = {}
         self._prefill_q: List[_QueueEntry] = []
         self._decode_q: List[_QueueEntry] = []
@@ -152,10 +157,16 @@ class PrefillDecodeScheduler:
         self._cv = asyncio.Condition()
         self.migrator = migrator
         self.max_migration_attempts = max_migration_attempts
+        # brownout rebalance: when one SIDE of a split fleet has no capacity
+        # (every prefill worker dead or saturated), workers of the OTHER
+        # role temporarily accept hybrid work instead of idling while the
+        # starved queue melts down — counted, so the condition is visible
+        self.allow_role_rebalance = allow_role_rebalance
         self.stats: Dict[str, Any] = {
             "submitted": 0, "prefills_assigned": 0, "decodes_assigned": 0,
             "migrations_requested": 0, "affinity_hits": 0, "completed": 0,
             "migration_failures": 0, "migration_dropped": 0,
+            "role_rebalanced_prefill": 0, "role_rebalanced_decode": 0,
         }
 
     # -- pool membership ----------------------------------------------------
@@ -237,15 +248,49 @@ class PrefillDecodeScheduler:
                 setattr(w, attr, getattr(w, attr) - 1)
 
     def _assign_prefill(self, req: PDRequest) -> Optional[str]:
-        best, best_score = None, -1.0
-        for w in self.prefill_workers:
-            if w.active_prefill >= w.cap.max_prefill_batch:
-                continue
-            score = w.cap.compute_tflops / (1.0 + w.active_prefill)
-            if score > best_score:
-                best, best_score = w, score
+        # admission by queue depth: active_prefill counts this worker's
+        # in-flight prefill placements (queued + running stage children);
+        # a worker at max_prefill_batch takes nothing more, and with EVERY
+        # prefill worker saturated the flow answers 503 + Retry-After —
+        # backpressure, not silent queue growth
+        def _pick(pool: List[_PoolWorker],
+                  ignore_exclusions: bool) -> Optional[_PoolWorker]:
+            best, best_score = None, -1.0
+            for w in pool:
+                if w.active_prefill >= w.cap.max_prefill_batch:
+                    continue
+                if not ignore_exclusions and \
+                        w.cap.worker_id in req.excluded_workers:
+                    continue
+                score = w.cap.compute_tflops / (1.0 + w.active_prefill)
+                if score > best_score:
+                    best, best_score = w, score
+            return best
+
+        # exclusion fallback: workers that already failed THIS request are
+        # skipped, and a HEALTHY rebalance candidate (other role) beats
+        # retrying an excluded one — the excluded worker just failed us,
+        # possibly persistently (partitioned pushes). Only when nothing
+        # un-excluded exists anywhere does the retry-over-everyone pass
+        # run, so a transient failure can never strand the request.
+        rebalance = [w for w in self._workers.values()
+                     if not w.cap.can_prefill] \
+            if self.allow_role_rebalance else []
+        best = _pick(self.prefill_workers, False)
+        rebalanced = False
+        if best is None and rebalance:
+            best = _pick(rebalance, False)
+            rebalanced = best is not None
+        if best is None and req.excluded_workers:
+            best = _pick(self.prefill_workers, True)
+            rebalanced = False
+        if best is None and rebalance and req.excluded_workers:
+            best = _pick(rebalance, True)
+            rebalanced = best is not None
         if best is None:
             return None
+        if rebalanced:
+            self.stats["role_rebalanced_prefill"] += 1
         best.active_prefill += 1
         best.total_prefills += 1
         req.prefill_worker = best.cap.worker_id
@@ -270,10 +315,12 @@ class PrefillDecodeScheduler:
         # Workers that already failed a migration for THIS request are skipped
         # (no livelock against a dead link); if exclusion empties the candidate
         # set, retry over everyone — a transient failure must not strand the
-        # request when only one decode worker exists.
-        def _pick(ignore_exclusions: bool) -> Optional[_PoolWorker]:
+        # request when only one decode worker exists. A browned-out decode
+        # side falls back to prefill-role workers (rebalance, counted).
+        def _pick(pool: List[_PoolWorker],
+                  ignore_exclusions: bool) -> Optional[_PoolWorker]:
             best, best_score = None, -1.0
-            for w in self.decode_workers:
+            for w in pool:
                 if w.active_decode >= w.cap.max_decode_batch:
                     continue
                 if not ignore_exclusions and \
@@ -284,11 +331,26 @@ class PrefillDecodeScheduler:
                     best, best_score = w, score
             return best
 
-        best = _pick(ignore_exclusions=False)
+        rebalance = [w for w in self._workers.values()
+                     if not w.cap.can_decode] \
+            if self.allow_role_rebalance else []
+        best = _pick(self.decode_workers, False)
+        rebalanced = False
+        if best is None and rebalance:
+            # healthy other-role capacity beats retrying an excluded
+            # (just-failed) decode worker — same order as prefill
+            best = _pick(rebalance, False)
+            rebalanced = best is not None
         if best is None and req.excluded_workers:
-            best = _pick(ignore_exclusions=True)
+            best = _pick(self.decode_workers, True)
+            rebalanced = False
+        if best is None and rebalance and req.excluded_workers:
+            best = _pick(rebalance, True)
+            rebalanced = best is not None
         if best is None:
             return None
+        if rebalanced:
+            self.stats["role_rebalanced_decode"] += 1
         best.active_decode += 1
         best.total_decodes += 1
         req.decode_worker = best.cap.worker_id
@@ -419,6 +481,23 @@ class PrefillDecodeScheduler:
         w = self._workers.get(src)
         gBps = w.cap.interconnect_gbps if w else 25.0  # GB/s, like all BW here
         return req.kv_bytes / (gBps * 1e9) * 1000.0
+
+    def capacity_by_role(self) -> Dict[str, int]:
+        """Free serving capacity per PD role (prefill slots / decode slots
+        still available across the registered pool) — the ``pd_fleet_
+        balance`` gauge. A side at 0 while the other has headroom is the
+        brownout the role-rebalance fallback exists for."""
+        cap = {"prefill": 0, "decode": 0}
+        for w in self._workers.values():
+            if w.cap.can_prefill:
+                cap["prefill"] += max(
+                    0, w.cap.max_prefill_batch - w.active_prefill
+                )
+            if w.cap.can_decode:
+                cap["decode"] += max(
+                    0, w.cap.max_decode_batch - w.active_decode
+                )
+        return cap
 
     def get_stats(self) -> Dict[str, Any]:
         out = dict(self.stats)

@@ -233,6 +233,14 @@ _MIGRATIONS = [
         " block_chars INTEGER NOT NULL DEFAULT 64,"
         " entries TEXT,"
         " updated_at REAL)"),
+    # v7: fast-restart fencing — each worker PROCESS mints a boot_id at
+    # startup and sends it with registration. A re-registration on the
+    # same fingerprint with a DIFFERENT boot_id proves the previous
+    # incarnation is dead even when the restart beat the heartbeat
+    # timeout (fast supervisor): its RUNNING jobs requeue immediately
+    # instead of stranding until the job timeout. A credential-blip
+    # re-register from the SAME process keeps its boot_id and its work.
+    (7, "ALTER TABLE workers ADD COLUMN boot_id TEXT"),
 ]
 
 SCHEMA_VERSION = max(

@@ -388,10 +388,28 @@ def stream_cut(site: str, **ctx: Any) -> bool:
 # never leave a replica dead forever by construction.
 FLEET_EVENT_KINDS = ("kill", "blackout", "partition", "pressure", "slow")
 
+# handoff-targeted kinds (round 11 — PD split fleets): chaos on the
+# prefill→decode KV stream itself rather than on whole replicas.
+# ``handoff_partition`` cuts a worker's outbound KV pushes (sender-side
+# flap on ``worker.pd.push``), ``handoff_corrupt`` truncates received
+# handoff messages in transit (``kv.receiver.message``, fleet-wide,
+# probabilistic), ``handoff_delay`` injects per-piece latency so send
+# timeouts + retries fire. Kept OUT of FLEET_EVENT_KINDS so round-9
+# seeds keep regenerating their exact historical schedules.
+HANDOFF_EVENT_KINDS = ("handoff_partition", "handoff_corrupt",
+                       "handoff_delay")
+ALL_FLEET_EVENT_KINDS = FLEET_EVENT_KINDS + HANDOFF_EVENT_KINDS
+
 # the canonical suite/CLI geometry: ``--replay`` must reconstruct the EXACT
 # schedule a failing suite seed ran, so both sides share these defaults
 FLEET_CHAOS_WORKERS = 2
 FLEET_CHAOS_DURATION_S = 6.0
+
+# PD-split chaos suite geometry (tests/test_pd_chaos.py): 3 workers
+# (1 prefill + 2 decode), kills + partitions + every handoff kind —
+# ``--replay SEED --pd`` reconstructs these schedules
+PD_CHAOS_WORKERS = 3
+PD_CHAOS_KINDS = ("kill", "partition") + HANDOFF_EVENT_KINDS
 
 
 @dataclass(frozen=True)
@@ -414,6 +432,14 @@ class FleetEvent:
                pool-exhausted for ``duration_s`` at ``prob``
     slow       latency injection: every direct request/stream event of
                the replica sleeps ``delay_s`` for ``duration_s``
+    handoff_partition  the worker's outbound KV handoff pushes hard-drop
+               for ``duration_s`` (``worker.pd.push`` flap) — the
+               prefill→decode stream is cut while both replicas live
+    handoff_corrupt    received handoff messages truncate in transit at
+               ``prob`` for ``duration_s`` (``kv.receiver.message``,
+               fleet-wide) — pieces poison their session, commits abort
+    handoff_delay      every outbound handoff piece of the worker pays
+               ``delay_s`` for ``duration_s`` — send timeouts/retries
     =========  ==========================================================
     """
 
@@ -445,10 +471,10 @@ class FleetFaultPlan:
                  kinds: Sequence[str] = FLEET_EVENT_KINDS,
                  max_disruptions: int = 2) -> None:
         for k in kinds:
-            if k not in FLEET_EVENT_KINDS:
+            if k not in ALL_FLEET_EVENT_KINDS:
                 raise ValueError(
                     f"unknown fleet event kind {k!r} "
-                    f"(one of {FLEET_EVENT_KINDS})"
+                    f"(one of {ALL_FLEET_EVENT_KINDS})"
                 )
         self.seed = seed
         self.n_workers = n_workers
@@ -486,7 +512,19 @@ class FleetFaultPlan:
                     duration_s=round(dur, 3),
                     delay_s=round(0.02 + 0.08 * rng.random(), 3),
                 ))
-            else:  # blackout / partition
+            elif kind == "handoff_corrupt":
+                events.append(FleetEvent(
+                    round(cursor, 3), "handoff_corrupt", -1,
+                    duration_s=round(dur, 3),
+                    prob=0.25 + 0.5 * rng.random(),
+                ))
+            elif kind == "handoff_delay":
+                events.append(FleetEvent(
+                    round(cursor, 3), "handoff_delay", worker,
+                    duration_s=round(dur, 3),
+                    delay_s=round(0.02 + 0.08 * rng.random(), 3),
+                ))
+            else:  # blackout / partition / handoff_partition
                 events.append(FleetEvent(
                     round(cursor, 3), kind, worker,
                     duration_s=round(dur, 3),
@@ -510,9 +548,9 @@ class FleetFaultPlan:
             extra = ""
             if e.duration_s:
                 extra += f" for {e.duration_s}s"
-            if e.kind == "pressure":
+            if e.kind in ("pressure", "handoff_corrupt"):
                 extra += f" prob={e.prob:.2f}"
-            if e.kind == "slow":
+            if e.kind in ("slow", "handoff_delay"):
                 extra += f" delay={e.delay_s}s"
             out.append(f"  t+{e.at_s:6.2f}s  {e.kind:<9} {tgt}{extra}")
         return out
@@ -558,17 +596,31 @@ def _replay_main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("--replay", type=int, required=True, metavar="SEED",
                     help="the failing suite seed to reconstruct")
-    ap.add_argument("--workers", type=int, default=FLEET_CHAOS_WORKERS,
-                    help="fleet size the suite ran (default: suite default)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="fleet size the suite ran (default: suite default; "
+                    "the PD suite's with --pd)")
     ap.add_argument("--duration", type=float,
                     default=FLEET_CHAOS_DURATION_S,
                     help="chaos window seconds (default: suite default)")
-    ap.add_argument("--kinds", default=",".join(FLEET_EVENT_KINDS),
-                    help="comma-separated event kinds the suite allowed")
+    ap.add_argument("--kinds", default=None,
+                    help="comma-separated event kinds the suite allowed "
+                    "(default: the fleet suite's kinds, or the PD suite's "
+                    "with --pd)")
+    ap.add_argument("--pd", action="store_true",
+                    help="reconstruct a tests/test_pd_chaos.py seed: the "
+                    "PD-split suite's kinds (kill/partition + handoff_"
+                    "partition/corrupt/delay) and its 3-worker fleet "
+                    "geometry")
     args = ap.parse_args(argv)
+    kinds = args.kinds
+    if kinds is None:
+        kinds = ",".join(PD_CHAOS_KINDS if args.pd else FLEET_EVENT_KINDS)
+    workers = args.workers
+    if workers is None:
+        workers = PD_CHAOS_WORKERS if args.pd else FLEET_CHAOS_WORKERS
     plan = FleetFaultPlan(
-        args.replay, n_workers=args.workers, duration_s=args.duration,
-        kinds=tuple(k for k in args.kinds.split(",") if k),
+        args.replay, n_workers=workers, duration_s=args.duration,
+        kinds=tuple(k for k in kinds.split(",") if k),
     )
     for line in plan.describe():
         print(line)

@@ -148,6 +148,7 @@ class FleetWorker:
                  hb_interval_s: float = 0.2,
                  poll_interval_s: float = 0.05,
                  role: Optional[str] = None,
+                 pd_data_plane: bool = False,
                  region: str = "us-west") -> None:
         self.index = index
         self.plane_url = plane_url
@@ -155,8 +156,15 @@ class FleetWorker:
         self.hb_interval_s = hb_interval_s
         self.poll_interval_s = poll_interval_s
         self.role = role
+        # PD split fleets: run a real DataPlaneServer (/kv/transfer) so
+        # prefill peers can stream KV handoffs at this member, and
+        # register its URL. EVERY member of a PD fleet runs one — role
+        # rebalance can hand decode work to a prefill-role worker when
+        # the decode side browns out, and it must be able to receive.
+        self.pd_data_plane = pd_data_plane
         self.region = region
         self.tag = f"fw{index}"
+        self.pd_plane: Optional[Any] = None
         # stable across restarts of THIS member: re-registration must land
         # on the same worker row (rejoin accounting, job requeue)
         self.fingerprint = f"fleet-{index}-{uuid.uuid4().hex[:8]}"
@@ -185,6 +193,9 @@ class FleetWorker:
 
         llm = TPULLMEngine(dict(self.engine_config))
         llm.load_model()
+        # per-replica chaos targeting on the KV push seam
+        # (worker.pd.push rules match {"worker": tag})
+        llm.fault_tag = self.tag
         api = APIClient(self.plane_url, backoff_s=0.0)
         api.fault_tag = self.tag
         cfg = WorkerConfig(
@@ -209,9 +220,24 @@ class FleetWorker:
             "machine_fingerprint": self.fingerprint,
             "supported_types": ["llm"], "supports_direct": True,
             "direct_url": f"http://127.0.0.1:{port}",
+            # fresh per cold (re)start: a restart that beats the heartbeat
+            # timeout still requeues the dead incarnation's RUNNING jobs
+            "boot_id": w.boot_id,
         }
         if self.role:
             info["role"] = self.role
+        if self.pd_data_plane:
+            from ..comm.data_plane import DataPlaneServer
+            from ..worker.main import _PDReceiverShim
+
+            self.pd_plane = DataPlaneServer(
+                _PDReceiverShim(llm), host="127.0.0.1", port=0,
+                kv_receiver=llm.kv_receiver,
+            )
+            self.pd_plane.start()
+            info["data_plane_url"] = (
+                f"http://127.0.0.1:{self.pd_plane.bound_port}"
+            )
         api.register(info)
         self.worker_id = api.worker_id
         w.state = WorkerState.IDLE
@@ -251,6 +277,14 @@ class FleetWorker:
             self.worker._shutdown.set()   # stops the poll loop
         if self.server is not None:
             self.server.stop()            # in-flight sockets die abruptly
+        if self.pd_plane is not None:
+            # the KV receiver dies with the process: in-flight handoff
+            # sessions are lost, senders see refused connections
+            try:
+                self.pd_plane.stop()
+            except Exception:  # noqa: BLE001 — a crash is not graceful
+                pass
+            self.pd_plane = None
         if self.llm is not None:
             # resolves outstanding batcher futures with errors and stops
             # the engine — concurrent requests see a crashed process
@@ -294,6 +328,24 @@ class FleetWorker:
                       times=None, match={"worker": self.tag}),
         ]
 
+    def handoff_rules(self) -> List[FaultRule]:
+        """Rules a ``handoff_partition`` arms: THIS replica's outbound KV
+        handoff pushes hard-drop — the prefill→decode stream is cut while
+        both sides keep serving (the sender's piece-retry ladder, abort
+        path, and the flow's re-prefill fallback take it from there)."""
+        return [
+            FaultRule(site="worker.pd.push", kind="flap", times=None,
+                      match={"worker": self.tag}),
+        ]
+
+    def handoff_delay_rules(self, delay_s: float) -> List[FaultRule]:
+        """Per-piece latency on THIS replica's outbound KV pushes."""
+        return [
+            FaultRule(site="worker.pd.push", kind="delay",
+                      delay_s=delay_s, times=None,
+                      match={"worker": self.tag}),
+        ]
+
     def slow_rules(self, delay_s: float) -> List[FaultRule]:
         """Latency-injection rules: every direct request admission and
         stream event of THIS replica pays ``delay_s``."""
@@ -333,7 +385,8 @@ class LiveFleet:
                  poll_interval_s: float = 0.05,
                  sweep_interval_s: float = 0.25,
                  submit_queue_limit: int = 0,
-                 roles: Optional[List[Optional[str]]] = None) -> None:
+                 roles: Optional[List[Optional[str]]] = None,
+                 pd_data_plane: bool = False) -> None:
         self.n = n
         self.engine_config = dict(engine_config or DEFAULT_FLEET_ENGINE)
         self.hb_interval_s = hb_interval_s
@@ -342,6 +395,9 @@ class LiveFleet:
         self.roles = list(roles) if roles is not None else [None] * n
         if len(self.roles) != n:
             raise ValueError("roles must have one entry per member")
+        # PD split fleets: every member runs a /kv/transfer data plane and
+        # registers its URL (role rebalance can point a handoff anywhere)
+        self.pd_data_plane = pd_data_plane
         self.plane = LiveControlPlane(
             heartbeat_timeout_s=heartbeat_timeout_s,
             submit_queue_limit=submit_queue_limit,
@@ -363,6 +419,7 @@ class LiveFleet:
                     hb_interval_s=self.hb_interval_s,
                     poll_interval_s=self.poll_interval_s,
                     role=self.roles[i],
+                    pd_data_plane=self.pd_data_plane,
                 )
                 m.start()
                 self.members.append(m)
@@ -506,4 +563,26 @@ class LiveFleet:
                 site="kv.block.alloc", kind="pressure", prob=ev.prob,
             ))
             return lambda: fp.remove_rule(rule)
+        if ev.kind == "handoff_partition":
+            rules = (member.handoff_rules() if member is not None else
+                     [FaultRule(site="worker.pd.push", kind="flap",
+                                times=None)])
+            armed = [fp.add_rule(r) for r in rules]
+            return lambda: [fp.remove_rule(r) for r in armed]
+        if ev.kind == "handoff_corrupt":
+            # fleet-wide: any receiver sees truncated handoff messages at
+            # ev.prob — pieces poison their session, commits abort, and
+            # the sender's retry/abort + the flow's re-prefill recover
+            rule = fp.add_rule(FaultRule(
+                site="kv.receiver.message", kind="truncate", cut=48,
+                prob=ev.prob, times=None,
+            ))
+            return lambda: fp.remove_rule(rule)
+        if ev.kind == "handoff_delay":
+            rules = (member.handoff_delay_rules(ev.delay_s)
+                     if member is not None else
+                     [FaultRule(site="worker.pd.push", kind="delay",
+                                delay_s=ev.delay_s, times=None)])
+            armed = [fp.add_rule(r) for r in rules]
+            return lambda: [fp.remove_rule(r) for r in armed]
         raise ValueError(f"unknown fleet event kind {ev.kind!r}")

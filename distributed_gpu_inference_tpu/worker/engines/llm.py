@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import queue as _queue_mod
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -30,6 +31,8 @@ from ...runtime.batcher import (
     RequestMigrated,
     synthesize_checkpoint,
 )
+from ...testing import faults as _faults
+from ...utils.backoff import full_jitter_delay
 from ...runtime.engine import EngineConfig, PreemptedSequence, TPUEngine
 from ...runtime.prefix_summary import TIER_HOST, PrefixHotSet
 from ...utils.config import ServingConfig
@@ -271,9 +274,40 @@ class TPULLMEngine(LLMBaseEngine):
         self.serving: Optional[BatcherServing] = None
         self._spec = None            # EAGLE-style decoder (engine=jax-speculative)
         self.tokenizer = self.config.get("tokenizer")
-        # PD disaggregation: kv_cache_key → engine slot holding an adopted
-        # (or locally retained) sequence awaiting its decode-stage job
-        self._pd_slots: Dict[str, int] = {}
+        # PD disaggregation: kv_cache_key → (slot, seq, adopted_at) — an
+        # adopted (or locally retained) sequence awaiting its decode-stage
+        # job. ``seq`` identity-guards late frees (the slot index may be
+        # recycled), ``adopted_at`` drives the TTL purge: a decode job that
+        # never arrives (decode child swept, parent re-prefilled elsewhere)
+        # must not pin its KV blocks for the life of the engine.
+        self._pd_slots: Dict[str, tuple] = {}
+        self.pd_slot_ttl_s = float(
+            self.config.get("pd_slot_ttl_s", 180.0) or 180.0
+        )
+        # sender/receiver handoff lifecycle counters — cumulative totals,
+        # heartbeat engine_stats["pd"] → delta-anchored
+        # pd_handoffs_total{outcome} / pd_handoff_bytes_total on the plane
+        self.pd_stats: Dict[str, int] = {
+            "handoffs_committed": 0,
+            "handoffs_failed": 0,
+            "handoffs_aborted": 0,
+            "handoffs_local": 0,
+            "handoff_bytes": 0,
+            "piece_retries": 0,
+            "adopted_expired": 0,
+        }
+        # per-piece push robustness knobs (satellite: a transport blip must
+        # not fail the whole handoff on the first try)
+        self._pd_push_timeout_s = float(
+            self.config.get("pd_push_timeout_s", 30.0) or 30.0
+        )
+        self._pd_push_retries = int(
+            self.config.get("pd_push_retries", 3) or 0
+        )
+        self._pd_push_backoff_s = float(
+            self.config.get("pd_push_backoff_s", 0.2) or 0.2
+        )
+        self._pd_rng = random.Random(0x9D5)
         # serializes engine mutation between the job path and the
         # data-plane KV receiver thread (adoption arrives asynchronously)
         self._engine_lock = threading.Lock()
@@ -723,6 +757,92 @@ class TPULLMEngine(LLMBaseEngine):
             time.perf_counter() - t0,
         )
 
+    def _pd_push(self, client: Any, url: str, content: bytes) -> Any:
+        """POST one handoff message with a per-piece timeout and a bounded
+        full-jitter retry ladder (``utils.backoff`` — the same formula as
+        the APIClient's): a transport blip or transient 5xx must not fail
+        the whole handoff on its first occurrence. Receiver-side begin and
+        commit are idempotent (duplicate-delivery tolerant), and piece
+        re-staging is a no-op on already-staged blocks, so retrying any
+        message kind is safe. Retries are counted (``piece_retries``) so a
+        flaky link is VISIBLE in /metrics, not silently absorbed."""
+        from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+            message_kind,
+        )
+
+        kind = message_kind(content)
+        attempt = 0
+        while True:
+            try:
+                r = _faults.wrap_http(
+                    "worker.pd.push",
+                    lambda: client.post(
+                        url, content=content,
+                        headers={"content-type": "application/octet-stream"},
+                        timeout=self._pd_push_timeout_s,
+                    ),
+                    worker=str(getattr(self, "fault_tag", "") or ""),
+                    kind=kind,
+                )
+                if r.status_code < 500:
+                    r.raise_for_status()   # 4xx: receiver rejected — no retry
+                    return r
+                last = RuntimeError(
+                    f"KV push {kind} answered HTTP {r.status_code}: "
+                    f"{r.text[:200]}"
+                )
+            except httpx.TransportError as exc:
+                last = exc
+            if attempt >= self._pd_push_retries:
+                raise last
+            delay = full_jitter_delay(
+                self._pd_push_backoff_s, attempt, self._pd_rng
+            )
+            time.sleep(delay or 0.0)
+            attempt += 1
+            self.pd_stats["piece_retries"] += 1
+
+    def _purge_stale_pd_slots(self) -> None:
+        """Free adopted/retained PD slots whose decode-stage job never
+        arrived within ``pd_slot_ttl_s`` (decode child swept, parent
+        re-prefilled elsewhere, stale attempt completing late) — an
+        orphaned adoption must not pin its KV blocks for the life of the
+        engine. Caller holds ``_engine_lock``; frees run serialized with
+        decode rounds and are identity-guarded against slot recycling."""
+        if not self._pd_slots:
+            return
+        now = time.monotonic()
+        eng = self.engine
+        for key, (slot, seq, adopted_at) in list(self._pd_slots.items()):
+            if now - adopted_at <= self.pd_slot_ttl_s:
+                continue
+            # pop-to-claim: pd_decode pops WITHOUT the engine lock, so
+            # the dict pop is the one atomic arbiter — if the decode
+            # stage won the entry between our snapshot and now, the
+            # sequence is live (being adopted into the batch) and is NOT
+            # ours to free
+            if self._pd_slots.pop(key, None) is None:
+                continue
+            self.pd_stats["adopted_expired"] += 1
+            if eng is not None:
+                self._release_adopted_slot(eng, slot, seq)
+
+    def pd_maintain(self) -> None:
+        """Periodic PD housekeeping (worker heartbeat cadence): age out
+        adopted slots whose decode stage never came — a re-prefilled flow
+        cancels its stale decode child, but the KV its prefill already
+        pushed would otherwise sit adopted until message-driven purging
+        happens to run. Non-blocking: a busy engine lock skips this beat
+        (the next one retries)."""
+        if not self._pd_slots or self.engine is None:
+            return
+        if not self._engine_lock.acquire(blocking=False):
+            return
+        try:
+            self._purge_stale_pd_slots()
+        finally:
+            self._engine_lock.release()
+
     def pd_prefill(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Prefill stage: run the prompt, sample the first token (TTFT),
         export the sequence's KV pages, and push them to the decode worker's
@@ -785,8 +905,17 @@ class TPULLMEngine(LLMBaseEngine):
             )
             prompt_tokens = s.prompt_len
             if local:
-                # KV affinity: this worker decodes too — retain the slot
-                self._pd_slots[key] = slot
+                # KV affinity: this worker decodes too — retain the slot.
+                # A re-run of the same child (lost completion report)
+                # supersedes its previous retained slot — free it or it
+                # leaks with no TTL entry (we're on the engine executor:
+                # freeing directly is serialized with decode rounds).
+                prev = self._pd_slots.get(key)
+                if prev is not None and prev[0] != slot and \
+                        self.engine.slots[prev[0]] is prev[1]:
+                    self.pd_stats["adopted_expired"] += 1
+                    self.engine.finish_slot(prev[0], cache=False)
+                self._pd_slots[key] = (slot, s, time.monotonic())
                 return slot, first_token, ttft_ms, prompt_tokens, None
             try:
                 handoff = export_slot_kv(self.engine, slot)
@@ -802,6 +931,7 @@ class TPULLMEngine(LLMBaseEngine):
             slot, first_token, ttft_ms, prompt_tokens, raw = \
                 self._exclusive(_prefill_and_export)
         if local:
+            self.pd_stats["handoffs_local"] += 1
             return {
                 "pd_stage": "prefill", "kv_cache_key": key,
                 "first_token": first_token, "ttft_ms": ttft_ms,
@@ -817,15 +947,18 @@ class TPULLMEngine(LLMBaseEngine):
         # adopt concurrently (kv_receiver takes the lock the engine work
         # above released) — no crossed-push deadlock
         t0 = time.perf_counter()
-        resp = httpx.post(
-            decode_url.rstrip("/") + "/kv/transfer",
-            content=raw,
-            headers={"content-type": "application/octet-stream"},
-            timeout=60.0,
-        )
-        resp.raise_for_status()
+        try:
+            with httpx.Client() as client:
+                resp = self._pd_push(
+                    client, decode_url.rstrip("/") + "/kv/transfer", raw
+                )
+        except Exception:
+            self.pd_stats["handoffs_failed"] += 1
+            raise
         migration_ms = (time.perf_counter() - t0) * 1000.0
         remote = resp.json()
+        self.pd_stats["handoffs_committed"] += 1
+        self.pd_stats["handoff_bytes"] += len(raw)
         return {
             "pd_stage": "prefill", "kv_cache_key": key,
             "first_token": first_token, "ttft_ms": ttft_ms,
@@ -868,12 +1001,10 @@ class TPULLMEngine(LLMBaseEngine):
                     if state["exc"] is not None:
                         continue        # drain after failure
                     try:
-                        r = client.post(
-                            url, content=item,
-                            headers={"content-type":
-                                     "application/octet-stream"},
-                        )
-                        r.raise_for_status()
+                        # per-piece timeout + bounded jittered retry
+                        # (_pd_push): a transport blip mid-stream retries
+                        # the piece instead of failing the whole handoff
+                        r = self._pd_push(client, url, item)
                         state["last"] = r.json()
                         state["t_ack"] = time.perf_counter()
                     except Exception as exc:  # noqa: BLE001
@@ -887,7 +1018,12 @@ class TPULLMEngine(LLMBaseEngine):
         def _abort_remote() -> None:
             # direct POST, not via the queue — the sender drains (skips)
             # queued items once state["exc"] is set, and the receiver's
-            # half-built session would otherwise pin its KV blocks
+            # half-built session would otherwise pin its KV blocks.
+            # Each failed handoff is counted EXACTLY ONCE across the
+            # pd_handoffs_total outcome labels: "aborted" = a streamed
+            # handoff failed and its abort was sent (this path);
+            # "failed" = a one-shot push failed (no session to abort).
+            self.pd_stats["handoffs_aborted"] += 1
             try:
                 httpx.post(url, content=abort_message(key), timeout=10.0)
             except Exception:  # noqa: BLE001
@@ -934,6 +1070,8 @@ class TPULLMEngine(LLMBaseEngine):
             _abort_remote()
             raise state["exc"]
         remote = state["last"] or {}
+        self.pd_stats["handoffs_committed"] += 1
+        self.pd_stats["handoff_bytes"] += exp.bytes_sent
         migration_ms = (
             (state["t_ack"] - t_prefill_end) * 1000.0
             if state["t_ack"] is not None and t_prefill_end is not None
@@ -960,12 +1098,21 @@ class TPULLMEngine(LLMBaseEngine):
         if not self.loaded or self.engine is None:
             raise EngineLoadError("engine not loaded")
         key = params.get("kv_cache_key") or ""
-        slot = self._pd_slots.pop(key, None)
-        if slot is None:
+        entry = self._pd_slots.pop(key, None)
+        if entry is None:
             raise RuntimeError(
                 f"no adopted KV for key {key!r} — handoff never arrived"
             )
+        slot, _adopted_seq, _adopted_at = entry
         eng = self.engine
+        if eng.slots[slot] is not _adopted_seq:
+            # the adoption was reclaimed (TTL purge raced this claim, or
+            # the slot was recycled after an engine-side abort): the KV is
+            # gone — fail like a lost handoff so the flow re-prefills
+            raise RuntimeError(
+                f"adopted KV for key {key!r} was reclaimed before the "
+                "decode stage claimed it"
+            )
         if self.serving is not None and self.serving.active:
             # batcher-backed: the adopted slot joins the shared decode
             # rounds instead of monopolizing the engine for its whole
@@ -1067,12 +1214,32 @@ class TPULLMEngine(LLMBaseEngine):
             if self._handoff_rx is None or \
                     self._handoff_rx.engine is not self.engine:
                 self._handoff_rx = HandoffReceiver(self.engine)
+            # orphaned adoptions (decode job never came) age out here, on
+            # the same serialized path that created them
+            self._purge_stale_pd_slots()
             # adoption mutates the engine (block allocation + slot bind):
             # under a batcher it runs on the engine executor thread,
             # serialized with live decode rounds
             result = self._exclusive(lambda: self._handoff_rx.handle(raw))
             if result.get("slot") is not None:
-                self._pd_slots[result["kv_cache_key"]] = result["slot"]
+                slot = result["slot"]
+                key = result["kv_cache_key"]
+                # pop-to-claim (same arbiter as the TTL purge): a decode
+                # stage that already popped this key owns its sequence
+                prev = self._pd_slots.pop(key, None)
+                if prev is not None and prev[0] != slot:
+                    # a re-run of the same prefill child (requeued after
+                    # its completion report was lost post-commit) pushed
+                    # the SAME key again: the new adoption supersedes the
+                    # old one — free the superseded slot NOW. Overwriting
+                    # the index without freeing would orphan it with no
+                    # TTL entry, leaking the slot for the engine's life.
+                    self.pd_stats["adopted_expired"] += 1
+                    self._release_adopted_slot(self.engine, prev[0],
+                                               prev[1])
+                self._pd_slots[key] = (
+                    slot, self.engine.slots[slot], time.monotonic()
+                )
         return result
 
     # -- crash-safe generation: live checkpoints + resumable drivers --------
@@ -1084,6 +1251,24 @@ class TPULLMEngine(LLMBaseEngine):
         ``kv_handoff_sessions_purged_total``."""
         rx = self._handoff_rx
         return int(rx.stats.get("sessions_purged", 0)) if rx is not None else 0
+
+    def pd_wire_stats(self) -> Optional[Dict[str, int]]:
+        """Cumulative PD handoff lifecycle counters (sender outcomes +
+        receiver abort/purge reasons) — heartbeat ``engine_stats["pd"]``,
+        delta-anchored into ``pd_handoffs_total{outcome}`` /
+        ``pd_handoff_bytes_total`` on the control plane. None when this
+        engine never touched a handoff (no payload bloat)."""
+        out = {k: int(v) for k, v in self.pd_stats.items() if v}
+        rx = self._handoff_rx
+        if rx is not None:
+            for src, dst in (("rx_aborts", "rx_aborts"),
+                             ("purged_ttl", "rx_purged_ttl"),
+                             ("purged_no_progress", "rx_purged_no_progress"),
+                             ("purged_cap", "rx_purged_cap")):
+                v = int(rx.stats.get(src, 0) or 0)
+                if v:
+                    out[dst] = v
+        return out or None
 
     def _register_live(self, key: str, kind: str, epoch: int,
                        request_id: str) -> None:

@@ -29,6 +29,7 @@ import random
 import signal
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -227,6 +228,12 @@ class Worker:
         self._last_job_done_at = 0.0
         self._released_once: set = set()          # jobs we declined once
         self._rng = random.Random(0xC0FFEE)
+        # per-PROCESS incarnation id: registration sends it so the plane
+        # can tell a fast restart (new boot_id on the same fingerprint →
+        # the old incarnation's RUNNING jobs requeue immediately) from a
+        # credential-blip re-register by the same live process (same
+        # boot_id → running work stays put)
+        self.boot_id = uuid.uuid4().hex
         self.stats: Dict[str, Any] = {
             "jobs_completed": 0, "jobs_failed": 0, "jobs_rejected": 0,
             "jobs_migrated": 0,
@@ -250,6 +257,7 @@ class Worker:
                 "direct_url": self.config.direct.public_url,
                 "role": self.config.role,
                 "data_plane_url": self.config.pd_data_plane_url,
+                "boot_id": self.boot_id,
             }
             data = self.api.register(info)
             if self._on_credentials:
@@ -374,6 +382,26 @@ class Worker:
                 )
         return out or None
 
+    def _pd_engine_stats(self) -> Optional[Dict[str, Any]]:
+        """PD handoff lifecycle counters of every loaded engine (sender
+        outcomes, piece retries, receiver abort/purge reasons) — nested
+        under heartbeat ``engine_stats["pd"]`` so the control plane's
+        ``/metrics`` surfaces ``pd_handoffs_total{outcome}`` and
+        ``pd_handoff_bytes_total`` per worker. None when no engine has
+        touched a handoff (payload stays lean off the PD path)."""
+        out: Dict[str, int] = {}
+        for eng in self.engines.values():
+            fn = getattr(eng, "pd_wire_stats", None)
+            if fn is None:
+                continue
+            try:
+                s = fn()
+            except Exception:  # noqa: BLE001 — never break the heartbeat
+                continue
+            for k, v in (s or {}).items():
+                out[k] = out.get(k, 0) + int(v)
+        return out or None
+
     def _batcher_stats(self) -> Optional[Dict[str, Any]]:
         """Live batcher serving stats of every batcher-backed engine
         (occupancy, queue depth, chunked admissions, preemption counters)
@@ -446,6 +474,16 @@ class Worker:
 
     def _heartbeat_once(self) -> None:
         summary_eng = None
+        for eng in self.engines.values():
+            # PD housekeeping on the heartbeat cadence: adopted slots
+            # whose decode stage never came (flow re-prefilled elsewhere)
+            # age out instead of pinning KV until the next handoff message
+            fn = getattr(eng, "pd_maintain", None)
+            if fn is not None:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — never break the beat
+                    pass
         try:
             extra: Dict[str, Any] = {}
             engine_stats: Dict[str, Any] = {}
@@ -458,6 +496,9 @@ class Worker:
             batcher_stats = self._batcher_stats()
             if batcher_stats:
                 engine_stats["batcher"] = batcher_stats
+            pd_stats = self._pd_engine_stats()
+            if pd_stats:
+                engine_stats["pd"] = pd_stats
             summary = self._prefix_summary_payload()
             if summary is not None:
                 # radix summary (full or delta) for cache-aware routing;
@@ -773,11 +814,16 @@ class Worker:
 
     def _job_runs_shared(self, job: Dict[str, Any]) -> bool:
         """A fetched job may join the continuous batch iff it targets the
-        batcher-backed llm engine and is not a PD stage (PD stages manage
-        engine slots out-of-band and keep the exclusive claim)."""
+        batcher-backed llm engine. PD stage jobs ride shared claims too
+        (round 11 — the split topology as a LIVE deployment mode): a
+        decode-fleet worker co-batches many adopted sequences through
+        ``batcher.adopt_slot``, and a prefill-fleet worker overlaps one
+        job's KV push with the next job's prefill — an exclusive claim per
+        stage would serialize the very fleets the split exists to scale.
+        The engine work inside each stage is already serialized with live
+        decode rounds (engine lock + ``run_exclusive``). Non-batcher
+        engines keep the legacy exclusive claim."""
         if job.get("type", "llm") != "llm":
-            return False
-        if (job.get("params") or {}).get("pd_stage"):
             return False
         return self._llm_serving_active()
 

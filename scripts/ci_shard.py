@@ -17,6 +17,8 @@ import pathlib
 
 # ordered heaviest-first; files absent from the checkout are skipped
 HEAVY = [
+    "tests/test_pd_chaos.py",            # 25-seed PD-split handoff chaos
+    #   (role-tagged LiveFleet + streamed-handoff kills/corruption)
     "tests/test_fleet_chaos.py",         # 25-seed LiveFleet chaos replays
     #   (real multi-worker fleet + kill/partition/pressure under load)
     "tests/test_chaos_scenarios.py",     # 50-seed replays per scenario

@@ -190,16 +190,44 @@ def test_pd_job_end_to_end_bit_exact():
         plane.stop()
 
 
-def test_pd_job_no_decode_worker_rejected():
+def test_pd_job_one_sided_fleet_rebalances_instead_of_rejecting():
+    """A fleet with ONLY prefill-capable workers no longer 503s PD jobs:
+    the role-rebalance fallback (round 11) lets the other side's absence
+    degrade to hybrid work — here the prefill worker takes the decode
+    placement too (counted), which is the local-affinity path."""
     async def body():
         client = await make_client()
-        await _register(client, "prefiller", "prefill")  # no decode-capable
+        reg = await _register(client, "prefiller", "prefill")
+        resp = await client.post("/api/v1/jobs", json={
+            "type": "llm",
+            "params": {"pd_disaggregated": True,
+                       "prompt_token_ids": PROMPT, "max_tokens": 4},
+        })
+        assert resp.status == 201
+        state = client.server.app["state"]
+        sched = state.pd_flow.scheduler
+        assert sched.stats["role_rebalanced_decode"] == 1
+        # both stages landed on the one worker → local affinity, no wire
+        child = await state.store.get_job(
+            (await resp.json())["job_id"] + "-prefill"
+        )
+        assert child["params"]["target_worker"] == reg["worker_id"]
+        assert child["params"]["decode_worker"] == reg["worker_id"]
+        await client.close()
+
+    run(body())
+
+
+def test_pd_job_no_worker_at_all_rejected():
+    async def body():
+        client = await make_client()
         resp = await client.post("/api/v1/jobs", json={
             "type": "llm",
             "params": {"pd_disaggregated": True,
                        "prompt_token_ids": PROMPT, "max_tokens": 4},
         })
         assert resp.status == 503
+        assert (await resp.json()).get("retry_after_s") is not None
         await client.close()
 
     run(body())

@@ -211,7 +211,11 @@ def test_migration_exhausts_attempts_and_drops():
         raise ConnectionError("link down")
 
     async def go():
+        # rebalance OFF: with it on (the round-11 default) a fully
+        # excluded decode fleet falls back to the prefill-role holder —
+        # this test isolates the exhaustion contract itself
         s = _sched(migrator=KVCacheMigrator(transport))
+        s.allow_role_rebalance = False
         r = PDRequest(prompt_tokens=64)
         await s.submit_job(r)
         [pr] = await s.get_batch("prefill", max_batch=1)
