@@ -1164,6 +1164,305 @@ def run_pd_split(args: Any, backend: str, model: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --overload (round 12): the brownout ladder, measured. A LiveFleet serves
+# steady PAID traffic while a 10x free-tier burst (the workloads.py bursty
+# class, all-free) slams the plane. Three legs:
+#   paid_baseline  — paid traffic alone, ladder ON (the SLO reference)
+#   ladder_on      — paid + 10x free burst, admission ladder ON: free is
+#                    clamped/shed (counted per tier), paid holds its SLO
+#   ladder_off     — same composed load, admission OFF: the blanket
+#                    backpressure 429s blindly — paid sheds too (the
+#                    before picture the ladder exists to fix)
+# plus an AUTOSCALER leg: a replica is killed mid-span (seeded
+# FleetFaultPlan), the brownout-driven autoscaler restores capacity off
+# the measured SLO window, and the leg reports the measured cold-start
+# lead time and time-to-recover.
+# ---------------------------------------------------------------------------
+
+
+def _tiered_trace(seed: int, paid_n: int, free_n: int, rate: float,
+                  max_tokens: int) -> List[Dict[str, Any]]:
+    """Merged open-loop trace: steady paid rag traffic + the bursty class
+    at 10x the paid rate, forced all-free (the misbehaving-tenant burst).
+    Returns arrival-sorted dicts {at, tenant, tier, prompt, max_tokens}."""
+    from benchmarks.workloads import generate
+
+    paid = generate("rag", seed, requests=paid_n, rate=rate,
+                    tenants=2, doc_len=96, query_len=24,
+                    max_tokens=max_tokens)
+    burst = generate("bursty", seed + 1, requests=free_n,
+                     rate=rate * 10.0, tenants=3, system_len=64,
+                     turn_len=16, max_tokens=max_tokens)
+    span = max((r.arrival_s for r in paid.requests), default=1.0)
+    out = []
+    for r in paid.requests:
+        out.append({"at": r.arrival_s, "tenant": f"paid-{r.tenant}",
+                    "tier": "paid", "prompt": r.prompt,
+                    "max_tokens": r.max_tokens})
+    b_span = max((x.arrival_s for x in burst.requests), default=1.0)
+    for r in burst.requests:
+        # compress the burst into the middle 60% of the paid span so the
+        # overload WINDOW is surrounded by calm paid-only traffic
+        at = span * 0.2 + (r.arrival_s / b_span) * span * 0.6
+        out.append({"at": round(at, 4), "tenant": f"burst-{r.tenant}",
+                    "tier": "free", "prompt": r.prompt,
+                    "max_tokens": r.max_tokens})
+    out.sort(key=lambda d: d["at"])
+    return out
+
+
+async def _drive_tiered(plane_url: str, trace: List[Dict[str, Any]],
+                        observe=None) -> List[Dict[str, Any]]:
+    """Open-loop tiered driver: NOBODY retries a 429 — a shed is a shed
+    (the burst models a misbehaving tenant; a paid shed is the failure
+    the ladder must prevent, and riding it out would hide it)."""
+    import httpx
+
+    t0 = time.perf_counter()
+    async with httpx.AsyncClient(timeout=600.0) as client:
+
+        async def one(i: int, req: Dict[str, Any]) -> Dict[str, Any]:
+            now = time.perf_counter() - t0
+            if req["at"] > now:
+                await asyncio.sleep(req["at"] - now)
+            rec = {"i": i, "tier": req["tier"], "arrival_s": req["at"],
+                   "status": 0}
+            t_req = time.perf_counter()
+            try:
+                r = await client.post(f"{plane_url}/api/v1/jobs", json={
+                    "type": "llm",
+                    "params": {"prompt": req["prompt"],
+                               "max_new_tokens": req["max_tokens"],
+                               "tenant": req["tenant"],
+                               "tier": req["tier"]},
+                })
+            except httpx.TransportError:
+                rec["status"] = 599
+                return rec
+            if r.status_code != 201:
+                rec["status"] = r.status_code
+                if observe is not None and req["tier"] == "paid":
+                    observe(in_slo=False)   # a paid shed IS an SLO miss
+                return rec
+            job_id = r.json()["job_id"]
+            while time.perf_counter() - t_req < 180.0:
+                try:
+                    j = (await client.get(
+                        f"{plane_url}/api/v1/jobs/{job_id}")).json()
+                except (httpx.TransportError, ValueError):
+                    await asyncio.sleep(0.1)
+                    continue
+                if j.get("status") in ("completed", "failed", "cancelled"):
+                    res = j.get("result") or {}
+                    e2e = (time.perf_counter() - t_req) * 1e3
+                    rec.update({
+                        "status": 200 if j["status"] == "completed"
+                        else 500,
+                        "e2e_ms": e2e,
+                        "done_s": time.perf_counter() - t0,
+                        "ttft_ms": res.get("ttft_ms"),
+                        "worker_id": j.get("worker_id"),
+                        "degraded": bool(
+                            (j.get("params") or {}).get(
+                                "degraded_max_tokens")),
+                        "completion_tokens": (res.get("usage") or {})
+                        .get("completion_tokens") or 0,
+                    })
+                    if observe is not None and req["tier"] == "paid":
+                        observe(latency_ms=e2e)
+                    return rec
+                await asyncio.sleep(0.05)
+            rec["status"] = 599
+            return rec
+
+        return list(await asyncio.gather(
+            *(one(i, r) for i, r in enumerate(trace))
+        ))
+
+
+def _tier_summary(results: List[Dict[str, Any]]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for tier in ("paid", "free"):
+        rs = [r for r in results if r["tier"] == tier]
+        if not rs:
+            continue
+        ok = [r for r in rs if r["status"] == 200]
+        out[tier] = {
+            "offered": len(rs),
+            "ok": len(ok),
+            "shed_429": sum(1 for r in rs if r["status"] == 429),
+            "failed": sum(1 for r in rs
+                          if r["status"] not in (200, 429)),
+            "degraded_clamped": sum(1 for r in ok if r.get("degraded")),
+            "tokens": sum(r.get("completion_tokens") or 0 for r in ok),
+            "ttft_ms": percentiles(
+                [r["ttft_ms"] for r in ok
+                 if r.get("ttft_ms") is not None]),
+            "e2e_ms": percentiles([r["e2e_ms"] for r in ok]),
+        }
+    return out
+
+
+def run_overload(args: Any, backend: str, model: str) -> None:
+    from distributed_gpu_inference_tpu.server.autoscaler import (
+        AutoscalerConfig,
+        BrownoutAutoscaler,
+    )
+    from distributed_gpu_inference_tpu.testing.faults import (
+        FleetEvent,
+        FleetFaultPlan,
+    )
+    from distributed_gpu_inference_tpu.testing.harness import (
+        FleetAutoscaler,
+        LiveFleet,
+    )
+
+    engine_config = {
+        "model": model,
+        "max_batch_size": args.concurrency,
+        "max_seq_len": 256 + args.max_tokens + 16,
+        "quantization": args.quantization,
+        "serving": {
+            "queue_limit": 4096,
+            "default_timeout_s": 600.0,
+        },
+    }
+    rate = float(args.arrival_rate) if args.arrival_rate else 2.0
+    paid_n, free_n = args.requests, args.requests * 6
+    trace = _tiered_trace(args.seed, paid_n, free_n, rate,
+                          args.max_tokens)
+    queue_limit = 8
+    admission = {
+        "enabled": True, "degrade_at": 0.2, "no_spec_at": 0.4,
+        "clamp_max_tokens": max(2, args.max_tokens // 4),
+        "min_retry_after_s": 0.05,
+    }
+    fractions = {"paid": 1.0, "free": 0.5, "batch": 0.3}
+
+    def configure(fleet: Any, enabled: bool) -> None:
+        fleet.plane.state.admission.cfg.update(
+            {**admission, "enabled": enabled})
+        fleet.plane.state.worker_config._defaults.load_control \
+            .tier_queue_fractions = dict(fractions)
+
+    def admission_delta(fleet: Any, before: Dict[str, int]
+                        ) -> Dict[str, int]:
+        after = dict(fleet.plane.state.admission.stats)
+        return {k: after.get(k, 0) - before.get(k, 0)
+                for k in set(after) | set(before)
+                if after.get(k, 0) != before.get(k, 0)}
+
+    out: Dict[str, Any] = {
+        "benchmark": "worker_serving_overload",
+        "path": "control_plane+admission_ladder+live_fleet",
+        "model": model, "backend": backend, "seed": args.seed,
+        "paid_requests": paid_n, "free_burst_requests": free_n,
+        "paid_rate_rps": rate, "free_burst_rate_rps": rate * 10.0,
+        "max_tokens": args.max_tokens,
+        "submit_queue_limit": queue_limit,
+        "tier_queue_fractions": fractions,
+        "clamp_max_tokens": admission["clamp_max_tokens"],
+        "replicas": int(args.chaos_replicas),
+    }
+
+    with LiveFleet(n=int(args.chaos_replicas),
+                   engine_config=engine_config,
+                   submit_queue_limit=queue_limit) as fleet:
+        configure(fleet, enabled=True)
+        paid_only = [r for r in trace if r["tier"] == "paid"]
+        # short warm: compile the serving graphs, not a whole leg
+        asyncio.run(_drive_tiered(fleet.url, paid_only[:4]))
+        base = asyncio.run(_drive_tiered(fleet.url, paid_only))
+        out["paid_baseline"] = _tier_summary(base)
+
+        before = dict(fleet.plane.state.admission.stats)
+        on = asyncio.run(_drive_tiered(fleet.url, trace))
+        out["ladder_on"] = _tier_summary(on)
+        out["ladder_on"]["admission_decisions"] = admission_delta(
+            fleet, before)
+
+        configure(fleet, enabled=False)
+        off = asyncio.run(_drive_tiered(fleet.url, trace))
+        out["ladder_off"] = _tier_summary(off)
+        configure(fleet, enabled=True)
+
+        p_on = out["ladder_on"].get("paid") or {}
+        p_base = out["paid_baseline"].get("paid") or {}
+        p_off = out["ladder_off"].get("paid") or {}
+        verdict = {
+            "paid_shed_ladder_on": p_on.get("shed_429", 0),
+            "paid_shed_ladder_off": p_off.get("shed_429", 0),
+            "free_shed_ladder_on":
+                (out["ladder_on"].get("free") or {}).get("shed_429", 0),
+            "free_clamped_ladder_on":
+                (out["ladder_on"].get("free") or {})
+                .get("degraded_clamped", 0),
+        }
+        for pct in ("p50", "p95"):
+            a = (p_on.get("e2e_ms") or {}).get(pct)
+            b = (p_base.get("e2e_ms") or {}).get(pct)
+            if a and b:
+                verdict[f"paid_e2e_{pct}_burst_over_baseline"] = round(
+                    a / b, 3)
+        out["verdict"] = verdict
+
+    # ---- autoscaler leg: seeded kill mid-span, brownout-driven recovery.
+    # The paid trace runs COMPRESSED (2x rate): the surviving replica must
+    # actually fall behind after the kill, or there is no brownout to
+    # scale out of.
+    with LiveFleet(n=2, engine_config=engine_config) as fleet:
+        wave = [{**r, "at": round(r["at"] / 2.0, 4)}
+                for r in trace if r["tier"] == "paid"]
+        w_span = max(r["at"] for r in wave)
+        # two back-to-back waves: the kill browns out wave 1, the scaled-
+        # out replica proves recovery by SERVING wave 2 (time-to-recover
+        # is kill → first request completed by autoscaled capacity)
+        paid_only = wave + [{**r, "at": round(r["at"] + w_span, 4)}
+                            for r in wave]
+        span = max(r["at"] for r in paid_only)
+        asyncio.run(_drive_tiered(fleet.url, wave[:4]))        # warm
+        asc = BrownoutAutoscaler(AutoscalerConfig(
+            slo_latency_ms=float(args.overload_slo_ms),
+            slo_target=0.9, window_s=max(2.0, span / 4.0),
+            min_samples=4, scale_out_cooldown_s=5.0,
+            max_replicas=3, default_cold_start_s=3.0,
+        ), metrics=fleet.plane.state.metrics)
+        driver = FleetAutoscaler(fleet, asc, tick_s=0.25).start()
+        t_kill = round(0.30 * span, 3)
+        plan = FleetFaultPlan(args.seed, n_workers=2, duration_s=span,
+                              kinds=("kill",))
+        plan.events = [FleetEvent(t_kill, "kill", 1),
+                       FleetEvent(round(0.95 * span, 3), "restart", 1)]
+        fleet.run_chaos(plan)
+        try:
+            scaled = asyncio.run(_drive_tiered(
+                fleet.url, paid_only, observe=asc.observe))
+        finally:
+            fleet.wait_chaos()
+            driver.stop()
+            for m in fleet.members:
+                if not m.alive:
+                    m.start()
+        scale_outs = [t for t, a in driver.actions if a == "scale_out"]
+        new_workers = {m.worker_id for m in fleet.members[2:]}
+        served_by_new = [r["done_s"] for r in scaled
+                         if r["status"] == 200
+                         and r.get("worker_id") in new_workers]
+        out["autoscaler"] = {
+            "kill_at_s": t_kill,
+            "summary": _tier_summary(scaled),
+            "scale_out_at_s": [round(t, 3) for t in scale_outs],
+            "decisions": dict(asc.stats),
+            "measured_cold_start_s": round(asc.cold_start_s, 3),
+            # recovery: kill → first request served by autoscaled capacity
+            "time_to_recover_s": round(min(served_by_new) - t_kill, 3)
+            if served_by_new else None,
+            "replicas_final": len(fleet.alive_members()),
+        }
+    emit(out)
+
+
+# ---------------------------------------------------------------------------
 # --spec (round 8): spec ON vs OFF on the SLO frontier with an ORACLE draft.
 # Real 8B trained draft heads are environment-blocked (VERDICT r5 #3), but
 # the win condition is testable without them: the oracle forces the
@@ -1380,6 +1679,16 @@ def main() -> None:
                     "equal worker count, plus a handoff-brownout leg "
                     "(handoff partition + prefill-side kill/restart: "
                     "SLO-in-window, re-prefill count, time-to-recover)")
+    ap.add_argument("--overload", action="store_true",
+                    help="brownout-ladder legs: steady paid traffic + a "
+                    "10x free-tier burst through a LiveFleet with the "
+                    "admission ladder ON vs OFF (paid SLO held vs blanket "
+                    "429s), plus a brownout-driven autoscaler leg with a "
+                    "seeded kill (measured cold-start lead time + "
+                    "time-to-recover)")
+    ap.add_argument("--overload-slo-ms", type=float, default=2000.0,
+                    help="per-request e2e SLO bound the autoscaler leg "
+                    "judges its window against")
     ap.add_argument("--chaos", action="store_true",
                     help="cluster frontier + brownout mode: drive the "
                     "same open-loop workload through a LiveFleet at "
@@ -1409,6 +1718,13 @@ def main() -> None:
             ap.error("--pd-split takes a single --arrival-rate (the "
                      "comparison axis is PD vs data-parallel)")
         run_pd_split(args, backend, model)
+        return
+
+    if args.overload:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--overload takes a single --arrival-rate (the paid "
+                     "rate; the burst is fixed at 10x)")
+        run_overload(args, backend, model)
         return
 
     if args.chaos:

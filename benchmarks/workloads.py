@@ -25,9 +25,18 @@ Scenarios:
 - ``bursty``   the chat mix, but tenant arrivals modulate through on/off
                bursts (a tenant's whole fleet goes quiet, then floods) —
                the schedule a locality router must not melt under.
-- ``priority`` the rag mix across two tenant tiers: interactive (high
-               priority, low rate) over batch (priority 0, high rate) —
-               exercises the admission heap + affinity together.
+- ``priority`` the rag mix across NAMED tenant tiers (round 12): paid
+               (priority 10) over free (priority 0) over batch
+               (priority -10), assigned per tenant by index — the tier
+               mix the overload-control ladder (server/admission.py)
+               sheds and degrades against. Every request carries its
+               tenant id and tier in the trace.
+
+Any scenario can additionally be generated ``tiered=True``: tenants gain
+paid/free/batch tiers (index-derived — NO extra rng draws, so arrival
+schedules and prompts stay byte-identical to the untiered trace) and the
+matching priorities. Untiered traces omit the ``tier`` field entirely,
+keeping their JSONL byte-identical to pre-tier builds.
 
 Usage (CLI emits JSONL for external drivers; ``generate()`` is the
 library surface ``benchmarks/worker_serving.py --workers`` drives):
@@ -50,6 +59,25 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import numpy as np
 
 _LETTERS = "abcdefghijklmnopqrstuvwxyz"
+
+# control-plane priority per named tier — mirrors
+# server/admission.py TIER_PRIORITY_BOOST (benchmarks must not import
+# server code; the pairing is asserted in tests/test_overload_chaos.py)
+TIER_PRIORITY = {"paid": 10, "free": 0, "batch": -10}
+
+
+def tier_for_tenant(index: int, tenants: int) -> str:
+    """Deterministic index-derived tier split: the first quarter of
+    tenants (at least one) is paid, the last quarter (when ≥3 tenants)
+    is batch, the middle is free. No rng draws — tier assignment can be
+    bolted onto an existing trace without moving a single arrival."""
+    n_paid = max(1, tenants // 4)
+    n_batch = max(1, tenants // 4) if tenants >= 3 else 0
+    if index < n_paid:
+        return "paid"
+    if n_batch and index >= tenants - n_batch:
+        return "batch"
+    return "free"
 
 
 def _text(rng: np.random.Generator, n: int) -> str:
@@ -74,6 +102,10 @@ class WorkloadRequest:
     turn: int = 0
     depends_on: Optional[str] = None
     think_s: float = 0.0
+    # named tenant tier (paid/free/batch — round 12 overload control).
+    # Empty = untiered: the field is OMITTED from JSONL so pre-tier
+    # traces stay byte-identical.
+    tier: str = ""
 
 
 @dataclass
@@ -88,7 +120,15 @@ class Workload:
         return max((r.arrival_s for r in self.requests), default=0.0)
 
     def to_jsonl(self) -> str:
-        return "\n".join(json.dumps(asdict(r)) for r in self.requests)
+        # untiered requests drop the empty tier key: same-seed JSONL for
+        # pre-tier scenarios is byte-identical to pre-tier builds
+        out = []
+        for r in self.requests:
+            d = asdict(r)
+            if not d.get("tier"):
+                d.pop("tier", None)
+            out.append(json.dumps(d))
+        return "\n".join(out)
 
 
 def _chat(rng: np.random.Generator, *, requests: int, tenants: int,
@@ -157,27 +197,38 @@ def generate(scenario: str, seed: int = 0, *, requests: int = 32,
              system_len: int = 256, turn_len: int = 64,
              doc_len: int = 512, query_len: int = 64,
              corpus_docs: int = 6, max_tokens: int = 32,
-             think_s: float = 0.2) -> Workload:
+             think_s: float = 0.2, tiered: bool = False) -> Workload:
     """Build one seed-stable trace. All randomness flows from ONE
     ``np.random.default_rng(seed)`` consumed in a fixed order — adding a
-    scenario must never reorder draws inside an existing one."""
+    scenario must never reorder draws inside an existing one.
+
+    ``tiered=True`` stamps every tenant with a named paid/free/batch tier
+    (index-derived, zero extra draws) and the matching priority —
+    prompts/arrivals stay byte-identical to the untiered trace. The
+    ``priority`` scenario is always tiered."""
     rng = np.random.default_rng(seed)
     kw: Dict[str, Any] = {}
+    tier_map = {f"t{t}": tier_for_tenant(t, tenants)
+                for t in range(tenants)}
+    prio_map = {k: TIER_PRIORITY[v] for k, v in tier_map.items()}
     if scenario == "chat":
         reqs = _chat(rng, requests=requests, tenants=tenants, turns=turns,
                      rate=rate, system_len=system_len, turn_len=turn_len,
-                     max_tokens=max_tokens, think_s=think_s)
+                     max_tokens=max_tokens, think_s=think_s,
+                     priority_for=prio_map if tiered else None)
     elif scenario == "rag":
         reqs = _rag(rng, requests=requests, tenants=tenants, rate=rate,
                     corpus_docs=corpus_docs, doc_len=doc_len,
-                    query_len=query_len, max_tokens=max_tokens)
+                    query_len=query_len, max_tokens=max_tokens,
+                    priority_for=prio_map if tiered else None)
     elif scenario == "bursty":
         # chat arrivals pushed through per-tenant on/off bursts: each
         # conversation's start is delayed to its tenant's next ON window
         reqs = _chat(rng, requests=requests, tenants=tenants, turns=turns,
                      rate=rate * 2.0, system_len=system_len,
                      turn_len=turn_len, max_tokens=max_tokens,
-                     think_s=think_s)
+                     think_s=think_s,
+                     priority_for=prio_map if tiered else None)
         period = {f"t{t}": float(rng.uniform(2.0, 6.0))
                   for t in range(tenants)}
         duty = {f"t{t}": float(rng.uniform(0.3, 0.7))
@@ -189,18 +240,23 @@ def generate(scenario: str, seed: int = 0, *, requests: int = 32,
                 r.arrival_s = round(r.arrival_s + (p - phase), 4)
         kw["burst_period_s"] = period
     elif scenario == "priority":
-        tiers = {f"t{t}": (10 if t < max(1, tenants // 4) else 0)
-                 for t in range(tenants)}
+        # named tenant tiers (round 12 — was a two-level 10/0 split):
+        # paid over free over batch, per-tenant ids in every trace row
+        tiered = True
         reqs = _rag(rng, requests=requests, tenants=tenants, rate=rate,
                     corpus_docs=corpus_docs, doc_len=doc_len,
                     query_len=query_len, max_tokens=max_tokens,
-                    priority_for=tiers)
-        kw["priority_tiers"] = tiers
+                    priority_for=prio_map)
+        kw["priority_tiers"] = prio_map
     else:
         raise ValueError(
             f"unknown scenario {scenario!r} "
             "(chat | rag | bursty | priority)"
         )
+    if tiered:
+        for r in reqs:
+            r.tier = tier_map[r.tenant]
+        kw["tenant_tiers"] = tier_map
     return Workload(
         scenario=scenario, seed=seed, requests=reqs,
         meta={"requests": len(reqs), "tenants": tenants, "rate": rate,
@@ -222,13 +278,18 @@ def main() -> None:
     ap.add_argument("--turn-len", type=int, default=64)
     ap.add_argument("--doc-len", type=int, default=512)
     ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--tiered", action="store_true",
+                    help="stamp paid/free/batch tenant tiers (+matching "
+                    "priorities) onto the trace; arrivals/prompts stay "
+                    "byte-identical to the untiered run")
     ap.add_argument("--summary", action="store_true",
                     help="print meta only, not the JSONL trace")
     args = ap.parse_args()
     wl = generate(args.scenario, args.seed, requests=args.requests,
                   tenants=args.tenants, turns=args.turns, rate=args.rate,
                   system_len=args.system_len, turn_len=args.turn_len,
-                  doc_len=args.doc_len, max_tokens=args.max_tokens)
+                  doc_len=args.doc_len, max_tokens=args.max_tokens,
+                  tiered=args.tiered)
     if args.summary:
         print(json.dumps({"scenario": wl.scenario, "seed": wl.seed,
                           "duration_s": round(wl.duration_s, 3),

@@ -148,7 +148,12 @@ class BatcherConfig:
 
 @dataclass(order=True)
 class _QueueItem:
-    sort_key: Tuple[int, float, int]
+    # (-priority, deadline_at, arrival_time, seq): EDF *within* a priority
+    # band (round 12) — deadline_at is +inf for deadline-less requests, so
+    # with no deadlines set every comparison falls through to the
+    # arrival/seq components and admission order is byte-identical to the
+    # pre-deadline batcher
+    sort_key: Tuple[int, float, float, int]
     request: InferenceRequest = field(compare=False)
     future: "asyncio.Future[InferenceResponse]" = field(compare=False)
     enqueued_at: float = field(compare=False, default_factory=time.time)
@@ -449,7 +454,11 @@ class ContinuousBatcher:
         if len(self._heap) >= self.cfg.queue_limit:
             self.stats["rejected"] += 1
             return InferenceResponse(
-                request_id=request.request_id, error="queue full"
+                request_id=request.request_id, error="queue full",
+                # machine-readable: nothing ran — an overload shed, safe
+                # to retry elsewhere (vs request_timeout, which may still
+                # be generating here)
+                error_code="shed_overload",
             )
         if resume_from is None and not self.engine.request_fits_pool(request):
             # the PROMPT alone cannot fit even an idle pool: no amount of
@@ -464,11 +473,13 @@ class ContinuousBatcher:
                 request_id=request.request_id,
                 error="request exceeds KV pool capacity (worst case "
                       "cannot fit even an idle pool)",
+                error_code="over_capacity",
             )
         loop = asyncio.get_running_loop()
         fut: "asyncio.Future[InferenceResponse]" = loop.create_future()
         item = _QueueItem(
-            sort_key=(-request.priority, request.arrival_time, next(self._seq)),
+            sort_key=(-request.priority, request.deadline_at,
+                      request.arrival_time, next(self._seq)),
             request=request,
             future=fut,
             observer=observer,
@@ -486,7 +497,11 @@ class ContinuousBatcher:
         except asyncio.TimeoutError:
             self.stats["timeouts"] += 1
             return InferenceResponse(
-                request_id=request.request_id, error=f"timeout after {timeout_s}s"
+                request_id=request.request_id,
+                error=f"timeout after {timeout_s}s",
+                # distinct from shed_overload: the caller's wait budget
+                # elapsed — the request was (or may still be) running
+                error_code="request_timeout",
             )
 
     async def adopt_slot(self, slot: int,
@@ -518,7 +533,8 @@ class ContinuousBatcher:
         loop = asyncio.get_running_loop()
         fut: "asyncio.Future[InferenceResponse]" = loop.create_future()
         item = _QueueItem(
-            sort_key=(-req.priority, req.arrival_time, next(self._seq)),
+            sort_key=(-req.priority, req.deadline_at, req.arrival_time,
+                      next(self._seq)),
             request=req,
             future=fut,
         )
@@ -974,21 +990,26 @@ class ContinuousBatcher:
             await self._preempt_victim(mandatory=False)
 
     async def _preempt_victim(self, mandatory: bool) -> None:
-        """Pick and preempt one victim: lowest priority first, ties broken
-        most-recently-admitted (LIFO — the youngest sequence has the least
-        compute invested and the warmest prefix to resume from). The frozen
-        sequence requeues at the FRONT of the heap; past
-        ``max_preemptions`` the request errors with ``preempted_too_often``."""
+        """Pick and preempt one victim: lowest priority first, then (round
+        12, deadline-aware) the slot with the MOST deadline slack —
+        deadline-less sequences before late-deadline ones before
+        tight-deadline ones — ties broken most-recently-admitted (LIFO —
+        the youngest sequence has the least compute invested and the
+        warmest prefix to resume from; with no deadlines set the policy is
+        byte-identical to the pre-deadline batcher). The frozen sequence
+        requeues at the FRONT of the heap; past ``max_preemptions`` the
+        request errors with ``preempted_too_often``."""
         cands = []
         for slot, item in self._slot_items.items():
             s = self.engine.slots[slot]
             if s is None or s.finish_reason is not None or s.prefilling:
                 continue
             cands.append((item.request.priority,
+                          -item.request.deadline_at,
                           -self._admit_stamp.get(slot, -1), slot, item))
         if not cands:
             return
-        prio, _, slot, item = min(cands)
+        prio, _, _, slot, item = min(cands)
         if not mandatory:
             # admission pressure: only preempt for strictly higher-priority
             # waiting work — FIFO fairness is not worth a spill round-trip
@@ -1032,6 +1053,7 @@ class ContinuousBatcher:
         self._resume_hold = True
         item.sort_key = (
             -(1 << 20) - item.request.priority,
+            item.request.deadline_at,
             item.request.arrival_time,
             next(self._seq),
         )

@@ -287,6 +287,10 @@ class PreemptedSequence:
                 "sampling": r.sampling.to_dict(),
                 "priority": r.priority,
                 "session_id": r.session_id,
+                # optional EDF deadline (absolute): resumes are already
+                # head-of-line, but the victim policy still reads it
+                **({"deadline_at": r.deadline_at}
+                   if r.deadline_s is not None else {}),
             },
             "prompt_len": self.prompt_len,
             "generated": list(self.generated),
@@ -317,6 +321,12 @@ class PreemptedSequence:
             priority=int(r.get("priority") or 0),
             session_id=r.get("session_id"),
         )
+        if r.get("deadline_at") is not None:
+            # arrival_time was re-minted by the ctor above: re-derive the
+            # relative deadline so deadline_at round-trips the wire
+            request.deadline_s = max(
+                0.0, float(r["deadline_at"]) - request.arrival_time
+            )
         key = data.get("slot_key") or [0, 0]
         return cls(
             request=request,

@@ -29,6 +29,12 @@ from aiohttp import web
 
 from ..utils.data_structures import JobStatus, WorkerState
 from ..utils.prefixes import fingerprints_for_params, sanitize_fingerprints
+from .admission import (
+    TIER_PRIORITY_BOOST,
+    AdmissionController,
+    estimate_cost_tokens,
+    tenant_of,
+)
 from .geo import GeoService
 from .observability import MetricsCollector, StructuredLogger, TracingManager
 from .prefix_routing import PrefixRegistry, RoutingConfig
@@ -103,6 +109,11 @@ class ServerState:
             # the same policy object the claim-side admission enforces)
             self.worker_config.set_submit_queue_limit(submit_queue_limit)
         self.usage = UsageService(self.store)
+        # SLO-native overload control (round 12): per-tenant token-bucket
+        # budgets + the degrade-before-reject ladder. Disabled by default
+        # (untiered fleets keep the blanket backpressure path verbatim);
+        # flipped/retuned live via GET/PUT /api/v1/admin/admission.
+        self.admission = AdmissionController(metrics=self.metrics)
         self.privacy = EnterprisePrivacyService(self.store)
         self.tracing = TracingManager()
         self.log = StructuredLogger("dgi-tpu.server")
@@ -166,15 +177,7 @@ async def _submit_backpressure(st: ServerState) -> Optional[web.Response]:
     instead of silent queue growth. Returns None when the job may enter."""
     if st.worker_config.submit_queue_limit <= 0:
         return None    # backpressure disabled: skip the queue-stats scans
-    now = time.time()
-    if st._bp_cache is not None and st._bp_cache[0] > now:
-        stats = st._bp_cache[1]
-    else:
-        stats = await st.store.queue_stats()
-        st._bp_cache = (now + 0.25, stats)
-    queued = int(stats.get("queued") or 0)
-    workers = stats.get("workers") or {}
-    active = int(workers.get("idle") or 0) + int(workers.get("busy") or 0)
+    queued, active = await _queue_snapshot(st)
     ok, retry_after = st.worker_config.should_accept_submission(
         queued, active
     )
@@ -187,6 +190,75 @@ async def _submit_backpressure(st: ServerState) -> Optional[web.Response]:
         f"{retry_after:.1f}s",
         retry_after_s=retry_after,
     )
+
+
+async def _queue_snapshot(st: ServerState) -> tuple:
+    """(queued, active_workers) through the short-TTL backpressure cache —
+    admission decisions under a rejection flood must not pay two GROUP BY
+    scans per rejected request (same contract as _submit_backpressure)."""
+    now = time.time()
+    if st._bp_cache is not None and st._bp_cache[0] > now:
+        stats = st._bp_cache[1]
+    else:
+        stats = await st.store.queue_stats()
+        st._bp_cache = (now + 0.25, stats)
+    queued = int(stats.get("queued") or 0)
+    workers = stats.get("workers") or {}
+    active = int(workers.get("idle") or 0) + int(workers.get("busy") or 0)
+    return queued, active
+
+
+async def _admit_submission(st: ServerState, body: Dict[str, Any]
+                            ) -> Optional[web.Response]:
+    """Overload control for job submission with the admission controller
+    ENABLED (callers keep the legacy ``_submit_backpressure`` — which
+    runs BEFORE body parsing, so a rejection flood never pays a JSON
+    parse — on the disabled path): the submission runs down the
+    per-tenant degrade/shed ladder. A shed answers 429 + Retry-After
+    (same machine-readable contract); a degrade MUTATES the body in
+    place (``max_tokens`` clamp, ``speculative`` off) and stamps
+    tenant/tier/priority-boost so workers and usage metering see the
+    tier the plane admitted."""
+    tenant, tier = tenant_of(body)
+    params = body.get("params")
+    if not isinstance(params, dict):
+        params = {}
+        body["params"] = params
+    queued, active = await _queue_snapshot(st)
+    decode = int(params.get("max_new_tokens") or params.get("max_tokens")
+                 or 256)
+    decision = st.admission.decide(
+        tenant, tier, estimate_cost_tokens(params),
+        queued, active, st.worker_config, decode_tokens=decode,
+    )
+    if not decision.admitted:
+        st.metrics.record_request("backpressure", "rejected")
+        return _json_error(
+            429,
+            f"overloaded: {decision.reason}; retry after "
+            f"{decision.retry_after_s:.1f}s",
+            retry_after_s=decision.retry_after_s,
+        )
+    if decision.max_tokens is not None:
+        # graceful degradation rung 1: clamp the decode ask (reported
+        # back to the client via the result's finish_reason/usage — the
+        # request still completes, just shorter)
+        for key in ("max_new_tokens", "max_tokens"):
+            if params.get(key) is not None:
+                params[key] = min(int(params[key]), decision.max_tokens)
+        params.setdefault("max_new_tokens", decision.max_tokens)
+        params["degraded_max_tokens"] = decision.max_tokens
+    if decision.disable_spec:
+        # rung 2: vanilla decode — drafting spends compute the fleet no
+        # longer has at this saturation
+        params["speculative"] = False
+    # the tier the plane admitted rides the job: workers place it in the
+    # batcher's priority/EDF heap, usage metering bills the right bucket
+    params.setdefault("tenant", tenant)
+    params["tier"] = decision.tier
+    body["priority"] = int(body.get("priority") or 0) \
+        + TIER_PRIORITY_BOOST.get(decision.tier, 0)
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -1002,9 +1074,15 @@ async def create_job(request: web.Request) -> web.Response:
     if (err := _check_api_key(request)) is not None:
         return err
     st = _state(request)
-    if (bp := await _submit_backpressure(st)) is not None:
-        return bp
+    if not st.admission.cfg.enabled:
+        # ladder OFF: the pre-round-12 blanket backpressure, still run
+        # BEFORE body parsing so a 429 flood stays parse-free
+        if (bp := await _submit_backpressure(st)) is not None:
+            return bp
     body = await request.json()
+    if st.admission.cfg.enabled and \
+            (bp := await _admit_submission(st, body)) is not None:
+        return bp
     row = await _make_job_row(request, body)
     if (row.get("params") or {}).get("pd_disaggregated"):
         # PD container job: created RUNNING (never claimable); the flow
@@ -1048,9 +1126,13 @@ async def create_job_sync(request: web.Request) -> web.Response:
     if (err := _check_api_key(request)) is not None:
         return err
     st = _state(request)
-    if (bp := await _submit_backpressure(st)) is not None:
-        return bp
+    if not st.admission.cfg.enabled:
+        if (bp := await _submit_backpressure(st)) is not None:
+            return bp
     body = await request.json()
+    if st.admission.cfg.enabled and \
+            (bp := await _admit_submission(st, body)) is not None:
+        return bp
     stats = await st.scheduler.get_queue_stats()
     if stats["active_workers"] == 0:
         # a fleet with zero live workers drains nothing: tell clients to
@@ -1351,6 +1433,36 @@ async def admin_put_routing(request: web.Request) -> web.Response:
     await st.store.audit("admin_update_routing", actor="admin",
                          detail=st.routing.to_dict())
     return web.json_response(st.routing.to_dict())
+
+
+async def admin_get_admission(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    return web.json_response({
+        **st.admission.cfg.to_dict(),
+        "snapshot": st.admission.snapshot(),
+    })
+
+
+async def admin_put_admission(request: web.Request) -> web.Response:
+    """Live overload-control switch: flips/retunes the admission ladder on
+    the RUNNING control plane (no restart, no worker involvement — only
+    the submission path reads the config). Same contract as the routing
+    A/B endpoint: a bad field 400s without half-applying."""
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    body = await request.json()
+    if not isinstance(body, dict):
+        return _json_error(400, "body must be a JSON object")
+    try:
+        st.admission.cfg.update(body)
+    except (TypeError, ValueError) as exc:
+        return _json_error(400, f"bad admission config: {exc}")
+    await st.store.audit("admin_update_admission", actor="admin",
+                         detail=st.admission.cfg.to_dict())
+    return web.json_response(st.admission.cfg.to_dict())
 
 
 async def admin_realtime(request: web.Request) -> web.Response:
@@ -1777,6 +1889,8 @@ def create_app(state: Optional[ServerState] = None,
     app.router.add_get(f"{API}/admin/stats/realtime", admin_realtime)
     app.router.add_get(f"{API}/admin/routing", admin_get_routing)
     app.router.add_put(f"{API}/admin/routing", admin_put_routing)
+    app.router.add_get(f"{API}/admin/admission", admin_get_admission)
+    app.router.add_put(f"{API}/admin/admission", admin_put_admission)
     app.router.add_get(f"{API}/admin/workers", admin_list_workers)
     app.router.add_get(f"{API}/admin/workers/{{worker_id}}",
                        admin_worker_detail)

@@ -96,6 +96,9 @@ class Metrics:
                 "chaos_events",
                 "pd_handoffs", "pd_handoff_bytes", "pd_reprefill",
                 "pd_fleet_balance",
+                "admission_decisions", "tenant_admissions",
+                "autoscaler_decisions", "autoscaler_replicas",
+                "autoscaler_slo", "autoscaler_cold_start",
             ):
                 setattr(self, name, noop)
             return
@@ -318,6 +321,42 @@ class Metrics:
             "pd_fleet_balance",
             "Free PD serving capacity by role (prefill/decode slots "
             "available across the registered pool)", ["role"], registry=r)
+        # SLO-native overload control (round 12): every rung of the
+        # degrade/shed ladder is counted by tier — a brownout panel reads
+        # "free degrading, paid accepting" directly from this series, and
+        # a paid:shed sample while free:accept still flows is the alarm
+        # the tier contract exists to prevent.
+        self.admission_decisions = Counter(
+            "admission_decisions_total",
+            "Overload-control ladder decisions (accept / degrade_clamp / "
+            "degrade_no_spec / shed) by tenant tier",
+            ["tenant_tier", "action"], registry=r)
+        # per-tenant view, label-capped: MetricsCollector maps tenants
+        # beyond the top-N LRU onto one "other" label so a tenant-id-
+        # spraying client cannot blow up the registry
+        self.tenant_admissions = Counter(
+            "tenant_admission_decisions_total",
+            "Admission decisions per tenant (top-N tenants by recency; "
+            "overflow aggregates under tenant=\"other\")",
+            ["tenant", "action"], registry=r)
+        # brownout-driven autoscaling: decisions, the replica target, the
+        # measured SLO-in-window the decisions were made from, and the
+        # measured cold-start lead time the scale-out projection uses
+        self.autoscaler_decisions = Counter(
+            "autoscaler_decisions_total",
+            "Autoscaler actions (scale_out / scale_in / hold)",
+            ["action"], registry=r)
+        self.autoscaler_replicas = Gauge(
+            "autoscaler_target_replicas",
+            "Replica count the autoscaler currently targets", registry=r)
+        self.autoscaler_slo = Gauge(
+            "autoscaler_slo_in_window",
+            "Fraction of recent requests meeting the SLO bound inside "
+            "the autoscaler's observation window", registry=r)
+        self.autoscaler_cold_start = Gauge(
+            "autoscaler_cold_start_seconds",
+            "Measured replica cold-start time (EMA) used as scale-out "
+            "lead time", registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -328,7 +367,13 @@ class Metrics:
 class MetricsCollector:
     """High-level facade the runtime calls into (reference :255-405)."""
 
-    def __init__(self, metrics: Optional[Metrics] = None) -> None:
+    # distinct tenant label values admitted into per-tenant series before
+    # new tenants aggregate under "other" — the Prometheus registry must
+    # stay bounded no matter how many tenant ids a client sprays
+    TENANT_LABEL_CAP = 64
+
+    def __init__(self, metrics: Optional[Metrics] = None,
+                 tenant_label_cap: Optional[int] = None) -> None:
         self.metrics = metrics or Metrics()
         self._tok_window: list[tuple[float, int]] = []
         # last-seen cumulative spec counters per worker: engines report
@@ -337,6 +382,14 @@ class MetricsCollector:
         self._pressure_prev: Dict[str, Dict[str, int]] = {}
         self._batcher_prev: Dict[str, Dict[str, int]] = {}
         self._pd_prev: Dict[str, Dict[str, int]] = {}
+        # bounded tenant-label admission (insertion-ordered dict as LRU):
+        # once full, unseen tenants map to "other" — existing series keep
+        # their labels (a label that has emitted samples must not migrate)
+        self._tenant_label_cap = int(
+            tenant_label_cap if tenant_label_cap is not None
+            else self.TENANT_LABEL_CAP
+        )
+        self._tenant_labels: Dict[str, None] = {}
 
     def record_request(self, job_type: str, status: str,
                        latency_s: Optional[float] = None) -> None:
@@ -542,6 +595,47 @@ class MetricsCollector:
             self.metrics.pd_fleet_balance.labels(role).set(
                 float(capacity.get(role, 0) or 0)
             )
+
+    # -- overload control / autoscaling (round 12) --------------------------
+
+    def tenant_label(self, tenant: str) -> str:
+        """Map a tenant id onto a bounded label set: known tenants keep
+        their label, new tenants are admitted until the cap, then
+        aggregate under ``other``. Deliberately NOT an evicting LRU for
+        label purposes: a label that has emitted samples keeps meaning
+        forever (re-assigning it would corrupt the series), so admission
+        is first-come-first-labeled."""
+        tenant = str(tenant)[:128]
+        if tenant in self._tenant_labels:
+            return tenant
+        if len(self._tenant_labels) < self._tenant_label_cap:
+            self._tenant_labels[tenant] = None
+            return tenant
+        return "other"
+
+    def record_admission(self, tier: str, action: str,
+                         tenant: Optional[str] = None) -> None:
+        """One overload-ladder decision: counted by tier always, and per
+        tenant under the bounded label map."""
+        self.metrics.admission_decisions.labels(tier, action).inc()
+        if tenant is not None:
+            self.metrics.tenant_admissions.labels(
+                self.tenant_label(tenant), action
+            ).inc()
+
+    def record_autoscaler(self, action: str,
+                          target_replicas: Optional[int] = None,
+                          slo_in_window: Optional[float] = None,
+                          cold_start_s: Optional[float] = None) -> None:
+        """One autoscaler tick: the decision (scale_out/scale_in/hold)
+        plus the observations it was made from."""
+        self.metrics.autoscaler_decisions.labels(action).inc()
+        if target_replicas is not None:
+            self.metrics.autoscaler_replicas.set(float(target_replicas))
+        if slo_in_window is not None:
+            self.metrics.autoscaler_slo.set(float(slo_in_window))
+        if cold_start_s is not None:
+            self.metrics.autoscaler_cold_start.set(float(cold_start_s))
 
     def record_prefix_route(self, path: str, hit: bool,
                             spillover: bool = False) -> None:

@@ -280,11 +280,27 @@ class SmartScheduler:
             estimate_job_duration_s(j["type"], j.get("params")) for j in queued
         )
         wait = est_backlog_s / max(1, len(workers)) if workers else float("inf")
+        # overload-control observability: the queued backlog by tenant
+        # tier (params["tier"], stamped at admission) — the brownout panel
+        # and the autoscaler read "who is actually waiting" from this
+        by_tier: Dict[str, int] = {}
+        for j in queued:
+            params = j.get("params")
+            if isinstance(params, str):
+                try:
+                    params = json.loads(params)
+                except ValueError:
+                    params = None
+            tier = (params or {}).get("tier") if isinstance(params, dict) \
+                else None
+            key = str(tier) if tier else "untiered"
+            by_tier[key] = by_tier.get(key, 0) + 1
         stats.update(
             {
                 "active_workers": len(workers),
                 "total_chips": total_chips,
                 "estimated_wait_s": wait if workers else None,
+                "queued_by_tier": by_tier,
             }
         )
         return stats

@@ -241,6 +241,14 @@ _MIGRATIONS = [
     # instead of stranding until the job timeout. A credential-blip
     # re-register from the SAME process keeps its boot_id and its work.
     (7, "ALTER TABLE workers ADD COLUMN boot_id TEXT"),
+    # v8: SLO-native overload control — usage records carry the tenant
+    # and tier the plane admitted the job under, so per-tenant accounting
+    # (and the fairness story behind the admission budgets) is auditable
+    # from the same table billing reads.
+    (8, "ALTER TABLE usage_records ADD COLUMN tenant TEXT"),
+    (8, "ALTER TABLE usage_records ADD COLUMN tier TEXT"),
+    (8, "CREATE INDEX IF NOT EXISTS idx_usage_tenant "
+        "ON usage_records (tenant, created_at)"),
 ]
 
 SCHEMA_VERSION = max(

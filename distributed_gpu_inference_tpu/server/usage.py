@@ -79,7 +79,8 @@ class UsageService:
                                enterprise_id: Optional[str] = None
                                ) -> Dict[str, Any]:
         job_type = job["type"]
-        units = units_from_result(job_type, job.get("params"), job.get("result"))
+        params = job.get("params") or {}
+        units = units_from_result(job_type, params, job.get("result"))
         price = await self._price_for(enterprise_id, job_type)
         cost = units * price
         rec = {
@@ -87,6 +88,11 @@ class UsageService:
             "job_id": job["id"],
             "job_type": job_type,
             "worker_id": job.get("worker_id"),
+            # overload control (round 12): the tenant/tier the plane
+            # admitted the job under — per-tenant accounting shares the
+            # table billing reads, so admission fairness is auditable
+            "tenant": params.get("tenant"),
+            "tier": params.get("tier"),
             "units": units,
             "unit_kind": UNIT_KINDS.get(job_type, "units"),
             "cost": cost,
@@ -111,6 +117,19 @@ class UsageService:
             params.append(enterprise_id)
         sql += " GROUP BY hour, job_type ORDER BY hour"
         return await self._store.query(sql, params)
+
+    async def tenant_summary(self, since: Optional[float] = None
+                             ) -> List[Dict[str, Any]]:
+        """Per-tenant usage aggregation (round 12 overload control): the
+        consumption side of the admission budgets — jobs, units, and cost
+        grouped by the tenant/tier stamped at admission. Untenanted
+        legacy records group under NULL."""
+        since = since if since is not None else time.time() - 24 * 3600
+        return await self._store.query(
+            "SELECT tenant, tier, COUNT(*) AS jobs, SUM(units) AS units, "
+            "SUM(cost) AS cost FROM usage_records WHERE created_at >= ? "
+            "GROUP BY tenant, tier ORDER BY units DESC", (since,),
+        )
 
     async def platform_stats(self) -> Dict[str, Any]:
         rows = await self._store.query(

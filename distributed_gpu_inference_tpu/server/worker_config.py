@@ -25,6 +25,17 @@ from typing import Any, Dict, Optional, Tuple
 
 from .store import Store
 
+# shed fractions of max_queue_depth by tenant tier when the fleet config
+# doesn't override them (LoadControl.tier_queue_fractions): batch browns
+# out first, then free; paid holds the full limit — the shed ORDER the
+# round-12 overload ladder guarantees ("paid never shed while free-tier
+# capacity exists") falls out of these being strictly ordered.
+DEFAULT_TIER_QUEUE_FRACTIONS: Dict[str, float] = {
+    "paid": 1.0,
+    "free": 0.85,
+    "batch": 0.6,
+}
+
 
 @dataclass
 class LoadControl:
@@ -46,6 +57,13 @@ class LoadControl:
     # rejected with 429 + Retry-After instead of growing the queue silently
     # (the SDK's jittered backoff honors the hint). 0 = unlimited.
     max_queue_depth: int = 0
+    # tier-aware shed fractions of max_queue_depth (round 12 overload
+    # control): a tier sheds once the queue passes fraction * limit, so
+    # lower tiers brown out FIRST and paid traffic is never shed while
+    # free-tier capacity exists. Missing tiers fall back to
+    # DEFAULT_TIER_QUEUE_FRACTIONS; untiered submissions keep the full
+    # limit (fraction 1.0 — exactly the pre-round-12 blanket behavior).
+    tier_queue_fractions: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -179,17 +197,31 @@ class WorkerConfigService:
     def set_submit_queue_limit(self, limit: int) -> None:
         self._defaults.load_control.max_queue_depth = int(limit)
 
-    def should_accept_submission(self, queued: int,
-                                 active_workers: int) -> Tuple[bool, float]:
+    def should_accept_submission(self, queued: int, active_workers: int,
+                                 tier: Optional[str] = None
+                                 ) -> Tuple[bool, float]:
         """Queue-depth admission control for POST /jobs. Returns
         ``(accept, retry_after_s)`` — when the fleet-default
         ``LoadControl.max_queue_depth`` is exceeded the submission is
         rejected and the hint estimates the drain time of the overflow
         (queue beyond the limit, spread over live workers), clamped to
         [1, 60] s so a burst never tells every client to come back at the
-        same instant far in the future."""
+        same instant far in the future.
+
+        ``tier`` (round 12 overload control) scales the limit by the
+        tier's queue fraction: free/batch tiers shed at a fraction of the
+        limit paid keeps, so the shed order under saturation is
+        batch → free → paid by construction. ``tier=None`` (legacy
+        untiered submissions) keeps the full limit — byte-identical to
+        the pre-tier behavior."""
         limit = self.submit_queue_limit
-        if limit <= 0 or queued < limit:
+        if limit <= 0:
+            return True, 0.0
+        if tier is not None:
+            frac = (self._defaults.load_control.tier_queue_fractions.get(
+                tier, DEFAULT_TIER_QUEUE_FRACTIONS.get(tier, 1.0)))
+            limit = max(1, int(limit * max(0.0, min(1.0, float(frac)))))
+        if queued < limit:
             return True, 0.0
         overflow = queued - limit + 1
         retry_after = min(60.0, max(1.0, overflow / max(1, active_workers)))

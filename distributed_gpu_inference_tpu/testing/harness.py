@@ -461,6 +461,41 @@ class LiveFleet:
     def alive_members(self) -> List[FleetWorker]:
         return [m for m in self.members if m.alive]
 
+    # -- elastic capacity (round 12: the autoscaler's actuation surface) -----
+
+    def scale_out(self, role: Optional[str] = None) -> FleetWorker:
+        """Add one COLD replica to the running fleet: a fresh
+        :class:`FleetWorker` (new engine build, registration, first
+        heartbeat) appended after the existing members, so chaos-plan
+        worker indices stay stable. Blocks until the replica is
+        registered and heartbeating — the caller measuring cold-start
+        lead time times this call."""
+        m = FleetWorker(
+            len(self.members), self.plane.url, self.engine_config,
+            hb_interval_s=self.hb_interval_s,
+            poll_interval_s=self.poll_interval_s,
+            role=role,
+            pd_data_plane=self.pd_data_plane,
+        )
+        m.start()
+        self.members.append(m)
+        self.roles.append(role)
+        return m
+
+    def scale_in(self) -> Optional[FleetWorker]:
+        """Retire the most recently added ALIVE replica (LIFO — scaled-out
+        capacity goes first, the founding members last). The kill is
+        abrupt by design: the control plane's sweeps requeue anything it
+        was running, which is exactly the failure path scale-in must
+        compose with. Returns the retired member, or None when only one
+        replica is alive (never scale to zero)."""
+        alive = self.alive_members()
+        if len(alive) <= 1:
+            return None
+        victim = alive[-1]
+        victim.kill()
+        return victim
+
     # -- chaos driver --------------------------------------------------------
 
     def run_chaos(self, plan: FleetFaultPlan,
@@ -586,3 +621,91 @@ class LiveFleet:
             armed = [fp.add_rule(r) for r in rules]
             return lambda: [fp.remove_rule(r) for r in armed]
         raise ValueError(f"unknown fleet event kind {ev.kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# brownout-driven autoscaling (round 12): the controller's actuation loop
+# ---------------------------------------------------------------------------
+
+
+class FleetAutoscaler:
+    """Ticker thread wiring a
+    :class:`~..server.autoscaler.BrownoutAutoscaler` to a
+    :class:`LiveFleet`: every ``tick_s`` the controller sees the CURRENT
+    alive replica count (chaos kills included — scaling decisions and
+    failures compose) and a utilization estimate from the plane's queue
+    stats; ``scale_out`` adds a cold replica (the bring-up is timed and
+    fed back as the measured cold-start lead time), ``scale_in`` retires
+    the youngest. The traffic driver feeds per-request SLO samples via
+    ``autoscaler.observe`` directly."""
+
+    def __init__(self, fleet: LiveFleet, autoscaler: Any,
+                 tick_s: float = 0.5,
+                 scale_out_role: Optional[str] = None) -> None:
+        self.fleet = fleet
+        self.autoscaler = autoscaler
+        self.tick_s = tick_s
+        self.scale_out_role = scale_out_role
+        self.actions: List[tuple] = []       # (wall_offset_s, action)
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._failure: Optional[BaseException] = None
+
+    def _utilization(self) -> Optional[float]:
+        """Coarse fleet utilization in [0, 1]: queued work saturates to
+        1.0; otherwise the busy fraction of live workers."""
+        try:
+            stats = self.fleet.plane.call(
+                self.fleet.plane.state.store.queue_stats()
+            )
+        except Exception:  # noqa: BLE001 — plane busy: skip this tick
+            return None
+        if int(stats.get("queued") or 0) > 0:
+            return 1.0
+        w = stats.get("workers") or {}
+        busy = int(w.get("busy") or 0)
+        idle = int(w.get("idle") or 0)
+        return busy / (busy + idle) if (busy + idle) else None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            replicas = len(self.fleet.alive_members())
+            action = self.autoscaler.tick(replicas, self._utilization())
+            if action == "scale_out":
+                self.actions.append(
+                    (time.monotonic() - self._t0, "scale_out"))
+                self.autoscaler.note_scale_out_started()
+                self.fleet.scale_out(role=self.scale_out_role)
+                # scale_out blocks through engine build + registration +
+                # first heartbeat: the replica is ready to serve, so this
+                # IS the cold-start lead time the projection needs
+                self.autoscaler.note_replica_serving()
+            elif action == "scale_in":
+                self.actions.append(
+                    (time.monotonic() - self._t0, "scale_in"))
+                self.fleet.scale_in()
+
+    def start(self) -> "FleetAutoscaler":
+        self._t0 = time.monotonic()
+
+        def run() -> None:
+            try:
+                self._loop()
+            except BaseException as exc:  # noqa: BLE001 — surfaced on stop
+                self._failure = exc
+
+        self._thread = threading.Thread(
+            target=run, name="fleet-autoscaler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 60.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        if self._failure is not None:
+            failure, self._failure = self._failure, None
+            raise failure

@@ -398,7 +398,21 @@ class InferenceRequest:
     priority: int = 0
     session_id: Optional[str] = None
     arrival_time: float = field(default_factory=time.time)
+    # relative completion deadline (seconds from arrival). Advisory EDF
+    # input for the batcher: WITHIN a priority band, earlier absolute
+    # deadlines admit first and later-deadline slots are preferred
+    # preemption victims. None (the default) = no deadline — ordering is
+    # then byte-identical to the pre-deadline batcher.
+    deadline_s: Optional[float] = None
     params: Dict[str, Any] = field(default_factory=dict)  # task-specific extras
+
+    @property
+    def deadline_at(self) -> float:
+        """Absolute deadline (epoch seconds), +inf when none is set —
+        directly usable as an EDF sort component."""
+        if self.deadline_s is None:
+            return float("inf")
+        return self.arrival_time + float(self.deadline_s)
 
     @property
     def num_prompt_tokens(self) -> int:
@@ -419,6 +433,13 @@ class InferenceResponse:
     ttft_ms: Optional[float] = None
     e2e_ms: Optional[float] = None
     error: Optional[str] = None
+    # machine-readable error class riding next to the human-readable
+    # ``error`` text (round 12): ``request_timeout`` (client-side wait
+    # budget elapsed — the request may still be generating), vs
+    # ``shed_overload`` (the batcher rejected at admission — nothing ran,
+    # safe to retry elsewhere). Surfaced through job results and SSE so
+    # clients branch on the class, not on parsing the message.
+    error_code: Optional[str] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @property

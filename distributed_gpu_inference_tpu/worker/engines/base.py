@@ -21,6 +21,22 @@ class EngineLoadError(RuntimeError):
     """Model/deps unavailable — worker should drop this task type."""
 
 
+class ServingError(RuntimeError):
+    """A serving-path request failed with a machine-readable class.
+
+    ``error_code`` mirrors ``InferenceResponse.error_code``
+    (``request_timeout`` / ``shed_overload`` / ``over_capacity`` / …) and
+    survives to the job result (worker/main.py attaches it to the
+    completion) and the SSE error event (the stream pump copies it onto
+    the error chunk) — clients branch on the class instead of parsing
+    the message text."""
+
+    def __init__(self, message: str,
+                 error_code: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.error_code = error_code
+
+
 class JobMigrated(Exception):
     """A generation was interrupted at a step boundary (graceful drain) and
     frozen into a portable checkpoint instead of finishing. The worker
@@ -49,10 +65,17 @@ class GenerationConfig:
     # run to the max_new_tokens budget, honoring no stops (benchmark
     # workloads where A/B legs must generate identical token counts)
     ignore_eos: bool = False
+    # advisory completion deadline (seconds from admission): within a
+    # priority band the batcher admits earlier deadlines first (EDF) and
+    # prefers later-deadline slots as preemption victims. None = no
+    # deadline — scheduling is byte-identical to the deadline-less path.
+    deadline_s: Optional[float] = None
 
     @classmethod
     def from_params(cls, params: Dict[str, Any]) -> "GenerationConfig":
+        dl = params.get("deadline_s")
         return cls(
+            deadline_s=float(dl) if dl is not None else None,
             max_new_tokens=int(
                 params.get("max_new_tokens") or params.get("max_tokens") or 256
             ),

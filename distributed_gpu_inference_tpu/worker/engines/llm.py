@@ -43,7 +43,17 @@ from .base import (
     GenerationResult,
     JobMigrated,
     LLMBaseEngine,
+    ServingError,
 )
+
+
+def _raise_serving(resp: Any) -> None:
+    """Raise the serving failure carried by an InferenceResponse,
+    preserving the machine-readable ``error_code`` (request_timeout /
+    shed_overload / …) so job results and SSE error events can surface
+    the class, not just the message."""
+    raise ServingError(resp.error,
+                       error_code=getattr(resp, "error_code", None))
 
 # Worker-YAML / remote-config serving knobs (``engines.llm.serving.*``) —
 # THE SLO configuration surface measured by the round-5 frontier. The
@@ -697,6 +707,9 @@ class TPULLMEngine(LLMBaseEngine):
         return InferenceRequest(
             prompt_token_ids=token_ids,
             sampling=self._sampling_from(cfg),
+            # EDF input: the batcher orders same-priority admissions by
+            # absolute deadline and prefers slack-rich preemption victims
+            deadline_s=cfg.deadline_s,
         )
 
     # -- PD disaggregation stages (server/pd_flow.py drives these) ----------
@@ -750,7 +763,7 @@ class TPULLMEngine(LLMBaseEngine):
         t0 = time.perf_counter()
         resp = self.serving.submit(req)
         if resp.error is not None:
-            raise RuntimeError(resp.error)
+            _raise_serving(resp)
         return self._finish_payload(
             list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
             resp.finish_reason or "stop", cfg, resp.ttft_ms,
@@ -1125,7 +1138,7 @@ class TPULLMEngine(LLMBaseEngine):
                 raise
             if resp.error is not None:
                 self._release_adopted_slot(eng, slot, seq)
-                raise RuntimeError(resp.error)
+                _raise_serving(resp)
         else:
             try:
                 while eng.slots[slot] is not None and \
@@ -1487,7 +1500,7 @@ class TPULLMEngine(LLMBaseEngine):
             if not spec_fast:
                 self._unregister_live(key)
         if resp.error is not None:
-            raise RuntimeError(resp.error)
+            _raise_serving(resp)
         return self._finish_payload(
             list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
             resp.finish_reason or "stop", cfg, resp.ttft_ms,
@@ -1730,7 +1743,7 @@ class TPULLMEngine(LLMBaseEngine):
                 if item is _DONE:
                     final = fut.result()   # raises on engine/submit failure
                     if final.error is not None:
-                        raise RuntimeError(final.error)
+                        _raise_serving(final)
                     gen = list(final.token_ids)
                     finished = True
                 else:
@@ -1938,7 +1951,13 @@ class TPULLMEngine(LLMBaseEngine):
                 for chunk in self.stream(params, cancel=cancel):
                     loop.call_soon_threadsafe(q.put_nowait, chunk)
             except Exception as exc:  # noqa: BLE001 - surface to consumer
-                loop.call_soon_threadsafe(q.put_nowait, {"error": str(exc)})
+                chunk = {"error": str(exc)}
+                code = getattr(exc, "error_code", None)
+                if code:
+                    # machine-readable class rides the SSE error event
+                    # (request_timeout vs shed_overload — round 12)
+                    chunk["error_code"] = code
+                loop.call_soon_threadsafe(q.put_nowait, chunk)
             finally:
                 loop.call_soon_threadsafe(q.put_nowait, _END)
 

@@ -789,7 +789,34 @@ class Worker:
             with self._state_lock:
                 self.stats["jobs_migrated"] += 1
         except Exception as exc:  # noqa: BLE001 - job failure is a result
+            if self._shutdown.is_set():
+                # the worker is dying (hard kill / unload), not the job:
+                # every in-flight batcher future resolves "batcher
+                # stopped" and racing those reports against api.close()
+                # used to let a few land as terminal FAILURES — marking
+                # work failed that any other replica can run. Release the
+                # claim instead (conditional RUNNING→QUEUED, retry_count
+                # untouched); if the plane is already unreachable the
+                # heartbeat-timeout sweep / boot_id fence requeues it
+                # anyway. (Round-12 overload suite caught this: a kill
+                # mid-burst failed the burst's tail.)
+                log.warning("job %s aborted by shutdown (%s): releasing",
+                            job_id, exc)
+                try:
+                    self.api.release_job(job_id)
+                except Exception:  # noqa: BLE001 — the sweeps own it then
+                    pass
+                with self._state_lock:
+                    self.stats["jobs_released_on_shutdown"] = \
+                        self.stats.get("jobs_released_on_shutdown", 0) + 1
+                return
             log.exception("job %s failed", job_id)
+            code = getattr(exc, "error_code", None)
+            if code:
+                # machine-readable failure class (ServingError —
+                # request_timeout vs shed_overload) rides the job result
+                # next to the human-readable error text
+                complete_kw["result"] = {"error_code": str(code)}
             try:
                 self.api.complete_job(
                     job_id, success=False, error=str(exc), **complete_kw

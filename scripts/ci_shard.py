@@ -17,6 +17,9 @@ import pathlib
 
 # ordered heaviest-first; files absent from the checkout are skipped
 HEAVY = [
+    "tests/test_overload_chaos.py",      # 25-seed overload-under-chaos
+    #   (10x free-tier burst + admission ladder + kill/restart + the
+    #   live-fleet autoscaler legs)
     "tests/test_pd_chaos.py",            # 25-seed PD-split handoff chaos
     #   (role-tagged LiveFleet + streamed-handoff kills/corruption)
     "tests/test_fleet_chaos.py",         # 25-seed LiveFleet chaos replays

@@ -75,13 +75,21 @@ def test_bursty_delays_land_in_on_windows():
     assert len(burst.requests) == len(base.requests)
 
 
-def test_priority_has_two_tiers():
+def test_priority_has_named_tiers():
+    """Round 12: the priority scenario grew from a two-level 10/0 split
+    to named paid/free/batch tenant tiers, with per-tenant ids and tier
+    names in every trace row."""
     wl = generate("priority", seed=1, requests=40, tenants=4)
     prios = {r.priority for r in wl.requests}
-    assert prios == {0, 10}
+    assert prios <= {10, 0, -10} and 10 in prios
     tiers = wl.meta["priority_tiers"]
     assert any(v == 10 for v in tiers.values())
     assert any(v == 0 for v in tiers.values())
+    names = wl.meta["tenant_tiers"]
+    assert set(names.values()) == {"paid", "free", "batch"}
+    for r in wl.requests:
+        assert r.tier == names[r.tenant]
+        assert r.priority == {"paid": 10, "free": 0, "batch": -10}[r.tier]
 
 
 def test_unknown_scenario_raises():
