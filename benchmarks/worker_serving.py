@@ -322,7 +322,8 @@ class FleetMember:
     """One live engine + direct server, registered with the control plane
     and heartbeating radix summaries like a production worker."""
 
-    def __init__(self, llm: Any, region: str = "us-west") -> None:
+    def __init__(self, llm: Any, region: str = "us-west",
+                 data_plane: bool = False) -> None:
         from distributed_gpu_inference_tpu.worker.direct_server import (
             DirectServer,
         )
@@ -334,6 +335,26 @@ class FleetMember:
         self.server.start()
         port = self.server._runner.addresses[0][1]
         self.url = f"http://127.0.0.1:{port}"
+        # cluster-KV migration legs: a real /kv/transfer + /kv/export data
+        # plane per member, so cold members PULL hot prefixes from peers
+        self.pd_plane: Optional[Any] = None
+        self.data_plane_url: Optional[str] = None
+        if data_plane:
+            from distributed_gpu_inference_tpu.comm.data_plane import (
+                DataPlaneServer,
+            )
+            from distributed_gpu_inference_tpu.worker.main import (
+                _PDReceiverShim,
+            )
+
+            self.pd_plane = DataPlaneServer(
+                _PDReceiverShim(llm), host="127.0.0.1", port=0,
+                kv_receiver=llm.kv_receiver, kv_exporter=llm.kv_export,
+            )
+            self.pd_plane.start()
+            self.data_plane_url = (
+                f"http://127.0.0.1:{self.pd_plane.bound_port}"
+            )
         self.worker_id: Optional[str] = None
         self.token: Optional[str] = None
 
@@ -344,6 +365,8 @@ class FleetMember:
             "supported_types": ["llm"],
             "supports_direct": True,
             "direct_url": self.url,
+            **({"data_plane_url": self.data_plane_url}
+               if self.data_plane_url else {}),
         })
         r.raise_for_status()
         data = r.json()
@@ -403,6 +426,12 @@ class FleetMember:
         st.prefix_queries = 0
         st.prefix_hit_tokens = 0
         st.prefix_total_tokens = 0
+        for k in self.llm.kv_migrate_stats:
+            self.llm.kv_migrate_stats[k] = 0
+        self.llm._kvmig_backoff.clear()
+        rx = self.llm._handoff_rx
+        if rx is not None:
+            rx.stats["prefix_commits"] = 0
 
     def cache_stats(self) -> Dict[str, Any]:
         s = self.llm.engine.manager.stats
@@ -412,8 +441,13 @@ class FleetMember:
             "prefix_total_tokens": s.prefix_total_tokens,
         }
 
+    def migrate_stats(self) -> Dict[str, int]:
+        return dict(self.llm.kv_migrate_stats)
+
     def stop(self) -> None:
         self.server.stop()
+        if self.pd_plane is not None:
+            self.pd_plane.stop()
         self.llm.unload()
 
 
@@ -491,7 +525,15 @@ async def _drive_fleet(plane_url: str, members: List["FleetMember"],
                                 "type": "llm",
                                 "params": {"prompt": req.prompt,
                                            "max_new_tokens": req.max_tokens,
-                                           "priority": req.priority},
+                                           "priority": req.priority,
+                                           # router migrate-KV verdict: the
+                                           # cold worker pulls the prefix
+                                           # from the named peer before
+                                           # admission
+                                           **({"kv_migrate_from":
+                                               disc["kv_migrate"]}
+                                              if disc.get("kv_migrate")
+                                              else {})},
                             })
                         break
                     except httpx.TransportError:
@@ -565,7 +607,8 @@ def run_fleet(args: Any, backend: str, model: str) -> None:
 
     wl = generate(args.scenario, args.seed, requests=args.requests,
                   max_tokens=args.max_tokens, rate=float(args.arrival_rate)
-                  if args.arrival_rate else 2.0)
+                  if args.arrival_rate else 2.0, burst=args.burst,
+                  tenants=args.tenants)
     max_prompt = max(len(r.prompt) for r in wl.requests)
     members: List[FleetMember] = []
     with LiveControlPlane() as plane:
@@ -641,6 +684,131 @@ def run_fleet(args: Any, backend: str, model: str) -> None:
                 - blind["re_prefill_tokens_saved"]
             )
             out["routing_vs_blind"] = ratios
+            emit(out)
+        finally:
+            client.close()
+            for m in members:
+                m.stop()
+
+
+# ---------------------------------------------------------------------------
+# --kv-migrate (round 13): cluster-wide KV migration vs PR 7's route-only
+# baseline. Same fleet harness as --workers, plus a real /kv/transfer +
+# /kv/export data plane per member so a cold worker PULLS a hot prefix from
+# its peer instead of re-prefilling. The workload is the anti-affinity
+# storm trace (benchmarks/workloads.py) — synchronized single-tenant bursts
+# that saturate whichever worker is warm, exactly where advisory routing
+# collapses — swept across offered rates: at low rate the warm worker
+# absorbs its bursts and both legs tie; at high rate route-only spills cold
+# and re-prefills while migrate-ON moves the KV to the spill target.
+# ---------------------------------------------------------------------------
+
+
+def run_kv_migrate(args: Any, backend: str, model: str) -> None:
+    from distributed_gpu_inference_tpu.testing.harness import (
+        LiveControlPlane,
+    )
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+    import httpx
+
+    from benchmarks.workloads import generate
+
+    rates = [float(r) for r in
+             str(args.arrival_rate or "0.5,2.0").split(",")]
+    workers = max(2, args.workers)
+    wls = {
+        rate: generate("storm", args.seed, requests=args.requests,
+                       max_tokens=args.max_tokens, rate=rate,
+                       burst=args.burst, tenants=args.tenants)
+        for rate in rates
+    }
+    max_prompt = max(len(r.prompt) for wl in wls.values()
+                     for r in wl.requests)
+    members: List[FleetMember] = []
+    with LiveControlPlane() as plane:
+        client = httpx.Client(timeout=60.0)
+        try:
+            for _ in range(workers):
+                llm = TPULLMEngine({
+                    "model": model,
+                    "max_batch_size": args.concurrency,
+                    "max_seq_len": max_prompt + args.max_tokens + 16,
+                    "quantization": args.quantization,
+                    "serving": {
+                        "queue_limit": max(4096, args.requests * 2),
+                        "default_timeout_s": 600.0,
+                    },
+                })
+                llm.load_model()
+                m = FleetMember(llm, data_plane=True)
+                m.register(client, plane.url)
+                members.append(m)
+
+            def routing(**kw: Any) -> None:
+                client.put(f"{plane.url}/api/v1/admin/routing",
+                           json=kw).raise_for_status()
+
+            def leg(wl: Any) -> Dict[str, Any]:
+                for m in members:
+                    m.reset_cache()
+                results, elapsed = asyncio.run(_drive_fleet(
+                    plane.url, members, wl,
+                    hb_interval_s=args.fleet_heartbeat_s,
+                ))
+                out = _fleet_leg_summary(results, elapsed, members)
+                mig: Dict[str, int] = {}
+                for m in members:
+                    for k, v in m.migrate_stats().items():
+                        mig[k] = mig.get(k, 0) + v
+                out["kv_migrate"] = mig
+                out["outputs"] = {
+                    r["id"]: r.get("text") for r in results
+                    if r.get("status") == 200
+                }
+                return out
+
+            # compile every graph once (prompt lengths are identical
+            # across rates, so one warmup serves every leg)
+            routing(enabled=True, kv_migrate=True)
+            leg(wls[rates[0]])
+
+            out: Dict[str, Any] = {
+                "benchmark": "worker_serving_kv_migrate",
+                "path": "control_plane+direct_nearest+kv_export_pull",
+                "scenario": "storm", "seed": args.seed,
+                "workers": workers, "model": model, "backend": backend,
+                "requests": args.requests, "burst": args.burst,
+                "concurrency": args.concurrency,
+                "max_tokens": args.max_tokens,
+                "rates": {},
+            }
+            for rate in rates:
+                wl = wls[rate]
+                routing(enabled=True, kv_migrate=True)
+                migrate_on = leg(wl)
+                # the A/B flip: routing stays ON (PR 7 baseline), only the
+                # migration cost model is disabled
+                routing(kv_migrate=False)
+                route_only = leg(wl)
+                identical = (migrate_on.pop("outputs")
+                             == route_only.pop("outputs"))
+                entry: Dict[str, Any] = {
+                    "migrate_on": migrate_on,
+                    "route_only": route_only,
+                    "outputs_identical": identical,
+                    "hit_rate_migrate": migrate_on["prefix_hit_rate"],
+                    "hit_rate_route_only": route_only["prefix_hit_rate"],
+                }
+                for pct in ("mean", "p50", "p95"):
+                    m_t = (migrate_on["ttft_ms"] or {}).get(pct)
+                    r_t = (route_only["ttft_ms"] or {}).get(pct)
+                    if m_t and r_t:
+                        entry[f"ttft_{pct}_migrate_over_route"] = round(
+                            m_t / r_t, 3
+                        )
+                out["rates"][str(rate)] = entry
+            routing(kv_migrate=False)
             emit(out)
         finally:
             client.close()
@@ -1703,8 +1871,19 @@ def main() -> None:
                     help="fleet size for the --chaos brownout leg "
                     "(one replica is killed and restarted)")
     ap.add_argument("--scenario", default="chat",
-                    choices=["chat", "rag", "bursty", "priority"],
+                    choices=["chat", "rag", "bursty", "storm", "priority"],
                     help="fleet-mode workload (benchmarks/workloads.py)")
+    ap.add_argument("--kv-migrate", action="store_true",
+                    help="cluster-wide KV migration A/B: migrate-ON vs "
+                    "route-only under the anti-affinity storm workload, "
+                    "swept over --arrival-rate (comma-separated storm "
+                    "rates; default 0.5,2.0)")
+    ap.add_argument("--burst", type=int, default=8,
+                    help="requests per tenant storm (storm scenario / "
+                    "--kv-migrate)")
+    ap.add_argument("--tenants", type=int, default=4,
+                    help="workload tenant count (--workers fleet mode and "
+                    "--kv-migrate)")
     ap.add_argument("--fleet-heartbeat-s", type=float, default=0.5,
                     help="fleet-mode worker heartbeat cadence (summaries "
                     "ride heartbeats; production uses 30s)")
@@ -1732,6 +1911,10 @@ def main() -> None:
             ap.error("--chaos takes a single --arrival-rate (the sweep "
                      "axis is the replica count)")
         run_chaos_fleet(args, backend, model)
+        return
+
+    if args.kv_migrate:
+        run_kv_migrate(args, backend, model)
         return
 
     if args.workers >= 2:

@@ -25,6 +25,14 @@ Scenarios:
 - ``bursty``   the chat mix, but tenant arrivals modulate through on/off
                bursts (a tenant's whole fleet goes quiet, then floods) —
                the schedule a locality router must not melt under.
+- ``storm``    the ANTI-AFFINITY schedule (round 13): a handful of tenants
+               with deep shared system prompts take turns flooding the
+               fleet — a whole burst of one tenant's requests lands inside
+               a fraction of a second, saturating whichever worker is warm
+               for that prefix so load-based spillover scatters the tail
+               across cold workers. Advisory routing (PR 7) collapses
+               here by design; cluster-wide KV migration is measured
+               against exactly this trace.
 - ``priority`` the rag mix across NAMED tenant tiers (round 12): paid
                (priority 10) over free (priority 0) over batch
                (priority -10), assigned per tenant by index — the tier
@@ -192,12 +200,50 @@ def _rag(rng: np.random.Generator, *, requests: int, tenants: int,
     return out
 
 
+def _storm(rng: np.random.Generator, *, requests: int, tenants: int,
+           rate: float, system_len: int, turn_len: int, max_tokens: int,
+           burst: int,
+           priority_for: Optional[Dict[str, int]] = None) -> List[WorkloadRequest]:
+    """Anti-affinity tenant storms: each storm picks ONE tenant and fires
+    ``burst`` requests sharing that tenant's deep system prompt within a
+    ~quarter-second window — faster than any single worker can absorb, so
+    a locality router must either queue on the warm worker or spill the
+    tail cold. ``rate`` is storms/s."""
+    sys_prompts = {f"t{t}": _text(rng, system_len) for t in range(tenants)}
+    burst = max(1, burst)
+    n_storms = max(1, -(-requests // burst))
+    storm_starts = np.cumsum(rng.exponential(1.0 / rate, n_storms))
+    # the burst window scales with the burst: requests land far faster
+    # than one worker drains them (saturation) while still spanning a few
+    # heartbeats — the router SEES the warm worker saturate mid-storm,
+    # which is the moment advisory routing starts spilling cold
+    span = 0.15 * burst
+    out: List[WorkloadRequest] = []
+    for s in range(n_storms):
+        tenant = f"t{int(rng.integers(0, tenants))}"
+        at = float(storm_starts[s])
+        offs = np.sort(rng.uniform(0.0, span, burst))
+        for j in range(burst):
+            if len(out) >= requests:
+                return out
+            out.append(WorkloadRequest(
+                id=f"s{s}.{j}", arrival_s=round(at + float(offs[j]), 4),
+                tenant=tenant,
+                prompt=sys_prompts[tenant] + _text(rng, turn_len),
+                max_tokens=max_tokens,
+                priority=(priority_for or {}).get(tenant, 0),
+                conversation=f"s{s}",
+            ))
+    return out
+
+
 def generate(scenario: str, seed: int = 0, *, requests: int = 32,
              tenants: int = 4, turns: int = 4, rate: float = 2.0,
              system_len: int = 256, turn_len: int = 64,
              doc_len: int = 512, query_len: int = 64,
              corpus_docs: int = 6, max_tokens: int = 32,
-             think_s: float = 0.2, tiered: bool = False) -> Workload:
+             think_s: float = 0.2, tiered: bool = False,
+             burst: int = 8) -> Workload:
     """Build one seed-stable trace. All randomness flows from ONE
     ``np.random.default_rng(seed)`` consumed in a fixed order — adding a
     scenario must never reorder draws inside an existing one.
@@ -239,6 +285,12 @@ def generate(scenario: str, seed: int = 0, *, requests: int = 32,
             if phase > p * d:   # OFF window: shift to the next ON edge
                 r.arrival_s = round(r.arrival_s + (p - phase), 4)
         kw["burst_period_s"] = period
+    elif scenario == "storm":
+        reqs = _storm(rng, requests=requests, tenants=tenants, rate=rate,
+                      system_len=system_len, turn_len=turn_len,
+                      max_tokens=max_tokens, burst=burst,
+                      priority_for=prio_map if tiered else None)
+        kw["burst"] = burst
     elif scenario == "priority":
         # named tenant tiers (round 12 — was a two-level 10/0 split):
         # paid over free over batch, per-tenant ids in every trace row
@@ -251,7 +303,7 @@ def generate(scenario: str, seed: int = 0, *, requests: int = 32,
     else:
         raise ValueError(
             f"unknown scenario {scenario!r} "
-            "(chat | rag | bursty | priority)"
+            "(chat | rag | bursty | storm | priority)"
         )
     if tiered:
         for r in reqs:
@@ -267,7 +319,7 @@ def generate(scenario: str, seed: int = 0, *, requests: int = 32,
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario", default="chat",
-                    choices=["chat", "rag", "bursty", "priority"])
+                    choices=["chat", "rag", "bursty", "storm", "priority"])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--tenants", type=int, default=4)
@@ -278,6 +330,8 @@ def main() -> None:
     ap.add_argument("--turn-len", type=int, default=64)
     ap.add_argument("--doc-len", type=int, default=512)
     ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--burst", type=int, default=8,
+                    help="requests per tenant storm (storm scenario)")
     ap.add_argument("--tiered", action="store_true",
                     help="stamp paid/free/batch tenant tiers (+matching "
                     "priorities) onto the trace; arrivals/prompts stay "
@@ -289,7 +343,7 @@ def main() -> None:
                   tenants=args.tenants, turns=args.turns, rate=args.rate,
                   system_len=args.system_len, turn_len=args.turn_len,
                   doc_len=args.doc_len, max_tokens=args.max_tokens,
-                  tiered=args.tiered)
+                  tiered=args.tiered, burst=args.burst)
     if args.summary:
         print(json.dumps({"scenario": wl.scenario, "seed": wl.seed,
                           "duration_s": round(wl.duration_s, 3),

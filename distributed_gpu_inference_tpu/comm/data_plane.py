@@ -29,12 +29,16 @@ class DataPlaneServer:
 
     def __init__(self, stage: PipelineStageWorker,
                  host: str = "0.0.0.0", port: int = 8472,
-                 kv_receiver: Optional[Callable[[bytes], Dict[str, Any]]] = None
+                 kv_receiver: Optional[Callable[[bytes], Dict[str, Any]]] = None,
+                 kv_exporter: Optional[Callable[[bytes], bytes]] = None,
                  ) -> None:
         self.stage = stage
         self.host = host
         self.port = port
         self.kv_receiver = kv_receiver
+        # cluster-wide KV migration: serve peers' prefix pulls (the
+        # response body is a framed run of streamed-handoff messages)
+        self.kv_exporter = kv_exporter
         self._runner: Optional[web.AppRunner] = None
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -99,6 +103,27 @@ class DataPlaneServer:
             return web.json_response({"detail": str(exc)}, status=500)
         return web.json_response(result)
 
+    async def _export_kv(self, request: web.Request) -> web.Response:
+        """Cluster-KV prefix export: a cold peer pulls our cached prefix
+        (``runtime/kv_handoff.py`` prefix-only frames). Mismatched model/
+        dtype/geometry answers 400 — the puller treats any non-200 as a
+        failed pull and recomputes."""
+        if self.kv_exporter is None:
+            return web.json_response(
+                {"detail": "this endpoint is not a KV exporter"}, status=501
+            )
+        raw = await request.read()
+        loop = asyncio.get_running_loop()
+        try:
+            body = await loop.run_in_executor(None, self.kv_exporter, raw)
+        except ValueError as exc:
+            return web.json_response({"detail": str(exc)}, status=400)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response({"detail": str(exc)}, status=500)
+        return web.Response(
+            body=body, content_type="application/octet-stream",
+        )
+
     # -- lifecycle -----------------------------------------------------------
 
     def make_app(self) -> web.Application:
@@ -108,6 +133,7 @@ class DataPlaneServer:
         app.router.add_post("/inference/close", self._close_session)
         app.router.add_post("/inference/forward", self._forward)
         app.router.add_post("/kv/transfer", self._transfer_kv)
+        app.router.add_post("/kv/export", self._export_kv)
         return app
 
     def start(self) -> None:

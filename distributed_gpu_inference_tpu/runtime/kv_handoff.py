@@ -31,7 +31,7 @@ import functools
 import io
 import time
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -745,6 +745,220 @@ def abort_message(key: str) -> bytes:
     return _pack_stream(_KIND_ABORT, {"key": key})
 
 
+# ---------------------------------------------------------------------------
+# Cluster-wide KV migration (round 13): prefix-only transfers over the SAME
+# begin/piece/commit protocol.
+#
+# A cold worker that was routed a request whose prefix is hot on a peer can
+# PULL the peer's cached KV instead of re-prefilling: it POSTs an export
+# request to the peer's ``/kv/export`` data-plane endpoint, and the peer
+# answers with a framed sequence of the chaos-hardened streamed-handoff
+# messages — one ``begin`` (``prefix_only`` marked, carrying the exact
+# prefix token ids), the ``piece`` frames, and one ``commit``. The puller
+# feeds each frame through its own :class:`HandoffReceiver`, so duplicate
+# tolerance, corrupt-piece session aborts, staged-coverage commit checks,
+# and the TTL/progress purge machinery all apply unchanged. A prefix-only
+# commit binds NO slot: it releases the staged sequence with
+# ``free_sequence(cache=True)``, landing the pulled blocks in the radix
+# prefix index — the very next admission of the real request hits L1 and
+# skips the re-prefill.
+#
+# The export side sources blocks from EVERY tier: device-resident radix
+# blocks come out in one pool gather (the ``export_slot_kv`` pattern), and
+# blocks past the L1 run are probed out of the spill tiers
+# (``_probe_spill`` — host RAM, then the remote store), which is what
+# promotes the per-worker spill tiers into a cluster-servable cache.
+# ---------------------------------------------------------------------------
+
+EXPORT_REQUEST_VERSION = 1
+
+
+def pack_export_request(*, key: str, token_ids: Sequence[int], model_name: str,
+                        block_size: int, int8_kv: bool,
+                        max_blocks: int = 64,
+                        start_block: int = 0) -> bytes:
+    """Wire form of a ``/kv/export`` pull request (msgpack header codec —
+    the same pickle-free framing as every other handoff message).
+    ``start_block``: leading full blocks the puller ALREADY holds — the
+    exporter ships pieces from there, so a partially-warm puller never
+    re-transfers (and the peer never re-gathers) the overlap."""
+    return _pack_header({
+        "v": EXPORT_REQUEST_VERSION,
+        "key": key,
+        "token_ids": [int(t) for t in token_ids],
+        "model_name": model_name,
+        "block_size": int(block_size),
+        "int8_kv": bool(int8_kv),
+        "max_blocks": int(max_blocks),
+        "start_block": max(0, int(start_block)),
+    })
+
+
+def unpack_export_request(raw: bytes) -> Dict[str, Any]:
+    req = _unpack_header(raw)
+    if int(req.get("v") or 0) != EXPORT_REQUEST_VERSION:
+        raise ValueError(
+            f"unsupported kv export request version {req.get('v')!r}"
+        )
+    return req
+
+
+def split_frames(data: bytes) -> List[bytes]:
+    """Split a ``/kv/export`` response body back into its stream messages
+    (the body is ``_frame_blobs(*frames)``; an empty body = no match).
+    Raises on truncation — a peer dying mid-response must surface as a
+    failed pull, never as a silently shorter prefix."""
+    view = memoryview(data)
+    off, out = 0, []
+    while off < len(view):
+        if off + 8 > len(view):
+            raise ValueError(
+                f"truncated kv export response: length prefix cut at "
+                f"offset {off} of {len(view)} bytes"
+            )
+        n = int.from_bytes(view[off:off + 8], "little")
+        if off + 8 + n > len(view):
+            raise ValueError(
+                f"truncated kv export response: {n}-byte frame at offset "
+                f"{off} overruns the {len(view)}-byte body"
+            )
+        out.append(bytes(view[off + 8:off + 8 + n]))
+        off += 8 + n
+    return out
+
+
+def export_prefix_frames(engine: "TPUEngine", token_ids: Sequence[int],
+                         key: str, *, piece_blocks: int = 4,
+                         max_blocks: int = 64, start_block: int = 0,
+                         compress: bool = False) -> Tuple[List[bytes], Dict[str, int]]:
+    """Build the prefix-only begin/piece/commit frames for the longest
+    locally-cached full-block prefix of ``token_ids``.
+
+    ``start_block``: leading full blocks the PULLER already holds — only
+    blocks ``[start_block, n)`` are gathered and shipped (the receiver's
+    own cached blocks satisfy the commit coverage check for the rest), so
+    a partially-warm puller costs transfer proportional to what it is
+    actually missing.
+
+    Returns ``(frames, info)`` where ``info`` counts the shipped blocks by
+    tier (``dev_blocks`` from the device radix, ``spill_blocks`` restored
+    from the host/remote spill tiers). ``frames`` is empty when the peer
+    has nothing beyond ``start_block`` — the caller answers "no match" and
+    the puller recomputes.
+
+    Must run serialized with the engine (the caller holds the engine lock /
+    executor): the gather reads live pool pages and the spill probe mutates
+    LRU state.
+    """
+    import jax.numpy as jnp
+
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        compute_prefix_hash,
+    )
+
+    mgr = engine.manager
+    bs = engine.cfg.block_size
+    empty = {"dev_blocks": 0, "spill_blocks": 0}
+    token_ids = [int(t) for t in token_ids]
+    start = max(0, int(start_block))
+    n_full = min(len(token_ids) // bs, max(0, int(max_blocks)))
+    if n_full <= start or not mgr.enable_prefix_cache:
+        return [], empty
+    prefix = token_ids[: n_full * bs]
+    cached = mgr.radix.match_prefix(prefix)[:n_full]
+    quant = "k_scale" in engine.kv
+
+    ship_dev = cached[start:]       # device blocks actually shipped
+    dev_pages = dev_scales = None
+    if ship_dev:
+        # pad the gather to a bucketed width (block 0 is the reserved pad
+        # block) so XLA compiles O(max_blocks / bucket) gather shapes, not
+        # one per distinct prefix depth — export latency must not eat a
+        # fresh compile on every new depth
+        bucket = 4
+        padded = list(ship_dev) + [0] * (-len(ship_dev) % bucket)
+        ids = jnp.asarray(np.asarray(padded, np.int32))
+        k = np.asarray(engine.kv["k"][:, ids])[:, : len(ship_dev)]
+        v = np.asarray(engine.kv["v"][:, ids])[:, : len(ship_dev)]
+        # → [n, L, 2, Hkv, Bk, D]: the adopt/spill upload layout
+        dev_pages = np.stack([k, v], axis=0).transpose(2, 1, 0, 3, 4, 5)
+        if quant:
+            ks = np.asarray(
+                engine.kv["k_scale"][:, ids]
+            )[:, : len(ship_dev)]
+            vs = np.asarray(
+                engine.kv["v_scale"][:, ids]
+            )[:, : len(ship_dev)]
+            dev_scales = np.stack([ks, vs], axis=0).transpose(2, 1, 0, 3, 4)
+
+    # past the device-resident run: the spill tiers are part of the
+    # cluster cache — a block evicted to host RAM or the remote store is
+    # still servable to a peer (validated for dtype/scale by the probe).
+    # Probe hits are NOT the exporter's own serving traffic: restore the
+    # l2/l3 hit counters so peer demand never skews this worker's cache
+    # panels (promote-on-hit is kept — repeated pulls of the same remote-
+    # tier prefix should get cheaper, and the L2 is a bounded LRU).
+    spill: List[Tuple[np.ndarray, Optional[np.ndarray]]] = []
+    spill_lo = max(len(cached), start)
+    idx = spill_lo
+    st = mgr.stats
+    l2_before, l3_before = st.l2_hits, st.l3_hits
+    try:
+        while idx < n_full:
+            hit = mgr._probe_spill(
+                compute_prefix_hash(prefix, (idx + 1) * bs)
+            )
+            if hit is None:
+                break
+            spill.append(hit)
+            idx += 1
+    finally:
+        st.l2_hits, st.l3_hits = l2_before, l3_before
+    n = idx
+    if n <= start:
+        return [], empty
+
+    def _block(i: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        if i < len(cached):
+            j = i - start
+            return dev_pages[j], (dev_scales[j] if quant else None)
+        page, scale = spill[i - spill_lo]
+        return page, scale
+
+    frames = [_pack_stream(_KIND_BEGIN, {
+        "key": key,
+        "model_name": engine.model_cfg.name,
+        "block_size": bs,
+        "int8_kv": quant,
+        "prefix_only": True,
+        "token_ids": prefix[: n * bs],
+    })]
+    ser = TensorSerializer(compress=compress)
+    pb_step = max(1, int(piece_blocks))
+    for lo in range(start, n, pb_step):
+        hi = min(n, lo + pb_step)
+        pages = np.stack([_block(i)[0] for i in range(lo, hi)], axis=0)
+        pb = ser.serialize(pages)
+        if quant:
+            scales = np.stack(
+                [_block(i)[1] for i in range(lo, hi)], axis=0
+            )
+            frames.append(_pack_stream(
+                _KIND_PIECE,
+                {"key": key, "block_lo": lo, "has_scales": True},
+                _frame_blobs(pb, ser.serialize(scales)),
+            ))
+        else:
+            frames.append(_pack_stream(
+                _KIND_PIECE, {"key": key, "block_lo": lo}, pb
+            ))
+    frames.append(_pack_stream(_KIND_COMMIT, {
+        "key": key, "prefix_only": True, "kv_len": n * bs,
+    }))
+    return frames, {"dev_blocks": len(ship_dev),
+                    "spill_blocks": len(spill)}
+
+
 @dataclass
 class _AdoptSession:
     seq_id: str
@@ -753,6 +967,10 @@ class _AdoptSession:
     blocks: List[int]
     cached_tokens: int
     prompt_len: int
+    # cluster-KV migration: a prefix-only session transfers CACHED prefix
+    # blocks with no live generation attached — its commit releases the
+    # chain into the radix prefix index instead of binding a slot
+    prefix_only: bool = False
     staged: List[int] = field(default_factory=list)
     # last-activity time, refreshed on every piece: a long streamed
     # migration (multi-GB KV at the documented ~4 MB/s tunnel D2H rate)
@@ -816,6 +1034,7 @@ class HandoffReceiver:
             "purged_cap": 0,
             "rx_aborts": 0,
             "commits": 0,
+            "prefix_commits": 0,
             "begin_duplicates": 0,
             "commit_replays": 0,
         }
@@ -868,9 +1087,14 @@ class HandoffReceiver:
                 "(and vice versa)"
             )
         key = meta["key"]
+        prefix_only = bool(meta.get("prefix_only"))
         existing = self._sessions.get(key)
         if existing is not None:
             rid = (meta.get("request") or {}).get("request_id")
+            if prefix_only:
+                # prefix-only sessions carry no request; the key itself is
+                # the idempotency token (pullers mint a fresh key per pull)
+                rid = f"kvmig-{key}"
             if existing.request.request_id == rid:
                 # duplicate delivery (sender retried a begin whose ACK was
                 # lost): the session is already open for the SAME request —
@@ -898,6 +1122,49 @@ class HandoffReceiver:
                 self.stats.get("sessions_purged", 0) + 1
             )
             self.stats["purged_cap"] = self.stats.get("purged_cap", 0) + 1
+        if prefix_only:
+            toks = [int(t) for t in (meta.get("token_ids") or [])]
+            bs = int(meta["block_size"])
+            if not toks or len(toks) % bs != 0:
+                raise ValueError(
+                    "prefix-only handoff needs a whole-block token_ids "
+                    f"prefix (got {len(toks)} tokens, block size {bs})"
+                )
+            if len(toks) // bs > eng.cfg.max_blocks_per_seq or \
+                    len(toks) > eng.cfg.max_seq_len:
+                raise ValueError(
+                    "prefix-only handoff exceeds engine sequence bounds"
+                )
+            request = InferenceRequest(
+                request_id=f"kvmig-{key}",
+                prompt_token_ids=toks,
+                sampling=SamplingParams(max_new_tokens=1),
+            )
+            seq_id = f"{key}-kvmig"
+            # the transfer is NOT a serving request: allocate_sequence
+            # would book the pulled prefix as one giant cache miss and
+            # skew every hit-rate panel/bench — restore the query stats
+            # (block/eviction accounting stays; kv_migrate counters own
+            # the transfer's own observability)
+            st = eng.manager.stats
+            before = (st.prefix_queries, st.prefix_hit_tokens,
+                      st.prefix_total_tokens, st.misses, st.l1_hits)
+            try:
+                blocks, cached_tokens = eng.manager.allocate_sequence(
+                    seq_id, toks
+                )
+            finally:
+                # restore on the failure path too (pool pressure raises
+                # AFTER the query stats were bumped)
+                (st.prefix_queries, st.prefix_hit_tokens,
+                 st.prefix_total_tokens, st.misses, st.l1_hits) = before
+            self._sessions[key] = _AdoptSession(
+                seq_id=seq_id, request=request, block_size=bs,
+                blocks=list(blocks), cached_tokens=cached_tokens,
+                prompt_len=len(toks), prefix_only=True,
+            )
+            return {"kv_cache_key": key, "state": "begun",
+                    "cached_tokens": cached_tokens, "prefix_only": True}
         r = meta["request"]
         request = InferenceRequest(
             request_id=r["request_id"],
@@ -977,6 +1244,38 @@ class HandoffReceiver:
             return {**self._recent_commits[key], "replay": True}
         sess = self._require(key)
         eng = self.engine
+        if sess.prefix_only:
+            # prefix-only commit: no slot to bind — verify coverage, then
+            # release the chain into the radix prefix index so the next
+            # admission of the real request hits L1 instead of re-prefilling
+            cached_blocks = sess.cached_tokens // sess.block_size
+            kv_len = int(meta.get("kv_len") or sess.prompt_len)
+            needed = -(-kv_len // sess.block_size)
+            staged = set(sess.staged)
+            missing = [
+                i for i in range(cached_blocks,
+                                 min(needed, len(sess.blocks)))
+                if sess.blocks[i] not in staged
+            ]
+            if missing:
+                self._drop(key)
+                raise ValueError(
+                    f"prefix handoff {key!r}: commit with unstaged blocks "
+                    f"{missing[:8]}{'...' if len(missing) > 8 else ''} "
+                    f"(piece lost in transit?) — session aborted"
+                )
+            eng.manager.free_sequence(sess.seq_id, cache=True)
+            del self._sessions[key]
+            self.stats["prefix_commits"] = (
+                self.stats.get("prefix_commits", 0) + 1
+            )
+            result = {"kv_cache_key": key, "state": "committed",
+                      "prefix_only": True, "blocks": len(sess.blocks),
+                      "cached_tokens": sess.cached_tokens, "streamed": True}
+            self._recent_commits[key] = result
+            while len(self._recent_commits) > self.MAX_COMMIT_MEMO:
+                self._recent_commits.pop(next(iter(self._recent_commits)))
+            return result
         req = sess.request
         token_ids = list(meta["token_ids"])
         # every block covering the committed KV range must have been staged

@@ -30,7 +30,11 @@ Wire format (versioned — the server rejects unknown versions):
             "add": [[fp, d, t], ...], "del": [fp, ...]}
 
 ``fp`` is a boundary fingerprint, ``d`` its 1-based block depth, ``t`` a
-tier tag (``dev`` | ``host`` | ``spill``).
+tier tag (``dev`` = device-resident, ``host`` = host-RAM spill tier,
+``spill`` = REMOTE-store spill tier). Since round 13 the tag is priced by
+the router's KV-migration cost model (a remote-tier pull costs more than
+a dev-tier one), so workers advertise the tier their evicted KV actually
+landed in, not a blanket demotion.
 """
 
 from __future__ import annotations

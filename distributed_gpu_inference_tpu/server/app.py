@@ -37,7 +37,7 @@ from .admission import (
 )
 from .geo import GeoService
 from .observability import MetricsCollector, StructuredLogger, TracingManager
-from .prefix_routing import PrefixRegistry, RoutingConfig
+from .prefix_routing import PrefixRegistry, RoutingConfig, decide_kv_route
 from .reliability import ReliabilityService
 from .scheduler import (
     _MAX_DISTANCE,
@@ -615,6 +615,11 @@ async def heartbeat(request: web.Request) -> web.Response:
         pd = es.get("pd")
         if isinstance(pd, dict):
             st.metrics.record_pd_engine(worker_id, pd)
+        # cluster-KV migration counters (pull outcomes, export service,
+        # bytes) → kv_migrations_total{outcome} / kv_migration_bytes_total
+        kvmig = es.get("kv_migrate")
+        if isinstance(kvmig, dict):
+            st.metrics.record_kv_migrate_engine(worker_id, kvmig)
         ps = es.get("prefix_summary")
         if ps is not None:
             # cache-aware routing: the worker's advertised radix summary
@@ -1270,6 +1275,61 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
         -headroom[w["id"]],
     ))
     best = cands[0]
+    migrate_hint: Optional[Dict[str, Any]] = None
+    if fps and st.routing.enabled and st.routing.kv_migrate:
+        # cluster-wide KV migration (round 13): a per-request cost model
+        # decides route-to-warm / migrate-KV / recompute instead of
+        # letting a saturated warm worker's cached KV go to waste. The
+        # flag OFF keeps this whole block out — byte-identical round-7
+        # behavior for the A/B.
+        # source eligibility ≠ placement eligibility: a FULLY saturated
+        # BUSY warm worker drops out of ``cands`` (it cannot take the
+        # request) but its data plane can still SERVE the pull — which is
+        # the storm scenario migration exists for. Sources come from every
+        # live worker (minus client-excluded ones); placement stays cands.
+        placeable = {w["id"] for w in cands}
+        sources = {w["id"]: w for w in workers if w["id"] not in exclude}
+        warm_id, warm_blocks, warm_tier = st.prefix_registry.best_match(
+            list(sources), fps, now=now,
+        )
+        choice = "recompute"
+        if warm_id is not None and warm_blocks > 0:
+            decision = decide_kv_route(
+                st.routing, request_blocks=len(fps),
+                matched_blocks=warm_blocks, tier=warm_tier,
+                warm_headroom=headroom[warm_id],
+                cold_headroom=headroom[best["id"]],
+                warm_is_cold=warm_id == best["id"],
+            )
+            choice = decision["choice"]
+            costs = decision["costs"]
+            if choice == "warm" and warm_id not in placeable:
+                # the warm worker cannot take the request itself:
+                # re-arbitrate the two remaining options
+                choice = ("migrate"
+                          if warm_blocks >= st.routing.migrate_min_blocks
+                          and costs["migrate"] <= costs["recompute"]
+                          else "recompute")
+            if choice == "migrate" and \
+                    not sources[warm_id].get("data_plane_url"):
+                # the warm peer cannot serve a pull (no data plane):
+                # re-arbitrate between the two feasible options rather
+                # than hard-falling to recompute past a cheaper warm route
+                choice = ("warm" if warm_id in placeable
+                          and costs["warm"] <= costs["recompute"]
+                          else "recompute")
+            if choice == "warm":
+                best = sources[warm_id]
+            elif choice == "migrate":
+                # the request runs on the score-best (cold) worker, which
+                # pulls the prefix from the warm peer before admission
+                migrate_hint = {
+                    "worker_id": warm_id,
+                    "data_plane_url": sources[warm_id]["data_plane_url"],
+                    "matched_blocks": warm_blocks,
+                    "tier": warm_tier,
+                }
+        st.metrics.record_kv_route_decision("direct", choice)
     if fps and st.routing.enabled:
         chosen_raw = st.prefix_registry.affinity(best["id"], fps, now=now)
         best_raw = st.prefix_registry.best_affinity_among(
@@ -1286,6 +1346,7 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
             "client_region": region,
             **({"prefix_affinity": round(affinity.get(best["id"], 0.0), 4)}
                if affinity else {}),
+            **({"kv_migrate": migrate_hint} if migrate_hint else {}),
         }
     )
 

@@ -96,6 +96,8 @@ class Metrics:
                 "chaos_events",
                 "pd_handoffs", "pd_handoff_bytes", "pd_reprefill",
                 "pd_fleet_balance",
+                "kv_migrations", "kv_migration_bytes",
+                "kv_route_decisions",
                 "admission_decisions", "tenant_admissions",
                 "autoscaler_decisions", "autoscaler_replicas",
                 "autoscaler_slo", "autoscaler_cold_start",
@@ -321,6 +323,25 @@ class Metrics:
             "pd_fleet_balance",
             "Free PD serving capacity by role (prefill/decode slots "
             "available across the registered pool)", ["role"], registry=r)
+        # cluster-wide KV migration (round 13): pulls by outcome (pulled /
+        # aborted mid-pull / fallback_recompute — a rising aborted rate
+        # means the fleet's data planes are flaky; fallback_recompute
+        # rising means budgets/backoffs or peer evictions are eating the
+        # wins), bytes moved by direction, and the router's three-way
+        # decision mix (warm routing collapsing into migrate under load is
+        # the whole point of the feature)
+        self.kv_migrations = Counter(
+            "kv_migrations_total",
+            "Cluster-KV prefix migration pull outcomes per worker",
+            ["worker", "outcome"], registry=r)
+        self.kv_migration_bytes = Counter(
+            "kv_migration_bytes_total",
+            "Bytes moved by cluster-KV prefix migration",
+            ["worker", "direction"], registry=r)
+        self.kv_route_decisions = Counter(
+            "kv_route_decisions_total",
+            "Router cost-model decisions (warm / migrate / recompute)",
+            ["path", "choice"], registry=r)
         # SLO-native overload control (round 12): every rung of the
         # degrade/shed ladder is counted by tier — a brownout panel reads
         # "free degrading, paid accepting" directly from this series, and
@@ -382,6 +403,7 @@ class MetricsCollector:
         self._pressure_prev: Dict[str, Dict[str, int]] = {}
         self._batcher_prev: Dict[str, Dict[str, int]] = {}
         self._pd_prev: Dict[str, Dict[str, int]] = {}
+        self._kvmig_prev: Dict[str, Dict[str, int]] = {}
         # bounded tenant-label admission (insertion-ordered dict as LRU):
         # once full, unseen tenants map to "other" — existing series keep
         # their labels (a label that has emitted samples must not migrate)
@@ -582,6 +604,57 @@ class MetricsCollector:
             if delta > 0:
                 self.metrics.pd_handoff_bytes.labels(worker).inc(delta)
             prev["handoff_bytes"] = cur
+
+    # heartbeat ``engine_stats["kv_migrate"]`` key → outcome label
+    _KVMIG_OUTCOMES = (
+        ("pulled", "pulled"),
+        ("fallback_recompute", "fallback_recompute"),
+        ("aborted", "aborted"),
+        ("local_hits", "local_hit"),
+        ("exports", "export_served"),
+        ("prefix_commits", "prefix_commit"),
+    )
+
+    def record_kv_migrate_engine(self, worker: str,
+                                 stats: Dict[str, Any]) -> None:
+        """Ingest one worker's cluster-KV migration counters (heartbeat
+        ``engine_stats["kv_migrate"]`` — ``TPULLMEngine.
+        kv_migrate_wire_stats()``): pull outcomes into
+        ``kv_migrations_total{outcome}``, bytes into
+        ``kv_migration_bytes_total{direction}``. Same delta anchoring as
+        the spec/pressure/pd payloads: totals re-anchor on engine restart,
+        malformed fields skip the sample."""
+        prev = self._kvmig_prev.setdefault(worker, {})
+        for key, outcome in self._KVMIG_OUTCOMES:
+            if key not in stats:
+                continue
+            try:
+                cur = int(stats.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                continue
+            delta = cur - prev.get(key, 0)
+            if delta > 0:
+                self.metrics.kv_migrations.labels(worker, outcome).inc(delta)
+            prev[key] = cur
+        for key, direction in (("pull_bytes", "pull"),
+                               ("export_bytes", "export")):
+            if key not in stats:
+                continue
+            try:
+                cur = int(stats.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                continue
+            delta = cur - prev.get(key, 0)
+            if delta > 0:
+                self.metrics.kv_migration_bytes.labels(
+                    worker, direction
+                ).inc(delta)
+            prev[key] = cur
+
+    def record_kv_route_decision(self, path: str, choice: str) -> None:
+        """One cost-model route decision on ``path`` (``direct`` discovery
+        or the ``queued`` claim): warm / migrate / recompute."""
+        self.metrics.kv_route_decisions.labels(path, choice).inc()
 
     def record_pd_reprefill(self, reason: str) -> None:
         """One PD flow fell back to re-prefill (stage failure, lost

@@ -36,6 +36,12 @@ from ..utils.prefixes import PREFIX_BLOCK_CHARS, deepest_match
 # spill (restore is an upload) beats a remote spill (restore is a fetch)
 TIER_WEIGHT = {"dev": 1.0, "host": 0.7, "spill": 0.5}
 
+# per-tier transfer-cost multiplier for the MIGRATE decision: a dev-tier
+# pull is one pool gather on the source; a host-tier pull adds the host
+# read; a remote ("spill") tier pull pays the remote fetch + L2 promote
+# before a single byte crosses to the puller
+MIGRATE_TIER_COST = {"dev": 1.0, "host": 1.25, "spill": 1.75}
+
 
 @dataclass
 class RoutingConfig:
@@ -58,36 +64,66 @@ class RoutingConfig:
     block_chars: int = PREFIX_BLOCK_CHARS
     # request fingerprints accepted per job / discovery call
     max_fps_per_request: int = 32
+    # -- cluster-wide KV migration (round 13) -------------------------------
+    # master switch for the per-request route-to-warm / migrate-KV /
+    # recompute cost model. OFF by default: routing behaves byte-identically
+    # to the round-7 advisory scoring (the A/B flip for BENCH_r12)
+    kv_migrate: bool = False
+    # matches shallower than this never migrate (the transfer setup isn't
+    # worth a block or two of saved prefill)
+    migrate_min_blocks: int = 2
+    # cost-model estimates. Fingerprints are text-space, so token counts
+    # are estimated as blocks × block_chars (exact for the byte tokenizer,
+    # advisory for every other — same stance as affinity itself):
+    #   transfer_s  = matched_tokens × bytes_per_token × tier_cost / bw
+    #   prefill_s   = tokens / prefill_tokens_per_s
+    #   queue_s     = (1 − graded headroom) × queue_wait_s
+    # defaults sized for intra-cluster links (≥1 GB/s effective): per
+    # token, transfer (~0.07 ms at 64 KiB/token) undercuts re-prefill
+    # (~0.25 ms at 4k tok/s), so deep matches migrate; a WAN deployment
+    # should push its measured bandwidth here or migration over-fires
+    migrate_bytes_per_token: float = 65536.0
+    migrate_bandwidth_bytes_per_s: float = 1e9
+    migrate_prefill_tokens_per_s: float = 4000.0
+    migrate_queue_wait_s: float = 2.0
 
     def update(self, d: Dict[str, Any]) -> None:
         # validate EVERYTHING before applying ANYTHING: a 400 answer must
         # leave the live config untouched (a half-applied push would flip
         # the A/B switch while reporting failure)
         staged: Dict[str, Any] = {}
-        if d.get("enabled") is not None:
-            v = d["enabled"]
-            if isinstance(v, str):
-                # bool("false") is True — the ONE coercion that would
-                # silently invert the A/B switch for shell/curl callers
-                low = v.strip().lower()
-                if low in ("true", "1", "on"):
-                    v = True
-                elif low in ("false", "0", "off"):
-                    v = False
-                else:
-                    raise ValueError(f"enabled: not a boolean: {v!r}")
-            elif not isinstance(v, bool):
-                raise ValueError(f"enabled: not a boolean: {v!r}")
-            staged["enabled"] = v
+        for flag in ("enabled", "kv_migrate"):
+            if d.get(flag) is not None:
+                v = d[flag]
+                if isinstance(v, str):
+                    # bool("false") is True — the ONE coercion that would
+                    # silently invert an A/B switch for shell/curl callers
+                    low = v.strip().lower()
+                    if low in ("true", "1", "on"):
+                        v = True
+                    elif low in ("false", "0", "off"):
+                        v = False
+                    else:
+                        raise ValueError(f"{flag}: not a boolean: {v!r}")
+                elif not isinstance(v, bool):
+                    raise ValueError(f"{flag}: not a boolean: {v!r}")
+                staged[flag] = v
         for k, lo, hi in (("affinity_weight", 0.0, 10.0),
                           ("min_headroom_factor", 0.0, 1.0),
-                          ("staleness_ttl_s", 1.0, float("inf"))):
+                          ("staleness_ttl_s", 1.0, float("inf")),
+                          ("migrate_bytes_per_token", 1.0, float("inf")),
+                          ("migrate_bandwidth_bytes_per_s", 1.0,
+                           float("inf")),
+                          ("migrate_prefill_tokens_per_s", 1.0,
+                           float("inf")),
+                          ("migrate_queue_wait_s", 0.0, float("inf"))):
             if d.get(k) is not None:
                 v = float(d[k])
                 if not lo <= v <= hi:
                     raise ValueError(f"{k}: {v} outside [{lo}, {hi}]")
                 staged[k] = v
-        for k in ("summary_max_entries", "max_fps_per_request"):
+        for k in ("summary_max_entries", "max_fps_per_request",
+                  "migrate_min_blocks"):
             if d.get(k) is not None:
                 v = int(d[k])
                 if v < 1:
@@ -118,6 +154,14 @@ class RoutingConfig:
             "staleness_ttl_s": self.staleness_ttl_s,
             "block_chars": self.block_chars,
             "max_fps_per_request": self.max_fps_per_request,
+            "kv_migrate": self.kv_migrate,
+            "migrate_min_blocks": self.migrate_min_blocks,
+            "migrate_bytes_per_token": self.migrate_bytes_per_token,
+            "migrate_bandwidth_bytes_per_s":
+                self.migrate_bandwidth_bytes_per_s,
+            "migrate_prefill_tokens_per_s":
+                self.migrate_prefill_tokens_per_s,
+            "migrate_queue_wait_s": self.migrate_queue_wait_s,
         }
 
 
@@ -347,22 +391,32 @@ class PrefixRegistry:
     def enabled(self) -> bool:
         return self.config.enabled
 
+    def _match(self, worker_id: str, fps: Sequence[str],
+               now: Optional[float] = None) -> Tuple[int, str]:
+        """→ (matched_blocks, tier) of the deepest request boundary this
+        worker advertises; (0, "dev") when stale/unknown/no match. The ONE
+        staleness-guarded lookup both scoring and peer selection share."""
+        if not fps:
+            return 0, "dev"
+        ws = self._workers.get(worker_id)
+        if ws is None:
+            return 0, "dev"
+        now = time.time() if now is None else now
+        if now - ws.updated_at > self.config.staleness_ttl_s:
+            return 0, "dev"
+        n = deepest_match(fps, ws.entries)
+        if n <= 0:
+            return 0, "dev"
+        _, tier = ws.entries[fps[n - 1]]
+        return n, tier
+
     def match_blocks(self, worker_id: str, fps: Sequence[str],
                      now: Optional[float] = None) -> Tuple[int, float]:
         """→ (matched_blocks, tier_weight) of the deepest request boundary
         this worker advertises; (0, 0) when stale/unknown/no match."""
-        if not fps:
-            return 0, 0.0
-        ws = self._workers.get(worker_id)
-        if ws is None:
-            return 0, 0.0
-        now = time.time() if now is None else now
-        if now - ws.updated_at > self.config.staleness_ttl_s:
-            return 0, 0.0
-        n = deepest_match(fps, ws.entries)
+        n, tier = self._match(worker_id, fps, now=now)
         if n <= 0:
             return 0, 0.0
-        _, tier = ws.entries[fps[n - 1]]
         return n, TIER_WEIGHT.get(tier, 1.0)
 
     def affinity(self, worker_id: str, fps: Sequence[str],
@@ -386,6 +440,26 @@ class PrefixRegistry:
                 best_w, best_a = wid, a
         return best_w, best_a
 
+    def best_match(self, worker_ids: Sequence[str], fps: Sequence[str],
+                   now: Optional[float] = None
+                   ) -> Tuple[Optional[str], int, str]:
+        """Peer selection for KV migration: the eligible worker advertising
+        the DEEPEST match of ``fps`` → (worker_id, matched_blocks, tier).
+        Depth wins; a warmer tier (dev > host > remote) breaks depth ties —
+        the cost model prices the pull by both. (None, 0, "dev") when
+        nobody matches."""
+        best_w: Optional[str] = None
+        best_n, best_tier = 0, "dev"
+        for wid in worker_ids:
+            n, tier = self._match(wid, fps, now=now)
+            if n <= 0:
+                continue
+            if n > best_n or (n == best_n and
+                              TIER_WEIGHT.get(tier, 0.0)
+                              > TIER_WEIGHT.get(best_tier, 0.0)):
+                best_w, best_n, best_tier = wid, n, tier
+        return best_w, best_n, best_tier
+
     def best_affinity_among(self, worker_ids: Sequence[str],
                             fps: Sequence[str],
                             now: Optional[float] = None) -> float:
@@ -406,3 +480,64 @@ class PrefixRegistry:
             (wid, len(ws.entries), max(0.0, now - ws.updated_at))
             for wid, ws in self._workers.items()
         ]
+
+
+# ---------------------------------------------------------------------------
+# Cluster-wide KV migration: the per-request route cost model (round 13)
+# ---------------------------------------------------------------------------
+
+
+def decide_kv_route(cfg: RoutingConfig, *, request_blocks: int,
+                    matched_blocks: int, tier: str,
+                    warm_headroom: float, cold_headroom: float,
+                    warm_is_cold: bool = False) -> Dict[str, Any]:
+    """Choose route-to-warm / migrate-KV / recompute for ONE request.
+
+    Inputs are the router's estimates: ``request_blocks`` = the request's
+    routable prefix depth (its fingerprint count), ``matched_blocks`` +
+    ``tier`` = the warmest eligible worker's advertised match
+    (:meth:`PrefixRegistry.best_match`), and the two graded load headrooms
+    ([0, 1] — 1 = idle) of that warm worker and of the load/region-best
+    "cold" candidate. Costs (seconds, estimated):
+
+    - warm:      wait(warm) + prefill(unmatched)          — PR 7's choice
+    - migrate:   wait(cold) + transfer(matched, tier) + prefill(unmatched)
+    - recompute: wait(cold) + prefill(all)
+
+    The decision is advisory, exactly like affinity: a wrong estimate
+    costs latency, never correctness (the worker-side pull falls back to
+    recompute on any failure). Returns ``{"choice", "costs"}``;
+    ``warm_is_cold`` (the score-best candidate IS the warm worker) and
+    too-shallow matches short-circuit to warm/recompute."""
+    bc = max(1, cfg.block_chars)
+    total_tokens = max(request_blocks, matched_blocks, 1) * bc
+    matched_tokens = max(0, matched_blocks) * bc
+
+    def _wait(headroom: float) -> float:
+        return (1.0 - max(0.0, min(1.0, headroom))) * cfg.migrate_queue_wait_s
+
+    def _prefill(tokens: float) -> float:
+        return max(0.0, tokens) / cfg.migrate_prefill_tokens_per_s
+
+    costs = {
+        "warm": _wait(warm_headroom) + _prefill(total_tokens
+                                                - matched_tokens),
+        "migrate": (
+            _wait(cold_headroom) + _prefill(total_tokens - matched_tokens)
+            + (matched_tokens * cfg.migrate_bytes_per_token
+               * MIGRATE_TIER_COST.get(tier, 1.0)
+               / cfg.migrate_bandwidth_bytes_per_s)
+        ),
+        "recompute": _wait(cold_headroom) + _prefill(total_tokens),
+    }
+    if matched_blocks <= 0:
+        return {"choice": "recompute", "costs": costs}
+    if warm_is_cold:
+        # the load/region-best candidate already holds the KV: nothing to
+        # move, nothing to trade off
+        return {"choice": "warm", "costs": costs}
+    eligible = ["warm", "recompute"]
+    if matched_blocks >= cfg.migrate_min_blocks:
+        eligible.append("migrate")
+    choice = min(eligible, key=lambda c: costs[c])
+    return {"choice": choice, "costs": costs}

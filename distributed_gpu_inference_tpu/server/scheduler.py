@@ -237,6 +237,7 @@ class SmartScheduler:
             region=w.get("region"),
             prefer=prefer,
         )
+        cands: Optional[List[Dict[str, Any]]] = None
         if job is not None:
             await self._store.update_worker(
                 worker_id, current_job_id=job["id"], status=WorkerState.BUSY.value
@@ -263,7 +264,68 @@ class SmartScheduler:
                         "queued", hit=aff > 0.0,
                         spillover=best > aff,
                     )
+        if job is not None and reg is not None and reg.enabled and \
+                reg.config.kv_migrate:
+            await self._maybe_stamp_migration(worker_id, job, cands=cands)
         return job
+
+    async def _maybe_stamp_migration(self, worker_id: str,
+                                     job: Dict[str, Any],
+                                     cands: Optional[List[Dict[str, Any]]]
+                                     = None) -> None:
+        """Cluster-wide KV migration on the claim path: the claiming
+        worker is FIXED (route-to-warm is off the table once the claim
+        lands), so the cost model only arbitrates migrate-KV vs recompute
+        — when this worker is cold for the job's prefix but a live peer
+        advertises a deep match and the estimated transfer beats the
+        re-prefill, the handed-out job carries a ``kv_migrate_from`` hint
+        (in-memory only: a requeue re-decides against fresh summaries).
+        Counted per decision in ``kv_route_decisions_total{path="queued"}``."""
+        from .prefix_routing import decide_kv_route
+
+        reg = self._prefix_registry
+        fps = self._job_fps(job)
+        if not fps:
+            return
+        choice = "recompute"
+        if reg.affinity(worker_id, fps) > 0.0:
+            choice = "warm"   # claim preference already landed it warm
+        else:
+            if cands is None:
+                # the spillover-metrics block usually just fetched this
+                # exact list — reuse it instead of a second worker-table
+                # scan inside the claim hot path
+                cands = await self._store.list_workers(
+                    status=[WorkerState.IDLE.value, WorkerState.BUSY.value],
+                    supports_type=job.get("type"),
+                )
+            by_id = {c["id"]: c for c in cands if c["id"] != worker_id}
+            warm_id, blocks, tier = reg.best_match(list(by_id), fps)
+            if warm_id is not None and \
+                    blocks >= reg.config.migrate_min_blocks and \
+                    by_id[warm_id].get("data_plane_url") and \
+                    isinstance(job.get("params"), dict):
+                me = next((c for c in cands if c["id"] == worker_id), None)
+                cold_head = graded_load_score(me) if me is not None else 1.0
+                decision = decide_kv_route(
+                    reg.config, request_blocks=len(fps),
+                    matched_blocks=blocks, tier=tier,
+                    warm_headroom=graded_load_score(by_id[warm_id]),
+                    cold_headroom=cold_head,
+                )
+                # wait(cold) appears in both remaining costs, so this is
+                # exactly "transfer beats the saved prefill"
+                if decision["costs"]["migrate"] < \
+                        decision["costs"]["recompute"]:
+                    choice = "migrate"
+                    job["params"]["kv_migrate_from"] = {
+                        "worker_id": warm_id,
+                        "data_plane_url": by_id[warm_id]["data_plane_url"],
+                        "matched_blocks": blocks,
+                        "tier": tier,
+                    }
+        if self._metrics is not None:
+            self._metrics.record_kv_route_decision("queued", choice)
 
     # -- queue stats (reference scheduler.py:236-280) ------------------------
 

@@ -233,6 +233,7 @@ class FleetWorker:
             self.pd_plane = DataPlaneServer(
                 _PDReceiverShim(llm), host="127.0.0.1", port=0,
                 kv_receiver=llm.kv_receiver,
+                kv_exporter=getattr(llm, "kv_export", None),
             )
             self.pd_plane.start()
             info["data_plane_url"] = (

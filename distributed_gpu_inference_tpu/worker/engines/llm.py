@@ -34,7 +34,7 @@ from ...runtime.batcher import (
 from ...testing import faults as _faults
 from ...utils.backoff import full_jitter_delay
 from ...runtime.engine import EngineConfig, PreemptedSequence, TPUEngine
-from ...runtime.prefix_summary import TIER_HOST, PrefixHotSet
+from ...runtime.prefix_summary import TIER_HOST, TIER_SPILL, PrefixHotSet
 from ...utils.config import ServingConfig
 from ...utils.data_structures import InferenceRequest, SamplingParams
 from .base import (
@@ -352,6 +352,41 @@ class TPULLMEngine(LLMBaseEngine):
             PrefixHotSet(top_n) if top_n > 0 else None
         )
         self._prefix_evictions_seen = 0
+        # cluster-wide KV migration (round 13): pull a hot prefix from a
+        # peer's /kv/export instead of re-prefilling, and serve peers'
+        # pulls from our own radix + spill tiers. Worker-side default ON;
+        # whether any request actually migrates is the ROUTER's per-request
+        # cost-model decision (RoutingConfig.kv_migrate, default off).
+        self.kv_migrate_enabled = bool(self.config.get("kv_migrate", True))
+        self._kvmig_max_blocks = int(
+            self.config.get("kv_migrate_max_blocks", 64) or 64
+        )
+        self._kvmig_timeout_s = float(
+            self.config.get("kv_migrate_pull_timeout_s", 20.0) or 20.0
+        )
+        # migration budget: concurrent pulls beyond this recompute instead
+        # of stacking network reads (a migrate-hint storm must degrade to
+        # PR 7 behavior, never amplify the overload that caused it)
+        self._kvmig_budget = int(self.config.get("kv_migrate_budget", 2) or 2)
+        self._kvmig_backoff_s = float(
+            self.config.get("kv_migrate_backoff_s", 1.0) or 1.0
+        )
+        self._kvmig_lock = threading.Lock()
+        self._kvmig_inflight = 0
+        # peer url → (consecutive failures, monotonic deadline): after a
+        # failed pull the peer is skipped under jittered exponential
+        # backoff — the PD re-prefill contract shape (first failure falls
+        # back immediately, repeats spread past the outage)
+        self._kvmig_backoff: Dict[str, tuple] = {}
+        self._kvmig_rng = random.Random(0x5CAF)
+        # cumulative counters → heartbeat engine_stats["kv_migrate"] →
+        # kv_migrations_total{outcome} / kv_migration_bytes_total
+        self.kv_migrate_stats: Dict[str, int] = {
+            "pulled": 0, "fallback_recompute": 0, "aborted": 0,
+            "local_hits": 0,
+            "pull_bytes": 0, "pull_blocks": 0,
+            "exports": 0, "export_bytes": 0,
+        }
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -603,9 +638,15 @@ class TPULLMEngine(LLMBaseEngine):
             if delta > 0 and len(hot):
                 frac = min(1.0, delta / len(hot))
                 if eng.manager.spill_on_evict:
-                    # evicted blocks landed in the spill tier: restorable,
-                    # but pricier than device-resident — demote the weight
-                    hot.demote(frac, tier=TIER_HOST)
+                    # evicted blocks landed in a spill tier: restorable,
+                    # but pricier than device-resident — demote to the
+                    # tier they ACTUALLY landed in, so the router's cost
+                    # model prices a host-RAM pull vs a remote-store one
+                    # (host wins when both exist: spill writes through L2
+                    # first and probes hit it first)
+                    tier = (TIER_HOST if eng.manager.host_store is not None
+                            else TIER_SPILL)
+                    hot.demote(frac, tier=tier)
                 else:
                     # no spill tier: evicted KV is GONE — advertising it
                     # at any weight would over-promise for a full TTL
@@ -686,10 +727,28 @@ class TPULLMEngine(LLMBaseEngine):
             ignore_eos=cfg.ignore_eos,
         )
 
+    def _encode_prompt(self, prompt_or_messages: Any,
+                       cfg: GenerationConfig) -> List[int]:
+        """THE prompt → token-ids mapping (template, tokenize, truncate)
+        shared by request building and the KV-migration pull driver — the
+        pulled prefix must key on exactly the tokens the admission will
+        probe with."""
+        text = self._to_prompt(prompt_or_messages)
+        token_ids = list(self.tokenizer.encode(text))
+        max_prompt = self.engine.cfg.max_seq_len - cfg.max_new_tokens - 1
+        if len(token_ids) > max_prompt > 0:
+            token_ids = token_ids[-max_prompt:]  # keep the tail (recency)
+        return token_ids
+
     def _build_request(self, prompt_or_messages: Any,
-                       cfg: GenerationConfig) -> InferenceRequest:
+                       cfg: GenerationConfig,
+                       token_ids: Optional[List[int]] = None
+                       ) -> InferenceRequest:
         """One request builder for the blocking AND streaming paths — the
-        two must never diverge on tokenization/truncation/sampling."""
+        two must never diverge on tokenization/truncation/sampling.
+        ``token_ids``: pre-encoded prompt (the KV-migration pull driver
+        already ran ``_encode_prompt`` on the same inputs — hinted
+        requests must not pay template+tokenize twice)."""
         if not self.loaded or self.engine is None:
             raise EngineLoadError("engine not loaded")
         hot = self.prefix_hot   # snapshot: the heartbeat thread may
@@ -699,11 +758,8 @@ class TPULLMEngine(LLMBaseEngine):
             # completion — record its boundary fingerprints for the
             # heartbeat summary (advisory; one O(prefix) hash pass)
             hot.note(prompt_or_messages)
-        text = self._to_prompt(prompt_or_messages)
-        token_ids = list(self.tokenizer.encode(text))
-        max_prompt = self.engine.cfg.max_seq_len - cfg.max_new_tokens - 1
-        if len(token_ids) > max_prompt > 0:
-            token_ids = token_ids[-max_prompt:]  # keep the tail (recency)
+        if token_ids is None:
+            token_ids = self._encode_prompt(prompt_or_messages, cfg)
         return InferenceRequest(
             prompt_token_ids=token_ids,
             sampling=self._sampling_from(cfg),
@@ -725,6 +781,12 @@ class TPULLMEngine(LLMBaseEngine):
         stage = params.get("pd_stage")
         if stage == "prefill":
             return self.pd_prefill(params)
+        if stage is None:
+            # router-hinted KV migration: pull the hot prefix from the
+            # named peer BEFORE admission (never under the engine lock —
+            # the peer's export serializes on ITS engine; ours adopts the
+            # frames through kv_receiver's own serialization)
+            self._maybe_migrate_kv(params)
         if self.serving is not None and self.serving.active:
             # batcher-backed serving: the batcher owns engine serialization
             # (every engine call runs on its one executor thread), so
@@ -754,7 +816,8 @@ class TPULLMEngine(LLMBaseEngine):
         decode rounds via slot-level continuous batching."""
         cfg = GenerationConfig.from_params(params)
         req = self._build_request(
-            params.get("messages") or params.get("prompt") or "", cfg
+            params.get("messages") or params.get("prompt") or "", cfg,
+            token_ids=params.pop("_kvmig_token_ids", None),
         )
         if params.get("priority") is not None:
             req.priority = int(params.get("priority") or 0)
@@ -1255,6 +1318,244 @@ class TPULLMEngine(LLMBaseEngine):
                 )
         return result
 
+    # -- cluster-wide KV migration (round 13) --------------------------------
+
+    def kv_export(self, raw: bytes) -> bytes:
+        """Data-plane ``/kv/export`` hook: a cold peer asks for the longest
+        locally-cached full-block prefix of its request's token ids. The
+        answer is a framed sequence of the SAME chaos-hardened streamed
+        handoff messages the ``/kv/transfer`` push path uses (prefix-only
+        begin/piece/commit — ``runtime.kv_handoff.export_prefix_frames``),
+        sourced from the device radix AND the host/remote spill tiers. An
+        empty body means "nothing cached" and the peer recomputes."""
+        from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+            _frame_blobs,
+            export_prefix_frames,
+            unpack_export_request,
+        )
+
+        if not self.loaded or self.engine is None:
+            raise EngineLoadError("engine not loaded")
+        if not self.kv_migrate_enabled:
+            raise ValueError("kv migration disabled on this worker")
+        req = unpack_export_request(raw)
+        eng = self.engine
+        if req.get("model_name") != eng.model_cfg.name:
+            raise ValueError(
+                f"model mismatch: engine={eng.model_cfg.name} "
+                f"request={req.get('model_name')}"
+            )
+        if int(req.get("block_size") or 0) != eng.cfg.block_size:
+            raise ValueError("block_size mismatch between engines")
+        if bool(req.get("int8_kv")) != ("k_scale" in eng.kv):
+            raise ValueError(
+                "kv_cache_dtype mismatch: int8 pools can only export to "
+                "int8 pools (and vice versa)"
+            )
+        max_blocks = min(
+            self._kvmig_max_blocks, int(req.get("max_blocks") or 64)
+        )
+        with self._engine_lock:
+            frames, info = self._exclusive(lambda: export_prefix_frames(
+                eng, req.get("token_ids") or [], str(req.get("key") or ""),
+                max_blocks=max_blocks,
+                start_block=int(req.get("start_block") or 0),
+            ))
+        body = _frame_blobs(*frames) if frames else b""
+        if frames:
+            self.kv_migrate_stats["exports"] += 1
+            self.kv_migrate_stats["export_bytes"] += len(body)
+        return body
+
+    def _kvmig_peer_allowed(self, url: str) -> bool:
+        """Budget + per-peer backoff gate (taken together under one lock):
+        a pull is only attempted when the concurrent-pull budget has room
+        AND the peer is not inside a failure backoff window."""
+        with self._kvmig_lock:
+            _, until = self._kvmig_backoff.get(url, (0, 0.0))
+            if time.monotonic() < until or \
+                    self._kvmig_inflight >= self._kvmig_budget:
+                return False
+            self._kvmig_inflight += 1
+            return True
+
+    # a peer that REJECTED a pull (4xx: model/dtype/geometry mismatch or
+    # migration disabled) is pinned out for this long — retrying a
+    # permanent incompatibility after every backoff window would burn an
+    # HTTP round-trip per hinted request forever
+    _KVMIG_REJECT_PIN_S = 600.0
+
+    def _kvmig_peer_result(self, url: str, ok: bool,
+                           permanent: bool = False) -> None:
+        with self._kvmig_lock:
+            self._kvmig_inflight = max(0, self._kvmig_inflight - 1)
+            if ok:
+                self._kvmig_backoff.pop(url, None)
+                return
+            fails, _ = self._kvmig_backoff.get(url, (0, 0.0))
+            fails += 1
+            if permanent:
+                self._kvmig_backoff[url] = (
+                    fails, time.monotonic() + self._KVMIG_REJECT_PIN_S
+                )
+                return
+            # PD re-prefill shape: the FIRST failure only falls back (no
+            # wait — the request recomputes immediately); repeats arm a
+            # jittered exponential window so a storm of hinted requests
+            # doesn't hammer a dead peer
+            delay = full_jitter_delay(
+                self._kvmig_backoff_s, fails - 1, self._kvmig_rng
+            ) if fails > 1 else 0.0
+            self._kvmig_backoff[url] = (fails, time.monotonic() + delay)
+
+    def _maybe_migrate_kv(self, params: Dict[str, Any]) -> None:
+        """Honor a router ``kv_migrate_from`` hint: pull the hot prefix
+        from the named peer BEFORE admission, landing it in our radix so
+        the ragged prefill that follows reuses it. Every failure mode —
+        peer dead mid-pull, corrupt piece, budget/backoff, no match —
+        falls back to a plain recompute; a migration can never fail the
+        request (counted: pulled / aborted / fallback_recompute)."""
+        # never trust an inbound stash (the key is worker-internal: the
+        # admission reuses the token ids THIS method encodes)
+        params.pop("_kvmig_token_ids", None)
+        hint = params.get("kv_migrate_from")
+        if not isinstance(hint, dict):
+            return
+        url = str(hint.get("data_plane_url") or "").rstrip("/")
+        stats = self.kv_migrate_stats
+        if not url or not self.kv_migrate_enabled or not self.loaded \
+                or self.engine is None \
+                or not self.engine.cfg.enable_prefix_cache:
+            stats["fallback_recompute"] += 1
+            return
+        if not self._kvmig_peer_allowed(url):
+            stats["fallback_recompute"] += 1
+            return
+        import uuid as _uuid
+
+        from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+            abort_message,
+            pack_export_request,
+            split_frames,
+        )
+
+        eng = self.engine
+        key = f"kvmig-{_uuid.uuid4().hex[:12]}"
+        begun = False
+        try:
+            cfg = GenerationConfig.from_params(params)
+            token_ids = self._encode_prompt(
+                params.get("messages") or params.get("prompt") or "", cfg
+            )
+            # hand the encode to the admission that follows (the request
+            # builder skips its own template+tokenize pass)
+            params["_kvmig_token_ids"] = token_ids
+            if len(token_ids) < eng.cfg.block_size:
+                stats["fallback_recompute"] += 1
+                self._kvmig_peer_result(url, ok=True)
+                return
+            # already warm locally? The router hints until OUR summary
+            # advertises the prefix (a heartbeat cadence away — 30 s in
+            # production), and a storm means MANY hinted requests for one
+            # prefix: re-pulling what the first pull landed would
+            # re-transfer the whole prefix per request and stall the warm
+            # peer's decode rounds under its export executor. Probe the
+            # local radix first (serialized like any engine read) and skip
+            # when it already covers the request's full-block prefix (the
+            # final block is forgone at worst — admission's
+            # keep-one-token-fresh rule usually recomputes it anyway).
+            bs = eng.cfg.block_size
+            n_full = len(token_ids) // bs
+
+            def _local_depth() -> int:
+                return len(eng.manager.radix.match_prefix(token_ids))
+
+            with self._engine_lock:
+                local = self._exclusive(_local_depth)
+            if local >= max(1, n_full - 1):
+                stats["local_hits"] += 1
+                self._kvmig_peer_result(url, ok=True)
+                return
+            req_raw = pack_export_request(
+                key=key, token_ids=token_ids,
+                model_name=eng.model_cfg.name,
+                block_size=eng.cfg.block_size,
+                int8_kv="k_scale" in eng.kv,
+                max_blocks=self._kvmig_max_blocks,
+                # the peer ships only what we are missing — our cached
+                # leading blocks satisfy the commit coverage check locally
+                start_block=local,
+            )
+            r = _faults.wrap_http(
+                "worker.kv.pull",
+                lambda: httpx.post(
+                    url + "/kv/export", content=req_raw,
+                    headers={"content-type": "application/octet-stream"},
+                    timeout=self._kvmig_timeout_s,
+                ),
+                worker=str(getattr(self, "fault_tag", "") or ""),
+            )
+            r.raise_for_status()
+            frames = split_frames(r.content)
+            if not frames:
+                # peer has nothing cached (evicted since the router's
+                # summary): an honest miss, not a peer failure
+                stats["fallback_recompute"] += 1
+                self._kvmig_peer_result(url, ok=True)
+                return
+            committed = None
+            for frame in frames:
+                # each frame runs through our own HandoffReceiver (via
+                # kv_receiver — the chaos seam, duplicate tolerance, and
+                # corrupt-piece session aborts all apply to pulls too)
+                begun = True
+                res = self.kv_receiver(frame)
+                if res.get("state") == "committed":
+                    committed = res
+            if committed is None:
+                raise ValueError("kv export response ended without commit")
+            stats["pulled"] += 1
+            # blocks the pull actually DELIVERED: the session chain minus
+            # what our own cache already covered (partial-overlap pulls
+            # ship only the missing tail)
+            stats["pull_blocks"] += max(0, int(committed.get("blocks") or 0)
+                                        - (int(committed.get("cached_tokens")
+                                               or 0)
+                                           // eng.cfg.block_size))
+            stats["pull_bytes"] += sum(len(f) for f in frames)
+            self._kvmig_peer_result(url, ok=True)
+        except Exception as exc:  # noqa: BLE001 — migration is best-effort
+            stats["aborted"] += 1
+            # a 4xx is the peer REJECTING the pull (incompatible engine,
+            # migration disabled) — pin it out instead of re-knocking
+            # after every backoff window (mirrors _pd_push's no-retry-4xx)
+            permanent = (
+                isinstance(exc, httpx.HTTPStatusError)
+                and exc.response is not None
+                and 400 <= exc.response.status_code < 500
+            )
+            self._kvmig_peer_result(url, ok=False, permanent=permanent)
+            if begun:
+                # drop a half-built session NOW instead of letting it pin
+                # blocks until the receiver's TTL purge
+                try:
+                    self.kv_receiver(abort_message(key))
+                except Exception:  # noqa: BLE001 — abort is best-effort
+                    pass
+
+    def kv_migrate_wire_stats(self) -> Optional[Dict[str, int]]:
+        """Cumulative KV-migration counters (pull outcomes + export
+        service) — heartbeat ``engine_stats["kv_migrate"]``, delta-anchored
+        into ``kv_migrations_total{outcome}`` / ``kv_migration_bytes_total``
+        on the control plane. None when this engine never migrated."""
+        out = {k: int(v) for k, v in self.kv_migrate_stats.items() if v}
+        rx = self._handoff_rx
+        if rx is not None:
+            v = int(rx.stats.get("prefix_commits", 0) or 0)
+            if v:
+                out["prefix_commits"] = v
+        return out or None
+
     # -- crash-safe generation: live checkpoints + resumable drivers --------
 
     @property
@@ -1417,7 +1718,8 @@ class TPULLMEngine(LLMBaseEngine):
             request_id = pre.request.request_id
         else:
             req = self._build_request(
-                params.get("messages") or params.get("prompt") or "", cfg
+                params.get("messages") or params.get("prompt") or "", cfg,
+                token_ids=params.pop("_kvmig_token_ids", None),
             )
             slot = eng.submit(req)
             request_id = req.request_id
@@ -1473,7 +1775,8 @@ class TPULLMEngine(LLMBaseEngine):
             req = pre.request
         else:
             req = self._build_request(
-                params.get("messages") or params.get("prompt") or "", cfg
+                params.get("messages") or params.get("prompt") or "", cfg,
+                token_ids=params.pop("_kvmig_token_ids", None),
             )
             if params.get("priority") is not None:
                 req.priority = int(params.get("priority") or 0)
@@ -1605,6 +1908,7 @@ class TPULLMEngine(LLMBaseEngine):
         (``serving.mode: direct``). Both emit the same chunk contract:
         ``{"text_delta", "token_ids", "offset"}...`` then a final
         ``{"done": True, "finish_reason", "usage", "offset"}``."""
+        self._maybe_migrate_kv(params)
         if self.serving is not None and self.serving.active:
             return self._stream_serving(params, cancel=cancel)
         return self._stream_direct(params, cancel=cancel)
@@ -1693,7 +1997,8 @@ class TPULLMEngine(LLMBaseEngine):
             req = pre.request
         else:
             req = self._build_request(
-                params.get("messages") or params.get("prompt") or "", cfg
+                params.get("messages") or params.get("prompt") or "", cfg,
+                token_ids=params.pop("_kvmig_token_ids", None),
             )
             if params.get("priority") is not None:
                 req.priority = int(params.get("priority") or 0)
@@ -1863,7 +2168,8 @@ class TPULLMEngine(LLMBaseEngine):
             request_id = pre.request.request_id
         else:
             req = self._build_request(
-                params.get("messages") or params.get("prompt") or "", cfg
+                params.get("messages") or params.get("prompt") or "", cfg,
+                token_ids=params.pop("_kvmig_token_ids", None),
             )
             slot = eng.submit(req)
             request_id = req.request_id

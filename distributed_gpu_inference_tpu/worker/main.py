@@ -402,6 +402,26 @@ class Worker:
                 out[k] = out.get(k, 0) + int(v)
         return out or None
 
+    def _kv_migrate_engine_stats(self) -> Optional[Dict[str, Any]]:
+        """Cluster-KV migration counters of every loaded engine (pull
+        outcomes, export service, bytes) — nested under heartbeat
+        ``engine_stats["kv_migrate"]`` so the control plane's ``/metrics``
+        surfaces ``kv_migrations_total{outcome}`` and
+        ``kv_migration_bytes_total`` per worker. None when nothing ever
+        migrated (payload stays lean)."""
+        out: Dict[str, int] = {}
+        for eng in self.engines.values():
+            fn = getattr(eng, "kv_migrate_wire_stats", None)
+            if fn is None:
+                continue
+            try:
+                s = fn()
+            except Exception:  # noqa: BLE001 — never break the heartbeat
+                continue
+            for k, v in (s or {}).items():
+                out[k] = out.get(k, 0) + int(v)
+        return out or None
+
     def _batcher_stats(self) -> Optional[Dict[str, Any]]:
         """Live batcher serving stats of every batcher-backed engine
         (occupancy, queue depth, chunked admissions, preemption counters)
@@ -499,6 +519,9 @@ class Worker:
             pd_stats = self._pd_engine_stats()
             if pd_stats:
                 engine_stats["pd"] = pd_stats
+            kvmig_stats = self._kv_migrate_engine_stats()
+            if kvmig_stats:
+                engine_stats["kv_migrate"] = kvmig_stats
             summary = self._prefix_summary_payload()
             if summary is not None:
                 # radix summary (full or delta) for cache-aware routing;
@@ -989,6 +1012,7 @@ class Worker:
             self._pd_plane = DataPlaneServer(
                 _PDReceiverShim(llm_eng), port=port,
                 kv_receiver=llm_eng.kv_receiver,
+                kv_exporter=getattr(llm_eng, "kv_export", None),
             )
             self._pd_plane.start()
         self.state = WorkerState.IDLE

@@ -32,6 +32,8 @@ HEAVY = [
     #   ragged-vs-split byte-identity serving runs (multiple engines)
     "tests/test_prefix_routing.py",      # two-engine e2e routing runs
     #   behind a live control plane (byte-identity ON/OFF)
+    "tests/test_kv_migration.py",        # cluster-KV migration: engine-
+    #   pair pull e2e + seeded source-kill/corruption chaos runs
     "tests/test_parallel_pipeline.py",
     "tests/test_parallel_ring_attention.py",
     "tests/test_spec_serving.py",        # spec x ragged x int8 identity

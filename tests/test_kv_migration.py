@@ -1,0 +1,835 @@
+"""Cluster-wide KV migration (round 13).
+
+Layers under test, bottom-up:
+
+- the router cost model (``server/prefix_routing.py`` ``decide_kv_route``):
+  decision flips at the bytes/FLOPs/queue-wait boundaries, the
+  ``migrate_min_blocks`` floor, tier penalties, and the config surface
+  (validation, defaults-OFF legacy identity)
+- peer selection (``PrefixRegistry.best_match``: depth wins, tier breaks
+  ties)
+- the prefix-only export/adopt protocol (``runtime/kv_handoff.py``):
+  export request codec, frame splitting, engine-pair round trip with
+  byte-identical continuation, spill-tier-sourced exports, corrupt-piece
+  session aborts with zero leaked blocks
+- the worker pull driver (``worker/engines/llm.py`` ``_maybe_migrate_kv``):
+  budget/backoff gates, dead-peer fallback-to-recompute, outcome counting
+- claim-path stamping (``server/scheduler.py``) and the /metrics delta
+  anchoring (``kv_migrations_total`` / ``kv_migration_bytes_total``)
+- e2e: two live engines with real data planes behind a real control
+  plane — the ``/jobs/direct/nearest`` cost model hands out a migrate
+  hint when the warm worker is saturated, the cold worker pulls, and
+  greedy outputs stay byte-identical to the warm worker's
+- chaos: seeded frame corruption + mid-run source death — every request
+  still completes with identical text (fallback to recompute, never a
+  client error)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+import pytest
+
+from distributed_gpu_inference_tpu.server.observability import (
+    MetricsCollector,
+)
+from distributed_gpu_inference_tpu.server.prefix_routing import (
+    MIGRATE_TIER_COST,
+    PrefixRegistry,
+    RoutingConfig,
+    decide_kv_route,
+)
+from distributed_gpu_inference_tpu.utils.prefixes import (
+    PREFIX_BLOCK_CHARS,
+    prefix_fingerprints,
+)
+
+pytestmark = pytest.mark.kv_migrate
+
+
+# ---------------------------------------------------------------------------
+# cost model (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**kw: Any) -> RoutingConfig:
+    cfg = RoutingConfig(kv_migrate=True)
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return cfg
+
+
+def test_decide_warm_when_warm_has_headroom():
+    d = decide_kv_route(_cfg(), request_blocks=8, matched_blocks=6,
+                        tier="dev", warm_headroom=1.0, cold_headroom=1.0)
+    assert d["choice"] == "warm"
+    assert d["costs"]["warm"] < d["costs"]["migrate"]
+
+
+def test_decide_migrate_when_warm_saturated():
+    d = decide_kv_route(_cfg(), request_blocks=8, matched_blocks=6,
+                        tier="dev", warm_headroom=0.0, cold_headroom=1.0)
+    assert d["choice"] == "migrate"
+
+
+def test_decide_flips_to_recompute_on_slow_link():
+    # same saturation, but the estimated link is so slow that moving the
+    # KV costs more than recomputing it — the bytes-vs-FLOPs boundary
+    d = decide_kv_route(
+        _cfg(migrate_bandwidth_bytes_per_s=1e6),
+        request_blocks=8, matched_blocks=6, tier="dev",
+        warm_headroom=0.0, cold_headroom=1.0,
+    )
+    assert d["choice"] == "recompute"
+
+
+def test_decide_flips_at_queue_wait_boundary():
+    # warm queue wait is the ONLY thing separating warm from migrate for
+    # a deep match: sweep headroom and the decision must flip exactly once
+    choices = [
+        decide_kv_route(_cfg(), request_blocks=8, matched_blocks=8,
+                        tier="dev", warm_headroom=h, cold_headroom=1.0
+                        )["choice"]
+        for h in (0.0, 0.25, 0.5, 0.75, 1.0)
+    ]
+    assert choices[0] == "migrate" and choices[-1] == "warm"
+    flips = sum(1 for a, b in zip(choices, choices[1:]) if a != b)
+    assert flips == 1
+
+
+def test_decide_min_blocks_floor():
+    cfg = _cfg(migrate_min_blocks=4)
+    # shallow match: migrate ineligible even though it would price lower
+    d = decide_kv_route(cfg, request_blocks=8, matched_blocks=3,
+                        tier="dev", warm_headroom=0.0, cold_headroom=1.0)
+    assert d["choice"] == "recompute"
+    d = decide_kv_route(cfg, request_blocks=8, matched_blocks=4,
+                        tier="dev", warm_headroom=0.0, cold_headroom=1.0)
+    assert d["choice"] == "migrate"
+
+
+def test_decide_tier_penalty_can_flip():
+    # a config tuned so a dev-tier pull just beats recompute: the remote
+    # ("spill") tier penalty pushes the same match past it
+    cfg = _cfg(migrate_bytes_per_token=65536.0,
+               migrate_bandwidth_bytes_per_s=65536.0
+               / (1.0 / 4000.0) * 1.1)   # transfer ≈ 0.91x prefill
+    dev = decide_kv_route(cfg, request_blocks=8, matched_blocks=8,
+                          tier="dev", warm_headroom=0.0, cold_headroom=1.0)
+    spill = decide_kv_route(cfg, request_blocks=8, matched_blocks=8,
+                            tier="spill", warm_headroom=0.0,
+                            cold_headroom=1.0)
+    assert dev["choice"] == "migrate"
+    assert spill["choice"] == "recompute"
+    assert MIGRATE_TIER_COST["spill"] > MIGRATE_TIER_COST["dev"]
+
+
+def test_decide_warm_is_cold_short_circuits():
+    d = decide_kv_route(_cfg(), request_blocks=8, matched_blocks=6,
+                        tier="dev", warm_headroom=0.0, cold_headroom=0.0,
+                        warm_is_cold=True)
+    assert d["choice"] == "warm"
+
+
+def test_decide_no_match_recomputes():
+    d = decide_kv_route(_cfg(), request_blocks=8, matched_blocks=0,
+                        tier="dev", warm_headroom=1.0, cold_headroom=1.0)
+    assert d["choice"] == "recompute"
+
+
+# ---------------------------------------------------------------------------
+# config surface (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_migrate_defaults_off_and_to_dict_round_trip():
+    cfg = RoutingConfig()
+    assert cfg.kv_migrate is False
+    d = cfg.to_dict()
+    for k in ("kv_migrate", "migrate_min_blocks", "migrate_bytes_per_token",
+              "migrate_bandwidth_bytes_per_s",
+              "migrate_prefill_tokens_per_s", "migrate_queue_wait_s"):
+        assert k in d
+
+
+def test_migrate_knob_validation_atomic():
+    cfg = RoutingConfig()
+    cfg.update({"kv_migrate": "true", "migrate_min_blocks": 3})
+    assert cfg.kv_migrate is True and cfg.migrate_min_blocks == 3
+    with pytest.raises(ValueError):
+        cfg.update({"kv_migrate": "maybe"})
+    assert cfg.kv_migrate is True   # rejected push left config untouched
+    with pytest.raises(ValueError):
+        # one bad field in a batch must not half-apply the good one
+        cfg.update({"migrate_min_blocks": 9, "migrate_queue_wait_s": -1})
+    assert cfg.migrate_min_blocks == 3
+    with pytest.raises(ValueError):
+        cfg.update({"migrate_bandwidth_bytes_per_s": 0})
+
+
+# ---------------------------------------------------------------------------
+# peer selection (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _summary(fps: List[str], tier: str = "dev") -> Dict[str, Any]:
+    return {"v": 1, "seq": 1, "block_chars": PREFIX_BLOCK_CHARS,
+            "full": [[fp, i + 1, tier] for i, fp in enumerate(fps)]}
+
+
+def test_best_match_depth_wins_tier_breaks_ties():
+    reg = PrefixRegistry(RoutingConfig())
+    fps = prefix_fingerprints("x" * (PREFIX_BLOCK_CHARS * 4))
+    assert reg.ingest("deep", _summary(fps[:3], tier="spill")).applied
+    assert reg.ingest("shallow", _summary(fps[:1], tier="dev")).applied
+    wid, blocks, tier = reg.best_match(["deep", "shallow"], fps)
+    assert (wid, blocks, tier) == ("deep", 3, "spill")
+    # equal depth: the warmer tier wins
+    assert reg.ingest("deep2", _summary(fps[:3], tier="dev")).applied
+    wid, blocks, tier = reg.best_match(["deep", "deep2"], fps)
+    assert (wid, blocks, tier) == ("deep2", 3, "dev")
+    # nobody matches
+    other = prefix_fingerprints("y" * (PREFIX_BLOCK_CHARS * 2))
+    assert reg.best_match(["deep", "deep2"], other) == (None, 0, "dev")
+
+
+# ---------------------------------------------------------------------------
+# export wire codec (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_export_request_codec_round_trip():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        pack_export_request,
+        unpack_export_request,
+    )
+
+    raw = pack_export_request(key="k1", token_ids=[1, 2, 3],
+                              model_name="m", block_size=16,
+                              int8_kv=False, max_blocks=8)
+    req = unpack_export_request(raw)
+    assert req["key"] == "k1" and req["token_ids"] == [1, 2, 3]
+    assert req["block_size"] == 16 and req["max_blocks"] == 8
+
+
+def test_split_frames_rejects_truncation():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        _frame_blobs,
+        split_frames,
+    )
+
+    body = _frame_blobs(b"aaa", b"bbbb")
+    assert split_frames(body) == [b"aaa", b"bbbb"]
+    assert split_frames(b"") == []
+    with pytest.raises(ValueError):
+        split_frames(body[:-2])     # peer died mid-response
+
+
+# ---------------------------------------------------------------------------
+# claim-path stamping (tier-1)
+# ---------------------------------------------------------------------------
+
+
+class _StubStore:
+    def __init__(self, workers: List[Dict[str, Any]]) -> None:
+        self._workers = workers
+
+    async def list_workers(self, status: Any = None,
+                           supports_type: Any = None
+                           ) -> List[Dict[str, Any]]:
+        return list(self._workers)
+
+
+def test_claim_path_stamps_migrate_hint():
+    from distributed_gpu_inference_tpu.server.scheduler import SmartScheduler
+
+    fps = prefix_fingerprints("s" * (PREFIX_BLOCK_CHARS * 4))
+    reg = PrefixRegistry(RoutingConfig(kv_migrate=True))
+    assert reg.ingest("warm", _summary(fps)).applied
+    workers = [
+        {"id": "warm", "data_plane_url": "http://warm:1", "status": "idle"},
+        {"id": "cold", "status": "idle"},
+    ]
+    mc = MetricsCollector()
+    sched = SmartScheduler(_StubStore(workers), reliability=object(),
+                           prefix_registry=reg, metrics=mc)
+    job = {"type": "llm", "prefix_fps": list(fps),
+           "params": {"prompt": "x"}}
+    asyncio.run(sched._maybe_stamp_migration("cold", job))
+    hint = job["params"].get("kv_migrate_from")
+    assert hint and hint["worker_id"] == "warm"
+    assert hint["data_plane_url"] == "http://warm:1"
+    assert hint["matched_blocks"] == len(fps)
+
+    # the claiming worker itself is warm → no stamp, decision "warm"
+    job2 = {"type": "llm", "prefix_fps": list(fps),
+            "params": {"prompt": "x"}}
+    asyncio.run(sched._maybe_stamp_migration("warm", job2))
+    assert "kv_migrate_from" not in job2["params"]
+
+    # warm peer without a data plane cannot serve a pull → no stamp
+    reg2 = PrefixRegistry(RoutingConfig(kv_migrate=True))
+    assert reg2.ingest("warm", _summary(fps)).applied
+    sched2 = SmartScheduler(
+        _StubStore([{"id": "warm", "status": "idle"},
+                    {"id": "cold", "status": "idle"}]),
+        reliability=object(), prefix_registry=reg2, metrics=mc)
+    job3 = {"type": "llm", "prefix_fps": list(fps),
+            "params": {"prompt": "x"}}
+    asyncio.run(sched2._maybe_stamp_migration("cold", job3))
+    assert "kv_migrate_from" not in job3["params"]
+
+
+# ---------------------------------------------------------------------------
+# metrics delta anchoring (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_kv_migrate_metrics_delta_anchor():
+    mc = MetricsCollector()
+    mc.record_kv_migrate_engine("w1", {"pulled": 2, "aborted": 1,
+                                       "pull_bytes": 1000,
+                                       "export_bytes": 400})
+    mc.record_kv_migrate_engine("w1", {"pulled": 5, "aborted": 1,
+                                       "pull_bytes": 2500,
+                                       "export_bytes": 400})
+    text = mc.render().decode()
+    if "kv_migrations_total" not in text:
+        pytest.skip("prometheus_client not installed")
+    assert 'kv_migrations_total{outcome="pulled",worker="w1"} 5.0' in text
+    assert 'kv_migrations_total{outcome="aborted",worker="w1"} 1.0' in text
+    assert ('kv_migration_bytes_total{direction="pull",worker="w1"} 2500.0'
+            in text)
+    # engine restart resets totals → re-anchor, no bogus delta
+    mc.record_kv_migrate_engine("w1", {"pulled": 1, "pull_bytes": 10})
+    text = mc.render().decode()
+    assert 'kv_migrations_total{outcome="pulled",worker="w1"} 5.0' in text
+    mc.record_kv_migrate_engine("w1", {"pulled": 2, "pull_bytes": 30})
+    text = mc.render().decode()
+    assert 'kv_migrations_total{outcome="pulled",worker="w1"} 6.0' in text
+    mc.record_kv_route_decision("direct", "migrate")
+    text = mc.render().decode()
+    assert ('kv_route_decisions_total{choice="migrate",path="direct"} 1.0'
+            in text)
+
+
+# ---------------------------------------------------------------------------
+# engine-pair export/adopt (heavy)
+# ---------------------------------------------------------------------------
+
+
+def _engine(**kw: Any):
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        EngineConfig,
+        TPUEngine,
+    )
+
+    cfg = EngineConfig(max_batch_size=2, max_seq_len=160, multi_step=4,
+                       **kw)
+    return TPUEngine("llama3-tiny", cfg)
+
+
+def _run_greedy(eng: Any, prompt: List[int], max_new: int = 8):
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+
+    req = InferenceRequest(prompt_token_ids=list(prompt),
+                           sampling=SamplingParams(max_new_tokens=max_new))
+    slot = eng.submit_batch([req])[0]
+    while eng.slots[slot] is not None and \
+            eng.slots[slot].finish_reason is None:
+        eng.decode_step()
+    return eng.finish_slot(slot)
+
+
+@pytest.mark.slow
+def test_prefix_export_adopt_byte_identity():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        HandoffReceiver,
+        export_prefix_frames,
+    )
+
+    donor, cold = _engine(), _engine()
+    prompt = list(range(4, 4 + 96))     # 6 full blocks of 16
+    ref = _run_greedy(donor, prompt)    # warms donor's radix
+
+    frames, info = export_prefix_frames(donor, prompt, "k1")
+    assert info["dev_blocks"] == 6 and info["spill_blocks"] == 0
+    rx = HandoffReceiver(cold)
+    last = None
+    for f in frames:
+        last = rx.handle(f)
+    assert last["state"] == "committed" and last["prefix_only"]
+    assert rx.stats["prefix_commits"] == 1
+    out = _run_greedy(cold, prompt)
+    assert out.token_ids == ref.token_ids
+    # at least 5 of the 6 pulled blocks were reusable (the admission's
+    # keep-one-token-fresh rule always recomputes the last block)
+    assert out.cached_tokens >= 80
+    assert cold.manager.stats.prefix_hit_tokens >= 80
+
+
+@pytest.mark.slow
+def test_prefix_export_serves_from_spill_tier():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        HandoffReceiver,
+        export_prefix_frames,
+    )
+
+    donor = _engine(spill_host_blocks=64)
+    cold = _engine()
+    prompt = list(range(4, 4 + 96))
+    ref = _run_greedy(donor, prompt)
+    # evict everything: with spill_on_evict the pages land in the host
+    # store, and the export must still serve them to the peer
+    donor.manager.clear_cached(spill=True)
+    donor._apply_pending()
+    assert len(donor.manager.host_store) > 0
+
+    frames, info = export_prefix_frames(donor, prompt, "k2")
+    assert info["dev_blocks"] == 0 and info["spill_blocks"] > 0
+    rx = HandoffReceiver(cold)
+    for f in frames:
+        rx.handle(f)
+    out = _run_greedy(cold, prompt)
+    assert out.token_ids == ref.token_ids
+    assert out.cached_tokens > 0
+
+
+@pytest.mark.slow
+def test_partial_overlap_ships_only_missing_blocks():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        HandoffReceiver,
+        export_prefix_frames,
+        message_kind,
+    )
+
+    donor, cold = _engine(), _engine()
+    prompt = list(range(4, 4 + 96))     # 6 full blocks
+    ref = _run_greedy(donor, prompt)
+    # pre-warm the puller with the first 2 blocks (a shorter same-prefix
+    # request) — its pull should start at block 2
+    _run_greedy(cold, prompt[:40])      # 2 full blocks cached + tail
+
+    frames, info = export_prefix_frames(donor, prompt, "k5", start_block=2)
+    assert info["dev_blocks"] + info["spill_blocks"] == 4   # 6 - 2
+    pieces = [f for f in frames if message_kind(f) == "piece"]
+    assert pieces    # only the missing range crossed
+    full_frames, full_info = export_prefix_frames(donor, prompt, "k5f")
+    assert sum(len(f) for f in pieces) < sum(
+        len(f) for f in full_frames if message_kind(f) == "piece"
+    )
+    rx = HandoffReceiver(cold)
+    for f in frames:
+        last = rx.handle(f)
+    assert last["state"] == "committed"
+    out = _run_greedy(cold, prompt)
+    assert out.token_ids == ref.token_ids
+    assert out.cached_tokens >= 80
+
+    # exporting beyond what the peer holds yields "no match"
+    nothing, _ = export_prefix_frames(donor, prompt, "k6", start_block=6)
+    assert nothing == []
+
+
+@pytest.mark.slow
+def test_corrupt_piece_aborts_session_without_leaks():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        HandoffReceiver,
+        export_prefix_frames,
+    )
+
+    donor, cold = _engine(), _engine()
+    prompt = list(range(4, 4 + 96))
+    _run_greedy(donor, prompt)
+    frames, _ = export_prefix_frames(donor, prompt, "k3")
+    free_before = cold.manager.num_free
+    radix_before = len(cold.manager.radix)
+    rx = HandoffReceiver(cold)
+    rx.handle(frames[0])            # begin
+    with pytest.raises(Exception):
+        rx.handle(frames[1][:-24])  # truncated piece poisons the session
+    assert "k3" not in rx._sessions
+    assert cold.manager.num_free == free_before
+    assert len(cold.manager.radix) == radix_before
+    # a commit for the aborted session fails cleanly (no replay memo)
+    with pytest.raises(ValueError):
+        rx.handle(frames[-1])
+
+
+@pytest.mark.slow
+def test_commit_with_lost_piece_aborts():
+    from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+        HandoffReceiver,
+        export_prefix_frames,
+    )
+
+    donor, cold = _engine(), _engine()
+    prompt = list(range(4, 4 + 96))
+    _run_greedy(donor, prompt)
+    frames, _ = export_prefix_frames(donor, prompt, "k4", piece_blocks=2)
+    rx = HandoffReceiver(cold)
+    free_before = cold.manager.num_free
+    rx.handle(frames[0])
+    for f in frames[1:-2]:          # drop the LAST piece, then commit
+        rx.handle(f)
+    with pytest.raises(ValueError, match="unstaged"):
+        rx.handle(frames[-1])
+    assert "k4" not in rx._sessions
+    assert cold.manager.num_free == free_before
+
+
+# ---------------------------------------------------------------------------
+# worker pull driver (heavy — real engines + real data planes)
+# ---------------------------------------------------------------------------
+
+WORKER_CFG: Dict[str, Any] = {
+    "model": "llama3-tiny",
+    "max_batch_size": 2,
+    "max_seq_len": 256,
+    "multi_step": 4,
+    "serving": {"queue_limit": 64, "default_timeout_s": 60.0},
+}
+
+SYSTEM = "s" * 128           # 8 KV blocks, 2 fingerprint blocks
+
+
+def _worker_pair():
+    from distributed_gpu_inference_tpu.comm.data_plane import (
+        DataPlaneServer,
+    )
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+    from distributed_gpu_inference_tpu.worker.main import _PDReceiverShim
+
+    out = []
+    for _ in range(2):
+        llm = TPULLMEngine(dict(WORKER_CFG))
+        llm.load_model()
+        plane = DataPlaneServer(_PDReceiverShim(llm), host="127.0.0.1",
+                                port=0, kv_receiver=llm.kv_receiver,
+                                kv_exporter=llm.kv_export)
+        plane.start()
+        out.append((llm, plane,
+                    f"http://127.0.0.1:{plane.bound_port}"))
+    return out
+
+
+@pytest.mark.slow
+def test_worker_pull_end_to_end_and_fallbacks():
+    (warm, warm_plane, warm_url), (cold, cold_plane, _) = _worker_pair()
+    try:
+        prompt = SYSTEM + "q" * 24
+        ref = warm.inference({"prompt": prompt, "max_new_tokens": 16})
+        hint = {"worker_id": "warm", "data_plane_url": warm_url,
+                "matched_blocks": 2, "tier": "dev"}
+        out = cold.inference({"prompt": prompt, "max_new_tokens": 16,
+                              "kv_migrate_from": dict(hint)})
+        assert out["text"] == ref["text"]
+        assert cold.kv_migrate_stats["pulled"] == 1
+        assert cold.kv_migrate_stats["pull_blocks"] >= 8
+        assert cold.kv_migrate_stats["pull_bytes"] > 0
+        assert warm.kv_migrate_stats["exports"] == 1
+        assert warm.kv_migrate_stats["export_bytes"] > 0
+        # the pulled prefix actually landed: admission reused cached KV
+        assert cold.engine.manager.stats.prefix_hit_tokens > 0
+        wire = cold.kv_migrate_wire_stats()
+        assert wire["pulled"] == 1 and wire["prefix_commits"] == 1
+
+        # second identical request needs NO pull (already cached locally)
+        out2 = cold.inference({"prompt": prompt, "max_new_tokens": 16})
+        assert out2["text"] == ref["text"]
+
+        # a STILL-HINTED identical request (router summaries lag a
+        # heartbeat) must not re-transfer the resident prefix: the local
+        # radix probe short-circuits the pull
+        out2b = cold.inference({"prompt": prompt, "max_new_tokens": 16,
+                                "kv_migrate_from": dict(hint)})
+        assert out2b["text"] == ref["text"]
+        assert cold.kv_migrate_stats["pulled"] == 1
+        assert cold.kv_migrate_stats["local_hits"] == 1
+        assert warm.kv_migrate_stats["exports"] == 1
+
+        # dead peer: fallback to recompute, never a client error
+        prompt2 = "t" * 128 + "u" * 24
+        ref2 = warm.inference({"prompt": prompt2, "max_new_tokens": 16})
+        out3 = cold.inference({
+            "prompt": prompt2, "max_new_tokens": 16,
+            "kv_migrate_from": {"worker_id": "x",
+                                "data_plane_url": "http://127.0.0.1:9"},
+        })
+        assert out3["text"] == ref2["text"]
+        assert cold.kv_migrate_stats["aborted"] == 1
+
+        # a peer that REJECTS the pull (4xx — incompatible engine or
+        # migration disabled) is pinned out, not retried per request
+        prompt3 = "w" * 128 + "x" * 24
+        ref3 = warm.inference({"prompt": prompt3, "max_new_tokens": 8})
+        warm.kv_migrate_enabled = False     # export now answers 400
+        out_rej = cold.inference({"prompt": prompt3, "max_new_tokens": 8,
+                                  "kv_migrate_from": dict(hint)})
+        assert out_rej["text"] == ref3["text"]
+        assert cold.kv_migrate_stats["aborted"] == 2
+        fails, until = cold._kvmig_backoff[warm_url]
+        assert until - time.monotonic() > 60.0    # pinned, not jittered
+        warm.kv_migrate_enabled = True
+        cold._kvmig_backoff.pop(warm_url, None)
+
+        # armed backoff window: the pull is skipped outright
+        cold._kvmig_backoff["http://127.0.0.1:9"] = (
+            2, time.monotonic() + 60.0
+        )
+        before = cold.kv_migrate_stats["fallback_recompute"]
+        out4 = cold.inference({
+            "prompt": "v" * 128 + "w" * 8, "max_new_tokens": 8,
+            "kv_migrate_from": {"worker_id": "x",
+                                "data_plane_url": "http://127.0.0.1:9"},
+        })
+        assert out4.get("text") is not None
+        assert cold.kv_migrate_stats["fallback_recompute"] == before + 1
+
+        # budget gate: zero concurrent-pull budget degrades to recompute
+        cold._kvmig_budget = 0
+        before = cold.kv_migrate_stats["fallback_recompute"]
+        out5 = cold.inference({
+            "prompt": "y" * 128 + "z" * 8, "max_new_tokens": 8,
+            "kv_migrate_from": dict(hint),
+        })
+        assert out5.get("text") is not None
+        assert cold.kv_migrate_stats["fallback_recompute"] == before + 1
+    finally:
+        for llm, plane, _ in ((warm, warm_plane, None),
+                              (cold, cold_plane, None)):
+            plane.stop()
+            llm.unload()
+
+
+@pytest.mark.slow
+def test_worker_pull_seeded_corruption_and_source_kill():
+    """Seeded chaos on the pull path: random frame truncation (the
+    kv.receiver.message seam — the same rule handoff_corrupt arms) while
+    hinted requests flow, then the source's data plane dies outright
+    mid-run. Every request completes with byte-identical greedy text;
+    outcomes are counted exactly once per hinted request; no session or
+    block leaks survive."""
+    from distributed_gpu_inference_tpu.testing import faults as _faults
+    from distributed_gpu_inference_tpu.testing.faults import (
+        FaultPlan,
+        FaultRule,
+    )
+
+    (warm, warm_plane, warm_url), (cold, cold_plane, _) = _worker_pair()
+    try:
+        prompts = [("p%d" % i) * 8 + "s" * 112 for i in range(4)]
+        refs = [warm.inference({"prompt": p, "max_new_tokens": 8})["text"]
+                for p in prompts]
+        hint = {"worker_id": "warm", "data_plane_url": warm_url}
+
+        for seed in range(4):
+            # fresh cold cache per seed so every request re-pulls
+            cold.serving.run_exclusive(
+                lambda: cold.engine.manager.clear_cached()
+            )
+            cold._kvmig_backoff.clear()
+            plan = FaultPlan(seed)
+            plan.add_rule(FaultRule(site="kv.receiver.message",
+                                    kind="truncate", cut=48, prob=0.5,
+                                    times=None))
+            base = dict(cold.kv_migrate_stats)
+            with _faults.active(plan):
+                for p, ref in zip(prompts, refs):
+                    out = cold.inference({
+                        "prompt": p, "max_new_tokens": 8,
+                        "kv_migrate_from": dict(hint),
+                    })
+                    assert out["text"] == ref
+            delta = {
+                k: cold.kv_migrate_stats[k] - base[k]
+                for k in ("pulled", "aborted", "fallback_recompute")
+            }
+            assert sum(delta.values()) == len(prompts)
+            assert not cold._handoff_rx._sessions
+
+        # source dies outright: every further hinted request recomputes
+        warm_plane.stop()
+        cold.serving.run_exclusive(
+            lambda: cold.engine.manager.clear_cached()
+        )
+        cold._kvmig_backoff.clear()
+        base = dict(cold.kv_migrate_stats)
+        for p, ref in zip(prompts, refs):
+            out = cold.inference({"prompt": p, "max_new_tokens": 8,
+                                  "kv_migrate_from": dict(hint)})
+            assert out["text"] == ref
+        assert cold.kv_migrate_stats["pulled"] == base["pulled"]
+        assert (cold.kv_migrate_stats["aborted"]
+                + cold.kv_migrate_stats["fallback_recompute"]
+                - base["aborted"] - base["fallback_recompute"]
+                ) == len(prompts)
+    finally:
+        warm.unload()
+        cold_plane.stop()
+        cold.unload()
+
+
+# ---------------------------------------------------------------------------
+# tier-accurate summary demotion (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_summary_demotes_to_actual_spill_tier():
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+    # remote-only spill: evicted entries must advertise the REMOTE tier
+    # ("spill"), not "host" — the cost model prices the pull by it
+    llm = TPULLMEngine({**WORKER_CFG, "kv_remote_url": "memory://"})
+    llm.load_model()
+    try:
+        llm.inference({"prompt": SYSTEM + "a" * 16, "max_new_tokens": 4})
+
+        def _spill(eng: Any) -> None:
+            eng.manager.clear_cached(spill=True)
+            eng._apply_pending()    # downloads → store_spilled
+
+        llm.serving.run_exclusive(lambda: _spill(llm.engine))
+        payload = llm.prefix_summary_wire()
+        assert payload is not None
+        tiers = {t for _, _, t in payload["full"]}
+        assert "spill" in tiers and "host" not in tiers
+    finally:
+        llm.unload()
+
+    # host-backed spill keeps the host tier
+    llm2 = TPULLMEngine({**WORKER_CFG, "kv_spill_host_blocks": 64})
+    llm2.load_model()
+    try:
+        llm2.inference({"prompt": SYSTEM + "b" * 16, "max_new_tokens": 4})
+
+        def _spill2(eng: Any) -> None:
+            eng.manager.clear_cached(spill=True)
+            eng._apply_pending()
+
+        llm2.serving.run_exclusive(lambda: _spill2(llm2.engine))
+        payload = llm2.prefix_summary_wire()
+        assert payload is not None
+        tiers = {t for _, _, t in payload["full"]}
+        assert "host" in tiers and "spill" not in tiers
+    finally:
+        llm2.unload()
+
+
+# ---------------------------------------------------------------------------
+# e2e: live control plane hands out a migrate hint (heavy)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_nearest_endpoint_migrate_decision_e2e():
+    import httpx
+
+    from distributed_gpu_inference_tpu.testing.harness import (
+        LiveControlPlane,
+    )
+
+    def _register(client: httpx.Client, url: str, name: str,
+                  data_plane_url: Optional[str]) -> Dict[str, str]:
+        r = client.post(f"{url}/api/v1/workers/register", json={
+            "name": name, "region": "us-west",
+            "supported_types": ["llm"], "supports_direct": True,
+            "direct_url": f"http://{name}.invalid",
+            **({"data_plane_url": data_plane_url}
+               if data_plane_url else {}),
+        })
+        r.raise_for_status()
+        return r.json()
+
+    def _beat(client: httpx.Client, url: str, cred: Dict[str, str],
+              es: Dict[str, Any]) -> Dict[str, Any]:
+        r = client.post(
+            f"{url}/api/v1/workers/{cred['worker_id']}/heartbeat",
+            json={"status": "idle", "engine_stats": es},
+            headers={"Authorization": f"Bearer {cred['auth_token']}"},
+        )
+        r.raise_for_status()
+        return r.json()
+
+    prompt = SYSTEM + "q" * 64
+    fps = prefix_fingerprints(prompt)
+    with LiveControlPlane() as plane:
+        with httpx.Client(timeout=30.0) as client:
+            warm = _register(client, plane.url, "warm", "http://warm:1")
+            cold = _register(client, plane.url, "cold", None)
+            # warm advertises the prefix but is SATURATED; cold is idle
+            _beat(client, plane.url, warm, {
+                "prefix_summary": _summary(fps),
+                "prefix_summary_live": True,
+                "batcher": {"active_slots": 4, "queue_depth": 8,
+                            "capacity": 4},
+            })
+            _beat(client, plane.url, cold, {
+                "batcher": {"active_slots": 0, "queue_depth": 0,
+                            "capacity": 4},
+            })
+            q = {"prefix_fps": ",".join(fps)}
+
+            # migration OFF (default): legacy response shape — no hint key
+            r = client.get(f"{plane.url}/api/v1/jobs/direct/nearest",
+                           params=q)
+            r.raise_for_status()
+            assert "kv_migrate" not in r.json()
+
+            client.put(f"{plane.url}/api/v1/admin/routing",
+                       json={"kv_migrate": True}).raise_for_status()
+            r = client.get(f"{plane.url}/api/v1/jobs/direct/nearest",
+                           params=q)
+            r.raise_for_status()
+            body = r.json()
+            # saturated warm worker → the cold worker serves, pulling
+            # from the warm peer's data plane
+            assert body["worker_id"] == cold["worker_id"]
+            hint = body.get("kv_migrate")
+            assert hint is not None
+            assert hint["worker_id"] == warm["worker_id"]
+            assert hint["data_plane_url"] == "http://warm:1"
+            assert hint["matched_blocks"] == len(fps)
+
+            # a BUSY-saturated warm worker drops out of PLACEMENT
+            # eligibility entirely — it must still be a migration SOURCE
+            # (the storm case the feature exists for)
+            r = client.post(
+                f"{plane.url}/api/v1/workers/"
+                f"{warm['worker_id']}/heartbeat",
+                json={"status": "busy", "engine_stats": {
+                    "prefix_summary_live": True,
+                    "batcher": {"active_slots": 4, "queue_depth": 8,
+                                "capacity": 4}}},
+                headers={"Authorization":
+                         f"Bearer {warm['auth_token']}"},
+            )
+            r.raise_for_status()
+            r = client.get(f"{plane.url}/api/v1/jobs/direct/nearest",
+                           params=q)
+            r.raise_for_status()
+            body = r.json()
+            assert body["worker_id"] == cold["worker_id"]
+            hint = body.get("kv_migrate")
+            assert hint is not None and \
+                hint["worker_id"] == warm["worker_id"]
+
+            # idle warm worker → route-to-warm, no hint
+            _beat(client, plane.url, warm, {
+                "prefix_summary_live": True,
+                "batcher": {"active_slots": 0, "queue_depth": 0,
+                            "capacity": 4},
+            })
+            r = client.get(f"{plane.url}/api/v1/jobs/direct/nearest",
+                           params=q)
+            r.raise_for_status()
+            body = r.json()
+            assert body["worker_id"] == warm["worker_id"]
+            assert "kv_migrate" not in body
