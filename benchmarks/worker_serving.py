@@ -52,8 +52,10 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import sys
 import time
+import uuid
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -232,8 +234,12 @@ async def _drive(one, prompts: List[str], rate: Optional[float],
 async def _drive_http(url: str, prompts: List[str], max_tokens: int,
                       rate: Optional[float], concurrency: int,
                       seed: int, extra_params: Optional[Dict[str, Any]] = None,
+                      trace: bool = False, collect_text: bool = False,
                       ) -> Tuple[List[Dict[str, Any]], float, float]:
-    """Drive the REAL direct server over HTTP."""
+    """Drive the REAL direct server over HTTP. ``trace`` stamps a flight
+    trace_id per request and collects the worker-side timeline off the
+    result; ``collect_text`` keeps the generated text (recorder-on-vs-off
+    byte-identity checks)."""
     import httpx
 
     async with httpx.AsyncClient(timeout=600.0) as client:
@@ -241,11 +247,14 @@ async def _drive_http(url: str, prompts: List[str], max_tokens: int,
         async def one(p: str, at: Optional[float]) -> Dict[str, Any]:
             if at is not None:
                 await asyncio.sleep(float(at))
+            params = {"prompt": p, "max_new_tokens": max_tokens,
+                      **(extra_params or {})}
+            if trace:
+                params["trace_id"] = f"bench-{uuid.uuid4().hex[:12]}"
             t0 = time.perf_counter()
             r = await client.post(url + "/inference", json={
                 "type": "llm",
-                "params": {"prompt": p, "max_new_tokens": max_tokens,
-                           **(extra_params or {})},
+                "params": params,
             })
             e2e_ms = (time.perf_counter() - t0) * 1000.0
             out = {"status": r.status_code, "e2e_ms": e2e_ms}
@@ -255,6 +264,10 @@ async def _drive_http(url: str, prompts: List[str], max_tokens: int,
                 out["completion_tokens"] = (
                     (res.get("usage") or {}).get("completion_tokens") or 0
                 )
+                if trace:
+                    out["timeline"] = res.get("timeline")
+                if collect_text:
+                    out["text"] = res.get("text")
             return out
 
         return await _drive(one, prompts, rate, concurrency, seed)
@@ -290,6 +303,44 @@ async def _drive_inproc(llm: Any, prompts: List[str], max_tokens: int,
         }
 
     return await _drive(one, prompts, rate, concurrency, seed)
+
+
+def _timeline_attribution(results: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-phase latency attribution from per-request flight timelines:
+    p50/p95 (ms) for each canonical phase. Accepts records carrying either
+    a raw worker ``timeline`` wire (direct-path legs) or already-derived
+    ``phases`` (queued/PD legs reading the plane's debug endpoint) — this
+    is the table that replaces 'a single opaque TTFT number'."""
+    from distributed_gpu_inference_tpu.runtime.flight import (
+        PHASES,
+        merge_events,
+        phase_durations,
+    )
+
+    per_phase: Dict[str, List[float]] = {p: [] for p in PHASES}
+    samples = 0
+    for rec in results:
+        phases = rec.get("phases")
+        if not phases:
+            wire = rec.get("timeline")
+            if not isinstance(wire, dict):
+                continue
+            merged = merge_events({
+                str(wire.get("source") or "worker"):
+                    wire.get("events") or []
+            })
+            phases = phase_durations(merged)
+        if not phases:
+            continue
+        samples += 1
+        for p, v in phases.items():
+            if p in per_phase:
+                per_phase[p].append(float(v) * 1000.0)
+    return {
+        "samples": samples,
+        "phase_ms": {p: percentiles(v)
+                     for p, v in per_phase.items() if v},
+    }
 
 
 def _summarize(results: List[Dict[str, Any]], elapsed: float,
@@ -832,6 +883,7 @@ def run_kv_migrate(args: Any, backend: str, model: str) -> None:
 
 async def _drive_fleet_direct(plane_url: str, prompts: List[str],
                               arrivals: List[float], max_tokens: int,
+                              timeline: bool = False,
                               ) -> Tuple[List[Dict[str, Any]], float]:
     """Open-loop direct-path driver that SURVIVES chaos: each request
     discovers its worker per attempt, excludes workers it just watched
@@ -849,6 +901,8 @@ async def _drive_fleet_direct(plane_url: str, prompts: List[str],
             if at > now:
                 await asyncio.sleep(at - now)
             rec: Dict[str, Any] = {"i": i, "arrival_s": at, "status": 0}
+            trace_id = (f"bench-{uuid.uuid4().hex[:12]}"
+                        if timeline else None)
             t_req = time.perf_counter()
             exclude: List[str] = []
             # deadline-based retry: an open-loop client under brownout (or
@@ -857,10 +911,14 @@ async def _drive_fleet_direct(plane_url: str, prompts: List[str],
             while time.perf_counter() - t_req < 180.0:
                 wid = None
                 try:
+                    query: Dict[str, str] = {}
+                    if exclude:
+                        query["exclude"] = ",".join(exclude)
+                    if trace_id:
+                        query["trace_id"] = trace_id
                     d = await client.get(
                         f"{plane_url}/api/v1/jobs/direct/nearest",
-                        params={"exclude": ",".join(exclude)}
-                        if exclude else None,
+                        params=query or None,
                     )
                     if d.status_code != 200:
                         # fleet momentarily dark (sweep lag): back off
@@ -869,11 +927,14 @@ async def _drive_fleet_direct(plane_url: str, prompts: List[str],
                         continue
                     disc = d.json()
                     wid = disc["worker_id"]
+                    params = {"prompt": prompt,
+                              "max_new_tokens": max_tokens}
+                    if trace_id:
+                        params["trace_id"] = trace_id
                     r = await client.post(
                         disc["direct_url"] + "/inference", json={
                             "type": "llm",
-                            "params": {"prompt": prompt,
-                                       "max_new_tokens": max_tokens},
+                            "params": params,
                         })
                     if r.status_code == 200:
                         res = r.json().get("result") or {}
@@ -887,6 +948,8 @@ async def _drive_fleet_direct(plane_url: str, prompts: List[str],
                             "completion_tokens": (res.get("usage") or {})
                             .get("completion_tokens") or 0,
                         })
+                        if trace_id:
+                            rec["timeline"] = res.get("timeline")
                         return rec
                     if r.status_code == 503:
                         await asyncio.sleep(0.1)   # busy: same worker frees up
@@ -909,9 +972,10 @@ async def _drive_fleet_direct(plane_url: str, prompts: List[str],
 
 
 def _fleet_leg(fleet: Any, prompts: List[str], arrivals: List[float],
-               max_tokens: int) -> Tuple[List[Dict[str, Any]], float]:
+               max_tokens: int, timeline: bool = False
+               ) -> Tuple[List[Dict[str, Any]], float]:
     return asyncio.run(_drive_fleet_direct(
-        fleet.url, prompts, arrivals, max_tokens
+        fleet.url, prompts, arrivals, max_tokens, timeline=timeline
     ))
 
 
@@ -999,12 +1063,18 @@ def run_chaos_fleet(args: Any, backend: str, model: str) -> None:
                        FleetEvent(t_restart, "restart", 0)]
         fleet.run_chaos(plan)
         try:
+            # with --timeline the CHAOS leg is the traced one: per-phase
+            # attribution of a brownout window, and the existing
+            # chaos-vs-calm byte-identity doubles as recorder-on-vs-off
             chaos_results, chaos_elapsed = _fleet_leg(
-                fleet, prompts, arrivals, args.max_tokens
+                fleet, prompts, arrivals, args.max_tokens,
+                timeline=args.timeline,
             )
         finally:
             fleet.wait_chaos()
         chaos = _aggregate_summary(chaos_results, chaos_elapsed)
+        if args.timeline:
+            chaos["timeline"] = _timeline_attribution(chaos_results)
 
         # schedule offsets as EXECUTED (the trace is wall-clock-stamped)
         kill_at = next(t for t, k, _ in plan.trace if k == "kill")
@@ -1079,7 +1149,7 @@ def run_chaos_fleet(args: Any, backend: str, model: str) -> None:
 
 async def _drive_queued_jobs(plane_url: str, prompts: List[str],
                              arrivals: List[float], max_tokens: int,
-                             pd: bool,
+                             pd: bool, timeline: bool = False,
                              ) -> Tuple[List[Dict[str, Any]], float]:
     """Open-loop queued-job driver (the PD path runs through /jobs, not
     the direct servers): submit at the arrival instant — riding out
@@ -1103,6 +1173,8 @@ async def _drive_queued_jobs(plane_url: str, prompts: List[str],
             }
             if pd:
                 params["pd_disaggregated"] = True
+            if timeline:
+                params["trace_id"] = f"bench-{uuid.uuid4().hex[:12]}"
             job_id = None
             while time.perf_counter() - t_req < 180.0:
                 try:
@@ -1153,6 +1225,37 @@ async def _drive_queued_jobs(plane_url: str, prompts: List[str],
                         .get("completion_tokens")
                         or res.get("completion_tokens") or 0,
                     })
+                    if timeline:
+                        # the plane merged server + both workers' events:
+                        # read the derived phases off the debug endpoint.
+                        # The recorder is eventually consistent BY DESIGN
+                        # (job status commits before the flight fan-in so
+                        # the recorder can never delay a completion) — a
+                        # read racing the fan-in sees a pre-merge snapshot
+                        # without worker events, so retry briefly until
+                        # ``server.completed`` has landed
+                        try:
+                            for _ in range(40):
+                                tr = await client.get(
+                                    f"{plane_url}/api/v1/debug/requests/"
+                                    f"{job_id}/timeline"
+                                )
+                                if tr.status_code != 200:
+                                    break
+                                tj = tr.json()
+                                rec["phases"] = tj.get("phases")
+                                rec["_timeline_detail"] = tj
+                                evs = tj.get("events") or []
+                                # complete ⇔ the LAST merged event is the
+                                # completion note (a PD trace already holds
+                                # the prefill child's server.completed while
+                                # the decode fan-in is still in flight)
+                                if evs and evs[-1].get("event") == \
+                                        "server.completed":
+                                    break
+                                await asyncio.sleep(0.025)
+                        except (httpx.TransportError, ValueError):
+                            pass
                     return rec
                 await asyncio.sleep(0.05)
             rec["status"] = 599
@@ -1200,9 +1303,11 @@ def run_pd_split(args: Any, backend: str, model: str) -> None:
     arrivals = [float(a) for a in _np.cumsum(gaps)]
     span = arrivals[-1]
 
-    def leg(fleet: Any, pd: bool) -> Tuple[List[Dict[str, Any]], float]:
+    def leg(fleet: Any, pd: bool, timeline: bool = False
+            ) -> Tuple[List[Dict[str, Any]], float]:
         return asyncio.run(_drive_queued_jobs(
-            fleet.url, prompts, arrivals, args.max_tokens, pd
+            fleet.url, prompts, arrivals, args.max_tokens, pd,
+            timeline=timeline,
         ))
 
     out: Dict[str, Any] = {
@@ -1219,12 +1324,79 @@ def run_pd_split(args: Any, backend: str, model: str) -> None:
     with LiveFleet(n=n, roles=roles, pd_data_plane=True,
                    engine_config=engine_config) as fleet:
         sched = fleet.plane.state.pd_flow.scheduler
-        leg(fleet, pd=True)                               # warm compiles
+        # warm compiles first: cold-compile stalls can back up the PD
+        # prefill slots (bounded by pd_slot_ttl_s) and fail requests,
+        # which would poison a byte-identity comparator — so with
+        # --timeline the recorder-OFF leg is a SEPARATE replay on the
+        # warmed fleet. The plane mints a trace_id for every queued job
+        # (always-on histograms), so "OFF" must be the process-wide kill
+        # switch: the whole fleet runs in this process, and DGI_FLIGHT=0
+        # darkens worker timelines AND the plane's recorder for the leg
+        leg(fleet, pd=True)
+        warm_results: List[Dict[str, Any]] = []
+        if args.timeline:
+            prev_flight = os.environ.get("DGI_FLIGHT")
+            os.environ["DGI_FLIGHT"] = "0"
+            try:
+                warm_results, _ = leg(fleet, pd=True)
+            finally:
+                if prev_flight is None:
+                    os.environ.pop("DGI_FLIGHT", None)
+                else:
+                    os.environ["DGI_FLIGHT"] = prev_flight
         # scheduler counters are cumulative across legs on the shared
         # fleet: every published stat is a per-leg DELTA
         affinity_before = sched.stats["affinity_hits"]
-        pd_results, pd_elapsed = leg(fleet, pd=True)
+        pd_results, pd_elapsed = leg(fleet, pd=True,
+                                     timeline=args.timeline)
         pd_summary = _aggregate_summary(pd_results, pd_elapsed)
+        if args.timeline:
+            # per-phase attribution for the PD leg (merged server + both
+            # workers' events via the plane's debug endpoint) + the
+            # recorder-on-vs-off byte-identity check against the untraced
+            # warm leg's outputs
+            attr = _timeline_attribution(pd_results)
+            on_t = {r["i"]: r.get("text") for r in pd_results
+                    if r["status"] == 200}
+            off_t = {r["i"]: r.get("text") for r in warm_results
+                     if r["status"] == 200}
+            attr["outputs_identical_recorder_on_vs_off"] = (
+                len(on_t) == len(off_t) == len(prompts) and on_t == off_t
+            )
+            if not attr["outputs_identical_recorder_on_vs_off"]:
+                # name the divergent requests so a failed identity check
+                # is attributable, not just a boolean
+                attr["identity_mismatch"] = {
+                    "on_ok": len(on_t), "off_ok": len(off_t),
+                    "requests": sorted(
+                        i for i in set(on_t) | set(off_t)
+                        if on_t.get(i) != off_t.get(i)
+                    )[:8],
+                }
+            # acceptance evidence: one merged PD timeline — causally
+            # ordered, spanning server + prefill worker + decode worker,
+            # with handoff begin/commit observed on BOTH sides
+            detail = next((r.get("_timeline_detail") for r in pd_results
+                           if r.get("_timeline_detail")), None)
+            if detail:
+                evs = detail.get("events") or []
+                names = [e["event"] for e in evs]
+                ts = [e["ts"] for e in evs]
+                attr["example"] = {
+                    "trace_id": detail.get("trace_id"),
+                    "sources": detail.get("sources"),
+                    "events": names,
+                    "monotonic": ts == sorted(ts),
+                    "handoff_events_both_workers": (
+                        any(n in ("handoff.begin", "handoff.commit",
+                                  "handoff.local") for n in names)
+                        and any(n.startswith("handoff.rx_")
+                                for n in names)
+                    ) or any(n == "handoff.local" for n in names),
+                }
+            for r in pd_results:
+                r.pop("_timeline_detail", None)
+            out["timeline"] = attr
         pd_summary["handoff_bytes"] = sum(
             r.get("migration_bytes") or 0 for r in pd_results
         )
@@ -1884,6 +2056,14 @@ def main() -> None:
     ap.add_argument("--tenants", type=int, default=4,
                     help="workload tenant count (--workers fleet mode and "
                     "--kv-migrate)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="flight-recorder attribution: stamp a trace_id "
+                    "per request and publish per-phase p50/p95 "
+                    "(queue_wait/prefill/ttft/handoff/decode/e2e) for the "
+                    "measured leg instead of a single opaque TTFT number; "
+                    "also asserts outputs byte-identical recorder on vs "
+                    "off. Composes with the default, --pd-split, and "
+                    "--chaos modes")
     ap.add_argument("--fleet-heartbeat-s", type=float, default=0.5,
                     help="fleet-mode worker heartbeat cadence (summaries "
                     "ride heartbeats; production uses 30s)")
@@ -1970,10 +2150,11 @@ def main() -> None:
         for i, rate in enumerate(rates):
             if i > 0:
                 llm.engine.manager.clear_cached()
-            deployed = _summarize(*asyncio.run(_drive_http(
+            dep_results, dep_elapsed, dep_span = asyncio.run(_drive_http(
                 url, prompts, args.max_tokens, rate, args.concurrency,
-                args.seed,
-            )))
+                args.seed, trace=args.timeline, collect_text=args.timeline,
+            ))
+            deployed = _summarize(dep_results, dep_elapsed, dep_span)
             out = {
                 "benchmark": "worker_serving",
                 "path": "direct_server+batcher_engine",
@@ -1997,6 +2178,24 @@ def main() -> None:
                           "queue_peak", "ragged_mode", "ragged_rounds",
                           "ragged_admissions")
             }
+            if args.timeline:
+                # per-phase attribution off the traced deployed leg, plus
+                # an UNTRACED replay of the identical workload: the
+                # recorder must never change what is generated
+                out["timeline"] = _timeline_attribution(dep_results)
+                llm.engine.manager.clear_cached()
+                off_results, _, _ = asyncio.run(_drive_http(
+                    url, prompts, args.max_tokens, rate, args.concurrency,
+                    args.seed, collect_text=True,
+                ))
+                on_texts = [r.get("text") for r in dep_results
+                            if r["status"] == 200]
+                off_texts = [r.get("text") for r in off_results
+                             if r["status"] == 200]
+                out["timeline"]["outputs_identical_recorder_on_vs_off"] = (
+                    len(on_texts) == len(off_texts) == len(prompts)
+                    and on_texts == off_texts
+                )
             if args.compare_legacy:
                 # flip the LIVE batcher to the legacy wave/chunk-
                 # interleaved admission path (the remote-config A/B a

@@ -174,6 +174,12 @@ class _QueueItem:
         field(compare=False, default=None)
     cancel: Optional[Any] = field(compare=False, default=None)
     interrupt: Optional[Any] = field(compare=False, default=None)
+    # flight recorder (round 14): the request's Timeline, when it carries
+    # a trace_id — queue wait, admission, chunk rounds, first token,
+    # preempt/resume, and completion are noted at their step boundaries.
+    # None for untraced requests: the recorder-off path costs one None
+    # check per boundary, nothing per token.
+    flight: Optional[Any] = field(compare=False, default=None)
 
 
 class ContinuousBatcher:
@@ -429,6 +435,21 @@ class ContinuousBatcher:
 
     # ---------------------------------------------------------------- API
 
+    @staticmethod
+    def _note(item: "_QueueItem", name: str, at: Optional[float] = None,
+              **attrs: Any) -> None:
+        """Flight-recorder boundary note for one request: a None check
+        when untraced, a list append when traced. ``at`` records an
+        engine-observed wall-clock instant (e.g. the slot's first-token
+        time) instead of "now"."""
+        f = item.flight
+        if f is None:
+            return
+        if at is not None:
+            f.note_at(name, at, **attrs)
+        else:
+            f.note(name, **attrs)
+
     async def submit(
         self, request: InferenceRequest, timeout_s: Optional[float] = None,
         *,
@@ -436,6 +457,7 @@ class ContinuousBatcher:
         cancel: Optional[Any] = None,
         interrupt: Optional[Any] = None,
         resume_from: Optional[PreemptedSequence] = None,
+        flight: Optional[Any] = None,
     ) -> InferenceResponse:
         """Enqueue and await completion (reference submit:130 semantics:
         future resolves with the response; queue-full and timeout surface as
@@ -486,7 +508,10 @@ class ContinuousBatcher:
             cancel=cancel,
             interrupt=interrupt,
             preempted=resume_from,
+            flight=flight,
         )
+        self._note(item, "batcher.enqueued",
+                   queue_depth=len(self._heap))
         heapq.heappush(self._heap, item)
         self.stats["submitted"] += 1
         self.stats["queue_peak"] = max(self.stats["queue_peak"], len(self._heap))
@@ -505,7 +530,8 @@ class ContinuousBatcher:
             )
 
     async def adopt_slot(self, slot: int,
-                         request: Optional[InferenceRequest] = None
+                         request: Optional[InferenceRequest] = None,
+                         flight: Optional[Any] = None
                          ) -> InferenceResponse:
         """Drive an ALREADY-ADMITTED engine slot (PD decode stage: the
         sequence arrived through a KV handoff, not through submit) inside
@@ -526,9 +552,15 @@ class ContinuousBatcher:
             # the sequence already finished (it decoded alongside earlier
             # batcher rounds while awaiting adoption): resolve immediately
             loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(
+            resp = await loop.run_in_executor(
                 self._exec, self.engine.finish_slot, slot
             )
+            if flight is not None:
+                flight.note("batcher.adopted", slot=slot)
+                flight.note("batcher.completed",
+                            finish_reason=resp.finish_reason,
+                            tokens=resp.completion_tokens)
+            return resp
         req = request or s.request
         loop = asyncio.get_running_loop()
         fut: "asyncio.Future[InferenceResponse]" = loop.create_future()
@@ -537,7 +569,9 @@ class ContinuousBatcher:
                       next(self._seq)),
             request=req,
             future=fut,
+            flight=flight,
         )
+        self._note(item, "batcher.adopted", slot=slot)
         self._slot_items[slot] = item
         self._admit_stamp[slot] = next(self._stamp)
         self._wake.set()
@@ -810,6 +844,7 @@ class ContinuousBatcher:
                 self._slot_items[slot] = item
                 self._admit_stamp[slot] = next(self._stamp)
                 self.stats["resumes"] += 1
+                self._note(item, "batcher.resumed", slot=slot)
                 admitted += 1
                 continue
             if self.use_ragged:
@@ -837,6 +872,8 @@ class ContinuousBatcher:
                 free.pop(0)
                 self._ragged.append((adm, item))
                 self.stats["ragged_admissions"] += 1
+                self._note(item, "batcher.admitted", slot=adm.slot,
+                           mode="ragged")
                 continue
             n_prompt = len(item.request.prompt_token_ids or [])
             if n_prompt > max_bucket:
@@ -869,11 +906,18 @@ class ContinuousBatcher:
                 free.pop(0)
                 self._chunked = (adm, item)
                 self.stats["chunked_admissions"] += 1
+                self._note(item, "batcher.admitted", slot=adm.slot,
+                           mode="chunked")
                 continue
             free.pop(0)
             wave.append(item)
 
         if wave:
+            # admission instant for the whole wave: submit_batch prefills
+            # AND samples the first token before returning, so noting
+            # "admitted" after it would land LATER than first_token and
+            # phase derivation would drop prefill and inflate queue_wait
+            t_admit = time.time()
             try:
                 slots = await loop.run_in_executor(
                     self._exec,
@@ -894,6 +938,7 @@ class ContinuousBatcher:
                 # failing request(s) by falling back to per-request admission
                 slots = None
                 for item in wave:
+                    t_admit = time.time()
                     try:
                         slot = await loop.run_in_executor(
                             self._exec, self.engine.submit, item.request
@@ -912,6 +957,9 @@ class ContinuousBatcher:
                         continue
                     self._slot_items[slot] = item
                     self._admit_stamp[slot] = next(self._stamp)
+                    self._note(item, "batcher.admitted", at=t_admit,
+                               slot=slot, mode="wave")
+                    self._note_first_token(item, slot)
                     admitted += 1
             if slots is not None:
                 if slots:
@@ -919,6 +967,9 @@ class ContinuousBatcher:
                 for item, slot in zip(wave, slots):
                     self._slot_items[slot] = item
                     self._admit_stamp[slot] = next(self._stamp)
+                    self._note(item, "batcher.admitted", at=t_admit,
+                               slot=slot, mode="wave")
+                    self._note_first_token(item, slot)
                 admitted += len(slots)
                 # pressure deferred the wave's tail (possibly the whole
                 # wave): requeue without error
@@ -931,6 +982,17 @@ class ContinuousBatcher:
             heapq.heapify(self._heap)
         self.stats["admitted"] += admitted
         return admitted
+
+    def _note_first_token(self, item: "_QueueItem", slot: int) -> None:
+        """Note the first-token boundary at the ENGINE's wall-clock stamp
+        (``SequenceSlot.first_token_time`` — the instant the token was
+        sampled) rather than the loop's observation time, so ttft on the
+        timeline matches the engine's own ttft_ms."""
+        if item.flight is None:
+            return
+        s = self.engine.slots[slot]
+        t = getattr(s, "first_token_time", None) if s is not None else None
+        self._note(item, "batcher.first_token", at=t)
 
     async def _step_chunked(self) -> None:
         """Advance the in-flight chunk-interleaved admission by ONE chunk."""
@@ -961,6 +1023,7 @@ class ContinuousBatcher:
             self._slot_items[adm.slot] = item
             self._chunked = None
             self.stats["admitted"] += 1
+            self._note_first_token(item, adm.slot)
 
     async def _check_pressure(self, after_round: bool = False) -> None:
         """Consume the engine's KV-pressure signal and apply the preemption
@@ -1031,6 +1094,8 @@ class ContinuousBatcher:
         self.stats["preemptions"] += 1
         item.preempt_count += 1
         pre.preempt_count = item.preempt_count
+        self._note(item, "batcher.preempted", slot=slot,
+                   generated=len(pre.generated))
         if item.preempt_count > self.cfg.max_preemptions:
             self.stats["preempted_too_often"] += 1
             if not item.future.done():
@@ -1317,6 +1382,12 @@ class ContinuousBatcher:
                 self.stats["decode_rounds"] += 1
                 self.stats["occupancy_sum"] += self.engine.num_active
                 self._retune(latency)
+                # admission-chunk rounds on the timeline: one bounded note
+                # per in-flight traced admission per round (saturates at
+                # the per-request event cap on pathological prompts)
+                for adm, item in self._ragged:
+                    if item.flight is not None:
+                        self._note(item, "batcher.chunk_round", off=adm.off)
                 # ragged admissions whose final chunk sampled its first
                 # token this round join the batch (the finished-slot sweep
                 # below then resolves any that immediately hit stop/length)
@@ -1325,6 +1396,7 @@ class ContinuousBatcher:
                     self._slot_items[adm.slot] = item
                     self._admit_stamp[adm.slot] = next(self._stamp)
                     self.stats["admitted"] += 1
+                    self._note_first_token(item, adm.slot)
                 for i, s in enumerate(list(self.engine.slots)):
                     if s is not None and s.finish_reason is not None \
                             and i in self._slot_items:
@@ -1337,6 +1409,9 @@ class ContinuousBatcher:
                         )
                         item = self._slot_items.pop(i, None)
                         if item and not item.future.done():
+                            self._note(item, "batcher.completed",
+                                       finish_reason=resp.finish_reason,
+                                       tokens=resp.completion_tokens)
                             item.future.set_result(resp)
                             self.stats["completed"] += 1
                 # streaming observers see each surviving slot's monotonic
@@ -1527,11 +1602,12 @@ class BatcherServing:
         return self.submit_async(request, timeout_s, **hooks).result()
 
     def adopt_slot(self, slot: int,
-                   request: Optional[InferenceRequest] = None
-                   ) -> InferenceResponse:
+                   request: Optional[InferenceRequest] = None,
+                   flight: Optional[Any] = None) -> InferenceResponse:
         assert self.batcher is not None and self._loop is not None
         return asyncio.run_coroutine_threadsafe(
-            self.batcher.adopt_slot(slot, request), self._loop
+            self.batcher.adopt_slot(slot, request, flight=flight),
+            self._loop
         ).result()
 
     def run_exclusive(self, fn: Callable[..., Any], *args: Any,

@@ -3055,7 +3055,7 @@ class TPUEngine:
         self._kv_lens[slot] = 0
         self.stats["completed"] += 1
         now = time.time()
-        return InferenceResponse(
+        resp = InferenceResponse(
             request_id=s.request.request_id,
             token_ids=list(s.generated),
             finish_reason=s.finish_reason or "abort",
@@ -3067,6 +3067,15 @@ class TPUEngine:
             else None,
             e2e_ms=(now - s.start_time) * 1000.0,
         )
+        # flight recorder: the engine's own wall-clock boundaries ride the
+        # response so timeline events can be anchored at the instant the
+        # engine observed them (first token sampled, sequence admitted)
+        # rather than when a driver loop got around to noticing
+        if s.start_time is not None:
+            resp.extra["t_start"] = s.start_time
+        if s.first_token_time is not None:
+            resp.extra["t_first_token"] = s.first_token_time
+        return resp
 
     # ---------------------------------------------------------- generate
 

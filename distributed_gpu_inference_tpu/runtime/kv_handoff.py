@@ -1038,6 +1038,30 @@ class HandoffReceiver:
             "begin_duplicates": 0,
             "commit_replays": 0,
         }
+        # flight recorder: receiver-side begin/commit/abort instants keyed
+        # by session key. The receiver knows only the kv_cache_key — the
+        # decode stage that later claims the adoption pops these into the
+        # request's Timeline (``pop_flight``). Bounded: oldest keys evict
+        # past the cap, duplicate begins/commit replays don't double-note.
+        self._flight: Dict[str, List[Tuple[str, float]]] = {}
+        self.FLIGHT_KEY_CAP = 64
+
+    def _flight_note(self, key: str, name: str) -> None:
+        if not key:
+            return
+        evs = self._flight.get(key)
+        if evs is None:
+            while len(self._flight) >= self.FLIGHT_KEY_CAP:
+                self._flight.pop(next(iter(self._flight)))
+            evs = self._flight[key] = []
+        if len(evs) < 8:
+            evs.append((name, time.time()))
+
+    def pop_flight(self, key: str) -> List[Tuple[str, float]]:
+        """Drain the receiver-side flight events for one session key —
+        ``[(event, wall_ts), ...]`` — for adoption into the claiming
+        request's Timeline. Empty when nothing was recorded."""
+        return self._flight.pop(key, [])
 
     def handle(self, raw: bytes) -> Dict[str, Any]:
         # chaos seam: an installed FaultPlan can truncate or lose this
@@ -1191,6 +1215,7 @@ class HandoffReceiver:
             block_size=meta["block_size"], blocks=list(blocks),
             cached_tokens=cached_tokens, prompt_len=len(prompt),
         )
+        self._flight_note(key, "handoff.rx_begin")
         return {"kv_cache_key": key, "state": "begun",
                 "cached_tokens": cached_tokens}
 
@@ -1333,6 +1358,7 @@ class HandoffReceiver:
             raise
         del self._sessions[key]
         self.stats["commits"] = self.stats.get("commits", 0) + 1
+        self._flight_note(key, "handoff.rx_commit")
         result = {"slot": slot, "kv_cache_key": key, "state": "committed",
                   "streamed": True}
         self._recent_commits[key] = result
@@ -1343,6 +1369,7 @@ class HandoffReceiver:
     def _abort(self, meta: Dict[str, Any]) -> Dict[str, Any]:
         if str(meta.get("key", "")) in self._sessions:
             self.stats["rx_aborts"] = self.stats.get("rx_aborts", 0) + 1
+            self._flight_note(str(meta.get("key", "")), "handoff.rx_abort")
         self._drop(meta.get("key", ""))
         return {"kv_cache_key": meta.get("key"), "state": "aborted"}
 

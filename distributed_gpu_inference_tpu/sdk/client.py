@@ -270,6 +270,16 @@ class InferenceClient:
     def queue_stats(self) -> Dict[str, Any]:
         return self._request("GET", "/api/v1/jobs/stats/queue").json()
 
+    def get_request_timeline(self, job_or_trace_id: str) -> Dict[str, Any]:
+        """Merged flight-recorder timeline for a job (PD stage children
+        resolve to the parent's trace) or a raw trace id: causally-ordered
+        events + derived per-phase durations. 404s (as
+        InferenceClientError) when nothing was recorded — e.g. the request
+        carried no ``trace_id``."""
+        return self._request(
+            "GET", f"/api/v1/debug/requests/{job_or_trace_id}/timeline"
+        ).json()
+
     def _run_job(self, job_type: str, params: Dict[str, Any], sync: bool,
                  timeout_s: float, **extra: Any) -> Dict[str, Any]:
         if sync:
@@ -334,6 +344,7 @@ class InferenceClient:
         priority: int = 0,
         session: Optional[str] = None,
         prefix_hint: Optional[str] = None,
+        trace_id: Optional[str] = None,
         **gen_params: Any,
     ) -> Dict[str, Any]:
         """``priority``: scheduling priority — orders the control-plane
@@ -357,6 +368,10 @@ class InferenceClient:
             params["model"] = model
         if priority:
             params["priority"] = int(priority)
+        if trace_id:
+            # flight recorder: ride the request end to end — fetch the
+            # merged timeline later via get_request_timeline()
+            params["trace_id"] = str(trace_id)
         fps = self._routing_fps(params, prefix_hint)
         if use_direct:
             result = self._try_direct("llm", params, prefix_fps=fps,
@@ -400,6 +415,7 @@ class InferenceClient:
         priority: int = 0,
         session: Optional[str] = None,
         prefix_hint: Optional[str] = None,
+        trace_id: Optional[str] = None,
         **gen_params: Any,
     ):
         """Token streaming via the nearest direct worker's SSE endpoint.
@@ -435,6 +451,11 @@ class InferenceClient:
             # reaches the worker batcher's admission heap: a high-priority
             # stream admits ahead of waiting work on a saturated worker
             params["priority"] = int(priority)
+        if trace_id:
+            # flight recorder: the stream's final done chunk carries the
+            # worker-side timeline; the heartbeat channel ships it to the
+            # plane's merged store too
+            params["trace_id"] = str(trace_id)
 
         stream_id = _uuid.uuid4().hex
         offset = 0            # token offset of the last consumed event
@@ -587,6 +608,7 @@ class InferenceClient:
         self, exclude: Optional[Sequence[str]] = None,
         prefix_fps: Optional[Sequence[str]] = None,
         session: Optional[str] = None,
+        trace_id: Optional[str] = None,
     ) -> Optional[Dict[str, Any]]:
         now = time.time()
         if session and not exclude:
@@ -605,6 +627,10 @@ class InferenceClient:
             # cache-aware routing: the control plane ranks direct workers
             # by advertised prefix affinity (load-spillover-scaled)
             query["prefix_fps"] = ",".join(prefix_fps)
+        if trace_id:
+            # flight recorder: the plane notes its route decision on the
+            # request's timeline (direct requests never pass complete_job)
+            query["trace_id"] = str(trace_id)
         try:
             resp = self._request(
                 "GET", "/api/v1/jobs/direct/nearest",
@@ -651,7 +677,8 @@ class InferenceClient:
         """POST straight to the nearest worker; any failure returns None so
         the caller falls back to the queued path (reference :308-329)."""
         worker = self._get_nearest_worker(prefix_fps=prefix_fps,
-                                          session=session)
+                                          session=session,
+                                          trace_id=params.get("trace_id"))
         if worker is None:
             return None
         try:

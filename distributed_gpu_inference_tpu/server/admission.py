@@ -204,6 +204,20 @@ class AdmissionDecision:
     def admitted(self) -> bool:
         return self.action != "shed"
 
+    def flight_attrs(self) -> Dict[str, Any]:
+        """Flat scalar attrs for the request's flight-recorder
+        ``server.admission`` event — one place decides what a timeline
+        reader sees about the ladder outcome, so the event shape cannot
+        drift from the decision shape."""
+        out: Dict[str, Any] = {"action": self.action, "tier": self.tier}
+        if self.max_tokens is not None:
+            out["max_tokens"] = int(self.max_tokens)
+        if self.disable_spec:
+            out["disable_spec"] = True
+        if self.action == "shed":
+            out["retry_after_s"] = round(float(self.retry_after_s), 3)
+        return out
+
 
 class AdmissionController:
     """Per-tenant budgeting + the degrade/shed ladder. One instance per

@@ -35,9 +35,15 @@ from .admission import (
     estimate_cost_tokens,
     tenant_of,
 )
+from .flight_recorder import FlightRecorder
 from .geo import GeoService
 from .observability import MetricsCollector, StructuredLogger, TracingManager
-from .prefix_routing import PrefixRegistry, RoutingConfig, decide_kv_route
+from .prefix_routing import (
+    PrefixRegistry,
+    RoutingConfig,
+    decide_kv_route,
+    route_flight_attrs,
+)
 from .reliability import ReliabilityService
 from .scheduler import (
     _MAX_DISTANCE,
@@ -115,7 +121,17 @@ class ServerState:
         # flipped/retuned live via GET/PUT /api/v1/admin/admission.
         self.admission = AdmissionController(metrics=self.metrics)
         self.privacy = EnterprisePrivacyService(self.store)
+        # console export is env-driven (DGI_OTEL_CONSOLE) — the knob was
+        # previously unreachable (no caller could ever enable it)
         self.tracing = TracingManager()
+        # request flight recorder (round 14): merged per-request timelines
+        # — server admission/route/claim/complete events plus worker-side
+        # events shipped through results and heartbeats. Always-on and
+        # advisory: every recorder call is wrapped so it can never fail or
+        # reorder a request.
+        self.flight = FlightRecorder(metrics=self.metrics,
+                                     tracing=self.tracing)
+        self.scheduler.attach_flight(self.flight)
         self.log = StructuredLogger("dgi-tpu.server")
         self.api_key = api_key
         self.admin_key = admin_key or api_key
@@ -155,6 +171,45 @@ class ServerState:
 
 def _state(request: web.Request) -> ServerState:
     return request.app["state"]
+
+
+def _stamp_trace(body: Dict[str, Any]) -> str:
+    """Ensure the submission carries a ``trace_id`` (client-supplied on
+    the body or params, minted otherwise) and stamp it into params so it
+    rides the job to workers — PD stage children inherit parent params,
+    so one trace spans the whole disaggregated flow. Returns the id."""
+    params = body.get("params")
+    if not isinstance(params, dict):
+        params = {}
+        body["params"] = params
+    tid = body.get("trace_id") or params.get("trace_id")
+    if not isinstance(tid, str) or not tid:
+        tid = uuid.uuid4().hex[:16]
+    params["trace_id"] = str(tid)[:64]
+    return params["trace_id"]
+
+
+def _log_submission(st: ServerState, trace_id: str,
+                    body: Dict[str, Any], **extra: Any) -> None:
+    """One-request-one-id greppability: server logs for this submission
+    (and everything later code logs through a bound child) carry the
+    trace id + admitted tenant/tier."""
+    params = body.get("params") or {}
+    st.log.bind(
+        trace_id=trace_id,
+        **({"tenant": params["tenant"]} if params.get("tenant") else {}),
+        **({"tier": params["tier"]} if params.get("tier") else {}),
+    ).info("job_submitted", job_type=body.get("type") or "llm", **extra)
+
+
+def _flight_note(st: ServerState, trace_id: Optional[str], event: str,
+                 job_id: Optional[str] = None, **attrs: Any) -> None:
+    """Advisory server-side flight event: the recorder can NEVER fail or
+    reorder a request, so every call is fenced here."""
+    try:
+        st.flight.note(trace_id, event, job_id=job_id, **attrs)
+    except Exception:  # noqa: BLE001 — recorder is advisory by contract
+        pass
 
 
 def _json_error(status: int, detail: str,
@@ -231,6 +286,10 @@ async def _admit_submission(st: ServerState, body: Dict[str, Any]
         tenant, tier, estimate_cost_tokens(params),
         queued, active, st.worker_config, decode_tokens=decode,
     )
+    # admission decision on the request's timeline (shed included — the
+    # trace then records WHY nothing else ever happened to it)
+    _flight_note(st, params.get("trace_id"), "server.admission",
+                 **decision.flight_attrs())
     if not decision.admitted:
         st.metrics.record_request("backpressure", "rejected")
         return _json_error(
@@ -620,6 +679,26 @@ async def heartbeat(request: web.Request) -> web.Response:
         kvmig = es.get("kv_migrate")
         if isinstance(kvmig, dict):
             st.metrics.record_kv_migrate_engine(worker_id, kvmig)
+        # flight-recorder channel: cumulative counters (delta-anchored,
+        # restart re-anchors like every other engine payload) plus a
+        # bounded ring of recently-completed stream timelines — direct
+        # streams never pass complete_job, so their worker-side events
+        # ship here. Ingest UNIONS events per (trace, source) keyed by
+        # name+timestamp and returns False when nothing changed, so the
+        # ring re-shipping on every beat (duplicate delivery) is a no-op
+        # that cannot re-finalize a trace.
+        fl = es.get("flight")
+        if isinstance(fl, dict):
+            st.metrics.record_flight_engine(worker_id, fl)
+            recent = fl.get("recent")
+            if isinstance(recent, list):
+                for wire in recent[:16]:
+                    try:
+                        if st.flight.ingest_wire(worker_id, wire) and \
+                                isinstance(wire, dict) and wire.get("done"):
+                            st.flight.finalize(wire.get("trace_id"))
+                    except Exception:  # noqa: BLE001 — never 500 a beat
+                        pass
         ps = es.get("prefix_summary")
         if ps is not None:
             # cache-aware routing: the worker's advertised radix summary
@@ -697,6 +776,15 @@ async def next_job(request: web.Request) -> web.Response:
             worker_id, current_job_id=None, status=WorkerState.IDLE.value
         )
         return web.Response(status=204)
+    # the claim lands on the request's timeline (+ an OTel span): queue
+    # wait on the queued path is submitted → claimed
+    trace_id = (job.get("params") or {}).get("trace_id") \
+        if isinstance(job.get("params"), dict) else None
+    if trace_id:
+        with st.tracing.span("job.claim", trace_id=trace_id,
+                             worker=worker_id):
+            _flight_note(st, trace_id, "server.claimed",
+                         job_id=job["id"], worker=worker_id)
     st.metrics.record_queue("queued", (await st.store.queue_stats())["queued"])
     return web.json_response({"job": job})
 
@@ -742,6 +830,12 @@ async def complete_job(request: web.Request) -> web.Response:
         return _json_error(404, "job not assigned to this worker")
     body = await request.json()
     success = bool(body.get("success", True))
+    # flight recorder: the worker's per-request timeline rides the result
+    # payload — lift it off before the result is stored (the merged
+    # timeline lands on the job row separately at finalize)
+    flight_wire = None
+    if isinstance(body.get("result"), dict):
+        flight_wire = body["result"].pop("timeline", None)
     claimed_epoch = body.get("assignment_epoch")
     if claimed_epoch is not None and \
             int(claimed_epoch) != int(job.get("assignment_epoch") or 0):
@@ -826,7 +920,53 @@ async def complete_job(request: web.Request) -> web.Response:
         # advance the PD flow (prefill done → enqueue pinned decode child;
         # decode done → merge results into the parent container job)
         await st.pd_flow.on_child_complete(job2)
+    await _flight_complete(st, job2 or job, job_id, worker_id, success,
+                           flight_wire)
     return web.json_response({"ok": True})
+
+
+async def _flight_complete(st: ServerState, job: Dict[str, Any],
+                           job_id: str, worker_id: str, success: bool,
+                           flight_wire: Any) -> None:
+    """Completion-time flight-recorder fan-in: ingest the worker's
+    result-borne events, stamp the completion, derive + observe phases
+    (observe-once per phase — PD children compose: the prefill child's
+    completion lands prefill/ttft, the decode child's lands decode/e2e),
+    and persist the merged timeline with the job (the PD parent's row for
+    stage children). Advisory end to end — any failure is swallowed."""
+    try:
+        params = job.get("params")
+        trace_id = params.get("trace_id") \
+            if isinstance(params, dict) else None
+        if not trace_id:
+            return
+        if flight_wire is not None:
+            st.flight.ingest_wire(worker_id, flight_wire)
+        with st.tracing.span("job.complete", trace_id=trace_id,
+                             worker=worker_id, success=success):
+            _flight_note(st, trace_id, "server.completed", job_id=job_id,
+                         worker=worker_id, success=success)
+        # a PD prefill child's completion is NOT the end of the request:
+        # defer e2e/decode/handoff observation to the decode child's
+        # finalize (observe-once would otherwise lock in a prefill-only
+        # e2e and permanently exclude decode time from the histograms)
+        st.flight.finalize(trace_id, partial=(
+            st.pd_flow.is_pd_child(job)
+            and (params or {}).get("pd_stage") == "prefill"
+        ))
+        tl = st.flight.timeline(trace_id)
+        if tl is None:
+            return
+        target = job_id
+        if st.pd_flow.is_pd_child(job):
+            target = str((params or {}).get("pd_parent") or job_id)
+        await st.store.update_job(target, timeline={
+            "trace_id": trace_id,
+            "events": tl["events"],
+            "phases": tl["phases"],
+        })
+    except Exception:  # noqa: BLE001 — the recorder can never fail a request
+        pass
 
 
 async def checkpoint_job(request: web.Request) -> web.Response:
@@ -1085,17 +1225,22 @@ async def create_job(request: web.Request) -> web.Response:
         if (bp := await _submit_backpressure(st)) is not None:
             return bp
     body = await request.json()
+    trace_id = _stamp_trace(body)
     if st.admission.cfg.enabled and \
             (bp := await _admit_submission(st, body)) is not None:
         return bp
+    _log_submission(st, trace_id, body)
     row = await _make_job_row(request, body)
     if (row.get("params") or {}).get("pd_disaggregated"):
         # PD container job: created RUNNING (never claimable); the flow
         # service places prefill/decode and enqueues the pinned stage jobs
         row["status"] = JobStatus.RUNNING.value
         row["started_at"] = time.time()
-        job_id = await st.store.create_job(row)
+        with st.tracing.span("job.submit", trace_id=trace_id, pd=True):
+            job_id = await st.store.create_job(row)
         st.bp_cache_clear()
+        _flight_note(st, trace_id, "server.submitted", job_id=job_id,
+                     pd=True)
         job = await st.store.get_job(job_id)
         try:
             await st.pd_flow.submit(job)
@@ -1119,8 +1264,10 @@ async def create_job(request: web.Request) -> web.Response:
         return web.json_response(
             {"job_id": job_id, "status": "running", "pd": True}, status=201
         )
-    job_id = await st.store.create_job(row)
+    with st.tracing.span("job.submit", trace_id=trace_id):
+        job_id = await st.store.create_job(row)
     st.bp_cache_clear()
+    _flight_note(st, trace_id, "server.submitted", job_id=job_id)
     st.metrics.record_request(row["type"], "queued")
     return web.json_response({"job_id": job_id, "status": "queued"}, status=201)
 
@@ -1135,6 +1282,7 @@ async def create_job_sync(request: web.Request) -> web.Response:
         if (bp := await _submit_backpressure(st)) is not None:
             return bp
     body = await request.json()
+    trace_id = _stamp_trace(body)
     if st.admission.cfg.enabled and \
             (bp := await _admit_submission(st, body)) is not None:
         return bp
@@ -1143,10 +1291,14 @@ async def create_job_sync(request: web.Request) -> web.Response:
         # a fleet with zero live workers drains nothing: tell clients to
         # come back on the heartbeat-revival timescale, not instantly
         return _json_error(503, "no workers available", retry_after_s=10.0)
+    _log_submission(st, trace_id, body, sync=True)
     row = await _make_job_row(request, body)
     row["priority"] = row["priority"] + 10
-    job_id = await st.store.create_job(row)
+    with st.tracing.span("job.submit", trace_id=trace_id, sync=True):
+        job_id = await st.store.create_job(row)
     st.bp_cache_clear()
+    _flight_note(st, trace_id, "server.submitted", job_id=job_id,
+                 sync=True)
     timeout = min(float(body.get("timeout_seconds") or 120.0), 300.0)
     job = await st.guarantee.wait_for_job(job_id, timeout_s=timeout)
     if job is None:
@@ -1276,6 +1428,8 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
     ))
     best = cands[0]
     migrate_hint: Optional[Dict[str, Any]] = None
+    route_choice: Optional[str] = None
+    route_decision: Optional[Dict[str, Any]] = None
     if fps and st.routing.enabled and st.routing.kv_migrate:
         # cluster-wide KV migration (round 13): a per-request cost model
         # decides route-to-warm / migrate-KV / recompute instead of
@@ -1294,7 +1448,7 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
         )
         choice = "recompute"
         if warm_id is not None and warm_blocks > 0:
-            decision = decide_kv_route(
+            route_decision = decision = decide_kv_route(
                 st.routing, request_blocks=len(fps),
                 matched_blocks=warm_blocks, tier=warm_tier,
                 warm_headroom=headroom[warm_id],
@@ -1330,6 +1484,15 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
                     "tier": warm_tier,
                 }
         st.metrics.record_kv_route_decision("direct", choice)
+        route_choice = choice
+    # direct-path requests never pass complete_job: a client that wants
+    # the route decision on its timeline sends its trace_id with the
+    # discovery query (the SDK/bench do) — the worker-side events arrive
+    # through the heartbeat flight channel instead
+    _flight_note(st, request.query.get("trace_id"), "server.route",
+                 **route_flight_attrs(route_choice or "direct",
+                                      route_decision,
+                                      worker_id=best["id"]))
     if fps and st.routing.enabled:
         chosen_raw = st.prefix_registry.affinity(best["id"], fps, now=now)
         best_raw = st.prefix_registry.best_affinity_among(
@@ -1354,6 +1517,52 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
 async def queue_stats(request: web.Request) -> web.Response:
     st = _state(request)
     return web.json_response(await st.scheduler.get_queue_stats())
+
+
+# ---------------------------------------------------------------------------
+# debug API: request flight recorder
+# ---------------------------------------------------------------------------
+
+
+async def debug_request_timeline(request: web.Request) -> web.Response:
+    """Merged per-request timeline: server admission/route/claim/complete
+    events + worker-side events (batcher, PD handoff from BOTH workers,
+    kv-migration pulls), causally ordered, with the derived phase
+    durations. The path segment accepts a job id (PD stage children
+    resolve to the parent's trace) or a raw trace id; after a plane
+    restart the completion-time snapshot persisted on the job row
+    answers instead."""
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    ref = request.match_info["job_id"]
+    tl = st.flight.timeline_for_job(ref) or st.flight.timeline(ref)
+    if tl is not None:
+        return web.json_response({"job_id": ref, **tl})
+    job = await st.store.get_job(ref)
+    if job is not None and isinstance(job.get("timeline"), dict):
+        return web.json_response(
+            {"job_id": ref, "stored": True, **job["timeline"]}
+        )
+    if job is not None and isinstance(job.get("params"), dict) \
+            and job["params"].get("trace_id"):
+        stored = st.flight.timeline(job["params"]["trace_id"])
+        if stored is not None:
+            return web.json_response({"job_id": ref, **stored})
+    return _json_error(404, f"no timeline recorded for {ref}")
+
+
+async def debug_slowest_requests(request: web.Request) -> web.Response:
+    """Per-phase exemplar rings: the N slowest traces seen per phase
+    (ring-buffered, slowest first) — the index from a histogram-tail
+    alert to the concrete requests behind it."""
+    if (err := _check_api_key(request)) is not None:
+        return err
+    st = _state(request)
+    return web.json_response({
+        "exemplars": st.flight.slowest(),
+        "stats": dict(st.flight.stats),
+    })
 
 
 # ---------------------------------------------------------------------------
@@ -1945,6 +2154,13 @@ def create_app(state: Optional[ServerState] = None,
     app.router.add_get(f"{API}/jobs/stats/queue", queue_stats)
     app.router.add_get(f"{API}/jobs/{{job_id}}", get_job)
     app.router.add_delete(f"{API}/jobs/{{job_id}}", cancel_job)
+
+    # static path FIRST (aiohttp matches in registration order): /slowest
+    # must not be swallowed by the {job_id} route
+    app.router.add_get(f"{API}/debug/requests/slowest",
+                       debug_slowest_requests)
+    app.router.add_get(f"{API}/debug/requests/{{job_id}}/timeline",
+                       debug_request_timeline)
 
     app.router.add_get(f"{API}/admin/stats/dashboard", admin_dashboard)
     app.router.add_get(f"{API}/admin/stats/realtime", admin_realtime)

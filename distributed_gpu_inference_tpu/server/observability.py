@@ -20,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import os
 import time
 from typing import Any, Dict, Iterator, Optional
 
@@ -52,6 +53,15 @@ except Exception:  # pragma: no cover
 # ---------------------------------------------------------------------------
 # Prometheus metrics (no-op fallbacks when the client is absent)
 # ---------------------------------------------------------------------------
+
+# request_phase_latency_seconds bucket boundaries: sub-ms resolution for
+# worker-side phases (queue wait on an idle batcher, a local handoff),
+# stretching to multi-minute long-context e2e. Module-level so tests and
+# dashboards share one source of truth.
+PHASE_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
 
 
 class _Noop:
@@ -101,6 +111,8 @@ class Metrics:
                 "admission_decisions", "tenant_admissions",
                 "autoscaler_decisions", "autoscaler_replicas",
                 "autoscaler_slo", "autoscaler_cold_start",
+                "request_phase_latency", "flight_timelines",
+                "flight_events_dropped",
             ):
                 setattr(self, name, noop)
             return
@@ -378,6 +390,25 @@ class Metrics:
             "autoscaler_cold_start_seconds",
             "Measured replica cold-start time (EMA) used as scale-out "
             "lead time", registry=r)
+        # request flight recorder (round 14): per-phase latency
+        # attribution — until now only hop and kv-migration latencies had
+        # histograms; a p95 blowout could not be attributed to queue wait
+        # vs prefill vs handoff vs decode. Buckets span sub-ms worker-side
+        # phases through multi-minute long-context e2e.
+        self.request_phase_latency = Histogram(
+            "request_phase_latency_seconds",
+            "Per-request phase latency from merged flight-recorder "
+            "timelines (queue_wait / prefill / ttft / handoff / decode / "
+            "e2e)", ["phase"], registry=r,
+            buckets=PHASE_LATENCY_BUCKETS)
+        self.flight_timelines = Counter(
+            "flight_timelines_total",
+            "Per-request timelines recorded by each worker's flight "
+            "recorder", ["worker"], registry=r)
+        self.flight_events_dropped = Counter(
+            "flight_events_dropped_total",
+            "Flight-recorder events dropped at the per-request cap",
+            ["worker"], registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -404,6 +435,7 @@ class MetricsCollector:
         self._batcher_prev: Dict[str, Dict[str, int]] = {}
         self._pd_prev: Dict[str, Dict[str, int]] = {}
         self._kvmig_prev: Dict[str, Dict[str, int]] = {}
+        self._flight_prev: Dict[str, Dict[str, int]] = {}
         # bounded tenant-label admission (insertion-ordered dict as LRU):
         # once full, unseen tenants map to "other" — existing series keep
         # their labels (a label that has emitted samples must not migrate)
@@ -651,6 +683,43 @@ class MetricsCollector:
                 ).inc(delta)
             prev[key] = cur
 
+    def record_phase(self, phase: str, seconds: float) -> None:
+        """One derived flight-recorder phase duration → the
+        ``request_phase_latency_seconds{phase}`` histogram. Unknown phase
+        names are recorded as-is (the label set is the canonical
+        ``runtime.flight.PHASES``, but the histogram is not the place to
+        police it)."""
+        try:
+            self.metrics.request_phase_latency.labels(str(phase)).observe(
+                float(seconds)
+            )
+        except (TypeError, ValueError):
+            pass
+
+    def record_flight_engine(self, worker: str,
+                             stats: Dict[str, Any]) -> None:
+        """Ingest one worker's flight-recorder counters (heartbeat
+        ``engine_stats["flight"]`` — cumulative ``timelines`` /
+        ``events_dropped``). Same delta anchoring as the
+        spec/pressure/pd/kv-migrate payloads: totals re-anchor on engine
+        restart (a smaller total emits no bogus negative delta, just
+        re-anchors), malformed fields skip the sample."""
+        prev = self._flight_prev.setdefault(worker, {})
+        for key, metric in (
+            ("timelines", self.metrics.flight_timelines),
+            ("events_dropped", self.metrics.flight_events_dropped),
+        ):
+            if key not in stats:
+                continue
+            try:
+                cur = int(stats.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                continue
+            delta = cur - prev.get(key, 0)
+            if delta > 0:
+                metric.labels(worker).inc(delta)
+            prev[key] = cur
+
     def record_kv_route_decision(self, path: str, choice: str) -> None:
         """One cost-model route decision on ``path`` (``direct`` discovery
         or the ``queued`` claim): warm / migrate / recompute."""
@@ -771,9 +840,21 @@ class MetricsCollector:
 # ---------------------------------------------------------------------------
 
 
+def otel_console_from_env() -> bool:
+    """``DGI_OTEL_CONSOLE=1`` turns on the console span exporter — the
+    previously-unreachable ``TracingManager(console_export=...)`` knob
+    (no caller could ever enable it) is now operator-settable without a
+    code change. Off by default."""
+    return os.environ.get("DGI_OTEL_CONSOLE", "").strip().lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
 class TracingManager:
     def __init__(self, service_name: str = "dgi-tpu",
-                 console_export: bool = False) -> None:
+                 console_export: Optional[bool] = None) -> None:
+        if console_export is None:
+            console_export = otel_console_from_env()
         self.enabled = HAVE_OTEL
         if not self.enabled:
             self._tracer = None
@@ -801,6 +882,27 @@ class TracingManager:
             except Exception as exc:
                 sp.record_exception(exc)
                 raise
+
+    def emit_span(self, name: str, start_s: float, end_s: float,
+                  **attributes: Any) -> None:
+        """One RETROACTIVE span (explicit wall-clock start/end): the
+        flight recorder derives phase boundaries after the fact and maps
+        each onto an OTel span. No-op without opentelemetry; best-effort
+        with it (a tracing failure must never fail a request)."""
+        if not self.enabled or self._tracer is None:
+            return
+        try:
+            sp = self._tracer.start_span(
+                name, start_time=int(float(start_s) * 1e9)
+            )
+            for k, v in attributes.items():
+                try:
+                    sp.set_attribute(k, v)
+                except Exception:  # noqa: BLE001
+                    pass
+            sp.end(end_time=int(float(end_s) * 1e9))
+        except Exception:  # noqa: BLE001 — advisory by contract
+            pass
 
 
 @contextlib.contextmanager

@@ -541,3 +541,22 @@ def decide_kv_route(cfg: RoutingConfig, *, request_blocks: int,
         eligible.append("migrate")
     choice = min(eligible, key=lambda c: costs[c])
     return {"choice": choice, "costs": costs}
+
+
+def route_flight_attrs(choice: str,
+                       decision: Optional[Dict[str, Any]] = None,
+                       worker_id: Optional[str] = None) -> Dict[str, Any]:
+    """Flat scalar attrs for a request's ``server.route`` flight event —
+    the one formatter both route paths (direct discovery and the claim
+    arbitration) use, so a timeline reader sees the same shape either
+    way. Costs are rounded to keep the event wire-lean."""
+    out: Dict[str, Any] = {"choice": str(choice)}
+    if worker_id:
+        out["worker"] = str(worker_id)
+    if decision and isinstance(decision.get("costs"), dict):
+        for k, v in decision["costs"].items():
+            try:
+                out[f"cost_{k}"] = round(float(v), 4)
+            except (TypeError, ValueError):
+                continue
+    return out

@@ -133,6 +133,28 @@ class SmartScheduler:
         # never a placement gate
         self._prefix_registry = prefix_registry
         self._metrics = metrics
+        # request flight recorder (round 14): claim-path route decisions
+        # land on the request's timeline. Attached post-construction by
+        # ServerState (the recorder needs metrics/tracing built first).
+        self._flight = None
+
+    def attach_flight(self, flight: Any) -> None:
+        self._flight = flight
+
+    def _flight_note(self, job: Dict[str, Any], event: str,
+                     **attrs: Any) -> None:
+        """Advisory flight event for a claimed job — never raises, never
+        reorders (the recorder is an observer, not a participant)."""
+        if self._flight is None:
+            return
+        params = job.get("params")
+        tid = params.get("trace_id") if isinstance(params, dict) else None
+        if not tid:
+            return
+        try:
+            self._flight.note(tid, event, job_id=job.get("id"), **attrs)
+        except Exception:  # noqa: BLE001 — recorder is advisory
+            pass
 
     # -- scoring (reference scheduler.py:111-164) ---------------------------
 
@@ -326,6 +348,10 @@ class SmartScheduler:
                     }
         if self._metrics is not None:
             self._metrics.record_kv_route_decision("queued", choice)
+        from .prefix_routing import route_flight_attrs
+
+        self._flight_note(job, "server.route",
+                          **route_flight_attrs(choice, worker_id=worker_id))
 
     # -- queue stats (reference scheduler.py:236-280) ------------------------
 

@@ -45,7 +45,7 @@ _WORKER_JSON = {
     "mesh_shape",
     "load_stats",
 }
-_JOB_JSON = {"params", "result", "checkpoint", "prefix_fps"}
+_JOB_JSON = {"params", "result", "checkpoint", "prefix_fps", "timeline"}
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS workers (
@@ -249,6 +249,13 @@ _MIGRATIONS = [
     (8, "ALTER TABLE usage_records ADD COLUMN tier TEXT"),
     (8, "CREATE INDEX IF NOT EXISTS idx_usage_tenant "
         "ON usage_records (tenant, created_at)"),
+    # v9: request flight recorder — the merged per-request timeline is
+    # stored with the job at completion (bounded by the recorder's
+    # per-job event cap), so GET /debug/requests/{id}/timeline survives a
+    # control-plane restart and post-mortems read from the same row the
+    # result lives on. Advisory: a write failure is swallowed — the
+    # recorder can never fail a request.
+    (9, "ALTER TABLE jobs ADD COLUMN timeline TEXT"),
 ]
 
 SCHEMA_VERSION = max(

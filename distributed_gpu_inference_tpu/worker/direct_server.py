@@ -91,6 +91,14 @@ class DirectServer:
         params = body.get("params")
         if isinstance(params, dict):
             params.pop("_failover_ctx", None)
+            # flight recorder: the arrival stamps are worker-minted too —
+            # a client-forged pickup time would skew phase attribution
+            params.pop("_flight_picked_up_ts", None)
+            params.pop("_flight_tl", None)
+            if params.get("trace_id"):
+                # direct requests skip the queue: the "pickup" is the
+                # moment this server admitted the request
+                params["_flight_picked_up_ts"] = time.time()
         accept = getattr(self.worker, "should_accept_job", None)
         if accept is not None and not accept({"type": task_type}):
             self.stats["rejected"] += 1

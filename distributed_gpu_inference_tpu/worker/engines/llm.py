@@ -34,6 +34,7 @@ from ...runtime.batcher import (
 from ...testing import faults as _faults
 from ...utils.backoff import full_jitter_delay
 from ...runtime.engine import EngineConfig, PreemptedSequence, TPUEngine
+from ...runtime.flight import NULL_TIMELINE, timeline_for
 from ...runtime.prefix_summary import TIER_HOST, TIER_SPILL, PrefixHotSet
 from ...utils.config import ServingConfig
 from ...utils.data_structures import InferenceRequest, SamplingParams
@@ -387,6 +388,18 @@ class TPULLMEngine(LLMBaseEngine):
             "pull_bytes": 0, "pull_blocks": 0,
             "exports": 0, "export_bytes": 0,
         }
+        # request flight recorder (round 14): per-request Timelines for
+        # traced requests (params carry a trace_id). Completed timelines
+        # ride job results (complete_job) AND a bounded heartbeat ring
+        # (direct streams never pass complete_job); cumulative counters
+        # delta-anchor into flight_timelines_total / events_dropped on the
+        # plane. Always advisory — a recorder problem never fails a job.
+        self.flight_stats: Dict[str, int] = {
+            "timelines": 0, "events_dropped": 0,
+        }
+        from collections import deque as _deque
+
+        self._flight_recent: Any = _deque(maxlen=8)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -782,6 +795,12 @@ class TPULLMEngine(LLMBaseEngine):
         if stage == "prefill":
             return self.pd_prefill(params)
         if stage is None:
+            # flight recorder: the request's Timeline is minted here (the
+            # single entry point for non-PD inference) and stashed through
+            # params so the migrate hook and the terminal driver share it
+            tl = self._flight_timeline(params)
+            if tl.enabled:
+                params["_flight_tl"] = tl
             # router-hinted KV migration: pull the hot prefix from the
             # named peer BEFORE admission (never under the engine lock —
             # the peer's export serializes on ITS engine; ours adopts the
@@ -807,7 +826,12 @@ class TPULLMEngine(LLMBaseEngine):
                 # registers for heartbeat checkpointing and resumes from a
                 # server-held checkpoint when the claim carries one
                 return self._job_inference(params, ctx)
-            return super().inference(params)
+            tl = params.pop("_flight_tl", NULL_TIMELINE)
+            tl.note("worker.start", path="legacy")
+            out = super().inference(params)
+        tl.note("worker.done")
+        self._flight_finish(tl, out if isinstance(out, dict) else None)
+        return out
 
     def _serving_inference(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Blocking request through the batcher front-end (direct server /
@@ -815,6 +839,8 @@ class TPULLMEngine(LLMBaseEngine):
         as the legacy ``_generate`` path, but concurrent callers share
         decode rounds via slot-level continuous batching."""
         cfg = GenerationConfig.from_params(params)
+        tl = params.pop("_flight_tl", NULL_TIMELINE)
+        tl.note("worker.start", path="serving")
         req = self._build_request(
             params.get("messages") or params.get("prompt") or "", cfg,
             token_ids=params.pop("_kvmig_token_ids", None),
@@ -824,14 +850,17 @@ class TPULLMEngine(LLMBaseEngine):
         if params.get("speculative") is False:
             req.params["speculative"] = False
         t0 = time.perf_counter()
-        resp = self.serving.submit(req)
+        resp = self.serving.submit(req, flight=tl if tl.enabled else None)
         if resp.error is not None:
             _raise_serving(resp)
-        return self._finish_payload(
+        tl.note("worker.done")
+        payload = self._finish_payload(
             list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
             resp.finish_reason or "stop", cfg, resp.ttft_ms,
             time.perf_counter() - t0,
         )
+        self._flight_finish(tl, payload)
+        return payload
 
     def _pd_push(self, client: Any, url: str, content: bytes) -> Any:
         """POST one handoff message with a per-piece timeout and a bounded
@@ -946,6 +975,10 @@ class TPULLMEngine(LLMBaseEngine):
         # the key rides IN the handoff (session_id) so the receiver can
         # index the adopted slot for the decode-stage job
         req.session_id = key
+        # flight recorder: the prefill child's events merge into the PD
+        # parent's trace (children inherit parent params, trace_id included)
+        tl = self._flight_timeline(params)
+        tl.note("pd.prefill.start", key=key)
         decode_url = params.get("decode_url")
         local = not decode_url or params.get("decode_worker") in (
             None, params.get("target_worker"),
@@ -967,6 +1000,7 @@ class TPULLMEngine(LLMBaseEngine):
                     params.get("pd_stream_piece_blocks")
                     or self.config.get("pd_stream_piece_blocks", 4)
                 ),
+                tl=tl,
             )
         def _prefill_and_export():
             # engine-touching block: under a batcher it runs on the engine
@@ -1006,9 +1040,11 @@ class TPULLMEngine(LLMBaseEngine):
         with self._engine_lock:
             slot, first_token, ttft_ms, prompt_tokens, raw = \
                 self._exclusive(_prefill_and_export)
+        tl.note("pd.prefill.done", ttft_ms=ttft_ms)
         if local:
             self.pd_stats["handoffs_local"] += 1
-            return {
+            tl.note("handoff.local")
+            out = {
                 "pd_stage": "prefill", "kv_cache_key": key,
                 "first_token": first_token, "ttft_ms": ttft_ms,
                 "migration_bytes": 0, "migration_ms": 0.0,
@@ -1019,10 +1055,13 @@ class TPULLMEngine(LLMBaseEngine):
                           "completion_tokens": 0,
                           "total_tokens": prompt_tokens},
             }
+            self._flight_finish(tl, out)
+            return out
         # network push OUTSIDE the engine lock: a peer pushing to US can
         # adopt concurrently (kv_receiver takes the lock the engine work
         # above released) — no crossed-push deadlock
         t0 = time.perf_counter()
+        tl.note("handoff.begin", bytes=len(raw))
         try:
             with httpx.Client() as client:
                 resp = self._pd_push(
@@ -1030,12 +1069,15 @@ class TPULLMEngine(LLMBaseEngine):
                 )
         except Exception:
             self.pd_stats["handoffs_failed"] += 1
+            tl.note("handoff.failed")
+            self._flight_finish(tl)   # ships via the heartbeat ring
             raise
         migration_ms = (time.perf_counter() - t0) * 1000.0
         remote = resp.json()
         self.pd_stats["handoffs_committed"] += 1
         self.pd_stats["handoff_bytes"] += len(raw)
-        return {
+        tl.note("handoff.commit", bytes=len(raw))
+        out = {
             "pd_stage": "prefill", "kv_cache_key": key,
             "first_token": first_token, "ttft_ms": ttft_ms,
             "migration_bytes": len(raw), "migration_ms": migration_ms,
@@ -1044,10 +1086,13 @@ class TPULLMEngine(LLMBaseEngine):
                       "completion_tokens": 0,
                       "total_tokens": prompt_tokens},
         }
+        self._flight_finish(tl, out)
+        return out
 
     def _pd_prefill_streamed(self, req: InferenceRequest, key: str,
                              decode_url: str,
-                             piece_blocks: int = 4) -> Dict[str, Any]:
+                             piece_blocks: int = 4,
+                             tl: Any = NULL_TIMELINE) -> Dict[str, Any]:
         """Streamed prefill stage: pages cross the wire WHILE the prompt is
         still computing (``runtime.kv_handoff.StreamedExport``). A sender
         thread drains the message queue so network I/O never runs under the
@@ -1126,6 +1171,7 @@ class TPULLMEngine(LLMBaseEngine):
                 gen.close()
             return t_end
 
+        tl.note("handoff.begin", streamed=True)
         try:
             with self._engine_lock:
                 t_prefill_end = self._exclusive(_drive_export)
@@ -1133,6 +1179,8 @@ class TPULLMEngine(LLMBaseEngine):
             q.put(None)
             sender.join(timeout=60.0)
             _abort_remote()
+            tl.note("handoff.failed")
+            self._flight_finish(tl)   # ships via the heartbeat ring
             raise
         q.put(None)
         # generous wire budget: bytes / ~1 MB/s, floor 120 s — a slower link
@@ -1144,6 +1192,8 @@ class TPULLMEngine(LLMBaseEngine):
             )
         if state["exc"] is not None:
             _abort_remote()
+            tl.note("handoff.failed")
+            self._flight_finish(tl)
             raise state["exc"]
         remote = state["last"] or {}
         self.pd_stats["handoffs_committed"] += 1
@@ -1153,7 +1203,20 @@ class TPULLMEngine(LLMBaseEngine):
             if state["t_ack"] is not None and t_prefill_end is not None
             else None
         )
-        return {
+        # perf_counter stamps → wall clock for the timeline (one shared
+        # offset; sub-ms drift over a handoff is noise)
+        wall_minus_perf = time.time() - time.perf_counter()
+        if t_prefill_end is not None:
+            tl.note_at("pd.prefill.done", t_prefill_end + wall_minus_perf,
+                       ttft_ms=exp.ttft_ms)
+        else:
+            tl.note("pd.prefill.done", ttft_ms=exp.ttft_ms)
+        if state["t_ack"] is not None:
+            tl.note_at("handoff.commit", state["t_ack"] + wall_minus_perf,
+                       bytes=exp.bytes_sent, pieces=exp.pieces_sent)
+        else:
+            tl.note("handoff.commit", bytes=exp.bytes_sent)
+        out = {
             "pd_stage": "prefill", "kv_cache_key": key,
             "first_token": exp.first_token, "ttft_ms": exp.ttft_ms,
             "migration_bytes": exp.bytes_sent,
@@ -1166,6 +1229,8 @@ class TPULLMEngine(LLMBaseEngine):
                       "completion_tokens": 0,
                       "total_tokens": exp.prompt_tokens},
         }
+        self._flight_finish(tl, out)
+        return out
 
     def pd_decode(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Decode stage: resume the adopted (or retained) slot and stream
@@ -1174,6 +1239,13 @@ class TPULLMEngine(LLMBaseEngine):
         if not self.loaded or self.engine is None:
             raise EngineLoadError("engine not loaded")
         key = params.get("kv_cache_key") or ""
+        tl = self._flight_timeline(params)
+        tl.note("pd.decode.start", key=key)
+        if tl.enabled and self._handoff_rx is not None:
+            # adopt the receiver-side handoff instants (begin/commit were
+            # observed by the data-plane thread, which knows only the
+            # session key) into this request's timeline
+            tl.extend_at(self._handoff_rx.pop_flight(key))
         entry = self._pd_slots.pop(key, None)
         if entry is None:
             raise RuntimeError(
@@ -1195,7 +1267,9 @@ class TPULLMEngine(LLMBaseEngine):
             # generation (it preempts/resumes like any other sequence)
             seq = eng.slots[slot]
             try:
-                resp = self.serving.adopt_slot(slot)
+                resp = self.serving.adopt_slot(
+                    slot, flight=tl if tl.enabled else None
+                )
             except Exception:
                 self._release_adopted_slot(eng, slot, seq)
                 raise
@@ -1217,7 +1291,8 @@ class TPULLMEngine(LLMBaseEngine):
                 raise
             resp = eng.finish_slot(slot)
         text = self.tokenizer.decode(resp.token_ids) if self.tokenizer else ""
-        return {
+        tl.note("pd.decode.done", tokens=resp.completion_tokens)
+        out = {
             "pd_stage": "decode", "kv_cache_key": key,
             "text": text,
             "token_ids": list(resp.token_ids),
@@ -1232,6 +1307,8 @@ class TPULLMEngine(LLMBaseEngine):
                       "completion_tokens": resp.completion_tokens,
                       "total_tokens": resp.completion_tokens},
         }
+        self._flight_finish(tl, out)
+        return out
 
     def _release_adopted_slot(self, eng: TPUEngine, slot: int,
                               seq: Any) -> None:
@@ -1421,15 +1498,18 @@ class TPULLMEngine(LLMBaseEngine):
         hint = params.get("kv_migrate_from")
         if not isinstance(hint, dict):
             return
+        tl = params.get("_flight_tl") or NULL_TIMELINE
         url = str(hint.get("data_plane_url") or "").rstrip("/")
         stats = self.kv_migrate_stats
         if not url or not self.kv_migrate_enabled or not self.loaded \
                 or self.engine is None \
                 or not self.engine.cfg.enable_prefix_cache:
             stats["fallback_recompute"] += 1
+            tl.note("kv_migrate.fallback", reason="disabled")
             return
         if not self._kvmig_peer_allowed(url):
             stats["fallback_recompute"] += 1
+            tl.note("kv_migrate.fallback", reason="budget_or_backoff")
             return
         import uuid as _uuid
 
@@ -1452,6 +1532,7 @@ class TPULLMEngine(LLMBaseEngine):
             params["_kvmig_token_ids"] = token_ids
             if len(token_ids) < eng.cfg.block_size:
                 stats["fallback_recompute"] += 1
+                tl.note("kv_migrate.fallback", reason="short_prompt")
                 self._kvmig_peer_result(url, ok=True)
                 return
             # already warm locally? The router hints until OUR summary
@@ -1474,8 +1555,11 @@ class TPULLMEngine(LLMBaseEngine):
                 local = self._exclusive(_local_depth)
             if local >= max(1, n_full - 1):
                 stats["local_hits"] += 1
+                tl.note("kv_migrate.local_hit", blocks=local)
                 self._kvmig_peer_result(url, ok=True)
                 return
+            tl.note("kv_migrate.begin", peer=hint.get("worker_id"),
+                    matched_blocks=hint.get("matched_blocks"))
             req_raw = pack_export_request(
                 key=key, token_ids=token_ids,
                 model_name=eng.model_cfg.name,
@@ -1501,6 +1585,7 @@ class TPULLMEngine(LLMBaseEngine):
                 # peer has nothing cached (evicted since the router's
                 # summary): an honest miss, not a peer failure
                 stats["fallback_recompute"] += 1
+                tl.note("kv_migrate.fallback", reason="peer_miss")
                 self._kvmig_peer_result(url, ok=True)
                 return
             committed = None
@@ -1523,9 +1608,13 @@ class TPULLMEngine(LLMBaseEngine):
                                                or 0)
                                            // eng.cfg.block_size))
             stats["pull_bytes"] += sum(len(f) for f in frames)
+            tl.note("kv_migrate.pulled",
+                    blocks=int(committed.get("blocks") or 0),
+                    bytes=sum(len(f) for f in frames))
             self._kvmig_peer_result(url, ok=True)
         except Exception as exc:  # noqa: BLE001 — migration is best-effort
             stats["aborted"] += 1
+            tl.note("kv_migrate.aborted")
             # a 4xx is the peer REJECTING the pull (incompatible engine,
             # migration disabled) — pin it out instead of re-knocking
             # after every backoff window (mirrors _pd_push's no-retry-4xx)
@@ -1555,6 +1644,58 @@ class TPULLMEngine(LLMBaseEngine):
             if v:
                 out["prefix_commits"] = v
         return out or None
+
+    # -- request flight recorder (round 14) ---------------------------------
+
+    def _flight_timeline(self, params: Dict[str, Any]) -> Any:
+        """A Timeline for the request iff it carries a ``trace_id`` (the
+        shared no-op NULL_TIMELINE otherwise — hot paths note
+        unconditionally). Adopts the poll-pickup instant the worker claim
+        path stamped into params before dispatch."""
+        tl = timeline_for(
+            params, source=str(getattr(self, "fault_tag", "") or "")
+        )
+        ts = params.pop("_flight_picked_up_ts", None)
+        if ts is not None and tl.enabled:
+            tl.note_at("worker.picked_up", ts)
+        return tl
+
+    def _flight_finish(self, tl: Any,
+                       payload: Optional[Dict[str, Any]] = None) -> None:
+        """Close one request's timeline: count it, retain it in the
+        bounded heartbeat ring (the channel direct streams ship through),
+        and attach the wire to the result payload when one is given (the
+        complete_job channel). Never raises — the recorder is advisory."""
+        try:
+            if not getattr(tl, "enabled", False):
+                return
+            wire = tl.wire(done=True)
+            if wire is None:
+                return
+            self.flight_stats["timelines"] += 1
+            if tl.dropped:
+                self.flight_stats["events_dropped"] += int(tl.dropped)
+            self._flight_recent.append(wire)
+            if payload is not None:
+                payload["timeline"] = wire
+        except Exception:  # noqa: BLE001 — never fail a request for this
+            pass
+
+    def flight_wire_stats(self) -> Optional[Dict[str, Any]]:
+        """Heartbeat ``engine_stats["flight"]`` payload: cumulative
+        counters (delta-anchored on the plane, restart re-anchors) plus
+        the bounded ring of recently-completed timelines. The ring is
+        re-shipped every beat — the plane's ingest unions events per
+        (trace, source) keyed by name+timestamp, so duplicate delivery
+        is a no-op.
+        None while nothing was ever traced (no payload bloat)."""
+        if not self.flight_stats["timelines"]:
+            return None
+        return {
+            "timelines": int(self.flight_stats["timelines"]),
+            "events_dropped": int(self.flight_stats["events_dropped"]),
+            "recent": list(self._flight_recent),
+        }
 
     # -- crash-safe generation: live checkpoints + resumable drivers --------
 
@@ -1691,6 +1832,8 @@ class TPULLMEngine(LLMBaseEngine):
             raise EngineLoadError("engine not loaded")
         if self.serving is not None and self.serving.active:
             return self._job_inference_serving(params, cfg, key, epoch, ckpt)
+        tl = params.pop("_flight_tl", NULL_TIMELINE)
+        tl.note("worker.start", path="job")
         if not isinstance(ckpt, dict) and self._spec is not None \
                 and cfg.temperature <= 0.0:
             # standalone tree-speculative decoder (engine=jax-speculative):
@@ -1742,11 +1885,17 @@ class TPULLMEngine(LLMBaseEngine):
         finally:
             self._unregister_live(key)
         resp = eng.finish_slot(slot)
-        return self._finish_payload(
+        if tl.enabled and resp.extra.get("t_first_token") is not None:
+            # engine-observed instant, not loop-observed
+            tl.note_at("batcher.first_token", resp.extra["t_first_token"])
+        tl.note("worker.done")
+        payload = self._finish_payload(
             list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
             resp.finish_reason or "stop", cfg, resp.ttft_ms,
             time.perf_counter() - t0,
         )
+        self._flight_finish(tl, payload)
+        return payload
 
     def _job_inference_serving(self, params: Dict[str, Any],
                                cfg: GenerationConfig, key: str, epoch: int,
@@ -1758,20 +1907,26 @@ class TPULLMEngine(LLMBaseEngine):
         :class:`JobMigrated` — the batcher freezes the sequence at the next
         step boundary and hands back the portable checkpoint."""
         t0 = time.perf_counter()
+        tl = params.pop("_flight_tl", NULL_TIMELINE)
+        tl.note("worker.start", path="job_serving")
         pre: Optional[PreemptedSequence] = None
         if isinstance(ckpt, dict):
             pre = PreemptedSequence.from_wire(ckpt)
             remaining = (pre.request.sampling.max_new_tokens
                          - len(pre.generated))
+            tl.note("worker.resume_from_checkpoint",
+                    tokens=len(pre.generated))
             if remaining <= 0:
                 # the checkpoint already holds the whole generation: the
                 # previous worker died between its last decode and its
                 # complete_job — deliver without touching the engine
-                return self._finish_payload(
+                payload = self._finish_payload(
                     list(pre.generated), pre.prompt_len,
                     pre.cached_tokens, "length", cfg, None,
                     time.perf_counter() - t0,
                 )
+                self._flight_finish(tl, payload)
+                return payload
             req = pre.request
         else:
             req = self._build_request(
@@ -1794,7 +1949,8 @@ class TPULLMEngine(LLMBaseEngine):
             self._register_live(key, "job", epoch, req.request_id)
         try:
             resp = self.serving.submit(
-                req, resume_from=pre, interrupt=interrupt
+                req, resume_from=pre, interrupt=interrupt,
+                flight=tl if tl.enabled else None,
             )
         except RequestMigrated as mig:
             raise JobMigrated(mig.pre.to_wire(),
@@ -1804,11 +1960,14 @@ class TPULLMEngine(LLMBaseEngine):
                 self._unregister_live(key)
         if resp.error is not None:
             _raise_serving(resp)
-        return self._finish_payload(
+        tl.note("worker.done")
+        payload = self._finish_payload(
             list(resp.token_ids), resp.prompt_tokens, resp.cached_tokens,
             resp.finish_reason or "stop", cfg, resp.ttft_ms,
             time.perf_counter() - t0,
         )
+        self._flight_finish(tl, payload)
+        return payload
 
     def _ride_out_pressure(self, eng: TPUEngine, slot: int) -> int:
         """Queued-job KV-pressure recovery without a batcher above us:
@@ -1908,10 +2067,40 @@ class TPULLMEngine(LLMBaseEngine):
         (``serving.mode: direct``). Both emit the same chunk contract:
         ``{"text_delta", "token_ids", "offset"}...`` then a final
         ``{"done": True, "finish_reason", "usage", "offset"}``."""
+        tl = self._flight_timeline(params)
+        if tl.enabled:
+            params["_flight_tl"] = tl
         self._maybe_migrate_kv(params)
         if self.serving is not None and self.serving.active:
             return self._stream_serving(params, cancel=cancel)
+        if tl.enabled:
+            params.pop("_flight_tl", None)
+            return self._stream_direct_traced(tl, params, cancel)
         return self._stream_direct(params, cancel=cancel)
+
+    def _stream_direct_traced(self, tl: Any, params: Dict[str, Any],
+                              cancel: Optional[Any] = None):
+        """Traced wrapper for the legacy per-step stream driver: the
+        driver itself predates the recorder, so the wrapper notes the
+        stream boundaries and closes the timeline — attaching the wire to
+        the final chunk exactly like ``_stream_serving`` does (streams
+        never pass ``complete_job``; the heartbeat ring ships it too)."""
+        tl.note("worker.stream.start", path="direct")
+        done = False
+        try:
+            for chunk in self._stream_direct(params, cancel=cancel):
+                if isinstance(chunk, dict) and chunk.get("done"):
+                    done = True
+                    tl.note("worker.stream.done",
+                            finish_reason=chunk.get("finish_reason"))
+                    self._flight_finish(tl, chunk)
+                yield chunk
+        finally:
+            if not done:
+                # abandoned stream (client hung up / chaos kill): the
+                # partial timeline still ships via the heartbeat ring
+                tl.note("worker.stream.done", finish_reason="abandoned")
+                self._flight_finish(tl)
 
     def _stream_checkpoint_tail(self, pre: PreemptedSequence,
                                 cfg: GenerationConfig, stamp: Any,
@@ -1968,6 +2157,8 @@ class TPULLMEngine(LLMBaseEngine):
         driver, so exactly-once token offsets and checkpoint/resume hold
         while the sequence shares decode rounds with other slots."""
         cfg = GenerationConfig.from_params(params)
+        tl = params.pop("_flight_tl", NULL_TIMELINE)
+        tl.note("worker.stream.start")
         ctx = params.get("_failover_ctx")
         ctx = ctx if isinstance(ctx, dict) else {}
         key = str(ctx.get("key") or params.get("stream_id") or "") or None
@@ -2015,6 +2206,7 @@ class TPULLMEngine(LLMBaseEngine):
         fut = self.serving.submit_async(
             req, observer=lambda toks: snaps.put(toks),
             cancel=stop_evt, resume_from=pre,
+            flight=tl if tl.enabled else None,
         )
         fut.add_done_callback(lambda f: snaps.put(_DONE))
 
@@ -2090,7 +2282,8 @@ class TPULLMEngine(LLMBaseEngine):
             if key is not None:
                 self._unregister_live(key)
         finish = sp.finish_override or final.finish_reason
-        yield stamp({
+        tl.note("worker.stream.done", finish_reason=finish)
+        done_chunk = {
             "done": True,
             "finish_reason": finish,
             "usage": {
@@ -2100,7 +2293,12 @@ class TPULLMEngine(LLMBaseEngine):
                 + final.completion_tokens,
                 "cached_tokens": final.cached_tokens,
             },
-        }, sp.sent_tokens)
+        }
+        # the final SSE chunk carries the worker-side timeline (streams
+        # never pass complete_job) — the heartbeat ring ships it to the
+        # plane too, so either consumer can attribute the stream's phases
+        self._flight_finish(tl, done_chunk)
+        yield stamp(done_chunk, sp.sent_tokens)
         # NOTE: as in the legacy driver, the server-held checkpoint is NOT
         # retired on completion — the worker cannot know the final SSE
         # bytes reached the client; the control plane ages streams out.

@@ -459,6 +459,37 @@ class Worker:
             out["capacity"] = self.serving_capacity()
         return out or None
 
+    def _flight_engine_stats(self) -> Optional[Dict[str, Any]]:
+        """Flight-recorder payload of every loaded engine (cumulative
+        timeline/drop counters + the bounded ring of recently-completed
+        timelines) — nested under heartbeat ``engine_stats["flight"]``.
+        The plane delta-anchors the counters and idempotently merges the
+        ring (direct streams never pass complete_job, so this is their
+        only route to the merged timeline store). None when nothing was
+        ever traced (payload stays lean)."""
+        out: Dict[str, Any] = {}
+        recent: List[Dict[str, Any]] = []
+        for eng in self.engines.values():
+            fn = getattr(eng, "flight_wire_stats", None)
+            if fn is None:
+                continue
+            try:
+                s = fn()
+            except Exception:  # noqa: BLE001 — never break the heartbeat
+                continue
+            if not s:
+                continue
+            for k in ("timelines", "events_dropped"):
+                out[k] = out.get(k, 0) + int(s.get(k, 0) or 0)
+            r = s.get("recent")
+            if isinstance(r, list):
+                recent.extend(r)
+        if not out:
+            return None
+        if recent:
+            out["recent"] = recent[-16:]
+        return out
+
     def _prefix_summary_payload(self) -> Optional[tuple]:
         """(engine, wire payload) of the first engine advertising a radix
         summary this beat — None when every engine is already in sync
@@ -522,6 +553,9 @@ class Worker:
             kvmig_stats = self._kv_migrate_engine_stats()
             if kvmig_stats:
                 engine_stats["kv_migrate"] = kvmig_stats
+            flight_stats = self._flight_engine_stats()
+            if flight_stats:
+                engine_stats["flight"] = flight_stats
             summary = self._prefix_summary_payload()
             if summary is not None:
                 # radix summary (full or delta) for cache-aware routing;
@@ -779,9 +813,18 @@ class Worker:
             if engine is None:
                 raise RuntimeError(f"no engine loaded for type {task_type!r}")
             params = dict(job.get("params") or {})
-            # reserved key: never accept a client-submitted failover
-            # context from job params — the worker mints it below
+            # reserved keys: never accept a client-submitted failover
+            # context or flight stamps from job params — the worker mints
+            # them below
             params.pop("_failover_ctx", None)
+            params.pop("_flight_picked_up_ts", None)
+            params.pop("_flight_tl", None)
+            if params.get("trace_id"):
+                # flight recorder: the poll-pickup instant (claim landed →
+                # engine dispatched) — the engine adopts it into the
+                # request's timeline, closing the server-side queue-wait
+                # phase at the worker boundary
+                params["_flight_picked_up_ts"] = time.time()
             if job.get("priority") is not None:
                 # control-plane priority reaches the batcher's admission
                 # heap (higher-priority jobs admit first, and KV-pressure

@@ -44,6 +44,8 @@ HEAVY = [
     "tests/test_model_moe.py",
     "tests/test_kv_handoff_stream.py",
     "tests/test_engine_tp.py",
+    "tests/test_flight_recorder.py",    # engine-backed recorder on/off
+    #   byte-identity run + the control-plane round-trip suites
 ]
 
 ap = argparse.ArgumentParser()

@@ -59,6 +59,26 @@ def test_worker_serving_bench(capsys):
     assert res["batcher"]["decode_rounds"] > 0
 
 
+def test_worker_serving_timeline_smoke(capsys):
+    """--timeline: the flight-recorder attribution leg — per-phase
+    p50/p95 instead of one opaque TTFT number, plus the recorder-on-vs-off
+    byte-identity assertion."""
+    from benchmarks.worker_serving import main
+
+    res = _run(main, [
+        "worker_serving", "--model", "llama3-tiny", "--requests", "4",
+        "--concurrency", "2", "--prompt-len", "16", "--max-tokens", "8",
+        "--shared-prefix", "8", "--arrival-rate", "20", "--timeline",
+    ], capsys)
+    assert res["benchmark"] == "worker_serving"
+    tl = res["timeline"]
+    assert tl["samples"] == 4
+    assert tl["outputs_identical_recorder_on_vs_off"] is True
+    for phase in ("queue_wait", "ttft", "decode", "e2e"):
+        assert tl["phase_ms"][phase]["p50"] is not None
+        assert tl["phase_ms"][phase]["p95"] is not None
+
+
 def test_speculative_bench(capsys):
     from benchmarks.speculative import main
 

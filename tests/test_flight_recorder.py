@@ -1,0 +1,728 @@
+"""Request flight recorder: timeline contracts, merge idempotency, phase
+histograms, and the debug endpoint.
+
+Tier-1 units cover the recorder primitives (event cap, monotonic merge,
+duplicate-delivery idempotency, restart re-anchor of the heartbeat
+counters, histogram bucket boundaries + concurrent render safety) and the
+control-plane round-trip (submit → claim → complete → GET
+/debug/requests/{id}/timeline). The engine-backed recorder-on-vs-off
+byte-identity run carries ``slow``.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from distributed_gpu_inference_tpu.runtime.flight import (
+    FLIGHT_EVENT_CAP,
+    NULL_TIMELINE,
+    PHASES,
+    Timeline,
+    merge_events,
+    phase_durations,
+    timeline_for,
+)
+from distributed_gpu_inference_tpu.server.app import ServerState, create_app
+from distributed_gpu_inference_tpu.server.flight_recorder import (
+    ExemplarRing,
+    FlightRecorder,
+)
+from distributed_gpu_inference_tpu.server.observability import (
+    HAVE_PROMETHEUS,
+    PHASE_LATENCY_BUCKETS,
+    MetricsCollector,
+)
+
+pytestmark = pytest.mark.obs
+
+
+# ---------------------------------------------------------------------------
+# Timeline primitives
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_note_and_wire_shape():
+    tl = Timeline("t1", source="w1")
+    tl.note("batcher.enqueued", queue_depth=3)
+    tl.note("batcher.admitted")
+    wire = tl.wire(done=True)
+    assert wire["trace_id"] == "t1" and wire["source"] == "w1"
+    assert wire["done"] is True
+    assert [e[0] for e in wire["events"]] == [
+        "batcher.enqueued", "batcher.admitted",
+    ]
+    # attrs are JSON-safe scalars
+    assert wire["events"][0][2] == {"queue_depth": 3}
+    assert wire["events"][1][2] is None
+    # timestamps never go backwards within one timeline
+    assert wire["events"][0][1] <= wire["events"][1][1]
+    # the wire survives a JSON round-trip (result/heartbeat channels)
+    json.dumps(wire)
+
+
+def test_timeline_event_cap_counts_dropped():
+    # cap 4 → reserve min(16, 4//2)=2: two bulk slots for the repeater,
+    # two reserved for boundary events; overflow is counted, never raised
+    tl = Timeline("t1", cap=4)
+    for i in range(10):
+        tl.note("batcher.chunk_round", off=i)
+    assert len(tl.events) == 2
+    assert tl.dropped == 8
+    tl.note("batcher.first_token")     # boundary: rides the reserve
+    tl.note("batcher.completed")
+    tl.note("worker.done")             # cap truly full now
+    assert [e[0] for e in tl.events][-2:] == ["batcher.first_token",
+                                              "batcher.completed"]
+    assert len(tl.events) == 4
+    assert tl.wire()["dropped"] == 9
+
+
+def test_null_timeline_is_inert():
+    NULL_TIMELINE.note("anything", x=1)
+    NULL_TIMELINE.note_at("anything", 123.0)
+    NULL_TIMELINE.extend_at([("a", 1.0)])
+    assert NULL_TIMELINE.wire(done=True) is None
+    assert NULL_TIMELINE.enabled is False
+
+
+def test_timeline_for_gates_on_trace_id_and_env(monkeypatch):
+    assert timeline_for({"prompt": "x"}) is NULL_TIMELINE
+    assert timeline_for(None) is NULL_TIMELINE
+    assert timeline_for({"trace_id": 123}) is NULL_TIMELINE  # non-str
+    tl = timeline_for({"trace_id": "abc"})
+    assert tl.enabled and tl.trace_id == "abc"
+    monkeypatch.setenv("DGI_FLIGHT", "0")
+    assert timeline_for({"trace_id": "abc"}) is NULL_TIMELINE
+
+
+def test_note_at_and_extend_at_tolerate_garbage():
+    tl = Timeline("t1")
+    tl.note_at("worker.picked_up", "not-a-number")
+    tl.extend_at([("ok", 100.0), ("bad",), None, ("also-bad", "x")])
+    names = [e[0] for e in tl.events]
+    assert names == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# merge + phases
+# ---------------------------------------------------------------------------
+
+
+def test_merge_events_monotonic_under_clock_skew():
+    # worker clock runs 5s AHEAD of the server: raw interleave would go
+    # backwards — the merged view clamps to monotonic order
+    merged = merge_events({
+        "server": [("server.submitted", 100.0, None),
+                   ("server.completed", 101.0, None)],
+        "w1": [("batcher.enqueued", 105.2, None),
+               ("batcher.completed", 105.9, None)],
+    })
+    ts = [e["ts"] for e in merged]
+    assert ts == sorted(ts)
+    assert len(merged) == 4
+
+
+def test_merge_events_deterministic_and_garbage_tolerant():
+    src = {
+        "w1": [("a", 1.0, None), ("bad", "x", None), ("b", 1.0, None)],
+        "w0": [("c", 1.0, None)],
+    }
+    m1 = merge_events(src)
+    m2 = merge_events(src)
+    assert m1 == m2
+    # equal timestamps: source name then within-source order break ties
+    assert [e["event"] for e in m1] == ["c", "a", "b"]
+
+
+def test_phase_durations_batcher_path():
+    t0 = 1000.0
+    merged = merge_events({"server": [
+        ("server.submitted", t0, None),
+        ("server.claimed", t0 + 0.5, None),
+        ("server.completed", t0 + 3.0, None),
+    ], "w1": [
+        ("batcher.enqueued", t0 + 0.6, None),
+        ("batcher.admitted", t0 + 0.8, None),
+        ("batcher.first_token", t0 + 1.0, None),
+        ("batcher.completed", t0 + 2.8, None),
+    ]})
+    ph = phase_durations(merged)
+    assert ph["queue_wait"] == pytest.approx(0.2)       # batcher wait wins
+    assert ph["prefill"] == pytest.approx(0.2)
+    assert ph["ttft"] == pytest.approx(1.0)
+    assert ph["decode"] == pytest.approx(1.8)
+    assert ph["e2e"] == pytest.approx(3.0)
+    assert "handoff" not in ph
+
+
+def test_phase_durations_pd_handoff_both_sides():
+    t0 = 2000.0
+    merged = merge_events({
+        "prefill-w": [("pd.prefill.start", t0, None),
+                      ("handoff.begin", t0 + 0.1, None),
+                      ("pd.prefill.done", t0 + 0.3, None),
+                      ("handoff.commit", t0 + 0.5, None)],
+        "decode-w": [("handoff.rx_begin", t0 + 0.15, None),
+                     ("handoff.rx_commit", t0 + 0.55, None),
+                     ("pd.decode.start", t0 + 0.6, None),
+                     ("pd.decode.done", t0 + 1.6, None)],
+    })
+    ph = phase_durations(merged)
+    # handoff opens at the FIRST begin, closes at the LAST commit
+    assert ph["handoff"] == pytest.approx(0.45)
+    assert ph["prefill"] == pytest.approx(0.3)
+    assert ph["decode"] == pytest.approx(1.0)
+    assert ph["e2e"] == pytest.approx(1.6)
+
+
+def test_phase_durations_empty_and_serverside_only():
+    assert phase_durations([]) == {}
+    merged = merge_events({"server": [
+        ("server.submitted", 10.0, None),
+        ("server.claimed", 11.0, None),
+    ]})
+    ph = phase_durations(merged)
+    assert ph["queue_wait"] == pytest.approx(1.0)
+    assert "decode" not in ph
+
+
+# ---------------------------------------------------------------------------
+# FlightRecorder: merge store, idempotency, finalize-once, exemplars
+# ---------------------------------------------------------------------------
+
+
+def _wire(trace="t1", source="w1", events=None, done=False):
+    out = {"trace_id": trace, "source": source,
+           "events": events or [["batcher.enqueued", 100.0, None],
+                                ["batcher.completed", 101.0, None]]}
+    if done:
+        out["done"] = True
+    return out
+
+
+def test_ingest_wire_idempotent_under_duplicate_delivery():
+    fr = FlightRecorder()
+    w = _wire()
+    assert fr.ingest_wire("w1", w)
+    n1 = len(fr.timeline("t1")["events"])
+    # exact duplicate (retried heartbeat / replayed completion): no-op —
+    # and reported as unchanged, so the heartbeat ingest path cannot
+    # re-finalize off a re-shipped ring entry
+    assert not fr.ingest_wire("w1", dict(w))
+    assert len(fr.timeline("t1")["events"]) == n1
+    # a STALE shorter payload never truncates the merged view
+    assert not fr.ingest_wire("w1", _wire(events=[["batcher.enqueued",
+                                                   100.0, None]]))
+    assert len(fr.timeline("t1")["events"]) == n1
+    # a longer re-delivery (more events since) extends it
+    assert fr.ingest_wire("w1", _wire(events=w["events"] + [
+        ["extra", 102.0, None]]))
+    assert len(fr.timeline("t1")["events"]) == n1 + 1
+
+
+def test_ingest_wire_unions_two_timelines_sharing_a_source():
+    # local PD: the prefill child and the decode child each mint their
+    # own Timeline on the SAME worker for the SAME trace — neither stage
+    # may clobber the other's events (keep-longest would drop the whole
+    # prefill stage)
+    fr = FlightRecorder()
+    assert fr.ingest_wire("w1", _wire(events=[
+        ["pd.prefill.start", 100.0, None],
+        ["pd.prefill.done", 100.5, None],
+        ["handoff.local", 100.6, None],
+    ], done=True))
+    assert fr.ingest_wire("w1", _wire(events=[
+        ["pd.decode.start", 100.7, None],
+        ["batcher.adopted", 100.8, None],
+        ["pd.decode.done", 101.2, None],
+    ], done=True))
+    names = [e["event"] for e in fr.timeline("t1")["events"]]
+    assert "pd.prefill.start" in names and "pd.decode.done" in names
+    assert len(names) == 6
+    # re-delivering either stage's wire changes nothing
+    assert not fr.ingest_wire("w1", _wire(events=[
+        ["pd.decode.start", 100.7, None],
+        ["batcher.adopted", 100.8, None],
+        ["pd.decode.done", 101.2, None],
+    ], done=True))
+    assert len(fr.timeline("t1")["events"]) == 6
+
+
+def test_ingest_wire_rejects_malformed():
+    fr = FlightRecorder()
+    assert not fr.ingest_wire("w1", None)
+    assert not fr.ingest_wire("w1", {"events": []})          # no trace id
+    assert not fr.ingest_wire("w1", {"trace_id": "t", "events": "x"})
+    assert fr.stats["wire_rejected"] == 3
+
+
+def test_ingest_wire_never_aliases_server_source():
+    fr = FlightRecorder()
+    fr.note("t1", "server.submitted")
+    assert fr.ingest_wire("w9", _wire(source="server"))
+    tl = fr.timeline("t1")
+    assert "server" in tl["sources"] and "worker:w9" in tl["sources"]
+
+
+def test_trace_store_is_bounded_lru():
+    fr = FlightRecorder(trace_cap=4)
+    for i in range(10):
+        fr.note(f"t{i}", "server.submitted", job_id=f"j{i}")
+    assert len(fr._traces) == 4
+    assert fr.timeline("t0") is None
+    assert fr.timeline("t9") is not None
+    assert fr.trace_for_job("j0") is None       # index evicted with it
+    assert fr.trace_for_job("j9") == "t9"
+
+
+class _CountingMetrics:
+    def __init__(self):
+        self.observed = []
+
+    def record_phase(self, phase, seconds):
+        self.observed.append((phase, seconds))
+
+
+def test_finalize_observes_each_phase_once():
+    m = _CountingMetrics()
+    fr = FlightRecorder(metrics=m)
+    fr.ingest_wire("w1", _wire(events=[
+        ["batcher.enqueued", 100.0, None],
+        ["batcher.admitted", 100.2, None],
+        ["batcher.first_token", 100.3, None],
+        ["batcher.completed", 101.0, None],
+    ]))
+    fresh = fr.finalize("t1")
+    assert set(fresh) == {"queue_wait", "prefill", "ttft", "decode", "e2e"}
+    n = len(m.observed)
+    # duplicate finalize (re-delivered completion): nothing re-observed
+    assert fr.finalize("t1") == {}
+    assert len(m.observed) == n
+    # later events derive only phases NOT yet observed (PD children
+    # completing out of band compose through this)
+    fr.ingest_wire("w2", {"trace_id": "t1", "source": "w2", "events": [
+        ["handoff.begin", 100.4, None], ["handoff.commit", 100.6, None],
+    ]})
+    fresh2 = fr.finalize("t1")
+    assert set(fresh2) == {"handoff"}
+    assert len(m.observed) == n + 1
+
+
+def test_evicted_finalized_trace_is_not_resurrected():
+    # the worker heartbeat ring re-ships done wires for ~8 recent
+    # requests every beat; once a finalized trace is LRU-evicted, a
+    # re-shipped wire must not re-create it with a fresh observed set
+    # and double-count its phases
+    m = _CountingMetrics()
+    fr = FlightRecorder(metrics=m, trace_cap=2)
+    w = _wire(trace="t-old", done=True)
+    assert fr.ingest_wire("w1", w)
+    fr.finalize("t-old")
+    n = len(m.observed)
+    assert n > 0
+    fr.note("t-new-1", "server.submitted")   # evict t-old (cap 2)
+    fr.note("t-new-2", "server.submitted")
+    assert fr.timeline("t-old") is None
+    # the ring re-ships the done wire: ignored, nothing re-observed
+    assert not fr.ingest_wire("w1", dict(w))
+    assert fr.timeline("t-old") is None
+    assert fr.finalize("t-old") == {}
+    assert len(m.observed) == n
+
+
+def test_ingest_union_truncation_preserves_boundary_events():
+    fr = FlightRecorder(event_cap=8)
+    assert fr.ingest_wire("w1", _wire(events=[
+        ["batcher.chunk_round", 100.0 + i / 100.0, None] for i in range(7)
+    ]))
+    # a second timeline on the same source delivers the terminal events
+    assert fr.ingest_wire("w1", _wire(events=[
+        ["batcher.first_token", 100.2, None],
+        ["batcher.completed", 101.0, None],
+        ["worker.done", 101.1, None],
+    ], done=True))
+    names = [e["event"] for e in fr.timeline("t1")["events"]]
+    assert len(names) <= 8
+    # the union overflowed the cap: bulk chunk rounds were truncated,
+    # the boundary events all survived
+    assert "batcher.first_token" in names
+    assert "batcher.completed" in names
+    assert "worker.done" in names
+
+
+def test_finalize_partial_defers_request_end_phases():
+    # the PD prefill child's completion must NOT lock a prefill-only
+    # span into the observe-once e2e/decode/handoff slots — those land
+    # at the decode child's (terminal) finalize
+    m = _CountingMetrics()
+    fr = FlightRecorder(metrics=m)
+    fr.note("t1", "server.submitted")
+    fr.note("t1", "server.claimed")
+    fr.ingest_wire("w1", _wire(source="fw0", events=[
+        ["pd.prefill.start", 100.0, None],
+        ["handoff.begin", 100.4, None],
+        ["pd.prefill.done", 100.5, None],
+        ["handoff.commit", 100.6, None],
+    ], done=True))
+    fr.note("t1", "server.completed")
+    fresh = fr.finalize("t1", partial=True)
+    assert "e2e" not in fresh and "decode" not in fresh \
+        and "handoff" not in fresh
+    assert "prefill" in fresh and "ttft" in fresh
+    # decode child completes: the full-span phases observe exactly once,
+    # with BOTH handoff sides merged
+    fr.ingest_wire("w2", _wire(source="fw1", events=[
+        ["handoff.rx_begin", 100.45, None],
+        ["handoff.rx_commit", 100.7, None],
+        ["pd.decode.start", 100.8, None],
+        ["pd.decode.done", 101.5, None],
+    ], done=True))
+    fr.note("t1", "server.completed")
+    fresh2 = fr.finalize("t1")
+    assert set(fresh2) >= {"e2e", "decode", "handoff"}
+    e2e = dict(m.observed)["e2e"]
+    assert e2e >= 1.0    # spans into decode, not prefill-only
+
+
+def test_finalize_defers_e2e_until_completion_lands():
+    # a queued job's wire can arrive by heartbeat BEFORE complete_job
+    # stamps server.completed — e2e must wait for the real end
+    m = _CountingMetrics()
+    fr = FlightRecorder(metrics=m)
+    fr.note("t1", "server.submitted")
+    fr.ingest_wire("w1", _wire(events=[
+        ["batcher.admitted", 100.0, None],
+        ["batcher.first_token", 100.1, None],
+        ["batcher.completed", 100.4, None],
+    ], done=True))
+    fresh = fr.finalize("t1")
+    assert "e2e" not in fresh
+    fr.note("t1", "server.completed")
+    assert "e2e" in fr.finalize("t1")
+
+
+def test_event_cap_reserves_room_for_boundary_events():
+    # a chunk-round repeater saturates the bulk of the cap, but the
+    # terminal events phase derivation hangs off must still land
+    tl = Timeline("t-cap", cap=32)
+    tl.note("batcher.enqueued")
+    tl.note("batcher.admitted")
+    for i in range(60):
+        tl.note("batcher.chunk_round", off=i)
+    tl.note("batcher.first_token")
+    tl.note("batcher.completed")
+    tl.note("worker.done")
+    names = [e[0] for e in tl.events]
+    assert names[-3:] == ["batcher.first_token", "batcher.completed",
+                          "worker.done"]
+    assert len(tl.events) <= 32
+    assert tl.dropped > 0
+
+
+def test_exemplar_ring_keeps_n_slowest():
+    ring = ExemplarRing(3)
+    for i, d in enumerate([0.1, 0.5, 0.05, 0.9, 0.2, 0.8]):
+        ring.push(d, f"t{i}")
+    items = ring.items()
+    assert [it["trace_id"] for it in items] == ["t3", "t5", "t1"]
+    assert items[0]["duration_s"] == pytest.approx(0.9)
+
+
+def test_finalize_feeds_exemplars():
+    fr = FlightRecorder(exemplars_per_phase=2)
+    for i, dur in enumerate([1.0, 3.0, 2.0]):
+        fr.ingest_wire("w1", {"trace_id": f"t{i}", "source": "w1",
+                              "events": [["batcher.enqueued", 100.0, None],
+                                         ["batcher.completed",
+                                          100.0 + dur, None]]})
+        fr.finalize(f"t{i}")
+    slow = fr.slowest()["e2e"]
+    assert [s["trace_id"] for s in slow] == ["t1", "t2"]
+
+
+# ---------------------------------------------------------------------------
+# /metrics: histogram buckets, render format, concurrency, re-anchor
+# ---------------------------------------------------------------------------
+
+
+needs_prom = pytest.mark.skipif(not HAVE_PROMETHEUS,
+                                reason="prometheus_client not installed")
+
+
+@needs_prom
+def test_phase_histogram_bucket_boundaries_and_render_format():
+    mc = MetricsCollector()
+    mc.record_phase("ttft", 0.03)
+    mc.record_phase("ttft", 4.0)
+    text = mc.render().decode()
+    for b in PHASE_LATENCY_BUCKETS:
+        # prometheus renders le labels without trailing zeros ("0.05")
+        assert f'request_phase_latency_seconds_bucket{{le="{b}"' \
+               f',phase="ttft"}}' in text or \
+               f'request_phase_latency_seconds_bucket{{phase="ttft"' \
+               f',le="{b}"}}' in text
+    # cumulative-bucket semantics: 0.03 lands at le=0.05, 4.0 at le=5.0
+    def bucket(le):
+        for line in text.splitlines():
+            if line.startswith("request_phase_latency_seconds_bucket") \
+                    and f'le="{le}"' in line and 'phase="ttft"' in line:
+                return float(line.rsplit(" ", 1)[1])
+        raise AssertionError(f"bucket {le} not rendered")
+    assert bucket("0.025") == 0.0
+    assert bucket("0.05") == 1.0
+    assert bucket("5.0") == 2.0
+    assert 'request_phase_latency_seconds_count{phase="ttft"} 2.0' in text
+
+
+@needs_prom
+def test_metrics_render_safe_under_concurrent_updates():
+    mc = MetricsCollector()
+    stop = threading.Event()
+    errors = []
+
+    def writer(phase):
+        while not stop.is_set():
+            mc.record_phase(phase, 0.01)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                out = mc.render()
+                assert b"request_phase_latency_seconds" in out
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer, args=(p,))
+               for p in ("ttft", "decode", "e2e")]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors
+    # the final render parses: every sample line is "name{labels} value"
+    for line in mc.render().decode().splitlines():
+        if line.startswith("request_phase_latency_seconds"):
+            float(line.rsplit(" ", 1)[1])
+
+
+@needs_prom
+def test_record_flight_engine_restart_reanchors():
+    mc = MetricsCollector()
+    mc.record_flight_engine("w1", {"timelines": 5, "events_dropped": 2})
+    # engine restart: totals reset BELOW the anchor — no negative delta,
+    # the anchor just moves (same contract as record_pd_engine)
+    mc.record_flight_engine("w1", {"timelines": 2, "events_dropped": 0})
+    mc.record_flight_engine("w1", {"timelines": 3, "events_dropped": 1})
+    text = mc.render().decode()
+    assert 'flight_timelines_total{worker="w1"} 6.0' in text
+    assert 'flight_events_dropped_total{worker="w1"} 3.0' in text
+    # malformed fields skip the sample, never raise
+    mc.record_flight_engine("w1", {"timelines": "garbage"})
+
+
+# ---------------------------------------------------------------------------
+# control-plane round-trip: submit → claim → complete → debug endpoint
+# ---------------------------------------------------------------------------
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _make_client(**state_kw):
+    state = ServerState(**state_kw)
+    app = create_app(state, start_background=False)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client, state
+
+
+async def _register(client):
+    resp = await client.post("/api/v1/workers/register", json={
+        "name": "tw", "region": "us-west", "supported_types": ["llm"],
+    })
+    assert resp.status == 200
+    return await resp.json()
+
+
+def _auth(reg):
+    return {"Authorization": f"Bearer {reg['auth_token']}"}
+
+
+def test_timeline_round_trip_and_duplicate_completion():
+    async def body():
+        client, state = await _make_client()
+        reg = await _register(client)
+        wid = reg["worker_id"]
+
+        resp = await client.post("/api/v1/jobs", json={
+            "type": "llm",
+            "params": {"prompt": "hi", "trace_id": "trace-rt"},
+        })
+        assert resp.status == 201
+        job_id = (await resp.json())["job_id"]
+
+        resp = await client.get(f"/api/v1/workers/{wid}/next-job",
+                                headers=_auth(reg))
+        assert resp.status == 200
+        job = (await resp.json())["job"]
+        assert job["params"]["trace_id"] == "trace-rt"
+
+        worker_tl = Timeline("trace-rt", source="")
+        worker_tl.note("worker.start")
+        worker_tl.note("batcher.enqueued")
+        worker_tl.note("batcher.admitted")
+        worker_tl.note("batcher.first_token")
+        worker_tl.note("batcher.completed")
+        result = {"text": "ok", "timeline": worker_tl.wire(done=True)}
+        complete = {"success": True, "result": result}
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/jobs/{job_id}/complete",
+            json=complete, headers=_auth(reg),
+        )
+        assert resp.status == 200
+
+        resp = await client.get(
+            f"/api/v1/debug/requests/{job_id}/timeline")
+        assert resp.status == 200
+        tl = await resp.json()
+        names = [e["event"] for e in tl["events"]]
+        assert "server.submitted" in names
+        assert "server.claimed" in names
+        assert "server.completed" in names
+        assert "batcher.first_token" in names
+        ts = [e["ts"] for e in tl["events"]]
+        assert ts == sorted(ts)
+        for p in ("queue_wait", "ttft", "decode", "e2e"):
+            assert p in tl["phases"]
+        n_events = len(tl["events"])
+
+        # the stored job result was stripped of the raw wire — the merged
+        # timeline lives on the row's own column instead
+        job_row = await state.store.get_job(job_id)
+        assert "timeline" not in (job_row.get("result") or {})
+        assert isinstance(job_row.get("timeline"), dict)
+        assert job_row["timeline"]["trace_id"] == "trace-rt"
+
+        # duplicate completion delivery: idempotent — no event growth
+        resp = await client.post(
+            f"/api/v1/workers/{wid}/jobs/{job_id}/complete",
+            json=complete, headers=_auth(reg),
+        )
+        assert (await resp.json()).get("duplicate") is True
+        resp = await client.get(
+            f"/api/v1/debug/requests/{job_id}/timeline")
+        assert len((await resp.json())["events"]) == n_events
+
+        # exemplars index the completed trace
+        resp = await client.get("/api/v1/debug/requests/slowest")
+        slow = await resp.json()
+        assert any(it["trace_id"] == "trace-rt"
+                   for it in slow["exemplars"]["e2e"])
+
+        resp = await client.get("/api/v1/debug/requests/nope/timeline")
+        assert resp.status == 404
+        await client.close()
+
+    run(body())
+
+
+def test_heartbeat_flight_channel_idempotent():
+    async def body():
+        client, state = await _make_client()
+        reg = await _register(client)
+        wid = reg["worker_id"]
+        wire = {
+            "trace_id": "trace-hb", "source": "", "done": True,
+            "events": [["worker.stream.start", 100.0, None],
+                       ["batcher.first_token", 100.2, None],
+                       ["worker.stream.done", 101.0, None]],
+        }
+        payload = {"engine_stats": {"flight": {
+            "timelines": 1, "events_dropped": 0, "recent": [wire],
+        }}}
+        for _ in range(3):     # duplicate heartbeat delivery
+            resp = await client.post(
+                f"/api/v1/workers/{wid}/heartbeat",
+                json=payload, headers=_auth(reg),
+            )
+            assert resp.status == 200
+        tl = state.flight.timeline("trace-hb")
+        assert len(tl["events"]) == 3
+        # done=True finalized the trace exactly once
+        assert state.flight.stats["finalized"] == 1
+        await client.close()
+
+    run(body())
+
+
+def test_shed_lands_on_timeline():
+    async def body():
+        client, state = await _make_client()
+        state.admission.cfg.update({"enabled": True})
+        state.worker_config.set_submit_queue_limit(1)
+        # no workers → queue never drains; flood past the shed fraction
+        for i in range(6):
+            await client.post("/api/v1/jobs", json={
+                "type": "llm",
+                "params": {"prompt": "x", "trace_id": f"shed-{i}"},
+                "tier": "free",
+            })
+        sheds = [state.flight.timeline(f"shed-{i}") for i in range(6)]
+        actions = [
+            e.get("attrs", {}).get("action")
+            for tl in sheds if tl
+            for e in tl["events"] if e["event"] == "server.admission"
+        ]
+        assert "shed" in actions
+        await client.close()
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# engine-backed: recorder on vs off is byte-identical (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_recorder_on_off_byte_identity_and_flag_off(monkeypatch):
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+    llm = TPULLMEngine({
+        "model": "llama3-tiny", "max_batch_size": 2, "max_seq_len": 64,
+    })
+    llm.load_model()
+    try:
+        base = {"prompt": "flight recorder byte identity",
+                "max_new_tokens": 8, "temperature": 0}
+        off = llm.inference(dict(base))
+        on = llm.inference({**base, "trace_id": "trace-engine"})
+        assert on["text"] == off["text"]
+        assert "timeline" not in off
+        wire = on.get("timeline")
+        assert wire and wire["trace_id"] == "trace-engine"
+        names = [e[0] for e in wire["events"]]
+        assert "batcher.enqueued" in names
+        assert "batcher.first_token" in names
+        assert "batcher.completed" in names
+        # the heartbeat ring retained it
+        hb = llm.flight_wire_stats()
+        assert hb["timelines"] == 1 and hb["recent"]
+        # process-wide kill switch: trace_id present but recorder off →
+        # byte-identical output, no timeline anywhere
+        monkeypatch.setenv("DGI_FLIGHT", "0")
+        dark = llm.inference({**base, "trace_id": "trace-dark"})
+        assert dark["text"] == off["text"]
+        assert "timeline" not in dark
+        assert llm.flight_wire_stats()["timelines"] == 1
+    finally:
+        llm.unload()
