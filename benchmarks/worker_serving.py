@@ -1848,6 +1848,188 @@ def _build_serving_llm(args: Any, model: str, spec_k: int = 0,
     return llm
 
 
+# ---------------------------------------------------------------------------
+# --plane-scale (round 15): control-plane replication, measured. A fleet of
+# FAKE-engine workers (real APIClient protocol — signing, epoch-fenced
+# completion, plane failover — no JAX engine, so the CONTROL PLANE is the
+# bottleneck) drives two legs:
+#   sweep     — open-loop submissions round-robin across P plane replicas
+#               sharing one job store, for each P in --plane-counts:
+#               claims/s (jobs brokered→completed per second), heartbeat
+#               ingest rate, and p50/p99 admission latency (POST→201)
+#   kill_one  — P=2, one plane hard-killed mid-stream: time-to-recover is
+#               kill → first job submitted AFTER the kill completing
+#               through the surviving plane, plus worker failover counts
+# ---------------------------------------------------------------------------
+
+
+async def _drive_plane_admissions(urls: List[str], n: int, rate: float,
+                                  max_poll_s: float = 60.0,
+                                  kill_after: Optional[Tuple[float, Any]]
+                                  = None) -> List[Dict[str, Any]]:
+    """Open-loop submissions spread round-robin over the plane cohort.
+
+    Every record carries the admission latency (POST→answer) and the
+    completion wall-clock; a transport error on one plane endpoint retries
+    the next (the SDK's failover contract, inlined so the bench measures
+    the raw HTTP path, not SDK backoff policy)."""
+    import httpx
+
+    t0 = time.perf_counter()
+    fired = [False]
+    async with httpx.AsyncClient(timeout=30.0) as client:
+
+        async def one(i: int) -> Dict[str, Any]:
+            at = i / rate
+            now = time.perf_counter() - t0
+            if at > now:
+                await asyncio.sleep(at - now)
+            if kill_after is not None and not fired[0] \
+                    and (time.perf_counter() - t0) >= kill_after[0]:
+                fired[0] = True
+                kill_after[1]()
+            rec: Dict[str, Any] = {"i": i, "submit_s": None,
+                                   "admit_ms": None, "done_s": None,
+                                   "status": 0}
+            job_id = None
+            for k in range(len(urls) * 2):
+                url = urls[(i + k) % len(urls)]
+                t_req = time.perf_counter()
+                try:
+                    r = await client.post(f"{url}/api/v1/jobs", json={
+                        "type": "llm",
+                        "params": {"prompt": f"plane-scale {i}",
+                                   "max_new_tokens": 1},
+                    })
+                except httpx.TransportError:
+                    continue          # dead plane: next endpoint
+                rec["status"] = r.status_code
+                if r.status_code == 201:
+                    rec["submit_s"] = t_req - t0
+                    rec["admit_ms"] = (time.perf_counter() - t_req) * 1e3
+                    job_id = r.json()["job_id"]
+                break
+            if job_id is None:
+                rec["status"] = rec["status"] or 599
+                return rec
+            while time.perf_counter() - t0 - rec["submit_s"] < max_poll_s:
+                for k in range(len(urls)):
+                    url = urls[(i + k) % len(urls)]
+                    try:
+                        j = (await client.get(
+                            f"{url}/api/v1/jobs/{job_id}")).json()
+                    except (httpx.TransportError, ValueError):
+                        continue
+                    if j.get("status") == "completed":
+                        rec["done_s"] = time.perf_counter() - t0
+                        return rec
+                    break
+                await asyncio.sleep(0.02)
+            rec["status"] = 599
+            return rec
+
+        return list(await asyncio.gather(*(one(i) for i in range(n))))
+
+
+def run_plane_scale(args: Any, backend: str, model: str) -> None:
+    from distributed_gpu_inference_tpu.testing.harness import LiveFleet
+
+    counts = [int(c) for c in str(args.plane_counts).split(",") if c]
+    rate = float(args.arrival_rate) if args.arrival_rate else 120.0
+    n_sub = args.requests
+    workers = int(args.plane_workers)
+    out: Dict[str, Any] = {
+        "benchmark": "worker_serving_plane_scale",
+        "path": "replicated_control_planes+fake_engine_fleet",
+        "backend": backend, "seed": args.seed,
+        "workers": workers, "submissions": n_sub,
+        "submit_rate_rps": rate, "plane_counts": counts,
+        "sweep": {},
+    }
+
+    for planes in counts:
+        with LiveFleet(n=workers, fake_engines=True, n_planes=planes,
+                       hb_interval_s=0.1) as fleet:
+            urls = fleet.plane_urls
+            # spread worker stickiness across the cohort: production
+            # deployments start each worker with a rotated endpoint list,
+            # the harness hands every member the same order
+            for m in fleet.members:
+                m.api._active = m.index % len(urls)
+            # warm: compile nothing (fake engines), but settle the
+            # registration burst before measuring
+            asyncio.run(_drive_plane_admissions(urls, 8, rate))
+            hb0 = sum(m.heartbeats for m in fleet.members)
+            t0 = time.perf_counter()
+            recs = asyncio.run(_drive_plane_admissions(urls, n_sub, rate))
+            elapsed = time.perf_counter() - t0
+            hb = sum(m.heartbeats for m in fleet.members) - hb0
+            done = [r for r in recs if r["done_s"] is not None]
+            stamped = fleet.any_plane().query(
+                "SELECT plane_id, COUNT(*) AS c FROM jobs "
+                "WHERE plane_id IS NOT NULL GROUP BY plane_id", ()
+            )
+            out["sweep"][str(planes)] = {
+                "completed": len(done),
+                "failed": len(recs) - len(done),
+                "elapsed_s": round(elapsed, 3),
+                "claims_per_s": round(len(done) / elapsed, 1),
+                "heartbeat_ingest_per_s": round(hb / elapsed, 1),
+                "admission_ms": percentiles(
+                    [r["admit_ms"] for r in recs
+                     if r["admit_ms"] is not None]),
+                "claims_by_plane": {
+                    r["plane_id"]: r["c"] for r in stamped
+                },
+            }
+
+    # kill-one leg: 2 planes, one dies mid-stream
+    with LiveFleet(n=workers, fake_engines=True, n_planes=2,
+                   hb_interval_s=0.1) as fleet:
+        urls = fleet.plane_urls
+        for m in fleet.members:
+            m.api._active = m.index % len(urls)
+        asyncio.run(_drive_plane_admissions(urls, 8, rate))
+        span = n_sub / rate
+        t_kill = round(span * 0.35, 3)
+        kill_state: Dict[str, float] = {}
+
+        def kill_now() -> None:
+            # from a side thread: plane teardown joins its server thread,
+            # and blocking the driver's event loop on that would stall
+            # every in-flight submission and poison the latency numbers
+            import threading as _threading
+
+            kill_state["at"] = time.perf_counter()
+            _threading.Thread(target=fleet.planes[0].kill,
+                              daemon=True).start()
+
+        t0 = time.perf_counter()
+        recs = asyncio.run(_drive_plane_admissions(
+            urls, n_sub, rate, kill_after=(t_kill, kill_now)))
+        kill_s = kill_state["at"] - t0
+        done = [r for r in recs if r["done_s"] is not None]
+        after = [r["done_s"] for r in done
+                 if r["submit_s"] is not None and r["submit_s"] >= kill_s]
+        fleet.planes[0].start()
+        out["kill_one"] = {
+            "planes": 2, "kill_at_s": round(kill_s, 3),
+            "completed": len(done),
+            "failed": len(recs) - len(done),
+            # recovery: kill → first job submitted AFTER the kill done
+            # through the surviving plane
+            "time_to_recover_s": round(min(after) - kill_s, 3)
+            if after else None,
+            "worker_plane_failovers": sum(
+                m.api.plane_failovers for m in fleet.members
+                if m.api is not None),
+            "admission_ms": percentiles(
+                [r["admit_ms"] for r in recs
+                 if r["admit_ms"] is not None]),
+        }
+    emit(out)
+
+
 def run_spec_ab(args: Any, backend: str, model: str) -> None:
     from distributed_gpu_inference_tpu.worker.direct_server import (
         DirectServer,
@@ -2029,6 +2211,17 @@ def main() -> None:
     ap.add_argument("--overload-slo-ms", type=float, default=2000.0,
                     help="per-request e2e SLO bound the autoscaler leg "
                     "judges its window against")
+    ap.add_argument("--plane-scale", action="store_true",
+                    help="replicated control-plane legs on a fake-engine "
+                    "fleet (real claim/heartbeat/completion protocol, no "
+                    "JAX): claims/s, heartbeat ingest rate, and p99 "
+                    "admission latency vs plane count, plus a 2-plane "
+                    "kill-one leg with measured time-to-recover")
+    ap.add_argument("--plane-counts", default="1,2,3",
+                    help="comma-separated plane replica counts for the "
+                    "--plane-scale sweep")
+    ap.add_argument("--plane-workers", type=int, default=24,
+                    help="fake-engine worker count for --plane-scale")
     ap.add_argument("--chaos", action="store_true",
                     help="cluster frontier + brownout mode: drive the "
                     "same open-loop workload through a LiveFleet at "
@@ -2084,6 +2277,13 @@ def main() -> None:
             ap.error("--overload takes a single --arrival-rate (the paid "
                      "rate; the burst is fixed at 10x)")
         run_overload(args, backend, model)
+        return
+
+    if args.plane_scale:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--plane-scale takes a single --arrival-rate (the "
+                     "sweep axis is the plane count)")
+        run_plane_scale(args, backend, model)
         return
 
     if args.chaos:

@@ -147,6 +147,7 @@ class InferenceClient:
         execute the job again."""
         last: Optional[Exception] = None
         saw_503 = False
+        saw_conn_fail = False
         err_429: Optional[InferenceClientError] = None
         last_retry_after: Optional[float] = None
         for server in self.servers:
@@ -161,10 +162,22 @@ class InferenceClient:
                                if timeout is not None else {}),
                         ),
                         method=method, path=path,
+                        # destination endpoint: plane-targeted chaos rules
+                        # (plane_partition / plane_slow) match on it
+                        server=server,
                     )
                 except httpx.TransportError as exc:
                     last = exc
                     if not idempotent:
+                        if isinstance(exc, httpx.ConnectError):
+                            # plane-connection loss BEFORE the request was
+                            # ever sent: the job was definitively NOT
+                            # created, so the next plane endpoint may
+                            # safely take the submission — this is the one
+                            # transport failure where failing over an
+                            # effectful POST cannot double-execute it
+                            saw_conn_fail = True
+                            break
                         raise InferenceClientError(
                             599, f"transport failed: {exc}"
                         ) from exc
@@ -210,9 +223,12 @@ class InferenceClient:
                         self._sleep_backoff(attempt)
                     continue
                 return resp
-            if not idempotent and not (saw_503 or err_429 is not None):
+            if not idempotent and not (
+                saw_503 or err_429 is not None or saw_conn_fail
+            ):
                 break  # no cross-server failover for effectful calls
-                #       (503/429 mean the job was never created — safe)
+                #       (503/429/connect-refused mean the job was never
+                #       created — safe)
         if saw_503:
             raise NoWorkersAvailable(retry_after_s=last_retry_after)
         if err_429 is not None:
@@ -469,12 +485,31 @@ class InferenceClient:
         last_err: Any = None
 
         fps = self._routing_fps(params, prefix_hint)
+        plane_retries = 0
         while True:
             resuming = yielded
-            worker = self._get_nearest_worker(
-                exclude=failed_workers or None,
-                prefix_fps=fps, session=session,
-            )
+            try:
+                worker = self._get_nearest_worker(
+                    exclude=failed_workers or None,
+                    prefix_fps=fps, session=session,
+                    raise_plane_errors=resuming,
+                )
+            except InferenceClientError as exc:
+                # plane-connection loss during failover rediscovery: every
+                # plane endpoint failed to ANSWER (this is not a worker
+                # dying — the checkpoint is still adoptable once any plane
+                # comes back). Retry discovery on its own bounded budget,
+                # WITHOUT burning max_stream_resumes and WITHOUT
+                # blacklisting the worker that was serving us.
+                plane_retries += 1
+                if plane_retries > self._max_retries + 1:
+                    raise InferenceClientError(
+                        599, "stream dropped mid-generation and no control "
+                             f"plane reachable for failover: {exc}"
+                    ) from exc
+                self._sleep_backoff(plane_retries - 1)
+                continue
+            plane_retries = 0
             if worker is None:
                 if resuming:
                     raise InferenceClientError(
@@ -609,6 +644,7 @@ class InferenceClient:
         prefix_fps: Optional[Sequence[str]] = None,
         session: Optional[str] = None,
         trace_id: Optional[str] = None,
+        raise_plane_errors: bool = False,
     ) -> Optional[Dict[str, Any]]:
         now = time.time()
         if session and not exclude:
@@ -636,7 +672,18 @@ class InferenceClient:
                 "GET", "/api/v1/jobs/direct/nearest",
                 params=query or None,
             )
-        except InferenceClientError:
+        except NoWorkersAvailable:
+            # a plane ANSWERED and said the fleet has no eligible worker —
+            # that is a definitive routing result, never plane loss
+            return None
+        except InferenceClientError as exc:
+            if raise_plane_errors and exc.status >= 500:
+                # the discovery failed because no control plane answered
+                # (transport = 599, or retry-exhausted 5xx) — NOT because
+                # the fleet has no worker. Callers holding a resumable
+                # stream need the distinction: plane loss is retryable
+                # without spending worker-failover budget.
+                raise
             return None
         worker = resp.json()
         if session:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -56,6 +57,7 @@ from .scheduler import (
 )
 from .security import LockoutState, SecurityService
 from .store import Store
+from .plane_cluster import HOPS_HEADER, PlaneCluster, _parse_chain
 from .pd_flow import PDFlowError, PDFlowService
 from .task_guarantee import TaskGuaranteeBackgroundWorker, TaskGuaranteeService
 from .usage import UsageService
@@ -78,8 +80,19 @@ class ServerState:
                  admin_key: Optional[str] = None,
                  require_signing: bool = False,
                  heartbeat_timeout_s: float = 90.0,
-                 submit_queue_limit: int = 0) -> None:
+                 submit_queue_limit: int = 0,
+                 plane_id: Optional[str] = None,
+                 plane_peers: Optional[list] = None,
+                 plane_forward_max_hops: Optional[int] = None) -> None:
         self.store = Store(db_path)
+        # replicated control planes (round 15): this replica's identity +
+        # peer membership. OFF unless plane_id/plane_peers are configured —
+        # the default single-plane build is byte-identical (no new response
+        # fields, NULL plane stamps, no forwarding).
+        self.plane = PlaneCluster(
+            plane_id=plane_id, peers=plane_peers,
+            forward_max_hops=plane_forward_max_hops, api_key=api_key,
+        )
         self.security = SecurityService()
         self.reliability = ReliabilityService(self.store)
         self.metrics = MetricsCollector()
@@ -92,6 +105,9 @@ class ServerState:
             self.store, self.reliability,
             prefix_registry=self.prefix_registry, metrics=self.metrics,
         )
+        # claims brokered by this replica carry its plane_id (NULL when the
+        # cohort is disabled) — the audit trail behind the epoch fence
+        self.scheduler.plane_id = self.plane.claim_stamp
         self.pd_flow = PDFlowService(self.store, metrics=self.metrics)
         self.guarantee = TaskGuaranteeService(
             self.store, self.reliability, heartbeat_timeout_s,
@@ -742,6 +758,12 @@ async def heartbeat(request: web.Request) -> web.Response:
         **({"prefix_summary_resync": summary_resync}
            if summary_resync is not None else {}),
         **({"prefix_summary_applied": False} if summary_rejected else {}),
+        # plane cohort (round 15): the replica answering this beat. The
+        # worker watches for a CHANGE (its plane died, it failed over) and
+        # resyncs a full prefix-summary snapshot — the new plane has no
+        # ACKed delta base. Omitted single-plane: the response stays
+        # byte-identical to the pre-cohort build.
+        **({"plane_id": st.plane.plane_id} if st.plane.enabled else {}),
     })
 
 
@@ -1215,15 +1237,35 @@ async def _make_job_row(request: web.Request, body: Dict[str, Any]
     }
 
 
+async def _forward_or(st: ServerState, request: web.Request,
+                      body: Dict[str, Any], local: web.Response,
+                      sync: bool = False) -> web.Response:
+    """Capacity rejection path with plane forwarding: before bouncing the
+    client, offer the submission to a peer plane (bounded hops, loop
+    fence — server/plane_cluster.py). A peer's definitive answer is
+    relayed; when every peer declines too, the LOCAL rejection stands, so
+    single-plane behavior (and the retry contract) is unchanged."""
+    chain = _parse_chain(request.headers.get(HOPS_HEADER))
+    fwd = await st.plane.forward_job(body, chain, sync=sync)
+    if fwd is None:
+        return local
+    status, payload = fwd
+    st.metrics.record_request("plane_forward", "sent")
+    return web.json_response(payload, status=status)
+
+
 async def create_job(request: web.Request) -> web.Response:
     if (err := _check_api_key(request)) is not None:
         return err
     st = _state(request)
+    st.plane.note_received(_parse_chain(request.headers.get(HOPS_HEADER)))
     if not st.admission.cfg.enabled:
         # ladder OFF: the pre-round-12 blanket backpressure, still run
         # BEFORE body parsing so a 429 flood stays parse-free
         if (bp := await _submit_backpressure(st)) is not None:
-            return bp
+            if not st.plane.enabled:
+                return bp
+            return await _forward_or(st, request, await request.json(), bp)
     body = await request.json()
     trace_id = _stamp_trace(body)
     if st.admission.cfg.enabled and \
@@ -1278,9 +1320,14 @@ async def create_job_sync(request: web.Request) -> web.Response:
     if (err := _check_api_key(request)) is not None:
         return err
     st = _state(request)
+    st.plane.note_received(_parse_chain(request.headers.get(HOPS_HEADER)))
     if not st.admission.cfg.enabled:
         if (bp := await _submit_backpressure(st)) is not None:
-            return bp
+            if not st.plane.enabled:
+                return bp
+            return await _forward_or(
+                st, request, await request.json(), bp, sync=True
+            )
     body = await request.json()
     trace_id = _stamp_trace(body)
     if st.admission.cfg.enabled and \
@@ -1289,8 +1336,13 @@ async def create_job_sync(request: web.Request) -> web.Response:
     stats = await st.scheduler.get_queue_stats()
     if stats["active_workers"] == 0:
         # a fleet with zero live workers drains nothing: tell clients to
-        # come back on the heartbeat-revival timescale, not instantly
-        return _json_error(503, "no workers available", retry_after_s=10.0)
+        # come back on the heartbeat-revival timescale, not instantly —
+        # unless a peer plane can take the job right now
+        return await _forward_or(
+            st, request, body,
+            _json_error(503, "no workers available", retry_after_s=10.0),
+            sync=True,
+        )
     _log_submission(st, trace_id, body, sync=True)
     row = await _make_job_row(request, body)
     row["priority"] = row["priority"] + 10
@@ -2072,6 +2124,7 @@ async def health(request: web.Request) -> web.Response:
             "uptime_s": time.time() - st.started_at,
             "workers": stats.get("workers", {}),
             "jobs": stats.get("jobs", {}),
+            **({"plane": st.plane.describe()} if st.plane.enabled else {}),
         }
     )
 
@@ -2227,6 +2280,11 @@ def create_app(state: Optional[ServerState] = None,
 
         app.on_startup.append(_on_startup)
         app.on_cleanup.append(_on_cleanup)
+
+    async def _on_plane_cleanup(app: web.Application) -> None:
+        await app["state"].plane.close()
+
+    app.on_cleanup.append(_on_plane_cleanup)
     return app
 
 
@@ -2241,10 +2299,22 @@ def main() -> None:  # pragma: no cover - manual entry point
     ap.add_argument("--submit-queue-limit", type=int, default=0,
                     help="reject job submissions with 429 + Retry-After "
                          "past this queue depth (0 = unlimited)")
+    ap.add_argument("--plane-id",
+                    default=os.environ.get("DGI_PLANE_ID") or None,
+                    help="this control-plane replica's identity in a "
+                         "multi-plane cohort (enables the cohort; claims "
+                         "are stamped with it)")
+    ap.add_argument("--plane-peers",
+                    default=os.environ.get("DGI_PLANE_PEERS") or "",
+                    help="comma-separated peer plane base URLs for job "
+                         "forwarding (all replicas must share --db)")
     args = ap.parse_args()
+    peers = [p.strip() for p in str(args.plane_peers).split(",") if p.strip()]
     web.run_app(
         create_app(ServerState(db_path=args.db, api_key=args.api_key,
-                               submit_queue_limit=args.submit_queue_limit)),
+                               submit_queue_limit=args.submit_queue_limit,
+                               plane_id=args.plane_id,
+                               plane_peers=peers or None)),
         host=args.host,
         port=args.port,
     )

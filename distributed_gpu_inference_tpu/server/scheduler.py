@@ -137,6 +137,11 @@ class SmartScheduler:
         # land on the request's timeline. Attached post-construction by
         # ServerState (the recorder needs metrics/tracing built first).
         self._flight = None
+        # replicated control planes (round 15): the plane_id stamped on
+        # every claim this scheduler brokers. None (NULL stamp) on
+        # single-plane deployments; set by ServerState when the cohort is
+        # configured.
+        self.plane_id: Optional[str] = None
 
     def attach_flight(self, flight: Any) -> None:
         self._flight = flight
@@ -258,6 +263,7 @@ class SmartScheduler:
             supported_types=list(w.get("supported_types") or []),
             region=w.get("region"),
             prefer=prefer,
+            plane_id=self.plane_id,
         )
         cands: Optional[List[Dict[str, Any]]] = None
         if job is not None:

@@ -27,13 +27,30 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import sqlite3
 import time
 import uuid
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 from ..testing import faults as _faults
 from ..utils.data_structures import JobStatus, WorkerState
+
+# Multi-writer contention knobs (replicated control planes share one
+# database file; see docs/ENV_CONFIG.md). busy_timeout makes sqlite block
+# up to N ms for the other plane's write transaction; the retry loop
+# handles the SQLITE_BUSY that still escapes (deadlock-avoidance returns
+# busy immediately when a deferred reader upgrades against a writer).
+_BUSY_TIMEOUT_MS = int(os.environ.get("DGI_STORE_BUSY_TIMEOUT_MS", "5000"))
+_LOCK_RETRIES = int(os.environ.get("DGI_STORE_LOCK_RETRIES", "6"))
+_LOCK_RETRY_BASE_S = 0.02
+
+
+def _is_locked(exc: BaseException) -> bool:
+    if not isinstance(exc, sqlite3.OperationalError):
+        return False
+    msg = str(exc)
+    return "locked" in msg or "busy" in msg
 
 # Columns stored as JSON text.
 _WORKER_JSON = {
@@ -256,6 +273,12 @@ _MIGRATIONS = [
     # result lives on. Advisory: a write failure is swallowed — the
     # recorder can never fail a request.
     (9, "ALTER TABLE jobs ADD COLUMN timeline TEXT"),
+    # v10: replicated control planes — every claim stamps the plane that
+    # brokered it. The assignment_epoch remains THE fence (a stale plane's
+    # late complete/checkpoint 409s exactly like a stale worker's); the
+    # plane_id column makes the broker auditable per epoch, so chaos tests
+    # and post-mortems can prove WHICH plane's write was fenced out.
+    (10, "ALTER TABLE jobs ADD COLUMN plane_id TEXT"),
 ]
 
 SCHEMA_VERSION = max(
@@ -290,21 +313,51 @@ class Store:
 
     def __init__(self, path: str = ":memory:") -> None:
         self._path = path
-        # one connection, serialized writes; check_same_thread off because we
-        # hop through the default executor
+        # one connection PER STORE, serialized writes within a plane;
+        # check_same_thread off because we hop through the default executor.
+        # Replicated planes each open their own Store on the same file:
+        # WAL + busy_timeout + the locked-retry loop make cross-plane
+        # writes safe (sqlite serializes writers; fenced conditional
+        # UPDATEs decide races).
         self._conn = sqlite3.connect(
             path, check_same_thread=False, isolation_level=None
         )
         self._conn.row_factory = sqlite3.Row
         if path != ":memory:":
             self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute(f"PRAGMA busy_timeout={_BUSY_TIMEOUT_MS}")
         self._conn.execute("PRAGMA foreign_keys=ON")
         try:
-            self._migrate()
+            self._locked_retry(self._migrate)
         except BaseException:
             self._conn.close()
             raise
         self._lock = asyncio.Lock()
+
+    def _rollback(self) -> None:
+        """Best-effort ROLLBACK: when BEGIN itself lost a lock race there
+        is no transaction to roll back, and that secondary error must not
+        mask the original one."""
+        try:
+            self._conn.execute("ROLLBACK")
+        except sqlite3.OperationalError:
+            pass
+
+    def _locked_retry(self, fn: Callable[[], Any]) -> Any:
+        """Run ``fn`` (a whole transaction), retrying on SQLITE_BUSY with
+        capped exponential backoff. With a single plane this never fires;
+        with replicated planes it absorbs the write-lock collisions
+        busy_timeout lets through. The transaction either fully commits or
+        fully rolls back per attempt, so a retry re-reads fresh state —
+        fenced UPDATEs (claim, transition) stay correct across planes."""
+        for attempt in range(_LOCK_RETRIES + 1):
+            try:
+                return fn()
+            except sqlite3.OperationalError as exc:
+                if not _is_locked(exc) or attempt >= _LOCK_RETRIES:
+                    raise
+                self._rollback()
+                time.sleep(min(0.25, _LOCK_RETRY_BASE_S * (2 ** attempt)))
 
     def _migrate(self) -> None:
         """Bring the database to ``SCHEMA_VERSION`` in place.
@@ -317,10 +370,26 @@ class Store:
         (ver,) = self._conn.execute("PRAGMA user_version").fetchone()
         if ver == 0:
             # executescript issues an implicit COMMIT, so the baseline runs
-            # in autocommit; the version stamp lands right after it
+            # in autocommit (IF NOT EXISTS makes it a no-op against a peer's
+            # concurrent bootstrap). The version stamp must re-check under
+            # the write lock: a racer that also read 0 must not clobber a
+            # peer that already advanced past the baseline, or it would
+            # re-apply ALTERs against the migrated schema.
             self._conn.executescript(_SCHEMA)
-            ver = _BASELINE_VERSION
-            self._conn.execute(f"PRAGMA user_version={ver}")
+            self._conn.execute("BEGIN IMMEDIATE")
+            try:
+                (cur,) = self._conn.execute(
+                    "PRAGMA user_version"
+                ).fetchone()
+                if cur == 0:
+                    self._conn.execute(
+                        f"PRAGMA user_version={_BASELINE_VERSION}"
+                    )
+                self._conn.execute("COMMIT")
+            except BaseException:
+                self._rollback()
+                raise
+            ver = cur if cur > 0 else _BASELINE_VERSION
         if ver > SCHEMA_VERSION:
             raise RuntimeError(
                 f"database {self._path!r} is at schema version {ver}, newer "
@@ -330,15 +399,24 @@ class Store:
             {v for v, _ in _MIGRATIONS if v > ver}
         )
         for v in pending:
-            self._conn.execute("BEGIN")
+            # IMMEDIATE + re-check: two planes opening the same fresh file
+            # concurrently must not both apply a version (the second ALTER
+            # TABLE would fail on a duplicate column)
+            self._conn.execute("BEGIN IMMEDIATE")
             try:
+                (cur_ver,) = self._conn.execute(
+                    "PRAGMA user_version"
+                ).fetchone()
+                if cur_ver >= v:
+                    self._conn.execute("COMMIT")
+                    continue
                 for mv, sql in _MIGRATIONS:
                     if mv == v:
                         self._conn.execute(sql)
                 self._conn.execute(f"PRAGMA user_version={v}")
                 self._conn.execute("COMMIT")
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                self._rollback()
                 raise
 
     async def _run(self, fn, *args):
@@ -352,7 +430,7 @@ class Store:
     # -- generic helpers ---------------------------------------------------
 
     def _exec(self, sql: str, params: Sequence[Any] = ()) -> None:
-        self._conn.execute(sql, params)
+        self._locked_retry(lambda: self._conn.execute(sql, params))
 
     def _query(self, sql: str, params: Sequence[Any] = ()) -> List[sqlite3.Row]:
         return self._conn.execute(sql, params).fetchall()
@@ -463,10 +541,10 @@ class Store:
                 self._conn.execute("COMMIT")
                 return candidate_id
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                self._rollback()
                 raise
 
-        return await self._run(txn)
+        return await self._run(self._locked_retry, txn)
 
     async def try_transition_job(self, job_id: str, from_status: str,
                                  owned_by: Optional[str] = None,
@@ -494,7 +572,7 @@ class Store:
             cur = self._conn.execute(sql, params)
             return cur.rowcount == 1
 
-        return await self._run(txn)
+        return await self._run(self._locked_retry, txn)
 
     # -- jobs --------------------------------------------------------------
 
@@ -554,6 +632,7 @@ class Store:
         region: Optional[str] = None,
         prefer: Optional[Any] = None,
         prefer_window: int = 32,
+        plane_id: Optional[str] = None,
     ) -> Optional[Dict[str, Any]]:
         """Atomically claim the best queued job for this worker.
 
@@ -569,6 +648,12 @@ class Store:
         ``prefer_window - 1`` positions, so affinity is a bounded
         reordering, not a starvation risk. The callable runs inside the
         claim transaction: it must be pure and in-memory (no store access).
+
+        ``plane_id``: the control-plane replica brokering this claim,
+        stamped on the row alongside the epoch bump. With replicated
+        planes sharing this file, two planes CAN race the same queued row:
+        the conditional UPDATE's ``status=QUEUED`` guard decides the
+        winner and the loser re-scans (returns None this poll).
         """
 
         def txn() -> Optional[sqlite3.Row]:
@@ -638,7 +723,7 @@ class Store:
                 # complete/checkpoint even if THIS worker reclaims the job
                 cur = self._conn.execute(
                     "UPDATE jobs SET status=?, worker_id=?, started_at=?, "
-                    "actual_region=?, "
+                    "actual_region=?, plane_id=?, "
                     "assignment_epoch=assignment_epoch+1 "
                     "WHERE id=? AND status=?",
                     (
@@ -646,22 +731,27 @@ class Store:
                         worker_id,
                         now,
                         region,
+                        plane_id,
                         pick["id"],
                         JobStatus.QUEUED.value,
                     ),
                 )
-                if cur.rowcount != 1:  # raced (cannot happen single-writer)
-                    self._conn.execute("ROLLBACK")
+                if cur.rowcount != 1:
+                    # raced: a peer plane claimed (or a sweep moved) this
+                    # row between our scan and the UPDATE. Single-writer
+                    # deployments never hit this; with replicated planes
+                    # the loser simply reports no job this poll.
+                    self._rollback()
                     return None
                 self._conn.execute("COMMIT")
                 return self._conn.execute(
                     "SELECT * FROM jobs WHERE id=?", (pick["id"],)
                 ).fetchone()
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                self._rollback()
                 raise
 
-        row = await self._run(txn)
+        row = await self._run(self._locked_retry, txn)
         return _decode(_JOB_JSON, row) if row is not None else None
 
     # -- prefix summaries (cache-aware routing) ----------------------------
@@ -729,10 +819,10 @@ class Store:
                 self._conn.execute("COMMIT")
                 return True
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                self._rollback()
                 raise
 
-        return await self._run(txn)
+        return await self._run(self._locked_retry, txn)
 
     async def adopt_stream_checkpoint(
         self, stream_id: str, worker_id: str
@@ -767,10 +857,10 @@ class Store:
                     return None
                 return {"state": state, "epoch": new_epoch}
             except BaseException:
-                self._conn.execute("ROLLBACK")
+                self._rollback()
                 raise
 
-        return await self._run(txn)
+        return await self._run(self._locked_retry, txn)
 
     async def delete_stream_checkpoint(self, stream_id: str, worker_id: str,
                                        epoch: int) -> bool:
@@ -786,7 +876,7 @@ class Store:
             )
             return cur.rowcount == 1
 
-        return await self._run(txn)
+        return await self._run(self._locked_retry, txn)
 
     async def get_stream_checkpoint(
         self, stream_id: str

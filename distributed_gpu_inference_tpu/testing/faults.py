@@ -398,7 +398,21 @@ FLEET_EVENT_KINDS = ("kill", "blackout", "partition", "pressure", "slow")
 # seeds keep regenerating their exact historical schedules.
 HANDOFF_EVENT_KINDS = ("handoff_partition", "handoff_corrupt",
                        "handoff_delay")
-ALL_FLEET_EVENT_KINDS = FLEET_EVENT_KINDS + HANDOFF_EVENT_KINDS
+
+# control-plane-targeted kinds (round 15 — replicated planes): chaos on the
+# plane REPLICAS themselves rather than on workers. ``plane_kill``
+# hard-stops one plane server mid-traffic (every ``plane_kill`` emits its
+# own paired ``plane_restart``, mirroring worker kill/restart),
+# ``plane_partition`` makes one plane unreachable (requests to it fail at
+# the transport) while the process stays up, ``plane_slow`` taxes every
+# request that plane answers with injected latency. The ``worker`` field of
+# a plane event indexes the PLANE cohort, not the worker fleet. Kept OUT of
+# FLEET_EVENT_KINDS so historical seeds keep regenerating their exact
+# schedules.
+PLANE_EVENT_KINDS = ("plane_kill", "plane_partition", "plane_slow")
+ALL_FLEET_EVENT_KINDS = (
+    FLEET_EVENT_KINDS + HANDOFF_EVENT_KINDS + PLANE_EVENT_KINDS
+)
 
 # the canonical suite/CLI geometry: ``--replay`` must reconstruct the EXACT
 # schedule a failing suite seed ran, so both sides share these defaults
@@ -410,6 +424,14 @@ FLEET_CHAOS_DURATION_S = 6.0
 # ``--replay SEED --pd`` reconstructs these schedules
 PD_CHAOS_WORKERS = 3
 PD_CHAOS_KINDS = ("kill", "partition") + HANDOFF_EVENT_KINDS
+
+# plane chaos suite geometry (tests/test_plane_chaos.py): 2 plane replicas
+# over one shared job store, 2 workers, plane-level events mixed with
+# worker kills so plane death lands mid-claim / mid-heartbeat / mid-stream
+# — ``--replay SEED --planes`` reconstructs these schedules
+PLANE_CHAOS_PLANES = 2
+PLANE_CHAOS_WORKERS = 2
+PLANE_CHAOS_KINDS = PLANE_EVENT_KINDS + ("kill",)
 
 
 @dataclass(frozen=True)
@@ -440,12 +462,21 @@ class FleetEvent:
                fleet-wide) — pieces poison their session, commits abort
     handoff_delay      every outbound handoff piece of the worker pays
                ``delay_s`` for ``duration_s`` — send timeouts/retries
+    plane_kill         hard-stop plane replica ``worker`` (index into the
+               PLANE cohort) mid-traffic — a crashed control plane
+    plane_restart      rebuild the killed plane over the SAME shared job
+               store and rejoin the cluster
+    plane_partition    every request to plane ``worker`` fails at the
+               transport for ``duration_s`` while the process stays up
+    plane_slow         every request plane ``worker`` answers pays
+               ``delay_s`` for ``duration_s``
     =========  ==========================================================
     """
 
     at_s: float            # offset from chaos start
     kind: str
-    worker: int            # fleet member index; -1 = fleet-wide
+    worker: int            # fleet member index; -1 = fleet-wide.
+    #                        plane_* events index the plane cohort instead
     duration_s: float = 0.0
     prob: float = 1.0      # pressure: per-allocation firing probability
     delay_s: float = 0.0   # slow: injected per-hit latency
@@ -469,7 +500,8 @@ class FleetFaultPlan:
                  n_workers: int = FLEET_CHAOS_WORKERS,
                  duration_s: float = FLEET_CHAOS_DURATION_S,
                  kinds: Sequence[str] = FLEET_EVENT_KINDS,
-                 max_disruptions: int = 2) -> None:
+                 max_disruptions: int = 2,
+                 n_planes: int = PLANE_CHAOS_PLANES) -> None:
         for k in kinds:
             if k not in ALL_FLEET_EVENT_KINDS:
                 raise ValueError(
@@ -481,6 +513,9 @@ class FleetFaultPlan:
         self.duration_s = duration_s
         self.kinds = tuple(kinds)
         self.max_disruptions = max_disruptions
+        # plane cohort size — only consulted when a plane_* kind is drawn,
+        # so schedules without plane kinds are bit-identical to round 9
+        self.n_planes = n_planes
         self.events: List[FleetEvent] = self._generate()
         self.trace: List[Tuple[float, str, int]] = []
 
@@ -493,7 +528,13 @@ class FleetFaultPlan:
         cursor = self.duration_s * (0.10 + 0.15 * rng.random())
         for _ in range(n):
             kind = self.kinds[rng.randrange(len(self.kinds))]
-            worker = rng.randrange(self.n_workers)
+            # plane events target the plane cohort; everything else the
+            # worker fleet. One randrange draw either way, so kind sets
+            # WITHOUT plane kinds consume the rng identically to round 9.
+            if kind in PLANE_EVENT_KINDS:
+                worker = rng.randrange(max(1, self.n_planes))
+            else:
+                worker = rng.randrange(self.n_workers)
             dur = self.duration_s * (0.20 + 0.25 * rng.random())
             if kind == "kill":
                 events.append(FleetEvent(round(cursor, 3), "kill", worker))
@@ -524,7 +565,23 @@ class FleetFaultPlan:
                     duration_s=round(dur, 3),
                     delay_s=round(0.02 + 0.08 * rng.random(), 3),
                 ))
-            else:  # blackout / partition / handoff_partition
+            elif kind == "plane_kill":
+                # like worker kill: every plane_kill pairs its own
+                # plane_restart, so no schedule strands a dead plane
+                events.append(
+                    FleetEvent(round(cursor, 3), "plane_kill", worker)
+                )
+                events.append(
+                    FleetEvent(round(cursor + dur, 3), "plane_restart",
+                               worker)
+                )
+            elif kind == "plane_slow":
+                events.append(FleetEvent(
+                    round(cursor, 3), "plane_slow", worker,
+                    duration_s=round(dur, 3),
+                    delay_s=round(0.02 + 0.08 * rng.random(), 3),
+                ))
+            else:  # blackout / partition / handoff_partition / plane_partition
                 events.append(FleetEvent(
                     round(cursor, 3), kind, worker,
                     duration_s=round(dur, 3),
@@ -544,13 +601,18 @@ class FleetFaultPlan:
             f"duration={self.duration_s}s, kinds={','.join(self.kinds)})"
         ]
         for e in self.events:
-            tgt = "fleet" if e.worker < 0 else f"worker[{e.worker}]"
+            if e.worker < 0:
+                tgt = "fleet"
+            elif e.kind.startswith("plane_"):
+                tgt = f"plane[{e.worker}]"
+            else:
+                tgt = f"worker[{e.worker}]"
             extra = ""
             if e.duration_s:
                 extra += f" for {e.duration_s}s"
             if e.kind in ("pressure", "handoff_corrupt"):
                 extra += f" prob={e.prob:.2f}"
-            if e.kind in ("slow", "handoff_delay"):
+            if e.kind in ("slow", "handoff_delay", "plane_slow"):
                 extra += f" delay={e.delay_s}s"
             out.append(f"  t+{e.at_s:6.2f}s  {e.kind:<9} {tgt}{extra}")
         return out
@@ -611,16 +673,34 @@ def _replay_main(argv: Optional[Sequence[str]] = None) -> int:
                     "PD-split suite's kinds (kill/partition + handoff_"
                     "partition/corrupt/delay) and its 3-worker fleet "
                     "geometry")
+    ap.add_argument("--planes", action="store_true",
+                    help="reconstruct a tests/test_plane_chaos.py seed: "
+                    "the plane suite's kinds (plane_kill/plane_partition/"
+                    "plane_slow + worker kill) and its 2-plane / 2-worker "
+                    "geometry")
     args = ap.parse_args(argv)
+    if args.pd and args.planes:
+        ap.error("--pd and --planes are mutually exclusive")
     kinds = args.kinds
     if kinds is None:
-        kinds = ",".join(PD_CHAOS_KINDS if args.pd else FLEET_EVENT_KINDS)
+        if args.pd:
+            kinds = ",".join(PD_CHAOS_KINDS)
+        elif args.planes:
+            kinds = ",".join(PLANE_CHAOS_KINDS)
+        else:
+            kinds = ",".join(FLEET_EVENT_KINDS)
     workers = args.workers
     if workers is None:
-        workers = PD_CHAOS_WORKERS if args.pd else FLEET_CHAOS_WORKERS
+        if args.pd:
+            workers = PD_CHAOS_WORKERS
+        elif args.planes:
+            workers = PLANE_CHAOS_WORKERS
+        else:
+            workers = FLEET_CHAOS_WORKERS
     plan = FleetFaultPlan(
         args.replay, n_workers=workers, duration_s=args.duration,
         kinds=tuple(k for k in kinds.split(",") if k),
+        n_planes=PLANE_CHAOS_PLANES,
     )
     for line in plan.describe():
         print(line)

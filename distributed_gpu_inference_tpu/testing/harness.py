@@ -24,11 +24,13 @@ observed reactions share one scrape timeline.
 from __future__ import annotations
 
 import asyncio
+import os
 import socket
+import tempfile
 import threading
 import time
 import uuid
-from typing import Any, Coroutine, Dict, List, Optional
+from typing import Any, Coroutine, Dict, List, Optional, Union
 
 from aiohttp import web
 
@@ -38,7 +40,14 @@ from .faults import FaultPlan, FaultRule, FleetFaultPlan
 
 
 class LiveControlPlane:
-    """Context manager: a served control plane + direct service access."""
+    """Context manager: a served control plane + direct service access.
+
+    Round 15 adds a kill/restart lifecycle for plane chaos: :meth:`kill`
+    hard-stops the server mid-traffic (in-flight requests die, the store
+    connection closes — the db FILE and its WAL survive for peer planes),
+    and :meth:`start` after a kill rebuilds the replica cold on the SAME
+    port, so endpoint lists held by workers and SDK clients keep working.
+    """
 
     def __init__(self, **state_kw: Any) -> None:
         self._state_kw = state_kw
@@ -47,10 +56,23 @@ class LiveControlPlane:
         self._runner: Optional[web.AppRunner] = None
         self.state: Optional[ServerState] = None
         self.port: int = 0
+        self.alive = False
 
     # -- lifecycle -----------------------------------------------------------
 
     def __enter__(self) -> "LiveControlPlane":
+        self.start()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.kill()
+
+    def start(self) -> None:
+        """Cold start (or cold RESTART after :meth:`kill`): fresh loop,
+        fresh ServerState over the same ``db_path`` — migrations re-run
+        idempotently, and a shared job store keeps every epoch fence."""
+        if self.alive:
+            return
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
             target=self._loop.run_forever, name="live-control-plane",
@@ -58,15 +80,23 @@ class LiveControlPlane:
         )
         self._thread.start()
         self.call(self._start())
-        return self
+        self.alive = True
 
-    def __exit__(self, *exc: Any) -> None:
+    def kill(self) -> None:
+        """Hard stop: in-flight requests die with the server. Safe to call
+        twice; :meth:`start` afterwards is a restart on the same port."""
+        if self._loop is None:
+            return
+        self.alive = False
         try:
-            self.call(self._stop())
+            self.call(self._stop(), timeout_s=15.0)
         finally:
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=10.0)
             self._loop.close()
+            self._loop = None
+            self._thread = None
+            self._runner = None
 
     async def _start(self) -> None:
         # ServerState (and its Store/asyncio primitives) is created on the
@@ -76,9 +106,12 @@ class LiveControlPlane:
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         sock = socket.socket()
-        sock.bind(("127.0.0.1", 0))
+        # a restart must land on the port the first start drew — every
+        # registered worker/SDK endpoint list points there
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(("127.0.0.1", self.port))
         self.port = sock.getsockname()[1]
-        site = web.SockSite(self._runner, sock)
+        site = web.SockSite(self._runner, sock, shutdown_timeout=2.0)
         await site.start()
 
     async def _stop(self) -> None:
@@ -366,6 +399,113 @@ class FleetWorker:
             or self.llm.engine.num_active == 0
 
 
+class FakeFleetWorker:
+    """Lightweight fleet member for plane-scale benchmarking (round 15):
+    registers, heartbeats, claims and INSTANTLY completes jobs through the
+    real :class:`~..worker.api_client.APIClient` — the full control-plane
+    protocol (signing, epoch-fenced completion, plane failover) with no
+    JAX engine, no batcher, no direct server. Hundreds of these fit in one
+    process, which is what measuring claims/s and heartbeat ingest against
+    the plane cohort needs. Exposes the :class:`FleetWorker` lifecycle
+    subset the chaos driver touches (``alive``/``kill``/``start``/
+    ``blackout``)."""
+
+    def __init__(self, index: int, plane_url: Any,
+                 hb_interval_s: float = 0.2,
+                 poll_interval_s: float = 0.05,
+                 region: str = "us-west") -> None:
+        self.index = index
+        self.plane_url = plane_url
+        self.hb_interval_s = hb_interval_s
+        self.poll_interval_s = poll_interval_s
+        self.region = region
+        self.tag = f"fk{index}"
+        self.fingerprint = f"fake-{index}-{uuid.uuid4().hex[:8]}"
+        self.alive = False
+        self.api: Optional[Any] = None
+        self.worker_id: Optional[str] = None
+        self.completed = 0          # jobs this member instantly served
+        self.heartbeats = 0         # beats that reached a plane
+        self._hb_blocked = threading.Event()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        from ..worker.api_client import APIClient
+
+        api = APIClient(self.plane_url, backoff_s=0.0)
+        api.fault_tag = self.tag
+        api.register({
+            "name": self.tag, "region": self.region,
+            "machine_fingerprint": self.fingerprint,
+            "supported_types": ["llm"], "supports_direct": False,
+        })
+        self.worker_id = api.worker_id
+        self.api = api
+        self._hb_blocked.clear()
+        self._stop.clear()
+        try:
+            api.heartbeat(status="idle")
+            self.heartbeats += 1
+        except Exception:  # noqa: BLE001 — loop beats catch up
+            pass
+        self._threads = [
+            threading.Thread(target=self._hb_loop,
+                             name=f"{self.tag}-hb", daemon=True),
+            threading.Thread(target=self._poll_loop,
+                             name=f"{self.tag}-poll", daemon=True),
+        ]
+        for t in self._threads:
+            t.start()
+        self.alive = True
+
+    def _hb_loop(self) -> None:
+        while not self._stop.wait(self.hb_interval_s):
+            if self._hb_blocked.is_set():
+                continue
+            try:
+                self.api.heartbeat(status="idle")
+                self.heartbeats += 1
+            except Exception:  # noqa: BLE001 — outage: next tick retries
+                pass
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                job = self.api.fetch_next_job()
+                if job is None:
+                    continue
+                self.api.complete_job(
+                    job["id"], True,
+                    result={"text": f"fake:{job['id']}"},
+                    assignment_epoch=job.get("assignment_epoch"),
+                )
+                self.completed += 1
+            except Exception:  # noqa: BLE001 — outage: next tick retries
+                pass
+
+    def blackout(self, on: bool) -> None:
+        if on:
+            self._hb_blocked.set()
+        else:
+            self._hb_blocked.clear()
+
+    def kill(self) -> None:
+        if not self.alive:
+            return
+        self.alive = False
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads = []
+        if self.api is not None:
+            self.api.close()
+            self.api = None
+
+    def stop(self) -> None:
+        self.kill()
+
+
 class LiveFleet:
     """Context manager: a live control plane + N real workers + a seeded
     chaos driver. The production composition in one object:
@@ -387,7 +527,9 @@ class LiveFleet:
                  sweep_interval_s: float = 0.25,
                  submit_queue_limit: int = 0,
                  roles: Optional[List[Optional[str]]] = None,
-                 pd_data_plane: bool = False) -> None:
+                 pd_data_plane: bool = False,
+                 n_planes: int = 1,
+                 fake_engines: bool = False) -> None:
         self.n = n
         self.engine_config = dict(engine_config or DEFAULT_FLEET_ENGINE)
         self.hb_interval_s = hb_interval_s
@@ -399,11 +541,37 @@ class LiveFleet:
         # PD split fleets: every member runs a /kv/transfer data plane and
         # registers its URL (role rebalance can point a handoff anywhere)
         self.pd_data_plane = pd_data_plane
-        self.plane = LiveControlPlane(
-            heartbeat_timeout_s=heartbeat_timeout_s,
-            submit_queue_limit=submit_queue_limit,
-        )
-        self.members: List[FleetWorker] = []
+        # fake_engines (round 15): members are FakeFleetWorker — heartbeat
+        # + claim + instant-complete through the real APIClient, no JAX
+        # engine. The plane-scale bench packs hundreds into one process.
+        self.fake_engines = fake_engines
+        # replicated control planes (round 15): N plane replicas over ONE
+        # shared sqlite file. ``:memory:`` cannot be shared across
+        # connections, so a multi-plane fleet gets a temp db file; the
+        # single-plane default keeps the exact round-9 construction
+        # (in-memory store, PlaneCluster disabled — byte-identical).
+        self.n_planes = max(1, int(n_planes))
+        self._db_tmp: Optional[tempfile.TemporaryDirectory] = None
+        if self.n_planes == 1:
+            self.plane = LiveControlPlane(
+                heartbeat_timeout_s=heartbeat_timeout_s,
+                submit_queue_limit=submit_queue_limit,
+            )
+            self.planes: List[LiveControlPlane] = [self.plane]
+        else:
+            self._db_tmp = tempfile.TemporaryDirectory(prefix="dgi-planes-")
+            db_path = os.path.join(self._db_tmp.name, "jobs.db")
+            self.planes = [
+                LiveControlPlane(
+                    db_path=db_path,
+                    heartbeat_timeout_s=heartbeat_timeout_s,
+                    submit_queue_limit=submit_queue_limit,
+                    plane_id=f"plane-{i}",
+                )
+                for i in range(self.n_planes)
+            ]
+            self.plane = self.planes[0]
+        self.members: List[Union[FleetWorker, FakeFleetWorker]] = []
         self._sweep_stop = threading.Event()
         self._sweeper: Optional[threading.Thread] = None
         self._chaos_thread: Optional[threading.Thread] = None
@@ -412,16 +580,19 @@ class LiveFleet:
     # -- lifecycle -----------------------------------------------------------
 
     def __enter__(self) -> "LiveFleet":
-        self.plane.__enter__()
+        for p in self.planes:
+            p.__enter__()
+        if len(self.planes) > 1:
+            # peer membership needs every port, which only exists after
+            # start — wire it post-hoc (PlaneCluster reads peers per
+            # forward, so a late assignment is safe)
+            for p in self.planes:
+                p.state.plane.peers = [
+                    q.url for q in self.planes if q is not p
+                ]
         try:
             for i in range(self.n):
-                m = FleetWorker(
-                    i, self.plane.url, self.engine_config,
-                    hb_interval_s=self.hb_interval_s,
-                    poll_interval_s=self.poll_interval_s,
-                    role=self.roles[i],
-                    pd_data_plane=self.pd_data_plane,
-                )
+                m = self._build_member(i, role=self.roles[i])
                 m.start()
                 self.members.append(m)
             self._sweep_stop.clear()
@@ -433,6 +604,28 @@ class LiveFleet:
             self.__exit__(None, None, None)
             raise
         return self
+
+    def _build_member(
+        self, index: int, role: Optional[str] = None
+    ) -> Union[FleetWorker, FakeFleetWorker]:
+        # workers get EVERY plane endpoint (single-plane: the same string
+        # as always) — the APIClient's sticky health-probed failover owns
+        # which one is active
+        urls = self.plane_urls
+        target = urls[0] if len(urls) == 1 else urls
+        if self.fake_engines:
+            return FakeFleetWorker(
+                index, target,
+                hb_interval_s=self.hb_interval_s,
+                poll_interval_s=self.poll_interval_s,
+            )
+        return FleetWorker(
+            index, target, self.engine_config,
+            hb_interval_s=self.hb_interval_s,
+            poll_interval_s=self.poll_interval_s,
+            role=role,
+            pd_data_plane=self.pd_data_plane,
+        )
 
     def __exit__(self, *exc: Any) -> None:
         try:
@@ -446,20 +639,45 @@ class LiveFleet:
                     m.stop()
                 except Exception:  # noqa: BLE001 — teardown best-effort
                     pass
-            self.plane.__exit__(None, None, None)
+            for p in self.planes:
+                p.__exit__(None, None, None)
+            if self._db_tmp is not None:
+                try:
+                    self._db_tmp.cleanup()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
 
     def _sweep_loop(self) -> None:
         while not self._sweep_stop.wait(self.sweep_interval_s):
-            try:
-                self.plane.sweep()
-            except Exception:  # noqa: BLE001 — next tick retries
-                pass
+            # sweeps must survive plane chaos: run on the first ALIVE
+            # replica (guarantee sweeps are fenced conditional writes over
+            # the shared store, so any replica may run them)
+            for p in self.planes:
+                if not p.alive:
+                    continue
+                try:
+                    p.sweep()
+                    break
+                except Exception:  # noqa: BLE001 — next plane / next tick
+                    continue
 
     @property
     def url(self) -> str:
         return self.plane.url
 
-    def alive_members(self) -> List[FleetWorker]:
+    @property
+    def plane_urls(self) -> List[str]:
+        return [p.url for p in self.planes]
+
+    def any_plane(self) -> LiveControlPlane:
+        """The first ALIVE plane replica (for store queries / sweeps in
+        tests while chaos may have killed the primary)."""
+        for p in self.planes:
+            if p.alive:
+                return p
+        return self.plane
+
+    def alive_members(self) -> List[Union[FleetWorker, FakeFleetWorker]]:
         return [m for m in self.members if m.alive]
 
     # -- elastic capacity (round 12: the autoscaler's actuation surface) -----
@@ -471,13 +689,7 @@ class LiveFleet:
         worker indices stay stable. Blocks until the replica is
         registered and heartbeating — the caller measuring cold-start
         lead time times this call."""
-        m = FleetWorker(
-            len(self.members), self.plane.url, self.engine_config,
-            hb_interval_s=self.hb_interval_s,
-            poll_interval_s=self.poll_interval_s,
-            role=role,
-            pd_data_plane=self.pd_data_plane,
-        )
+        m = self._build_member(len(self.members), role=role)
         m.start()
         self.members.append(m)
         self.roles.append(role)
@@ -571,7 +783,10 @@ class LiveFleet:
     def _execute(self, ev: Any, fp: FaultPlan) -> Optional[Any]:
         """Apply one fleet event; returns the disarm callback for windowed
         kinds (None for kill/restart)."""
-        member = self.members[ev.worker] if ev.worker >= 0 else None
+        member = (
+            self.members[ev.worker]
+            if ev.worker >= 0 and not ev.kind.startswith("plane_") else None
+        )
         if ev.kind == "kill":
             member.kill()
             return None
@@ -620,6 +835,31 @@ class LiveFleet:
                      [FaultRule(site="worker.pd.push", kind="delay",
                                 delay_s=ev.delay_s, times=None)])
             armed = [fp.add_rule(r) for r in rules]
+            return lambda: [fp.remove_rule(r) for r in armed]
+        if ev.kind == "plane_kill":
+            # ev.worker indexes the PLANE cohort for plane events
+            self.planes[ev.worker].kill()
+            return None
+        if ev.kind == "plane_restart":
+            self.planes[ev.worker].start()
+            return None
+        if ev.kind in ("plane_partition", "plane_slow"):
+            # cut (or tax) every request ADDRESSED TO this plane at the
+            # client seams — worker API calls, health probes, SDK calls —
+            # while the plane process itself stays up. Matching on the
+            # destination endpoint means failover probes see exactly what
+            # real requests see.
+            pat = f"*:{self.planes[ev.worker].port}"
+            kw: Dict[str, Any] = (
+                {"kind": "flap"} if ev.kind == "plane_partition"
+                else {"kind": "delay", "delay_s": ev.delay_s}
+            )
+            armed = [
+                fp.add_rule(FaultRule(site="worker.api.request", times=None,
+                                      match={"server": pat}, **kw)),
+                fp.add_rule(FaultRule(site="sdk.client.request", times=None,
+                                      match={"server": pat}, **kw)),
+            ]
             return lambda: [fp.remove_rule(r) for r in armed]
         raise ValueError(f"unknown fleet event kind {ev.kind!r}")
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 import json
 import random
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 import httpx
 
@@ -33,9 +33,22 @@ class APIError(Exception):
 
 
 class APIClient:
+    """Control-plane HTTP client with plane-failover.
+
+    ``base_url`` accepts a single URL (the historical single-plane
+    contract, unchanged) or a LIST of plane endpoints. With a list, a
+    transport failure or 5xx on the active plane rotates to the next
+    health-probed peer and the request is retried there WITHOUT burning a
+    backoff attempt — the rotation sticks, so every later heartbeat /
+    poll / completion / checkpoint / adoption targets the surviving plane.
+    Duplicate-delivery idempotency on the server (terminal completes
+    answer ``{"ok": true, "duplicate": true}``; checkpoint upserts are
+    epoch-fenced) is what makes the cross-plane retry safe.
+    """
+
     def __init__(
         self,
-        base_url: str,
+        base_url: Union[str, Sequence[str]],
         worker_id: Optional[str] = None,
         auth_token: Optional[str] = None,
         refresh_token: Optional[str] = None,
@@ -47,7 +60,11 @@ class APIClient:
         transport: Optional[httpx.BaseTransport] = None,
         rng: Optional[random.Random] = None,
     ) -> None:
-        self.base_url = base_url.rstrip("/")
+        urls = [base_url] if isinstance(base_url, str) else list(base_url)
+        if not urls:
+            raise ValueError("APIClient needs at least one plane endpoint")
+        self.endpoints: List[str] = [u.rstrip("/") for u in urls]
+        self._active = 0
         self.worker_id = worker_id
         self.auth_token = auth_token
         self.refresh_token = refresh_token
@@ -58,12 +75,65 @@ class APIClient:
         # full-jitter source; injectable so tests can pin the schedule
         self._rng = rng if rng is not None else random.Random()
         self._signer = RequestSigner()
-        self._client = httpx.Client(
-            base_url=self.base_url, timeout=timeout_s, transport=transport
-        )
+        self._timeout_s = timeout_s
+        self._clients = [
+            httpx.Client(base_url=u, timeout=timeout_s, transport=transport)
+            for u in self.endpoints
+        ]
+        # observability: how often this worker changed planes (the chaos
+        # suite asserts failovers actually happened under plane kills)
+        self.plane_failovers = 0
+
+    @property
+    def base_url(self) -> str:
+        """The ACTIVE plane endpoint (single-plane: the only one)."""
+        return self.endpoints[self._active]
+
+    @property
+    def _client(self) -> httpx.Client:
+        return self._clients[self._active]
 
     def close(self) -> None:
-        self._client.close()
+        for c in self._clients:
+            c.close()
+
+    # -- plane failover ------------------------------------------------------
+
+    def _probe_plane(self, index: int) -> bool:
+        """GET /health on a candidate plane, through the same chaos seam as
+        real requests: a partitioned plane is alive but unreachable FROM
+        THIS WORKER, and the probe must see what the worker sees."""
+        try:
+            resp = _faults.wrap_http(
+                "worker.api.request",
+                lambda: self._clients[index].get("/health", timeout=2.0),
+                method="GET", path="/health",
+                worker=str(getattr(self, "fault_tag", "") or ""),
+                # destination endpoint: plane-targeted chaos rules
+                # (plane_partition / plane_slow) match on it
+                server=self.endpoints[index],
+            )
+            return resp.status_code == 200
+        except Exception:  # noqa: BLE001 — any failure means unhealthy
+            return False
+
+    def _failover_plane(self) -> bool:
+        """Rotate to the next healthy plane endpoint (sticky — later
+        requests start there). Prefers a probe-healthy peer; falls back to
+        plain round-robin when nothing probes healthy right now (the
+        request-level retry ladder keeps rotating). Returns False on a
+        single-endpoint client."""
+        if len(self.endpoints) <= 1:
+            return False
+        for step in range(1, len(self.endpoints)):
+            cand = (self._active + step) % len(self.endpoints)
+            if self._probe_plane(cand):
+                self._active = cand
+                self.plane_failovers += 1
+                return True
+        self._active = (self._active + 1) % len(self.endpoints)
+        self.plane_failovers += 1
+        return True
 
     # -- low-level ----------------------------------------------------------
 
@@ -96,7 +166,16 @@ class APIClient:
         attempts = (self._max_retries if retries is None else retries) + 1
         budget = self._retry_budget_s
         last_exc: Optional[Exception] = None
-        for attempt in range(attempts):
+        # plane failover: a transport failure / 5xx rotates to a peer plane
+        # and retries THERE without consuming a backoff attempt — bounded
+        # to one full lap of the endpoint list per request, so a dead
+        # cohort still exhausts in finite time. Even a retries=0 call
+        # (next-job poll, stream checkpoint) gets its lap: the rotation is
+        # sticky, so the NEXT call starts on the surviving plane.
+        rotations = 0
+        max_rotations = len(self.endpoints) - 1
+        attempt = 0
+        while attempt < attempts:
             try:
                 resp = _faults.wrap_http(
                     "worker.api.request",
@@ -109,21 +188,32 @@ class APIClient:
                     # fault_tag): a bidirectional partition must cut ONE
                     # worker's control-plane traffic, not the process's
                     worker=str(getattr(self, "fault_tag", "") or ""),
+                    # destination endpoint: plane-targeted chaos rules
+                    # (plane_partition / plane_slow) match on it
+                    server=self.base_url,
                 )
             except httpx.TransportError as exc:
                 last_exc = exc
-                if attempt + 1 >= attempts:
+                if rotations < max_rotations and self._failover_plane():
+                    rotations += 1
+                    continue
+                attempt += 1
+                if attempt >= attempts:
                     break
-                slept = self._backoff(attempt, budget)
+                slept = self._backoff(attempt - 1, budget)
                 if slept is None:
                     break
                 budget -= slept
                 continue
             if resp.status_code >= 500:
                 last_exc = APIError(resp.status_code, resp.text[:200])
-                if attempt + 1 >= attempts:
+                if rotations < max_rotations and self._failover_plane():
+                    rotations += 1
+                    continue
+                attempt += 1
+                if attempt >= attempts:
                     raise last_exc
-                slept = self._backoff(attempt, budget)
+                slept = self._backoff(attempt - 1, budget)
                 if slept is None:
                     raise last_exc
                 budget -= slept

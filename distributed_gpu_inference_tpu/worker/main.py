@@ -190,7 +190,9 @@ class Worker:
     ) -> None:
         self.config = config
         self.api = api or APIClient(
-            config.server.url,
+            # plane cohort: primary + fallbacks become the failover list
+            # (a single URL keeps the historical one-plane behavior)
+            [config.server.url, *(config.server.fallback_urls or [])],
             worker_id=config.server.worker_id,
             auth_token=config.server.auth_token,
             refresh_token=config.server.refresh_token,
@@ -234,6 +236,11 @@ class Worker:
         # credential-blip re-register by the same live process (same
         # boot_id → running work stays put)
         self.boot_id = uuid.uuid4().hex
+        # plane cohort: identity of the control-plane replica that answered
+        # our last heartbeat (None single-plane / pre-first-beat). A CHANGE
+        # means we failed over — the new plane holds no ACKed base for our
+        # prefix-summary delta chain, so a full snapshot must be resynced.
+        self._last_plane_id: Optional[str] = None
         self.stats: Dict[str, Any] = {
             "jobs_completed": 0, "jobs_failed": 0, "jobs_rejected": 0,
             "jobs_migrated": 0,
@@ -612,6 +619,33 @@ class Worker:
                     # not hold — fall back to a full snapshot
                     summary_eng.prefix_summary_resync()
                 summary_eng = None
+            plane_id = resp.get("plane_id")
+            if isinstance(plane_id, str) and plane_id:
+                if self._last_plane_id is not None \
+                        and plane_id != self._last_plane_id:
+                    # plane failover: a DIFFERENT replica answered this
+                    # beat. Its registry has no ACKed base for our delta
+                    # chain (and may hold nothing at all for us) — force a
+                    # full-snapshot resync now, even on in-sync beats that
+                    # carry no payload, so affinity routing converges
+                    # within one round-trip instead of staying blind until
+                    # the next cache mutation. Runs AFTER the ack block:
+                    # an ack from the new plane must not commit a base it
+                    # only just learned.
+                    log.info(
+                        "control plane changed (%s -> %s); resyncing "
+                        "prefix summary", self._last_plane_id, plane_id,
+                    )
+                    self.stats["plane_failovers"] = \
+                        self.stats.get("plane_failovers", 0) + 1
+                    for eng in self.engines.values():
+                        fn = getattr(eng, "prefix_summary_resync", None)
+                        if fn is not None:
+                            try:
+                                fn()
+                            except Exception:  # noqa: BLE001 — advisory
+                                pass
+                self._last_plane_id = plane_id
             if resp.get("stale_job") and self.current_job_id:
                 # the server requeued our claim (we looked dead): the
                 # in-flight inference cannot be cancelled mid-graph, but

@@ -17,6 +17,9 @@ import pathlib
 
 # ordered heaviest-first; files absent from the checkout are skipped
 HEAVY = [
+    "tests/test_plane_chaos.py",         # 25-seed plane-cohort chaos
+    #   (multi-plane LiveFleet: plane kills/partitions/latency while
+    #   open-loop queued+SSE traffic runs over a shared job store)
     "tests/test_overload_chaos.py",      # 25-seed overload-under-chaos
     #   (10x free-tier burst + admission ladder + kill/restart + the
     #   live-fleet autoscaler legs)
