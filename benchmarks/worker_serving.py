@@ -2146,6 +2146,195 @@ def run_spec_ab(args: Any, backend: str, model: str) -> None:
     emit(out)
 
 
+# ---------------------------------------------------------------------------
+# --long-context (round 17): the mixed-traffic frontier. Three legs on ONE
+# engine through the REAL DirectServer + batcher ragged rounds:
+#   baseline    — the short-request stream alone (no long traffic)
+#   unbudgeted  — same short stream + background --long-len prompts,
+#                 prefill_budget=0 (a giant admission may claim the whole
+#                 chunk bucket round after round)
+#   budgeted    — same traffic, prefill_budget pushed LIVE via the serving
+#                 remote-config path (the deployed knob, not a rebuild)
+# The verdict metric is the SHORT requests' decode ITL p95: budgeted must
+# land materially closer to baseline than unbudgeted. Outputs are asserted
+# byte-identical budgeted vs unbudgeted (chunk widths change, tokens must
+# not), and --timeline attributes where the long prefill time goes.
+# ---------------------------------------------------------------------------
+
+
+def _itl_ms(results: List[Dict[str, Any]]) -> List[float]:
+    """Per-request mean inter-token latency: decode time spread over the
+    tokens after the first. The tail of THIS distribution over short
+    requests is what a monopolizing long prefill wrecks."""
+    out = []
+    for r in results:
+        if r.get("status") == 200 and r.get("ttft_ms") is not None:
+            n = r.get("completion_tokens") or 0
+            if n > 1:
+                out.append((r["e2e_ms"] - r["ttft_ms"]) / (n - 1))
+    return out
+
+
+def run_long_context(args: Any, backend: str, model: str) -> None:
+    from distributed_gpu_inference_tpu.worker.direct_server import (
+        DirectServer,
+    )
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+    rate = float(args.arrival_rate) if args.arrival_rate else 2.0
+    long_len = int(args.long_len)
+    n_long = max(1, int(args.long_requests))
+    blocks = 16  # EngineConfig default block_size
+    short_blocks = -(-(args.prompt_len + args.max_tokens + 16) // blocks)
+    long_blocks = -(-(long_len + args.max_tokens + 16) // blocks)
+    # chunk width of the unbudgeted rounds, and the width the budget caps
+    # rounds to (floored at the short-prompt bucket so short admissions
+    # never pad up to the full chunk)
+    chunk = min(2048, long_len)
+    bud_w = min(max(int(args.prefill_budget) or chunk,
+                    args.prompt_len + 1), chunk)
+    llm = TPULLMEngine({
+        "model": model,
+        "max_batch_size": args.concurrency,
+        "max_seq_len": long_len + args.max_tokens + 16,
+        # size the pool for the ACTUAL working set (shorts + the long
+        # streams), not 1.5x batch x the 32k worst case — the default
+        # sizing rule assumes every slot can be max_seq_len deep, which
+        # at 32k is pure pad
+        "num_blocks": args.concurrency
+        * max(short_blocks, -(-(bud_w + 32) // blocks))
+        + (n_long + 1) * long_blocks + 64,
+        "quantization": args.quantization,
+        # pin the compiled widths to exactly the two the legs dispatch —
+        # the budget-capped chunk and the full chunk. Budget grants
+        # bucket UP through prefill_buckets, so a free-form bucket
+        # ladder would let the water-fill land widths no warmup
+        # compiled and bill cold XLA compiles to the budgeted leg
+        "prefill_buckets": tuple(sorted({bud_w, chunk})),
+        "serving": {
+            "target_step_ms": args.target_step_ms,
+            "queue_limit": max(4096, args.requests * 2),
+            "default_timeout_s": 1800.0,
+            "ragged_chunk": chunk,
+        },
+    })
+    llm.load_model()
+    worker = BenchWorker(llm)
+    ds = DirectServer(worker, host="127.0.0.1", port=0)
+    ds.start()
+    url = f"http://127.0.0.1:{ds._runner.addresses[0][1]}"
+
+    # warm the budget-capped width at full wave concurrency (the shorts
+    # live there) and the full chunk width single-file (only the long
+    # stream's ragged chunks dispatch it — a w-wide wave of full-chunk
+    # prompts would need a pool sized for pure pad)
+    _warm(llm, bud_w, llm.serving.batcher._levels, args.concurrency)
+    if chunk != bud_w:
+        _warm(llm, chunk, llm.serving.batcher._levels, 1)
+    shorts = synth_prompt_strings(args.requests, args.prompt_len,
+                                  args.shared_prefix, seed=args.seed)
+    longs = synth_prompt_strings(n_long, long_len, 0, seed=args.seed + 1)
+
+    async def leg_async(include_long: bool):
+        st = _drive_http(url, shorts, args.max_tokens, rate,
+                         args.concurrency, args.seed,
+                         trace=args.timeline, collect_text=True)
+        if include_long:
+            # the long stream fires immediately and all at once (its own
+            # closed loop) so the giant prefills overlap the short
+            # stream's whole span; the SHORT arrival schedule (rate +
+            # seed) is byte-identical across all three legs
+            lt = _drive_http(url, longs, args.max_tokens, None, n_long,
+                             args.seed + 1, trace=args.timeline,
+                             collect_text=True)
+            return await asyncio.gather(st, lt)
+        return [await st, ([], 0.0, 0.0)]
+
+    def leg(name: str, include_long: bool, budget: int) -> Dict[str, Any]:
+        # push the budget through the REAL remote-config path, then fence
+        # on the engine executor so the push (applied between rounds on
+        # the loop thread) lands before the first measured request
+        llm.apply_serving_config({"prefill_budget": budget})
+        deadline = time.perf_counter() + 5.0
+        while llm.serving.batcher.cfg.prefill_budget != budget \
+                and time.perf_counter() < deadline:
+            time.sleep(0.01)   # the push applies on the loop thread
+        llm.serving.run_exclusive(llm.engine.manager.clear_cached)
+        pre = {k: llm.serving.get_stats().get(k, 0)
+               for k in ("budgeted_rounds", "budget_skipped_admissions",
+                         "ragged_rounds")}
+        (s_res, s_el, s_span), (l_res, _l_el, _l_span) = asyncio.run(
+            leg_async(include_long)
+        )
+        stats = llm.serving.get_stats()
+        out: Dict[str, Any] = {
+            "prefill_budget": budget,
+            "short": _summarize(s_res, s_el, s_span),
+            "short_itl_ms": percentiles(_itl_ms(s_res)),
+            "rounds": {k: stats.get(k, 0) - pre[k] for k in pre},
+        }
+        if include_long:
+            ok_long = [r for r in l_res if r["status"] == 200]
+            out["long"] = {
+                "requests": n_long, "ok": len(ok_long),
+                "prompt_len": long_len,
+                "ttft_ms": percentiles(
+                    [r["ttft_ms"] for r in ok_long
+                     if r.get("ttft_ms") is not None]
+                ),
+                "e2e_ms": percentiles([r["e2e_ms"] for r in ok_long]),
+            }
+        if args.timeline:
+            out["attribution_short"] = _timeline_attribution(s_res)
+            if include_long:
+                out["attribution_long"] = _timeline_attribution(l_res)
+        out["_texts"] = (
+            [r.get("text") for r in s_res] + [r.get("text") for r in l_res]
+        )
+        return out
+
+    ragged_chunk = int(llm.engine.cfg.ragged_chunk)
+    try:
+        baseline = leg("baseline", False, 0)
+        unbudgeted = leg("unbudgeted", True, 0)
+        budgeted = leg("budgeted", True, int(args.prefill_budget))
+    finally:
+        ds.stop()
+        llm.unload()
+
+    identical = unbudgeted.pop("_texts") == budgeted.pop("_texts")
+    baseline.pop("_texts")
+    base_itl = (baseline["short_itl_ms"] or {}).get("p95")
+    unb_itl = (unbudgeted["short_itl_ms"] or {}).get("p95")
+    bud_itl = (budgeted["short_itl_ms"] or {}).get("p95")
+    out = {
+        "benchmark": "worker_serving_long_context",
+        "path": "direct_server+batcher_engine+ragged_rounds",
+        "model": model, "backend": backend,
+        "requests": args.requests, "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len, "max_tokens": args.max_tokens,
+        "arrival_rate_rps": rate, "seed": args.seed,
+        "long_len": long_len, "long_requests": n_long,
+        "prefill_budget": int(args.prefill_budget),
+        "ragged_chunk": ragged_chunk,
+        "baseline": baseline,
+        "unbudgeted": unbudgeted,
+        "budgeted": budgeted,
+        "outputs_identical_budgeted_vs_unbudgeted": identical,
+    }
+    if base_itl and unb_itl and bud_itl:
+        # how much of the long-prefill-induced short-ITL inflation the
+        # budget claws back (1.0 = all the way to baseline)
+        out["short_itl_p95"] = {
+            "baseline": base_itl, "unbudgeted": unb_itl,
+            "budgeted": bud_itl,
+            "budget_recovery": round(
+                (unb_itl - bud_itl) / (unb_itl - base_itl), 3
+            ) if unb_itl > base_itl else None,
+        }
+    emit(out)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None)
@@ -2249,6 +2438,20 @@ def main() -> None:
     ap.add_argument("--tenants", type=int, default=4,
                     help="workload tenant count (--workers fleet mode and "
                     "--kv-migrate)")
+    ap.add_argument("--long-context", action="store_true",
+                    help="mixed-traffic long-context frontier: short-"
+                    "request ITL/TTFT with and without background "
+                    "--long-len prompts, unbudgeted vs --prefill-budget "
+                    "(pushed live), through DirectServer + ragged rounds")
+    ap.add_argument("--long-len", type=int, default=32768,
+                    help="background long-prompt length in tokens "
+                    "(--long-context)")
+    ap.add_argument("--long-requests", type=int, default=2,
+                    help="number of background long prompts "
+                    "(--long-context)")
+    ap.add_argument("--prefill-budget", type=int, default=512,
+                    help="per-round prefill token budget for the budgeted "
+                    "leg (--long-context); 0 disables")
     ap.add_argument("--timeline", action="store_true",
                     help="flight-recorder attribution: stamp a trace_id "
                     "per request and publish per-phase p50/p95 "
@@ -2309,6 +2512,13 @@ def main() -> None:
             ap.error("--spec takes a single --arrival-rate (the sweep "
                      "axis is the forced acceptance rate)")
         run_spec_ab(args, backend, model)
+        return
+
+    if args.long_context:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--long-context takes a single --arrival-rate (the "
+                     "comparison axis is budgeted vs unbudgeted)")
+        run_long_context(args, backend, model)
         return
 
     from distributed_gpu_inference_tpu.worker.direct_server import (

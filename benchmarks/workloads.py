@@ -39,6 +39,16 @@ Scenarios:
                mix the overload-control ladder (server/admission.py)
                sheds and degrades against. Every request carries its
                tenant id and tier in the trace.
+- ``longctx``  long-context traffic (round 17): book-length RAG contexts
+               (a shared corpus of ~``long_len``-char documents, one per
+               request plus a unique query — the 32k shape) interleaved
+               with long AGENT TRACES (one conversation whose prompt is
+               the full accumulated tool-call transcript, dependency-
+               chained like chat turns). A background trickle of SHORT
+               chat requests rides the same trace so one run measures
+               both the giant prefills and the short-request tails they
+               threaten — the mixed-traffic frontier the prefill budget
+               exists for.
 
 Any scenario can additionally be generated ``tiered=True``: tenants gain
 paid/free/batch tiers (index-derived — NO extra rng draws, so arrival
@@ -237,13 +247,84 @@ def _storm(rng: np.random.Generator, *, requests: int, tenants: int,
     return out
 
 
+def _longctx(rng: np.random.Generator, *, requests: int, tenants: int,
+             rate: float, long_len: int, query_len: int, turn_len: int,
+             max_tokens: int, corpus_docs: int, agent_turns: int,
+             short_fraction: float,
+             priority_for: Optional[Dict[str, int]] = None
+             ) -> List[WorkloadRequest]:
+    """Long-context mix: ~1/3 book-length RAG one-shots, ~1/3 one long
+    agent trace (dependency-chained turns whose prompt accumulates the
+    whole transcript toward ``long_len``), and ``short_fraction`` short
+    chat requests woven between them. Length jitter is mild (±12%) so a
+    trace generated for a 32k deployment actually exercises ~32k paths
+    instead of averaging down to 16k."""
+    corpus = [
+        _text(rng, max(256, int(long_len * float(rng.uniform(0.88, 1.12)))))
+        for _ in range(corpus_docs)
+    ]
+    n_short = int(requests * short_fraction)
+    n_agent = min(agent_turns, max(0, (requests - n_short) // 3))
+    n_rag = max(0, requests - n_short - n_agent)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, requests))
+    out: List[WorkloadRequest] = []
+    # book-length RAG one-shots: hot docs dominate (zipf), so prefix
+    # caching and affinity routing have something to win at 32k depth
+    for i in range(n_rag):
+        tenant = f"t{int(rng.integers(0, tenants))}"
+        doc = corpus[min(corpus_docs - 1, int(rng.zipf(1.5)) - 1)]
+        out.append(WorkloadRequest(
+            id=f"L{i}", arrival_s=round(float(arrivals[i]), 4),
+            tenant=tenant, prompt=doc + _text(rng, query_len),
+            max_tokens=max_tokens,
+            priority=(priority_for or {}).get(tenant, 0),
+        ))
+    # one long agent trace: each turn's prompt is the full transcript so
+    # far — the grown prefix marches toward long_len and each turn
+    # depends on its predecessor (a tool call cannot fire before the
+    # previous observation exists)
+    if n_agent:
+        tenant = f"t{int(rng.integers(0, tenants))}"
+        step = max(turn_len, long_len // max(1, n_agent))
+        history = _text(rng, step)
+        prev_id: Optional[str] = None
+        for k in range(n_agent):
+            i = n_rag + k
+            rid = f"A0.{k}"
+            out.append(WorkloadRequest(
+                id=rid, arrival_s=round(float(arrivals[i]), 4),
+                tenant=tenant, prompt=history, max_tokens=max_tokens,
+                priority=(priority_for or {}).get(tenant, 0),
+                conversation="A0", turn=k, depends_on=prev_id,
+                think_s=round(float(rng.uniform(0.05, 0.2)), 4)
+                if prev_id is not None else 0.0,
+            ))
+            history = history + "|" + _text(rng, step) + "|"
+            prev_id = rid
+    # the short-request tail riding alongside: the latency victims the
+    # prefill budget protects
+    for j in range(requests - len(out)):
+        i = len(out)
+        tenant = f"t{int(rng.integers(0, tenants))}"
+        out.append(WorkloadRequest(
+            id=f"s{j}", arrival_s=round(float(arrivals[i]), 4),
+            tenant=tenant, prompt=_text(rng, turn_len),
+            max_tokens=max_tokens,
+            priority=(priority_for or {}).get(tenant, 0),
+        ))
+    out.sort(key=lambda r: (r.arrival_s, r.id))
+    return out
+
+
 def generate(scenario: str, seed: int = 0, *, requests: int = 32,
              tenants: int = 4, turns: int = 4, rate: float = 2.0,
              system_len: int = 256, turn_len: int = 64,
              doc_len: int = 512, query_len: int = 64,
              corpus_docs: int = 6, max_tokens: int = 32,
              think_s: float = 0.2, tiered: bool = False,
-             burst: int = 8) -> Workload:
+             burst: int = 8, long_len: int = 32768,
+             agent_turns: int = 6,
+             short_fraction: float = 0.5) -> Workload:
     """Build one seed-stable trace. All randomness flows from ONE
     ``np.random.default_rng(seed)`` consumed in a fixed order — adding a
     scenario must never reorder draws inside an existing one.
@@ -300,10 +381,20 @@ def generate(scenario: str, seed: int = 0, *, requests: int = 32,
                     query_len=query_len, max_tokens=max_tokens,
                     priority_for=prio_map)
         kw["priority_tiers"] = prio_map
+    elif scenario == "longctx":
+        reqs = _longctx(rng, requests=requests, tenants=tenants, rate=rate,
+                        long_len=long_len, query_len=query_len,
+                        turn_len=turn_len, max_tokens=max_tokens,
+                        corpus_docs=max(2, min(corpus_docs, 4)),
+                        agent_turns=agent_turns,
+                        short_fraction=short_fraction,
+                        priority_for=prio_map if tiered else None)
+        kw["long_len"] = long_len
+        kw["short_fraction"] = short_fraction
     else:
         raise ValueError(
             f"unknown scenario {scenario!r} "
-            "(chat | rag | bursty | storm | priority)"
+            "(chat | rag | bursty | storm | priority | longctx)"
         )
     if tiered:
         for r in reqs:
@@ -319,7 +410,8 @@ def generate(scenario: str, seed: int = 0, *, requests: int = 32,
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario", default="chat",
-                    choices=["chat", "rag", "bursty", "storm", "priority"])
+                    choices=["chat", "rag", "bursty", "storm", "priority",
+                             "longctx"])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--requests", type=int, default=32)
     ap.add_argument("--tenants", type=int, default=4)
@@ -332,6 +424,14 @@ def main() -> None:
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--burst", type=int, default=8,
                     help="requests per tenant storm (storm scenario)")
+    ap.add_argument("--long-len", type=int, default=32768,
+                    help="target long-prompt chars (longctx scenario; "
+                    "ByteTokenizer: 1 char = 1 token)")
+    ap.add_argument("--agent-turns", type=int, default=6,
+                    help="turns in the longctx agent trace")
+    ap.add_argument("--short-fraction", type=float, default=0.5,
+                    help="fraction of longctx requests that are short "
+                    "chat traffic (the tail-latency victims)")
     ap.add_argument("--tiered", action="store_true",
                     help="stamp paid/free/batch tenant tiers (+matching "
                     "priorities) onto the trace; arrivals/prompts stay "
@@ -343,7 +443,9 @@ def main() -> None:
                   tenants=args.tenants, turns=args.turns, rate=args.rate,
                   system_len=args.system_len, turn_len=args.turn_len,
                   doc_len=args.doc_len, max_tokens=args.max_tokens,
-                  tiered=args.tiered, burst=args.burst)
+                  tiered=args.tiered, burst=args.burst,
+                  long_len=args.long_len, agent_turns=args.agent_turns,
+                  short_fraction=args.short_fraction)
     if args.summary:
         print(json.dumps({"scenario": wl.scenario, "seed": wl.seed,
                           "duration_s": round(wl.duration_s, 3),

@@ -717,8 +717,13 @@ def _ragged_q_tile(s: int, qpk: int) -> int:
 
 def _ragged_kernel(
     # scalar prefetch (SMEM; bidx/init persist across the sequential grid)
-    bt_ref,        # [R, M] int32 per-row block tables
-    lens_ref,      # [R] int32 effective kv length per row
+    bt_ref,        # [B, M] int32 per-SEQUENCE block tables (q-tile rows of
+                   # one sequence share its table: row // q_tiles indexes it
+                   # — repeating the table per tile would multiply the SMEM
+                   # footprint by the tile count, which at long-context
+                   # table widths (32k = 2048 pages) is the difference
+                   # between fitting and not)
+    lens_ref,      # [B] int32 effective kv length per sequence
     qmax_ref,      # [R] int32 max valid query position (-1 = inactive row)
     qmin_ref,      # [R] int32 min valid query position (0 when inactive)
     bidx_ref,      # [1] int32 current double-buffer slot
@@ -731,6 +736,7 @@ def _ragged_kernel(
     *rest,         # [ks_hbm, vs_hbm,] out_ref, kbuf, vbuf, [ksbuf, vsbuf,]
                    # sems, [ssems,] m_scr, l_scr, acc_scr
     rows: int,
+    q_tiles: int,
     q_tile: int,
     block_size: int,
     pages_per_group: int,
@@ -760,7 +766,7 @@ def _ragged_kernel(
         # a padded/inactive q-tile (qmax < 0) has zero live groups and its
         # grid cells skip in a few cycles — dead tiles of a short row in a
         # wide ragged batch cost nothing but the grid step
-        needed = jnp.minimum(qmax_ref[s_] + 1, lens_ref[s_])
+        needed = jnp.minimum(qmax_ref[s_] + 1, lens_ref[s_ // q_tiles])
         return jnp.minimum(pl.cdiv(needed, gsz), max_groups)
 
     def start_group(s_):
@@ -776,7 +782,7 @@ def _ragged_kernel(
     def start_dma(s_, j, slot):
         for p in range(gp):  # static unroll: G paired page DMAs
             idx = jnp.minimum(j * gp + p, max_pages - 1)
-            page = bt_ref[jnp.clip(s_, 0, rows - 1), idx]
+            page = bt_ref[jnp.clip(s_, 0, rows - 1) // q_tiles, idx]
             pltpu.make_async_copy(
                 k_hbm.at[page], kbuf.at[slot, p], sems.at[0, slot, p]
             ).start()
@@ -794,7 +800,7 @@ def _ragged_kernel(
     def wait_dma(s_, j, slot):
         for p in range(gp):
             idx = jnp.minimum(j * gp + p, max_pages - 1)
-            page = bt_ref[jnp.clip(s_, 0, rows - 1), idx]
+            page = bt_ref[jnp.clip(s_, 0, rows - 1) // q_tiles, idx]
             pltpu.make_async_copy(
                 k_hbm.at[page], kbuf.at[slot, p], sems.at[0, slot, p]
             ).wait()
@@ -857,7 +863,7 @@ def _ragged_kernel(
             l_scr[...] = jnp.zeros((hkv, qpk * q_tile), jnp.float32)
             acc_scr[...] = jnp.zeros((hkv, qpk * q_tile, d), jnp.float32)
 
-        kv_len = lens_ref[r]
+        kv_len = lens_ref[r // q_tiles]
         # the dot runs in the pool dtype (bf16 in, f32 accumulation) — the
         # same MXU contract as the decode kernel; int8 pages dequantize in
         # page layout during the upcast
@@ -980,8 +986,6 @@ def ragged_paged_attention(
     q_r = q.reshape(b, qt, t, hkv, qpk, d).transpose(0, 1, 3, 4, 2, 5) \
         .reshape(rows, hkv, qpk * t, d)
     pos_r = positions.reshape(rows, t).astype(jnp.int32)
-    tables_r = jnp.repeat(block_tables.astype(jnp.int32), qt, axis=0)
-    lens_r = jnp.repeat(kv_lens.astype(jnp.int32), qt, axis=0)
     qmax_r = jnp.max(pos_r, axis=1)
     qmin_r = jnp.min(jnp.where(pos_r >= 0, pos_r, jnp.int32(2**30)), axis=1)
     qmin_r = jnp.where(qmax_r >= 0, qmin_r, 0)
@@ -1042,6 +1046,7 @@ def ragged_paged_attention(
     kernel = functools.partial(
         _ragged_kernel,
         rows=rows,
+        q_tiles=qt,
         q_tile=t,
         block_size=block_size,
         pages_per_group=gp,
@@ -1050,8 +1055,15 @@ def ragged_paged_attention(
         scale=d**-0.5,
         quantized=quantized,
     )
+    # block tables and kv lens stay per-SEQUENCE ([B, M] / [B]): q-tile
+    # rows index them via row // q_tiles inside the kernel. Repeating them
+    # per tile (the old layout) multiplied the SMEM scalar-prefetch
+    # footprint by the tile count — at 32k contexts (M = 2048 pages,
+    # 2048-wide chunks → 32+ tiles) that is megabytes of SMEM tables for
+    # kilobytes of real data
     operands = [
-        tables_r, lens_r, qmax_r, qmin_r,
+        block_tables.astype(jnp.int32), kv_lens.astype(jnp.int32),
+        qmax_r, qmin_r,
         jnp.zeros((1,), jnp.int32),   # buffer_index
         jnp.ones((1,), jnp.int32),    # init_flag
         q_r, pos_r, k_pool, v_pool,

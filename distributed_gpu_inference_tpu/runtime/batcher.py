@@ -134,6 +134,17 @@ class BatcherConfig:
     # kept for A/B benchmarking (worker_serving --compare-legacy), not
     # production.
     ragged: Optional[bool] = None
+    # per-ROUND prefill token budget for ragged rounds (PR 17, long-context
+    # serving): the total prefill-chunk tokens all in-flight admissions may
+    # land in one ragged round, split fairly across them (water-fill, with
+    # a rotating start so sub-token shares starve nobody). Bounds the
+    # matmul work a giant admission adds to each co-dispatched decode
+    # round, so decode ITL for short requests stays flat while a 32k
+    # prompt streams in over many rounds. 0 = unbudgeted (pre-PR-17
+    # behavior: every admission gets a full ``ragged_chunk`` slice per
+    # round — byte-identical outputs either way; the budget only shapes
+    # WHEN prefill work lands). Live-pushable (serving.prefill_budget).
+    prefill_budget: int = 0
 
     @property
     def horizon_levels(self) -> Tuple[int, ...]:
@@ -144,6 +155,43 @@ class BatcherConfig:
         levels = [t for t in (1, 4, 16, 64)
                   if self.min_multi_step <= t <= self.max_multi_step]
         return tuple(levels) or (self.min_multi_step,)
+
+
+def split_prefill_budget(needs: List[int], budget: int,
+                         start: int = 0) -> List[int]:
+    """Fair water-fill of a per-round prefill token ``budget`` across
+    concurrent admissions. ``needs[i]`` is admission i's remaining demand
+    this round (min of its unprefilled tokens and the chunk cap); returns
+    per-admission grants summing to <= budget.
+
+    Water-fill: every still-hungry admission repeatedly receives an equal
+    share of what is left, so small admissions finish inside their share
+    and release the remainder to large ones — a 32k prompt co-admitted
+    with a 40-token prompt cannot crowd it out, and N giant prompts split
+    the budget evenly instead of first-come-takes-all. When the budget is
+    smaller than the admission count the integer share floors to zero;
+    the minimum 1-token share plus the rotating ``start`` offset hands
+    the scarce tokens to a DIFFERENT admission subset each round
+    (starvation-free round-robin). Deterministic: same inputs, same
+    grants."""
+    n = len(needs)
+    grants = [0] * n
+    if n == 0 or budget <= 0:
+        return grants
+    remaining = budget
+    order = [(start + k) % n for k in range(n)]
+    while remaining > 0:
+        hungry = [i for i in order if grants[i] < needs[i]]
+        if not hungry:
+            break
+        share = max(1, remaining // len(hungry))
+        for i in hungry:
+            if remaining <= 0:
+                break
+            give = min(share, needs[i] - grants[i], remaining)
+            grants[i] += give
+            remaining -= give
+    return grants
 
 
 @dataclass(order=True)
@@ -238,12 +286,18 @@ class ContinuousBatcher:
         # may be in flight at once; an admission leaves this list for
         # _slot_items when its final chunk samples the first token.
         self._ragged: List[Tuple[ChunkedAdmission, _QueueItem]] = []
+        # rotating start offset for the per-round prefill-budget split:
+        # when the budget floors below one token per admission, a
+        # different admission subset receives the scarce tokens each
+        # round (split_prefill_budget's starvation-freedom)
+        self._prefill_rr = 0
         self.stats: Dict[str, Any] = {
             "submitted": 0, "completed": 0, "rejected": 0, "timeouts": 0,
             "decode_rounds": 0, "admitted": 0, "queue_peak": 0,
             "step_latency_ema_ms": 0.0, "occupancy_sum": 0, "horizon": self._horizon,
             "chunked_admissions": 0, "batched_waves": 0,
             "ragged_admissions": 0, "ragged_rounds": 0,
+            "budgeted_rounds": 0, "budget_skipped_admissions": 0,
             "spec_waves": 0, "spec_completed": 0, "spec_errors": 0,
             "preemptions": 0, "resumes": 0, "preemption_block_pressure": 0,
             "preempted_too_often": 0,
@@ -657,7 +711,21 @@ class ContinuousBatcher:
         Horizon-shaping fields (``max_multi_step``, ``min_multi_step``,
         ``multi_step``, ``adaptive``) rebuild the quantized level set; the
         current level snaps to the nearest surviving horizon so retuning
-        never requests an uncompiled scan length mid-flight."""
+        never requests an uncompiled scan length mid-flight.
+
+        ``ragged_chunk`` is the one ENGINE knob accepted here (PR 17):
+        the per-admission chunk-row width of ragged rounds. It is read
+        per round, never compile-baked — chunk widths bucket through
+        ``prefill_buckets``, so retuning it live only selects among
+        already-compiled graph widths. Together with ``prefill_budget``
+        it makes the long-context prefill geometry live-pushable."""
+        ragged_chunk = updates.pop("ragged_chunk", None)
+        if ragged_chunk is not None:
+            rc = int(ragged_chunk)
+            if rc < 1:
+                raise ValueError(
+                    f"ragged_chunk must be >= 1, got {ragged_chunk}"
+                )
         coerced: Dict[str, Any] = {}
         for key, val in updates.items():
             if val is None or not hasattr(self.cfg, key):
@@ -679,6 +747,9 @@ class ContinuousBatcher:
         # so one bad value can't leave a half-applied retune
         for key, val in coerced.items():
             setattr(self.cfg, key, val)
+        if ragged_chunk is not None and \
+                hasattr(self.engine.cfg, "ragged_chunk"):
+            self.engine.cfg.ragged_chunk = rc
         self._rebuild_levels(self._horizon)
 
     # ------------------------------------------------------------- internals
@@ -866,6 +937,10 @@ class ContinuousBatcher:
                             InferenceResponse(
                                 request_id=item.request.request_id,
                                 error=str(e),
+                                # typed admission failures (e.g. the
+                                # engine's over_length rejection) stay
+                                # machine-readable through the batcher
+                                error_code=getattr(e, "error_code", None),
                             )
                         )
                     continue
@@ -897,6 +972,7 @@ class ContinuousBatcher:
                             InferenceResponse(
                                 request_id=item.request.request_id,
                                 error=str(e),
+                                error_code=getattr(e, "error_code", None),
                             )
                         )
                     continue
@@ -952,6 +1028,8 @@ class ContinuousBatcher:
                                 InferenceResponse(
                                     request_id=item.request.request_id,
                                     error=str(e),
+                                    error_code=getattr(e, "error_code",
+                                                       None),
                                 )
                             )
                         continue
@@ -1015,7 +1093,9 @@ class ContinuousBatcher:
             if not item.future.done():
                 item.future.set_result(
                     InferenceResponse(
-                        request_id=item.request.request_id, error=str(e)
+                        request_id=item.request.request_id,
+                        error=str(e),
+                        error_code=getattr(e, "error_code", None),
                     )
                 )
             return
@@ -1279,6 +1359,39 @@ class ContinuousBatcher:
             except Exception:  # noqa: BLE001 — an observer must never wedge serving
                 pass
 
+    def _prefill_chunk_caps(
+        self, adms: List[ChunkedAdmission],
+    ) -> Optional[Dict[int, int]]:
+        """Per-round prefill-budget split (PR 17): the per-admission token
+        caps the next ragged round may land, keyed by slot. None when the
+        budget is off (``prefill_budget <= 0``) — the engine then runs its
+        pre-budget behavior verbatim (every admission gets a full
+        ``ragged_chunk`` slice), so budget-OFF is byte-identical to the
+        pre-PR scheduler by construction. Runs on the engine thread just
+        before the round (``_engine_round``), so the caps always reflect
+        the admissions actually dispatched."""
+        budget = int(self.cfg.prefill_budget)
+        if budget <= 0 or not adms:
+            return None
+        eng_cfg = self.engine.cfg
+        chunk_cap = min(
+            max(int(getattr(eng_cfg, "ragged_chunk", budget)), 1),
+            eng_cfg.prefill_buckets[-1],
+        )
+        # a fully-cached admission (empty ``fresh``) still needs ONE
+        # budget token to ride a round and sample its first token — a
+        # zero need would grant a zero cap and skip it forever
+        needs = [max(1, min(len(adm.fresh), chunk_cap)) for adm in adms]
+        grants = split_prefill_budget(needs, budget,
+                                      start=self._prefill_rr)
+        self._prefill_rr += 1
+        if sum(grants) < sum(needs):
+            self.stats["budgeted_rounds"] += 1
+            self.stats["budget_skipped_admissions"] += sum(
+                1 for g in grants if g <= 0
+            )
+        return {adm.slot: g for adm, g in zip(adms, grants)}
+
     def _engine_round(self) -> float:
         """One blocking engine round on the worker thread. Returns latency ms.
 
@@ -1292,7 +1405,8 @@ class ContinuousBatcher:
         better dispatch for the identical math and runs instead."""
         t0 = time.perf_counter()
         if self._ragged:
-            self.engine.ragged_round([adm for adm, _ in self._ragged])
+            adms = [adm for adm, _ in self._ragged]
+            self.engine.ragged_round(adms, self._prefill_chunk_caps(adms))
             self.stats["ragged_rounds"] += 1
             return (time.perf_counter() - t0) * 1000.0
         steps = self._levels[self._level]

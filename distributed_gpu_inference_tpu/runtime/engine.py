@@ -220,6 +220,18 @@ class ChunkedAdmission:
     done: bool = False
 
 
+class RequestOverLength(ValueError):
+    """Prompt + max_new_tokens exceeds the engine's ``max_seq_len`` — a
+    per-request input error, not a capacity condition: no amount of
+    waiting, preemption, or retry makes it fit THIS engine geometry.
+    Carries the machine-readable ``error_code`` the serving layers thread
+    through job results and SSE (like ``shed_overload`` /
+    ``request_timeout``), so a client can route the request to a
+    longer-context deployment instead of string-matching the message."""
+
+    error_code = "over_length"
+
+
 @dataclass
 class KVPressure:
     """KV-block exhaustion observed at a step boundary — a SCHEDULING event,
@@ -1662,7 +1674,7 @@ class TPUEngine:
         if not token_ids:
             raise ValueError("request has no prompt_token_ids")
         if len(token_ids) + request.sampling.max_new_tokens > self.cfg.max_seq_len:
-            raise ValueError(
+            raise RequestOverLength(
                 f"prompt {len(token_ids)} + max_new {request.sampling.max_new_tokens}"
                 f" exceeds max_seq_len {self.cfg.max_seq_len}"
             )
@@ -2186,6 +2198,8 @@ class TPUEngine:
             tok = int(np.asarray(first)[0])
             self._record_token(adm.slot, tok)
             adm.done = True
+        else:
+            self._release_prefill_window(adm)
         return adm.done
 
     def abort_chunked(self, adm: ChunkedAdmission) -> None:
@@ -2215,27 +2229,44 @@ class TPUEngine:
 
     def ragged_round(
         self, admissions: Sequence[ChunkedAdmission] = (),
+        chunk_caps: Optional[Dict[int, int]] = None,
     ) -> Dict[int, List[int]]:
+        """``chunk_caps``: optional per-admission prefill-token caps for
+        THIS round, keyed by slot (the scheduler's per-round prefill
+        budget — PR 17). A missing slot gets the full ``ragged_chunk``
+        cap; a cap <= 0 skips the admission this round entirely (no row,
+        no reservation — it retries next round). Chunked prefill is
+        chunk-width-invariant, so any cap schedule yields byte-identical
+        outputs; caps only shape WHEN prefill work lands."""
         if self.cfg.speculative is not None:
-            return self._spec_ragged_round(admissions)
-        return self._plain_ragged_round(admissions)
+            return self._spec_ragged_round(admissions, chunk_caps)
+        return self._plain_ragged_round(admissions, chunk_caps)
 
     def _ragged_admission_rows(
         self, admissions: Sequence[ChunkedAdmission], chunk_cap: int,
+        chunk_caps: Optional[Dict[int, int]] = None,
     ) -> Tuple[List[Tuple[ChunkedAdmission, List[int], bool]], int]:
         """Slice each in-flight admission's next chunk row for a ragged
         round, pre-reserving the sampled first token's block for FINAL
         chunks (``submit_chunked_step``'s step-boundary rule); a
         pressured final chunk skips this round and retries. Shared by
         the plain and spec ragged rounds so the retry contract cannot
-        drift. Returns (ready rows, max chunk width)."""
+        drift. ``chunk_caps`` tightens (never widens) the per-admission
+        slice — the scheduler's per-round prefill budget; a cap <= 0
+        drops the admission from this round. Returns (ready rows, max
+        chunk width)."""
         ready: List[Tuple[ChunkedAdmission, List[int], bool]] = []
         width = 1
         for adm in admissions:
             s = self.slots[adm.slot]
             assert s is not None
-            piece = adm.fresh[:chunk_cap]
-            is_last = len(adm.fresh) <= chunk_cap
+            cap = chunk_cap
+            if chunk_caps is not None:
+                cap = min(cap, int(chunk_caps.get(adm.slot, cap)))
+                if cap <= 0:
+                    continue
+            piece = adm.fresh[:cap]
+            is_last = len(adm.fresh) <= cap
             if is_last:
                 try:
                     if self.manager.reserve_tokens(s.seq_id, 1):
@@ -2289,9 +2320,12 @@ class TPUEngine:
                 out[adm.slot] = [tok]
                 self._record_token(adm.slot, tok, device_synced=True)
                 adm.done = True
+            else:
+                self._release_prefill_window(adm)
 
     def _plain_ragged_round(
         self, admissions: Sequence[ChunkedAdmission] = (),
+        chunk_caps: Optional[Dict[int, int]] = None,
     ) -> Dict[int, List[int]]:
         """ONE device dispatch serving a ragged row batch: every active
         decode slot advances one token AND every in-flight admission
@@ -2347,7 +2381,8 @@ class TPUEngine:
 
         # --- admission chunk rows: shared slicing + final-chunk
         # pending-block pre-reservation (``_ragged_admission_rows``)
-        ready, width = self._ragged_admission_rows(admissions, chunk_cap)
+        ready, width = self._ragged_admission_rows(admissions, chunk_cap,
+                                                   chunk_caps)
         if not kept and not ready:
             return {}
 
@@ -2400,6 +2435,7 @@ class TPUEngine:
 
     def _spec_ragged_round(
         self, admissions: Sequence[ChunkedAdmission] = (),
+        chunk_caps: Optional[Dict[int, int]] = None,
     ) -> Dict[int, List[int]]:
         """Spec-integrated ragged round: ONE dispatch serving VERIFY rows
         (per active decode slot: the draft chain + pending token,
@@ -2479,11 +2515,12 @@ class TPUEngine:
             # reservation can fit where K+2 did not — graceful
             # degradation, still target-greedy so outputs are unchanged;
             # only the stale draft hidden costs next-round acceptance).
-            return self._plain_ragged_round(admissions)
+            return self._plain_ragged_round(admissions, chunk_caps)
 
         # --- admission chunk rows: identical contract to the plain path
         # (shared helper — the retry/reservation rules cannot drift)
-        ready, width = self._ragged_admission_rows(admissions, chunk_cap)
+        ready, width = self._ragged_admission_rows(admissions, chunk_cap,
+                                                   chunk_caps)
 
         self._apply_pending()
         # row width: a dedicated K+1 shape serves pure-verify rounds (the
@@ -2613,6 +2650,32 @@ class TPUEngine:
                 )
             self._apply_pending()
             self._maybe_release_window(slot)
+
+    def _release_prefill_window(self, adm: ChunkedAdmission) -> None:
+        """Sliding-window models, MID-prefill: hand back blocks that every
+        REMAINING chunk query is already past, between chunks. Without
+        this a 32k prompt on a windowed model holds its entire prompt KV
+        until the first decode step (``_maybe_release_window`` only runs
+        on token commits) — worst-case pool pressure exactly when a long
+        admission is streaming in. The earliest remaining query sits at
+        position ``adm.off``, not ``cur - 1`` (``seq_tokens`` already
+        holds the WHOLE prompt during prefill), so the window passed to
+        the manager widens by the not-yet-queried tail: only keys
+        <= adm.off - window release. The attention window mask already
+        excludes those positions for every remaining chunk row, so
+        pad-block reads are never visible — byte-identical outputs."""
+        w = self.model_cfg.sliding_window
+        if w is None:
+            return
+        s = self.slots[adm.slot]
+        if s is None:
+            return
+        cur = len(self.manager.seq_tokens[s.seq_id])
+        released = self.manager.release_out_of_window(
+            s.seq_id, w + max(cur - adm.off, 0)
+        )
+        for lb in released:
+            self._block_tables[adm.slot, lb] = 0
 
     def _maybe_release_window(self, slot: int) -> None:
         """Sliding-window models: hand blocks every future query is past back

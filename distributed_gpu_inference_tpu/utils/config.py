@@ -151,6 +151,16 @@ class ServingConfig(BaseModel):
     # THE default serving path), False = force the legacy wave/chunk-
     # interleaved admission (A/B benchmarking), True = require ragged
     ragged: Optional[bool] = None
+    # per-ROUND prefill token budget for ragged rounds: caps how many fresh
+    # prompt tokens all concurrent admissions may prefill in one round
+    # combined (fair water-fill split), so a 32k admission streams in over
+    # many rounds instead of monopolizing every round's chunk bucket.
+    # 0 = unbudgeted (pre-budget behavior). Remote-pushable.
+    prefill_budget: int = 0
+    # per-admission prefill chunk width override (engine ragged_chunk).
+    # Read per-round and bucketed through compiled prefill widths, so it is
+    # safe to retune live. None = keep the engine default. Remote-pushable.
+    ragged_chunk: Optional[int] = None
 
     @model_validator(mode="after")
     def _warn_deprecated(self) -> "ServingConfig":

@@ -26,16 +26,46 @@ request to a warmer worker.
 
 from __future__ import annotations
 
+import os
+
 from typing import Any, Dict, List, Optional, Sequence
 
 # one fingerprint boundary every this many canonical-text chars; both ends
 # of a comparison MUST use the same value (workers advertise theirs and
 # the registry rejects mismatches rather than mis-matching silently)
 PREFIX_BLOCK_CHARS = 64
+
+
+def _max_blocks_default() -> int:
+    """Deployment-wide fingerprint depth cap, overridable via the
+    ``TPU_PREFIX_MAX_BLOCKS`` env var (read once at import).
+
+    The tradeoff is routing RESOLUTION vs summary cost: at the default 32
+    blocks x 64 chars, affinity routing sees at most ~2k canonical chars —
+    two 32k prompts sharing a 30k prefix look IDENTICAL to the router past
+    depth 2k, so long-context fleets that want the router to distinguish
+    deep RAG contexts should raise it (512 blocks ≈ 32k chars). The cost
+    is linear everywhere: hashing per request, radix-summary wire size per
+    heartbeat, and the control plane's advertised-set memory. Because every
+    layer must agree on depth to compare fingerprints, set the SAME value
+    on workers, planes, and SDK clients — a deeper client is harmless (the
+    extra boundaries just never match) but a deeper worker advertises
+    boundaries no request computes.
+    """
+    raw = os.environ.get("TPU_PREFIX_MAX_BLOCKS")
+    if not raw:
+        return 32
+    try:
+        val = int(raw)
+    except ValueError:
+        return 32
+    return max(1, val)
+
+
 # boundaries computed per prompt — bounds hashing work AND summary bloat
-# for pathological prompts; 32 blocks = 2048 chars of routable prefix,
-# past which the affinity signal is saturated anyway
-MAX_PREFIX_BLOCKS = 32
+# for pathological prompts; 32 blocks = 2048 chars of routable prefix
+# (see ``_max_blocks_default`` for the long-context resolution tradeoff)
+MAX_PREFIX_BLOCKS = _max_blocks_default()
 
 _MOD = (1 << 61) - 1          # Mersenne prime 2^61-1
 _BASE = 1_000_003

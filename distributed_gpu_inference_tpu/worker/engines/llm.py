@@ -81,6 +81,13 @@ SERVING_REMOTE_KEYS: Dict[str, str] = {
     # ragged rounds (round 6): remote-flippable so a fleet can A/B the
     # ragged vs legacy admission path live (None = auto, the default)
     "ragged": "ragged",
+    # long-context round shaping: the per-round prefill token budget and
+    # the per-admission chunk width are both read per-round (widths bucket
+    # through compiled prefill_buckets), so they retune live without a
+    # recompile — push them to trade 32k prefill throughput against
+    # co-batched decode ITL
+    "prefill_budget": "prefill_budget",
+    "ragged_chunk": "ragged_chunk",
 }
 
 
@@ -440,7 +447,21 @@ class TPULLMEngine(LLMBaseEngine):
             # SLO admission shaping (compile-affecting: load-time only)
             admission_subwave=int(sv["subwave"]),
             admission_interleave_steps=int(sv["interleave"]),
+            # long-context pool sizing: the default rule (1.5x batch x
+            # max_blocks_per_seq) assumes every slot can run max_seq_len
+            # deep — at 32k that is mostly pad, so deployments size the
+            # pool for the actual working set instead
+            num_blocks=(int(self.config["num_blocks"])
+                        if self.config.get("num_blocks") else None),
         )
+        if self.config.get("prefill_buckets"):
+            eng_cfg.prefill_buckets = tuple(
+                sorted(int(w) for w in self.config["prefill_buckets"])
+            )
+        # long-context chunk width: per-round knob, so load-time config is
+        # just the initial value (remote pushes can retune it live)
+        if sv.get("ragged_chunk"):
+            eng_cfg.ragged_chunk = int(sv["ragged_chunk"])
         # engine-INTEGRATED speculative decoding (EngineConfig.speculative):
         # every decode round runs fused draft→verify→accept steps committing
         # 1..K+1 tokens per slot — unlike engine=jax-speculative below,
@@ -609,6 +630,7 @@ class TPULLMEngine(LLMBaseEngine):
             spec_max_active=int(sv["spec_max_active"]),
             ragged=(None if sv.get("ragged") is None
                     else bool(sv["ragged"])),
+            prefill_budget=int(sv.get("prefill_budget") or 0),
         )
 
     def apply_serving_config(self, updates: Optional[Dict[str, Any]]) -> None:

@@ -33,6 +33,8 @@ HEAVY = [
     #   (real engines + direct servers + stream_cut chaos replays)
     "tests/test_ragged_attention.py",    # interpret-mode ragged kernel +
     #   ragged-vs-split byte-identity serving runs (multiple engines)
+    "tests/test_long_context.py",        # longctx: a true 32k prompt
+    #   through the deployed batcher path + wire formats at 32k scale
     "tests/test_prefix_routing.py",      # two-engine e2e routing runs
     #   behind a live control plane (byte-identity ON/OFF)
     "tests/test_kv_migration.py",        # cluster-KV migration: engine-

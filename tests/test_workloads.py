@@ -14,7 +14,8 @@ from benchmarks.workloads import Workload, generate
 pytestmark = [pytest.mark.routing]
 
 
-@pytest.mark.parametrize("scenario", ["chat", "rag", "bursty", "priority"])
+@pytest.mark.parametrize("scenario", ["chat", "rag", "bursty", "priority",
+                                      "longctx"])
 def test_same_seed_same_trace(scenario):
     a = generate(scenario, seed=11, requests=48)
     b = generate(scenario, seed=11, requests=48)
@@ -90,6 +91,34 @@ def test_priority_has_named_tiers():
     for r in wl.requests:
         assert r.tier == names[r.tenant]
         assert r.priority == {"paid": 10, "free": 0, "batch": -10}[r.tier]
+
+
+def test_longctx_mixes_long_and_short_traffic():
+    """Round 17: the longctx trace must carry BOTH the ~long_len giant
+    prompts (book RAG + an agent trace marching toward long_len) and a
+    short-request tail, in one seed-stable schedule — the mixed-traffic
+    frontier workload."""
+    wl = generate("longctx", seed=4, requests=24, long_len=4000,
+                  turn_len=64, agent_turns=4)
+    assert wl.meta["long_len"] == 4000
+    lens = [len(r.prompt) for r in wl.requests]
+    # the long side actually reaches the target length (±12% jitter)
+    assert max(lens) >= 3500
+    # the short tail rides the same trace
+    assert min(lens) <= 128
+    shorts = [r for r in wl.requests if len(r.prompt) <= 128]
+    assert len(shorts) >= 24 // 4
+    # the agent trace chains like chat turns and its prompt accumulates
+    agent = [r for r in wl.requests if r.conversation == "A0"]
+    assert len(agent) >= 2
+    agent.sort(key=lambda r: r.turn)
+    for prev, cur in zip(agent, agent[1:]):
+        assert cur.depends_on == prev.id
+        assert cur.prompt.startswith(prev.prompt)
+        assert len(cur.prompt) > len(prev.prompt)
+    # arrivals are sorted (the driver replays the trace in order)
+    arr = [r.arrival_s for r in wl.requests]
+    assert arr == sorted(arr)
 
 
 def test_unknown_scenario_raises():
