@@ -1133,6 +1133,328 @@ def run_chaos_fleet(args: Any, backend: str, model: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --gray (round 18): what the gray-failure defenses buy. One replica of a
+# 3-worker LiveFleet DEGRADES (alive, heartbeating, 0.3s/request slow) for
+# the whole measured window while a mixed workload runs — half the requests
+# carry deadline_s, half don't. Leg OFF is the round-17 build (health
+# scoring disabled, no hedging); leg ON enables quarantine + hedge hints
+# and the driver races deadline-carrying requests exactly like the SDK
+# (fire primary, wait the plane's p95-derived delay, fire the hedge, first
+# winner cancels the loser). Published: deadline-carrying p99 ON vs OFF,
+# hedges fired/won, abandonment counts split by deadline-ness (the
+# deadline-LESS count must be zero — abandonment is armed in both legs),
+# and byte-identity of greedy outputs across legs.
+# ---------------------------------------------------------------------------
+
+
+async def _drive_gray(plane_url: str, prompts: List[str],
+                      arrivals: List[float], max_tokens: int,
+                      deadlines: List[Optional[float]], hedging: bool,
+                      ) -> Tuple[List[Dict[str, Any]], float]:
+    """Open-loop direct driver for the gray legs: per-request deadline_s
+    rides the params, and (hedging=True) deadline-carrying requests opt
+    into the plane's hedge hint and race two legs."""
+    import httpx
+
+    t0 = time.perf_counter()
+    tidy: List[Any] = []   # loser-drain tasks; awaited before client close
+    async with httpx.AsyncClient(timeout=600.0) as client:
+
+        async def post_leg(url: str, params: Dict[str, Any],
+                           key: str) -> Optional[Any]:
+            try:
+                return await client.post(url + "/inference", json={
+                    "type": "llm",
+                    "params": {**params, "hedge_key": key},
+                })
+            except httpx.TransportError:
+                return None
+
+        async def drain_loser(task: Any, url: str, key: str) -> None:
+            # cancel releases the loser at the next step boundary; then
+            # let its POST finish so nothing outlives the client
+            try:
+                await client.post(url + "/inference/cancel",
+                                  json={"hedge_key": key})
+            except httpx.TransportError:
+                pass
+            try:
+                await asyncio.wait_for(task, timeout=30.0)
+            except Exception:
+                pass
+
+        async def race(disc: Dict[str, Any], params: Dict[str, Any]
+                       ) -> Tuple[Optional[Any], bool, bool, str]:
+            """(response, hedge_fired, hedge_won, serving_worker)."""
+            hint = disc["hedge"]
+            kp, kh = uuid.uuid4().hex, uuid.uuid4().hex
+            p_task = asyncio.create_task(
+                post_leg(disc["direct_url"], params, kp))
+            delay_s = max(0.0, float(hint.get("delay_ms") or 0.0)) / 1e3
+            done, _ = await asyncio.wait({p_task}, timeout=delay_s)
+            if p_task in done:
+                return p_task.result(), False, False, disc["worker_id"]
+            h_task = asyncio.create_task(
+                post_leg(hint["direct_url"], params, kh))
+            meta = {p_task: (disc["direct_url"], kp, disc["worker_id"]),
+                    h_task: (hint["direct_url"], kh, hint["worker_id"])}
+            pending = set(meta)
+            while pending:
+                done, pending = await asyncio.wait(
+                    pending, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    r = t.result()
+                    if r is not None and r.status_code == 200:
+                        for o in pending:
+                            ourl, okey, _ = meta[o]
+                            tidy.append(asyncio.create_task(
+                                drain_loser(o, ourl, okey)))
+                        return r, True, t is h_task, meta[t][2]
+            # both legs failed: surface the primary's answer (may be None)
+            return p_task.result(), True, False, disc["worker_id"]
+
+        async def one(i: int, prompt: str, at: float) -> Dict[str, Any]:
+            now = time.perf_counter() - t0
+            if at > now:
+                await asyncio.sleep(at - now)
+            rec: Dict[str, Any] = {
+                "i": i, "arrival_s": at, "status": 0,
+                "deadline_s": deadlines[i],
+                "hedged": False, "hedge_won": False, "abandoned": False,
+            }
+            params: Dict[str, Any] = {"prompt": prompt,
+                                      "max_new_tokens": max_tokens}
+            if deadlines[i] is not None:
+                params["deadline_s"] = deadlines[i]
+            t_req = time.perf_counter()
+            exclude: List[str] = []
+            while time.perf_counter() - t_req < 180.0:
+                wid = None
+                try:
+                    query: Dict[str, str] = {}
+                    if exclude:
+                        query["exclude"] = ",".join(exclude)
+                    if hedging and deadlines[i] is not None:
+                        query["hedge"] = "1"
+                    d = await client.get(
+                        f"{plane_url}/api/v1/jobs/direct/nearest",
+                        params=query or None,
+                    )
+                    if d.status_code != 200:
+                        exclude = []
+                        await asyncio.sleep(0.15)
+                        continue
+                    disc = d.json()
+                    wid = disc["worker_id"]
+                    if disc.get("hedge", {}).get("direct_url"):
+                        r, fired, won, wid = await race(disc, params)
+                        rec["hedged"] = rec["hedged"] or fired
+                        rec["hedge_won"] = rec["hedge_won"] or won
+                    else:
+                        r = await client.post(
+                            disc["direct_url"] + "/inference", json={
+                                "type": "llm", "params": params,
+                            })
+                    if r is None:
+                        if wid and wid not in exclude:
+                            exclude.append(wid)
+                        await asyncio.sleep(0.05)
+                        continue
+                    if r.status_code == 200:
+                        res = r.json().get("result") or {}
+                        rec.update({
+                            "status": 200,
+                            "e2e_ms": (time.perf_counter() - t_req) * 1e3,
+                            "done_s": time.perf_counter() - t0,
+                            "ttft_ms": res.get("ttft_ms"),
+                            "worker_id": wid,
+                            "text": res.get("text"),
+                            "completion_tokens": (res.get("usage") or {})
+                            .get("completion_tokens") or 0,
+                        })
+                        return rec
+                    if r.status_code == 503:
+                        await asyncio.sleep(0.1)
+                        continue
+                    detail = ""
+                    try:
+                        detail = str((r.json() or {}).get("detail") or "")
+                    except ValueError:
+                        pass
+                    if "deadline exceeded" in detail:
+                        # typed abandonment: hopeless by projection —
+                        # retrying is exactly the waste the scan prevents
+                        rec.update({"status": r.status_code,
+                                    "abandoned": True, "error": detail})
+                        return rec
+                    if wid and wid not in exclude:
+                        exclude.append(wid)
+                except httpx.TransportError:
+                    if wid and wid not in exclude:
+                        exclude.append(wid)
+                    await asyncio.sleep(0.05)
+            rec["status"] = 599
+            return rec
+
+        results = list(await asyncio.gather(
+            *(one(i, p, a) for i, (p, a) in
+              enumerate(zip(prompts, arrivals)))
+        ))
+        if tidy:
+            await asyncio.gather(*tidy, return_exceptions=True)
+    return results, time.perf_counter() - t0
+
+
+def _gray_subset(results: List[Dict[str, Any]],
+                 with_deadline: bool) -> Dict[str, Any]:
+    sub = [r for r in results
+           if (r["deadline_s"] is not None) == with_deadline]
+    ok = [r for r in sub if r["status"] == 200]
+    return {
+        "requests": len(sub), "ok": len(ok),
+        "failed": len(sub) - len(ok),
+        "abandoned": sum(1 for r in sub if r.get("abandoned")),
+        "e2e_ms": percentiles([r["e2e_ms"] for r in ok]),
+        "ttft_ms": percentiles(
+            [r["ttft_ms"] for r in ok if r.get("ttft_ms") is not None]),
+    }
+
+
+def run_gray(args: Any, backend: str, model: str) -> None:
+    import httpx
+    import numpy as _np
+
+    from distributed_gpu_inference_tpu.testing.faults import (
+        FleetEvent,
+        FleetFaultPlan,
+        GRAY_CHAOS_KINDS,
+    )
+    from distributed_gpu_inference_tpu.testing.harness import LiveFleet
+
+    engine_config = {
+        "model": model,
+        "max_batch_size": args.concurrency,
+        "max_seq_len": args.prompt_len + args.max_tokens + 16,
+        "quantization": args.quantization,
+        "serving": {
+            "queue_limit": max(4096, args.requests * 2),
+            "default_timeout_s": 600.0,
+            # armed in BOTH legs: the deadline-LESS abandonment count
+            # must stay zero with the scan live, not with it off
+            "abandon_deadlines": True,
+            "deadline_grace_s": 0.5,
+        },
+    }
+    prompts = synth_prompt_strings(args.requests, args.prompt_len,
+                                   args.shared_prefix, seed=args.seed)
+    rate = float(args.arrival_rate) if args.arrival_rate else 4.0
+    gaps = _np.random.default_rng(args.seed).exponential(
+        1.0 / rate, len(prompts))
+    arrivals = [float(a) for a in _np.cumsum(gaps)]
+    span = arrivals[-1]
+    # every other request carries a generous deadline: eligible for
+    # hedging, not in actual abandonment danger — so greedy outputs stay
+    # comparable across legs
+    deadlines: List[Optional[float]] = [
+        float(args.gray_deadline_s) if i % 2 == 0 else None
+        for i in range(len(prompts))
+    ]
+
+    def scrape(url: str, name: str) -> List[str]:
+        body = httpx.get(f"{url}/metrics", timeout=10.0).text
+        return [ln for ln in body.splitlines()
+                if ln.startswith(name) and not ln.startswith("#")]
+
+    def leg(defenses_on: bool) -> Dict[str, Any]:
+        with LiveFleet(n=3, engine_config=engine_config) as fleet:
+            if defenses_on:
+                r = httpx.put(
+                    f"{fleet.url}/api/v1/admin/health", json={
+                        "enabled": True, "hedge": True,
+                        "window_s": 30.0, "min_samples": 4,
+                        "min_peers": 2, "suspect_ratio": 3.0,
+                        "clear_ratio": 1.5, "grace_s": 0.2,
+                        "probation_after_s": 300.0, "canary_budget": 2,
+                    }, timeout=10.0)
+                r.raise_for_status()
+            # warm every engine calm (JIT compile must not eat the
+            # degrade window) — also seeds the fast fleet baseline
+            asyncio.run(_drive_gray(
+                fleet.url, prompts, arrivals, args.max_tokens,
+                [None] * len(prompts), hedging=False))
+            plan = FleetFaultPlan(args.seed, n_workers=3,
+                                  duration_s=span + 4.0,
+                                  kinds=GRAY_CHAOS_KINDS)
+            plan.events = [FleetEvent(0.0, "degrade", 0,
+                                      duration_s=span + 3.0,
+                                      delay_s=float(args.gray_degrade_s))]
+            fleet.run_chaos(plan)
+            try:
+                results, elapsed = asyncio.run(_drive_gray(
+                    fleet.url, prompts, arrivals, args.max_tokens,
+                    deadlines, hedging=defenses_on))
+            finally:
+                fleet.wait_chaos()
+            degraded_wid = fleet.members[0].worker_id
+            ok = [r for r in results if r["status"] == 200]
+            entry = {
+                "defenses": "on" if defenses_on else "off",
+                "elapsed_s": round(elapsed, 3),
+                "degraded_worker": degraded_wid,
+                "requests_on_degraded": sum(
+                    1 for r in ok if r.get("worker_id") == degraded_wid),
+                "with_deadline": _gray_subset(results, True),
+                "deadline_less": _gray_subset(results, False),
+                "hedges": {
+                    "fired": sum(1 for r in results if r["hedged"]),
+                    "won": sum(1 for r in results if r["hedge_won"]),
+                },
+                "health_metrics": {
+                    "worker_health_state":
+                        scrape(fleet.url, "worker_health_state"),
+                    "health_transitions_total":
+                        scrape(fleet.url, "health_transitions_total"),
+                    "hedges_total": scrape(fleet.url, "hedges_total"),
+                    "jobs_abandoned_total":
+                        scrape(fleet.url, "jobs_abandoned_total"),
+                },
+            }
+            texts = {r["i"]: r.get("text") for r in ok}
+            return entry, texts
+
+    out: Dict[str, Any] = {
+        "benchmark": "worker_serving_gray",
+        "path": "control_plane+direct_nearest+live_fleet+degrade",
+        "model": model, "backend": backend, "seed": args.seed,
+        "requests": args.requests, "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len, "max_tokens": args.max_tokens,
+        "arrival_rate_rps": rate,
+        "deadline_s": float(args.gray_deadline_s),
+        "degrade_delay_s": float(args.gray_degrade_s),
+    }
+    off, off_texts = leg(False)
+    on, on_texts = leg(True)
+    p99_off = off["with_deadline"]["e2e_ms"]["p99"]
+    p99_on = on["with_deadline"]["e2e_ms"]["p99"]
+    out["gray"] = {
+        "off": off, "on": on,
+        "deadline_p99_ms_off": p99_off,
+        "deadline_p99_ms_on": p99_on,
+        "deadline_p99_improvement": round(p99_off / p99_on, 3)
+        if p99_off and p99_on else None,
+        "deadline_less_abandoned": (
+            off["deadline_less"]["abandoned"]
+            + on["deadline_less"]["abandoned"]
+        ),
+        "outputs_identical": (
+            len(off_texts) == len(on_texts) == len(prompts)
+            and off_texts == on_texts
+        ),
+    }
+    emit(out)
+
+
+# ---------------------------------------------------------------------------
 # --pd-split (round 11): the PD frontier. A LiveFleet split into a prefill
 # fleet and a decode fleet (role-tagged registrations, every member running
 # a real /kv/transfer data plane) serves pd-disaggregated jobs through the
@@ -2418,6 +2740,22 @@ def main() -> None:
                     "kill/restart mid-workload and publish SLO-in-window, "
                     "goodput, time-to-recover, and chaos-on/off "
                     "byte-identity")
+    ap.add_argument("--gray", action="store_true",
+                    help="gray-failure defense legs: one replica of a "
+                    "3-worker LiveFleet degrades (alive, 0.3s/request "
+                    "slow) under a mixed deadline/deadline-less workload "
+                    "with quarantine+hedging ON vs OFF; publishes "
+                    "deadline-carrying p99, hedges fired/won, abandonment "
+                    "counts by deadline-ness, and output byte-identity")
+    ap.add_argument("--gray-deadline-s", type=float, default=30.0,
+                    help="deadline_s the deadline-carrying half of the "
+                    "--gray workload requests carry")
+    ap.add_argument("--gray-degrade-s", type=float, default=1.0,
+                    help="per-request delay the degraded replica pays in "
+                    "the --gray legs (gray failures are typically 10x+, "
+                    "not marginal: below the fleet's queueing slack, "
+                    "quarantining a third of the capacity costs more "
+                    "than the slow replica does)")
     ap.add_argument("--replicas", default="1,2,4",
                     help="comma-separated replica counts for the --chaos "
                     "cluster frontier sweep")
@@ -2494,6 +2832,13 @@ def main() -> None:
             ap.error("--chaos takes a single --arrival-rate (the sweep "
                      "axis is the replica count)")
         run_chaos_fleet(args, backend, model)
+        return
+
+    if args.gray:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--gray takes a single --arrival-rate (the "
+                     "comparison axis is defenses ON vs OFF)")
+        run_gray(args, backend, model)
         return
 
     if args.kv_migrate:

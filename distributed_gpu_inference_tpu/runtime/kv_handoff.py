@@ -330,6 +330,14 @@ def serialize_handoff(h: KVHandoff, compress: bool = True) -> bytes:
             "sampling": h.request.sampling.to_dict(),
             "priority": h.request.priority,
             "session_id": h.request.session_id,
+            # deadline crosses the PD boundary as an ABSOLUTE time (the
+            # checkpoint-wire convention, runtime/engine.py): relative
+            # deadline_s would silently re-anchor to the receiver's
+            # arrival_time and hand a migrated job fresh slack. Omitted
+            # (not null) when unset, so deadline-less wires are
+            # byte-identical to the pre-deadline format.
+            **({"deadline_at": h.request.deadline_at}
+               if h.request.deadline_s is not None else {}),
         },
         "model_name": h.model_name,
         "block_size": h.block_size,
@@ -623,6 +631,9 @@ class StreamedExport:
                 "sampling": req.sampling.to_dict(),
                 "priority": req.priority,
                 "session_id": req.session_id,
+                # same absolute-deadline convention as serialize_handoff
+                **({"deadline_at": req.deadline_at}
+                   if req.deadline_s is not None else {}),
             },
         })
 
@@ -1198,6 +1209,15 @@ class HandoffReceiver:
             priority=r.get("priority", 0),
             session_id=r.get("session_id"),
         )
+        if r.get("deadline_at") is not None:
+            # re-derive the RELATIVE deadline against this engine's fresh
+            # arrival_time so deadline_at lands on the original absolute
+            # instant — elapsed handoff time stays spent, EDF order
+            # survives the migration (clamped: already-missed deadlines
+            # must not go negative)
+            request.deadline_s = max(
+                0.0, float(r["deadline_at"]) - request.arrival_time
+            )
         prompt = list(request.prompt_token_ids or [])
         if not prompt:
             raise ValueError("streamed handoff with empty prompt")
@@ -1435,6 +1455,13 @@ def deserialize_handoff(data: bytes) -> KVHandoff:
         priority=r.get("priority", 0),
         session_id=r.get("session_id"),
     )
+    if r.get("deadline_at") is not None:
+        # absolute → relative against the fresh arrival_time (same
+        # re-derivation as the streamed _begin path): EDF ordering
+        # survives the handoff, elapsed transfer time stays spent
+        request.deadline_s = max(
+            0.0, float(r["deadline_at"]) - request.arrival_time
+        )
     return KVHandoff(
         request=request,
         model_name=meta["model_name"],

@@ -17,8 +17,10 @@ Behavioral parity with the reference's ``sdk/python/inference_client.py``:
 
 from __future__ import annotations
 
+import concurrent.futures
 import random
 import time
+import uuid
 from typing import Any, Dict, List, Optional, Sequence
 
 import httpx
@@ -645,16 +647,24 @@ class InferenceClient:
         session: Optional[str] = None,
         trace_id: Optional[str] = None,
         raise_plane_errors: bool = False,
+        hedge: bool = False,
     ) -> Optional[Dict[str, Any]]:
         now = time.time()
-        if session and not exclude:
+        if session and not exclude and not hedge:
             cached = self._session_workers.get(session)
             if cached is not None and now - cached[1] < SESSION_CACHE_TTL_S:
                 return cached[0]
-        if not exclude and not prefix_fps and self._direct_cache is not None \
+        if not exclude and not prefix_fps and not hedge \
+                and self._direct_cache is not None \
                 and now - self._direct_cache_at < DIRECT_CACHE_TTL_S:
             return self._direct_cache
         query: Dict[str, str] = {}
+        if hedge:
+            # hedged dispatch: ask the plane for a second-ranked backup
+            # worker + the p95-derived hedge delay alongside the primary.
+            # Hedged discoveries bypass the caches above — the backup
+            # choice and delay are per-request-fresh by design.
+            query["hedge"] = "1"
         if exclude:
             # exclude: workers the caller just watched fail — a failover
             # reconnect must not land on the corpse
@@ -702,6 +712,10 @@ class InferenceClient:
             # session just because it was inserted first
             self._session_workers.pop(session, None)
             self._session_workers[session] = (worker, now)
+        if "hedge" in worker:
+            # a hedge hint is per-request-fresh (backup pick + delay are
+            # derived from live health state) — never cache it
+            return worker
         if not prefix_fps or "prefix_affinity" not in worker:
             # the generic cache stays affinity-free: a fingerprinted pick
             # for one conversation must not leak to unrelated requests.
@@ -722,12 +736,25 @@ class InferenceClient:
                     session: Optional[str] = None
                     ) -> Optional[Dict[str, Any]]:
         """POST straight to the nearest worker; any failure returns None so
-        the caller falls back to the queued path (reference :308-329)."""
+        the caller falls back to the queued path (reference :308-329).
+
+        Hedged dispatch (gray-failure round): DEADLINE-carrying requests
+        ask discovery for a backup worker + a p95-derived hedge delay. If
+        the primary has not answered within the delay, the same request
+        fires at the backup and the first finisher wins — the loser is
+        cancelled at its next step boundary via ``/inference/cancel``.
+        Deadline-less requests keep the single-POST path bit-for-bit."""
+        want_hedge = params.get("deadline_s") is not None
         worker = self._get_nearest_worker(prefix_fps=prefix_fps,
                                           session=session,
-                                          trace_id=params.get("trace_id"))
+                                          trace_id=params.get("trace_id"),
+                                          hedge=want_hedge)
         if worker is None:
             return None
+        hint = worker.get("hedge") if want_hedge else None
+        if isinstance(hint, dict) and hint.get("direct_url"):
+            return self._race_hedged(job_type, params, worker, hint,
+                                     session)
         try:
             resp = self._client.post(
                 f"{worker['direct_url'].rstrip('/')}/inference",
@@ -743,6 +770,96 @@ class InferenceClient:
             self._drop_session_worker(session)
             return None
         return resp.json()["result"]
+
+    def _post_direct_leg(self, direct_url: str, job_type: str,
+                         params: Dict[str, Any],
+                         hedge_key: str) -> Optional[Dict[str, Any]]:
+        """One leg of a hedged race: the request carries its cancel key so
+        the losing leg can be aborted server-side. Any failure (transport,
+        busy 503, flaky 5xx) returns None — the OTHER leg is the retry."""
+        try:
+            resp = self._client.post(
+                f"{direct_url.rstrip('/')}/inference",
+                json={"type": job_type,
+                      "params": {**params, "hedge_key": hedge_key}},
+                headers=self._headers(),
+            )
+        except httpx.TransportError:
+            return None
+        if resp.status_code != 200:
+            return None
+        try:
+            return resp.json()["result"]
+        except (ValueError, KeyError):
+            return None
+
+    def _cancel_hedge_leg(self, direct_url: str, hedge_key: str) -> None:
+        """Best-effort loser abort: idempotent server-side, and a miss
+        (request already finished) costs nothing but the wasted decode."""
+        try:
+            self._client.post(
+                f"{direct_url.rstrip('/')}/inference/cancel",
+                json={"hedge_key": hedge_key},
+                headers=self._headers(), timeout=5.0,
+            )
+        except Exception:  # noqa: BLE001 — the winner's result stands
+            pass
+
+    def _race_hedged(self, job_type: str, params: Dict[str, Any],
+                     primary: Dict[str, Any], hint: Dict[str, Any],
+                     session: Optional[str]) -> Optional[Dict[str, Any]]:
+        """Primary fires immediately; the backup fires after the plane's
+        hedge delay unless the primary already answered. First non-error
+        answer wins and cancels the other leg. Both-legs-failed falls back
+        to the queued path (None), same as the unhedged single POST."""
+        legs = {
+            "primary": (str(primary["direct_url"]), uuid.uuid4().hex),
+            "hedge": (str(hint["direct_url"]), uuid.uuid4().hex),
+        }
+        delay_s = max(0.0, float(hint.get("delay_ms") or 0.0)) / 1000.0
+        ex = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="hedge"
+        )
+        futures: Dict[Any, str] = {}
+        try:
+            url, key = legs["primary"]
+            pfut = ex.submit(self._post_direct_leg, url, job_type, params,
+                             key)
+            futures[pfut] = "primary"
+            done, _ = concurrent.futures.wait([pfut], timeout=delay_s)
+            if pfut in done:
+                futures.pop(pfut, None)
+                r = pfut.result()
+                if r is not None:
+                    return r   # primary beat the hedge delay: no hedge
+                # primary failed fast: the backup leg IS the retry
+            # primary slow (race it) or failed: fire the hedge leg
+            url, key = legs["hedge"]
+            hfut = ex.submit(self._post_direct_leg, url, job_type,
+                             params, key)
+            futures[hfut] = "hedge"
+            result: Optional[Dict[str, Any]] = None
+            while futures:
+                done, _ = concurrent.futures.wait(
+                    list(futures),
+                    return_when=concurrent.futures.FIRST_COMPLETED,
+                )
+                for f in done:
+                    futures.pop(f, None)
+                    r = f.result()
+                    if r is not None and result is None:
+                        result = r
+                        for lf, name in list(futures.items()):
+                            lurl, lkey = legs[name]
+                            self._cancel_hedge_leg(lurl, lkey)
+                if result is not None:
+                    return result
+            # both legs failed: rediscover next time, queued fallback now
+            self._direct_cache = None
+            self._drop_session_worker(session)
+            return None
+        finally:
+            ex.shutdown(wait=False)
 
 
 # ---------------------------------------------------------------------------

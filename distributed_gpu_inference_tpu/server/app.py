@@ -38,6 +38,7 @@ from .admission import (
 )
 from .flight_recorder import FlightRecorder
 from .geo import GeoService
+from .health import HealthService
 from .observability import MetricsCollector, StructuredLogger, TracingManager
 from .prefix_routing import (
     PrefixRegistry,
@@ -148,6 +149,15 @@ class ServerState:
         self.flight = FlightRecorder(metrics=self.metrics,
                                      tracing=self.tracing)
         self.scheduler.attach_flight(self.flight)
+        # gray-failure defense (round 18): windowed per-worker health
+        # scores + the healthy→suspect→quarantined→probation machine.
+        # Disabled by default (discovery/claim stay byte-identical);
+        # flipped/retuned live via GET/PUT /api/v1/admin/health.
+        self.health = HealthService(
+            on_transition=lambda wid, frm, to:
+                self.metrics.record_health_transition(frm, to)
+        )
+        self.scheduler.attach_health(self.health)
         self.log = StructuredLogger("dgi-tpu.server")
         self.api_key = api_key
         self.admin_key = admin_key or api_key
@@ -695,6 +705,12 @@ async def heartbeat(request: web.Request) -> web.Response:
         kvmig = es.get("kv_migrate")
         if isinstance(kvmig, dict):
             st.metrics.record_kv_migrate_engine(worker_id, kvmig)
+        # direct-serving channel (round 18): cancelled hedge losers →
+        # hedges_total{outcome=cancelled}; the latency samples riding
+        # the same payload feed the HealthService below
+        direct = es.get("direct")
+        if isinstance(direct, dict):
+            st.metrics.record_direct_engine(worker_id, direct)
         # flight-recorder channel: cumulative counters (delta-anchored,
         # restart re-anchors like every other engine payload) plus a
         # bounded ring of recently-completed stream timelines — direct
@@ -740,6 +756,12 @@ async def heartbeat(request: web.Request) -> web.Response:
                     await st.prefix_registry.persist(worker_id, st.store)
                 except Exception:  # noqa: BLE001 — persistence is warm-
                     pass           # start comfort, never heartbeat-fatal
+    # gray-failure defense: every beat feeds the health score — direct
+    # serving latencies/errors (es["direct"]) + the worker-measured
+    # heartbeat round-trip (body["hb_rtt_ms"]) — and advances the
+    # quarantine state machine. No-op (not even accumulation) while the
+    # service is disabled.
+    st.health.ingest(worker_id, es, body)
     if es is not None and es.get("prefix_summary_live"):
         # the worker declares its summary channel alive this beat (wire()
         # returns None while in sync, so no payload ≠ no summary): keep
@@ -1441,6 +1463,15 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
     ]
     if not cands:
         return _json_error(404, "no direct workers available")
+    if st.health.enabled:
+        # gray-failure defense: quarantined workers drop out of the
+        # ranking (they still heartbeat, still serve /kv/export pulls,
+        # still finish in-flight work). admissible() falls back to the
+        # unfiltered list rather than answering 404 — availability beats
+        # purity. Disabled (default): this block never runs and the
+        # ranking below is byte-identical to the pre-health build.
+        allowed = set(st.health.admissible([w["id"] for w in cands]))
+        cands = [w for w in cands if w["id"] in allowed]
     # cache-aware routing: ``prefix_fps`` (comma-separated boundary
     # fingerprints, SDK-computed) ranks workers by advertised prefix
     # affinity — load-headroom-scaled so a hot cached replica spills over —
@@ -1477,8 +1508,22 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
         -score.get(w["id"], 0.0),
         region_distance(region, w.get("region")),
         -headroom[w["id"]],
+        # reliability's measured avg latency as the LAST tiebreak: when
+        # score, region, and headroom all tie, the historically faster
+        # worker wins — the legacy reliability signal and the health
+        # score agree on one surface. Workers with no history (0.0) tie,
+        # preserving the previous stable order.
+        float(w.get("avg_latency_ms") or 0.0),
     ))
     best = cands[0]
+    if st.health.enabled:
+        # probation canary gate at SELECTION time: a probation worker may
+        # win only while its bounded canary budget lasts (allow_canary
+        # charges it); past budget the next-ranked candidate takes the
+        # request. Healthy/suspect workers always pass.
+        best = next(
+            (w for w in cands if st.health.allow_canary(w["id"])), best
+        )
     migrate_hint: Optional[Dict[str, Any]] = None
     route_choice: Optional[str] = None
     route_decision: Optional[Dict[str, Any]] = None
@@ -1553,6 +1598,26 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
         st.metrics.record_prefix_route(
             "direct", hit=chosen_raw > 0.0, spillover=best_raw > chosen_raw,
         )
+    hedge_hint: Optional[Dict[str, Any]] = None
+    if st.health.enabled and st.health.cfg.hedge \
+            and request.query.get("hedge"):
+        # hedged dispatch (round 18): a deadline-carrying client asked
+        # for a backup — hand it the best-ranked DIFFERENT worker plus
+        # the p95-derived fire delay. Both switches (health + hedge) and
+        # the client's opt-in must agree, so the response is
+        # byte-identical whenever any of the three is off.
+        alt = next(
+            (w for w in cands
+             if w["id"] != best["id"] and st.health.allow_canary(w["id"])),
+            None,
+        )
+        if alt is not None:
+            hedge_hint = {
+                "worker_id": alt["id"],
+                "direct_url": alt["direct_url"],
+                "delay_ms": round(st.health.hedge_delay_ms(), 1),
+            }
+            st.metrics.record_hedge("offered")
     return web.json_response(
         {
             "worker_id": best["id"],
@@ -1562,6 +1627,7 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
             **({"prefix_affinity": round(affinity.get(best["id"], 0.0), 4)}
                if affinity else {}),
             **({"kv_migrate": migrate_hint} if migrate_hint else {}),
+            **({"hedge": hedge_hint} if hedge_hint else {}),
         }
     )
 
@@ -1757,6 +1823,39 @@ async def admin_put_routing(request: web.Request) -> web.Response:
     return web.json_response(st.routing.to_dict())
 
 
+async def admin_get_health(request: web.Request) -> web.Response:
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    st.health.evaluate()
+    return web.json_response({
+        **st.health.cfg.to_dict(),
+        "snapshot": st.health.snapshot(),
+    })
+
+
+async def admin_put_health(request: web.Request) -> web.Response:
+    """Live gray-failure A/B switch: flips/retunes health scoring,
+    quarantine thresholds, and hedging on the RUNNING control plane (no
+    restart, no worker involvement — workers ship the same telemetry
+    either way, only the scoring/ranking paths read the flags). Same
+    contract as the routing endpoint: a bad field 400s without
+    half-applying."""
+    if (err := _check_admin_key(request)) is not None:
+        return err
+    st = _state(request)
+    body = await request.json()
+    if not isinstance(body, dict):
+        return _json_error(400, "body must be a JSON object")
+    try:
+        st.health.cfg.update(body)
+    except (TypeError, ValueError) as exc:
+        return _json_error(400, f"bad health config: {exc}")
+    await st.store.audit("admin_update_health", actor="admin",
+                         detail=st.health.cfg.to_dict())
+    return web.json_response(st.health.cfg.to_dict())
+
+
 async def admin_get_admission(request: web.Request) -> web.Response:
     if (err := _check_admin_key(request)) is not None:
         return err
@@ -1881,6 +1980,9 @@ async def admin_worker_delete(request: web.Request) -> web.Response:
     # the registry entry and deletes the persisted summary row (counted)
     await st.guarantee.handle_worker_offline(wid, graceful=False)
     await st.store.delete_worker(wid)
+    # clean death supersedes gray state: drop any quarantine record so a
+    # re-registered worker with the same id starts healthy
+    st.health.forget(wid)
     await st.store.audit("admin_delete_worker", actor="admin",
                          detail={"worker_id": wid})
     return web.json_response({"status": "deleted"})
@@ -2150,6 +2252,18 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
         for s in (WorkerState.IDLE.value, WorkerState.BUSY.value,
                   WorkerState.DRAINING.value)
     )
+    if st.health.enabled:
+        # gray-failure defense: a quarantined worker is registered and
+        # heartbeating but NOT taking new work — fleet strength must
+        # count it degraded, not serving (pre-round-18 the gauge only
+        # saw dead/offline replicas). Per-worker states refresh at
+        # scrape time like the summary gauges above.
+        st.health.evaluate()
+        states = st.health.states()
+        st.metrics.record_health_states(states)
+        serving = max(0, serving - sum(
+            1 for s in states.values() if s == "quarantined"
+        ))
     st.metrics.record_fleet_strength(serving, sum(
         int(n or 0) for n in w.values()
     ))
@@ -2221,6 +2335,8 @@ def create_app(state: Optional[ServerState] = None,
     app.router.add_put(f"{API}/admin/routing", admin_put_routing)
     app.router.add_get(f"{API}/admin/admission", admin_get_admission)
     app.router.add_put(f"{API}/admin/admission", admin_put_admission)
+    app.router.add_get(f"{API}/admin/health", admin_get_health)
+    app.router.add_put(f"{API}/admin/health", admin_put_health)
     app.router.add_get(f"{API}/admin/workers", admin_list_workers)
     app.router.add_get(f"{API}/admin/workers/{{worker_id}}",
                        admin_worker_detail)

@@ -104,6 +104,8 @@ class Metrics:
                 "prefix_summaries_invalidated", "worker_rejoin",
                 "fleet_degraded", "chaos_kills", "chaos_partitions",
                 "chaos_events",
+                "worker_health_state", "health_transitions",
+                "jobs_abandoned", "hedges",
                 "pd_handoffs", "pd_handoff_bytes", "pd_reprefill",
                 "pd_fleet_balance",
                 "kv_migrations", "kv_migration_bytes",
@@ -300,6 +302,31 @@ class Metrics:
             "fleet_degraded",
             "Replicas serving / replicas registered (1.0 = full strength)",
             registry=r)
+        # gray-failure defense (round 18): the quarantine state machine's
+        # externals — per-worker state gauge (codes match
+        # server.health.STATE_CODES), transition counter (a worker
+        # cycling suspect↔healthy is noise; healthy→…→quarantined edges
+        # are pages), worker-side deadline abandonment, and hedged
+        # dispatch (offered by discovery, cancelled losers reported back
+        # through the worker's direct channel)
+        self.worker_health_state = Gauge(
+            "worker_health_state",
+            "Gray-failure health state per worker "
+            "(0=healthy 1=suspect 2=quarantined 3=probation)",
+            ["worker"], registry=r)
+        self.health_transitions = Counter(
+            "health_transitions_total",
+            "Health state-machine transitions",
+            ["from", "to"], registry=r)
+        self.jobs_abandoned = Counter(
+            "jobs_abandoned_total",
+            "Requests abandoned by the worker batcher (hopeless work: "
+            "the deadline passed and the projected remaining decode "
+            "cannot land)",
+            ["worker", "reason"], registry=r)
+        self.hedges = Counter(
+            "hedges_total",
+            "Hedged-dispatch lifecycle events", ["outcome"], registry=r)
         self.chaos_kills = Counter(
             "chaos_kills_total",
             "Hard worker kills injected by the chaos harness", registry=r)
@@ -436,6 +463,7 @@ class MetricsCollector:
         self._pd_prev: Dict[str, Dict[str, int]] = {}
         self._kvmig_prev: Dict[str, Dict[str, int]] = {}
         self._flight_prev: Dict[str, Dict[str, int]] = {}
+        self._direct_prev: Dict[str, Dict[str, int]] = {}
         # bounded tenant-label admission (insertion-ordered dict as LRU):
         # once full, unseen tenants map to "other" — existing series keep
         # their labels (a label that has emitted samples must not migrate)
@@ -591,6 +619,19 @@ class MetricsCollector:
             if delta > 0:
                 metric.labels(worker).inc(delta)
             prev[key] = cur
+        if "abandoned" in stats:
+            # deadline-abandonment (round 18): hopeless slots the batcher
+            # freed at a step boundary — same cumulative channel, reason
+            # label for future abandonment causes
+            try:
+                cur = int(stats.get("abandoned", 0) or 0)
+            except (TypeError, ValueError):
+                return
+            delta = cur - prev.get("abandoned", 0)
+            if delta > 0:
+                self.metrics.jobs_abandoned.labels(
+                    worker, "deadline").inc(delta)
+            prev["abandoned"] = cur
 
     # heartbeat ``engine_stats["pd"]`` key → pd_handoffs_total outcome label
     _PD_OUTCOMES = (
@@ -811,6 +852,45 @@ class MetricsCollector:
         to take work over replicas the plane knows about."""
         ratio = (serving / registered) if registered else 1.0
         self.metrics.fleet_degraded.set(max(0.0, min(1.0, ratio)))
+
+    def record_health_transition(self, frm: str, to: str) -> None:
+        """One edge of the gray-failure state machine (round 18)."""
+        self.metrics.health_transitions.labels(frm, to).inc()
+
+    def record_health_states(self, states: Dict[str, str]) -> None:
+        """Scrape-time refresh of the per-worker health-state gauge."""
+        from .health import STATE_CODES
+
+        for wid, state in states.items():
+            self.metrics.worker_health_state.labels(wid).set(
+                STATE_CODES.get(state, 0)
+            )
+
+    def record_hedge(self, outcome: str, n: int = 1) -> None:
+        """Hedged-dispatch lifecycle: ``offered`` at discovery time
+        (plane-side), ``cancelled`` losers delta-reported through the
+        worker's direct channel."""
+        if n > 0:
+            self.metrics.hedges.labels(outcome).inc(n)
+
+    def record_direct_engine(self, worker: str,
+                             stats: Dict[str, Any]) -> None:
+        """Ingest one worker's direct-serving channel (heartbeat
+        ``engine_stats["direct"]`` — ``DirectServer.wire_stats()``):
+        cancelled hedge losers into ``hedges_total{outcome=cancelled}``.
+        Same delta anchoring as every other engine payload; the latency
+        samples riding the same channel feed the HealthService, not a
+        metric."""
+        prev = self._direct_prev.setdefault(worker, {})
+        if "hedge_cancels" in stats:
+            try:
+                cur = int(stats.get("hedge_cancels", 0) or 0)
+            except (TypeError, ValueError):
+                return
+            delta = cur - prev.get("hedge_cancels", 0)
+            if delta > 0:
+                self.metrics.hedges.labels("cancelled").inc(delta)
+            prev["hedge_cancels"] = cur
 
     def record_chaos_event(self, kind: str) -> None:
         """Harness-facing seam: the fleet chaos driver reports each event

@@ -142,9 +142,16 @@ class SmartScheduler:
         # single-plane deployments; set by ServerState when the cohort is
         # configured.
         self.plane_id: Optional[str] = None
+        # gray-failure defense (round 18): quarantine gate on the claim
+        # path. Attached post-construction by ServerState; None (or the
+        # service disabled) keeps the claim path byte-identical.
+        self._health = None
 
     def attach_flight(self, flight: Any) -> None:
         self._flight = flight
+
+    def attach_health(self, health: Any) -> None:
+        self._health = health
 
     def _flight_note(self, job: Dict[str, Any], event: str,
                      **attrs: Any) -> None:
@@ -245,6 +252,15 @@ class SmartScheduler:
             WorkerState.OFFLINE.value,
             WorkerState.DRAINING.value,
         ):
+            return None
+        if self._health is not None and self._health.enabled and \
+                not self._health.allow_canary(worker_id):
+            # gray-failure defense (round 18): a quarantined worker's
+            # poll claims nothing — it keeps heartbeating, finishes its
+            # in-flight work, and serves /kv/export pulls, but new work
+            # routes around it. Probation re-admits through the bounded
+            # canary budget (allow_canary charges it); the service
+            # disabled keeps this path byte-identical.
             return None
         prefer = None
         reg = self._prefix_registry

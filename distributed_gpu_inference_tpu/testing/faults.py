@@ -379,6 +379,33 @@ def stream_cut(site: str, **ctx: Any) -> bool:
     raise ValueError(f"rule kind {rule.kind!r} unsupported at stream seam")
 
 
+def http_reject(site: str, **ctx: Any) -> Optional[int]:
+    """Server-side rejection seam (worker direct endpoints). One fire()
+    per event so first-match stays well-defined whatever kind is armed:
+
+    - ``error`` rules → returns ``rule.status``: the handler must ANSWER
+      with that status — a flaky replica that 5xxs requests while its
+      process (and its heartbeats) stay perfectly healthy.
+    - ``drop``/``flap`` rules → returns ``0``: cut the connection (same
+      contract as :func:`stream_cut` returning True).
+    - ``delay`` rules sleep and pass through (returns None).
+    - None = serve normally."""
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    rule = plan.fire(site, **ctx)
+    if rule is None:
+        return None
+    if rule.kind == "error":
+        return rule.status
+    if rule.kind in ("drop", "flap"):
+        return 0
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return None
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at reject seam")
+
+
 # ---------------------------------------------------------------------------
 # fleet-level chaos: seeded schedules of whole-replica events
 # ---------------------------------------------------------------------------
@@ -410,8 +437,20 @@ HANDOFF_EVENT_KINDS = ("handoff_partition", "handoff_corrupt",
 # FLEET_EVENT_KINDS so historical seeds keep regenerating their exact
 # schedules.
 PLANE_EVENT_KINDS = ("plane_kill", "plane_partition", "plane_slow")
+
+# gray-failure kinds (round 18 — slow-worker quarantine): the worker is
+# ALIVE and heartbeating the whole time, just wrong. ``degrade`` is a
+# persistent slowdown (every direct request/stream of the replica pays
+# ``delay_s`` for the WHOLE window — the 10x-slow worker that passes
+# health checks), ``jitter`` is the probabilistic version (each event
+# pays ``delay_s`` at ``prob`` — a noisy NIC / contended host), and
+# ``flaky`` answers direct requests with a 5xx at ``prob`` while the
+# process stays up. Kept OUT of FLEET_EVENT_KINDS so historical seeds
+# keep regenerating their exact schedules.
+GRAY_EVENT_KINDS = ("degrade", "jitter", "flaky")
 ALL_FLEET_EVENT_KINDS = (
     FLEET_EVENT_KINDS + HANDOFF_EVENT_KINDS + PLANE_EVENT_KINDS
+    + GRAY_EVENT_KINDS
 )
 
 # the canonical suite/CLI geometry: ``--replay`` must reconstruct the EXACT
@@ -432,6 +471,13 @@ PD_CHAOS_KINDS = ("kill", "partition") + HANDOFF_EVENT_KINDS
 PLANE_CHAOS_PLANES = 2
 PLANE_CHAOS_WORKERS = 2
 PLANE_CHAOS_KINDS = PLANE_EVENT_KINDS + ("kill",)
+
+# gray-chaos suite geometry (tests/test_gray_chaos.py): 3 workers so the
+# quarantine of one degraded replica still leaves a 2-replica serving
+# fleet, gray kinds composed with clean kills — ``--replay SEED --gray``
+# reconstructs these schedules
+GRAY_CHAOS_WORKERS = 3
+GRAY_CHAOS_KINDS = GRAY_EVENT_KINDS + ("kill",)
 
 
 @dataclass(frozen=True)
@@ -470,6 +516,16 @@ class FleetEvent:
                transport for ``duration_s`` while the process stays up
     plane_slow         every request plane ``worker`` answers pays
                ``delay_s`` for ``duration_s``
+    degrade    persistent gray slowdown: every direct request/stream
+               event of the replica pays ``delay_s`` for ``duration_s``
+               (stretched to ≥ half the run) while heartbeats stay
+               healthy — the alive-but-10x-slow worker
+    jitter     probabilistic gray slowdown: each direct request/stream
+               event of the replica pays ``delay_s`` at ``prob`` for
+               ``duration_s``
+    flaky      probabilistic 5xx: the replica's direct requests answer
+               HTTP 500 at ``prob`` for ``duration_s`` while the
+               process (and its heartbeats) stay up
     =========  ==========================================================
     """
 
@@ -581,6 +637,31 @@ class FleetFaultPlan:
                     duration_s=round(dur, 3),
                     delay_s=round(0.02 + 0.08 * rng.random(), 3),
                 ))
+            elif kind == "degrade":
+                # persistent slowdown: heavier than ``slow`` (the worker
+                # is 5-15x a healthy replica's latency, not 1.2x) and the
+                # window stretches to most of the run — the gray failure
+                # quarantine exists to catch. ``dur`` is stretched so the
+                # sequential-window cursor below still never overlaps.
+                dur = max(dur, self.duration_s * 0.5)
+                events.append(FleetEvent(
+                    round(cursor, 3), "degrade", worker,
+                    duration_s=round(dur, 3),
+                    delay_s=round(0.10 + 0.20 * rng.random(), 3),
+                ))
+            elif kind == "jitter":
+                events.append(FleetEvent(
+                    round(cursor, 3), "jitter", worker,
+                    duration_s=round(dur, 3),
+                    prob=0.25 + 0.5 * rng.random(),
+                    delay_s=round(0.05 + 0.10 * rng.random(), 3),
+                ))
+            elif kind == "flaky":
+                events.append(FleetEvent(
+                    round(cursor, 3), "flaky", worker,
+                    duration_s=round(dur, 3),
+                    prob=0.25 + 0.5 * rng.random(),
+                ))
             else:  # blackout / partition / handoff_partition / plane_partition
                 events.append(FleetEvent(
                     round(cursor, 3), kind, worker,
@@ -610,9 +691,11 @@ class FleetFaultPlan:
             extra = ""
             if e.duration_s:
                 extra += f" for {e.duration_s}s"
-            if e.kind in ("pressure", "handoff_corrupt"):
+            if e.kind in ("pressure", "handoff_corrupt", "jitter",
+                          "flaky"):
                 extra += f" prob={e.prob:.2f}"
-            if e.kind in ("slow", "handoff_delay", "plane_slow"):
+            if e.kind in ("slow", "handoff_delay", "plane_slow",
+                          "degrade", "jitter"):
                 extra += f" delay={e.delay_s}s"
             out.append(f"  t+{e.at_s:6.2f}s  {e.kind:<9} {tgt}{extra}")
         return out
@@ -678,15 +761,21 @@ def _replay_main(argv: Optional[Sequence[str]] = None) -> int:
                     "the plane suite's kinds (plane_kill/plane_partition/"
                     "plane_slow + worker kill) and its 2-plane / 2-worker "
                     "geometry")
+    ap.add_argument("--gray", action="store_true",
+                    help="reconstruct a tests/test_gray_chaos.py seed: "
+                    "the gray-failure suite's kinds (degrade/jitter/flaky "
+                    "+ worker kill) and its 3-worker fleet geometry")
     args = ap.parse_args(argv)
-    if args.pd and args.planes:
-        ap.error("--pd and --planes are mutually exclusive")
+    if sum(1 for f in (args.pd, args.planes, args.gray) if f) > 1:
+        ap.error("--pd, --planes and --gray are mutually exclusive")
     kinds = args.kinds
     if kinds is None:
         if args.pd:
             kinds = ",".join(PD_CHAOS_KINDS)
         elif args.planes:
             kinds = ",".join(PLANE_CHAOS_KINDS)
+        elif args.gray:
+            kinds = ",".join(GRAY_CHAOS_KINDS)
         else:
             kinds = ",".join(FLEET_EVENT_KINDS)
     workers = args.workers
@@ -695,6 +784,8 @@ def _replay_main(argv: Optional[Sequence[str]] = None) -> int:
             workers = PD_CHAOS_WORKERS
         elif args.planes:
             workers = PLANE_CHAOS_WORKERS
+        elif args.gray:
+            workers = GRAY_CHAOS_WORKERS
         else:
             workers = FLEET_CHAOS_WORKERS
     plan = FleetFaultPlan(

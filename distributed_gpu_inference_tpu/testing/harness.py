@@ -247,6 +247,11 @@ class FleetWorker:
         llm.checkpoint_sink = w.push_stream_checkpoint
         ds = DirectServer(w, host="127.0.0.1", port=0)
         ds.start()
+        # production wires the direct server into the heartbeat loop
+        # (worker.main line of duty); an externally-built one must opt in
+        # the same way or the plane's gray-failure health scoring never
+        # sees this replica's direct latency/error samples
+        w._direct = ds
         port = ds._runner.addresses[0][1]
         info: Dict[str, Any] = {
             "name": self.tag, "region": self.region,
@@ -389,6 +394,31 @@ class FleetWorker:
                       match={"worker": self.tag}),
             FaultRule(site="worker.direct.stream", kind="delay",
                       delay_s=delay_s, times=None,
+                      match={"worker": self.tag}),
+        ]
+
+    def jitter_rules(self, delay_s: float, prob: float) -> List[FaultRule]:
+        """Gray jitter: each direct request/stream event of THIS replica
+        pays ``delay_s`` at ``prob`` — a noisy NIC rather than a uniformly
+        slow host, so latency-window health scoring sees a fat tail, not a
+        shifted median."""
+        return [
+            FaultRule(site="worker.direct.request", kind="delay",
+                      delay_s=delay_s, prob=prob, times=None,
+                      match={"worker": self.tag}),
+            FaultRule(site="worker.direct.stream", kind="delay",
+                      delay_s=delay_s, prob=prob, times=None,
+                      match={"worker": self.tag}),
+        ]
+
+    def flaky_rules(self, prob: float) -> List[FaultRule]:
+        """Gray flakiness: THIS replica's direct admission answers HTTP 500
+        at ``prob`` while the process — and its heartbeats — stay healthy.
+        Consulted through the :func:`~.faults.http_reject` seam so the
+        client sees a real status, not a cut socket."""
+        return [
+            FaultRule(site="worker.direct.request", kind="error",
+                      status=500, prob=prob, times=None,
                       match={"worker": self.tag}),
         ]
 
@@ -806,8 +836,18 @@ class LiveFleet:
                 member.blackout(False)
 
             return heal
-        if ev.kind == "slow":
+        if ev.kind in ("slow", "degrade"):
+            # degrade reuses the slow seam with a far heavier delay over a
+            # far longer window — the gray failure the quarantine exists
+            # to catch (the replica heartbeats fine the whole time)
             rules = [fp.add_rule(r) for r in member.slow_rules(ev.delay_s)]
+            return lambda: [fp.remove_rule(r) for r in rules]
+        if ev.kind == "jitter":
+            rules = [fp.add_rule(r)
+                     for r in member.jitter_rules(ev.delay_s, ev.prob)]
+            return lambda: [fp.remove_rule(r) for r in rules]
+        if ev.kind == "flaky":
+            rules = [fp.add_rule(r) for r in member.flaky_rules(ev.prob)]
             return lambda: [fp.remove_rule(r) for r in rules]
         if ev.kind == "pressure":
             rule = fp.add_rule(FaultRule(

@@ -161,6 +161,13 @@ class ServingConfig(BaseModel):
     # Read per-round and bucketed through compiled prefill widths, so it is
     # safe to retune live. None = keep the engine default. Remote-pushable.
     ragged_chunk: Optional[int] = None
+    # hopeless-work abandonment (gray-failure round): when True the batcher
+    # drops deadline-carrying work whose deadline has passed AND whose
+    # projected remaining decode cannot land within ``deadline_grace_s``
+    # (typed ``deadline_abandoned`` error; blocks freed at the next step
+    # boundary). Never fires for deadline-less requests. Remote-pushable.
+    abandon_deadlines: bool = False
+    deadline_grace_s: float = 0.5
 
     @model_validator(mode="after")
     def _warn_deprecated(self) -> "ServingConfig":

@@ -37,7 +37,20 @@ class DirectServer:
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
-        self.stats: Dict[str, Any] = {"requests": 0, "rejected": 0}
+        self.stats: Dict[str, Any] = {"requests": 0, "rejected": 0,
+                                      "hedge_cancels": 0}
+        # health-telemetry accumulators, drained into each heartbeat by
+        # wire_stats(): per-request wall latencies (ms) and served-5xx
+        # counts since the last beat. Handlers run on the direct-server
+        # loop thread while the heartbeat drains from the worker thread,
+        # so the buffers take a lock.
+        self._stats_lock = threading.Lock()
+        self._recent_ms: list = []
+        self._new_errors = 0
+        # hedged dispatch: in-flight requests that registered a client
+        # hedge key, cancellable at the next step boundary via
+        # POST /inference/cancel — the losing racer's abort path
+        self._cancels: Dict[str, threading.Event] = {}
 
     # -- handlers ------------------------------------------------------------
 
@@ -150,28 +163,104 @@ class DirectServer:
         ``fault_tag``; untagged workers match the empty string."""
         return str(getattr(self.worker, "fault_tag", "") or "")
 
+    def _record_sample(self, latency_ms: Optional[float] = None,
+                       error: bool = False) -> None:
+        """Accumulate a health-telemetry observation for the next
+        heartbeat. The sample buffer is bounded: if the heartbeat loop
+        stalls, old samples drop rather than the buffer growing forever
+        (the freshest window is what health scoring wants anyway)."""
+        with self._stats_lock:
+            if latency_ms is not None:
+                self._recent_ms.append(float(latency_ms))
+                if len(self._recent_ms) > 512:
+                    del self._recent_ms[:-256]
+            if error:
+                self._new_errors += 1
+
+    def wire_stats(self) -> Dict[str, Any]:
+        """Heartbeat ``engine_stats["direct"]`` channel: drains the
+        since-last-beat latency samples / served-5xx count (deltas), plus
+        the CUMULATIVE hedge-cancel counter the plane delta-anchors into
+        ``hedges_total{outcome="cancelled"}``."""
+        with self._stats_lock:
+            recent = self._recent_ms
+            self._recent_ms = []
+            errors = self._new_errors
+            self._new_errors = 0
+        return {"recent_ms": recent, "new_errors": errors,
+                "hedge_cancels": int(self.stats["hedge_cancels"])}
+
     async def _inference(self, request: web.Request) -> web.Response:
-        if _faults.stream_cut("worker.direct.request",
-                              worker=self._fault_tag()):
+        t0 = time.time()   # BEFORE the fault seam: injected gray delay is
+        # real service time and must land in the health latency samples
+        reject = _faults.http_reject("worker.direct.request",
+                                     worker=self._fault_tag())
+        if reject == 0:
             # chaos seam: the worker "dies" on this request — hard-close
             # so the client sees a crashed process, not a clean error
             with contextlib.suppress(Exception):
                 request.transport.close()
             raise ConnectionResetError("fault injected: request cut")
+        if reject is not None:
+            # gray flaky seam: the process is healthy, the answer is a 5xx
+            self.stats["rejected"] += 1
+            self._record_sample(error=True)
+            return web.json_response(
+                {"detail": "fault injected: flaky reply"}, status=reject
+            )
         engine, body, release, err = await self._parse_and_admit(request)
         if err is not None:
             return err
+        # hedged dispatch: a client that raced this request against another
+        # replica registers a cancel key — the losing leg is aborted at the
+        # next step boundary via POST /inference/cancel instead of burning
+        # decode rounds to the end. The key is client-supplied but the
+        # EVENT is server-minted (``_cancel_evt`` rides the reserved
+        # underscore namespace _parse_and_admit strips from clients).
+        params = body.get("params") or {}
+        hedge_key = None
+        if isinstance(params, dict):
+            # the event slot is server-owned: a wire-supplied value would
+            # reach the batcher's cancel hook as a non-Event and crash it
+            params.pop("_cancel_evt", None)
+            if params.get("hedge_key"):
+                hedge_key = str(params.pop("hedge_key"))
+                evt = threading.Event()
+                params["_cancel_evt"] = evt
+                self._cancels[hedge_key] = evt
         started = time.time()
         loop = asyncio.get_running_loop()
         try:
             result = await loop.run_in_executor(
-                None, engine.inference, body.get("params") or {}
+                None, engine.inference, params
             )
         except Exception as exc:  # noqa: BLE001 - surface as a job error
+            self._record_sample(error=True)
             return web.json_response({"detail": str(exc)}, status=500)
         finally:
             release(started)
+            if hedge_key is not None:
+                self._cancels.pop(hedge_key, None)
+        self._record_sample(latency_ms=(time.time() - t0) * 1000.0)
         return web.json_response({"result": result})
+
+    async def _inference_cancel(self, request: web.Request) -> web.Response:
+        """Hedge-loser abort: flips the cancel event registered under the
+        caller's ``hedge_key``, so the batcher releases the slot at the
+        next step boundary. Idempotent; an unknown key (request already
+        finished, or never started here) is a no-op 200 so racers never
+        error out while tidying up."""
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response({"detail": "invalid JSON"}, status=400)
+        key = str((body or {}).get("hedge_key") or "")
+        evt = self._cancels.get(key) if key else None
+        if evt is not None and not evt.is_set():
+            evt.set()
+            self.stats["hedge_cancels"] += 1
+            return web.json_response({"cancelled": True})
+        return web.json_response({"cancelled": False})
 
     async def _inference_stream(self, request: web.Request
                                 ) -> web.StreamResponse:
@@ -287,6 +376,7 @@ class DirectServer:
         app.router.add_get("/health", self._health)
         app.router.add_get("/status", self._status)
         app.router.add_post("/inference", self._inference)
+        app.router.add_post("/inference/cancel", self._inference_cancel)
         app.router.add_post("/inference/stream", self._inference_stream)
         return app
 

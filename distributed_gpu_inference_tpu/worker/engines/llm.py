@@ -88,6 +88,10 @@ SERVING_REMOTE_KEYS: Dict[str, str] = {
     # co-batched decode ITL
     "prefill_budget": "prefill_budget",
     "ragged_chunk": "ragged_chunk",
+    # gray-failure round: hopeless-deadline abandonment is a policy read
+    # per step-boundary scan — flip it live to shed doomed work fleet-wide
+    "abandon_deadlines": "abandon_deadlines",
+    "deadline_grace_s": "deadline_grace_s",
 }
 
 
@@ -631,6 +635,8 @@ class TPULLMEngine(LLMBaseEngine):
             ragged=(None if sv.get("ragged") is None
                     else bool(sv["ragged"])),
             prefill_budget=int(sv.get("prefill_budget") or 0),
+            abandon_deadlines=bool(sv.get("abandon_deadlines") or False),
+            deadline_grace_s=float(sv.get("deadline_grace_s") or 0.5),
         )
 
     def apply_serving_config(self, updates: Optional[Dict[str, Any]]) -> None:
@@ -871,8 +877,14 @@ class TPULLMEngine(LLMBaseEngine):
             req.priority = int(params.get("priority") or 0)
         if params.get("speculative") is False:
             req.params["speculative"] = False
+        # hedged dispatch: the direct server mints a cancel event for
+        # requests carrying a hedge key — the losing racer's abort rides
+        # the batcher's step-boundary cancel path (partial output with
+        # finish_reason="abort", never an error)
+        cancel = params.pop("_cancel_evt", None)
         t0 = time.perf_counter()
-        resp = self.serving.submit(req, flight=tl if tl.enabled else None)
+        resp = self.serving.submit(req, cancel=cancel,
+                                   flight=tl if tl.enabled else None)
         if resp.error is not None:
             _raise_serving(resp)
         tl.note("worker.done")

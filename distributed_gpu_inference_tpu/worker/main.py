@@ -208,6 +208,10 @@ class Worker:
         self._shutdown = threading.Event()
         self._drained = threading.Event()
         self._direct: Optional[Any] = None
+        # worker-measured round-trip of the PREVIOUS heartbeat (ms) —
+        # shipped on the next beat as a control-path latency sample for
+        # the plane's gray-failure health scoring
+        self._hb_rtt_ms: Optional[float] = None
         # guards IDLE→BUSY transitions so the poll loop and the direct server
         # can never run engine.inference concurrently on the same engines
         self._state_lock = threading.Lock()
@@ -449,7 +453,8 @@ class Worker:
             for k in ("submitted", "completed", "rejected", "admitted",
                       "decode_rounds", "chunked_admissions",
                       "batched_waves", "preemptions", "resumes",
-                      "preempted_too_often", "cancelled", "migrated"):
+                      "preempted_too_often", "cancelled", "migrated",
+                      "abandoned"):
                 out[k] = out.get(k, 0) + int(s.get(k, 0) or 0)
             for k in ("queue_depth", "active_slots"):
                 out[k] = out.get(k, 0) + int(s.get(k, 0) or 0)
@@ -563,6 +568,20 @@ class Worker:
             flight_stats = self._flight_engine_stats()
             if flight_stats:
                 engine_stats["flight"] = flight_stats
+            direct = self._direct
+            if direct is not None:
+                # gray-failure telemetry: per-request direct latencies /
+                # served-5xx deltas feed the plane's health scoring; the
+                # cumulative hedge-cancel counter delta-anchors
+                # hedges_total{outcome="cancelled"}. Omitted while empty
+                # so quiet beats stay byte-identical to pre-round ones.
+                try:
+                    ds = direct.wire_stats()
+                except Exception:  # noqa: BLE001 — never break the beat
+                    ds = None
+                if ds and (ds.get("recent_ms") or ds.get("new_errors")
+                           or ds.get("hedge_cancels")):
+                    engine_stats["direct"] = ds
             summary = self._prefix_summary_payload()
             if summary is not None:
                 # radix summary (full or delta) for cache-aware routing;
@@ -589,6 +608,12 @@ class Worker:
                 # claim — report the full set so the server's stale-claim
                 # guard covers every in-flight job, not an arbitrary one
                 extra["active_job_ids"] = active
+            if self._hb_rtt_ms is not None:
+                # previous beat's measured round-trip: a worker whose
+                # control path has gone gray (slow NIC, throttled host)
+                # reports it here even when no direct traffic lands
+                extra["hb_rtt_ms"] = round(self._hb_rtt_ms, 3)
+            hb_t0 = time.perf_counter()
             resp = self.api.heartbeat(
                 status=self.state.value,
                 config_version=self.config.config_version,
@@ -603,6 +628,7 @@ class Worker:
                 },
                 **extra,
             )
+            self._hb_rtt_ms = (time.perf_counter() - hb_t0) * 1000.0
             self.stats["heartbeats"] += 1
             if summary_eng is not None:
                 if resp.get("prefix_summary_applied") is False:

@@ -27,6 +27,9 @@ HEAVY = [
     #   (role-tagged LiveFleet + streamed-handoff kills/corruption)
     "tests/test_fleet_chaos.py",         # 25-seed LiveFleet chaos replays
     #   (real multi-worker fleet + kill/partition/pressure under load)
+    "tests/test_gray_chaos.py",          # 25-seed gray-failure replays
+    #   (degrade/jitter/flaky + kills with quarantine live, plus the
+    #   quarantine/probation/re-admission walk on a live fleet)
     "tests/test_chaos_scenarios.py",     # 50-seed replays per scenario
     "tests/test_worker_failover_chaos.py",  # 25-seed kill-mid-stream e2e
     "tests/test_worker_serving_batcher.py",  # batcher-backed serving e2e
