@@ -1455,6 +1455,162 @@ def run_gray(args: Any, backend: str, model: str) -> None:
 
 
 # ---------------------------------------------------------------------------
+# --io-chaos (round 19): what the per-tier IO breakers buy under a spill-
+# tier brownout. A spill-tiered 2-replica LiveFleet (L2 host blocks + the
+# in-process L3) serves the same open-loop workload three times: calm,
+# then under a composed io_slow+io_error storm (every spill op pays a
+# browning-out device's latency AND fails probabilistically) with the
+# breakers armed (default), then the identical storm with
+# DGI_IO_BREAKER_DISABLE=1 — the pre-round-19 behavior where every
+# admission keeps paying the dying tier's latency for the whole window.
+# Published: TTFT/e2e percentiles per leg, the ON/OFF latency ratios, the
+# per-tier error/skip counters, and byte-identity of greedy outputs
+# across all three legs — the spill tiers are an optimization, and
+# fencing them off must never change WHAT is generated.
+# ---------------------------------------------------------------------------
+
+
+def run_io_chaos(args: Any, backend: str, model: str) -> None:
+    import numpy as _np
+
+    from distributed_gpu_inference_tpu.testing.faults import (
+        FleetEvent,
+        FleetFaultPlan,
+        IO_CHAOS_SUITE_KINDS,
+    )
+    from distributed_gpu_inference_tpu.testing.harness import LiveFleet
+
+    engine_config = {
+        "model": model,
+        "max_batch_size": args.concurrency,
+        "max_seq_len": args.prompt_len + args.max_tokens + 16,
+        "quantization": args.quantization,
+        # the durable surfaces under test: a host spill tier + the
+        # in-process remote tier, spill-on-evict implied. The device pool
+        # is pinned SMALL (the default sizing rule would fit the whole
+        # working set and spill only at the leg's tail) so evictions —
+        # and therefore spill-tier IO — run continuously through the
+        # storm window instead of clustering after it
+        "num_blocks": 64,
+        "kv_spill_host_blocks": 64,
+        "kv_remote_url": "memory://",
+        "serving": {
+            "queue_limit": max(4096, args.requests * 2),
+            "default_timeout_s": 600.0,
+        },
+    }
+    # spill churn is the point of this leg: with the global default
+    # --shared-prefix 64 and a 64-token prompt every request is the SAME
+    # prompt — one cached prefix, zero evictions, a storm with nothing
+    # to hit. Cap the shared prefix so suffixes stay distinct and the
+    # working set actually cycles through the spill tiers.
+    shared = min(args.shared_prefix, args.prompt_len // 4)
+    prompts = synth_prompt_strings(args.requests, args.prompt_len,
+                                   shared, seed=args.seed)
+    # warm prompts are a DIFFERENT draw: warming compiles the graphs
+    # without pre-filling the L1 prefix cache for the measured set, so
+    # measured admissions actually probe the spill tiers
+    warm_prompts = synth_prompt_strings(args.requests, args.prompt_len,
+                                        shared, seed=args.seed + 1)
+    rate = float(args.arrival_rate) if args.arrival_rate else 4.0
+    gaps = _np.random.default_rng(args.seed).exponential(
+        1.0 / rate, len(prompts))
+    arrivals = [float(a) for a in _np.cumsum(gaps)]
+    span = arrivals[-1]
+
+    def spill_stats(fleet: Any) -> Dict[str, int]:
+        agg: Dict[str, int] = {}
+        for m in fleet.members:
+            mgr = m.llm.engine.manager
+            for k, v in mgr.spill_wire_stats().items():
+                if k.endswith("_state"):
+                    agg[k] = max(agg.get(k, 0), int(v))
+                else:
+                    agg[k] = agg.get(k, 0) + int(v)
+        return agg
+
+    def leg(storm: bool, breakers_on: bool) -> Dict[str, Any]:
+        old = os.environ.get("DGI_IO_BREAKER_DISABLE")
+        if not breakers_on:
+            os.environ["DGI_IO_BREAKER_DISABLE"] = "1"
+        try:
+            with LiveFleet(n=2, engine_config=engine_config) as fleet:
+                _fleet_leg(fleet, warm_prompts, arrivals,
+                           args.max_tokens)               # compile warm
+                if storm:
+                    plan = FleetFaultPlan(
+                        args.seed, n_workers=2, duration_s=span + 4.0,
+                        kinds=IO_CHAOS_SUITE_KINDS)
+                    # the browning-out device: spill ops fail at prob and
+                    # the survivors pay the delay — the composed storm a
+                    # dying disk/NIC actually produces. ORDER MATTERS:
+                    # rule matching is first-match with prob-miss
+                    # fallthrough, so io_error must arm FIRST — armed
+                    # after the always-firing delay rule it would be
+                    # shadowed and never raise
+                    plan.events = [
+                        FleetEvent(0.0, "io_error", -1,
+                                   duration_s=span + 3.0,
+                                   prob=float(args.io_error_prob)),
+                        FleetEvent(0.0, "io_slow", -1,
+                                   duration_s=span + 3.0,
+                                   delay_s=float(args.io_delay_s)),
+                    ]
+                    fleet.run_chaos(plan)
+                try:
+                    results, elapsed = _fleet_leg(
+                        fleet, prompts, arrivals, args.max_tokens)
+                finally:
+                    if storm:
+                        fleet.wait_chaos()
+                entry = _aggregate_summary(results, elapsed)
+                entry["spill_io"] = spill_stats(fleet)
+                texts = {r["i"]: r.get("text") for r in results
+                         if r["status"] == 200}
+                return entry, texts
+        finally:
+            if old is None:
+                os.environ.pop("DGI_IO_BREAKER_DISABLE", None)
+            else:
+                os.environ["DGI_IO_BREAKER_DISABLE"] = old
+
+    out: Dict[str, Any] = {
+        "benchmark": "worker_serving_io_chaos",
+        "path": "control_plane+direct_nearest+spill_tiers+io_storm",
+        "model": model, "backend": backend, "seed": args.seed,
+        "requests": args.requests, "concurrency": args.concurrency,
+        "prompt_len": args.prompt_len, "max_tokens": args.max_tokens,
+        "arrival_rate_rps": rate,
+        "io_delay_s": float(args.io_delay_s),
+        "io_error_prob": float(args.io_error_prob),
+    }
+    calm, calm_texts = leg(storm=False, breakers_on=True)
+    on, on_texts = leg(storm=True, breakers_on=True)
+    off, off_texts = leg(storm=True, breakers_on=False)
+    ratios: Dict[str, Any] = {}
+    for pct in ("p50", "p95"):
+        o, f = (on["e2e_ms"] or {}).get(pct), (off["e2e_ms"] or {}).get(pct)
+        if o and f:
+            ratios[f"e2e_{pct}_on_over_off"] = round(o / f, 3)
+        ot = (on["ttft_ms"] or {}).get(pct)
+        ft = (off["ttft_ms"] or {}).get(pct)
+        if ot and ft:
+            ratios[f"ttft_{pct}_on_over_off"] = round(ot / ft, 3)
+    out["io_chaos"] = {
+        "calm": calm,
+        "brownout_breakers_on": on,
+        "brownout_breakers_off": off,
+        "breakers_on_vs_off": ratios,
+        "outputs_identical": (
+            len(calm_texts) == len(on_texts) == len(off_texts)
+            == len(prompts)
+            and calm_texts == on_texts == off_texts
+        ),
+    }
+    emit(out)
+
+
+# ---------------------------------------------------------------------------
 # --pd-split (round 11): the PD frontier. A LiveFleet split into a prefill
 # fleet and a decode fleet (role-tagged registrations, every member running
 # a real /kv/transfer data plane) serves pd-disaggregated jobs through the
@@ -2756,6 +2912,20 @@ def main() -> None:
                     "not marginal: below the fleet's queueing slack, "
                     "quarantining a third of the capacity costs more "
                     "than the slow replica does)")
+    ap.add_argument("--io-chaos", action="store_true",
+                    help="durable-tier brownout legs: a spill-tiered "
+                    "2-worker LiveFleet under a composed io_slow+io_error "
+                    "storm with the per-tier IO breakers ON (default) vs "
+                    "DISABLED; publishes per-leg TTFT/e2e, the ON/OFF "
+                    "latency ratios, spill error/skip counters, and "
+                    "three-way output byte-identity")
+    ap.add_argument("--io-delay-s", type=float, default=0.05,
+                    help="per-op latency the browning-out spill device "
+                    "pays during the --io-chaos storm")
+    ap.add_argument("--io-error-prob", type=float, default=0.6,
+                    help="per-op failure probability of the spill device "
+                    "during the --io-chaos storm (what trips the "
+                    "breakers; pure slowness never raises)")
     ap.add_argument("--replicas", default="1,2,4",
                     help="comma-separated replica counts for the --chaos "
                     "cluster frontier sweep")
@@ -2839,6 +3009,13 @@ def main() -> None:
             ap.error("--gray takes a single --arrival-rate (the "
                      "comparison axis is defenses ON vs OFF)")
         run_gray(args, backend, model)
+        return
+
+    if args.io_chaos:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--io-chaos takes a single --arrival-rate (the "
+                     "comparison axis is breakers ON vs OFF)")
+        run_io_chaos(args, backend, model)
         return
 
     if args.kv_migrate:

@@ -24,8 +24,10 @@ from __future__ import annotations
 
 import functools
 import itertools
+import json
 import time
 import uuid
+import zlib
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -286,11 +288,24 @@ class PreemptedSequence:
     cached_tokens: int
     preempt_count: int = 0                # maintained by the scheduler layer
 
+    @staticmethod
+    def _wire_crc(data: Dict[str, Any]) -> int:
+        """CRC32 over the canonical JSON of the checkpoint WITHOUT its
+        ``crc`` field — the integrity check for a record that crosses HTTP
+        and sits in a TEXT column through a store brownout (round 19)."""
+        body = {k: v for k, v in data.items() if k != "crc"}
+        return zlib.crc32(
+            json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+        )
+
     def to_wire(self) -> Dict[str, Any]:
         """Versioned JSON-safe checkpoint (numbers, strings, lists only —
-        it crosses HTTP and lands in a TEXT column)."""
+        it crosses HTTP and lands in a TEXT column). Carries a ``crc``
+        field over the canonical JSON body so a torn/corrupted store row is
+        DETECTED at resume (caller degrades to recompute) rather than
+        resuming a half-written sequence."""
         r = self.request
-        return {
+        data = {
             "v": CHECKPOINT_WIRE_VERSION,
             "request": {
                 "request_id": r.request_id,
@@ -312,6 +327,8 @@ class PreemptedSequence:
             "cached_tokens": self.cached_tokens,
             "preempt_count": self.preempt_count,
         }
+        data["crc"] = self._wire_crc(data)
+        return data
 
     @classmethod
     def from_wire(cls, data: Dict[str, Any]) -> "PreemptedSequence":
@@ -323,6 +340,11 @@ class PreemptedSequence:
                 f"unsupported checkpoint version {ver!r} (this build "
                 f"speaks v{CHECKPOINT_WIRE_VERSION})"
             )
+        # verify-when-present: pre-round-19 rows carry no crc and parse as
+        # before (mixed-version fleets); a present-but-wrong crc means the
+        # row was torn or bit-flipped in the store — refuse to resume it
+        if "crc" in data and int(data["crc"]) != cls._wire_crc(data):
+            raise ValueError("checkpoint integrity check failed (bad crc)")
         r = data["request"]
         request = InferenceRequest(
             request_id=r["request_id"],

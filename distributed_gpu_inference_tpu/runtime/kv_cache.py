@@ -42,28 +42,62 @@ class OutOfBlocksError(RuntimeError):
     pass
 
 
+class SpillIntegrityError(ValueError):
+    """A spilled entry failed its CRC — bit rot or a torn write. The probe
+    path QUARANTINES the entry (best-effort delete + counter) and degrades
+    to the next tier or recompute; this error never crosses a request."""
+
+
+#: checksummed spill-entry framing (round 19): magic + CRC32 of the body.
+#: Entries without the magic are the pre-round-19 legacy form and are
+#: accepted unchecked — a mixed-version fleet sharing one remote tier must
+#: keep hitting, and legacy entries age out under TTL anyway.
+_SPILL_MAGIC = b"SPL2"
+
+
 def _pack_spill(page: np.ndarray,
                 scale_page: Optional[np.ndarray]) -> bytes:
-    """L3 wire form of a spilled block: length-prefixed page blob, then the
-    optional scale blob. One entry per block — (page, scale) are atomic by
-    construction, so there is no orphaned-scale state to defend against."""
+    """L3 wire form of a spilled block: magic + CRC32, then the
+    length-prefixed page blob and the optional scale blob. One entry per
+    block — (page, scale) are atomic by construction, so there is no
+    orphaned-scale state to defend against. The CRC covers the whole body,
+    so both bit rot (corrupt read) and torn writes surface as
+    :class:`SpillIntegrityError` at unpack time."""
+    import zlib
+
     from distributed_gpu_inference_tpu.utils.serialization import (
         TensorSerializer,
     )
 
     ser = TensorSerializer()
     pb = ser.serialize(page)
-    out = len(pb).to_bytes(8, "little") + pb
+    body = len(pb).to_bytes(8, "little") + pb
     if scale_page is not None:
-        out += ser.serialize(scale_page)
-    return out
+        body += ser.serialize(scale_page)
+    return _SPILL_MAGIC + zlib.crc32(body).to_bytes(4, "little") + body
 
 
 def _unpack_spill(raw: bytes) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    import zlib
+
     from distributed_gpu_inference_tpu.utils.serialization import (
         TensorSerializer,
     )
 
+    if raw[:4] == _SPILL_MAGIC:
+        if len(raw) < 8:
+            raise SpillIntegrityError(
+                f"torn spill entry: {len(raw)} bytes is shorter than the "
+                "checksummed header"
+            )
+        want = int.from_bytes(raw[4:8], "little")
+        raw = raw[8:]
+        got = zlib.crc32(raw)
+        if got != want:
+            raise SpillIntegrityError(
+                f"spill entry checksum mismatch: stored {want:#010x}, "
+                f"computed {got:#010x} over {len(raw)} bytes"
+            )
     n = int.from_bytes(raw[:8], "little")
     if 8 + n > len(raw):
         raise ValueError(
@@ -265,6 +299,11 @@ class HostKVStore:
         self._store: "OrderedDict[str, Any]" = OrderedDict()
 
     def get(self, key: str) -> Optional[Any]:
+        # chaos seam: host-RAM tier IO (an injected error models the NUMA
+        # pool / pinned-buffer allocation failing, not bit rot — RAM
+        # entries are objects, so corrupt/torn kinds live on the remote
+        # tier's byte seams instead)
+        _faults.io_fault("io.spill.host.get", key=key)
         arr = self._store.get(key)
         if arr is not None:
             self._store.move_to_end(key)
@@ -273,10 +312,16 @@ class HostKVStore:
     def put(self, key: str, value: Any) -> None:
         if self.max_blocks <= 0:
             return
+        _faults.io_fault("io.spill.host.put", key=key)
         self._store[key] = value
         self._store.move_to_end(key)
         while len(self._store) > self.max_blocks:
             self._store.popitem(last=False)
+
+    def delete(self, key: str) -> None:
+        """Quarantine hook: drop one entry (no seam — eviction of a bad
+        entry must never be blockable by the chaos that exposed it)."""
+        self._store.pop(key, None)
 
     def __len__(self) -> int:
         return len(self._store)
@@ -297,15 +342,26 @@ class RemoteKVStore:
     def get(self, key: str) -> Optional[bytes]:
         item = self._store.get(key)
         if item is None:
-            return None
+            # the seam still fires on a miss (an io_error is a failed READ,
+            # hit or not) — mutating kinds pass None through untouched
+            return _faults.io_bytes("io.spill.remote.get", None, key=key)
         expires, data = item
         if time.monotonic() > expires:
             del self._store[key]
             return None
-        return data
+        # chaos seam: corrupt reads flip a byte, short reads truncate,
+        # errors raise OSError — what the entry CRC + quarantine defend
+        return _faults.io_bytes("io.spill.remote.get", data, key=key)
 
     def put(self, key: str, data: bytes) -> None:
+        # chaos seam: a torn write persists only a prefix — detected at
+        # read time by the CRC, exactly like real partial-flush loss
+        data = _faults.io_bytes("io.spill.remote.put", data, key=key)
         self._store[key] = (time.monotonic() + self.ttl_s, data)
+
+    def delete(self, key: str) -> None:
+        """Quarantine hook: evict one (corrupt) entry."""
+        self._store.pop(key, None)
 
     def purge_expired(self) -> int:
         now = time.monotonic()
@@ -353,6 +409,34 @@ class PagedKVCacheManager:
         self.spill_on_evict = spill_on_evict
         self.kv_dtype = np.dtype(kv_dtype) if kv_dtype is not None else None
         self.quantized_kv = self.kv_dtype == np.int8
+
+        # durable-tier immunity (round 19): per-tier circuit breakers +
+        # cumulative error/quarantine counters. A tier put/get that raises
+        # is counted and SKIPPED — an optional cache tier can never fail
+        # eviction or a request (the PR 13 contract) — and a tier failing
+        # repeatedly trips open so serving stops paying its latency tax.
+        # Counters ride heartbeats (spill_wire_stats → engine_stats
+        # ["kv_spill"]) into kv_spill_errors_total / spill_quarantined_
+        # total / io_breaker_state on the plane.
+        from distributed_gpu_inference_tpu.runtime.io_guard import (
+            IOBreaker,
+            breaker_env_config,
+        )
+
+        bcfg = breaker_env_config()
+        self.breakers: Dict[str, Any] = {}
+        if not bcfg["disabled"]:
+            for tier in ("host", "remote"):
+                self.breakers[tier] = IOBreaker(
+                    tier, threshold=bcfg["threshold"],
+                    open_s=bcfg["open_s"], jitter=bcfg["jitter"],
+                )
+        self.spill_io: Dict[str, int] = {
+            "host_put_errors": 0, "host_get_errors": 0,
+            "remote_put_errors": 0, "remote_get_errors": 0,
+            "host_quarantined_corrupt": 0, "remote_quarantined_corrupt": 0,
+            "breaker_skips": 0,
+        }
 
         self.metas: Dict[int, KVBlockMeta] = {}
         self.free_list: List[int] = list(range(num_blocks - 1, 0, -1))  # pop() → 1..
@@ -443,6 +527,57 @@ class PagedKVCacheManager:
 
     # -- spill tiers (reference get_or_compute chain, kv_cache.py:389-462) ---
 
+    # -- tier guards (round 19): breaker gating + error isolation ------------
+
+    def _tier_allow(self, tier: str) -> bool:
+        """Breaker gate for one tier; an open breaker skips the tier
+        entirely (and counts the skip) — no per-op latency tax from a
+        browned-out device."""
+        br = self.breakers.get(tier)
+        if br is None or br.allow():
+            return True
+        self.spill_io["breaker_skips"] += 1
+        return False
+
+    def _tier_result(self, tier: str, ok: bool, op: str) -> None:
+        br = self.breakers.get(tier)
+        if ok:
+            if br is not None:
+                br.record_success()
+            return
+        self.spill_io[f"{tier}_{op}_errors"] += 1
+        if br is not None:
+            br.record_failure()
+            if not br.closed:
+                logging.getLogger("dgi_kv_spill").warning(
+                    "spill tier %r breaker %s after %s failure",
+                    tier, br.state, op,
+                )
+
+    def _quarantine(self, tier: str, key: str, reason: str) -> None:
+        """A provably bad entry (CRC mismatch, torn frame) is deleted from
+        its tier — best-effort: the delete itself failing must not block
+        the degraded read path — and counted. Mirrors the handoff
+        corrupt-piece contract: poison stays local, requests recompute."""
+        store = self.host_store if tier == "host" else self.remote_store
+        try:
+            delete = getattr(store, "delete", None)
+            if delete is not None:
+                delete(key)
+        except Exception:  # noqa: BLE001 — quarantine is best-effort
+            pass
+        self.spill_io[f"{tier}_quarantined_{reason}"] += 1
+
+    def spill_wire_stats(self) -> Dict[str, int]:
+        """Cumulative spill-IO counters + breaker states for the heartbeat
+        ``engine_stats["kv_spill"]`` channel (plane delta-anchors the
+        counters; breaker states are gauges)."""
+        out = dict(self.spill_io)
+        for tier, br in self.breakers.items():
+            out[f"breaker_{tier}_state"] = br.state_code
+            out[f"breaker_{tier}_trips"] = br.trips
+        return out
+
     def store_spilled(self, key: str, page: np.ndarray,
                       scale_page: Optional[np.ndarray] = None) -> None:
         """Engine callback with the evicted page bytes: L2 host store plus
@@ -451,11 +586,26 @@ class PagedKVCacheManager:
         ``scale_page`` (int8 pools, [L, 2, Bk, D] bf16): packed WITH the
         page as one atomic entry per block in both tiers — a page without
         its scale is garbage, the pair costs one LRU slot, and there is no
-        orphaned-scale state."""
-        if self.host_store is not None:
-            self.host_store.put(key, (page, scale_page))
-        if self.remote_store is not None:
-            self.remote_store.put(key, _pack_spill(page, scale_page))
+        orphaned-scale state.
+
+        Tier writes are ISOLATED: a raising put is counted and skipped —
+        losing a spill is a future miss, never a failed eviction (and
+        never a failed request). A tier failing repeatedly trips its
+        breaker and is skipped wholesale until a half-open probe heals."""
+        if self.host_store is not None and self._tier_allow("host"):
+            try:
+                self.host_store.put(key, (page, scale_page))
+            except Exception:  # noqa: BLE001 — optional tier, never fatal
+                self._tier_result("host", False, "put")
+            else:
+                self._tier_result("host", True, "put")
+        if self.remote_store is not None and self._tier_allow("remote"):
+            try:
+                self.remote_store.put(key, _pack_spill(page, scale_page))
+            except Exception:  # noqa: BLE001 — optional tier, never fatal
+                self._tier_result("remote", False, "put")
+            else:
+                self._tier_result("remote", True, "put")
 
     def _spill_entry_valid(self, page: np.ndarray,
                            scale: Optional[np.ndarray]) -> bool:
@@ -475,10 +625,22 @@ class PagedKVCacheManager:
         """Probe the tiers for a spilled block → (page, scale_page | None),
         or None on miss. An L3 hit is promoted to L2 (reference
         promote-on-hit :447-462) — but only AFTER validation, so a
-        known-rejected entry never pollutes the bounded L2. A corrupt L3
-        entry likewise degrades to a miss."""
-        if self.host_store is not None:
-            entry = self.host_store.get(key)
+        known-rejected entry never pollutes the bounded L2.
+
+        Failure semantics (round 19): a RAISING tier get is counted,
+        charged to the tier's breaker, and falls through to the next tier;
+        a corrupt L3 entry (CRC mismatch / torn frame) is QUARANTINED
+        (deleted + counted) and degrades to a miss; a failing promote put
+        never discards the successfully fetched page. Nothing in here can
+        fail the request that probed."""
+        if self.host_store is not None and self._tier_allow("host"):
+            entry: Any = None
+            try:
+                entry = self.host_store.get(key)
+            except Exception:  # noqa: BLE001 — fall through to L3
+                self._tier_result("host", False, "get")
+            else:
+                self._tier_result("host", True, "get")
             if entry is not None:
                 page, scale = (
                     entry if isinstance(entry, tuple) else (entry, None)
@@ -487,17 +649,31 @@ class PagedKVCacheManager:
                     self.stats.l2_hits += 1
                     return page, scale
                 return None
-        if self.remote_store is not None:
-            raw = self.remote_store.get(key)
+        if self.remote_store is not None and self._tier_allow("remote"):
+            raw = None
+            try:
+                raw = self.remote_store.get(key)
+            except Exception:  # noqa: BLE001 — degraded tier = miss
+                self._tier_result("remote", False, "get")
+            else:
+                self._tier_result("remote", True, "get")
             if raw is not None:
                 try:
                     page, scale = _unpack_spill(raw)
                 except Exception:
-                    return None     # corrupt entry = miss, not a crash
+                    # corrupt entry: quarantine so the NEXT probe doesn't
+                    # pay the deserialize-and-fail tax again, then miss
+                    self._quarantine("remote", key, "corrupt")
+                    return None
                 if self._spill_entry_valid(page, scale):
                     self.stats.l3_hits += 1
                     if self.host_store is not None:
-                        self.host_store.put(key, (page, scale))
+                        # promote-on-hit is advisory: a failing host put
+                        # must NOT discard the page we already fetched
+                        try:
+                            self.host_store.put(key, (page, scale))
+                        except Exception:  # noqa: BLE001
+                            self._tier_result("host", False, "put")
                     return page, scale
         return None
 

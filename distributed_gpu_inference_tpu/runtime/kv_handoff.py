@@ -1241,6 +1241,13 @@ class HandoffReceiver:
 
     def _piece(self, meta: Dict[str, Any], payload: bytes,
                raw_len: int) -> Dict[str, Any]:
+        # io chaos seam (round 19): receiver-side STAGING faults — a torn
+        # or corrupted staging buffer (io_bytes mutates payload, error
+        # kinds raise) rides the existing corrupt-piece contract above:
+        # handle() aborts the session and the sender's retry ladder runs
+        payload = _faults.io_bytes(
+            "io.handoff.stage", payload, key=str(meta.get("key", ""))
+        )
         sess = self._require(meta["key"])
         sess.last_activity = time.monotonic()
         if meta.get("has_scales"):

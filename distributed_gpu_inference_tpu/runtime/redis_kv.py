@@ -38,6 +38,8 @@ import time
 from typing import List, Optional, Tuple
 from urllib.parse import urlparse
 
+from distributed_gpu_inference_tpu.testing import faults as _faults
+
 
 class RESPError(Exception):
     """Server-reported RESP error reply."""
@@ -155,11 +157,14 @@ class RedisKVStore:
         self._down_until = 0.0
         self.stats = {"gets": 0, "hits": 0, "puts": 0, "dropped": 0,
                       "errors": 0, "slow_trips": 0}
-        # async writeback: bounded queue + daemon writer (its own conn)
-        self._q: "queue.Queue[Tuple[str, bytes]]" = queue.Queue(
+        # async writeback: bounded queue + daemon writer (its own conn);
+        # (key, None) is a delete tombstone (quarantine of a corrupt entry)
+        self._q: "queue.Queue[Tuple[str, Optional[bytes]]]" = queue.Queue(
             maxsize=writeback_queue
         )
         self._stop = threading.Event()
+        self._inflight = 0                     # dequeued, not yet durable
+        self._wconn: Optional[_Conn] = None    # the writer's connection
         self._writer = threading.Thread(
             target=self._writeback_loop, name="redis-kv-writeback", daemon=True
         )
@@ -212,6 +217,9 @@ class RedisKVStore:
                 return None
             t0 = time.monotonic()
             try:
+                # chaos seam INSIDE the guarded block: an injected io_error /
+                # io_slow rides the exact fail-open path a real outage takes
+                _faults.io_fault("io.spill.redis.get", key=key)
                 conn.sock.settimeout(self.probe_timeout_s)
                 data = conn.command(b"GET", self._key(key))
             except socket.timeout:
@@ -251,17 +259,41 @@ class RedisKVStore:
                 except queue.Empty:
                     pass
 
+    def delete(self, key: str) -> None:
+        """Best-effort async delete (quarantine of a corrupt/poisoned
+        entry): rides the writeback queue as a ``(key, None)`` tombstone so
+        it serializes after any pending put of the same key."""
+        while True:
+            try:
+                self._q.put_nowait((key, None))
+                return
+            except queue.Full:
+                try:
+                    self._q.get_nowait()
+                    self.stats["dropped"] += 1
+                except queue.Empty:
+                    pass
+
     # ------------------------------------------------------------ writer
 
     def _writeback_loop(self) -> None:
-        conn: Optional[_Conn] = None
         px = str(int(self.ttl_s * 1000)).encode()
         while not self._stop.is_set():
             try:
                 key, data = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
-            while not self._stop.is_set():
+            # the dequeued item is invisible to the queue but not yet
+            # durable — flush() must count it until the SET/DEL lands,
+            # or a stuck writer reads as drained
+            self._inflight = 1
+            self._write_one(key, data, px)
+            self._inflight = 0
+
+    def _write_one(self, key: str, data: Optional[bytes],
+                   px: bytes) -> None:
+        conn = self._wconn
+        while not self._stop.is_set():
                 if conn is None:
                     try:
                         conn = self._factory()
@@ -271,8 +303,13 @@ class RedisKVStore:
                             return
                         continue
                 try:
-                    conn.command(b"SET", self._key(key), data, b"PX", px)
-                    break
+                    _faults.io_fault("io.spill.redis.put", key=key)
+                    if data is None:
+                        conn.command(b"DEL", self._key(key))
+                    else:
+                        conn.command(b"SET", self._key(key), data, b"PX", px)
+                    self._wconn = conn
+                    return
                 except (OSError, ConnectionError, RESPError):
                     # server-side rejections (MISCONF/OOM/READONLY) must
                     # back off like connect failures — a tight
@@ -280,22 +317,28 @@ class RedisKVStore:
                     self.stats["errors"] += 1
                     conn.close()
                     conn = None
+                    self._wconn = None
                     if self._stop.wait(self._backoff):
                         return
 
     def flush(self, timeout_s: float = 5.0) -> bool:
-        """Drain pending writebacks (tests, graceful shutdown)."""
+        """Drain pending writebacks (tests, graceful shutdown). Counts the
+        dequeued-but-not-yet-durable item too: a writer stuck in its
+        reconnect loop reports False at the deadline instead of reading
+        as drained."""
         deadline = time.monotonic() + timeout_s
-        while not self._q.empty():
+        while not self._q.empty() or self._inflight:
             if time.monotonic() > deadline:
                 return False
             time.sleep(0.01)
-        time.sleep(0.05)  # let the in-flight SET finish
         return True
 
     def close(self) -> None:
         self._stop.set()
         self._writer.join(timeout=2.0)
+        if self._wconn is not None:
+            self._wconn.close()
+            self._wconn = None
         with self._lock:
             if self._conn is not None:
                 self._conn.close()

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import sqlite3
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -239,16 +240,35 @@ def _flight_note(st: ServerState, trace_id: Optional[str], event: str,
 
 
 def _json_error(status: int, detail: str,
-                retry_after_s: Optional[float] = None) -> web.Response:
+                retry_after_s: Optional[float] = None,
+                error_code: Optional[str] = None) -> web.Response:
     """JSON error body; capacity-style rejections (429/503) carry a
     machine-readable ``retry_after_s`` in the body AND the standard
-    ``Retry-After`` header, so the SDK has ONE retry contract for both."""
+    ``Retry-After`` header, so the SDK has ONE retry contract for both.
+    ``error_code`` names the degradation class (``store_unavailable``)
+    so clients can distinguish a browned-out durable tier from plain
+    capacity without parsing the human-readable detail."""
     body: Dict[str, Any] = {"detail": detail}
     headers = None
+    if error_code is not None:
+        body["error_code"] = error_code
     if retry_after_s is not None:
         body["retry_after_s"] = round(float(retry_after_s), 3)
         headers = {"Retry-After": str(max(1, int(-(-retry_after_s // 1))))}
     return web.json_response(body, status=status, headers=headers)
+
+
+def _store_unavailable(st: "ServerState", exc: Exception) -> web.Response:
+    """Typed degraded-mode rejection for a failed store WRITE (round 19):
+    a wedged/full backing store must bounce submissions with a retryable
+    503 + ``error_code="store_unavailable"`` — not an opaque 500 — while
+    read paths keep serving from the intact database. Flags the
+    ``store_degraded`` gauge; the next successful write clears it."""
+    st.metrics.record_store_degraded(True)
+    return _json_error(
+        503, f"job store unavailable: {exc}",
+        retry_after_s=2.0, error_code="store_unavailable",
+    )
 
 
 async def _submit_backpressure(st: ServerState) -> Optional[web.Response]:
@@ -705,6 +725,12 @@ async def heartbeat(request: web.Request) -> web.Response:
         kvmig = es.get("kv_migrate")
         if isinstance(kvmig, dict):
             st.metrics.record_kv_migrate_engine(worker_id, kvmig)
+        # spill-tier IO health (round 19): put/get errors, corrupt-entry
+        # quarantines, breaker states → kv_spill_errors_total{tier} /
+        # spill_quarantined_total{tier,reason} / io_breaker_state{tier}
+        kvspill = es.get("kv_spill")
+        if isinstance(kvspill, dict):
+            st.metrics.record_kv_spill_engine(worker_id, kvspill)
         # direct-serving channel (round 18): cancelled hedge losers →
         # hedges_total{outcome=cancelled}; the latency samples riding
         # the same payload feed the HealthService below
@@ -1077,12 +1103,21 @@ async def checkpoint_stream(request: web.Request) -> web.Response:
     st = _state(request)
     body = await request.json()
     epoch = int(body.get("epoch") or 0)
-    if body.get("done"):
-        await st.store.delete_stream_checkpoint(stream_id, worker_id, epoch)
-        return web.json_response({"ok": True, "deleted": True})
-    ok = await st.store.save_stream_checkpoint(
-        stream_id, worker_id, epoch, body.get("state")
-    )
+    try:
+        if body.get("done"):
+            await st.store.delete_stream_checkpoint(
+                stream_id, worker_id, epoch
+            )
+            return web.json_response({"ok": True, "deleted": True})
+        ok = await st.store.save_stream_checkpoint(
+            stream_id, worker_id, epoch, body.get("state")
+        )
+    except sqlite3.OperationalError as exc:
+        # a dark store costs checkpoint STALENESS, never an opaque 500:
+        # the worker's pusher treats any failure as a skipped push and
+        # the next cadence retries (typed so it shows up in SDK traces)
+        return _store_unavailable(st, exc)
+    st.metrics.record_store_degraded(False)
     if not ok:
         st.metrics.record_checkpoint_rejected("stale_epoch")
         return _json_error(
@@ -1300,8 +1335,12 @@ async def create_job(request: web.Request) -> web.Response:
         # service places prefill/decode and enqueues the pinned stage jobs
         row["status"] = JobStatus.RUNNING.value
         row["started_at"] = time.time()
-        with st.tracing.span("job.submit", trace_id=trace_id, pd=True):
-            job_id = await st.store.create_job(row)
+        try:
+            with st.tracing.span("job.submit", trace_id=trace_id, pd=True):
+                job_id = await st.store.create_job(row)
+        except sqlite3.OperationalError as exc:
+            return _store_unavailable(st, exc)
+        st.metrics.record_store_degraded(False)
         st.bp_cache_clear()
         _flight_note(st, trace_id, "server.submitted", job_id=job_id,
                      pd=True)
@@ -1328,8 +1367,12 @@ async def create_job(request: web.Request) -> web.Response:
         return web.json_response(
             {"job_id": job_id, "status": "running", "pd": True}, status=201
         )
-    with st.tracing.span("job.submit", trace_id=trace_id):
-        job_id = await st.store.create_job(row)
+    try:
+        with st.tracing.span("job.submit", trace_id=trace_id):
+            job_id = await st.store.create_job(row)
+    except sqlite3.OperationalError as exc:
+        return _store_unavailable(st, exc)
+    st.metrics.record_store_degraded(False)
     st.bp_cache_clear()
     _flight_note(st, trace_id, "server.submitted", job_id=job_id)
     st.metrics.record_request(row["type"], "queued")
@@ -1368,8 +1411,12 @@ async def create_job_sync(request: web.Request) -> web.Response:
     _log_submission(st, trace_id, body, sync=True)
     row = await _make_job_row(request, body)
     row["priority"] = row["priority"] + 10
-    with st.tracing.span("job.submit", trace_id=trace_id, sync=True):
-        job_id = await st.store.create_job(row)
+    try:
+        with st.tracing.span("job.submit", trace_id=trace_id, sync=True):
+            job_id = await st.store.create_job(row)
+    except sqlite3.OperationalError as exc:
+        return _store_unavailable(st, exc)
+    st.metrics.record_store_degraded(False)
     st.bp_cache_clear()
     _flight_note(st, trace_id, "server.submitted", job_id=job_id,
                  sync=True)
@@ -2280,9 +2327,24 @@ async def metrics_endpoint(request: web.Request) -> web.Response:
 # ---------------------------------------------------------------------------
 
 
+@web.middleware
+async def _store_degraded_middleware(request: web.Request, handler):
+    """Backstop for the store-write seams the handlers don't wrap
+    individually (heartbeat's update_worker, completion/release/claim
+    transitions): a failed durable write surfaces as the SAME typed
+    retryable 503 the submission path speaks — never a raw 500 stack
+    trace. sqlite3.OperationalError is precisely the store-failure class
+    (full disk, wedged file, injected chaos), so nothing else is
+    masked."""
+    try:
+        return await handler(request)
+    except sqlite3.OperationalError as exc:
+        return _store_unavailable(_state(request), exc)
+
+
 def create_app(state: Optional[ServerState] = None,
                start_background: bool = True) -> web.Application:
-    app = web.Application()
+    app = web.Application(middlewares=[_store_degraded_middleware])
     app["state"] = state or ServerState()
 
     app.router.add_post(f"{API}/workers/register", register_worker)

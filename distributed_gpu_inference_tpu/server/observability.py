@@ -115,6 +115,8 @@ class Metrics:
                 "autoscaler_slo", "autoscaler_cold_start",
                 "request_phase_latency", "flight_timelines",
                 "flight_events_dropped",
+                "kv_spill_errors", "spill_quarantined",
+                "io_breaker_state", "store_degraded",
             ):
                 setattr(self, name, noop)
             return
@@ -436,6 +438,29 @@ class Metrics:
             "flight_events_dropped_total",
             "Flight-recorder events dropped at the per-request cap",
             ["worker"], registry=r)
+        # durable tier under fire (round 19): spill-tier IO health per
+        # worker — a browned-out host/remote tier shows up as rising
+        # errors, tripped breakers (gauge 0=closed 1=half_open 2=open),
+        # and quarantined corrupt entries; store_degraded flips to 1 while
+        # the plane's own job store rejects writes (reads keep serving)
+        self.kv_spill_errors = Counter(
+            "kv_spill_errors_total",
+            "Spill-tier put/get failures absorbed by the KV manager",
+            ["worker", "tier", "op"], registry=r)
+        self.spill_quarantined = Counter(
+            "spill_quarantined_total",
+            "Spilled/persisted entries quarantined instead of served",
+            ["worker", "tier", "reason"], registry=r)
+        self.io_breaker_state = Gauge(
+            "io_breaker_state",
+            "Per-tier spill circuit breaker state "
+            "(0=closed, 1=half_open, 2=open)",
+            ["worker", "tier"], registry=r)
+        self.store_degraded = Gauge(
+            "store_degraded",
+            "1 while the plane's job store is rejecting writes "
+            "(submissions bounce with error_code=store_unavailable)",
+            registry=r)
 
     def render(self) -> bytes:
         if not HAVE_PROMETHEUS or self.registry is None:
@@ -462,6 +487,7 @@ class MetricsCollector:
         self._batcher_prev: Dict[str, Dict[str, int]] = {}
         self._pd_prev: Dict[str, Dict[str, int]] = {}
         self._kvmig_prev: Dict[str, Dict[str, int]] = {}
+        self._kvspill_prev: Dict[str, Dict[str, int]] = {}
         self._flight_prev: Dict[str, Dict[str, int]] = {}
         self._direct_prev: Dict[str, Dict[str, int]] = {}
         # bounded tenant-label admission (insertion-ordered dict as LRU):
@@ -723,6 +749,59 @@ class MetricsCollector:
                     worker, direction
                 ).inc(delta)
             prev[key] = cur
+
+    def record_kv_spill_engine(self, worker: str,
+                               stats: Dict[str, Any]) -> None:
+        """Ingest one worker's spill-tier IO health counters (heartbeat
+        ``engine_stats["kv_spill"]`` — ``TPULLMEngine.
+        kv_spill_wire_stats()``): per-tier put/get failures into
+        ``kv_spill_errors_total{tier,op}``, corrupt-entry quarantines (and
+        refused corrupt checkpoints) into
+        ``spill_quarantined_total{tier,reason}``, breaker states straight
+        onto the ``io_breaker_state{tier}`` gauge. Same delta anchoring as
+        the spec/pressure/pd/kv-migrate payloads: totals re-anchor on
+        engine restart, malformed fields skip the sample."""
+        prev = self._kvspill_prev.setdefault(worker, {})
+
+        def _delta(key: str) -> int:
+            try:
+                cur = int(stats.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                return 0
+            d = cur - prev.get(key, 0)
+            prev[key] = cur
+            return max(0, d)
+
+        for tier in ("host", "remote"):
+            for op in ("put", "get"):
+                d = _delta(f"{tier}_{op}_errors")
+                if d:
+                    self.metrics.kv_spill_errors.labels(
+                        worker, tier, op
+                    ).inc(d)
+            d = _delta(f"{tier}_quarantined_corrupt")
+            if d:
+                self.metrics.spill_quarantined.labels(
+                    worker, tier, "corrupt"
+                ).inc(d)
+            if f"breaker_{tier}_state" in stats:
+                try:
+                    self.metrics.io_breaker_state.labels(worker, tier).set(
+                        int(stats[f"breaker_{tier}_state"])
+                    )
+                except (TypeError, ValueError):
+                    pass
+        d = _delta("ckpt_corrupt")
+        if d:
+            self.metrics.spill_quarantined.labels(
+                worker, "checkpoint", "corrupt"
+            ).inc(d)
+
+    def record_store_degraded(self, degraded: bool) -> None:
+        """Flip the ``store_degraded`` gauge: 1 while the plane's own job
+        store rejects writes (submissions bounce typed-503), back to 0 on
+        the next write that lands."""
+        self.metrics.store_degraded.set(1 if degraded else 0)
 
     def record_phase(self, phase: str, seconds: float) -> None:
         """One derived flight-recorder phase duration → the

@@ -790,6 +790,12 @@ class Store:
         epoch. A zombie worker (whose stream was adopted by a failover peer,
         bumping the epoch) is rejected — its stale state must never clobber
         the live continuation. Returns True when the write landed."""
+        # chaos seam (round 19): a checkpoint write through a dark/slow
+        # store raises OperationalError or is silently lost — the pushers
+        # upstream already tolerate both (staleness, not failure)
+        if _faults.store_fault("server.store.checkpoint",
+                               stream_id=stream_id):
+            return False
 
         def txn() -> bool:
             self._conn.execute("BEGIN IMMEDIATE")

@@ -30,6 +30,9 @@ delay      sleep ``delay_s`` then proceed.
 error      HTTP: synthesize a ``status`` response without calling.
            Store: raise ``sqlite3.OperationalError``.
 truncate   byte seams: keep only the first ``cut`` bytes.
+corrupt    byte seams (IO reads): flip one byte mid-payload — the
+           bit-rot a checksum exists to catch (truncation is caught
+           by length framing; corruption needs the CRC).
 duplicate  HTTP: perform the call twice, return the second
            response. Stream filter: deliver the message twice.
 flap       unconditional drop for the next ``times`` hits — a
@@ -65,7 +68,7 @@ from typing import (
 
 _KINDS = {
     "drop", "delay", "error", "truncate", "duplicate", "flap", "reorder",
-    "pressure",
+    "pressure", "corrupt",
 }
 
 
@@ -448,9 +451,24 @@ PLANE_EVENT_KINDS = ("plane_kill", "plane_partition", "plane_slow")
 # process stays up. Kept OUT of FLEET_EVENT_KINDS so historical seeds
 # keep regenerating their exact schedules.
 GRAY_EVENT_KINDS = ("degrade", "jitter", "flaky")
+
+# durable-tier kinds (round 19 — IO-fault immunity): storms on the bytes
+# we PERSIST rather than the processes/links that move them. ``disk_full``
+# fails every durable write fleet-wide (store mutations, spill puts,
+# checkpoint saves, file writes) while reads keep serving; ``io_error``
+# fails spill-tier/checkpoint IO probabilistically in BOTH directions;
+# ``io_slow`` taxes every spill/checkpoint op with injected latency (the
+# browning-out device the per-tier breaker exists to fence); ``corrupt_
+# read`` flips bytes in spill entries read back (the entry CRC must catch
+# it and quarantine, never poison a request); ``torn_write`` persists only
+# a prefix of written spill entries (detected at read time the same way).
+# Kept OUT of FLEET_EVENT_KINDS so historical seeds keep regenerating
+# their exact schedules.
+IO_CHAOS_KINDS = ("disk_full", "io_error", "io_slow", "corrupt_read",
+                  "torn_write")
 ALL_FLEET_EVENT_KINDS = (
     FLEET_EVENT_KINDS + HANDOFF_EVENT_KINDS + PLANE_EVENT_KINDS
-    + GRAY_EVENT_KINDS
+    + GRAY_EVENT_KINDS + IO_CHAOS_KINDS
 )
 
 # the canonical suite/CLI geometry: ``--replay`` must reconstruct the EXACT
@@ -478,6 +496,13 @@ PLANE_CHAOS_KINDS = PLANE_EVENT_KINDS + ("kill",)
 # reconstructs these schedules
 GRAY_CHAOS_WORKERS = 3
 GRAY_CHAOS_KINDS = GRAY_EVENT_KINDS + ("kill",)
+
+# io-chaos suite geometry (tests/test_io_chaos.py): 2 workers with spill
+# tiers + per-token checkpoints enabled, every io kind composed with clean
+# kills so a crash can land right after a window of failed/torn/corrupt
+# durable writes — ``--replay SEED --io`` reconstructs these schedules
+IO_CHAOS_WORKERS = 2
+IO_CHAOS_SUITE_KINDS = IO_CHAOS_KINDS + ("kill",)
 
 
 @dataclass(frozen=True)
@@ -526,6 +551,19 @@ class FleetEvent:
     flaky      probabilistic 5xx: the replica's direct requests answer
                HTTP 500 at ``prob`` for ``duration_s`` while the
                process (and its heartbeats) stay up
+    disk_full  fleet-wide: every durable WRITE fails for ``duration_s``
+               (store INSERT/UPDATE, spill puts, checkpoint saves, file
+               writes raise like a full disk) while reads keep serving
+    io_error   fleet-wide: spill-tier and checkpoint IO fails at
+               ``prob`` in both directions for ``duration_s``
+    io_slow    fleet-wide: every spill/checkpoint op pays ``delay_s``
+               for ``duration_s`` — the browning-out device the
+               per-tier breaker fences off the serving path
+    corrupt_read  spill entries read back bit-flipped at ``prob`` for
+               ``duration_s`` — the entry CRC quarantines, serving
+               falls back to the next tier or recompute
+    torn_write    spill writes persist only a prefix at ``prob`` for
+               ``duration_s`` — detected by the CRC at read time
     =========  ==========================================================
     """
 
@@ -662,6 +700,32 @@ class FleetFaultPlan:
                     duration_s=round(dur, 3),
                     prob=0.25 + 0.5 * rng.random(),
                 ))
+            elif kind == "disk_full":
+                # a full disk fails EVERY write until space frees — no
+                # probability draw, so historical rng sequences without
+                # io kinds are untouched by construction
+                events.append(FleetEvent(
+                    round(cursor, 3), "disk_full", -1,
+                    duration_s=round(dur, 3),
+                ))
+            elif kind == "io_error":
+                events.append(FleetEvent(
+                    round(cursor, 3), "io_error", -1,
+                    duration_s=round(dur, 3),
+                    prob=0.5 + 0.5 * rng.random(),
+                ))
+            elif kind == "io_slow":
+                events.append(FleetEvent(
+                    round(cursor, 3), "io_slow", -1,
+                    duration_s=round(dur, 3),
+                    delay_s=round(0.02 + 0.08 * rng.random(), 3),
+                ))
+            elif kind in ("corrupt_read", "torn_write"):
+                events.append(FleetEvent(
+                    round(cursor, 3), kind, -1,
+                    duration_s=round(dur, 3),
+                    prob=0.25 + 0.5 * rng.random(),
+                ))
             else:  # blackout / partition / handoff_partition / plane_partition
                 events.append(FleetEvent(
                     round(cursor, 3), kind, worker,
@@ -692,10 +756,11 @@ class FleetFaultPlan:
             if e.duration_s:
                 extra += f" for {e.duration_s}s"
             if e.kind in ("pressure", "handoff_corrupt", "jitter",
-                          "flaky"):
+                          "flaky", "io_error", "corrupt_read",
+                          "torn_write"):
                 extra += f" prob={e.prob:.2f}"
             if e.kind in ("slow", "handoff_delay", "plane_slow",
-                          "degrade", "jitter"):
+                          "degrade", "jitter", "io_slow"):
                 extra += f" delay={e.delay_s}s"
             out.append(f"  t+{e.at_s:6.2f}s  {e.kind:<9} {tgt}{extra}")
         return out
@@ -719,6 +784,58 @@ def mutate_bytes(site: str, data: bytes, **ctx: Any) -> bytes:
         time.sleep(rule.delay_s)
         return data
     raise ValueError(f"rule kind {rule.kind!r} unsupported at byte seam")
+
+
+def io_fault(site: str, **ctx: Any) -> None:
+    """Durable-IO seam (host spill tier, store checkpoints, file writes):
+    injected backend failures surface as :class:`OSError` — exactly what a
+    full disk, a dying device, or a flaky mount raises — so callers
+    exercise their degraded paths (tier isolation, breakers, atomic-write
+    cleanup). ``delay`` models a browning-out device."""
+    plan = _ACTIVE
+    if plan is None:
+        return
+    rule = plan.fire(site, **ctx)
+    if rule is None:
+        return
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return
+    if rule.kind in ("drop", "flap", "error"):
+        raise OSError(f"fault injected: {rule.kind} at {site}")
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at io seam")
+
+
+def io_bytes(site: str, data: Optional[bytes],
+             **ctx: Any) -> Optional[bytes]:
+    """Byte-carrying durable-IO seam (remote spill tier): ``truncate``
+    models a TORN WRITE (only a prefix of the payload lands) or a
+    short read, ``corrupt`` flips one byte mid-payload (bit rot the
+    entry checksum must catch), ``error``/``drop``/``flap`` raise
+    :class:`OSError`. One ``fire`` per hit whatever is armed, so
+    first-match stays well-defined. ``data`` may be None (a read that
+    missed) — mutating kinds pass a miss through untouched."""
+    plan = _ACTIVE
+    if plan is None:
+        return data
+    rule = plan.fire(site, size=len(data) if data is not None else 0, **ctx)
+    if rule is None:
+        return data
+    if rule.kind == "delay":
+        time.sleep(rule.delay_s)
+        return data
+    if rule.kind in ("drop", "flap", "error"):
+        raise OSError(f"fault injected: {rule.kind} at {site}")
+    if data is None:
+        return None
+    if rule.kind == "truncate":
+        return data[: rule.cut]
+    if rule.kind == "corrupt":
+        if not data:
+            return data
+        i = len(data) // 2
+        return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+    raise ValueError(f"rule kind {rule.kind!r} unsupported at io seam")
 
 
 # ---------------------------------------------------------------------------
@@ -765,9 +882,14 @@ def _replay_main(argv: Optional[Sequence[str]] = None) -> int:
                     help="reconstruct a tests/test_gray_chaos.py seed: "
                     "the gray-failure suite's kinds (degrade/jitter/flaky "
                     "+ worker kill) and its 3-worker fleet geometry")
+    ap.add_argument("--io", action="store_true",
+                    help="reconstruct a tests/test_io_chaos.py seed: the "
+                    "durable-tier suite's kinds (disk_full/io_error/"
+                    "io_slow/corrupt_read/torn_write + worker kill) and "
+                    "its 2-worker fleet geometry")
     args = ap.parse_args(argv)
-    if sum(1 for f in (args.pd, args.planes, args.gray) if f) > 1:
-        ap.error("--pd, --planes and --gray are mutually exclusive")
+    if sum(1 for f in (args.pd, args.planes, args.gray, args.io) if f) > 1:
+        ap.error("--pd, --planes, --gray and --io are mutually exclusive")
     kinds = args.kinds
     if kinds is None:
         if args.pd:
@@ -776,6 +898,8 @@ def _replay_main(argv: Optional[Sequence[str]] = None) -> int:
             kinds = ",".join(PLANE_CHAOS_KINDS)
         elif args.gray:
             kinds = ",".join(GRAY_CHAOS_KINDS)
+        elif args.io:
+            kinds = ",".join(IO_CHAOS_SUITE_KINDS)
         else:
             kinds = ",".join(FLEET_EVENT_KINDS)
     workers = args.workers
@@ -786,6 +910,8 @@ def _replay_main(argv: Optional[Sequence[str]] = None) -> int:
             workers = PLANE_CHAOS_WORKERS
         elif args.gray:
             workers = GRAY_CHAOS_WORKERS
+        elif args.io:
+            workers = IO_CHAOS_WORKERS
         else:
             workers = FLEET_CHAOS_WORKERS
     plan = FleetFaultPlan(

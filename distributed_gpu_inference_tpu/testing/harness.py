@@ -876,6 +876,60 @@ class LiveFleet:
                                 delay_s=ev.delay_s, times=None)])
             armed = [fp.add_rule(r) for r in rules]
             return lambda: [fp.remove_rule(r) for r in armed]
+        if ev.kind == "disk_full":
+            # the durable tier fills up fleet-wide: every WRITE surface
+            # fails for the window — store mutations (sql-matched so
+            # reads keep serving and the typed-503 contract is what
+            # clients observe), checkpoint upserts, spill puts, file
+            # persists. Recovery is pure disarm: space "frees up".
+            armed = [fp.add_rule(FaultRule(site=s, kind="error",
+                                           times=None, **kw))
+                     for s, kw in (
+                         ("server.store.execute",
+                          {"match": {"sql": "INSERT*"}}),
+                         ("server.store.execute",
+                          {"match": {"sql": "UPDATE*"}}),
+                         ("server.store.checkpoint", {}),
+                         ("io.spill.*.put", {}),
+                         ("io.file.write", {}),
+                     )]
+            return lambda: [fp.remove_rule(r) for r in armed]
+        if ev.kind == "io_error":
+            # flaky device: spill-tier reads AND writes fail at ev.prob
+            # (both directions — the breaker sees consecutive failures),
+            # checkpoint writes too
+            armed = [fp.add_rule(FaultRule(
+                site=s, kind="error", prob=ev.prob, times=None,
+            )) for s in ("io.spill.*", "server.store.checkpoint")]
+            return lambda: [fp.remove_rule(r) for r in armed]
+        if ev.kind == "io_slow":
+            # browned-out device: every spill op pays ev.delay_s — the
+            # redis path converts sustained slowness into slow_trips +
+            # backoff, the rest just rides it out (worker-side seams
+            # only: no event-loop stalls on the plane)
+            rule = fp.add_rule(FaultRule(
+                site="io.spill.*", kind="delay", delay_s=ev.delay_s,
+                times=None,
+            ))
+            return lambda: fp.remove_rule(rule)
+        if ev.kind == "corrupt_read":
+            # bit rot: spilled frames and handoff staging buffers read
+            # back flipped at ev.prob — the CRC catches the spill frames
+            # (quarantine + next tier / recompute), the piece contract
+            # catches the staging buffers
+            armed = [fp.add_rule(FaultRule(
+                site=s, kind="corrupt", prob=ev.prob, times=None,
+            )) for s in ("io.spill.remote.get", "io.handoff.stage")]
+            return lambda: [fp.remove_rule(r) for r in armed]
+        if ev.kind == "torn_write":
+            # power-loss torn writes: spilled frames persist only a
+            # prefix at ev.prob — detected at READ time by the frame CRC
+            # (or the torn-header check), quarantined, never served
+            rule = fp.add_rule(FaultRule(
+                site="io.spill.remote.put", kind="truncate", cut=32,
+                prob=ev.prob, times=None,
+            ))
+            return lambda: fp.remove_rule(rule)
         if ev.kind == "plane_kill":
             # ev.worker indexes the PLANE cohort for plane events
             self.planes[ev.worker].kill()

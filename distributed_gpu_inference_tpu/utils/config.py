@@ -316,11 +316,19 @@ def load_worker_config(
 
 def save_worker_config(cfg: WorkerConfig, yaml_path: str | Path) -> None:
     """Persist config (the worker writes issued credentials back after
-    registration — reference main.py:133-136)."""
+    registration — reference main.py:133-136). Atomic temp+fsync+rename:
+    this file carries ISSUED CREDENTIALS — a crash or disk-full torn write
+    mid-save must leave the previous config intact, never a truncated one
+    that locks the worker out on restart (round 19)."""
+    from distributed_gpu_inference_tpu.runtime.io_guard import (
+        atomic_write_text,
+    )
+
     path = Path(yaml_path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with open(path, "w") as f:
-        yaml.safe_dump(cfg.model_dump(mode="json"), f, sort_keys=False)
+    atomic_write_text(
+        path, yaml.safe_dump(cfg.model_dump(mode="json"), sort_keys=False)
+    )
 
 
 def set_dotted(cfg: WorkerConfig, dotted_key: str, value: Any) -> WorkerConfig:

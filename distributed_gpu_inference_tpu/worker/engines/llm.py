@@ -351,6 +351,10 @@ class TPULLMEngine(LLMBaseEngine):
         # admission and every checkpoint_interval_tokens afterwards
         self.checkpoint_sink = None
         self._ckpt_pusher: Optional[_CheckpointPusher] = None
+        # corrupt server-held checkpoints refused at resume (bad crc /
+        # unparseable row): each one degrades to a from-scratch recompute —
+        # counted here, ships via kv_spill_wire_stats (round 19)
+        self.ckpt_corrupt = 0
         self._ckpt_interval = int(
             self.config.get("checkpoint_interval_tokens", 8) or 0
         )
@@ -1679,6 +1683,46 @@ class TPULLMEngine(LLMBaseEngine):
                 out["prefix_commits"] = v
         return out or None
 
+    def kv_spill_wire_stats(self) -> Optional[Dict[str, int]]:
+        """Cumulative spill-tier IO health counters (put/get errors,
+        corrupt-entry quarantines, breaker states/trips) plus refused
+        corrupt checkpoints — heartbeat ``engine_stats["kv_spill"]``,
+        delta-anchored into ``kv_spill_errors_total{tier}`` /
+        ``spill_quarantined_total{tier,reason}`` / ``io_breaker_state``
+        on the control plane. None when every counter is zero and all
+        breakers are closed (no payload bloat)."""
+        eng = self.engine
+        mgr = getattr(eng, "manager", None) if eng is not None else None
+        out: Dict[str, int] = {}
+        if mgr is not None:
+            ws = mgr.spill_wire_stats()
+            out.update({k: int(v) for k, v in ws.items() if v})
+            if out:
+                # once anything has fired, ship breaker states INCLUDING
+                # zeros: a recovered breaker must drive the plane's
+                # io_breaker_state gauge back to healthy, not freeze it
+                # at its sickest reading
+                out.update({k: int(v) for k, v in ws.items()
+                            if k.endswith("_state")})
+        if self.ckpt_corrupt:
+            out["ckpt_corrupt"] = int(self.ckpt_corrupt)
+        return out or None
+
+    def _ckpt_from_wire(self, ckpt: Any) -> Optional[PreemptedSequence]:
+        """Parse a claim's server-held checkpoint, degrading CORRUPTION to
+        a fresh recompute: a torn/bit-flipped store row (bad crc, missing
+        fields, wrong version) returns None — the driver falls through to
+        its from-scratch path — instead of failing the whole resumed job.
+        Mirrors the spill-tier quarantine contract: persisted state is an
+        optimization, never a single point of failure."""
+        if not isinstance(ckpt, dict):
+            return None
+        try:
+            return PreemptedSequence.from_wire(ckpt)
+        except Exception:  # noqa: BLE001 — ValueError + anything torn JSON does
+            self.ckpt_corrupt += 1
+            return None
+
     # -- request flight recorder (round 14) ---------------------------------
 
     def _flight_timeline(self, params: Dict[str, Any]) -> Any:
@@ -1878,8 +1922,8 @@ class TPULLMEngine(LLMBaseEngine):
             # from scratch, exactly the pre-failover contract.
             return super().inference(params)
         t0 = time.perf_counter()
-        if isinstance(ckpt, dict):
-            pre = PreemptedSequence.from_wire(ckpt)
+        pre = self._ckpt_from_wire(ckpt)
+        if pre is not None:
             remaining = (pre.request.sampling.max_new_tokens
                          - len(pre.generated))
             if remaining <= 0:
@@ -1943,9 +1987,8 @@ class TPULLMEngine(LLMBaseEngine):
         t0 = time.perf_counter()
         tl = params.pop("_flight_tl", NULL_TIMELINE)
         tl.note("worker.start", path="job_serving")
-        pre: Optional[PreemptedSequence] = None
-        if isinstance(ckpt, dict):
-            pre = PreemptedSequence.from_wire(ckpt)
+        pre = self._ckpt_from_wire(ckpt)
+        if pre is not None:
             remaining = (pre.request.sampling.max_new_tokens
                          - len(pre.generated))
             tl.note("worker.resume_from_checkpoint",
@@ -2209,9 +2252,8 @@ class TPULLMEngine(LLMBaseEngine):
 
         holdback = max((len(s) for s in cfg.stop), default=0)
         holdback = max(holdback - 1, 0)
-        pre: Optional[PreemptedSequence] = None
-        if isinstance(ckpt, dict):
-            pre = PreemptedSequence.from_wire(ckpt)
+        pre = self._ckpt_from_wire(ckpt)
+        if pre is not None:
             remaining = (pre.request.sampling.max_new_tokens
                          - len(pre.generated))
             if remaining <= 0:
@@ -2384,8 +2426,8 @@ class TPULLMEngine(LLMBaseEngine):
 
         holdback = max((len(s) for s in cfg.stop), default=0)
         holdback = max(holdback - 1, 0)
-        if isinstance(ckpt, dict):
-            pre = PreemptedSequence.from_wire(ckpt)
+        pre = self._ckpt_from_wire(ckpt)
+        if pre is not None:
             remaining = (pre.request.sampling.max_new_tokens
                          - len(pre.generated))
             if remaining <= 0:

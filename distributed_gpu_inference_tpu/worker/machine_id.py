@@ -16,6 +16,8 @@ import uuid
 from pathlib import Path
 from typing import Dict, Optional
 
+from distributed_gpu_inference_tpu.runtime.io_guard import atomic_write_text
+
 DEFAULT_STATE_DIR = "~/.dgi_tpu"
 
 
@@ -74,7 +76,10 @@ class MachineFingerprint:
     def save(self, fingerprint: str) -> None:
         self._dir.mkdir(parents=True, exist_ok=True)
         payload = {"fingerprint": fingerprint, "components": self.components()}
-        self._file.write_text(json.dumps(payload, indent=2))
+        # atomic temp+fsync+rename: a crash mid-save must leave the OLD
+        # fingerprint readable — a torn file would mint a new identity and
+        # orphan this worker's server-side state (round 19)
+        atomic_write_text(self._file, json.dumps(payload, indent=2))
 
     def get_or_create(self) -> str:
         """Persisted fingerprint wins (stable across hardware tweaks)."""

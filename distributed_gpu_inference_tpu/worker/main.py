@@ -433,6 +433,31 @@ class Worker:
                 out[k] = out.get(k, 0) + int(v)
         return out or None
 
+    def _kv_spill_engine_stats(self) -> Optional[Dict[str, Any]]:
+        """Spill-tier IO health of every loaded engine (put/get errors,
+        corrupt-entry quarantines, breaker states/trips, refused corrupt
+        checkpoints) — nested under heartbeat ``engine_stats["kv_spill"]``
+        so the control plane's ``/metrics`` surfaces
+        ``kv_spill_errors_total{tier}``, ``spill_quarantined_total`` and
+        ``io_breaker_state{tier}`` per worker. None while every counter is
+        zero and all breakers are closed (payload stays lean)."""
+        out: Dict[str, int] = {}
+        for eng in self.engines.values():
+            fn = getattr(eng, "kv_spill_wire_stats", None)
+            if fn is None:
+                continue
+            try:
+                s = fn()
+            except Exception:  # noqa: BLE001 — never break the heartbeat
+                continue
+            for k, v in (s or {}).items():
+                if k.endswith("_state"):
+                    # breaker state is a gauge: report the sickest engine
+                    out[k] = max(out.get(k, 0), int(v))
+                else:
+                    out[k] = out.get(k, 0) + int(v)
+        return out or None
+
     def _batcher_stats(self) -> Optional[Dict[str, Any]]:
         """Live batcher serving stats of every batcher-backed engine
         (occupancy, queue depth, chunked admissions, preemption counters)
@@ -562,6 +587,9 @@ class Worker:
             pd_stats = self._pd_engine_stats()
             if pd_stats:
                 engine_stats["pd"] = pd_stats
+            kv_spill_stats = self._kv_spill_engine_stats()
+            if kv_spill_stats:
+                engine_stats["kv_spill"] = kv_spill_stats
             kvmig_stats = self._kv_migrate_engine_stats()
             if kvmig_stats:
                 engine_stats["kv_migrate"] = kvmig_stats
@@ -844,6 +872,34 @@ class Worker:
 
     # -- job processing (reference main.py:335-402) --------------------------
 
+    def _report_completion(self, job_id: str, success: bool,
+                           result: Optional[Dict[str, Any]] = None,
+                           error: Optional[str] = None,
+                           deadline_s: float = 45.0,
+                           **complete_kw: Any) -> Dict[str, Any]:
+        """Report a terminal job outcome, riding out transient plane-side
+        store brownouts (round 19): the plane answers a failed durable
+        write with a retryable 503 (``store_unavailable`` + Retry-After),
+        and the client's own 5xx ladder exhausts well inside a multi-
+        second disk_full window — so keep re-reporting until
+        ``deadline_s``. Safe to repeat: terminal completes are idempotent
+        on the server (duplicates answer ``{"ok": true}``) and zombie
+        results are epoch-fenced with a 409, which is NOT retried."""
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                return self.api.complete_job(
+                    job_id, success=success, result=result, error=error,
+                    **complete_kw
+                )
+            except APIError as exc:
+                if exc.status < 500 or self._shutdown.is_set() \
+                        or time.monotonic() > deadline:
+                    raise
+                log.warning("completion report for %s bounced (%s); "
+                            "retrying", job_id, exc)
+                time.sleep(0.5)
+
     def process_job(self, job: Dict[str, Any],
                     release: Optional[Callable[[], None]] = None) -> None:
         """Run one claimed job. Caller must hold a claim: the exclusive
@@ -896,11 +952,24 @@ class Worker:
                     "checkpoint": job.get("checkpoint"),
                 }
             result = engine.inference(params)
-            self.api.complete_job(
-                job_id, success=True, result=result, **complete_kw
-            )
-            with self._state_lock:
-                self.stats["jobs_completed"] += 1
+            # the completion report gets its own fault domain: the result
+            # is already computed, so a bounced POST (plane store
+            # brownout → typed store_unavailable 503, or a raw 5xx past
+            # the client's retry ladder) must NOT reclassify the JOB as
+            # failed — ride out the window and report the success
+            try:
+                self._report_completion(
+                    job_id, success=True, result=result, **complete_kw
+                )
+            except APIError:
+                # window outlasted the deadline: leave the claim for the
+                # sweeps/epoch fence to requeue — a rerun beats a
+                # spuriously FAILED job with a perfectly good result
+                log.error("could not report completion for job %s "
+                          "(store brownout outlasted retries)", job_id)
+            else:
+                with self._state_lock:
+                    self.stats["jobs_completed"] += 1
         except JobMigrated as mig:
             log.info("job %s migrated on drain (%d tokens checkpointed)",
                      job_id, mig.tokens)
@@ -944,7 +1013,7 @@ class Worker:
                 # next to the human-readable error text
                 complete_kw["result"] = {"error_code": str(code)}
             try:
-                self.api.complete_job(
+                self._report_completion(
                     job_id, success=False, error=str(exc), **complete_kw
                 )
             except APIError:

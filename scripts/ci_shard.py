@@ -30,6 +30,9 @@ HEAVY = [
     "tests/test_gray_chaos.py",          # 25-seed gray-failure replays
     #   (degrade/jitter/flaky + kills with quarantine live, plus the
     #   quarantine/probation/re-admission walk on a live fleet)
+    "tests/test_io_chaos.py",            # 25-seed durable-tier io chaos
+    #   (disk_full/io_error/corrupt/torn storms on a spill-tiered fleet
+    #   + the fully-dark-tier and disk-full degraded-mode walks)
     "tests/test_chaos_scenarios.py",     # 50-seed replays per scenario
     "tests/test_worker_failover_chaos.py",  # 25-seed kill-mid-stream e2e
     "tests/test_worker_serving_batcher.py",  # batcher-backed serving e2e

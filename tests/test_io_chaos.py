@@ -1,0 +1,1036 @@
+"""The durable tier under fire (round 19): IO-fault immunity for the
+store, the KV spill tiers, and checkpoints.
+
+The contract under test: every durable surface this stack touches — the
+job store, the L2/L3 spill tiers, stream checkpoints, persisted files —
+is an OPTIMIZATION, never a single point of failure. Failures are typed,
+counted and fenced:
+
+- **Schedules**: the five io kinds (``disk_full``/``io_error``/
+  ``io_slow``/``corrupt_read``/``torn_write``) live in their own tuple —
+  historical fleet/PD/plane/gray seeds stay bit-identical — and
+  ``--replay SEED --io`` reconstructs a failing suite seed's schedule.
+- **Spill wire integrity**: checksummed entries; corruption and torn
+  writes surface as :class:`SpillIntegrityError`, legacy frames still
+  parse.
+- **Manager tier isolation**: a raising tier put/get is counted and
+  skipped (never a failed eviction or request), corrupt entries are
+  quarantined, a failing promote never discards the fetched page, and
+  the per-tier breaker fences a browned-out tier off the serving path.
+- **IOBreaker units**: the closed → open → half-open machine with
+  virtual clocks — jittered probe instants, one-probe half-open,
+  re-trip on a failed probe.
+- **Atomic file writes**: temp + fsync + rename; an injected
+  ``io.file.write`` fault leaves the old content intact and no temp
+  litter; the machine fingerprint still mints an id on a dead disk.
+- **Checkpoint CRC**: tampered wire rows are refused (ValueError) and
+  degrade to recompute via ``_ckpt_from_wire``; legacy no-crc rows keep
+  parsing (mixed-version fleets).
+- **RedisKVStore outage**: connect failures, GET deadlines, writeback
+  reconnect, stuck-flush deadline, delete tombstones — all non-fatal.
+- **Plane degraded mode**: a store WRITE failure bounces submissions
+  with a typed 503 (``error_code="store_unavailable"`` + Retry-After)
+  while reads keep serving; the heartbeat ``kv_spill`` channel renders
+  ``kv_spill_errors_total`` / ``spill_quarantined_total`` /
+  ``io_breaker_state`` with delta anchoring.
+
+Heavy replays carry ``slow`` + ``io_chaos`` (HEAVY CI shard, ``pytest
+-m io_chaos``); everything else stays tier-1 unmarked.
+"""
+
+import json
+import os
+import random
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import httpx
+import numpy as np
+import pytest
+
+from distributed_gpu_inference_tpu.runtime.io_guard import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    IOBreaker,
+    atomic_write_bytes,
+    atomic_write_text,
+    breaker_env_config,
+)
+from distributed_gpu_inference_tpu.runtime.kv_cache import (
+    HostKVStore,
+    PagedKVCacheManager,
+    RemoteKVStore,
+    SpillIntegrityError,
+    _pack_spill,
+    _unpack_spill,
+)
+from distributed_gpu_inference_tpu.runtime.redis_kv import (
+    RedisKVStore,
+    remote_store_from_url,
+)
+from distributed_gpu_inference_tpu.sdk.client import (
+    InferenceClient,
+    InferenceClientError,
+)
+from distributed_gpu_inference_tpu.testing import faults
+from distributed_gpu_inference_tpu.testing.faults import (
+    ALL_FLEET_EVENT_KINDS,
+    FLEET_EVENT_KINDS,
+    GRAY_EVENT_KINDS,
+    HANDOFF_EVENT_KINDS,
+    IO_CHAOS_KINDS,
+    IO_CHAOS_SUITE_KINDS,
+    IO_CHAOS_WORKERS,
+    PLANE_EVENT_KINDS,
+    FaultPlan,
+    FaultRule,
+    FleetEvent,
+    FleetFaultPlan,
+    _replay_main,
+)
+from distributed_gpu_inference_tpu.testing.harness import (
+    DEFAULT_FLEET_ENGINE,
+    LiveControlPlane,
+    LiveFleet,
+)
+from distributed_gpu_inference_tpu.worker.api_client import APIClient
+from distributed_gpu_inference_tpu.worker.machine_id import MachineFingerprint
+
+N_SEEDS = 25
+
+
+# ---------------------------------------------------------------------------
+# schedule determinism + replay CLI (cheap, tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _io_plan(seed: int) -> FleetFaultPlan:
+    return FleetFaultPlan(seed, n_workers=IO_CHAOS_WORKERS,
+                          kinds=IO_CHAOS_SUITE_KINDS)
+
+
+def test_io_plan_same_seed_same_schedule():
+    for seed in range(N_SEEDS):
+        a, b = _io_plan(seed), _io_plan(seed)
+        assert a.events == b.events, seed
+        assert a.events, seed
+
+
+def test_io_plan_covers_every_io_kind_across_suite_seeds():
+    kinds = set()
+    for seed in range(N_SEEDS):
+        kinds |= {e.kind for e in _io_plan(seed).events}
+    assert set(IO_CHAOS_KINDS) | {"kill"} <= kinds
+
+
+def test_io_kinds_are_separate_from_historical_tuples():
+    """Adding io kinds must not perturb a single historical seed: they
+    live in their own tuple, and no other suite's generator ever draws
+    them."""
+    for other in (FLEET_EVENT_KINDS, HANDOFF_EVENT_KINDS,
+                  PLANE_EVENT_KINDS, GRAY_EVENT_KINDS):
+        assert not set(IO_CHAOS_KINDS) & set(other)
+    assert set(IO_CHAOS_KINDS) <= set(ALL_FLEET_EVENT_KINDS)
+    for seed in range(40):
+        for e in FleetFaultPlan(seed).events:
+            assert e.kind not in IO_CHAOS_KINDS, (seed, e)
+
+
+def test_io_plan_event_parameters_are_sane():
+    """All io storms are fleet-wide (the durable surfaces are shared);
+    disk_full fails EVERYTHING (prob stays the 1.0 default — it draws no
+    rng, by construction); the probabilistic kinds stay in their
+    generator bands."""
+    seen = set()
+    for seed in range(60):
+        for e in _io_plan(seed).events:
+            if e.kind not in IO_CHAOS_KINDS:
+                continue
+            seen.add(e.kind)
+            assert e.worker == -1, (seed, e)
+            assert e.duration_s > 0.0, (seed, e)
+            if e.kind == "disk_full":
+                assert e.prob == 1.0, (seed, e)
+            elif e.kind == "io_error":
+                assert 0.5 <= e.prob <= 1.0, (seed, e)
+            elif e.kind == "io_slow":
+                assert 0.02 <= e.delay_s <= 0.1, (seed, e)
+            else:                      # corrupt_read / torn_write
+                assert 0.25 <= e.prob <= 0.75, (seed, e)
+    assert seen == set(IO_CHAOS_KINDS)
+
+
+def test_io_replay_cli_reconstructs_suite_schedules(capsys):
+    assert _replay_main(["--replay", "7", "--io"]) == 0
+    out = capsys.readouterr().out
+    for line in _io_plan(7).describe():
+        assert line in out
+
+
+def test_io_replay_cli_rejects_mixed_suite_flags(capsys):
+    with pytest.raises(SystemExit):
+        _replay_main(["--replay", "1", "--io", "--gray"])
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# spill wire integrity: CRC-framed entries
+# ---------------------------------------------------------------------------
+
+
+def _page(dtype=np.float32) -> np.ndarray:
+    rng = np.random.default_rng(7)
+    return rng.standard_normal((2, 2, 1, 4, 2)).astype(dtype)
+
+
+def test_spill_pack_roundtrip_with_and_without_scale():
+    page = _page()
+    out, scale = _unpack_spill(_pack_spill(page, None))
+    assert scale is None
+    np.testing.assert_array_equal(out, page)
+    q = (page * 10).astype(np.int8)
+    s = _page()[:, :1]
+    out, scale = _unpack_spill(_pack_spill(q, s))
+    np.testing.assert_array_equal(out, q)
+    np.testing.assert_array_equal(scale, s)
+
+
+def test_spill_unpack_rejects_corruption_and_torn_writes():
+    raw = _pack_spill(_page(), None)
+    # bit rot mid-body
+    i = len(raw) // 2
+    flipped = raw[:i] + bytes([raw[i] ^ 0xFF]) + raw[i + 1:]
+    with pytest.raises(SpillIntegrityError, match="checksum"):
+        _unpack_spill(flipped)
+    # torn write: only a prefix landed
+    with pytest.raises(SpillIntegrityError):
+        _unpack_spill(raw[:32])
+    # torn inside the checksummed header itself
+    with pytest.raises(SpillIntegrityError, match="torn"):
+        _unpack_spill(raw[:6])
+
+
+def test_spill_unpack_accepts_legacy_unchecksummed_frames():
+    """Pre-round-19 entries (no magic) in a shared remote tier must keep
+    hitting on mixed-version fleets."""
+    raw = _pack_spill(_page(), None)
+    legacy = raw[8:]                   # strip magic + crc → the old format
+    out, scale = _unpack_spill(legacy)
+    assert scale is None
+    np.testing.assert_array_equal(out, _page())
+
+
+# ---------------------------------------------------------------------------
+# manager tier isolation: raising tiers, quarantine, breakers
+# ---------------------------------------------------------------------------
+
+
+class _RaisingStore:
+    """A spill tier whose every op raises — the dead device."""
+
+    def __init__(self) -> None:
+        self.puts = 0
+        self.gets = 0
+
+    def put(self, key: str, value: Any) -> None:
+        self.puts += 1
+        raise OSError("device on fire")
+
+    def get(self, key: str) -> Any:
+        self.gets += 1
+        raise OSError("device on fire")
+
+
+class _MissingHostPutRaises:
+    """L2 that always misses and whose put (the L3 promote) raises."""
+
+    def get(self, key: str) -> Any:
+        return None
+
+    def put(self, key: str, value: Any) -> None:
+        raise OSError("pinned pool exhausted")
+
+
+class _CorruptRemote:
+    """L3 returning a bit-flipped entry; records quarantine deletes."""
+
+    def __init__(self, raw: bytes) -> None:
+        i = len(raw) // 2
+        self.raw = raw[:i] + bytes([raw[i] ^ 0xFF]) + raw[i + 1:]
+        self.deleted: List[str] = []
+
+    def get(self, key: str) -> bytes:
+        return self.raw
+
+    def put(self, key: str, data: bytes) -> None:
+        pass
+
+    def delete(self, key: str) -> None:
+        self.deleted.append(key)
+
+
+def _mgr(host=None, remote=None) -> PagedKVCacheManager:
+    return PagedKVCacheManager(num_blocks=4, host_store=host,
+                               remote_store=remote, spill_on_evict=True)
+
+
+def test_store_spilled_isolates_raising_tiers_and_counts():
+    """Satellite: a put-raising tier is counted and SKIPPED — spilling a
+    page can never fail the eviction that triggered it."""
+    host, remote = _RaisingStore(), _RaisingStore()
+    m = _mgr(host=host, remote=remote)
+    m.store_spilled("k0", _page())      # must not raise
+    assert host.puts == 1 and remote.puts == 1
+    assert m.spill_io["host_put_errors"] == 1
+    assert m.spill_io["remote_put_errors"] == 1
+
+
+def test_repeated_tier_failures_trip_the_breaker_and_skip():
+    host = _RaisingStore()
+    m = _mgr(host=host)
+    threshold = m.breakers["host"].threshold
+    for i in range(threshold):
+        m.store_spilled(f"k{i}", _page())
+    assert host.puts == threshold
+    assert not m.breakers["host"].closed
+    assert m.breakers["host"].trips == 1
+    # tripped open: the tier is skipped wholesale, no more latency tax
+    m.store_spilled("k-next", _page())
+    assert host.puts == threshold            # untouched
+    assert m.spill_io["breaker_skips"] == 1
+    ws = m.spill_wire_stats()
+    assert ws["breaker_host_state"] == BREAKER_OPEN
+    assert ws["breaker_host_trips"] == 1
+
+
+def test_probe_failing_host_get_falls_through_to_remote():
+    page = _page()
+    host, remote = _RaisingStore(), RemoteKVStore()
+    remote.put("k", _pack_spill(page, None))
+    m = _mgr(host=host, remote=remote)
+    got = m._probe_spill("k")
+    assert got is not None
+    np.testing.assert_array_equal(got[0], page)
+    assert m.spill_io["host_get_errors"] == 1
+    assert m.stats.l3_hits == 1
+
+
+def test_probe_promote_put_failure_never_discards_the_fetched_page():
+    """Satellite: the L3 hit is already in hand — a failing L2 promote is
+    counted, not allowed to turn the hit into a miss."""
+    page = _page()
+    remote = RemoteKVStore()
+    remote.put("k", _pack_spill(page, None))
+    m = _mgr(host=_MissingHostPutRaises(), remote=remote)
+    got = m._probe_spill("k")
+    assert got is not None
+    np.testing.assert_array_equal(got[0], page)
+    assert got[1] is None
+    assert m.spill_io["host_put_errors"] == 1
+    assert m.stats.l3_hits == 1
+
+
+def test_probe_quarantines_corrupt_remote_entries():
+    remote = _CorruptRemote(_pack_spill(_page(), None))
+    m = _mgr(remote=remote)
+    assert m._probe_spill("bad") is None     # degrades to a miss
+    assert remote.deleted == ["bad"]         # evicted, won't fail again
+    assert m.spill_io["remote_quarantined_corrupt"] == 1
+    ws = m.spill_wire_stats()
+    assert ws["remote_quarantined_corrupt"] == 1
+
+
+def test_defaults_off_spill_path_is_byte_identical_and_quiet():
+    """With no FaultPlan installed and healthy tiers, the round-19 guards
+    are pure bookkeeping: the round trip is byte-identical and every
+    error counter stays zero (the PR 18 behavior)."""
+    assert faults.current() is None
+    page = _page()
+    m = _mgr(host=HostKVStore(8), remote=RemoteKVStore())
+    m.store_spilled("k", page)
+    got = m._probe_spill("k")
+    assert got is not None
+    assert got[0].dtype == page.dtype
+    np.testing.assert_array_equal(got[0], page)
+    assert m.stats.l2_hits == 1
+    assert all(v == 0 for v in m.spill_io.values()), m.spill_io
+    assert all(br.closed for br in m.breakers.values())
+
+
+def test_breaker_disable_env_leaves_no_breakers(monkeypatch):
+    monkeypatch.setenv("DGI_IO_BREAKER_DISABLE", "1")
+    host = _RaisingStore()
+    m = _mgr(host=host)
+    assert m.breakers == {}
+    # every op attempted (the pre-round-19 behavior), still isolated
+    for i in range(10):
+        m.store_spilled(f"k{i}", _page())
+    assert host.puts == 10
+    assert m.spill_io["host_put_errors"] == 10
+    assert m.spill_io["breaker_skips"] == 0
+
+
+# ---------------------------------------------------------------------------
+# IOBreaker: the state machine with virtual clocks
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_walks_closed_open_halfopen_and_back():
+    t = [0.0]
+    br = IOBreaker("host", threshold=2, open_s=10.0, jitter=0.5,
+                   clock=lambda: t[0])
+    assert br.closed and br.allow()
+    br.record_failure()
+    assert br.state_code == BREAKER_CLOSED      # below threshold
+    br.record_failure()
+    assert br.state_code == BREAKER_OPEN and br.trips == 1
+    assert not br.allow()
+    # the probe instant is jittered inside [open_s, open_s*(1+jitter)]
+    assert 10.0 <= br._probe_at <= 15.0
+    t[0] = br._probe_at - 0.01
+    assert not br.allow()
+    t[0] = br._probe_at
+    assert br.allow()                            # the single probe
+    assert br.state_code == BREAKER_HALF_OPEN
+    assert not br.allow()                        # probe in flight: no pile-on
+    br.record_failure()                          # probe failed → re-open
+    assert br.state_code == BREAKER_OPEN and br.trips == 2
+    assert br._probe_at >= t[0] + 10.0           # fresh jitter window
+    t[0] = br._probe_at + 1.0
+    assert br.allow()
+    br.record_success()                          # probe healed the tier
+    assert br.closed and br.allow()
+
+
+def test_breaker_success_resets_the_failure_streak():
+    br = IOBreaker("x", threshold=3, clock=lambda: 0.0)
+    for _ in range(5):
+        br.record_failure()
+        br.record_failure()
+        br.record_success()                      # streak broken at 2
+    assert br.closed and br.trips == 0
+
+
+def test_breaker_rejects_nonsense_threshold():
+    with pytest.raises(ValueError):
+        IOBreaker("x", threshold=0)
+
+
+def test_breaker_env_config_defaults_and_garbage(monkeypatch):
+    for name in ("DGI_IO_BREAKER_THRESHOLD", "DGI_IO_BREAKER_OPEN_S",
+                 "DGI_IO_BREAKER_JITTER", "DGI_IO_BREAKER_DISABLE"):
+        monkeypatch.delenv(name, raising=False)
+    cfg = breaker_env_config()
+    assert cfg == {"threshold": 3, "open_s": 10.0, "jitter": 0.5,
+                   "disabled": False}
+    # malformed values fall back instead of taking the worker down
+    monkeypatch.setenv("DGI_IO_BREAKER_THRESHOLD", "banana")
+    monkeypatch.setenv("DGI_IO_BREAKER_OPEN_S", "-4")
+    monkeypatch.setenv("DGI_IO_BREAKER_JITTER", "")
+    cfg = breaker_env_config()
+    assert cfg["threshold"] == 3
+    assert cfg["open_s"] == 0.0                  # clamped, not negative
+    assert cfg["jitter"] == 0.5
+    monkeypatch.setenv("DGI_IO_BREAKER_DISABLE", "1")
+    assert breaker_env_config()["disabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# atomic file writes + the machine fingerprint on a dead disk
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_write_lands_content_and_leaves_no_temp(tmp_path):
+    target = tmp_path / "cfg.yaml"
+    atomic_write_text(target, "a: 1\n")
+    assert target.read_text() == "a: 1\n"
+    atomic_write_bytes(target, b"b: 2\n")
+    assert target.read_bytes() == b"b: 2\n"
+    assert [p.name for p in tmp_path.iterdir()] == ["cfg.yaml"]
+
+
+def test_atomic_write_failure_preserves_old_content(tmp_path):
+    target = tmp_path / "cfg.yaml"
+    atomic_write_text(target, "old")
+    plan = FaultPlan(0, rules=[FaultRule(site="io.file.write",
+                                         kind="error")])
+    with faults.active(plan):
+        with pytest.raises(OSError):
+            atomic_write_text(target, "new")
+    assert target.read_text() == "old"           # torn write never lands
+    assert [p.name for p in tmp_path.iterdir()] == ["cfg.yaml"]
+
+
+def test_machine_fingerprint_survives_a_dead_disk(tmp_path):
+    state = str(tmp_path / "state")
+    plan = FaultPlan(0, rules=[FaultRule(site="io.file.write",
+                                         kind="error")])
+    with faults.active(plan):
+        fp = MachineFingerprint(state_dir=state).get_or_create()
+    assert len(fp) == 32                         # usable id, nothing saved
+    assert not (tmp_path / "state" / "machine_fingerprint.json").exists()
+    # disk back: the save lands atomically and the id is stable
+    m = MachineFingerprint(state_dir=state)
+    fp2 = m.get_or_create()
+    assert fp2 == fp
+    assert m.load() == fp
+    assert MachineFingerprint(state_dir=state).get_or_create() == fp
+
+
+# ---------------------------------------------------------------------------
+# checkpoint wire CRC: refuse tampered rows, degrade to recompute
+# ---------------------------------------------------------------------------
+
+
+def _mk_ckpt():
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        PreemptedSequence,
+    )
+    from distributed_gpu_inference_tpu.utils.data_structures import (
+        InferenceRequest,
+        SamplingParams,
+    )
+
+    req = InferenceRequest(
+        prompt_token_ids=[1, 2, 3],
+        sampling=SamplingParams(max_new_tokens=8),
+        arrival_time=time.time() - 1.0,
+    )
+    return PreemptedSequence(
+        request=req, prompt_len=3, generated=[7, 9], slot_key=(0, 0),
+        start_time=req.arrival_time, first_token_time=None,
+        cached_tokens=0,
+    )
+
+
+def test_checkpoint_wire_carries_crc_and_survives_json():
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        PreemptedSequence,
+    )
+
+    wire = _mk_ckpt().to_wire()
+    assert "crc" in wire
+    # the crc must hold across an HTTP hop: floats round-trip through
+    # JSON repr, so the store-and-reload copy still verifies
+    reloaded = json.loads(json.dumps(wire))
+    out = PreemptedSequence.from_wire(reloaded)
+    assert out.generated == [7, 9]
+
+
+def test_checkpoint_wire_rejects_tampered_rows():
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        PreemptedSequence,
+    )
+
+    wire = _mk_ckpt().to_wire()
+    evil = dict(wire)
+    evil["generated"] = [7, 9, 11]               # bit rot / torn rewrite
+    with pytest.raises(ValueError, match="crc"):
+        PreemptedSequence.from_wire(evil)
+
+
+def test_checkpoint_wire_accepts_legacy_rows_without_crc():
+    from distributed_gpu_inference_tpu.runtime.engine import (
+        PreemptedSequence,
+    )
+
+    wire = {k: v for k, v in _mk_ckpt().to_wire().items() if k != "crc"}
+    out = PreemptedSequence.from_wire(wire)
+    assert out.generated == [7, 9]
+
+
+def test_engine_degrades_corrupt_checkpoints_to_recompute():
+    """``_ckpt_from_wire`` is the driver-side fuse: a corrupt claim
+    checkpoint returns None (the driver recomputes from params) and is
+    counted — never a failed resumed job."""
+    from distributed_gpu_inference_tpu.worker.engines.llm import (
+        TPULLMEngine,
+    )
+
+    class _Stub:
+        ckpt_corrupt = 0
+
+    s = _Stub()
+    wire = _mk_ckpt().to_wire()
+    good = TPULLMEngine._ckpt_from_wire(s, wire)
+    assert good is not None and s.ckpt_corrupt == 0
+    evil = dict(wire)
+    evil["generated"] = [1, 2, 3]
+    assert TPULLMEngine._ckpt_from_wire(s, evil) is None
+    assert s.ckpt_corrupt == 1
+    # a non-dict claim field is a missing checkpoint, not corruption
+    assert TPULLMEngine._ckpt_from_wire(s, "zz") is None
+    assert s.ckpt_corrupt == 1
+
+
+# ---------------------------------------------------------------------------
+# RedisKVStore: outages are misses and backoffs, never failures
+# ---------------------------------------------------------------------------
+
+
+class _FakeSock:
+    def settimeout(self, t: float) -> None:
+        pass
+
+
+class _FakeConn:
+    """RESP connection double: a dict-backed server, optional fail mode."""
+
+    def __init__(self, store: Dict[bytes, bytes],
+                 fail: Optional[BaseException] = None) -> None:
+        self.sock = _FakeSock()
+        self.store = store
+        self.fail = fail
+        self.commands: List[tuple] = []
+
+    def command(self, *args: bytes):
+        self.commands.append(args)
+        if self.fail is not None:
+            raise self.fail
+        op = args[0]
+        if op == b"GET":
+            return self.store.get(args[1])
+        if op == b"SET":
+            self.store[args[1]] = args[2]
+            return b"OK"
+        if op == b"DEL":
+            return 1 if self.store.pop(args[1], None) is not None else 0
+        if op == b"PING":
+            return b"PONG"
+        return b"OK"
+
+    def close(self) -> None:
+        pass
+
+
+def test_redis_connect_outage_is_a_miss_with_backoff():
+    calls = [0]
+
+    def factory():
+        calls[0] += 1
+        raise ConnectionError("no route to host")
+
+    st = RedisKVStore(conn_factory=factory, reconnect_backoff_s=30.0)
+    try:
+        assert st.get("k") is None
+        assert st.stats["errors"] >= 1
+        reads = calls[0]
+        # inside the backoff window: no reconnect hammering per probe
+        assert st.get("k") is None
+        assert calls[0] == reads
+        assert st.ping() is False
+    finally:
+        st.close()
+
+
+def test_redis_slow_get_trips_the_latency_fail_open():
+    store: Dict[bytes, bytes] = {}
+    st = RedisKVStore(conn_factory=lambda: _FakeConn(store,
+                                                     fail=socket.timeout()),
+                      reconnect_backoff_s=30.0)
+    try:
+        assert st.get("k") is None               # deadline breach → miss
+        assert st.stats["slow_trips"] == 1
+        assert st.stats["errors"] == 1           # conn dropped + backoff
+    finally:
+        st.close()
+
+
+def test_redis_writeback_reconnects_and_delete_tombstones():
+    store: Dict[bytes, bytes] = {}
+    calls = [0]
+
+    def flaky_factory():
+        calls[0] += 1
+        if calls[0] <= 2:                        # first attempts: down
+            raise ConnectionError("still booting")
+        return _FakeConn(store)
+
+    st = RedisKVStore(conn_factory=flaky_factory,
+                      reconnect_backoff_s=0.05, ttl_s=60.0)
+    try:
+        st.put("k", b"v")
+        deadline = time.time() + 5.0
+        while time.time() < deadline and st._key("k") not in store:
+            time.sleep(0.01)
+        assert store[st._key("k")] == b"v"       # landed after reconnect
+        assert st.flush(timeout_s=2.0) is True
+        # quarantine delete rides the same queue as a tombstone → DEL
+        st.delete("k")
+        deadline = time.time() + 5.0
+        while time.time() < deadline and st._key("k") in store:
+            time.sleep(0.01)
+        assert st._key("k") not in store
+        assert st.stats["errors"] == 2           # the two dead connects
+    finally:
+        st.close()
+
+
+def test_redis_flush_reports_a_stuck_writer():
+    def dead_factory():
+        raise ConnectionError("hard down")
+
+    st = RedisKVStore(conn_factory=dead_factory, reconnect_backoff_s=5.0)
+    try:
+        st.put("k", b"v")
+        assert st.flush(timeout_s=0.3) is False  # deadline, not a hang
+    finally:
+        st.close()
+
+
+def test_remote_store_from_url_schemes():
+    assert remote_store_from_url(None) is None
+    assert remote_store_from_url("") is None
+    assert isinstance(remote_store_from_url("memory://"), RemoteKVStore)
+    with pytest.raises(ValueError, match="scheme"):
+        remote_store_from_url("s3://bucket/kv")
+
+
+# ---------------------------------------------------------------------------
+# plane degraded mode: typed 503 on store-write outage; kv_spill metrics
+# ---------------------------------------------------------------------------
+
+
+def _register(cp: LiveControlPlane, name: str) -> APIClient:
+    api = APIClient(cp.url, backoff_s=0.0)
+    api.register({"name": name, "region": "us-west",
+                  "supported_types": ["llm"], "supports_direct": True,
+                  "direct_url": f"http://{name}.example:8471"})
+    return api
+
+
+def _metric(cp: LiveControlPlane, name: str) -> str:
+    text = httpx.get(f"{cp.url}/metrics").text
+    return "\n".join(
+        line for line in text.splitlines() if line.startswith(name)
+    )
+
+
+def test_store_write_outage_bounces_typed_503_while_reads_serve():
+    with LiveControlPlane() as cp:
+        # a pre-outage job proves the read path below
+        r = httpx.post(f"{cp.url}/api/v1/jobs",
+                       json={"type": "llm", "params": {"prompt": "x"}})
+        assert r.status_code == 201
+        job_id = r.json()["job_id"]
+        plan = FaultPlan(0, rules=[FaultRule(
+            site="server.store.execute", kind="error",
+            match={"sql": "INSERT INTO jobs*"},
+        )])
+        with faults.active(plan):
+            r = httpx.post(f"{cp.url}/api/v1/jobs",
+                           json={"type": "llm", "params": {"prompt": "y"}})
+            assert r.status_code == 503
+            body = r.json()
+            assert body["error_code"] == "store_unavailable"
+            assert body["retry_after_s"] == 2.0
+            assert r.headers["Retry-After"] == "2"
+            # reads keep serving off the intact database
+            g = httpx.get(f"{cp.url}/api/v1/jobs/{job_id}")
+            assert g.status_code == 200
+            assert g.json()["id"] == job_id
+            assert "store_degraded 1.0" in _metric(cp, "store_degraded")
+        # outage over: the next write lands and clears the gauge
+        r = httpx.post(f"{cp.url}/api/v1/jobs",
+                       json={"type": "llm", "params": {"prompt": "z"}})
+        assert r.status_code == 201
+        assert "store_degraded 0.0" in _metric(cp, "store_degraded")
+
+
+def test_heartbeat_kv_spill_channel_renders_plane_metrics():
+    """The worker-side counters ride ``engine_stats["kv_spill"]`` and
+    land as delta-anchored plane series — re-anchoring on restart, never
+    emitting negative deltas."""
+    with LiveControlPlane() as cp:
+        api = _register(cp, "w")
+        api.heartbeat(status="idle", engine_stats={"kv_spill": {
+            "host_put_errors": 3, "remote_get_errors": 2,
+            "remote_quarantined_corrupt": 1,
+            "breaker_host_state": 2, "breaker_remote_state": 0,
+            "ckpt_corrupt": 1,
+        }})
+        errs = _metric(cp, "kv_spill_errors_total")
+        assert 'tier="host"' in errs and 'op="put"' in errs
+        assert " 3.0" in errs and " 2.0" in errs
+        quar = _metric(cp, "spill_quarantined_total")
+        assert 'tier="remote"' in quar and 'reason="corrupt"' in quar
+        assert 'tier="checkpoint"' in quar       # refused corrupt ckpt
+        state = _metric(cp, "io_breaker_state")
+        assert 'tier="host"' in state and " 2.0" in state
+        # cumulative repeat: no double counting
+        api.heartbeat(status="idle", engine_stats={"kv_spill": {
+            "host_put_errors": 3,
+        }})
+        assert " 3.0" in _metric(cp, "kv_spill_errors_total")
+        # engine restart re-anchors: a SMALLER total emits no bogus delta
+        api.heartbeat(status="idle", engine_stats={"kv_spill": {
+            "host_put_errors": 1,
+        }})
+        errs = _metric(cp, "kv_spill_errors_total")
+        assert " 3.0" in errs
+        # and growth from the new anchor counts from there
+        api.heartbeat(status="idle", engine_stats={"kv_spill": {
+            "host_put_errors": 4, "breaker_host_state": 0,
+        }})
+        errs = _metric(cp, "kv_spill_errors_total")
+        assert " 6.0" in errs                    # 3 + (4 - 1)
+        state = _metric(cp, "io_breaker_state")
+        # the recovered breaker drives the gauge back to healthy
+        for line in state.splitlines():
+            if 'tier="host"' in line:
+                assert line.endswith(" 0.0"), line
+        api.close()
+
+
+# ---------------------------------------------------------------------------
+# the 25-seed composed suite (HEAVY: slow + io_chaos)
+# ---------------------------------------------------------------------------
+
+# spill tiers ON (DEFAULT_FLEET_ENGINE has none — the io seams would
+# never fire): a small L2 plus the in-process L3, per-token checkpoints
+# already on in the default
+IO_FLEET_ENGINE = {
+    **DEFAULT_FLEET_ENGINE,
+    "kv_spill_host_blocks": 16,
+    "kv_remote_url": "memory://",
+}
+
+
+@pytest.fixture(scope="module")
+def io_fleet():
+    # short breaker windows so post-storm probes heal within the test:
+    # env is read at engine construction, so set it before the fleet
+    old = os.environ.get("DGI_IO_BREAKER_OPEN_S")
+    os.environ["DGI_IO_BREAKER_OPEN_S"] = "1.0"
+    try:
+        with LiveFleet(n=IO_CHAOS_WORKERS,
+                       engine_config=IO_FLEET_ENGINE) as f:
+            yield f
+    finally:
+        if old is None:
+            os.environ.pop("DGI_IO_BREAKER_OPEN_S", None)
+        else:
+            os.environ["DGI_IO_BREAKER_OPEN_S"] = old
+
+
+def _create_job_resilient(c: InferenceClient, prompt: str,
+                          max_tokens: int, deadline_s: float = 45.0) -> str:
+    """Submit with the degraded-mode retry contract: a disk_full window
+    bounces typed 503s longer than the SDK's built-in ladder, so honor
+    ``retry_after_s`` until the window passes."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return c.create_job("llm", {"prompt": prompt,
+                                        "max_new_tokens": max_tokens})
+        except InferenceClientError as exc:
+            if exc.status < 500 or time.monotonic() > deadline:
+                raise
+            time.sleep(max(exc.retry_after_s or 0.25, 0.25))
+
+
+def _drive_open_loop_io(fleet: LiveFleet, prompts: List[str], seed: int,
+                        max_tokens: int, rate: float = 2.5,
+                        stream_every: int = 3) -> List[Dict[str, Any]]:
+    """The fleet-chaos open-loop driver with degraded-mode submission:
+    queued jobs retry through store-outage 503s, every third request is
+    a direct SSE stream (exactly-once offsets through kills)."""
+    rng = random.Random(seed * 101 + 3)
+    arrivals, t = [], 0.0
+    for _ in prompts:
+        t += rng.expovariate(rate)
+        arrivals.append(t)
+    results: List[Optional[Dict[str, Any]]] = [None] * len(prompts)
+    errors: List[BaseException] = []
+    t0 = time.monotonic()
+
+    def queued(i: int, prompt: str) -> None:
+        c = InferenceClient(fleet.url, backoff_s=0.05)
+        try:
+            job_id = _create_job_resilient(c, prompt, max_tokens)
+            job = c.wait_for_job(job_id, timeout_s=90.0, poll_s=0.05)
+            assert job["status"] == "completed", (prompt, job)
+            results[i] = {"prompt": prompt, "path": "queued",
+                          "text": job["result"]["text"], "job_id": job_id}
+        finally:
+            c.close()
+
+    def streamed(i: int, prompt: str) -> None:
+        c = InferenceClient(fleet.url, backoff_s=0.05)
+        try:
+            chunks = list(c.stream_chat(prompt=prompt,
+                                        max_new_tokens=max_tokens,
+                                        timeout_s=90.0,
+                                        max_stream_resumes=6))
+            assert chunks[-1].get("done") is True, (prompt, chunks[-1:])
+            text = "".join(ch.get("text_delta") or "" for ch in chunks[:-1])
+            offs = [int(ch["offset"]) for ch in chunks
+                    if ch.get("offset") is not None]
+            assert offs == sorted(offs), (prompt, offs)
+            toks = [tk for ch in chunks[:-1]
+                    for tk in ch.get("token_ids") or []]
+            if offs:
+                assert len(toks) == offs[-1], (prompt, len(toks), offs)
+            results[i] = {"prompt": prompt, "path": "stream", "text": text}
+        finally:
+            c.close()
+
+    def one(i: int, prompt: str) -> None:
+        wait = arrivals[i] - (time.monotonic() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        try:
+            if i % stream_every == stream_every - 1:
+                streamed(i, prompt)
+            else:
+                queued(i, prompt)
+        except BaseException as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=one, args=(i, p), daemon=True)
+        for i, p in enumerate(prompts)
+    ]
+    for t_ in threads:
+        t_.start()
+    for t_ in threads:
+        t_.join(timeout=120.0)
+    if errors:
+        raise errors[0]
+    lost = [prompts[i] for i, r in enumerate(results) if r is None]
+    assert not lost, f"lost requests: {lost}"
+    return results  # type: ignore[return-value]
+
+
+def _breaker_states(fleet: LiveFleet) -> List[tuple]:
+    out = []
+    for m in fleet.members:
+        eng = getattr(m.llm, "engine", None)
+        mgr = getattr(eng, "manager", None) if eng is not None else None
+        if mgr is None:
+            continue
+        for tier, br in mgr.breakers.items():
+            out.append((m.tag, tier, br.state))
+    return out
+
+
+def _assert_breakers_reconciled(fleet: LiveFleet,
+                                timeout_s: float = 25.0) -> None:
+    """End-state reconciliation: every tripped breaker must heal once the
+    storm passes — spill traffic (tiny nudge requests force KV churn)
+    lands the half-open probes that close them."""
+    c = InferenceClient(fleet.url, backoff_s=0.05)
+    try:
+        deadline, n = time.time() + timeout_s, 0
+        while True:
+            bad = [s for s in _breaker_states(fleet) if s[2] != "closed"]
+            if not bad:
+                return
+            assert time.time() < deadline, f"breakers never healed: {bad}"
+            job_id = _create_job_resilient(
+                c, f"heal{n} abcdefgh", max_tokens=4)
+            c.wait_for_job(job_id, timeout_s=30.0, poll_s=0.05)
+            n += 1
+            time.sleep(0.2)
+    finally:
+        c.close()
+
+
+@pytest.mark.slow
+@pytest.mark.io_chaos
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_io_chaos_seeded(io_fleet, seed):
+    """One seeded io replay: disk_full/io_error/io_slow/corrupt_read/
+    torn_write composed with clean kills on a spill-tiered 2-replica
+    fleet — nothing lost, exactly-once SSE offsets, outputs
+    byte-identical to a calm replay, breakers healed at the end."""
+    from tests.test_fleet_chaos import (
+        _assert_no_lost_or_duplicated_jobs,
+        _await_quiet,
+        _calm_reference,
+        _heal,
+        _suite_prompts,
+    )
+
+    plan = _io_plan(seed)
+    assert plan.events == _io_plan(seed).events        # determinism
+    prompts = _suite_prompts(seed, 9)
+    io_fleet.run_chaos(plan)
+    try:
+        records = _drive_open_loop_io(io_fleet, prompts, seed=seed,
+                                      max_tokens=7)
+    finally:
+        io_fleet.wait_chaos(timeout_s=180.0)
+        _heal(io_fleet)
+    assert [k for _, k, _ in plan.trace] == [e.kind for e in plan.events]
+    _await_quiet(io_fleet)
+    _assert_no_lost_or_duplicated_jobs(io_fleet)
+    _calm_reference(io_fleet, records, max_tokens=7)
+    assert all(m.alive for m in io_fleet.members)
+    _assert_breakers_reconciled(io_fleet)
+
+
+@pytest.mark.slow
+@pytest.mark.io_chaos
+def test_fully_dark_spill_tier_degrades_to_recompute(io_fleet):
+    """The acceptance walk: EVERY spill/checkpoint op fails for the whole
+    window (io_error at prob=1.0, fleet-wide). Serving must degrade to
+    cache-less recompute with ZERO failed requests, and the breakers
+    must close again once the tier comes back."""
+    from tests.test_fleet_chaos import (
+        _assert_no_lost_or_duplicated_jobs,
+        _await_quiet,
+        _calm_reference,
+        _suite_prompts,
+    )
+
+    plan = FleetFaultPlan(0, n_workers=IO_CHAOS_WORKERS, duration_s=8.0,
+                          kinds=IO_CHAOS_SUITE_KINDS)
+    plan.events = [FleetEvent(0.0, "io_error", -1, duration_s=6.0,
+                              prob=1.0)]
+    prompts = _suite_prompts(777, 8)
+    io_fleet.run_chaos(plan)
+    try:
+        records = _drive_open_loop_io(io_fleet, prompts, seed=777,
+                                      max_tokens=7)
+    finally:
+        io_fleet.wait_chaos(timeout_s=60.0)
+    _await_quiet(io_fleet)
+    _assert_no_lost_or_duplicated_jobs(io_fleet)
+    _calm_reference(io_fleet, records, max_tokens=7)
+    assert all(m.alive for m in io_fleet.members)
+    _assert_breakers_reconciled(io_fleet)
+
+
+@pytest.mark.slow
+@pytest.mark.io_chaos
+def test_disk_full_window_bounces_then_recovers(io_fleet):
+    """A disk_full window fails every durable write (store INSERT/UPDATE,
+    spill puts, checkpoint saves, file writes) while reads serve; the
+    retrying submitter rides the typed 503s through the window and
+    nothing is lost."""
+    from tests.test_fleet_chaos import (
+        _assert_no_lost_or_duplicated_jobs,
+        _await_quiet,
+        _suite_prompts,
+    )
+
+    plan = FleetFaultPlan(1, n_workers=IO_CHAOS_WORKERS, duration_s=4.0,
+                          kinds=IO_CHAOS_SUITE_KINDS)
+    plan.events = [FleetEvent(0.2, "disk_full", -1, duration_s=2.0)]
+    prompts = _suite_prompts(42, 6)
+    io_fleet.run_chaos(plan)
+    try:
+        records = _drive_open_loop_io(io_fleet, prompts, seed=42,
+                                      max_tokens=6)
+    finally:
+        io_fleet.wait_chaos(timeout_s=60.0)
+    assert len(records) == len(prompts)
+    _await_quiet(io_fleet)
+    _assert_no_lost_or_duplicated_jobs(io_fleet)
+    # the degraded-mode gauge cleared with the first post-window write
+    assert "store_degraded 0.0" in _metric(io_fleet.plane,
+                                           "store_degraded")
