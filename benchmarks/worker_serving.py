@@ -438,12 +438,30 @@ class FleetMember:
             es["prefix_summary"] = summary
         if self.llm.prefix_hot is not None:
             es["prefix_summary_live"] = True
+        # mirror worker/main.py: ship the migrate counters and the flight
+        # ring — the plane's calibration (round 20) learns pull bandwidth
+        # and queue-wait/prefill rates from exactly these channels
+        kvmig = self.llm.kv_migrate_wire_stats()
+        if kvmig:
+            es["kv_migrate"] = kvmig
+        fl = self.llm.flight_wire_stats()
+        if fl:
+            es["flight"] = fl
         try:
             r = client.post(
                 f"{plane_url}/api/v1/workers/{self.worker_id}/heartbeat",
                 json={"status": "idle", "engine_stats": es},
                 headers={"Authorization": f"Bearer {self.token}"},
             )
+            if r.status_code == 200:
+                # proactive replication (round 20): hand plane hints to
+                # the engine's prefetch driver, like a production worker
+                hints = r.json().get("kv_replicate")
+                if hints:
+                    try:
+                        self.llm.kv_replicate(hints)
+                    except Exception:  # noqa: BLE001 — advisory prefetch
+                        pass
             if summary is not None:
                 # mirror worker/main.py: ack ONLY on an explicit
                 # "applied" answer — an absent key means the server never
@@ -504,6 +522,7 @@ class FleetMember:
 
 async def _drive_fleet(plane_url: str, members: List["FleetMember"],
                        workload: Any, hb_interval_s: float,
+                       trace: Optional[str] = None,
                        ) -> Tuple[List[Dict[str, Any]], float]:
     """Replay one workload leg against the fleet: every request discovers
     its worker through the control plane (prefix-fingerprinted), honoring
@@ -577,6 +596,15 @@ async def _drive_fleet(plane_url: str, members: List["FleetMember"],
                                 "params": {"prompt": req.prompt,
                                            "max_new_tokens": req.max_tokens,
                                            "priority": req.priority,
+                                           # flight-traced legs: the done
+                                           # wire rides the heartbeat ring
+                                           # into the recorder (and the
+                                           # round-20 calibration sink);
+                                           # the leg tag keeps trace ids
+                                           # unique across A/B replays
+                                           **({"trace_id":
+                                               f"bench-{trace}-{req.id}"}
+                                              if trace else {}),
                                            # router migrate-KV verdict: the
                                            # cold worker pulls the prefix
                                            # from the named peer before
@@ -602,6 +630,8 @@ async def _drive_fleet(plane_url: str, members: List["FleetMember"],
                         (res.get("usage") or {}).get("completion_tokens")
                         or 0
                     )
+                    if trace:
+                        out["timeline"] = res.get("timeline")
             finally:
                 done_at[req.id] = time.perf_counter() - t0
                 done_events[req.id].set()
@@ -860,6 +890,199 @@ def run_kv_migrate(args: Any, backend: str, model: str) -> None:
                         )
                 out["rates"][str(rate)] = entry
             routing(kv_migrate=False)
+            emit(out)
+        finally:
+            client.close()
+            for m in members:
+                m.stop()
+
+
+# ---------------------------------------------------------------------------
+# --predictive (round 20): the serving-intelligence A/B. Two frontiers on a
+# live fleet: (1) cost-model self-calibration under the storm workload —
+# the SAME trace replayed with the static priors vs the learned per-worker
+# EMAs, replayed `--predictive-repeats` times with calibration ON so the
+# published predicted-vs-measured error's round-over-round FALL is the
+# convergence evidence; (2) proactive prefix replication under the bursty
+# workload — heartbeat-hinted prefetch pulls vs the purely reactive
+# round-13 migrate path, measured as prefix hit-rate and TTFT. Greedy
+# outputs predictor-on vs predictor-off are byte-identical in both halves:
+# predictions move WHERE and WHEN work runs, never what it computes.
+# ---------------------------------------------------------------------------
+
+
+def run_predictive(args: Any, backend: str, model: str) -> None:
+    from distributed_gpu_inference_tpu.testing.harness import (
+        LiveControlPlane,
+    )
+    from distributed_gpu_inference_tpu.worker.engines.llm import TPULLMEngine
+
+    import httpx
+
+    from benchmarks.workloads import generate
+
+    rate = float(args.arrival_rate or 2.0)
+    workers = max(2, args.workers)
+    repeats = max(2, args.predictive_repeats)
+    storm = generate("storm", args.seed, requests=args.requests,
+                     max_tokens=args.max_tokens, rate=rate,
+                     burst=args.burst, tenants=args.tenants)
+    bursty = generate("bursty", args.seed + 1, requests=args.requests,
+                      max_tokens=args.max_tokens, rate=rate,
+                      tenants=args.tenants)
+    max_prompt = max(len(r.prompt) for wl in (storm, bursty)
+                     for r in wl.requests)
+    members: List[FleetMember] = []
+    with LiveControlPlane() as plane:
+        client = httpx.Client(timeout=60.0)
+        try:
+            for _ in range(workers):
+                llm = TPULLMEngine({
+                    "model": model,
+                    "max_batch_size": args.concurrency,
+                    "max_seq_len": max_prompt + args.max_tokens + 16,
+                    "quantization": args.quantization,
+                    "serving": {
+                        "queue_limit": max(4096, args.requests * 2),
+                        "default_timeout_s": 600.0,
+                    },
+                })
+                llm.load_model()
+                m = FleetMember(llm, data_plane=True)
+                m.register(client, plane.url)
+                members.append(m)
+
+            def routing(**kw: Any) -> None:
+                client.put(f"{plane.url}/api/v1/admin/routing",
+                           json=kw).raise_for_status()
+
+            def routing_state() -> Dict[str, Any]:
+                r = client.get(f"{plane.url}/api/v1/admin/routing")
+                r.raise_for_status()
+                return r.json()
+
+            def spillover_split(wl: Any,
+                                results: List[Dict[str, Any]],
+                                ) -> Dict[str, Any]:
+                """TTFT split by placement continuity: a turn landing on
+                the SAME worker as its conversation's previous turn rides
+                the deep local prefix ('sticky'); one landing elsewhere
+                ('spillover') starts from whatever that worker holds —
+                the requests proactive replication exists to pre-warm."""
+                conv_last: Dict[Any, Any] = {}
+                sticky: List[float] = []
+                spill: List[float] = []
+                for req, rec in zip(wl.requests, results):
+                    wid = rec.get("worker_id")
+                    if wid is None:
+                        continue
+                    last = conv_last.get(req.conversation)
+                    conv_last[req.conversation] = wid
+                    t = rec.get("ttft_ms")
+                    if last is None or t is None:
+                        continue
+                    (sticky if wid == last else spill).append(float(t))
+                return {
+                    "sticky_turns": len(sticky),
+                    "spillover_turns": len(spill),
+                    "sticky_ttft_ms": percentiles(sticky),
+                    "spillover_ttft_ms": percentiles(spill),
+                }
+
+            def leg(wl: Any, tag: str) -> Dict[str, Any]:
+                for m in members:
+                    m.reset_cache()
+                results, elapsed = asyncio.run(_drive_fleet(
+                    plane.url, members, wl,
+                    hb_interval_s=args.fleet_heartbeat_s,
+                    trace=tag,   # traces feed the calibration sink
+                ))
+                out = _fleet_leg_summary(results, elapsed, members)
+                out["placement"] = spillover_split(wl, results)
+                mig: Dict[str, int] = {}
+                for m in members:
+                    for k, v in m.migrate_stats().items():
+                        mig[k] = mig.get(k, 0) + v
+                out["kv_migrate"] = mig
+                out["outputs"] = {
+                    r["id"]: r.get("text") for r in results
+                    if r.get("status") == 200
+                }
+                if args.timeline:
+                    out["timeline"] = _timeline_attribution(results)
+                return out
+
+            # compile every graph once before anything is measured
+            routing(enabled=True, kv_migrate=True)
+            leg(storm, "warm")
+
+            out: Dict[str, Any] = {
+                "benchmark": "worker_serving_predictive",
+                "path": "control_plane+direct_nearest+kv_export_pull",
+                "seed": args.seed, "workers": workers, "model": model,
+                "backend": backend, "requests": args.requests,
+                "rate": rate, "burst": args.burst,
+                "concurrency": args.concurrency,
+                "max_tokens": args.max_tokens, "repeats": repeats,
+            }
+
+            # -- half 1: cost-model self-calibration x storm ----------------
+            routing(calibrate=False, calibrate_reset=True)
+            static = leg(storm, "cal-off")
+            routing(calibrate=True, calibrate_reset=True)
+            err_by_round: List[Optional[float]] = []
+            calibrated: Dict[str, Any] = {}
+            for i in range(repeats):
+                calibrated = leg(storm, f"cal-on-{i}")
+                snap = routing_state().get("calibration") or {}
+                err_by_round.append(snap.get("predicted_vs_measured"))
+            cal_snapshot = routing_state().get("calibration") or {}
+            routing(calibrate=False, calibrate_reset=True)
+            errs = [e for e in err_by_round if e is not None]
+            entry: Dict[str, Any] = {
+                "static": static, "calibrated": calibrated,
+                "outputs_identical": (static.pop("outputs")
+                                      == calibrated.pop("outputs")),
+                "predicted_vs_measured_by_round": err_by_round,
+                "error_converged": (len(errs) >= 2
+                                    and errs[-1] < errs[0]),
+                "calibration": cal_snapshot,
+            }
+            for pct in ("mean", "p50", "p95"):
+                c_t = (calibrated["ttft_ms"] or {}).get(pct)
+                s_t = (static["ttft_ms"] or {}).get(pct)
+                if c_t and s_t:
+                    entry[f"ttft_{pct}_calibrated_over_static"] = round(
+                        c_t / s_t, 3
+                    )
+            out["calibration_storm"] = entry
+
+            # -- half 2: proactive replication x bursty ---------------------
+            routing(replicate=False)
+            reactive = leg(bursty, "rep-off")
+            # hints must land within the burst windows: a short cooldown
+            # and a 2-hit threshold fit bench-sized traffic
+            routing(replicate=True, replicate_hot_threshold=2,
+                    replicate_cooldown_s=5.0)
+            proactive = leg(bursty, "rep-on")
+            rep_snapshot = routing_state().get("replication") or {}
+            routing(replicate=False)
+            entry = {
+                "reactive": reactive, "proactive": proactive,
+                "outputs_identical": (reactive.pop("outputs")
+                                      == proactive.pop("outputs")),
+                "hit_rate_reactive": reactive["prefix_hit_rate"],
+                "hit_rate_proactive": proactive["prefix_hit_rate"],
+                "replication": rep_snapshot,
+            }
+            for pct in ("mean", "p50", "p95"):
+                p_t = (proactive["ttft_ms"] or {}).get(pct)
+                r_t = (reactive["ttft_ms"] or {}).get(pct)
+                if p_t and r_t:
+                    entry[f"ttft_{pct}_proactive_over_reactive"] = round(
+                        p_t / r_t, 3
+                    )
+            out["replication_bursty"] = entry
             emit(out)
         finally:
             client.close()
@@ -2940,6 +3163,17 @@ def main() -> None:
                     "route-only under the anti-affinity storm workload, "
                     "swept over --arrival-rate (comma-separated storm "
                     "rates; default 0.5,2.0)")
+    ap.add_argument("--predictive", action="store_true",
+                    help="serving-intelligence A/B (round 20): cost-model "
+                    "self-calibration ON vs static priors under the storm "
+                    "workload (replayed --predictive-repeats times so the "
+                    "predicted-vs-measured error trajectory shows "
+                    "convergence), and proactive prefix replication ON vs "
+                    "reactive-only under the bursty workload; per-leg "
+                    "--timeline attribution and output byte-identity")
+    ap.add_argument("--predictive-repeats", type=int, default=3,
+                    help="calibrated-leg replays for the --predictive "
+                    "convergence trajectory (min 2)")
     ap.add_argument("--burst", type=int, default=8,
                     help="requests per tenant storm (storm scenario / "
                     "--kv-migrate)")
@@ -3020,6 +3254,14 @@ def main() -> None:
 
     if args.kv_migrate:
         run_kv_migrate(args, backend, model)
+        return
+
+    if args.predictive:
+        if args.arrival_rate and "," in str(args.arrival_rate):
+            ap.error("--predictive takes a single --arrival-rate (the "
+                     "comparison axes are calibrated-vs-static and "
+                     "proactive-vs-reactive)")
+        run_predictive(args, backend, model)
         return
 
     if args.workers >= 2:

@@ -156,6 +156,15 @@ class BatcherConfig:
     # pre-round scheduler.
     abandon_deadlines: bool = False
     deadline_grace_s: float = 0.5
+    # predictive abandonment (round 20): when ON (requires
+    # abandon_deadlines too), the projection fires BEFORE the deadline
+    # passes — a job whose remaining decode (tokens_left × observed ITL)
+    # already overruns deadline + grace stops burning ragged-round slots
+    # now instead of limping to the deadline first. Same typed
+    # ``deadline_abandoned`` error, counted separately
+    # (stats["abandoned_predictive"]); OFF keeps the reactive-only round-15
+    # behavior byte-identical.
+    predictive_abandon: bool = False
 
     @property
     def horizon_levels(self) -> Tuple[int, ...]:
@@ -313,7 +322,7 @@ class ContinuousBatcher:
             "preemptions": 0, "resumes": 0, "preemption_block_pressure": 0,
             "preempted_too_often": 0,
             "cancelled": 0, "migrated": 0, "adopted": 0,
-            "abandoned": 0,
+            "abandoned": 0, "abandoned_predictive": 0,
         }
 
     @property
@@ -960,7 +969,8 @@ class ContinuousBatcher:
                 self._ragged.append((adm, item))
                 self.stats["ragged_admissions"] += 1
                 self._note(item, "batcher.admitted", slot=adm.slot,
-                           mode="ragged")
+                           mode="ragged",
+                           tokens=len(item.request.prompt_token_ids or []))
                 continue
             n_prompt = len(item.request.prompt_token_ids or [])
             if n_prompt > max_bucket:
@@ -995,7 +1005,7 @@ class ContinuousBatcher:
                 self._chunked = (adm, item)
                 self.stats["chunked_admissions"] += 1
                 self._note(item, "batcher.admitted", slot=adm.slot,
-                           mode="chunked")
+                           mode="chunked", tokens=n_prompt)
                 continue
             free.pop(0)
             wave.append(item)
@@ -1048,7 +1058,9 @@ class ContinuousBatcher:
                     self._slot_items[slot] = item
                     self._admit_stamp[slot] = next(self._stamp)
                     self._note(item, "batcher.admitted", at=t_admit,
-                               slot=slot, mode="wave")
+                               slot=slot, mode="wave",
+                               tokens=len(item.request.prompt_token_ids
+                                          or []))
                     self._note_first_token(item, slot)
                     admitted += 1
             if slots is not None:
@@ -1058,7 +1070,9 @@ class ContinuousBatcher:
                     self._slot_items[slot] = item
                     self._admit_stamp[slot] = next(self._stamp)
                     self._note(item, "batcher.admitted", at=t_admit,
-                               slot=slot, mode="wave")
+                               slot=slot, mode="wave",
+                               tokens=len(item.request.prompt_token_ids
+                                          or []))
                     self._note_first_token(item, slot)
                 admitted += len(slots)
                 # pressure deferred the wave's tail (possibly the whole
@@ -1373,13 +1387,28 @@ class ContinuousBatcher:
         if tokens_left <= 0:
             return False
         deadline_at = request.deadline_at
-        if now <= deadline_at:
+        if now <= deadline_at and not self.cfg.predictive_abandon:
+            # reactive mode waits for the deadline to actually pass;
+            # predictive mode (round 20) lets the ITL projection below
+            # fire EARLY — the projection test is identical either way,
+            # so a request reactive mode would carry to its deadline and
+            # then drop is dropped now, before burning the rounds
             return False
         # observed inter-token latency; floor at 1ms so a cold EMA (no
         # rounds yet) still projects SOME forward progress instead of 0
         itl_s = max(float(self.stats["step_latency_ema_ms"]), 1.0) / 1000.0
         return now + tokens_left * itl_s > \
             deadline_at + self.cfg.deadline_grace_s
+
+    def _count_abandon(self, request: InferenceRequest, now: float) -> None:
+        """Bump the abandonment counters: every abandonment lands in
+        ``abandoned``; one that fired BEFORE the deadline passed (only
+        possible with ``predictive_abandon``) also lands in
+        ``abandoned_predictive`` — the A/B-visible split."""
+        self.stats["completed"] += 1
+        self.stats["abandoned"] += 1
+        if now <= request.deadline_at:
+            self.stats["abandoned_predictive"] += 1
 
     def _abandon_response(self, request: InferenceRequest,
                           token_ids: List[int],
@@ -1426,8 +1455,7 @@ class ContinuousBatcher:
                 pre.prompt_len if pre
                 else len(req.prompt_token_ids or []),
             ))
-            self.stats["completed"] += 1
-            self.stats["abandoned"] += 1
+            self._count_abandon(req, now)
         if changed:
             heapq.heapify(self._heap)
         if self._chunked is not None:
@@ -1447,8 +1475,7 @@ class ContinuousBatcher:
                         item.request, [],
                         len(item.request.prompt_token_ids or []),
                     ))
-                    self.stats["completed"] += 1
-                    self.stats["abandoned"] += 1
+                    self._count_abandon(item.request, now)
         for adm, item in list(self._ragged):
             if item.future.done() or not self._deadline_hopeless(
                     item.request,
@@ -1466,8 +1493,7 @@ class ContinuousBatcher:
                     item.request, [],
                     len(item.request.prompt_token_ids or []),
                 ))
-                self.stats["completed"] += 1
-                self.stats["abandoned"] += 1
+                self._count_abandon(item.request, now)
         for slot, item in list(self._slot_items.items()):
             s = self.engine.slots[slot]
             if s is None or s.finish_reason is not None:
@@ -1487,8 +1513,7 @@ class ContinuousBatcher:
             if resp is not None and not item.future.done():
                 item.future.set_result(self._abandon_response(
                     req, list(resp.token_ids), resp.prompt_tokens))
-                self.stats["completed"] += 1
-                self.stats["abandoned"] += 1
+                self._count_abandon(req, now)
 
     def _notify_observers(self) -> None:
         """Push per-round progress to streaming observers (loop thread;

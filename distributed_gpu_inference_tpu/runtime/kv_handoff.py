@@ -787,12 +787,20 @@ EXPORT_REQUEST_VERSION = 1
 def pack_export_request(*, key: str, token_ids: Sequence[int], model_name: str,
                         block_size: int, int8_kv: bool,
                         max_blocks: int = 64,
-                        start_block: int = 0) -> bytes:
+                        start_block: int = 0,
+                        fp: Optional[str] = None) -> bytes:
     """Wire form of a ``/kv/export`` pull request (msgpack header codec —
     the same pickle-free framing as every other handoff message).
     ``start_block``: leading full blocks the puller ALREADY holds — the
     exporter ships pieces from there, so a partially-warm puller never
-    re-transfers (and the peer never re-gathers) the overlap."""
+    re-transfers (and the peer never re-gathers) the overlap.
+    ``fp`` (round 20, proactive replication): a text-space prefix
+    fingerprint in place of token ids — a plane-hinted puller has never
+    seen the prompt, so the WARM exporter resolves the fingerprint back
+    to the token ids its radix is keyed by (miss → empty response, an
+    honest "nothing cached"). ``token_ids`` may be empty when ``fp`` is
+    given; the version stays 1 because old exporters simply see an
+    empty-token request and answer with an empty body."""
     return _pack_header({
         "v": EXPORT_REQUEST_VERSION,
         "key": key,
@@ -802,6 +810,7 @@ def pack_export_request(*, key: str, token_ids: Sequence[int], model_name: str,
         "int8_kv": bool(int8_kv),
         "max_blocks": int(max_blocks),
         "start_block": max(0, int(start_block)),
+        **({"fp": str(fp)} if fp else {}),
     })
 
 

@@ -87,10 +87,21 @@ class PrefixHotSet:
         """Record one served prompt: every full-block boundary fingerprint
         of its canonical text enters (or refreshes) the hot set. Returns
         the number of boundaries recorded."""
-        fps = prefix_fingerprints(
-            canonical_prompt_text(prompt_or_messages),
-            self.block_chars, self.max_blocks,
+        return self.note_fingerprints(
+            prefix_fingerprints(
+                canonical_prompt_text(prompt_or_messages),
+                self.block_chars, self.max_blocks,
+            ),
+            tier=tier,
         )
+
+    def note_fingerprints(self, fps: List[str],
+                          tier: str = TIER_DEVICE) -> int:
+        """Record an already-computed boundary-fingerprint chain (depth
+        order). Split from :meth:`note` so callers that hold the chain —
+        a completed proactive-replication pull advertising adopted KV, a
+        request builder that also feeds the export fp→tokens map — skip
+        the hash pass. Semantics identical to :meth:`note`."""
         if not fps:
             return 0
         with self._lock:

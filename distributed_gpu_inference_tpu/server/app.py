@@ -37,6 +37,7 @@ from .admission import (
     estimate_cost_tokens,
     tenant_of,
 )
+from .calibration import CostCalibration, MigrateHintTracker
 from .flight_recorder import FlightRecorder
 from .geo import GeoService
 from .health import HealthService
@@ -47,6 +48,7 @@ from .prefix_routing import (
     decide_kv_route,
     route_flight_attrs,
 )
+from .replication import ReplicationPlanner
 from .reliability import ReliabilityService
 from .scheduler import (
     _MAX_DISTANCE,
@@ -103,10 +105,27 @@ class ServerState:
         # scheduler/direct-discovery affinity terms read
         self.routing = RoutingConfig()
         self.prefix_registry = PrefixRegistry(self.routing)
+        # cost-model self-calibration (round 20): per-worker online
+        # estimators fed from flight-trace phase durations and
+        # kv_migrate counter deltas. Accumulates always (cheap, bounded);
+        # decide_kv_route only READS measured values while
+        # routing.calibrate is on — off keeps the static priors verbatim.
+        self.calibration = CostCalibration(self.routing)
+        # in-flight migrate-pull pressure per cold worker: fixes the
+        # blind spot where a target already running its full pull budget
+        # was priced as idle (hints expire after migrate_hint_window_s)
+        self.migrate_hints = MigrateHintTracker(self.routing)
+        # proactive prefix replication (round 20): discovery-time heat
+        # tracking + heartbeat-response hints. Gated on routing.replicate
+        # at every call site, so off costs nothing.
+        self.replication = ReplicationPlanner(self.routing,
+                                              self.prefix_registry)
         self.scheduler = SmartScheduler(
             self.store, self.reliability,
             prefix_registry=self.prefix_registry, metrics=self.metrics,
         )
+        self.scheduler.attach_calibration(self.calibration,
+                                          self.migrate_hints)
         # claims brokered by this replica carry its plane_id (NULL when the
         # cohort is disabled) — the audit trail behind the epoch fence
         self.scheduler.plane_id = self.plane.claim_stamp
@@ -148,7 +167,8 @@ class ServerState:
         # advisory: every recorder call is wrapped so it can never fail or
         # reorder a request.
         self.flight = FlightRecorder(metrics=self.metrics,
-                                     tracing=self.tracing)
+                                     tracing=self.tracing,
+                                     calibration=self.calibration)
         self.scheduler.attach_flight(self.flight)
         # gray-failure defense (round 18): windowed per-worker health
         # scores + the healthy→suspect→quarantined→probation machine.
@@ -725,6 +745,13 @@ async def heartbeat(request: web.Request) -> web.Response:
         kvmig = es.get("kv_migrate")
         if isinstance(kvmig, dict):
             st.metrics.record_kv_migrate_engine(worker_id, kvmig)
+            # self-calibration: per-tier pull_bytes/pull_ms deltas feed
+            # the worker's measured handoff bandwidth (accumulates even
+            # with calibrate off — flipping the flag uses warm estimates)
+            try:
+                st.calibration.ingest_kv_migrate(worker_id, kvmig)
+            except Exception:  # noqa: BLE001 — advisory, never 500 a beat
+                pass
         # spill-tier IO health (round 19): put/get errors, corrupt-entry
         # quarantines, breaker states → kv_spill_errors_total{tier} /
         # spill_quarantined_total{tier,reason} / io_breaker_state{tier}
@@ -796,6 +823,21 @@ async def heartbeat(request: web.Request) -> web.Response:
         # serving new prefixes". A restarted worker that no longer ships
         # summaries omits the marker and ages out within one TTL.
         st.prefix_registry.touch(worker_id)
+    replicate_hints = None
+    if st.routing.enabled and st.routing.replicate:
+        # proactive prefix replication: hot prefixes this worker does not
+        # hold ride the response as pull hints. The store query runs only
+        # while the flag is on; off keeps the beat byte-identical.
+        try:
+            srcs = await st.store.list_workers(
+                status=[WorkerState.IDLE.value, WorkerState.BUSY.value]
+            )
+            hints = st.replication.hints_for(worker_id, srcs)
+            if hints:
+                replicate_hints = hints
+                st.metrics.record_kv_replicate_hints(len(hints))
+        except Exception:  # noqa: BLE001 — advisory, never 500 a beat
+            pass
     client_version = int(body.get("config_version") or 0)
     changed = await st.worker_config.config_changed_since(
         worker_id, client_version
@@ -812,6 +854,11 @@ async def heartbeat(request: web.Request) -> web.Response:
         # ACKed delta base. Omitted single-plane: the response stays
         # byte-identical to the pre-cohort build.
         **({"plane_id": st.plane.plane_id} if st.plane.enabled else {}),
+        # proactive replication (round 20): pull-ahead hints for prefixes
+        # heating up that this worker does not advertise. Omitted unless
+        # routing.replicate is on AND the planner found work — the beat
+        # stays byte-identical otherwise.
+        **({"kv_replicate": replicate_hints} if replicate_hints else {}),
     })
 
 
@@ -1528,6 +1575,11 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
         [s for s in (request.query.get("prefix_fps") or "").split(",") if s],
         st.routing.max_fps_per_request,
     )
+    if fps and st.routing.enabled and st.routing.replicate:
+        # proactive replication: every fingerprinted discovery feeds the
+        # prefix heat tracker (bounded, lock-scoped; gated here so the
+        # off path costs nothing)
+        st.replication.note_query(fps, now=now)
     affinity = {}
     score = {}
     if fps and st.routing.enabled:
@@ -1592,12 +1644,27 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
         )
         choice = "recompute"
         if warm_id is not None and warm_blocks > 0:
+            # self-calibration: measured per-worker prefill rate, queue
+            # wait, and handoff bandwidth replace the static priors when
+            # routing.calibrate is on (every accessor returns None while
+            # off or below min_samples — decide_kv_route then uses the
+            # configured prior, byte-identical to the uncalibrated build)
+            cal = st.calibration
             route_decision = decision = decide_kv_route(
                 st.routing, request_blocks=len(fps),
                 matched_blocks=warm_blocks, tier=warm_tier,
                 warm_headroom=headroom[warm_id],
                 cold_headroom=headroom[best["id"]],
                 warm_is_cold=warm_id == best["id"],
+                warm_prefill_tps=cal.prefill_tps(warm_id),
+                cold_prefill_tps=cal.prefill_tps(best["id"]),
+                warm_queue_wait_s=cal.queue_wait_s(warm_id),
+                cold_queue_wait_s=cal.queue_wait_s(best["id"]),
+                migrate_bandwidth=cal.bandwidth(best["id"], warm_tier),
+                # a cold worker already running its pull budget is NOT
+                # idle for one more: each hinted-but-unexpired pull adds
+                # one queued transfer to the migrate estimate
+                cold_inflight_pulls=st.migrate_hints.inflight(best["id"]),
             )
             choice = decision["choice"]
             costs = decision["costs"]
@@ -1627,6 +1694,7 @@ async def nearest_direct_worker(request: web.Request) -> web.Response:
                     "matched_blocks": warm_blocks,
                     "tier": warm_tier,
                 }
+                st.migrate_hints.note(best["id"], now=now)
         st.metrics.record_kv_route_decision("direct", choice)
         route_choice = choice
     # direct-path requests never pass complete_job: a client that wants
@@ -1846,7 +1914,14 @@ async def admin_get_routing(request: web.Request) -> web.Response:
     if (err := _check_admin_key(request)) is not None:
         return err
     st = _state(request)
-    return web.json_response(st.routing.to_dict())
+    # configured priors + what calibration has MEASURED, side by side:
+    # the operator's predicted_vs_measured view of the cost model, plus
+    # the replication planner's heat/hint counters
+    return web.json_response({
+        **st.routing.to_dict(),
+        "calibration": st.calibration.snapshot(),
+        "replication": st.replication.snapshot(),
+    })
 
 
 async def admin_put_routing(request: web.Request) -> web.Response:
@@ -1854,20 +1929,32 @@ async def admin_put_routing(request: web.Request) -> web.Response:
     knobs on the RUNNING control plane (no restart, no worker involvement
     — summaries keep flowing either way, only the scoring term reads the
     flag). ``block_chars`` is intentionally NOT pushable: changing the
-    fingerprint basis requires a coordinated fleet restart."""
+    fingerprint basis requires a coordinated fleet restart.
+
+    ``calibrate_reset: true`` (an action, not a stored knob) freezes the
+    cost model back to the configured priors by dropping every learned
+    estimate — combined with ``calibrate: false`` it is the hard half of
+    the calibration A/B switch."""
     if (err := _check_admin_key(request)) is not None:
         return err
     st = _state(request)
     body = await request.json()
     if not isinstance(body, dict):
         return _json_error(400, "body must be a JSON object")
+    reset = bool(body.pop("calibrate_reset", False))
     try:
         st.routing.update(body)
     except (TypeError, ValueError) as exc:
         return _json_error(400, f"bad routing config: {exc}")
+    if reset:
+        st.calibration.reset()
     await st.store.audit("admin_update_routing", actor="admin",
                          detail=st.routing.to_dict())
-    return web.json_response(st.routing.to_dict())
+    return web.json_response({
+        **st.routing.to_dict(),
+        "calibration": st.calibration.snapshot(),
+        "replication": st.replication.snapshot(),
+    })
 
 
 async def admin_get_health(request: web.Request) -> web.Response:

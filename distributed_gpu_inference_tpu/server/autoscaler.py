@@ -221,3 +221,104 @@ class BrownoutAutoscaler:
             except Exception:  # noqa: BLE001 — metrics must not gate
                 pass
         return action
+
+
+# ---------------------------------------------------------------------------
+# predictive PD rebalance (round 20)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PredictiveRebalanceConfig:
+    # off by default: tick() is a no-op and the PD pool behaves exactly
+    # as the reactive-only build
+    enabled: bool = False
+    # preflip when the PROJECTED SLO drops below this; None inherits the
+    # autoscaler's own slo_target (one knob, one truth)
+    slo_target: Optional[float] = None
+    # restore preflips only once projected SLO recovers ABOVE
+    # target + hysteresis — a value hovering at the target must not flap
+    # roles every tick
+    hysteresis: float = 0.05
+    # preflip only when the starved side's free capacity is below this
+    # fraction of the donor side's: a projected miss with BALANCED pools
+    # is an under-provisioned fleet (scale out), not a role imbalance
+    imbalance_ratio: float = 0.5
+    # between consecutive preflips
+    cooldown_s: float = 5.0
+    # bound how much of the donor side a streak of misses can convert
+    max_preflips: int = 1
+
+
+class PredictiveRebalancer:
+    """Couples the brownout autoscaler's projected-SLO signal to PD role
+    rebalancing: when the projection says the fleet will miss its target
+    one cold-start from now AND one PD side is starved for capacity while
+    the other has headroom, flip a donor worker to HYBRID *before* the
+    starved queue melts down (the reactive ``role_rebalance`` in
+    :class:`~.pd_scheduler.PrefillDecodeScheduler` only fires once a side
+    is already dark). The same starved-side signal is returned to the
+    scale driver so a scale-out lands a replica of the role the
+    projection says will be short.
+
+    Advisory and reversible: a wrong prediction costs one worker serving
+    hybrid for a few ticks — roles gate new assignments only, in-flight
+    work is untouched, and recovery past target + hysteresis restores
+    the configured roles."""
+
+    def __init__(self, autoscaler: BrownoutAutoscaler, pd_scheduler: Any,
+                 cfg: Optional[PredictiveRebalanceConfig] = None,
+                 metrics: Optional[Any] = None) -> None:
+        self.autoscaler = autoscaler
+        self.pd = pd_scheduler
+        self.cfg = cfg or PredictiveRebalanceConfig()
+        self.metrics = metrics
+        self._last_flip = -float("inf")
+        self.stats = {"ticks": 0, "preflips": 0, "restores": 0,
+                      "suggestions": 0}
+
+    def _record(self, action: str) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.record_predictive_rebalance(action)
+            except Exception:  # noqa: BLE001 — metrics must not gate
+                pass
+
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One control pass. Returns the PD role the NEXT scale-out
+        should target (the projected-starved side), or None (no signal /
+        disabled / balanced)."""
+        if not self.cfg.enabled:
+            return None
+        now = time.time() if now is None else now
+        self.stats["ticks"] += 1
+        projected = self.autoscaler.projected_slo(now)
+        target = (self.autoscaler.cfg.slo_target
+                  if self.cfg.slo_target is None else self.cfg.slo_target)
+        if projected is None:
+            return None
+        if projected >= target + self.cfg.hysteresis:
+            if self.pd.restore_preflips():
+                self.stats["restores"] += 1
+                self._record("restore")
+            return None
+        if projected >= target:
+            return None   # inside the hysteresis band: hold current shape
+        cap = self.pd.capacity_by_role()
+        pf, dc = int(cap.get("prefill") or 0), int(cap.get("decode") or 0)
+        if pf == dc:
+            return None   # balanced shortage → plain scale-out territory
+        starved = "prefill" if pf < dc else "decode"
+        starved_free, donor_free = (pf, dc) if starved == "prefill" \
+            else (dc, pf)
+        self.stats["suggestions"] += 1
+        self._record("scale_out_role")
+        if donor_free > 0 and \
+                starved_free < self.cfg.imbalance_ratio * donor_free and \
+                len(self.pd._preflipped) < max(0, self.cfg.max_preflips) and \
+                now - self._last_flip >= self.cfg.cooldown_s:
+            if self.pd.preflip_role(starved) is not None:
+                self._last_flip = now
+                self.stats["preflips"] += 1
+                self._record("preflip")
+        return starved

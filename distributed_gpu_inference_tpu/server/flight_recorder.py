@@ -106,9 +106,15 @@ class FlightRecorder:
                  tracing: Optional[Any] = None,
                  trace_cap: int = TRACE_CAP,
                  event_cap: int = FLIGHT_EVENT_CAP,
-                 exemplars_per_phase: int = EXEMPLARS_PER_PHASE) -> None:
+                 exemplars_per_phase: int = EXEMPLARS_PER_PHASE,
+                 calibration: Optional[Any] = None) -> None:
         self._metrics = metrics
         self._tracing = tracing
+        # cost-model self-calibration sink (server/calibration.py): done
+        # wires carry the full per-source event list, whose queue-wait /
+        # prefill spans are the calibration samples. Optional and
+        # best-effort — a calibration failure never rejects a wire
+        self._calibration = calibration
         self._trace_cap = max(1, int(trace_cap))
         self._event_cap = max(1, int(event_cap))
         self._traces: "OrderedDict[str, _Trace]" = OrderedDict()
@@ -282,6 +288,15 @@ class FlightRecorder:
                 tr.done_sources.add(source)
                 changed = True
             self.stats["wire_ingested"] += 1
+        if self._calibration is not None and wire.get("done"):
+            # done wires carry the full event list — one calibration
+            # sample per (trace, worker), deduped inside the calibrator
+            # (the heartbeat ring re-ships recent done wires every beat)
+            try:
+                self._calibration.ingest_trace(
+                    str(worker_id or source), tid, cleaned)
+            except Exception:  # noqa: BLE001 — advisory, never fatal
+                pass
         return changed
 
     # -- merged views ---------------------------------------------------------

@@ -109,7 +109,8 @@ class Metrics:
                 "pd_handoffs", "pd_handoff_bytes", "pd_reprefill",
                 "pd_fleet_balance",
                 "kv_migrations", "kv_migration_bytes",
-                "kv_route_decisions",
+                "kv_route_decisions", "kv_replicate_hints",
+                "predictive_rebalance",
                 "admission_decisions", "tenant_admissions",
                 "autoscaler_decisions", "autoscaler_replicas",
                 "autoscaler_slo", "autoscaler_cold_start",
@@ -383,6 +384,20 @@ class Metrics:
             "kv_route_decisions_total",
             "Router cost-model decisions (warm / migrate / recompute)",
             ["path", "choice"], registry=r)
+        # predictive placement (round 20): proactive-replication hints
+        # handed out per heartbeat, and predictive PD rebalance actions —
+        # both advisory signals, so a panel reading hints without a
+        # matching rise in kv_migrations{outcome=replicated} means the
+        # workers are dropping them (budget/backoff) rather than failing
+        self.kv_replicate_hints = Counter(
+            "kv_replicate_hints_total",
+            "Proactive prefix-replication pull hints handed to workers",
+            registry=r)
+        self.predictive_rebalance = Counter(
+            "predictive_rebalance_total",
+            "Predictive PD rebalance actions "
+            "(preflip / restore / scale_out_role)",
+            ["action"], registry=r)
         # SLO-native overload control (round 12): every rung of the
         # degrade/shed ladder is counted by tier — a brownout panel reads
         # "free degrading, paid accepting" directly from this series, and
@@ -712,6 +727,12 @@ class MetricsCollector:
         ("local_hits", "local_hit"),
         ("exports", "export_served"),
         ("prefix_commits", "prefix_commit"),
+        # proactive replication (round 20): hint-driven pulls, keyed off
+        # the same engine stats dict — committed / fp-miss (exporter
+        # churned the prefix out) / aborted mid-pull
+        ("replicated", "replicated"),
+        ("replicate_miss", "replicate_miss"),
+        ("replicate_aborted", "replicate_aborted"),
     )
 
     def record_kv_migrate_engine(self, worker: str,
@@ -749,6 +770,18 @@ class MetricsCollector:
                     worker, direction
                 ).inc(delta)
             prev[key] = cur
+
+    def record_kv_replicate_hints(self, n: int) -> None:
+        """Count proactive-replication hints handed out in a heartbeat
+        response (the plane-side half; the worker-side outcomes arrive
+        through ``record_kv_migrate_engine``)."""
+        if n > 0:
+            self.metrics.kv_replicate_hints.inc(n)
+
+    def record_predictive_rebalance(self, action: str) -> None:
+        """Count one predictive PD rebalance action (preflip / restore /
+        scale_out_role)."""
+        self.metrics.predictive_rebalance.labels(action).inc()
 
     def record_kv_spill_engine(self, worker: str,
                                stats: Dict[str, Any]) -> None:

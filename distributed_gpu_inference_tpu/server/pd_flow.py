@@ -75,6 +75,10 @@ class PDFlowService:
         self.metrics = metrics
         self.max_reprefills = max_reprefills
         self.reprefill_backoff_s = reprefill_backoff_s
+        # predictive rebalance (round 20): optional
+        # ``server.autoscaler.PredictiveRebalancer`` ticked on every
+        # placement sync — None (default) keeps the reactive-only build
+        self.rebalancer: Optional[Any] = None
         # request_id → PDRequest (placement state released on completion)
         self._live: Dict[str, PDRequest] = {}
         # in-flight delayed re-placement tasks (strong refs)
@@ -95,19 +99,25 @@ class PDFlowService:
                 else TpuTopology()
             role = WorkerRole(w.get("role") or "hybrid")
             cap = WorkerCapability.from_topology(w["id"], topo, role=role)
-            existing = self.scheduler.worker(w["id"])
-            if existing is not None:
-                # refresh the capability IN PLACE — register_worker would
-                # replace the pool entry and zero active_prefill/active_decode
-                # for live placements, unbinding the batch caps
-                existing.cap = cap
-            else:
-                self.scheduler.register_worker(cap)
+            # refresh IN PLACE for live workers (register_worker would
+            # zero active_prefill/active_decode for live placements,
+            # unbinding the batch caps) — and a predictive preflip must
+            # survive the refresh, so the scheduler owns the merge
+            self.scheduler.refresh_worker(cap)
             seen.add(w["id"])
         for wid in [w.cap.worker_id for w in
                     self.scheduler._workers.values()]:
             if wid not in seen:
                 self.scheduler.remove_worker(wid)
+        if self.rebalancer is not None:
+            # predictive rebalance rides the placement sync: the
+            # projection is re-read against fresh capabilities, preflips
+            # restore once it recovers. Advisory — a rebalancer failure
+            # never blocks a placement.
+            try:
+                self.rebalancer.tick()
+            except Exception:  # noqa: BLE001
+                pass
         if self.metrics is not None:
             # pd_fleet_balance{role}: free capacity per side, refreshed on
             # every placement pass — a side pinned at 0 while the other

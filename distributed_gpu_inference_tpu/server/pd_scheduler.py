@@ -162,11 +162,17 @@ class PrefillDecodeScheduler:
         # role temporarily accept hybrid work instead of idling while the
         # starved queue melts down — counted, so the condition is visible
         self.allow_role_rebalance = allow_role_rebalance
+        # predictive rebalance (round 20): worker_id -> ORIGINAL role for
+        # workers temporarily flipped to HYBRID ahead of a projected SLO
+        # miss (reactive role_rebalance above only fires once a side is
+        # already dark; the preflip acts on the projection)
+        self._preflipped: Dict[str, WorkerRole] = {}
         self.stats: Dict[str, Any] = {
             "submitted": 0, "prefills_assigned": 0, "decodes_assigned": 0,
             "migrations_requested": 0, "affinity_hits": 0, "completed": 0,
             "migration_failures": 0, "migration_dropped": 0,
             "role_rebalanced_prefill": 0, "role_rebalanced_decode": 0,
+            "preflipped": 0, "preflip_restored": 0,
         }
 
     # -- pool membership ----------------------------------------------------
@@ -176,6 +182,67 @@ class PrefillDecodeScheduler:
 
     def remove_worker(self, worker_id: str) -> None:
         self._workers.pop(worker_id, None)
+        self._preflipped.pop(worker_id, None)
+
+    def refresh_worker(self, cap: WorkerCapability) -> None:
+        """Refresh a live worker's capability IN PLACE (register_worker
+        would replace the pool entry and zero active_prefill/active_decode
+        for live placements, unbinding the batch caps). A preflipped
+        worker keeps its temporary HYBRID role across refreshes — the
+        store-configured role becomes the restore target instead."""
+        w = self._workers.get(cap.worker_id)
+        if w is None:
+            self.register_worker(cap)
+            return
+        if cap.worker_id in self._preflipped:
+            self._preflipped[cap.worker_id] = cap.role
+            cap.role = WorkerRole.HYBRID
+        w.cap = cap
+
+    # -- predictive preflip (round 20) ---------------------------------------
+
+    def preflip_role(self, starved: str) -> Optional[str]:
+        """Flip ONE worker of the role OPPOSITE ``starved`` to HYBRID so
+        it can absorb starved-side work before the projected brownout
+        lands. Picks the donor with the most free capacity on its own
+        side (the flip costs the donating side least). Returns the
+        flipped worker id, or None (no single-role donor left). The
+        original role is remembered; :meth:`restore_preflips` reverts."""
+        donor_role = (WorkerRole.DECODE if starved == "prefill"
+                      else WorkerRole.PREFILL)
+        best: Optional[_PoolWorker] = None
+        best_free = -1
+        for w in self._workers.values():
+            if w.cap.role is not donor_role or \
+                    w.cap.worker_id in self._preflipped:
+                continue
+            free = (w.cap.max_decode_batch - w.active_decode
+                    if donor_role is WorkerRole.DECODE
+                    else w.cap.max_prefill_batch - w.active_prefill)
+            if free > best_free:
+                best, best_free = w, free
+        if best is None:
+            return None
+        self._preflipped[best.cap.worker_id] = best.cap.role
+        best.cap.role = WorkerRole.HYBRID
+        self.stats["preflipped"] += 1
+        return best.cap.worker_id
+
+    def restore_preflips(self) -> int:
+        """Put every preflipped worker back on its configured role (the
+        projected miss resolved). Returns the number restored. In-flight
+        work on a restored worker finishes normally — roles gate NEW
+        assignments only."""
+        n = 0
+        for wid, role in list(self._preflipped.items()):
+            w = self._workers.get(wid)
+            if w is not None:
+                w.cap.role = role
+                n += 1
+            del self._preflipped[wid]
+        if n:
+            self.stats["preflip_restored"] += n
+        return n
 
     def worker(self, worker_id: str) -> Optional[_PoolWorker]:
         return self._workers.get(worker_id)

@@ -86,13 +86,51 @@ class RoutingConfig:
     migrate_bandwidth_bytes_per_s: float = 1e9
     migrate_prefill_tokens_per_s: float = 4000.0
     migrate_queue_wait_s: float = 2.0
+    # -- cost-model self-calibration (round 20) -----------------------------
+    # master switch: when ON, decide-time calls substitute per-worker
+    # MEASURED prefill tok/s, queue-wait and per-(worker, tier) handoff
+    # bandwidth (server/calibration.py, fed from flight traces and the
+    # worker kv_migrate wire counters) for the four static priors above.
+    # OFF by default: routing is byte-identical to the static cost model —
+    # ingestion still runs (the /admin/routing snapshot shows what WOULD
+    # be used), but no decision reads a learned value
+    calibrate: bool = False
+    # EMA smoothing for each estimator (higher = reacts faster)
+    calibrate_alpha: float = 0.3
+    # once warm, a sample further than this factor from the running value
+    # is clamped before blending (one 60 s GC pause must not poison the
+    # queue-wait estimate)
+    calibrate_clamp: float = 5.0
+    # estimators answer None (→ caller keeps the prior) below this many
+    # samples — never steer placement off one lucky measurement
+    calibrate_min_samples: int = 3
+    # sliding window for the in-flight migrate-hint tracker: hints older
+    # than this are presumed resolved (pull done or abandoned) and stop
+    # inflating the cold-side queue estimate. Always on with kv_migrate —
+    # it is a correctness-of-estimate fix, not a predictor
+    migrate_hint_window_s: float = 10.0
+    # -- proactive prefix replication (round 20) ----------------------------
+    # master switch: the plane watches prefix hit-velocity at discovery
+    # time and rides kv_replicate hints down the heartbeat response to
+    # cold workers, which pull via the existing /kv/export protocol under
+    # the same budget/backoff as reactive migration. OFF by default
+    replicate: bool = False
+    # a deepest-boundary fingerprint is "hot" at this many discovery hits
+    # inside replicate_window_s
+    replicate_hot_threshold: int = 3
+    replicate_window_s: float = 10.0
+    # hints per heartbeat response (each is one bounded pull on the worker)
+    replicate_max_hints: int = 2
+    # per-(worker, prefix) re-hint cooldown: a worker that dropped or
+    # failed a hint is not re-asked until this elapses
+    replicate_cooldown_s: float = 30.0
 
     def update(self, d: Dict[str, Any]) -> None:
         # validate EVERYTHING before applying ANYTHING: a 400 answer must
         # leave the live config untouched (a half-applied push would flip
         # the A/B switch while reporting failure)
         staged: Dict[str, Any] = {}
-        for flag in ("enabled", "kv_migrate"):
+        for flag in ("enabled", "kv_migrate", "calibrate", "replicate"):
             if d.get(flag) is not None:
                 v = d[flag]
                 if isinstance(v, str):
@@ -116,14 +154,20 @@ class RoutingConfig:
                            float("inf")),
                           ("migrate_prefill_tokens_per_s", 1.0,
                            float("inf")),
-                          ("migrate_queue_wait_s", 0.0, float("inf"))):
+                          ("migrate_queue_wait_s", 0.0, float("inf")),
+                          ("calibrate_alpha", 0.0, 1.0),
+                          ("calibrate_clamp", 1.0, float("inf")),
+                          ("migrate_hint_window_s", 0.1, float("inf")),
+                          ("replicate_window_s", 0.1, float("inf")),
+                          ("replicate_cooldown_s", 0.0, float("inf"))):
             if d.get(k) is not None:
                 v = float(d[k])
                 if not lo <= v <= hi:
                     raise ValueError(f"{k}: {v} outside [{lo}, {hi}]")
                 staged[k] = v
         for k in ("summary_max_entries", "max_fps_per_request",
-                  "migrate_min_blocks"):
+                  "migrate_min_blocks", "calibrate_min_samples",
+                  "replicate_hot_threshold", "replicate_max_hints"):
             if d.get(k) is not None:
                 v = int(d[k])
                 if v < 1:
@@ -162,6 +206,16 @@ class RoutingConfig:
             "migrate_prefill_tokens_per_s":
                 self.migrate_prefill_tokens_per_s,
             "migrate_queue_wait_s": self.migrate_queue_wait_s,
+            "calibrate": self.calibrate,
+            "calibrate_alpha": self.calibrate_alpha,
+            "calibrate_clamp": self.calibrate_clamp,
+            "calibrate_min_samples": self.calibrate_min_samples,
+            "migrate_hint_window_s": self.migrate_hint_window_s,
+            "replicate": self.replicate,
+            "replicate_hot_threshold": self.replicate_hot_threshold,
+            "replicate_window_s": self.replicate_window_s,
+            "replicate_max_hints": self.replicate_max_hints,
+            "replicate_cooldown_s": self.replicate_cooldown_s,
         }
 
 
@@ -490,7 +544,13 @@ class PrefixRegistry:
 def decide_kv_route(cfg: RoutingConfig, *, request_blocks: int,
                     matched_blocks: int, tier: str,
                     warm_headroom: float, cold_headroom: float,
-                    warm_is_cold: bool = False) -> Dict[str, Any]:
+                    warm_is_cold: bool = False,
+                    warm_prefill_tps: Optional[float] = None,
+                    cold_prefill_tps: Optional[float] = None,
+                    warm_queue_wait_s: Optional[float] = None,
+                    cold_queue_wait_s: Optional[float] = None,
+                    migrate_bandwidth: Optional[float] = None,
+                    cold_inflight_pulls: int = 0) -> Dict[str, Any]:
     """Choose route-to-warm / migrate-KV / recompute for ONE request.
 
     Inputs are the router's estimates: ``request_blocks`` = the request's
@@ -504,6 +564,17 @@ def decide_kv_route(cfg: RoutingConfig, *, request_blocks: int,
     - migrate:   wait(cold) + transfer(matched, tier) + prefill(unmatched)
     - recompute: wait(cold) + prefill(all)
 
+    The five ``*_tps`` / ``*_wait`` / ``migrate_bandwidth`` keywords are
+    the calibration overrides: a MEASURED per-worker rate replaces the
+    corresponding ``cfg`` prior when given (None — the default, and what
+    every call passes while calibration is off or cold — keeps the cost
+    arithmetic byte-identical to the static model).
+    ``cold_inflight_pulls`` folds the pulls the plane has already steered
+    at the cold candidate into its queue estimate: each outstanding pull
+    serializes on the worker's ``kv_migrate_budget``, so a target mid-way
+    through its budget no longer prices as idle (the burst-race fix —
+    without it every request in a storm migrates to the same exporter).
+
     The decision is advisory, exactly like affinity: a wrong estimate
     costs latency, never correctness (the worker-side pull falls back to
     recompute on any failure). Returns ``{"choice", "costs"}``;
@@ -513,22 +584,33 @@ def decide_kv_route(cfg: RoutingConfig, *, request_blocks: int,
     total_tokens = max(request_blocks, matched_blocks, 1) * bc
     matched_tokens = max(0, matched_blocks) * bc
 
-    def _wait(headroom: float) -> float:
-        return (1.0 - max(0.0, min(1.0, headroom))) * cfg.migrate_queue_wait_s
+    def _wait(headroom: float, measured: Optional[float]) -> float:
+        base = cfg.migrate_queue_wait_s if measured is None else measured
+        return (1.0 - max(0.0, min(1.0, headroom))) * base
 
-    def _prefill(tokens: float) -> float:
-        return max(0.0, tokens) / cfg.migrate_prefill_tokens_per_s
+    def _prefill(tokens: float, measured: Optional[float]) -> float:
+        tps = (cfg.migrate_prefill_tokens_per_s if measured is None
+               else max(1.0, measured))
+        return max(0.0, tokens) / tps
 
+    bw = (cfg.migrate_bandwidth_bytes_per_s if migrate_bandwidth is None
+          else max(1.0, migrate_bandwidth))
+    transfer_s = (matched_tokens * cfg.migrate_bytes_per_token
+                  * MIGRATE_TIER_COST.get(tier, 1.0) / bw)
     costs = {
-        "warm": _wait(warm_headroom) + _prefill(total_tokens
-                                                - matched_tokens),
+        "warm": (_wait(warm_headroom, warm_queue_wait_s)
+                 + _prefill(total_tokens - matched_tokens,
+                            warm_prefill_tps)),
         "migrate": (
-            _wait(cold_headroom) + _prefill(total_tokens - matched_tokens)
-            + (matched_tokens * cfg.migrate_bytes_per_token
-               * MIGRATE_TIER_COST.get(tier, 1.0)
-               / cfg.migrate_bandwidth_bytes_per_s)
+            _wait(cold_headroom, cold_queue_wait_s)
+            + _prefill(total_tokens - matched_tokens, cold_prefill_tps)
+            + transfer_s
+            # each pull already in flight at the target serializes ahead
+            # of this one on the worker's kv_migrate_budget
+            + max(0, cold_inflight_pulls) * transfer_s
         ),
-        "recompute": _wait(cold_headroom) + _prefill(total_tokens),
+        "recompute": (_wait(cold_headroom, cold_queue_wait_s)
+                      + _prefill(total_tokens, cold_prefill_tps)),
     }
     if matched_blocks <= 0:
         return {"choice": "recompute", "costs": costs}

@@ -146,12 +146,23 @@ class SmartScheduler:
         # path. Attached post-construction by ServerState; None (or the
         # service disabled) keeps the claim path byte-identical.
         self._health = None
+        # cost-model self-calibration (round 20): measured per-worker
+        # prefill/queue/bandwidth estimates + the in-flight migrate-pull
+        # tracker. Attached post-construction; None (or calibrate off)
+        # keeps the claim-path cost model on its static priors.
+        self._calibration = None
+        self._migrate_hints = None
 
     def attach_flight(self, flight: Any) -> None:
         self._flight = flight
 
     def attach_health(self, health: Any) -> None:
         self._health = health
+
+    def attach_calibration(self, calibration: Any,
+                           migrate_hints: Any = None) -> None:
+        self._calibration = calibration
+        self._migrate_hints = migrate_hints
 
     def _flight_note(self, job: Dict[str, Any], event: str,
                      **attrs: Any) -> None:
@@ -351,11 +362,28 @@ class SmartScheduler:
                     isinstance(job.get("params"), dict):
                 me = next((c for c in cands if c["id"] == worker_id), None)
                 cold_head = graded_load_score(me) if me is not None else 1.0
+                cal = self._calibration
                 decision = decide_kv_route(
                     reg.config, request_blocks=len(fps),
                     matched_blocks=blocks, tier=tier,
                     warm_headroom=graded_load_score(by_id[warm_id]),
                     cold_headroom=cold_head,
+                    # self-calibration (round 20): measured values when
+                    # attached + warm + flag on; every accessor returns
+                    # None otherwise, keeping the static priors verbatim
+                    warm_prefill_tps=(cal.prefill_tps(warm_id)
+                                      if cal is not None else None),
+                    cold_prefill_tps=(cal.prefill_tps(worker_id)
+                                      if cal is not None else None),
+                    warm_queue_wait_s=(cal.queue_wait_s(warm_id)
+                                       if cal is not None else None),
+                    cold_queue_wait_s=(cal.queue_wait_s(worker_id)
+                                       if cal is not None else None),
+                    migrate_bandwidth=(cal.bandwidth(worker_id, tier)
+                                       if cal is not None else None),
+                    cold_inflight_pulls=(
+                        self._migrate_hints.inflight(worker_id)
+                        if self._migrate_hints is not None else 0),
                 )
                 # wait(cold) appears in both remaining costs, so this is
                 # exactly "transfer beats the saved prefill"
@@ -368,6 +396,8 @@ class SmartScheduler:
                         "matched_blocks": blocks,
                         "tier": tier,
                     }
+                    if self._migrate_hints is not None:
+                        self._migrate_hints.note(worker_id)
         if self._metrics is not None:
             self._metrics.record_kv_route_decision("queued", choice)
         from .prefix_routing import route_flight_attrs

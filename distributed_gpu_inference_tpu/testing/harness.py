@@ -976,11 +976,17 @@ class FleetAutoscaler:
 
     def __init__(self, fleet: LiveFleet, autoscaler: Any,
                  tick_s: float = 0.5,
-                 scale_out_role: Optional[str] = None) -> None:
+                 scale_out_role: Optional[str] = None,
+                 rebalancer: Optional[Any] = None) -> None:
         self.fleet = fleet
         self.autoscaler = autoscaler
         self.tick_s = tick_s
         self.scale_out_role = scale_out_role
+        # predictive rebalance (round 18): a
+        # ``server.autoscaler.PredictiveRebalancer`` ticked every loop —
+        # its starved-side suggestion overrides the static scale_out_role
+        # so a projected prefill shortage lands a prefill replica
+        self.rebalancer = rebalancer
         self.actions: List[tuple] = []       # (wall_offset_s, action)
         self._t0 = time.monotonic()
         self._stop = threading.Event()
@@ -1006,12 +1012,20 @@ class FleetAutoscaler:
     def _loop(self) -> None:
         while not self._stop.wait(self.tick_s):
             replicas = len(self.fleet.alive_members())
+            suggested = None
+            if self.rebalancer is not None:
+                # every tick, not just scale-outs: restores (projection
+                # recovered) must land even while the fleet holds
+                try:
+                    suggested = self.rebalancer.tick()
+                except Exception:  # noqa: BLE001 — advisory
+                    suggested = None
             action = self.autoscaler.tick(replicas, self._utilization())
             if action == "scale_out":
                 self.actions.append(
                     (time.monotonic() - self._t0, "scale_out"))
                 self.autoscaler.note_scale_out_started()
-                self.fleet.scale_out(role=self.scale_out_role)
+                self.fleet.scale_out(role=suggested or self.scale_out_role)
                 # scale_out blocks through engine build + registration +
                 # first heartbeat: the replica is ready to serve, so this
                 # IS the cold-start lead time the projection needs

@@ -168,6 +168,11 @@ class ServingConfig(BaseModel):
     # boundary). Never fires for deadline-less requests. Remote-pushable.
     abandon_deadlines: bool = False
     deadline_grace_s: float = 0.5
+    # predictive abandonment (round 18): the same ITL projection fires
+    # BEFORE the deadline passes, so a job that provably cannot land stops
+    # burning ragged-round slots immediately (counted separately as
+    # ``abandoned_predictive``). Requires abandon_deadlines. Remote-pushable.
+    predictive_abandon: bool = False
 
     @model_validator(mode="after")
     def _warn_deprecated(self) -> "ServingConfig":

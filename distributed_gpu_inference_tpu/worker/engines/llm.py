@@ -21,6 +21,7 @@ import queue as _queue_mod
 import random
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import httpx
@@ -92,6 +93,8 @@ SERVING_REMOTE_KEYS: Dict[str, str] = {
     # per step-boundary scan — flip it live to shed doomed work fleet-wide
     "abandon_deadlines": "abandon_deadlines",
     "deadline_grace_s": "deadline_grace_s",
+    # round 20: fire the same projection BEFORE the deadline passes
+    "predictive_abandon": "predictive_abandon",
 }
 
 
@@ -402,7 +405,17 @@ class TPULLMEngine(LLMBaseEngine):
             "local_hits": 0,
             "pull_bytes": 0, "pull_blocks": 0,
             "exports": 0, "export_bytes": 0,
+            # proactive replication (round 20): plane-hinted prefetch pulls
+            "replicated": 0, "replicate_miss": 0, "replicate_aborted": 0,
         }
+        # fingerprint → prompt token ids, for fp-keyed exports (round 18
+        # proactive replication: the COLD puller knows only the text-space
+        # fingerprint the plane hinted; this worker — the warm exporter —
+        # resolves it back to the exact token ids its radix is keyed by).
+        # Bounded LRU, populated per built request alongside the hot-set
+        # note; entries for one prompt share one token list.
+        self._kvmig_fp_tokens: "OrderedDict[str, List[int]]" = OrderedDict()
+        self._kvmig_fp_cap = 512
         # request flight recorder (round 14): per-request Timelines for
         # traced requests (params carry a trace_id). Completed timelines
         # ride job results (complete_job) AND a bounded heartbeat ring
@@ -641,6 +654,7 @@ class TPULLMEngine(LLMBaseEngine):
             prefill_budget=int(sv.get("prefill_budget") or 0),
             abandon_deadlines=bool(sv.get("abandon_deadlines") or False),
             deadline_grace_s=float(sv.get("deadline_grace_s") or 0.5),
+            predictive_abandon=bool(sv.get("predictive_abandon") or False),
         )
 
     def apply_serving_config(self, updates: Optional[Dict[str, Any]]) -> None:
@@ -802,7 +816,28 @@ class TPULLMEngine(LLMBaseEngine):
             # every built request's prefix will be radix-cached on
             # completion — record its boundary fingerprints for the
             # heartbeat summary (advisory; one O(prefix) hash pass)
-            hot.note(prompt_or_messages)
+            from ...utils.prefixes import (
+                canonical_prompt_text,
+                prefix_fingerprints,
+            )
+            fps = prefix_fingerprints(
+                canonical_prompt_text(prompt_or_messages),
+                hot.block_chars, hot.max_blocks,
+            )
+            hot.note_fingerprints(fps)
+            if fps and self.kv_migrate_enabled:
+                if token_ids is None:
+                    token_ids = self._encode_prompt(prompt_or_messages, cfg)
+                # fp-keyed export resolution (proactive replication): a
+                # cold puller hints only the text-space fingerprint; map
+                # every boundary of this prompt to its token ids so
+                # kv_export can serve the pull. One shared list per prompt
+                with self._kvmig_lock:
+                    for fp in fps:
+                        self._kvmig_fp_tokens[fp] = token_ids
+                        self._kvmig_fp_tokens.move_to_end(fp)
+                    while len(self._kvmig_fp_tokens) > self._kvmig_fp_cap:
+                        self._kvmig_fp_tokens.popitem(last=False)
         if token_ids is None:
             token_ids = self._encode_prompt(prompt_or_messages, cfg)
         return InferenceRequest(
@@ -1470,9 +1505,18 @@ class TPULLMEngine(LLMBaseEngine):
         max_blocks = min(
             self._kvmig_max_blocks, int(req.get("max_blocks") or 64)
         )
+        token_ids = req.get("token_ids") or []
+        if not token_ids and req.get("fp"):
+            # fp-keyed pull (proactive replication): the cold puller never
+            # saw the prompt — resolve the hinted fingerprint back to the
+            # token ids our radix is keyed by. A miss (LRU churn, restart)
+            # answers empty: an honest "nothing cached", never an error
+            with self._kvmig_lock:
+                token_ids = self._kvmig_fp_tokens.get(
+                    str(req["fp"])) or []
         with self._engine_lock:
             frames, info = self._exclusive(lambda: export_prefix_frames(
-                eng, req.get("token_ids") or [], str(req.get("key") or ""),
+                eng, token_ids, str(req.get("key") or ""),
                 max_blocks=max_blocks,
                 start_block=int(req.get("start_block") or 0),
             ))
@@ -1598,6 +1642,13 @@ class TPULLMEngine(LLMBaseEngine):
                 return
             tl.note("kv_migrate.begin", peer=hint.get("worker_id"),
                     matched_blocks=hint.get("matched_blocks"))
+            # source tier the router priced the pull at (validated — the
+            # hint crosses the wire): keys the per-tier bandwidth counters
+            # the plane's cost calibration delta-anchors
+            tier = hint.get("tier")
+            if tier not in ("dev", "host", "spill"):
+                tier = "dev"
+            t_pull = time.monotonic()
             req_raw = pack_export_request(
                 key=key, token_ids=token_ids,
                 model_name=eng.model_cfg.name,
@@ -1645,10 +1696,19 @@ class TPULLMEngine(LLMBaseEngine):
                                         - (int(committed.get("cached_tokens")
                                                or 0)
                                            // eng.cfg.block_size))
-            stats["pull_bytes"] += sum(len(f) for f in frames)
+            pull_bytes = sum(len(f) for f in frames)
+            stats["pull_bytes"] += pull_bytes
+            # per-tier measured transfer: cumulative (bytes, wall-ms)
+            # pairs whose heartbeat deltas give the plane one bandwidth
+            # sample per pull (server/calibration.py)
+            pull_ms = max(1, int((time.monotonic() - t_pull) * 1000.0))
+            stats[f"pull_bytes_{tier}"] = (
+                stats.get(f"pull_bytes_{tier}", 0) + pull_bytes)
+            stats[f"pull_ms_{tier}"] = (
+                stats.get(f"pull_ms_{tier}", 0) + pull_ms)
             tl.note("kv_migrate.pulled",
                     blocks=int(committed.get("blocks") or 0),
-                    bytes=sum(len(f) for f in frames))
+                    bytes=pull_bytes)
             self._kvmig_peer_result(url, ok=True)
         except Exception as exc:  # noqa: BLE001 — migration is best-effort
             stats["aborted"] += 1
@@ -1665,6 +1725,138 @@ class TPULLMEngine(LLMBaseEngine):
             if begun:
                 # drop a half-built session NOW instead of letting it pin
                 # blocks until the receiver's TTL purge
+                try:
+                    self.kv_receiver(abort_message(key))
+                except Exception:  # noqa: BLE001 — abort is best-effort
+                    pass
+
+    def kv_replicate(self, hints: Any) -> int:
+        """Plane-hinted proactive prefix replication (round 20): the
+        heartbeat response named hot prefixes this worker does NOT hold
+        that a warm peer exports — pull them NOW, ahead of the predicted
+        storm, over the same chaos-hardened ``/kv/export`` protocol the
+        reactive migrate driver uses (same budget, same per-peer backoff,
+        same recompute-on-any-failure stance). Pulls run on a daemon
+        thread — a prefetch must never sit in the heartbeat loop. Returns
+        the number of hints accepted (0 = all malformed/disabled; a
+        budget-full drop happens later, on the thread, and the plane
+        simply re-hints after its cooldown)."""
+        if not self.kv_migrate_enabled or not self.loaded \
+                or self.engine is None \
+                or not self.engine.cfg.enable_prefix_cache:
+            return 0
+        todo = []
+        for h in hints if isinstance(hints, list) else []:
+            if not isinstance(h, dict):
+                continue
+            fps = h.get("fps")
+            url = str(h.get("data_plane_url") or "").rstrip("/")
+            if not url or not isinstance(fps, list) or not fps \
+                    or not all(isinstance(f, str) for f in fps):
+                continue
+            todo.append((h, url, [str(f) for f in fps]))
+        if not todo:
+            return 0
+        threading.Thread(
+            target=self._kv_replicate_run, args=(todo,),
+            name="kv-replicate", daemon=True,
+        ).start()
+        return len(todo)
+
+    def _kv_replicate_run(self, todo: List[tuple]) -> None:
+        for hint, url, fps in todo:
+            try:
+                self._kv_replicate_pull(hint, url, fps)
+            except Exception:  # noqa: BLE001 — prefetch is best-effort
+                pass
+
+    def _kv_replicate_pull(self, hint: Dict[str, Any], url: str,
+                           fps: List[str]) -> None:
+        eng = self.engine
+        hot = self.prefix_hot
+        stats = self.kv_migrate_stats
+        if eng is None:
+            return
+        if hot is not None and fps[-1] in hot.snapshot():
+            return   # a racing request already landed it — nothing to do
+        if not self._kvmig_peer_allowed(url):
+            return   # budget/backoff: drop; the plane re-hints past its
+            #          cooldown, and prefetch must never amplify load
+        import uuid as _uuid
+
+        from distributed_gpu_inference_tpu.runtime.kv_handoff import (
+            abort_message,
+            pack_export_request,
+            split_frames,
+        )
+
+        key = f"kvrep-{_uuid.uuid4().hex[:12]}"
+        tier = hint.get("tier")
+        if tier not in ("dev", "host", "spill"):
+            tier = "dev"
+        begun = False
+        try:
+            t_pull = time.monotonic()
+            # fp-keyed: we never saw the prompt — the warm exporter
+            # resolves the fingerprint to its own token ids, and the
+            # begin frame carries them back, so our HandoffReceiver
+            # commits into the radix keyed exactly as an admission probes
+            req_raw = pack_export_request(
+                key=key, token_ids=[],
+                model_name=eng.model_cfg.name,
+                block_size=eng.cfg.block_size,
+                int8_kv="k_scale" in eng.kv,
+                max_blocks=self._kvmig_max_blocks,
+                fp=fps[-1],
+            )
+            r = _faults.wrap_http(
+                "worker.kv.pull",
+                lambda: httpx.post(
+                    url + "/kv/export", content=req_raw,
+                    headers={"content-type": "application/octet-stream"},
+                    timeout=self._kvmig_timeout_s,
+                ),
+                worker=str(getattr(self, "fault_tag", "") or ""),
+            )
+            r.raise_for_status()
+            frames = split_frames(r.content)
+            if not frames:
+                # the exporter's fp→tokens map churned it out, or its
+                # cache evicted: an honest miss, not a peer failure
+                stats["replicate_miss"] += 1
+                self._kvmig_peer_result(url, ok=True)
+                return
+            committed = None
+            for frame in frames:
+                begun = True
+                res = self.kv_receiver(frame)
+                if res.get("state") == "committed":
+                    committed = res
+            if committed is None:
+                raise ValueError("kv export response ended without commit")
+            stats["replicated"] += 1
+            pull_bytes = sum(len(f) for f in frames)
+            stats["pull_bytes"] += pull_bytes
+            pull_ms = max(1, int((time.monotonic() - t_pull) * 1000.0))
+            stats[f"pull_bytes_{tier}"] = (
+                stats.get(f"pull_bytes_{tier}", 0) + pull_bytes)
+            stats[f"pull_ms_{tier}"] = (
+                stats.get(f"pull_ms_{tier}", 0) + pull_ms)
+            if hot is not None:
+                # advertise the adopted prefix so the next summary stops
+                # the hints (advisory like every entry: a shallower-than-
+                # hinted pull costs at most one partial re-prefill)
+                hot.note_fingerprints(fps)
+            self._kvmig_peer_result(url, ok=True)
+        except Exception as exc:  # noqa: BLE001 — prefetch is best-effort
+            stats["replicate_aborted"] += 1
+            permanent = (
+                isinstance(exc, httpx.HTTPStatusError)
+                and exc.response is not None
+                and 400 <= exc.response.status_code < 500
+            )
+            self._kvmig_peer_result(url, ok=False, permanent=permanent)
+            if begun:
                 try:
                     self.kv_receiver(abort_message(key))
                 except Exception:  # noqa: BLE001 — abort is best-effort

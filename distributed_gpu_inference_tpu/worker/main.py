@@ -479,7 +479,7 @@ class Worker:
                       "decode_rounds", "chunked_admissions",
                       "batched_waves", "preemptions", "resumes",
                       "preempted_too_often", "cancelled", "migrated",
-                      "abandoned"):
+                      "abandoned", "abandoned_predictive"):
                 out[k] = out.get(k, 0) + int(s.get(k, 0) or 0)
             for k in ("queue_depth", "active_slots"):
                 out[k] = out.get(k, 0) + int(s.get(k, 0) or 0)
@@ -700,6 +700,25 @@ class Worker:
                             except Exception:  # noqa: BLE001 — advisory
                                 pass
                 self._last_plane_id = plane_id
+            hints = resp.get("kv_replicate")
+            if hints:
+                # proactive prefix replication (round 20): the plane
+                # predicts a storm for prefixes we don't hold — hand the
+                # hints to the first migrate-capable engine, which pulls
+                # on a daemon thread under the reactive driver's own
+                # budget/backoff (never in this heartbeat loop)
+                for eng in self.engines.values():
+                    fn = getattr(eng, "kv_replicate", None)
+                    if fn is None:
+                        continue
+                    try:
+                        if fn(hints):
+                            self.stats["kv_replicate_hints"] = \
+                                self.stats.get("kv_replicate_hints", 0) \
+                                + len(hints)
+                            break
+                    except Exception:  # noqa: BLE001 — advisory prefetch
+                        pass
             if resp.get("stale_job") and self.current_job_id:
                 # the server requeued our claim (we looked dead): the
                 # in-flight inference cannot be cancelled mid-graph, but

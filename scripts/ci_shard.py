@@ -57,6 +57,8 @@ HEAVY = [
     "tests/test_engine_tp.py",
     "tests/test_flight_recorder.py",    # engine-backed recorder on/off
     #   byte-identity run + the control-plane round-trip suites
+    "tests/test_predictive.py",         # serving intelligence: calibration
+    #   convergence grids + predictive rebalance/abandonment suites
 ]
 
 ap = argparse.ArgumentParser()
